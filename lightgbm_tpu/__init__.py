@@ -1,0 +1,30 @@
+"""lightgbm_tpu — a TPU-native gradient boosting framework.
+
+A from-scratch re-design of the LightGBM feature set for TPUs: histogram
+tree learning as XLA/Pallas kernels, data-parallel training via
+``jax.sharding`` collectives over ICI/DCN, with the familiar
+``train()`` / ``Dataset`` / ``Booster`` / sklearn user surface.
+"""
+
+from .config import Config
+from .utils.log import LightGBMError, register_logger
+
+__version__ = "0.1.0"
+
+from .basic import Booster, Dataset  # noqa: E402
+from .engine import cv, train  # noqa: E402
+from .callback import (early_stopping, log_evaluation,  # noqa: E402
+                       record_evaluation, reset_parameter)
+
+try:  # sklearn wrappers are optional (sklearn may be absent)
+    from .sklearn import (LGBMClassifier, LGBMModel,  # noqa: E402
+                          LGBMRanker, LGBMRegressor)
+except ImportError:  # pragma: no cover
+    pass
+
+__all__ = [
+    "Config", "Dataset", "Booster", "train", "cv",
+    "early_stopping", "log_evaluation", "record_evaluation", "reset_parameter",
+    "LGBMModel", "LGBMClassifier", "LGBMRegressor", "LGBMRanker",
+    "LightGBMError", "register_logger",
+]

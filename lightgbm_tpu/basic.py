@@ -1,0 +1,366 @@
+"""User-facing ``Dataset`` and ``Booster``.
+
+TPU-native re-design of the reference python-package core (reference:
+python-package/lightgbm/basic.py — ``Dataset`` :1764 lazy construction with
+reference alignment, ``Booster`` :3586).  The reference goes through ctypes
+into the C API (src/c_api.cpp); here the "C API layer" is the in-process
+framework itself, so these classes orchestrate binning/training directly.
+Semantics preserved: lazy Dataset construction, valid sets binned against
+their training reference, ``free_raw_data``, Booster train/eval/predict/
+save/load surface.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .boosting import create_boosting
+from .config import Config, as_config, normalize_params
+from .io.dataset import Dataset as _InnerDataset
+from .io.parser import load_text_file
+from .metrics import create_metrics
+from .models.model_io import (model_to_json, model_to_string,
+                              objective_to_string, parse_model_string)
+from .models.tree import Tree
+from .objectives import create_objective
+from .utils import log
+
+
+class Dataset:
+    """Lazily-constructed binned dataset (reference basic.py:1764)."""
+
+    def __init__(self, data: Any, label: Optional[Sequence[float]] = None,
+                 reference: Optional["Dataset"] = None,
+                 weight: Optional[Sequence[float]] = None,
+                 group: Optional[Sequence[int]] = None,
+                 init_score: Optional[Sequence[float]] = None,
+                 feature_name: Union[str, List[str], None] = "auto",
+                 categorical_feature: Union[str, List, None] = "auto",
+                 params: Optional[Dict[str, Any]] = None,
+                 free_raw_data: bool = True, position=None):
+        self.data = data
+        self.label = label
+        self.reference = reference
+        self.weight = weight
+        self.group = group
+        self.init_score = init_score
+        self.feature_name = feature_name
+        self.categorical_feature = categorical_feature
+        self.params = dict(params or {})
+        self.free_raw_data = free_raw_data
+        self.position = position
+        self._inner: Optional[_InnerDataset] = None
+
+    # ------------------------------------------------------------ plumbing
+    def construct(self) -> "Dataset":
+        if self._inner is not None:
+            return self
+        params = dict(self.params)
+        ref_inner = None
+        if self.reference is not None:
+            self.reference.construct()
+            ref_inner = self.reference._inner
+            params = {**self.reference.params, **params}
+        cfg = Config(params)
+        data = self.data
+        if isinstance(data, (str, os.PathLike)):
+            arr, label, meta = load_text_file(str(data), cfg)
+            if self.label is None:
+                self.label = label
+            for k, v in meta.items():
+                if getattr(self, k, None) is None:
+                    setattr(self, k, v)
+            data = arr
+        fn = None if self.feature_name in ("auto", None) else list(self.feature_name)
+        cat = None if self.categorical_feature in ("auto", None) else \
+            list(self.categorical_feature)
+        self._inner = _InnerDataset.from_data(
+            data, label=self.label, config=cfg, weight=self.weight,
+            group=self.group, init_score=self.init_score, feature_names=fn,
+            categorical_feature=cat, reference=ref_inner)
+        if self._inner.metadata.position is None and self.position is not None:
+            self._inner.metadata.set_position(self.position)
+        if self.free_raw_data:
+            self.data = None
+        return self
+
+    def create_valid(self, data, label=None, **kwargs) -> "Dataset":
+        return Dataset(data, label=label, reference=self, **kwargs)
+
+    # ------------------------------------------------------------ accessors
+    @property
+    def inner(self) -> _InnerDataset:
+        self.construct()
+        return self._inner  # type: ignore[return-value]
+
+    def num_data(self) -> int:
+        return self.inner.num_data
+
+    def num_feature(self) -> int:
+        return self.inner.num_total_features
+
+    def get_label(self) -> np.ndarray:
+        return self.inner.metadata.label
+
+    def get_weight(self) -> Optional[np.ndarray]:
+        return self.inner.metadata.weight
+
+    def get_group(self) -> Optional[np.ndarray]:
+        qb = self.inner.metadata.query_boundaries
+        return None if qb is None else np.diff(qb)
+
+    def get_init_score(self) -> Optional[np.ndarray]:
+        return self.inner.metadata.init_score
+
+    def set_label(self, label) -> "Dataset":
+        self.label = label
+        if self._inner is not None:
+            self._inner.metadata.set_label(label)
+        return self
+
+    def set_weight(self, weight) -> "Dataset":
+        self.weight = weight
+        if self._inner is not None:
+            self._inner.metadata.set_weight(weight)
+        return self
+
+    def set_group(self, group) -> "Dataset":
+        self.group = group
+        if self._inner is not None:
+            self._inner.metadata.set_group(group)
+        return self
+
+    def set_init_score(self, init_score) -> "Dataset":
+        self.init_score = init_score
+        if self._inner is not None:
+            self._inner.metadata.set_init_score(init_score)
+        return self
+
+    @property
+    def feature_names(self) -> List[str]:
+        return self.inner.feature_names
+
+
+class Booster:
+    """Trained/trainable model handle (reference basic.py:3586)."""
+
+    def __init__(self, params: Optional[Dict[str, Any]] = None,
+                 train_set: Optional[Dataset] = None,
+                 model_file: Optional[str] = None,
+                 model_str: Optional[str] = None):
+        self.params = normalize_params(params)
+        self.best_iteration = -1
+        self.best_score: Dict[str, Dict[str, float]] = {}
+        self._gbdt = None
+        self._loaded: Optional[Dict[str, Any]] = None
+        self.train_set = train_set
+        if model_file is not None:
+            with open(model_file) as f:
+                model_str = f.read()
+        if model_str is not None:
+            self._loaded = parse_model_string(model_str)
+            return
+        if train_set is None:
+            log.fatal("Booster requires train_set or a model to load")
+        train_set.params = {**train_set.params, **{
+            k: v for k, v in self.params.items()}}
+        train_set.construct()
+        cfg = Config(self.params)
+        self._cfg = cfg
+        self._gbdt = create_boosting(cfg, train_set.inner)
+
+    # ------------------------------------------------------------ training
+    def add_valid(self, data: Dataset, name: str) -> "Booster":
+        data.construct()
+        self._gbdt.add_valid(data.inner, name)
+        return self
+
+    def update(self, train_set: Optional[Dataset] = None, fobj=None) -> bool:
+        """One boosting round (reference Booster.update →
+        LGBM_BoosterUpdateOneIter c_api.h:765; custom fobj → :793)."""
+        if fobj is None:
+            return self._gbdt.train_one_iter()
+        if self._gbdt.objective is not None:
+            log.fatal("Cannot use fobj with a built-in objective; set "
+                      "objective=none")
+        grad, hess = fobj(self._current_train_preds(), self.train_set)
+        return self._gbdt.train_one_iter(np.asarray(grad), np.asarray(hess))
+
+    def _current_train_preds(self) -> np.ndarray:
+        return self._gbdt._host_scores(self._gbdt.scores)
+
+    def rollback_one_iter(self) -> "Booster":
+        self._gbdt.rollback_one_iter()
+        return self
+
+    @property
+    def current_iteration(self):
+        return self._gbdt.current_iteration
+
+    def num_trees(self) -> int:
+        return self._gbdt.num_trees() if self._gbdt else \
+            len(self._loaded["trees"])
+
+    def num_model_per_iteration(self) -> int:
+        return self._gbdt.num_tree_per_iteration if self._gbdt else \
+            self._loaded["num_tree_per_iteration"]
+
+    # ---------------------------------------------------------- evaluation
+    def eval_train(self):
+        return self._gbdt.eval_train()
+
+    def eval_valid(self):
+        return self._gbdt.eval_valid()
+
+    # ---------------------------------------------------------- prediction
+    def predict(self, data: Any, start_iteration: int = 0,
+                num_iteration: Optional[int] = None, raw_score: bool = False,
+                pred_leaf: bool = False, pred_contrib: bool = False,
+                **kwargs) -> np.ndarray:
+        X = self._to_matrix(data)
+        if num_iteration is None:
+            num_iteration = self.best_iteration if self.best_iteration > 0 else -1
+        if self._gbdt is not None:
+            if pred_contrib:
+                return self._predict_contrib(X, start_iteration, num_iteration)
+            return self._gbdt.predict(X, raw_score=raw_score,
+                                      start_iteration=start_iteration,
+                                      num_iteration=num_iteration,
+                                      pred_leaf=pred_leaf)
+        return self._predict_loaded(X, start_iteration, num_iteration,
+                                    raw_score, pred_leaf, pred_contrib)
+
+    def _to_matrix(self, data: Any) -> np.ndarray:
+        if hasattr(data, "to_numpy"):
+            return data.to_numpy(dtype=np.float64, na_value=np.nan)
+        if hasattr(data, "toarray"):
+            return np.asarray(data.toarray(), np.float64)
+        return np.asarray(data, np.float64)
+
+    def _predict_loaded(self, X, start_iteration, num_iteration, raw_score,
+                        pred_leaf, pred_contrib) -> np.ndarray:
+        trees = self._loaded["trees"]
+        k = self._loaded["num_tree_per_iteration"]
+        total_iters = len(trees) // k if k else 0
+        end = total_iters if num_iteration is None or num_iteration <= 0 else \
+            min(total_iters, start_iteration + num_iteration)
+        if X.ndim == 1:
+            X = X.reshape(1, -1)
+        if pred_leaf:
+            leaves = [trees[it * k + c].predict_leaf_index(X)
+                      for it in range(start_iteration, end) for c in range(k)]
+            return np.stack(leaves, axis=1)
+        out = np.zeros((X.shape[0], k))
+        for it in range(start_iteration, end):
+            for c in range(k):
+                out[:, c] += trees[it * k + c].predict(X)
+        obj_tokens = self._loaded["objective"].split(" ")
+        obj = obj_tokens[0]
+        if not raw_score:
+            if obj == "binary":
+                sig = 1.0
+                for tok in obj_tokens[1:]:
+                    if tok.startswith("sigmoid:"):
+                        sig = float(tok.split(":")[1])
+                out = 1.0 / (1.0 + np.exp(-sig * out))
+            elif obj in ("multiclass",):
+                ex = np.exp(out - out.max(axis=1, keepdims=True))
+                out = ex / ex.sum(axis=1, keepdims=True)
+            elif obj in ("multiclassova", "cross_entropy"):
+                out = 1.0 / (1.0 + np.exp(-out))
+            elif obj in ("poisson", "gamma", "tweedie"):
+                out = np.exp(out)
+            elif obj == "cross_entropy_lambda":
+                out = np.log1p(np.exp(out))
+            elif obj == "regression" and "sqrt" in obj_tokens[1:]:
+                out = np.sign(out) * out * out
+        return out[:, 0] if k == 1 else out
+
+    def _predict_contrib(self, X, start_iteration, num_iteration):
+        log.fatal("pred_contrib (SHAP) is not implemented yet")
+
+    # ------------------------------------------------------------- im/export
+    def model_to_string(self, num_iteration: Optional[int] = None,
+                        start_iteration: int = 0) -> str:
+        if self._gbdt is None:
+            # re-serialize loaded model
+            d = self._loaded
+            return model_to_string(
+                d["trees"], num_class=d["num_class"],
+                num_tree_per_iteration=d["num_tree_per_iteration"],
+                max_feature_idx=d["max_feature_idx"],
+                objective_str=d["objective"], feature_names=d["feature_names"],
+                feature_infos=d["feature_infos"], params={})
+        g = self._gbdt
+        ds = g.train_set
+        k = g.num_tree_per_iteration
+        if num_iteration is None:
+            num_iteration = self.best_iteration if self.best_iteration > 0 else -1
+        total_iters = len(g.models) // k
+        end = total_iters if num_iteration is None or num_iteration <= 0 else \
+            min(total_iters, start_iteration + num_iteration)
+        trees = [g.models[it * k + c] for it in range(start_iteration, end)
+                 for c in range(k)]
+        feature_infos = []
+        for j in range(ds.num_total_features):
+            m = ds.mappers[j]
+            if m.is_trivial():
+                feature_infos.append("none")
+            elif m.bin_type == 1:
+                feature_infos.append(
+                    ":".join(str(c) for c in m.bin_2_categorical) or "none")
+            else:
+                feature_infos.append(f"[{m.min_val:g}:{m.max_val:g}]")
+        obj_str = objective_to_string(
+            g.objective.NAME if g.objective else "none", g.config)
+        return model_to_string(
+            trees, num_class=g.num_class, num_tree_per_iteration=k,
+            max_feature_idx=ds.num_total_features - 1, objective_str=obj_str,
+            feature_names=ds.feature_names, feature_infos=feature_infos,
+            params=g.config._explicit)
+
+    def save_model(self, filename: str, num_iteration: Optional[int] = None,
+                   start_iteration: int = 0, **kwargs) -> "Booster":
+        with open(filename, "w") as f:
+            f.write(self.model_to_string(num_iteration, start_iteration))
+        return self
+
+    def dump_model(self, num_iteration: Optional[int] = None,
+                   start_iteration: int = 0) -> str:
+        if self._gbdt is not None:
+            g = self._gbdt
+            k = g.num_tree_per_iteration
+            return model_to_json(
+                g.models, num_class=g.num_class, num_tree_per_iteration=k,
+                max_feature_idx=g.train_set.num_total_features - 1,
+                objective_str=objective_to_string(
+                    g.objective.NAME if g.objective else "none", g.config),
+                feature_names=g.train_set.feature_names)
+        d = self._loaded
+        return model_to_json(
+            d["trees"], num_class=d["num_class"],
+            num_tree_per_iteration=d["num_tree_per_iteration"],
+            max_feature_idx=d["max_feature_idx"],
+            objective_str=d["objective"], feature_names=d["feature_names"])
+
+    def feature_importance(self, importance_type: str = "split",
+                           iteration: Optional[int] = None) -> np.ndarray:
+        trees = (self._gbdt.models if self._gbdt else self._loaded["trees"])
+        nf = (self._gbdt.train_set.num_total_features if self._gbdt
+              else self._loaded["max_feature_idx"] + 1)
+        imp = np.zeros(nf)
+        for t in trees:
+            for i in range(t.num_leaves - 1):
+                if importance_type == "split":
+                    imp[t.split_feature[i]] += 1
+                else:
+                    imp[t.split_feature[i]] += max(float(t.split_gain[i]), 0.0)
+        return imp
+
+    def feature_name(self) -> List[str]:
+        if self._gbdt is not None:
+            return self._gbdt.train_set.feature_names
+        return self._loaded["feature_names"]

@@ -1,0 +1,20 @@
+"""Boosting drivers (reference src/boosting/boosting.cpp:34 factory)."""
+
+from ..config import Config
+from ..io.dataset import Dataset
+from .gbdt import GBDT
+
+
+def create_boosting(config: Config, train_set: Dataset) -> GBDT:
+    """reference Boosting::CreateBoosting — gbdt / dart / rf / goss."""
+    from .dart import DART
+    from .rf import RF
+    kind = config.boosting
+    if kind == "gbdt":
+        return GBDT(config, train_set)
+    if kind == "dart":
+        return DART(config, train_set)
+    if kind == "rf":
+        return RF(config, train_set)
+    from ..utils import log
+    log.fatal(f"Unknown boosting type: {kind}")

@@ -1,0 +1,87 @@
+"""DART boosting (Dropouts meet Multiple Additive Regression Trees).
+
+TPU-native re-design of the reference DART driver (reference:
+src/boosting/dart.hpp ``DART : GBDT`` — per-iteration random tree dropout,
+training against the residual of the non-dropped ensemble, then
+normalization: dropped trees scaled k/(k+1), the new tree 1/(k+1)
+(xgboost_dart_mode: k/(k+lr) and lr/(k+lr)); uniform_drop/skip_drop/max_drop
+semantics follow dart.hpp).
+
+Score bookkeeping is incremental on train AND valid tensors (the reference
+re-adds via score updater the same way); tree contributions always exclude
+the folded boost-from-average bias, which the score tensors track
+separately, and rescaling uses ``Tree.scale_contribution`` so the bias
+survives normalization.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..models.predict import predict_bins_tree
+from .gbdt import GBDT, _tree_to_arrays_stub
+
+
+class DART(GBDT):
+    def __init__(self, config, train_set, objective=None, metrics=None):
+        super().__init__(config, train_set, objective, metrics)
+        self._drop_rng = np.random.default_rng(config.drop_seed)
+
+    def _add_contrib(self, tree, cls_idx: int, factor: float) -> None:
+        """Add ``factor`` x the tree's own contribution to train and valid
+        score tensors."""
+        arrs = _tree_to_arrays_stub(tree, self.train_set, exclude_bias=True)
+        contrib = predict_bins_tree(arrs, self.bins, self.nan_bin_arr)
+        self.scores = self.scores.at[:, cls_idx].add(contrib * factor)
+        for vi in range(len(self.valid_sets)):
+            vc = predict_bins_tree(arrs, self._valid_bins[vi], self.nan_bin_arr)
+            self.valid_scores[vi] = \
+                self.valid_scores[vi].at[:, cls_idx].add(vc * factor)
+
+    def train_one_iter(self, grad=None, hess=None) -> bool:
+        drop_idx = self._select_drop()
+        k = len(drop_idx)
+        ktrees = self.num_tree_per_iteration
+        for ti in drop_idx:
+            self._add_contrib(self.models[ti], ti % ktrees, -1.0)
+
+        start_model = len(self.models)
+        finished = super().train_one_iter(grad, hess)
+
+        if k > 0:
+            lr = self.shrinkage_rate
+            if self.config.xgboost_dart_mode:
+                new_scale = lr / (k + lr)
+                old_scale = k / (k + lr)
+            else:
+                new_scale = 1.0 / (k + 1.0)
+                old_scale = k / (k + 1.0)
+            # shrink the new trees' contribution from full lr to lr*new_scale
+            for ti in range(start_model, len(self.models)):
+                self._add_contrib(self.models[ti], ti % ktrees,
+                                  new_scale - 1.0)
+                self.models[ti].scale_contribution(new_scale)
+            # scale dropped trees down, then re-add their reduced contribution
+            for ti in drop_idx:
+                self.models[ti].scale_contribution(old_scale)
+                self._add_contrib(self.models[ti], ti % ktrees, 1.0)
+        return finished
+
+    def _select_drop(self):
+        n_models = len(self.models)
+        if n_models == 0:
+            return []
+        if self._drop_rng.random() < self.config.skip_drop:
+            return []
+        rate = self.config.drop_rate
+        if self.config.uniform_drop:
+            mask = self._drop_rng.random(n_models) < rate
+            idx = np.nonzero(mask)[0]
+        else:
+            k = max(1, int(n_models * rate))
+            idx = self._drop_rng.choice(n_models, size=min(k, n_models),
+                                        replace=False)
+        if self.config.max_drop > 0 and len(idx) > self.config.max_drop:
+            idx = self._drop_rng.choice(idx, size=self.config.max_drop,
+                                        replace=False)
+        return sorted(int(i) for i in idx)

@@ -1,0 +1,41 @@
+"""Random-forest mode.
+
+TPU-native re-design of the reference RF driver (reference:
+src/boosting/rf.hpp ``RF : GBDT`` — bagging required, no shrinkage,
+gradients always evaluated at the constant init score, ensemble output is
+the AVERAGE of trees).  Averaging is materialized by scaling every tree by
+1/num_iterations (known up front), which keeps saved models self-contained;
+the reference instead re-normalizes scores incrementally.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .gbdt import GBDT
+
+
+class RF(GBDT):
+    def __init__(self, config, train_set, objective=None, metrics=None):
+        super().__init__(config, train_set, objective, metrics)
+        self.shrinkage_rate = 1.0 / max(1, int(config.num_iterations))
+        # constant score at which gradients are evaluated
+        self._grad_scores = self.scores
+
+    def boosting_gradients(self):
+        if self.num_tree_per_iteration == 1:
+            g, h = self.objective.get_gradients(self._grad_scores[:, 0])
+            return g[:, None], h[:, None]
+        return self.objective.get_gradients(self._grad_scores)
+
+    def _host_scores(self, scores):
+        """Mid-training scores hold (sum of t trees)/T; rescale to the
+        running average over t trees so metrics/early-stopping see the true
+        ensemble (reference rf.hpp renormalizes incrementally)."""
+        s = np.asarray(scores, np.float64)
+        t = max(self.iter_, 1)
+        T = max(1, int(self.config.num_iterations))
+        init = self.init_scores[None, :]
+        s = init + (s - init) * (T / t)
+        return s[:, 0] if s.shape[1] == 1 else s

@@ -1,0 +1,134 @@
+"""Row sampling strategies: bagging and GOSS.
+
+TPU-native re-design of the reference sampling layer (reference:
+src/boosting/sample_strategy.{h,cpp} factory, src/boosting/bagging.hpp
+``BaggingSampleStrategy``, src/boosting/goss.hpp ``GOSSStrategy``).
+
+The reference materializes index subsets (``bag_data_indices_``) and
+optionally copies a row subset of the Dataset; on TPU rows never move —
+sampling is a boolean ``row_mask`` the histogram kernel folds into the value
+channels, and GOSS's small-gradient amplification multiplies grad/hess
+in place ((1-top_rate)/other_rate, goss.hpp:85-130).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..io.dataset import Metadata
+from ..utils import log
+
+
+class SampleStrategy:
+    def __init__(self, config: Config, num_data: int):
+        self.config = config
+        self.num_data = num_data
+
+    def sample(self, iter_: int, grad: jax.Array, hess: jax.Array,
+               rng: np.random.Generator, metadata: Metadata
+               ) -> Tuple[Optional[jax.Array], jax.Array, jax.Array]:
+        """Returns (row_mask or None, grad, hess) — grad/hess possibly
+        reweighted (GOSS)."""
+        return None, grad, hess
+
+
+class BaggingSampleStrategy(SampleStrategy):
+    """bagging_fraction / bagging_freq / pos+neg bagging
+    (reference bagging.hpp)."""
+
+    def __init__(self, config: Config, num_data: int):
+        super().__init__(config, num_data)
+        self._mask: Optional[jax.Array] = None
+        self._use_pos_neg = (config.pos_bagging_fraction < 1.0 or
+                             config.neg_bagging_fraction < 1.0)
+        self._rng = np.random.default_rng(config.bagging_seed)
+
+    def _need_resample(self, iter_: int) -> bool:
+        freq = self.config.bagging_freq
+        if freq <= 0:
+            return False
+        full = (self.config.bagging_fraction < 1.0) or self._use_pos_neg
+        if not full:
+            return False
+        return iter_ % freq == 0
+
+    def sample(self, iter_, grad, hess, rng, metadata):
+        if self.config.bagging_freq <= 0 or (
+                self.config.bagging_fraction >= 1.0 and not self._use_pos_neg):
+            return None, grad, hess
+        if self._need_resample(iter_) or self._mask is None:
+            n = self.num_data
+            if self._use_pos_neg:
+                lbl = np.asarray(metadata.label) > 0
+                m = np.zeros(n, bool)
+                m[lbl] = self._rng.random(int(lbl.sum())) < \
+                    self.config.pos_bagging_fraction
+                m[~lbl] = self._rng.random(int((~lbl).sum())) < \
+                    self.config.neg_bagging_fraction
+            elif self.config.bagging_by_query and \
+                    metadata.query_boundaries is not None:
+                qb = metadata.query_boundaries
+                nq = len(qb) - 1
+                qm = self._rng.random(nq) < self.config.bagging_fraction
+                m = np.zeros(n, bool)
+                for qi in np.nonzero(qm)[0]:
+                    m[qb[qi]:qb[qi + 1]] = True
+            else:
+                m = self._rng.random(n) < self.config.bagging_fraction
+            if not m.any():
+                m[self._rng.integers(0, n)] = True
+            self._mask = jnp.asarray(m)
+        return self._mask, grad, hess
+
+
+class GOSSStrategy(SampleStrategy):
+    """Gradient-based one-side sampling (reference goss.hpp:18).
+
+    Keep the top ``top_rate`` fraction by |g|*sqrt(h), uniformly sample
+    ``other_rate`` of the rest and amplify their grad/hess by
+    (1 - top_rate) / other_rate.
+    """
+
+    def __init__(self, config: Config, num_data: int):
+        super().__init__(config, num_data)
+        if config.top_rate + config.other_rate > 1.0:
+            log.fatal("top_rate + other_rate cannot be larger than 1.0")
+        self._key = jax.random.PRNGKey(config.bagging_seed)
+
+    def sample(self, iter_, grad, hess, rng, metadata):
+        # reference starts GOSS after 1/learning_rate warmup iterations
+        warmup = min(int(1.0 / max(self.config.learning_rate, 1e-6)),
+                     self.config.num_iterations // 2)
+        if iter_ < warmup:
+            return None, grad, hess
+        n = self.num_data
+        a, b = self.config.top_rate, self.config.other_rate
+        top_k = max(1, int(n * a))
+        score = jnp.sum(jnp.abs(grad) * jnp.sqrt(jnp.abs(hess) + 1e-12), axis=1)
+        thresh = -jnp.sort(-score)[top_k - 1]
+        is_top = score >= thresh
+        if b <= 0.0:
+            return is_top, grad, hess
+        other_k = max(1, int(n * b))
+        self._key, sub = jax.random.split(self._key)
+        u = jax.random.uniform(sub, (n,))
+        # sample from the non-top pool with probability other_k / pool_size
+        pool = jnp.maximum(n - jnp.sum(is_top), 1)
+        p_other = jnp.minimum(other_k / pool, 1.0)
+        is_other = (~is_top) & (u < p_other)
+        mask = is_top | is_other
+        amp = (1.0 - a) / b
+        mult = jnp.where(is_other, amp, 1.0)[:, None]
+        return mask, grad * mult, hess * mult
+
+
+def create_sample_strategy(config: Config, num_data: int) -> SampleStrategy:
+    """Factory (reference sample_strategy.cpp:12-22)."""
+    if config.data_sample_strategy == "goss":
+        return GOSSStrategy(config, num_data)
+    return BaggingSampleStrategy(config, num_data)

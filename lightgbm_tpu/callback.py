@@ -1,0 +1,141 @@
+"""Training callbacks.
+
+TPU-native re-design of the reference callback system (reference:
+python-package/lightgbm/callback.py — ``early_stopping`` :278 with min_delta,
+``log_evaluation``, ``record_evaluation``, ``reset_parameter``;
+``CallbackEnv`` namedtuple).
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Callable, Dict, List
+
+from .utils import log
+
+CallbackEnv = collections.namedtuple(
+    "CallbackEnv",
+    ["model", "params", "iteration", "begin_iteration", "end_iteration",
+     "evaluation_result_list"])
+
+
+class EarlyStopException(Exception):
+    def __init__(self, best_iteration: int, best_score):
+        self.best_iteration = best_iteration
+        self.best_score = best_score
+
+
+def log_evaluation(period: int = 1, show_stdv: bool = True) -> Callable:
+    def _callback(env: CallbackEnv) -> None:
+        if period > 0 and env.evaluation_result_list and \
+                (env.iteration + 1) % period == 0:
+            parts = []
+            for item in env.evaluation_result_list:
+                if len(item) == 4:
+                    name, metric, val, _ = item
+                    parts.append(f"{name}'s {metric}: {val:g}")
+                else:
+                    name, metric, val, _, stdv = item
+                    parts.append(f"{name}'s {metric}: {val:g} + {stdv:g}"
+                                 if show_stdv else f"{name}'s {metric}: {val:g}")
+            log.info(f"[{env.iteration + 1}]\t" + "\t".join(parts))
+    _callback.order = 10
+    return _callback
+
+
+def record_evaluation(eval_result: Dict[str, Dict[str, List[float]]]) -> Callable:
+    if not isinstance(eval_result, dict):
+        raise TypeError("eval_result should be a dictionary")
+
+    def _init(env: CallbackEnv) -> None:
+        eval_result.clear()
+        for item in env.evaluation_result_list:
+            name, metric = item[0], item[1]
+            eval_result.setdefault(name, collections.OrderedDict())
+            eval_result[name].setdefault(metric, [])
+
+    def _callback(env: CallbackEnv) -> None:
+        if not eval_result:
+            _init(env)
+        for item in env.evaluation_result_list:
+            name, metric, val = item[0], item[1], item[2]
+            eval_result.setdefault(name, collections.OrderedDict())
+            eval_result[name].setdefault(metric, []).append(val)
+    _callback.order = 20
+    return _callback
+
+
+def reset_parameter(**kwargs: Any) -> Callable:
+    def _callback(env: CallbackEnv) -> None:
+        new_params = {}
+        for key, value in kwargs.items():
+            if isinstance(value, list):
+                if len(value) != env.end_iteration - env.begin_iteration:
+                    raise ValueError(f"Length of list {key!r} has to be equal "
+                                     "to number of boosting rounds")
+                new_params[key] = value[env.iteration - env.begin_iteration]
+            elif callable(value):
+                new_params[key] = value(env.iteration - env.begin_iteration)
+        if new_params:
+            if "learning_rate" in new_params:
+                env.model._gbdt.shrinkage_rate = float(
+                    new_params["learning_rate"])
+            env.params.update(new_params)
+    _callback.before_iteration = True
+    _callback.order = 10
+    return _callback
+
+
+def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
+                   verbose: bool = True, min_delta: float = 0.0) -> Callable:
+    """reference callback.py:278 — stop when no eval metric improves by more
+    than ``min_delta`` in ``stopping_rounds`` rounds."""
+    state: Dict[str, Any] = {}
+
+    def _is_better(curr, best, bigger, delta):
+        if bigger:
+            return curr > best + delta
+        return curr < best - delta
+
+    def _init(env: CallbackEnv) -> None:
+        if not env.evaluation_result_list:
+            raise ValueError(
+                "For early stopping, at least one dataset and eval metric is "
+                "required for evaluation")
+        state["best_score"] = [None] * len(env.evaluation_result_list)
+        state["best_iter"] = [0] * len(env.evaluation_result_list)
+        state["best_list"] = [None] * len(env.evaluation_result_list)
+        state["first_metric"] = env.evaluation_result_list[0][1]
+        if verbose:
+            log.info(f"Training until validation scores don't improve for "
+                     f"{stopping_rounds} rounds")
+
+    def _callback(env: CallbackEnv) -> None:
+        if not state:
+            _init(env)
+        best_score = state["best_score"]
+        best_iter = state["best_iter"]
+        for i, item in enumerate(env.evaluation_result_list):
+            name, metric, val, bigger = item[0], item[1], item[2], item[3]
+            if name == "training":
+                continue
+            if first_metric_only and metric.split("@")[0] != \
+                    state["first_metric"].split("@")[0]:
+                continue
+            if best_score[i] is None or _is_better(val, best_score[i], bigger,
+                                                   min_delta):
+                best_score[i] = val
+                best_iter[i] = env.iteration
+                state["best_list"][i] = list(env.evaluation_result_list)
+            elif env.iteration - best_iter[i] >= stopping_rounds:
+                if verbose:
+                    log.info(f"Early stopping, best iteration is: "
+                             f"[{best_iter[i] + 1}]")
+                raise EarlyStopException(best_iter[i], state["best_list"][i])
+            if env.iteration == env.end_iteration - 1:
+                if verbose:
+                    log.info(f"Did not meet early stopping. Best iteration is:"
+                             f" [{best_iter[i] + 1}]")
+                raise EarlyStopException(best_iter[i], state["best_list"][i])
+    _callback.order = 30
+    return _callback

@@ -1,0 +1,431 @@
+"""Parameter/config system.
+
+TPU-native re-design of the reference's config layer (reference:
+include/LightGBM/config.h:39 ``struct Config`` with 837 defaulted fields,
+src/io/config.cpp ``Config::Set`` and the generated alias table in
+src/io/config_auto.cpp).  The reference generates its alias table and setters
+from structured comments; here a single declarative ``_PARAMS`` registry plays
+that role (single source of truth for names, aliases, defaults and checks).
+
+Semantics preserved:
+  * alias resolution is first-wins per canonical name
+    (reference application.cpp:79 ``KeepFirstValues``),
+  * ``Config.set(params)`` accepts strings or typed values,
+  * ``check`` constraints mirror the reference's ``// check = ...`` comments,
+  * ``check_param_conflict`` fixes illegal combos (reference config.cpp).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+from .utils import log
+
+
+def _parse_bool(v: Any) -> bool:
+    if isinstance(v, bool):
+        return v
+    if isinstance(v, (int, float)):
+        return bool(v)
+    s = str(v).strip().lower()
+    if s in ("true", "1", "yes", "+"):
+        return True
+    if s in ("false", "0", "no", "-"):
+        return False
+    raise ValueError(f"cannot parse bool from {v!r}")
+
+
+def _parse_int_list(v: Any) -> List[int]:
+    if v is None or v == "":
+        return []
+    if isinstance(v, (list, tuple)):
+        return [int(x) for x in v]
+    return [int(x) for x in str(v).split(",") if x != ""]
+
+
+def _parse_float_list(v: Any) -> List[float]:
+    if v is None or v == "":
+        return []
+    if isinstance(v, (list, tuple)):
+        return [float(x) for x in v]
+    return [float(x) for x in str(v).split(",") if x != ""]
+
+
+def _parse_str_list(v: Any) -> List[str]:
+    if v is None or v == "":
+        return []
+    if isinstance(v, (list, tuple)):
+        return [str(x) for x in v]
+    return [s for s in str(v).split(",") if s != ""]
+
+
+# (name, default, aliases, check) — check is (op, bound) pairs like the
+# reference's `// check = >0` annotations (config.h:202-253).
+_PARAMS: List[Tuple[str, Any, Tuple[str, ...], Tuple[Tuple[str, float], ...]]] = [
+    # --- core (config.h "Core Parameters") ---
+    ("objective", "regression", ("objective_type", "app", "application", "loss"), ()),
+    ("boosting", "gbdt", ("boosting_type", "boost"), ()),
+    ("data_sample_strategy", "bagging", (), ()),
+    ("data", "", ("train", "train_data", "train_data_file", "data_filename"), ()),
+    ("valid", [], ("test", "valid_data", "valid_data_file", "test_data",
+                   "test_data_file", "valid_filenames"), ()),
+    ("num_iterations", 100, ("num_iteration", "n_iter", "num_tree", "num_trees",
+                             "num_round", "num_rounds", "nrounds", "num_boost_round",
+                             "n_estimators", "max_iter"), ((">=", 0),)),
+    ("learning_rate", 0.1, ("shrinkage_rate", "eta"), ((">", 0.0),)),
+    ("num_leaves", 31, ("num_leaf", "max_leaves", "max_leaf", "max_leaf_nodes"),
+     ((">", 1),)),
+    ("tree_learner", "serial", ("tree", "tree_type", "tree_learner_type"), ()),
+    ("num_threads", 0, ("num_thread", "nthread", "nthreads", "n_jobs"), ()),
+    ("device_type", "tpu", ("device",), ()),
+    ("seed", None, ("random_seed", "random_state"), ()),
+    ("deterministic", False, (), ()),
+    # --- learning control ---
+    ("force_col_wise", False, (), ()),
+    ("force_row_wise", False, (), ()),
+    ("histogram_pool_size", -1.0, ("hist_pool_size",), ()),
+    ("max_depth", -1, (), ()),
+    ("min_data_in_leaf", 20, ("min_data_per_leaf", "min_data", "min_child_samples",
+                              "min_samples_leaf"), ((">=", 0),)),
+    ("min_sum_hessian_in_leaf", 1e-3, ("min_sum_hessian_per_leaf", "min_sum_hessian",
+                                       "min_hessian", "min_child_weight"), ((">=", 0.0),)),
+    ("bagging_fraction", 1.0, ("sub_row", "subsample", "bagging"),
+     ((">", 0.0), ("<=", 1.0))),
+    ("pos_bagging_fraction", 1.0, ("pos_sub_row", "pos_subsample", "pos_bagging"),
+     ((">", 0.0), ("<=", 1.0))),
+    ("neg_bagging_fraction", 1.0, ("neg_sub_row", "neg_subsample", "neg_bagging"),
+     ((">", 0.0), ("<=", 1.0))),
+    ("bagging_freq", 0, ("subsample_freq",), ()),
+    ("bagging_seed", 3, ("bagging_fraction_seed",), ()),
+    ("bagging_by_query", False, (), ()),
+    ("feature_fraction", 1.0, ("sub_feature", "colsample_bytree"),
+     ((">", 0.0), ("<=", 1.0))),
+    ("feature_fraction_bynode", 1.0, ("sub_feature_bynode", "colsample_bynode"),
+     ((">", 0.0), ("<=", 1.0))),
+    ("feature_fraction_seed", 2, (), ()),
+    ("extra_trees", False, ("extra_tree",), ()),
+    ("extra_seed", 6, (), ()),
+    ("early_stopping_round", 0, ("early_stopping_rounds", "early_stopping",
+                                 "n_iter_no_change"), ()),
+    ("early_stopping_min_delta", 0.0, (), ((">=", 0.0),)),
+    ("first_metric_only", False, (), ()),
+    ("max_delta_step", 0.0, ("max_tree_output", "max_leaf_output"), ()),
+    ("lambda_l1", 0.0, ("reg_alpha", "l1_regularization"), ((">=", 0.0),)),
+    ("lambda_l2", 0.0, ("reg_lambda", "lambda", "l2_regularization"), ((">=", 0.0),)),
+    ("linear_lambda", 0.0, (), ((">=", 0.0),)),
+    ("min_gain_to_split", 0.0, ("min_split_gain",), ((">=", 0.0),)),
+    ("drop_rate", 0.1, ("rate_drop",), ((">=", 0.0), ("<=", 1.0))),
+    ("max_drop", 50, (), ()),
+    ("skip_drop", 0.5, (), ((">=", 0.0), ("<=", 1.0))),
+    ("xgboost_dart_mode", False, (), ()),
+    ("uniform_drop", False, (), ()),
+    ("drop_seed", 4, (), ()),
+    ("top_rate", 0.2, (), ((">=", 0.0), ("<=", 1.0))),
+    ("other_rate", 0.1, (), ((">=", 0.0), ("<=", 1.0))),
+    ("min_data_per_group", 100, (), ((">", 0),)),
+    ("max_cat_threshold", 32, (), ((">", 0),)),
+    ("cat_l2", 10.0, (), ((">=", 0.0),)),
+    ("cat_smooth", 10.0, (), ((">=", 0.0),)),
+    ("max_cat_to_onehot", 4, (), ((">", 0),)),
+    ("top_k", 20, ("topk",), ((">", 0),)),
+    ("monotone_constraints", [], ("mc", "monotone_constraint", "monotonic_cst"), ()),
+    ("monotone_constraints_method", "basic", ("monotone_constraining_method", "mc_method"), ()),
+    ("monotone_penalty", 0.0, ("monotone_splits_penalty", "ms_penalty", "mc_penalty"),
+     ((">=", 0.0),)),
+    ("feature_contri", [], ("feature_contrib", "fc", "fp", "feature_penalty"), ()),
+    ("forcedsplits_filename", "", ("fs", "forced_splits_filename", "forced_splits_file",
+                                   "forced_splits"), ()),
+    ("refit_decay_rate", 0.9, (), ((">=", 0.0), ("<=", 1.0))),
+    ("cegb_tradeoff", 1.0, (), ((">=", 0.0),)),
+    ("cegb_penalty_split", 0.0, (), ((">=", 0.0),)),
+    ("cegb_penalty_feature_lazy", [], (), ()),
+    ("cegb_penalty_feature_coupled", [], (), ()),
+    ("path_smooth", 0.0, (), ((">=", 0.0),)),
+    ("interaction_constraints", "", (), ()),
+    ("verbosity", 1, ("verbose",), ()),
+    ("snapshot_freq", -1, ("save_period",), ()),
+    ("use_quantized_grad", False, (), ()),
+    ("num_grad_quant_bins", 4, (), ()),
+    ("quant_train_renew_leaf", False, (), ()),
+    ("stochastic_rounding", True, (), ()),
+    # --- dataset (config.h "Dataset Parameters") ---
+    ("max_bin", 255, ("max_bins",), ((">", 1),)),
+    ("max_bin_by_feature", [], (), ()),
+    ("min_data_in_bin", 3, (), ((">", 0),)),
+    ("bin_construct_sample_cnt", 200000, ("subsample_for_bin",), ((">", 0),)),
+    ("data_random_seed", 1, ("data_seed",), ()),
+    ("is_enable_sparse", True, ("is_sparse", "enable_sparse", "sparse"), ()),
+    ("enable_bundle", True, ("is_enable_bundle", "bundle"), ()),
+    ("use_missing", True, (), ()),
+    ("zero_as_missing", False, (), ()),
+    ("feature_pre_filter", True, (), ()),
+    ("pre_partition", False, ("is_pre_partition",), ()),
+    ("two_round", False, ("two_round_loading", "use_two_round_loading"), ()),
+    ("header", False, ("has_header",), ()),
+    ("label_column", "", ("label",), ()),
+    ("weight_column", "", ("weight",), ()),
+    ("group_column", "", ("group", "group_id", "query_column", "query", "query_id"), ()),
+    ("ignore_column", "", ("ignore_feature", "blacklist"), ()),
+    ("categorical_feature", "", ("cat_feature", "categorical_column", "cat_column",
+                                 "categorical_features"), ()),
+    ("forcedbins_filename", "", (), ()),
+    ("save_binary", False, ("is_save_binary", "is_save_binary_file"), ()),
+    ("precise_float_parser", False, (), ()),
+    ("parser_config_file", "", (), ()),
+    ("linear_tree", False, ("linear_trees",), ()),
+    # --- predict ---
+    ("start_iteration_predict", 0, (), ()),
+    ("num_iteration_predict", -1, (), ()),
+    ("predict_raw_score", False, ("is_predict_raw_score", "predict_rawscore",
+                                  "raw_score"), ()),
+    ("predict_leaf_index", False, ("is_predict_leaf_index", "leaf_index"), ()),
+    ("predict_contrib", False, ("is_predict_contrib", "contrib"), ()),
+    ("predict_disable_shape_check", False, (), ()),
+    ("pred_early_stop", False, (), ()),
+    ("pred_early_stop_freq", 10, (), ()),
+    ("pred_early_stop_margin", 10.0, (), ()),
+    # --- convert ---
+    ("convert_model_language", "", (), ()),
+    ("convert_model", "gbdt_prediction.cpp", ("convert_model_file",), ()),
+    # --- objective (config.h "Objective Parameters") ---
+    ("objective_seed", 5, (), ()),
+    ("num_class", 1, ("num_classes",), ((">", 0),)),
+    ("is_unbalance", False, ("unbalance", "unbalanced_sets"), ()),
+    ("scale_pos_weight", 1.0, (), ((">", 0.0),)),
+    ("sigmoid", 1.0, (), ((">", 0.0),)),
+    ("boost_from_average", True, (), ()),
+    ("reg_sqrt", False, (), ()),
+    ("alpha", 0.9, (), ((">", 0.0),)),
+    ("fair_c", 1.0, (), ((">", 0.0),)),
+    ("poisson_max_delta_step", 0.7, (), ((">", 0.0),)),
+    ("tweedie_variance_power", 1.5, (), ((">=", 1.0), ("<", 2.0))),
+    ("lambdarank_truncation_level", 30, (), ((">", 0),)),
+    ("lambdarank_norm", True, (), ()),
+    ("label_gain", [], (), ()),
+    ("lambdarank_position_bias_regularization", 0.0, (), ((">=", 0.0),)),
+    # --- metric ---
+    ("metric", [], ("metrics", "metric_types"), ()),
+    ("metric_freq", 1, ("output_freq",), ((">", 0),)),
+    ("is_provide_training_metric", False, ("training_metric", "is_training_metric",
+                                           "train_metric"), ()),
+    ("eval_at", [1, 2, 3, 4, 5], ("ndcg_eval_at", "ndcg_at", "map_eval_at", "map_at"), ()),
+    ("multi_error_top_k", 1, (), ((">", 0),)),
+    ("auc_mu_weights", [], (), ()),
+    # --- network (config.h:1086-1110); on TPU these describe the JAX mesh ---
+    ("num_machines", 1, ("num_machine",), ((">", 0),)),
+    ("local_listen_port", 12400, ("local_port", "port"), ()),
+    ("time_out", 120, (), ((">", 0),)),
+    ("machine_list_filename", "", ("machine_list_file", "machine_list", "mlist"), ()),
+    ("machines", "", ("workers", "nodes"), ()),
+    # --- device / TPU (replaces reference GPU params config.h:1113-1150) ---
+    ("gpu_platform_id", -1, (), ()),
+    ("gpu_device_id", -1, (), ()),
+    ("gpu_use_dp", False, (), ()),
+    ("num_gpu", 1, (), ((">", 0),)),
+    ("tpu_hist_dtype", "float32", (), ()),       # histogram accumulator dtype
+    ("tpu_rows_per_block", 16384, (), ()),        # histogram kernel row tile
+    ("tpu_donate_scores", True, (), ()),
+]
+
+_CANONICAL: Dict[str, Any] = {name: default for name, default, _, _ in _PARAMS}
+_ALIASES: Dict[str, str] = {}
+for _name, _default, _aliases, _checks in _PARAMS:
+    _ALIASES[_name] = _name
+    for _a in _aliases:
+        _ALIASES[_a] = _name
+_CHECKS: Dict[str, Tuple[Tuple[str, float], ...]] = {
+    name: checks for name, _, _, checks in _PARAMS if checks
+}
+
+# objective aliases resolved inside the objective string itself
+# (reference config.cpp ParseObjectiveAlias)
+_OBJECTIVE_ALIASES = {
+    "regression": "regression", "regression_l2": "regression", "l2": "regression",
+    "mean_squared_error": "regression", "mse": "regression", "l2_root": "regression",
+    "root_mean_squared_error": "regression", "rmse": "regression",
+    "regression_l1": "regression_l1", "l1": "regression_l1",
+    "mean_absolute_error": "regression_l1", "mae": "regression_l1",
+    "huber": "huber", "fair": "fair", "poisson": "poisson", "quantile": "quantile",
+    "mape": "mape", "mean_absolute_percentage_error": "mape",
+    "gamma": "gamma", "tweedie": "tweedie",
+    "binary": "binary", "binary_logloss": "binary",
+    "multiclass": "multiclass", "softmax": "multiclass",
+    "multiclassova": "multiclassova", "multiclass_ova": "multiclassova",
+    "ova": "multiclassova", "ovr": "multiclassova",
+    "cross_entropy": "cross_entropy", "xentropy": "cross_entropy",
+    "cross_entropy_lambda": "cross_entropy_lambda", "xentlambda": "cross_entropy_lambda",
+    "lambdarank": "lambdarank", "rank_xendcg": "rank_xendcg",
+    "xendcg": "rank_xendcg", "xe_ndcg": "rank_xendcg", "xe_ndcg_mart": "rank_xendcg",
+    "xendcg_mart": "rank_xendcg",
+    "none": "none", "null": "none", "custom": "none", "na": "none",
+}
+
+_METRIC_ALIASES = {
+    "l1": "l1", "mean_absolute_error": "l1", "mae": "l1", "regression_l1": "l1",
+    "l2": "l2", "mean_squared_error": "l2", "mse": "l2", "regression": "l2",
+    "regression_l2": "l2",
+    "rmse": "rmse", "root_mean_squared_error": "rmse", "l2_root": "rmse",
+    "quantile": "quantile", "huber": "huber", "fair": "fair", "poisson": "poisson",
+    "mape": "mape", "mean_absolute_percentage_error": "mape",
+    "gamma": "gamma", "gamma_deviance": "gamma_deviance", "tweedie": "tweedie",
+    "binary_logloss": "binary_logloss", "binary": "binary_logloss",
+    "binary_error": "binary_error",
+    "auc": "auc", "average_precision": "average_precision",
+    "auc_mu": "auc_mu",
+    "multi_logloss": "multi_logloss", "multiclass": "multi_logloss",
+    "softmax": "multi_logloss", "multiclassova": "multi_logloss",
+    "multiclass_ova": "multi_logloss", "ova": "multi_logloss", "ovr": "multi_logloss",
+    "multi_error": "multi_error",
+    "ndcg": "ndcg", "lambdarank": "ndcg", "rank_xendcg": "ndcg", "xendcg": "ndcg",
+    "xe_ndcg": "ndcg", "xe_ndcg_mart": "ndcg", "xendcg_mart": "ndcg",
+    "map": "map", "mean_average_precision": "map",
+    "cross_entropy": "cross_entropy", "xentropy": "cross_entropy",
+    "cross_entropy_lambda": "cross_entropy_lambda", "xentlambda": "cross_entropy_lambda",
+    "kullback_leibler": "kullback_leibler", "kldiv": "kullback_leibler",
+    "none": "none", "null": "none", "custom": "none", "na": "none",
+}
+
+
+def resolve_objective_alias(name: str) -> str:
+    return _OBJECTIVE_ALIASES.get(str(name).strip().lower(), str(name))
+
+
+def resolve_metric_alias(name: str) -> str:
+    return _METRIC_ALIASES.get(str(name).strip().lower(), str(name))
+
+
+def normalize_params(params: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """Resolve aliases first-wins into canonical names (application.cpp:79)."""
+    out: Dict[str, Any] = {}
+    if not params:
+        return out
+    for k, v in params.items():
+        canon = _ALIASES.get(str(k).strip().lower())
+        if canon is None:
+            log.warning(f"Unknown parameter: {k}")
+            continue
+        if canon in out:
+            log.warning(f"{k} is set={v}, {canon}={out[canon]} will be used. "
+                        f"Current value: {canon}={out[canon]}")
+            continue
+        out[canon] = v
+    return out
+
+
+class Config:
+    """Flat runtime config; attribute access for every canonical parameter."""
+
+    def __init__(self, params: Optional[Dict[str, Any]] = None, **kwargs: Any):
+        self._explicit: Dict[str, Any] = {}
+        for name, default in _CANONICAL.items():
+            object.__setattr__(self, name, default() if callable(default) else
+                               (list(default) if isinstance(default, list) else default))
+        merged = dict(params or {})
+        merged.update(kwargs)
+        self.set(merged)
+
+    def set(self, params: Dict[str, Any]) -> "Config":
+        canon = normalize_params(params)
+        for name, value in canon.items():
+            setattr(self, name, self._coerce(name, value))
+            self._explicit[name] = getattr(self, name)
+        self._post_process()
+        return self
+
+    def is_explicit(self, name: str) -> bool:
+        return name in self._explicit
+
+    @staticmethod
+    def _coerce(name: str, value: Any) -> Any:
+        default = _CANONICAL[name]
+        try:
+            if name == "seed":
+                return None if value is None else int(value)
+            if isinstance(default, bool):
+                v: Any = _parse_bool(value)
+            elif isinstance(default, int):
+                v = int(float(value)) if not isinstance(value, int) else value
+            elif isinstance(default, float):
+                v = float(value)
+            elif isinstance(default, list):
+                if default and isinstance(default[0], int) or name in (
+                        "eval_at", "max_bin_by_feature", "monotone_constraints"):
+                    v = _parse_int_list(value)
+                elif name in ("label_gain", "feature_contri", "auc_mu_weights",
+                              "cegb_penalty_feature_lazy", "cegb_penalty_feature_coupled"):
+                    v = _parse_float_list(value)
+                else:
+                    v = _parse_str_list(value)
+            else:
+                v = str(value)
+        except (TypeError, ValueError) as e:
+            log.fatal(f"Failed to parse parameter {name}={value!r}: {e}")
+        for op, bound in _CHECKS.get(name, ()):
+            ok = {"<": v < bound, "<=": v <= bound, ">": v > bound, ">=": v >= bound}[op]
+            if not ok:
+                log.fatal(f"Check failed: {name} {op} {bound}, got {v}")
+        return v
+
+    def _post_process(self) -> None:
+        # resolve objective-style aliases
+        self.objective = resolve_objective_alias(self.objective)
+        if self.objective == "rmse":  # l2_root alias keeps reg_sqrt semantics
+            self.objective, self.reg_sqrt = "regression", True
+        self.boosting = {"gbdt": "gbdt", "gbrt": "gbdt", "dart": "dart",
+                         "rf": "rf", "random_forest": "rf",
+                         "goss": "gbdt"}.get(str(self.boosting).lower(), self.boosting)
+        # reference: `boosting=goss` is sugar for data_sample_strategy=goss
+        if str(self._explicit.get("boosting", "")).lower() == "goss":
+            self.data_sample_strategy = "goss"
+        if isinstance(self.metric, str):
+            self.metric = _parse_str_list(self.metric)
+        self.metric = [resolve_metric_alias(m) for m in self.metric]
+        self.check_param_conflict()
+        log.set_verbosity(self.verbosity)
+
+    def check_param_conflict(self) -> None:
+        """Mirror of reference Config::CheckParamConflict (config.cpp)."""
+        if self.is_explicit("bagging_freq") and self.bagging_freq > 0 and \
+                self.bagging_fraction >= 1.0 and not self.is_explicit("bagging_fraction") \
+                and self.data_sample_strategy != "goss":
+            pass  # bagging_freq without fraction is a no-op; keep silently like ref
+        if self.boosting == "rf":
+            if self.bagging_freq <= 0 or self.bagging_fraction >= 1.0 or \
+                    self.bagging_fraction <= 0.0:
+                log.warning("RF requires bagging; setting bagging_fraction=0.9, "
+                            "bagging_freq=1")
+                if self.bagging_freq <= 0:
+                    self.bagging_freq = 1
+                if not (0.0 < self.bagging_fraction < 1.0):
+                    self.bagging_fraction = 0.9
+        if self.objective in ("multiclass", "multiclassova") and self.num_class <= 1:
+            log.fatal("Number of classes should be specified and greater than 1 "
+                      "for multiclass training")
+        if self.objective not in ("multiclass", "multiclassova", "none") and \
+                self.num_class != 1:
+            log.fatal("Number of classes must be 1 for non-multiclass training")
+        if self.objective in ("lambdarank", "rank_xendcg") and \
+                self.lambdarank_truncation_level <= 0:
+            log.fatal("lambdarank_truncation_level must be positive")
+        # max_depth implies a num_leaves cap when num_leaves not explicit
+        if self.max_depth > 0 and not self.is_explicit("num_leaves"):
+            full = 1 << min(self.max_depth, 30)
+            self.num_leaves = min(self.num_leaves, full)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {name: getattr(self, name) for name in _CANONICAL}
+
+    def __repr__(self) -> str:
+        keys = sorted(self._explicit)
+        inner = ", ".join(f"{k}={getattr(self, k)!r}" for k in keys)
+        return f"Config({inner})"
+
+
+ParamsLike = Union[Dict[str, Any], Config, None]
+
+
+def as_config(params: ParamsLike) -> Config:
+    if isinstance(params, Config):
+        return params
+    return Config(params or {})

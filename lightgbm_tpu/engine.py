@@ -1,0 +1,224 @@
+"""Training entry points: ``train()`` and ``cv()``.
+
+TPU-native re-design of the reference training engine (reference:
+python-package/lightgbm/engine.py — ``train`` :109, ``cv``/``CVBooster``
+:611,354).  The control flow mirrors the reference: construct datasets, build
+the booster, run callbacks before/after each iteration, aggregate eval
+results, honor EarlyStopException, set ``best_iteration``/``best_score``.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .basic import Booster, Dataset
+from .callback import CallbackEnv, EarlyStopException
+from .config import normalize_params
+from .utils import log
+
+
+def train(params: Dict[str, Any], train_set: Dataset,
+          num_boost_round: int = 100,
+          valid_sets: Optional[Sequence[Dataset]] = None,
+          valid_names: Optional[Sequence[str]] = None,
+          feval: Optional[Callable] = None,
+          init_model: Optional[Union[str, Booster]] = None,
+          keep_training_booster: bool = False,
+          callbacks: Optional[List[Callable]] = None,
+          fobj: Optional[Callable] = None) -> Booster:
+    """Train a booster (reference engine.py:109)."""
+    params = normalize_params(params)
+    if "num_iterations" in params:
+        num_boost_round = params["num_iterations"]
+    params["num_iterations"] = num_boost_round
+    if fobj is not None:
+        params["objective"] = "none"
+
+    booster = Booster(params=params, train_set=train_set)
+    if init_model is not None:
+        log.warning("init_model continuation is not yet supported; starting "
+                    "fresh")
+
+    valid_sets = list(valid_sets or [])
+    names = list(valid_names or [])
+    train_in_valid = False
+    valid_pairs = []  # (name, Dataset) for non-train valid sets, in order
+    for i, vs in enumerate(valid_sets):
+        name = names[i] if i < len(names) else f"valid_{i}"
+        if vs is train_set:
+            train_in_valid = True
+            continue
+        booster.add_valid(vs, name)
+        valid_pairs.append((name, vs))
+
+    callbacks = sorted(callbacks or [], key=lambda cb: getattr(cb, "order", 0))
+    cbs_before = [cb for cb in callbacks if getattr(cb, "before_iteration",
+                                                    False)]
+    cbs_after = [cb for cb in callbacks if not getattr(cb, "before_iteration",
+                                                       False)]
+
+    for it in range(num_boost_round):
+        for cb in cbs_before:
+            cb(CallbackEnv(booster, params, it, 0, num_boost_round, None))
+        finished = booster.update(fobj=fobj)
+        evals = []
+        if train_in_valid or booster._gbdt.config.is_provide_training_metric:
+            evals.extend(booster.eval_train())
+        evals.extend(booster.eval_valid())
+        if feval is not None:
+            evals.extend(_eval_custom(feval, booster, train_set, valid_pairs,
+                                      train_in_valid))
+        try:
+            for cb in cbs_after:
+                cb(CallbackEnv(booster, params, it, 0, num_boost_round, evals))
+        except EarlyStopException as e:
+            booster.best_iteration = e.best_iteration + 1
+            _set_best_score(booster, e.best_score)
+            break
+        if finished:
+            log.warning("Stopped training because there are no more leaves "
+                        "that meet the split requirements")
+            break
+    if booster.best_iteration <= 0:
+        booster.best_iteration = booster._gbdt.current_iteration()
+        _set_best_score(booster, evals if 'evals' in dir() else [])
+    return booster
+
+
+def _eval_custom(feval, booster, train_set, valid_pairs, train_in_valid):
+    out = []
+    fevals = feval if isinstance(feval, (list, tuple)) else [feval]
+    gb = booster._gbdt
+    for f in fevals:
+        if train_in_valid:
+            res = f(gb._host_scores(gb.scores), train_set)
+            out.append(("training",) + tuple(res))
+        for vi, (name, vs) in enumerate(valid_pairs):
+            res = f(gb._host_scores(gb.valid_scores[vi]), vs)
+            out.append((name,) + tuple(res))
+    return out
+
+
+def _set_best_score(booster: Booster, evals) -> None:
+    booster.best_score = {}
+    for item in evals or []:
+        name, metric, val = item[0], item[1], item[2]
+        booster.best_score.setdefault(name, {})[metric] = val
+
+
+class CVBooster:
+    """Ensemble of per-fold boosters (reference engine.py:354)."""
+
+    def __init__(self):
+        self.boosters: List[Booster] = []
+        self.best_iteration = -1
+
+    def append(self, booster: Booster) -> None:
+        self.boosters.append(booster)
+
+    def __getattr__(self, name: str):
+        def handler(*args, **kwargs):
+            return [getattr(b, name)(*args, **kwargs) for b in self.boosters]
+        return handler
+
+
+def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
+       folds=None, nfold: int = 5, stratified: bool = True, shuffle: bool = True,
+       metrics=None, feval=None, seed: int = 0,
+       callbacks: Optional[List[Callable]] = None,
+       eval_train_metric: bool = False,
+       return_cvbooster: bool = False) -> Dict[str, List[float]]:
+    """K-fold cross-validation (reference engine.py:611)."""
+    params = normalize_params(params)
+    if metrics is not None:
+        params["metric"] = metrics
+    train_set.construct()
+    inner = train_set.inner
+    n = inner.num_data
+    label = np.asarray(inner.metadata.label)
+
+    rng = np.random.default_rng(seed)
+    qb = inner.metadata.query_boundaries
+    fold_groups = None  # per-fold (train_sizes, test_sizes) for ranking
+    if folds is None:
+        idx = np.arange(n)
+        if qb is not None:
+            # fold over whole queries so boundaries survive
+            nq = len(qb) - 1
+            qidx = np.arange(nq)
+            if shuffle:
+                rng.shuffle(qidx)
+            qparts = np.array_split(qidx, nfold)
+            folds = []
+            fold_groups = []
+            for part in qparts:
+                test_q = np.sort(part)
+                train_q = np.setdiff1d(qidx, part)
+                test_rows = np.concatenate(
+                    [np.arange(qb[q], qb[q + 1]) for q in test_q]) \
+                    if len(test_q) else np.array([], int)
+                train_rows = np.concatenate(
+                    [np.arange(qb[q], qb[q + 1]) for q in train_q]) \
+                    if len(train_q) else np.array([], int)
+                folds.append((train_rows, test_rows))
+                fold_groups.append(
+                    (np.diff(qb)[train_q], np.diff(qb)[test_q]))
+        elif stratified and params.get("objective") in (
+                "binary", "multiclass", "multiclassova"):
+            folds_idx = [[] for _ in range(nfold)]
+            for cls in np.unique(label):
+                cls_idx = idx[label == cls]
+                if shuffle:
+                    rng.shuffle(cls_idx)
+                for i, part in enumerate(np.array_split(cls_idx, nfold)):
+                    folds_idx[i].extend(part)
+            folds = [(np.setdiff1d(idx, np.asarray(f)), np.asarray(sorted(f)))
+                     for f in folds_idx]
+        else:
+            if shuffle:
+                rng.shuffle(idx)
+            parts = np.array_split(idx, nfold)
+            folds = [(np.setdiff1d(np.arange(n), p), np.sort(p))
+                     for p in parts]
+
+    cvb = CVBooster()
+    raw = train_set.data
+    if raw is None:
+        log.fatal("cv() requires the Dataset raw data; construct with "
+                  "free_raw_data=False")
+    X = np.asarray(raw, np.float64)
+    weight = inner.metadata.weight
+    init_score = inner.metadata.init_score
+    for fi, (train_idx, test_idx) in enumerate(folds):
+        gtr = gte = None
+        if fold_groups is not None:
+            gtr, gte = fold_groups[fi]
+        dtrain = Dataset(X[train_idx], label=label[train_idx],
+                         params=dict(train_set.params),
+                         weight=None if weight is None else weight[train_idx],
+                         group=gtr,
+                         init_score=None if init_score is None else
+                         init_score[train_idx])
+        dtest = dtrain.create_valid(
+            X[test_idx], label=label[test_idx],
+            weight=None if weight is None else weight[test_idx],
+            group=gte,
+            init_score=None if init_score is None else init_score[test_idx])
+        bst = train(params, dtrain, num_boost_round,
+                    valid_sets=[dtest], valid_names=["valid"],
+                    feval=feval, callbacks=list(callbacks or []))
+        cvb.append(bst)
+
+    final: Dict[str, List[float]] = {}
+    for bst in cvb.boosters:
+        for name, metric, val, _ in bst.eval_valid():
+            final.setdefault(f"{name} {metric}-mean", []).append(val)
+    out = {k: [float(np.mean(v))] for k, v in final.items()}
+    out.update({k.replace("-mean", "-stdv"): [float(np.std(final[k]))]
+                for k in final})
+    if return_cvbooster:
+        out["cvbooster"] = cvb
+    return out

@@ -1,0 +1,271 @@
+"""Binned Dataset + Metadata.
+
+TPU-native re-design of the reference data layer (reference:
+include/LightGBM/dataset.h:487 ``Dataset``, dataset.h:48 ``Metadata``,
+src/io/dataset_loader.cpp ``DatasetLoader``).  The reference's column/row-wise
+bin storages (dense_bin.hpp / sparse_bin.hpp / multi_val_dense_bin.hpp)
+collapse into ONE packed device layout: a row-major ``uint8`` matrix
+``[n_rows, n_used_features]`` — the natural operand for a TPU histogram
+kernel (rows stream through VMEM tiles, features sit on the lane dimension).
+The col-wise/row-wise auto-choice (dataset.cpp:615) is therefore moot.
+
+Trivial features (single bin) are dropped from the packed matrix but kept in
+the mapper list so model I/O refers to original feature indices (reference
+``feature_pre_filter``, used_feature_map_).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..config import Config, as_config
+from ..utils import log
+from .binning import BIN_CATEGORICAL, BinMapper
+
+MAX_UINT8_BINS = 256
+
+
+def _as_2d_float(data: Any) -> np.ndarray:
+    """Accept numpy / pandas / list-of-rows; return float64 [n, F] with NaN
+    for missing (the reference accepts mat/CSR/CSC/pandas via c_api)."""
+    if hasattr(data, "values") and hasattr(data, "columns"):  # pandas DataFrame
+        arr = data.to_numpy(dtype=np.float64, na_value=np.nan)
+    elif hasattr(data, "toarray"):  # scipy sparse
+        arr = np.asarray(data.toarray(), dtype=np.float64)
+    else:
+        arr = np.asarray(data, dtype=np.float64)
+    if arr.ndim == 1:
+        arr = arr.reshape(-1, 1)
+    if arr.ndim != 2:
+        log.fatal(f"data must be 2-dimensional, got shape {arr.shape}")
+    return arr
+
+
+class Metadata:
+    """Labels / weights / query boundaries / init scores / positions
+    (reference dataset.h:48-360)."""
+
+    def __init__(self, num_data: int):
+        self.num_data = int(num_data)
+        self.label: np.ndarray = np.zeros(num_data, dtype=np.float32)
+        self.weight: Optional[np.ndarray] = None
+        self.query_boundaries: Optional[np.ndarray] = None  # int32 [nq+1]
+        self.init_score: Optional[np.ndarray] = None
+        self.position: Optional[np.ndarray] = None
+
+    def set_label(self, label: Sequence[float]) -> None:
+        label = np.asarray(label, dtype=np.float32).reshape(-1)
+        if len(label) != self.num_data:
+            log.fatal(f"Length of label ({len(label)}) != num_data ({self.num_data})")
+        self.label = label
+
+    def set_weight(self, weight: Optional[Sequence[float]]) -> None:
+        if weight is None:
+            self.weight = None
+            return
+        weight = np.asarray(weight, dtype=np.float32).reshape(-1)
+        if len(weight) != self.num_data:
+            log.fatal(f"Length of weight ({len(weight)}) != num_data ({self.num_data})")
+        if (weight < 0).any():
+            log.fatal("Weights should be non-negative")
+        self.weight = weight
+
+    def set_group(self, group: Optional[Sequence[int]]) -> None:
+        """``group`` is per-query SIZES (python-package convention;
+        reference Metadata::SetQuery, dataset.h).  Loaders that read per-row
+        query-id columns convert to sizes first (io/parser.py)."""
+        if group is None:
+            self.query_boundaries = None
+            return
+        sizes = np.asarray(group).astype(np.int64)
+        bounds = np.zeros(len(sizes) + 1, dtype=np.int32)
+        np.cumsum(sizes, out=bounds[1:])
+        if bounds[-1] != self.num_data:
+            log.fatal(f"Sum of query counts ({bounds[-1]}) != num_data "
+                      f"({self.num_data})")
+        self.query_boundaries = bounds
+
+    def set_init_score(self, init_score: Optional[Sequence[float]]) -> None:
+        if init_score is None:
+            self.init_score = None
+            return
+        self.init_score = np.asarray(init_score, dtype=np.float64).reshape(-1)
+
+    def set_position(self, position: Optional[Sequence[int]]) -> None:
+        self.position = None if position is None else \
+            np.asarray(position, dtype=np.int32).reshape(-1)
+
+    @property
+    def num_queries(self) -> int:
+        return 0 if self.query_boundaries is None else len(self.query_boundaries) - 1
+
+
+class Dataset:
+    """Binned training data (reference dataset.h:487).
+
+    ``bins``  uint8 [n_rows, n_used]   packed bin matrix (device operand)
+    ``mappers``  one BinMapper per ORIGINAL feature
+    ``used_feature_idx``  original index of each packed column
+    """
+
+    def __init__(self) -> None:
+        self.bins: np.ndarray = np.zeros((0, 0), dtype=np.uint8)
+        self.mappers: List[BinMapper] = []
+        self.used_feature_idx: List[int] = []
+        self.num_total_features: int = 0
+        self.feature_names: List[str] = []
+        self.metadata: Metadata = Metadata(0)
+        self.config: Config = Config()
+        self._reference: Optional["Dataset"] = None
+
+    # ------------------------------------------------------------ properties
+    @property
+    def num_data(self) -> int:
+        return self.bins.shape[0]
+
+    @property
+    def num_features(self) -> int:
+        """Packed (used) feature count."""
+        return self.bins.shape[1]
+
+    @property
+    def label(self) -> np.ndarray:
+        return self.metadata.label
+
+    def num_bins_array(self) -> np.ndarray:
+        return np.array([self.mappers[i].num_bin for i in self.used_feature_idx],
+                        dtype=np.int32)
+
+    def nan_bin_array(self) -> np.ndarray:
+        return np.array([self.mappers[i].nan_bin for i in self.used_feature_idx],
+                        dtype=np.int32)
+
+    def categorical_array(self) -> np.ndarray:
+        return np.array([self.mappers[i].bin_type == BIN_CATEGORICAL
+                         for i in self.used_feature_idx], dtype=bool)
+
+    def max_num_bin(self) -> int:
+        return int(max((self.mappers[i].num_bin for i in self.used_feature_idx),
+                       default=1))
+
+    # ---------------------------------------------------------- construction
+    @classmethod
+    def from_data(cls, data: Any, label: Optional[Sequence[float]] = None,
+                  config: Union[Config, Dict[str, Any], None] = None,
+                  weight: Optional[Sequence[float]] = None,
+                  group: Optional[Sequence[int]] = None,
+                  init_score: Optional[Sequence[float]] = None,
+                  feature_names: Optional[List[str]] = None,
+                  categorical_feature: Optional[Sequence[Union[int, str]]] = None,
+                  reference: Optional["Dataset"] = None) -> "Dataset":
+        """Build a binned dataset (reference DatasetLoader::ConstructFromSampleData
+        path through c_api LGBM_DatasetCreateFromMat, c_api.h:409)."""
+        cfg = as_config(config)
+        arr = _as_2d_float(data)
+        n, f = arr.shape
+        ds = cls()
+        ds.config = cfg
+        ds.num_total_features = f
+        if feature_names is None and hasattr(data, "columns"):
+            feature_names = [str(c) for c in data.columns]
+        ds.feature_names = feature_names or [f"Column_{i}" for i in range(f)]
+
+        ds.metadata = Metadata(n)
+        if label is not None:
+            ds.metadata.set_label(label)
+        ds.metadata.set_weight(weight)
+        ds.metadata.set_group(group)
+        ds.metadata.set_init_score(init_score)
+
+        if reference is not None:
+            # valid set: reuse the training mappers (reference CreateValid,
+            # dataset.h:703 — bin boundaries must align with train)
+            ds.mappers = reference.mappers
+            ds.used_feature_idx = list(reference.used_feature_idx)
+            ds.num_total_features = reference.num_total_features
+            ds.feature_names = reference.feature_names
+            ds._reference = reference
+            ds._bin_all(arr)
+            return ds
+
+        cat_idx = _resolve_categorical(categorical_feature, ds.feature_names)
+        ds._construct_mappers(arr, cfg, cat_idx)
+        ds._bin_all(arr)
+        return ds
+
+    def create_valid(self, data: Any, label: Optional[Sequence[float]] = None,
+                     **kwargs: Any) -> "Dataset":
+        return Dataset.from_data(data, label=label, config=self.config,
+                                 reference=self, **kwargs)
+
+    def _construct_mappers(self, arr: np.ndarray, cfg: Config,
+                           cat_idx: Sequence[int]) -> None:
+        n, f = arr.shape
+        max_bin = int(cfg.max_bin)
+        if max_bin > MAX_UINT8_BINS:
+            log.warning(f"max_bin={max_bin} > {MAX_UINT8_BINS} not yet supported "
+                        f"on the uint8 path; clamping")
+            max_bin = MAX_UINT8_BINS
+        # sample rows for bin finding (reference bin_construct_sample_cnt,
+        # dataset_loader.cpp sampling)
+        sample_cnt = min(n, int(cfg.bin_construct_sample_cnt))
+        if sample_cnt < n:
+            rng = np.random.default_rng(cfg.data_random_seed)
+            sample_rows = rng.choice(n, size=sample_cnt, replace=False)
+            sample = arr[np.sort(sample_rows)]
+        else:
+            sample = arr
+        mbf = list(cfg.max_bin_by_feature or [])
+        self.mappers = []
+        cat_set = set(cat_idx)
+        for j in range(f):
+            fmax = mbf[j] if j < len(mbf) and mbf[j] > 1 else max_bin
+            m = BinMapper.find_bin(
+                sample[:, j], total_sample_cnt=len(sample), max_bin=int(fmax),
+                min_data_in_bin=int(cfg.min_data_in_bin),
+                use_missing=bool(cfg.use_missing),
+                zero_as_missing=bool(cfg.zero_as_missing),
+                is_categorical=(j in cat_set))
+            self.mappers.append(m)
+        self.used_feature_idx = [j for j in range(f)
+                                 if not self.mappers[j].is_trivial()]
+        dropped = f - len(self.used_feature_idx)
+        if dropped:
+            log.info(f"Dropped {dropped} trivial (single-bin) feature(s)")
+        if not self.used_feature_idx:
+            log.fatal("Cannot construct Dataset: all features are trivial "
+                      "(single bin). Check your data or binning parameters.")
+
+    def _bin_all(self, arr: np.ndarray) -> None:
+        n = arr.shape[0]
+        used = self.used_feature_idx
+        bins = np.zeros((n, len(used)), dtype=np.uint8)
+        if arr.shape[1] != self.num_total_features:
+            log.fatal(f"The number of features in data ({arr.shape[1]}) does not "
+                      f"match Dataset ({self.num_total_features})")
+        for col, j in enumerate(used):
+            bins[:, col] = self.mappers[j].values_to_bins(arr[:, j]).astype(np.uint8)
+        self.bins = np.ascontiguousarray(bins)
+
+    # --------------------------------------------------------------- utility
+    def bin_threshold_to_value(self, packed_feature: int, bin_thr: int) -> float:
+        """Convert a learner bin threshold to the real-valued model threshold."""
+        return self.mappers[self.used_feature_idx[packed_feature]].bin_to_value(bin_thr)
+
+
+def _resolve_categorical(categorical_feature: Optional[Sequence[Union[int, str]]],
+                         feature_names: List[str]) -> List[int]:
+    if not categorical_feature or categorical_feature == "auto":
+        return []
+    out = []
+    for c in categorical_feature:
+        if isinstance(c, str) and not c.isdigit():
+            if c in feature_names:
+                out.append(feature_names.index(c))
+            else:
+                log.warning(f"Unknown categorical feature name: {c}")
+        else:
+            out.append(int(c))
+    return sorted(set(out))

@@ -1,0 +1,121 @@
+"""Text data loading (CSV/TSV/LibSVM).
+
+TPU-native re-design of the reference text pipeline (reference:
+src/io/parser.cpp ``Parser::CreateParser`` format autodetection,
+src/io/dataset_loader.cpp label/weight/group column extraction).  Pure NumPy
+host code; the optional C++ fast loader (lightgbm_tpu/native) replaces the
+hot parse when built.  Label/weight/group columns follow the reference
+``label_column``/``weight_column``/``group_column`` conventions including
+``name:`` prefixes; companion files ``<data>.weight`` / ``<data>.query``
+are honored like the reference loader.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from ..config import Config
+from ..utils import log
+
+
+def _detect_format(first_line: str) -> str:
+    toks = first_line.strip().split()
+    if any(":" in t for t in toks[1:]):
+        return "libsvm"
+    if "\t" in first_line:
+        return "tsv"
+    return "csv"
+
+
+def _parse_column_spec(spec: str, header_names) -> Optional[int]:
+    if spec is None or spec == "":
+        return None
+    s = str(spec)
+    if s.startswith("name:"):
+        name = s[5:]
+        if header_names and name in header_names:
+            return header_names.index(name)
+        log.fatal(f"Column name {name} not found in header")
+    return int(s)
+
+
+def load_text_file(path: str, config: Config
+                   ) -> Tuple[np.ndarray, Optional[np.ndarray], Dict[str, Any]]:
+    """Load a train/test text file → (features, label, metadata dict).
+
+    Supports CSV/TSV (label column configurable, default 0) and LibSVM
+    (label first, 1-based sparse idx:value pairs).
+    """
+    try:
+        from ..native import parse_text  # C++ fast path
+    except ImportError:
+        parse_text = None
+
+    with open(path) as f:
+        first = f.readline()
+    fmt = _detect_format(first)
+    has_header = bool(config.header)
+    header_names = None
+    if has_header:
+        sep = "\t" if fmt == "tsv" else ","
+        header_names = [t.strip() for t in first.strip().split(sep)]
+
+    meta: Dict[str, Any] = {}
+    if fmt == "libsvm":
+        rows = []
+        labels = []
+        max_idx = -1
+        with open(path) as f:
+            for line in f:
+                toks = line.strip().split()
+                if not toks:
+                    continue
+                labels.append(float(toks[0]))
+                pairs = []
+                for t in toks[1:]:
+                    i, v = t.split(":")
+                    pairs.append((int(i), float(v)))
+                    max_idx = max(max_idx, int(i))
+                rows.append(pairs)
+        arr = np.zeros((len(rows), max_idx + 1))
+        for r, pairs in enumerate(rows):
+            for i, v in pairs:
+                arr[r, i] = v
+        label = np.asarray(labels)
+    else:
+        sep = "\t" if fmt == "tsv" else ","
+        if parse_text is not None:
+            raw = parse_text(path, sep, 1 if has_header else 0)
+        else:
+            raw = np.genfromtxt(path, delimiter=sep,
+                                skip_header=1 if has_header else 0,
+                                dtype=np.float64)
+        if raw.ndim == 1:
+            raw = raw.reshape(1, -1)
+        label_col = _parse_column_spec(config.label_column or "0", header_names)
+        weight_col = _parse_column_spec(config.weight_column, header_names)
+        group_col = _parse_column_spec(config.group_column, header_names)
+        drop = [c for c in (label_col, weight_col, group_col) if c is not None]
+        label = raw[:, label_col] if label_col is not None else None
+        if weight_col is not None:
+            meta["weight"] = raw[:, weight_col]
+        if group_col is not None:
+            # per-row query ids -> per-query sizes (contiguous runs)
+            qid = raw[:, group_col].astype(np.int64)
+            change = np.r_[True, qid[1:] != qid[:-1]]
+            meta["group"] = np.diff(np.r_[np.flatnonzero(change), len(qid)])
+        keep = [c for c in range(raw.shape[1]) if c not in drop]
+        arr = raw[:, keep]
+
+    # companion files (reference dataset_loader.cpp: <file>.weight, .query)
+    for suffix, key in ((".weight", "weight"), (".query", "group"),
+                        (".group", "group"), (".init", "init_score"),
+                        (".position", "position")):
+        side = path + suffix
+        if os.path.exists(side) and key not in meta:
+            vals = np.loadtxt(side)
+            meta[key] = vals.astype(np.int64) if key == "group" else vals
+    return arr, label, meta

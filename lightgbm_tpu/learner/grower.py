@@ -1,0 +1,288 @@
+"""Leaf-wise (best-first) tree growth, fully on device.
+
+TPU-native re-design of the reference single-device tree learner (reference:
+src/treelearner/serial_tree_learner.cpp:179 ``Train`` and the CUDA blueprint
+src/treelearner/cuda/cuda_single_gpu_tree_learner.cpp:158 — histogram →
+subtract → best-split → partition per leaf).  Two deliberate departures:
+
+  * The reference syncs ~1 SplitInfo device→host per split
+    (cuda_single_gpu_tree_learner.cpp:276) — the latency bottleneck SURVEY.md
+    §7 calls out.  Here the ENTIRE ``num_leaves - 1`` split loop runs inside
+    one jitted ``lax.fori_loop``; early exit (no positive-gain split) becomes
+    a sticky ``done`` flag that turns remaining iterations into no-ops.
+  * The reference physically re-partitions row indices per split
+    (cuda_data_partition.cu:288,907).  TPUs hate scatter, so rows never move:
+    a dense ``leaf_of_row`` int32 map is updated with a masked ``where``, and
+    per-leaf histograms mask through it.  The histogram-subtraction trick
+    (serial_tree_learner.cpp:364-378) survives: only the SMALLER child gets a
+    data pass, the sibling is parent − smaller.
+
+Tree topology follows the reference array format (include/LightGBM/tree.h:26):
+internal node i created at split i; left child keeps the parent's leaf index,
+right child takes leaf index i+1; child pointers encode leaf l as ``-(l+1)``.
+
+Under ``shard_map`` the same code runs data-parallel: histograms and root
+stats are ``psum``-ed over the mesh axis, after which every device makes the
+identical split decision — the TPU equivalent of the reference's
+ReduceScatter/Allreduce dance (data_parallel_tree_learner.cpp:281,441).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.histogram import histogram_for_leaf, root_histogram
+from ..ops.split import (NEG_INF, SplitHyper, SplitResult, find_best_split,
+                         leaf_output)
+
+
+class TreeArrays(NamedTuple):
+    """Struct-of-arrays tree (reference tree.h flat arrays)."""
+    split_feature: jax.Array   # i32 [L-1] packed feature idx (-1 = unused node)
+    split_bin: jax.Array       # i32 [L-1] bin threshold
+    default_left: jax.Array    # bool [L-1]
+    split_cat: jax.Array       # bool [L-1] one-hot categorical split
+    left_child: jax.Array      # i32 [L-1]; >=0 node, negative -(leaf+1)
+    right_child: jax.Array     # i32 [L-1]
+    split_gain: jax.Array      # f32 [L-1]
+    internal_value: jax.Array  # f32 [L-1] node output before split (SHAP)
+    internal_count: jax.Array  # f32 [L-1]
+    leaf_value: jax.Array      # f32 [L]
+    leaf_count: jax.Array      # f32 [L]
+    leaf_weight: jax.Array     # f32 [L] sum of hessians
+    leaf_depth: jax.Array      # i32 [L]
+    num_leaves: jax.Array      # i32 scalar — actual leaves grown
+
+
+class _GrowState(NamedTuple):
+    tree: TreeArrays
+    leaf_of_row: jax.Array     # i32 [n]
+    hist: jax.Array            # f32 [L, F, B, C]
+    sum_g: jax.Array           # f32 [L]
+    sum_h: jax.Array
+    count: jax.Array
+    best_gain: jax.Array       # f32 [L]
+    best_feat: jax.Array       # i32 [L]
+    best_thr: jax.Array
+    best_dl: jax.Array         # bool [L]
+    best_cat: jax.Array        # bool [L]
+    best_lg: jax.Array         # f32 [L] left child sums of cached best split
+    best_lh: jax.Array
+    best_lc: jax.Array
+    parent_node: jax.Array     # i32 [L] internal node owning this leaf (-1 root)
+    parent_side: jax.Array     # i32 [L] 0 left / 1 right
+    done: jax.Array            # bool scalar
+
+
+def _empty_tree(num_leaves: int) -> TreeArrays:
+    li = num_leaves - 1
+    return TreeArrays(
+        split_feature=jnp.full((li,), -1, jnp.int32),
+        split_bin=jnp.zeros((li,), jnp.int32),
+        default_left=jnp.zeros((li,), bool),
+        split_cat=jnp.zeros((li,), bool),
+        left_child=jnp.full((li,), -1, jnp.int32),
+        right_child=jnp.full((li,), -1, jnp.int32),
+        split_gain=jnp.zeros((li,), jnp.float32),
+        internal_value=jnp.zeros((li,), jnp.float32),
+        internal_count=jnp.zeros((li,), jnp.float32),
+        leaf_value=jnp.zeros((num_leaves,), jnp.float32),
+        leaf_count=jnp.zeros((num_leaves,), jnp.float32),
+        leaf_weight=jnp.zeros((num_leaves,), jnp.float32),
+        leaf_depth=jnp.zeros((num_leaves,), jnp.int32),
+        num_leaves=jnp.int32(1),
+    )
+
+
+def _child_best(hist: jax.Array, g: jax.Array, h: jax.Array, c: jax.Array,
+                depth: jax.Array, num_bins, nan_bin, is_cat, feature_mask,
+                hp: SplitHyper) -> SplitResult:
+    res = find_best_split(hist, g, h, c, num_bins, nan_bin, is_cat,
+                          feature_mask, hp)
+    depth_ok = (hp.max_depth <= 0) | (depth < hp.max_depth)
+    return res._replace(gain=jnp.where(depth_ok, res.gain, NEG_INF))
+
+
+@functools.partial(jax.jit, static_argnames=("hp", "axis_name"))
+def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
+              row_mask: Optional[jax.Array], num_bins: jax.Array,
+              nan_bin: jax.Array, is_cat: jax.Array,
+              feature_mask: Optional[jax.Array], hp: SplitHyper,
+              axis_name: Optional[str] = None
+              ) -> Tuple[TreeArrays, jax.Array]:
+    """Grow one tree; returns (TreeArrays, leaf_of_row).
+
+    bins: uint8 [n, F]; grad/hess: f32 [n]; row_mask: bool [n] or None
+    (bagging); num_bins/nan_bin: i32 [F]; is_cat: bool [F];
+    feature_mask: bool [F] or None (feature_fraction).
+    ``leaf_of_row`` is returned for ALL rows (bagged-out rows included), so the
+    boosting score update is a pure gather — the reference's train-score
+    shortcut through DataPartition (score_updater.hpp).
+    """
+    n, num_f = bins.shape
+    L = hp.num_leaves
+    mask_f = jnp.ones_like(grad) if row_mask is None else row_mask.astype(grad.dtype)
+
+    hist0 = root_histogram(bins, grad, hess, row_mask, n_bins=hp.n_bins,
+                           rows_per_block=hp.rows_per_block, axis_name=axis_name)
+    g0 = jnp.sum(grad * mask_f)
+    h0 = jnp.sum(hess * mask_f)
+    c0 = jnp.sum(mask_f)
+    if axis_name is not None:
+        g0 = lax.psum(g0, axis_name)
+        h0 = lax.psum(h0, axis_name)
+        c0 = lax.psum(c0, axis_name)
+
+    best0 = _child_best(hist0, g0, h0, c0, jnp.int32(0), num_bins, nan_bin,
+                        is_cat, feature_mask, hp)
+
+    tree = _empty_tree(L)
+    tree = tree._replace(
+        leaf_value=tree.leaf_value.at[0].set(
+            leaf_output(g0, h0, hp.lambda_l1, hp.lambda_l2, hp.max_delta_step)),
+        leaf_count=tree.leaf_count.at[0].set(c0),
+        leaf_weight=tree.leaf_weight.at[0].set(h0),
+    )
+    C = hist0.shape[-1]
+    state = _GrowState(
+        tree=tree,
+        leaf_of_row=jnp.zeros((n,), jnp.int32),
+        hist=jnp.zeros((L, num_f, hp.n_bins, C), jnp.float32).at[0].set(hist0),
+        sum_g=jnp.zeros((L,), jnp.float32).at[0].set(g0),
+        sum_h=jnp.zeros((L,), jnp.float32).at[0].set(h0),
+        count=jnp.zeros((L,), jnp.float32).at[0].set(c0),
+        best_gain=jnp.full((L,), NEG_INF, jnp.float32).at[0].set(best0.gain),
+        best_feat=jnp.zeros((L,), jnp.int32).at[0].set(best0.feature),
+        best_thr=jnp.zeros((L,), jnp.int32).at[0].set(best0.threshold),
+        best_dl=jnp.zeros((L,), bool).at[0].set(best0.default_left),
+        best_cat=jnp.zeros((L,), bool).at[0].set(best0.is_categorical),
+        best_lg=jnp.zeros((L,), jnp.float32).at[0].set(best0.left_sum_g),
+        best_lh=jnp.zeros((L,), jnp.float32).at[0].set(best0.left_sum_h),
+        best_lc=jnp.zeros((L,), jnp.float32).at[0].set(best0.left_count),
+        parent_node=jnp.full((L,), -1, jnp.int32),
+        parent_side=jnp.zeros((L,), jnp.int32),
+        done=jnp.bool_(False),
+    )
+
+    def body(i, st: _GrowState) -> _GrowState:
+        bl = jnp.argmax(st.best_gain).astype(jnp.int32)
+        do = (~st.done) & (st.best_gain[bl] > 0.0)
+
+        def no_split(st: _GrowState) -> _GrowState:
+            return st._replace(done=jnp.bool_(True))
+
+        def split(st: _GrowState) -> _GrowState:
+            t = st.tree
+            feat = st.best_feat[bl]
+            thr = st.best_thr[bl]
+            dl = st.best_dl[bl]
+            catl = st.best_cat[bl]
+            new_leaf = i + 1
+
+            # -- link the parent's child pointer to the new internal node i
+            p = st.parent_node[bl]
+            side = st.parent_side[bl]
+            ps = jnp.maximum(p, 0)
+            lc_arr = t.left_child.at[ps].set(
+                jnp.where((p >= 0) & (side == 0), i, t.left_child[ps]))
+            rc_arr = t.right_child.at[ps].set(
+                jnp.where((p >= 0) & (side == 1), i, t.right_child[ps]))
+
+            # -- record split at internal node i
+            pg, ph, pc = st.sum_g[bl], st.sum_h[bl], st.count[bl]
+            lc_arr = lc_arr.at[i].set(-(bl + 1))
+            rc_arr = rc_arr.at[i].set(-(new_leaf + 1))
+            t = t._replace(
+                split_feature=t.split_feature.at[i].set(feat),
+                split_bin=t.split_bin.at[i].set(thr),
+                default_left=t.default_left.at[i].set(dl),
+                split_cat=t.split_cat.at[i].set(catl),
+                left_child=lc_arr, right_child=rc_arr,
+                split_gain=t.split_gain.at[i].set(st.best_gain[bl]),
+                internal_value=t.internal_value.at[i].set(
+                    leaf_output(pg, ph, hp.lambda_l1, hp.lambda_l2,
+                                hp.max_delta_step)),
+                internal_count=t.internal_count.at[i].set(pc),
+                num_leaves=jnp.int32(i + 2),
+            )
+
+            # -- partition (dense map update, no data movement)
+            col = jnp.take(bins, feat, axis=1).astype(jnp.int32)
+            nb = nan_bin[feat]
+            go_left_num = jnp.where(col == nb, dl, col <= thr)
+            go_left = jnp.where(catl, col == thr, go_left_num)
+            active = st.leaf_of_row == bl
+            leaf_of_row = jnp.where(
+                active, jnp.where(go_left, bl, new_leaf), st.leaf_of_row)
+
+            # -- children stats from the cached best split
+            lg, lh, lcn = st.best_lg[bl], st.best_lh[bl], st.best_lc[bl]
+            rg, rh, rcn = pg - lg, ph - lh, pc - lcn
+
+            # -- histogram: data pass for the smaller child, subtract sibling
+            smaller = jnp.where(lcn <= rcn, bl, new_leaf)
+            h_small = histogram_for_leaf(
+                bins, grad, hess, leaf_of_row, smaller, row_mask,
+                n_bins=hp.n_bins, rows_per_block=hp.rows_per_block,
+                axis_name=axis_name)
+            h_parent = st.hist[bl]
+            h_large = h_parent - h_small
+            left_small = lcn <= rcn
+            h_left = jnp.where(left_small, h_small, h_large)
+            h_right = jnp.where(left_small, h_large, h_small)
+            hist = st.hist.at[bl].set(h_left).at[new_leaf].set(h_right)
+
+            d = t.leaf_depth[bl] + 1
+            t = t._replace(
+                leaf_depth=t.leaf_depth.at[bl].set(d).at[new_leaf].set(d),
+                leaf_value=t.leaf_value
+                    .at[bl].set(leaf_output(lg, lh, hp.lambda_l1, hp.lambda_l2,
+                                            hp.max_delta_step))
+                    .at[new_leaf].set(leaf_output(rg, rh, hp.lambda_l1,
+                                                  hp.lambda_l2,
+                                                  hp.max_delta_step)),
+                leaf_count=t.leaf_count.at[bl].set(lcn).at[new_leaf].set(rcn),
+                leaf_weight=t.leaf_weight.at[bl].set(lh).at[new_leaf].set(rh),
+            )
+
+            bs_l = _child_best(h_left, lg, lh, lcn, d, num_bins, nan_bin,
+                               is_cat, feature_mask, hp)
+            bs_r = _child_best(h_right, rg, rh, rcn, d, num_bins, nan_bin,
+                               is_cat, feature_mask, hp)
+
+            return st._replace(
+                tree=t,
+                leaf_of_row=leaf_of_row,
+                hist=hist,
+                sum_g=st.sum_g.at[bl].set(lg).at[new_leaf].set(rg),
+                sum_h=st.sum_h.at[bl].set(lh).at[new_leaf].set(rh),
+                count=st.count.at[bl].set(lcn).at[new_leaf].set(rcn),
+                best_gain=st.best_gain.at[bl].set(bs_l.gain)
+                                       .at[new_leaf].set(bs_r.gain),
+                best_feat=st.best_feat.at[bl].set(bs_l.feature)
+                                       .at[new_leaf].set(bs_r.feature),
+                best_thr=st.best_thr.at[bl].set(bs_l.threshold)
+                                     .at[new_leaf].set(bs_r.threshold),
+                best_dl=st.best_dl.at[bl].set(bs_l.default_left)
+                                   .at[new_leaf].set(bs_r.default_left),
+                best_cat=st.best_cat.at[bl].set(bs_l.is_categorical)
+                                     .at[new_leaf].set(bs_r.is_categorical),
+                best_lg=st.best_lg.at[bl].set(bs_l.left_sum_g)
+                                   .at[new_leaf].set(bs_r.left_sum_g),
+                best_lh=st.best_lh.at[bl].set(bs_l.left_sum_h)
+                                   .at[new_leaf].set(bs_r.left_sum_h),
+                best_lc=st.best_lc.at[bl].set(bs_l.left_count)
+                                   .at[new_leaf].set(bs_r.left_count),
+                parent_node=st.parent_node.at[bl].set(i).at[new_leaf].set(i),
+                parent_side=st.parent_side.at[bl].set(0).at[new_leaf].set(1),
+            )
+
+        return lax.cond(do, split, no_split, st)
+
+    state = lax.fori_loop(0, L - 1, body, state)
+    return state.tree, state.leaf_of_row

@@ -1,0 +1,431 @@
+"""Evaluation metrics.
+
+TPU-native re-design of the reference metric layer (reference:
+include/LightGBM/metric.h:24 ``Metric`` — Init/Eval/factor_to_bigger_better;
+factory src/metric/metric.cpp:21-127).  Metrics run once per
+``metric_freq`` iterations on host NumPy over the (converted) score array —
+they are O(n) or O(n log n) passes whose cost is negligible next to training,
+matching the reference where metrics are OpenMP host code even in CUDA mode.
+
+Families (reference files): regression_metric.hpp, binary_metric.hpp,
+multiclass_metric.hpp, rank_metric.hpp (+dcg_calculator.cpp), map_metric.hpp,
+xentropy_metric.hpp.  Convention preserved: ``Eval`` returns values where
+HIGHER ``factor * value`` is better; factor is -1 for losses, +1 for
+auc/ndcg/map (metric.h factor_to_bigger_better).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .config import Config
+from .io.dataset import Metadata
+from .utils import log
+
+
+class Metric:
+    NAME = "none"
+    bigger_is_better = False
+
+    def __init__(self, config: Config):
+        self.config = config
+
+    def init(self, metadata: Metadata, num_data: int) -> None:
+        self.metadata = metadata
+        self.num_data = num_data
+        self.label = np.asarray(metadata.label, np.float64)
+        self.weight = None if metadata.weight is None else \
+            np.asarray(metadata.weight, np.float64)
+        self.sum_weight = float(self.weight.sum()) if self.weight is not None \
+            else float(num_data)
+
+    def eval(self, score: np.ndarray, objective=None) -> List[Tuple[str, float]]:
+        raise NotImplementedError
+
+    def _avg(self, losses: np.ndarray) -> float:
+        if self.weight is not None:
+            return float(np.sum(losses * self.weight) / self.sum_weight)
+        return float(np.mean(losses))
+
+    def _convert(self, score: np.ndarray, objective) -> np.ndarray:
+        if objective is not None and objective.need_convert_output:
+            import jax.numpy as jnp
+            return np.asarray(objective.convert_output(jnp.asarray(score)))
+        return score
+
+
+# ------------------------------------------------------------- regression
+class _PointwiseRegression(Metric):
+    def eval(self, score, objective=None):
+        pred = self._convert(score, objective)
+        return [(self.NAME, self._avg(self._loss(pred, self.label)))]
+
+
+class L2Metric(_PointwiseRegression):
+    NAME = "l2"
+    def _loss(self, p, y): return (p - y) ** 2
+
+
+class RMSEMetric(_PointwiseRegression):
+    NAME = "rmse"
+    def eval(self, score, objective=None):
+        pred = self._convert(score, objective)
+        return [(self.NAME, float(np.sqrt(self._avg((pred - self.label) ** 2))))]
+
+
+class L1Metric(_PointwiseRegression):
+    NAME = "l1"
+    def _loss(self, p, y): return np.abs(p - y)
+
+
+class QuantileMetric(_PointwiseRegression):
+    NAME = "quantile"
+    def _loss(self, p, y):
+        a = self.config.alpha
+        d = y - p
+        return np.where(d >= 0, a * d, (a - 1.0) * d)
+
+
+class HuberMetric(_PointwiseRegression):
+    NAME = "huber"
+    def _loss(self, p, y):
+        a = self.config.alpha
+        d = np.abs(p - y)
+        return np.where(d <= a, 0.5 * d * d, a * (d - 0.5 * a))
+
+
+class FairMetric(_PointwiseRegression):
+    NAME = "fair"
+    def _loss(self, p, y):
+        c = self.config.fair_c
+        x = np.abs(p - y)
+        return c * x - c * c * np.log1p(x / c)
+
+
+class PoissonMetric(_PointwiseRegression):
+    NAME = "poisson"
+    def _loss(self, p, y):
+        eps = 1e-10
+        return p - y * np.log(np.maximum(p, eps))
+
+
+class MAPEMetric(_PointwiseRegression):
+    NAME = "mape"
+    def _loss(self, p, y):
+        return np.abs((y - p) / np.maximum(1.0, np.abs(y)))
+
+
+class GammaMetric(_PointwiseRegression):
+    NAME = "gamma"
+    def _loss(self, p, y):
+        eps = 1e-10
+        psafe = np.maximum(p, eps)
+        return y / psafe + np.log(psafe) - 1.0 - np.log(np.maximum(y, eps))
+
+
+class GammaDevianceMetric(_PointwiseRegression):
+    NAME = "gamma_deviance"
+    def _loss(self, p, y):
+        eps = 1e-10
+        r = y / np.maximum(p, eps)
+        return 2.0 * (np.log(np.maximum(1.0 / np.maximum(r, eps), eps)) + r - 1.0)
+
+
+class TweedieMetric(_PointwiseRegression):
+    NAME = "tweedie"
+    def _loss(self, p, y):
+        rho = self.config.tweedie_variance_power
+        eps = 1e-10
+        psafe = np.maximum(p, eps)
+        return -y * np.power(psafe, 1 - rho) / (1 - rho) + \
+            np.power(psafe, 2 - rho) / (2 - rho)
+
+
+# ----------------------------------------------------------------- binary
+class BinaryLoglossMetric(Metric):
+    NAME = "binary_logloss"
+
+    def eval(self, score, objective=None):
+        p = np.clip(self._convert(score, objective), 1e-15, 1 - 1e-15)
+        y = self.label
+        loss = -(y * np.log(p) + (1 - y) * np.log(1 - p))
+        return [(self.NAME, self._avg(loss))]
+
+
+class BinaryErrorMetric(Metric):
+    NAME = "binary_error"
+
+    def eval(self, score, objective=None):
+        p = self._convert(score, objective)
+        err = (p > 0.5) != (self.label > 0)
+        return [(self.NAME, self._avg(err.astype(np.float64)))]
+
+
+def _weighted_auc(label: np.ndarray, score: np.ndarray,
+                  weight: Optional[np.ndarray]) -> float:
+    """Rank-based weighted AUC (reference binary_metric.hpp AUCMetric)."""
+    if weight is None:
+        weight = np.ones_like(label, dtype=np.float64)
+    order = np.argsort(score, kind="mergesort")
+    y, s, w = label[order], score[order], weight[order]
+    pos_w = np.where(y > 0, w, 0.0)
+    neg_w = np.where(y > 0, 0.0, w)
+    # tie-aware: within tied score groups, credit half the pos x neg mass
+    cum_neg = np.cumsum(neg_w)
+    total_neg = cum_neg[-1] if len(cum_neg) else 0.0
+    total_pos = pos_w.sum()
+    if total_pos <= 0 or total_neg <= 0:
+        return 1.0
+    # group by unique score
+    boundary = np.r_[True, s[1:] != s[:-1]]
+    gid = np.cumsum(boundary) - 1
+    ng = gid[-1] + 1
+    gpos = np.bincount(gid, weights=pos_w, minlength=ng)
+    gneg = np.bincount(gid, weights=neg_w, minlength=ng)
+    neg_before = np.cumsum(gneg) - gneg
+    auc = np.sum(gpos * (neg_before + 0.5 * gneg))
+    return float(auc / (total_pos * total_neg))
+
+
+class AUCMetric(Metric):
+    NAME = "auc"
+    bigger_is_better = True
+
+    def eval(self, score, objective=None):
+        return [(self.NAME, _weighted_auc(self.label, score, self.weight))]
+
+
+class AveragePrecisionMetric(Metric):
+    NAME = "average_precision"
+    bigger_is_better = True
+
+    def eval(self, score, objective=None):
+        w = self.weight if self.weight is not None else \
+            np.ones_like(self.label)
+        order = np.argsort(-score, kind="mergesort")
+        y, ww = self.label[order] > 0, w[order]
+        tp = np.cumsum(np.where(y, ww, 0.0))
+        fp = np.cumsum(np.where(y, 0.0, ww))
+        prec = tp / np.maximum(tp + fp, 1e-20)
+        total_pos = tp[-1] if len(tp) else 0.0
+        if total_pos <= 0:
+            return [(self.NAME, 1.0)]
+        rec_delta = np.diff(np.r_[0.0, tp]) / total_pos
+        return [(self.NAME, float(np.sum(prec * rec_delta)))]
+
+
+# ------------------------------------------------------------- multiclass
+class MultiLoglossMetric(Metric):
+    NAME = "multi_logloss"
+
+    def eval(self, score, objective=None):
+        # score: [n, K] raw; convert via softmax/sigmoid per objective
+        p = self._convert(score, objective)
+        if objective is None or not objective.need_convert_output:
+            ex = np.exp(score - score.max(axis=1, keepdims=True))
+            p = ex / ex.sum(axis=1, keepdims=True)
+        idx = self.label.astype(int)
+        p_true = np.clip(p[np.arange(len(idx)), idx], 1e-15, None)
+        if getattr(objective, "NAME", "") == "multiclassova":
+            p_true = np.clip(p_true / np.maximum(p.sum(axis=1), 1e-15), 1e-15, None)
+        return [(self.NAME, self._avg(-np.log(p_true)))]
+
+
+class MultiErrorMetric(Metric):
+    NAME = "multi_error"
+
+    def eval(self, score, objective=None):
+        k = self.config.multi_error_top_k
+        idx = self.label.astype(int)
+        true_score = score[np.arange(len(idx)), idx]
+        # error when the true class is not within top-k (reference
+        # multiclass_metric.hpp MultiErrorMetric)
+        rank = (score > true_score[:, None]).sum(axis=1)
+        err = rank >= k
+        return [(self.NAME, self._avg(err.astype(np.float64)))]
+
+
+class AucMuMetric(Metric):
+    """Multiclass AUC-mu (reference multiclass_metric.hpp:368 AucMuMetric,
+    Kleiman & Page 2019)."""
+    NAME = "auc_mu"
+    bigger_is_better = True
+
+    def eval(self, score, objective=None):
+        y = self.label.astype(int)
+        k = self.config.num_class
+        wmat = None
+        if self.config.auc_mu_weights:
+            wmat = np.asarray(self.config.auc_mu_weights, np.float64).reshape(k, k)
+        aucs = []
+        for a in range(k):
+            for b in range(a + 1, k):
+                m = (y == a) | (y == b)
+                if m.sum() == 0 or (y[m] == a).all() or (y[m] == b).all():
+                    continue
+                # decision value: difference in class scores, weighted by the
+                # partition weights when provided
+                if wmat is not None:
+                    d = score[m] @ (wmat[a] - wmat[b])
+                    d = -d
+                else:
+                    d = score[m, a] - score[m, b]
+                aucs.append(_weighted_auc((y[m] == a).astype(np.float64), d,
+                                          None if self.weight is None
+                                          else self.weight[m]))
+        return [(self.NAME, float(np.mean(aucs)) if aucs else 1.0)]
+
+
+# ---------------------------------------------------------------- ranking
+def _dcg_at_k(labels: np.ndarray, order: np.ndarray, k: int,
+              label_gain: np.ndarray) -> float:
+    top = order[:k]
+    gains = label_gain[labels[top].astype(int)]
+    return float(np.sum(gains / np.log2(np.arange(2, len(top) + 2))))
+
+
+class NDCGMetric(Metric):
+    """reference rank_metric.hpp NDCGMetric + dcg_calculator.cpp."""
+    NAME = "ndcg"
+    bigger_is_better = True
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if metadata.query_boundaries is None:
+            log.fatal("NDCG metric requires query information")
+        self.bounds = metadata.query_boundaries
+        mx = int(self.label.max()) + 1 if len(self.label) else 1
+        gains = self.config.label_gain or [float((1 << i) - 1)
+                                           for i in range(max(mx, 31))]
+        self.label_gain = np.asarray(gains, np.float64)
+        self.ks = list(self.config.eval_at)
+
+    def eval(self, score, objective=None):
+        res = {k: [] for k in self.ks}
+        qw = []
+        for qi in range(len(self.bounds) - 1):
+            s, e = self.bounds[qi], self.bounds[qi + 1]
+            lbl = self.label[s:e]
+            sc = score[s:e]
+            order = np.argsort(-sc, kind="mergesort")
+            ideal = np.argsort(-lbl, kind="mergesort")
+            qw.append(1.0)
+            for k in self.ks:
+                idcg = _dcg_at_k(lbl, ideal, k, self.label_gain)
+                if idcg <= 0:
+                    res[k].append(1.0)
+                else:
+                    res[k].append(_dcg_at_k(lbl, order, k, self.label_gain) / idcg)
+        return [(f"ndcg@{k}", float(np.average(res[k], weights=qw)))
+                for k in self.ks]
+
+
+class MapMetric(Metric):
+    """reference map_metric.hpp MapMetric."""
+    NAME = "map"
+    bigger_is_better = True
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if metadata.query_boundaries is None:
+            log.fatal("MAP metric requires query information")
+        self.bounds = metadata.query_boundaries
+        self.ks = list(self.config.eval_at)
+
+    def eval(self, score, objective=None):
+        res = {k: [] for k in self.ks}
+        for qi in range(len(self.bounds) - 1):
+            s, e = self.bounds[qi], self.bounds[qi + 1]
+            rel = (self.label[s:e] > 0).astype(np.float64)
+            order = np.argsort(-score[s:e], kind="mergesort")
+            rel_sorted = rel[order]
+            hits = np.cumsum(rel_sorted)
+            prec = hits / np.arange(1, len(rel_sorted) + 1)
+            for k in self.ks:
+                topk = slice(0, k)
+                denom = min(k, int(rel.sum())) or 1
+                ap = np.sum(prec[topk] * rel_sorted[topk]) / denom
+                res[k].append(ap if rel.sum() > 0 else 1.0)
+        return [(f"map@{k}", float(np.mean(res[k]))) for k in self.ks]
+
+
+# --------------------------------------------------------------- xentropy
+class CrossEntropyMetric(Metric):
+    NAME = "cross_entropy"
+
+    def eval(self, score, objective=None):
+        p = np.clip(self._convert(score, objective), 1e-15, 1 - 1e-15)
+        y = self.label
+        loss = -(y * np.log(p) + (1 - y) * np.log(1 - p))
+        return [(self.NAME, self._avg(loss))]
+
+
+class CrossEntropyLambdaMetric(Metric):
+    NAME = "cross_entropy_lambda"
+
+    def eval(self, score, objective=None):
+        # p through the lambda link (see objectives.CrossEntropyLambda)
+        w = self.weight if self.weight is not None else 1.0
+        sp = np.logaddexp(0.0, score)
+        p = np.clip(1.0 - np.exp(-w * sp), 1e-15, 1 - 1e-15)
+        y = self.label
+        loss = -(y * np.log(p) + (1 - y) * np.log(1 - p))
+        return [(self.NAME, float(np.mean(loss)))]
+
+
+class KLDivergenceMetric(Metric):
+    NAME = "kullback_leibler"
+
+    def eval(self, score, objective=None):
+        p = np.clip(self._convert(score, objective), 1e-15, 1 - 1e-15)
+        y = np.clip(self.label, 1e-15, 1 - 1e-15)
+        kl = y * np.log(y / p) + (1 - y) * np.log((1 - y) / (1 - p))
+        return [(self.NAME, self._avg(kl))]
+
+
+_METRICS = {
+    "l1": L1Metric, "l2": L2Metric, "rmse": RMSEMetric,
+    "quantile": QuantileMetric, "huber": HuberMetric, "fair": FairMetric,
+    "poisson": PoissonMetric, "mape": MAPEMetric, "gamma": GammaMetric,
+    "gamma_deviance": GammaDevianceMetric, "tweedie": TweedieMetric,
+    "binary_logloss": BinaryLoglossMetric, "binary_error": BinaryErrorMetric,
+    "auc": AUCMetric, "average_precision": AveragePrecisionMetric,
+    "multi_logloss": MultiLoglossMetric, "multi_error": MultiErrorMetric,
+    "auc_mu": AucMuMetric,
+    "ndcg": NDCGMetric, "map": MapMetric,
+    "cross_entropy": CrossEntropyMetric,
+    "cross_entropy_lambda": CrossEntropyLambdaMetric,
+    "kullback_leibler": KLDivergenceMetric,
+}
+
+_DEFAULT_METRIC_FOR_OBJECTIVE = {
+    "regression": "l2", "regression_l1": "l1", "huber": "huber", "fair": "fair",
+    "poisson": "poisson", "quantile": "quantile", "mape": "mape",
+    "gamma": "gamma", "tweedie": "tweedie",
+    "binary": "binary_logloss",
+    "multiclass": "multi_logloss", "multiclassova": "multi_logloss",
+    "cross_entropy": "cross_entropy",
+    "cross_entropy_lambda": "cross_entropy_lambda",
+    "lambdarank": "ndcg", "rank_xendcg": "ndcg",
+}
+
+
+def create_metrics(config: Config) -> List[Metric]:
+    """Factory (reference metric.cpp:21-127): explicit list, or the
+    objective's default metric when none requested."""
+    names: Sequence[str] = config.metric
+    if not names:
+        default = _DEFAULT_METRIC_FOR_OBJECTIVE.get(config.objective)
+        names = [default] if default else []
+    out: List[Metric] = []
+    for nm in names:
+        if nm in ("none", ""):
+            continue
+        cls = _METRICS.get(nm)
+        if cls is None:
+            log.warning(f"Unknown metric: {nm}")
+            continue
+        out.append(cls(config))
+    return out

@@ -1,0 +1,58 @@
+"""On-device prediction over binned data.
+
+TPU-native re-design of the reference score updater / predictor (reference:
+src/boosting/score_updater.hpp:21 valid-score ``AddScore`` via full tree
+traversal; src/boosting/cuda/cuda_score_updater.hpp:17).  The branchy
+per-row walk (tree.h:137 ``Predict``) becomes a frontier iteration: every row
+carries its current node id, each step gathers that node's split and moves
+one level — all rows advance in lockstep under ``lax.while_loop``, so one
+tree costs depth × O(n) gathers instead of per-row branching.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..learner.grower import TreeArrays
+
+
+@jax.jit
+def predict_bins_tree(tree: TreeArrays, bins: jax.Array,
+                      nan_bin: jax.Array) -> jax.Array:
+    """Leaf VALUE per row for one device tree over binned features.
+
+    tree: TreeArrays (packed feature indices, bin thresholds);
+    bins: uint8 [n, F]; nan_bin: i32 [F].
+    """
+    leaf = predict_bins_leaf(tree, bins, nan_bin)
+    return tree.leaf_value[leaf]
+
+
+@jax.jit
+def predict_bins_leaf(tree: TreeArrays, bins: jax.Array,
+                      nan_bin: jax.Array) -> jax.Array:
+    n = bins.shape[0]
+    rows = lax.iota(jnp.int32, n)
+    node0 = jnp.zeros((n,), jnp.int32)
+
+    def cond(node):
+        return jnp.any(node >= 0)
+
+    def body(node):
+        active = node >= 0
+        safe = jnp.maximum(node, 0)
+        feat = jnp.maximum(tree.split_feature[safe], 0)
+        thr = tree.split_bin[safe]
+        dl = tree.default_left[safe]
+        cat = tree.split_cat[safe]
+        col = bins[rows, feat].astype(jnp.int32)
+        nb = nan_bin[feat]
+        go_left = jnp.where(col == nb, dl,
+                            jnp.where(cat, col == thr, col <= thr))
+        nxt = jnp.where(go_left, tree.left_child[safe], tree.right_child[safe])
+        return jnp.where(active, nxt, node)
+
+    node = lax.while_loop(cond, body, node0)
+    return (-node - 1).astype(jnp.int32)

@@ -1,0 +1,186 @@
+"""Best-split finding from histograms.
+
+TPU-native re-design of the reference split finder (reference:
+src/treelearner/feature_histogram.hpp:832 ``FindBestThresholdSequentially``
+CPU scans; src/treelearner/cuda/cuda_best_split_finder.cu:772
+``FindBestSplitsForLeafKernel`` — one thread-block per (feature, direction)
+with in-block prefix scans + arg-reduction).
+
+On TPU the whole thing is a handful of vector ops over the [F, B] histogram:
+cumulative sums along the bin axis give every threshold's left-side stats at
+once, both missing-value default directions are evaluated as a 2-wide variant
+axis (the reference's forward/backward scans), one-hot categorical candidates
+ride the same argmax, and a single flat argmax picks the winner.  Bins beyond
+a feature's ``num_bin`` and the dedicated NaN bin are masked, replacing the
+reference's per-feature loop bounds.
+
+Gain/regularization semantics mirror feature_histogram.hpp:
+``ThresholdL1`` soft-shrink, gain = GL'^2/(HL+l2) + GR'^2/(HR+l2), validity =
+min_data_in_leaf / min_sum_hessian_in_leaf on both children, reported gain is
+the improvement over the parent minus ``min_gain_to_split``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+@dataclasses.dataclass(frozen=True)
+class SplitHyper:
+    """Static split/growth hyperparameters (subset of reference Config used by
+    the learner; config.h learning-control block)."""
+    num_leaves: int = 31
+    max_depth: int = -1
+    lambda_l1: float = 0.0
+    lambda_l2: float = 0.0
+    min_data_in_leaf: int = 20
+    min_sum_hessian_in_leaf: float = 1e-3
+    min_gain_to_split: float = 0.0
+    max_delta_step: float = 0.0
+    cat_l2: float = 10.0
+    cat_smooth: float = 10.0
+    max_cat_threshold: int = 32
+    n_bins: int = 256
+    rows_per_block: int = 4096
+    path_smooth: float = 0.0
+
+
+class SplitResult(NamedTuple):
+    """Chosen split for one leaf (reference split_info.hpp:294 ``SplitInfo``)."""
+    gain: jax.Array          # f32 — improvement; <= 0 means "don't split"
+    feature: jax.Array       # i32 packed feature index
+    threshold: jax.Array     # i32 bin threshold (left = bin <= threshold)
+    default_left: jax.Array  # bool — missing goes left
+    is_categorical: jax.Array  # bool — one-hot categorical split (bin == thr)
+    left_sum_g: jax.Array
+    left_sum_h: jax.Array
+    left_count: jax.Array
+    right_sum_g: jax.Array
+    right_sum_h: jax.Array
+    right_count: jax.Array
+
+
+def threshold_l1(s: jax.Array, l1: float) -> jax.Array:
+    """Soft-threshold (reference feature_histogram.hpp ThresholdL1)."""
+    if l1 <= 0.0:
+        return s
+    return jnp.sign(s) * jnp.maximum(jnp.abs(s) - l1, 0.0)
+
+
+def leaf_gain(g: jax.Array, h: jax.Array, l1: float, l2: float) -> jax.Array:
+    t = threshold_l1(g, l1)
+    return (t * t) / (h + l2 + 1e-15)
+
+
+def leaf_output(g: jax.Array, h: jax.Array, l1: float, l2: float,
+                max_delta_step: float = 0.0) -> jax.Array:
+    """CalculateSplittedLeafOutput (feature_histogram.hpp static)."""
+    out = -threshold_l1(g, l1) / (h + l2 + 1e-15)
+    if max_delta_step > 0.0:
+        out = jnp.clip(out, -max_delta_step, max_delta_step)
+    return out
+
+
+def find_best_split(hist: jax.Array, sum_g: jax.Array, sum_h: jax.Array,
+                    count: jax.Array, num_bins: jax.Array, nan_bin: jax.Array,
+                    is_cat: jax.Array, feature_mask: Optional[jax.Array],
+                    hp: SplitHyper) -> SplitResult:
+    """Pick the best (feature, threshold, default-dir) for one leaf.
+
+    hist: f32 [F, B, C>=3] (grad, hess, count); sum_g/sum_h/count: leaf totals.
+    num_bins/nan_bin: i32 [F]; is_cat: bool [F]; feature_mask: bool [F] or None.
+    """
+    num_f, n_b = hist.shape[0], hist.shape[1]
+    g, h, n = hist[..., 0], hist[..., 1], hist[..., 2]
+    bin_idx = lax.iota(jnp.int32, n_b)[None, :]                  # [1, B]
+    valid_bin = bin_idx < num_bins[:, None]                      # [F, B]
+    is_nan = bin_idx == nan_bin[:, None]                         # [F, B]
+
+    # base cumulatives exclude the missing bin; its stats ride the variant axis
+    gz = jnp.where(is_nan, 0.0, g)
+    hz = jnp.where(is_nan, 0.0, h)
+    nz = jnp.where(is_nan, 0.0, n)
+    gl = jnp.cumsum(gz, axis=1)
+    hl = jnp.cumsum(hz, axis=1)
+    nl = jnp.cumsum(nz, axis=1)
+    gm = jnp.sum(jnp.where(is_nan, g, 0.0), axis=1, keepdims=True)  # [F, 1]
+    hm = jnp.sum(jnp.where(is_nan, h, 0.0), axis=1, keepdims=True)
+    nm = jnp.sum(jnp.where(is_nan, n, 0.0), axis=1, keepdims=True)
+    has_missing = nan_bin[:, None] >= 0
+
+    l1, l2 = hp.lambda_l1, hp.lambda_l2
+    parent_gain = leaf_gain(sum_g, sum_h, l1, l2)
+    min_shift = parent_gain + hp.min_gain_to_split
+
+    def variant_gain(gl_v, hl_v, nl_v):
+        gr = sum_g - gl_v
+        hr = sum_h - hl_v
+        nr = count - nl_v
+        gain = leaf_gain(gl_v, hl_v, l1, l2) + leaf_gain(gr, hr, l1, l2)
+        ok = ((nl_v >= hp.min_data_in_leaf) & (nr >= hp.min_data_in_leaf)
+              & (hl_v >= hp.min_sum_hessian_in_leaf)
+              & (hr >= hp.min_sum_hessian_in_leaf))
+        return jnp.where(ok, gain, NEG_INF)
+
+    # numerical thresholds: t splits {bin <= t} | {bin > t}; t == last real bin
+    # only splits off the missing bin, t at the nan bin itself is invalid
+    thr_ok = valid_bin & (bin_idx < num_bins[:, None] - 1) & ~is_nan
+    thr_ok = thr_ok & ~is_cat[:, None]
+    gain_right = jnp.where(thr_ok, variant_gain(gl, hl, nl), NEG_INF)
+    gain_left = jnp.where(thr_ok & has_missing,
+                          variant_gain(gl + gm, hl + hm, nl + nm), NEG_INF)
+
+    # one-hot categorical: {bin == t} goes left (reference
+    # FindBestThresholdCategoricalInner one-hot branch, l2 += cat_l2)
+    l2c = l2 + hp.cat_l2
+    gl_cat, hl_cat, nl_cat = g, h, n
+
+    def cat_gain():
+        gr = sum_g - gl_cat
+        hr = sum_h - hl_cat
+        nr = count - nl_cat
+        gain = leaf_gain(gl_cat, hl_cat, l1, l2c) + leaf_gain(gr, hr, l1, l2c)
+        ok = ((nl_cat >= hp.min_data_in_leaf) & (nr >= hp.min_data_in_leaf)
+              & (hl_cat >= hp.min_sum_hessian_in_leaf)
+              & (hr >= hp.min_sum_hessian_in_leaf))
+        return jnp.where(ok, gain, NEG_INF)
+
+    gain_cat = jnp.where(valid_bin & is_cat[:, None], cat_gain(), NEG_INF)
+
+    cand = jnp.stack([gain_right, gain_left, gain_cat], axis=-1)  # [F, B, 3]
+    if feature_mask is not None:
+        cand = jnp.where(feature_mask[:, None, None], cand, NEG_INF)
+
+    flat = cand.reshape(-1)
+    best = jnp.argmax(flat)
+    best_gain_raw = flat[best]
+    feat = (best // (n_b * 3)).astype(jnp.int32)
+    rem = best % (n_b * 3)
+    thr = (rem // 3).astype(jnp.int32)
+    variant = (rem % 3).astype(jnp.int32)
+
+    # recover the winner's left-side stats
+    glw = jnp.stack([gl[feat, thr], gl[feat, thr] + gm[feat, 0], g[feat, thr]])
+    hlw = jnp.stack([hl[feat, thr], hl[feat, thr] + hm[feat, 0], h[feat, thr]])
+    nlw = jnp.stack([nl[feat, thr], nl[feat, thr] + nm[feat, 0], n[feat, thr]])
+    lg = glw[variant]
+    lh = hlw[variant]
+    ln = nlw[variant]
+
+    gain = best_gain_raw - min_shift
+    return SplitResult(
+        gain=jnp.where(best_gain_raw <= NEG_INF / 2, jnp.float32(NEG_INF), gain),
+        feature=feat,
+        threshold=thr,
+        default_left=(variant == 1),
+        is_categorical=(variant == 2),
+        left_sum_g=lg, left_sum_h=lh, left_count=ln,
+        right_sum_g=sum_g - lg, right_sum_h=sum_h - lh, right_count=count - ln,
+    )

@@ -1,0 +1,63 @@
+"""Logging facade for lightgbm_tpu.
+
+TPU-native re-design of the reference logger (reference: include/LightGBM/utils/log.h:78
+``Log`` with levels Fatal/Warning/Info/Debug and a redirectable callback,
+``Log::ResetCallBack`` log.h:97).  We keep the same user surface: four levels, a
+process-global verbosity, and a pluggable callback (``register_logger`` in the
+reference python package, basic.py:231).
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Callable, Optional
+
+
+class LightGBMError(Exception):
+    """Raised where the reference calls ``Log::Fatal`` (utils/log.h:117)."""
+
+
+_LEVELS = {"fatal": -1, "warning": 0, "info": 1, "debug": 2}
+_verbosity = 1  # matches reference config.h `verbosity` default (1 = Info)
+_callback: Optional[Callable[[str], None]] = None
+
+
+def set_verbosity(level: int) -> None:
+    global _verbosity
+    _verbosity = int(level)
+
+
+def get_verbosity() -> int:
+    return _verbosity
+
+
+def register_logger(func: Optional[Callable[[str], None]]) -> None:
+    """Redirect log output through ``func`` (reference c_api.h:73)."""
+    global _callback
+    _callback = func
+
+
+def _emit(msg: str) -> None:
+    if _callback is not None:
+        _callback(msg)
+    else:
+        print(msg, file=sys.stderr, flush=True)
+
+
+def debug(msg: str) -> None:
+    if _verbosity >= 2:
+        _emit(f"[LightGBM-TPU] [Debug] {msg}")
+
+
+def info(msg: str) -> None:
+    if _verbosity >= 1:
+        _emit(f"[LightGBM-TPU] [Info] {msg}")
+
+
+def warning(msg: str) -> None:
+    if _verbosity >= 0:
+        _emit(f"[LightGBM-TPU] [Warning] {msg}")
+
+
+def fatal(msg: str) -> "None":
+    raise LightGBMError(msg)

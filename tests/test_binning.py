@@ -1,0 +1,112 @@
+"""Binning unit tests (reference analogue: test_basic.py bin-boundary
+checks, SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.io.binning import (BIN_CATEGORICAL, MISSING_NAN,
+                                     MISSING_NONE, MISSING_ZERO, BinMapper)
+from lightgbm_tpu.io.dataset import Dataset
+
+
+def test_simple_numeric_bins():
+    vals = np.arange(100, dtype=float)
+    m = BinMapper.find_bin(vals, 100, max_bin=255, min_data_in_bin=1,
+                           use_missing=True, zero_as_missing=False)
+    assert m.num_bin <= 255
+    b = m.values_to_bins(vals)
+    # monotone: higher values get >= bins
+    assert (np.diff(b) >= 0).all()
+    # mapping respects boundaries: value <= ub[t] iff bin <= t
+    for t in range(m.num_bin - 1):
+        ub = m.bin_upper_bound[t]
+        assert ((vals <= ub) == (b <= t)).all()
+
+
+def test_equal_count_binning():
+    rng = np.random.default_rng(0)
+    vals = rng.normal(size=100_000)
+    m = BinMapper.find_bin(vals, len(vals), max_bin=64, min_data_in_bin=3,
+                           use_missing=True, zero_as_missing=False)
+    b = m.values_to_bins(vals)
+    counts = np.bincount(b, minlength=m.num_bin)
+    # roughly equal-count: no bin more than 5x the mean (heavy hitters aside)
+    assert counts.max() < 5 * counts.mean()
+    assert m.num_bin <= 64
+
+
+def test_zero_bin_isolated():
+    vals = np.concatenate([np.zeros(500), np.linspace(-3, 3, 500)])
+    m = BinMapper.find_bin(vals, len(vals), max_bin=32, min_data_in_bin=1,
+                           use_missing=True, zero_as_missing=False)
+    zb = m.values_to_bins(np.array([0.0]))[0]
+    # zero bin contains only (near-)zero
+    near = m.values_to_bins(np.array([1e-40, -1e-40]))
+    assert (near == zb).all()
+    far = m.values_to_bins(np.array([0.5, -0.5]))
+    assert (far != zb).all()
+
+
+def test_nan_gets_last_bin():
+    vals = np.array([1.0, 2.0, 3.0, np.nan, np.nan, 4.0] * 10)
+    m = BinMapper.find_bin(vals, len(vals), max_bin=16, min_data_in_bin=1,
+                           use_missing=True, zero_as_missing=False)
+    assert m.missing_type == MISSING_NAN
+    assert m.nan_bin == m.num_bin - 1
+    b = m.values_to_bins(np.array([np.nan]))
+    assert b[0] == m.nan_bin
+
+
+def test_zero_as_missing():
+    vals = np.array([0.0, 1.0, 2.0, 3.0] * 10)
+    m = BinMapper.find_bin(vals, len(vals), max_bin=16, min_data_in_bin=1,
+                           use_missing=True, zero_as_missing=True)
+    assert m.missing_type == MISSING_ZERO
+    assert m.nan_bin == m.values_to_bins(np.array([0.0]))[0]
+    # NaN folds into the zero bin
+    assert m.values_to_bins(np.array([np.nan]))[0] == m.nan_bin
+
+
+def test_categorical_by_frequency():
+    vals = np.array([5] * 50 + [2] * 30 + [9] * 20 + [7] * 5)
+    m = BinMapper.find_bin(vals.astype(float), len(vals), max_bin=32,
+                           min_data_in_bin=1, use_missing=True,
+                           zero_as_missing=False, is_categorical=True)
+    assert m.bin_type == BIN_CATEGORICAL
+    assert m.bin_2_categorical[0] == 5          # most frequent first
+    assert m.values_to_bins(np.array([5.0]))[0] == 0
+    assert m.values_to_bins(np.array([2.0]))[0] == 1
+    # unseen category -> bin 0; NaN -> bin 0; nan_bin disabled for cats
+    assert m.values_to_bins(np.array([123.0]))[0] == 0
+    assert m.values_to_bins(np.array([np.nan]))[0] == 0
+    assert m.nan_bin == -1
+
+
+def test_trivial_feature_dropped():
+    X = np.stack([np.ones(100), np.arange(100, dtype=float)], axis=1)
+    ds = Dataset.from_data(X, label=np.zeros(100), config={"min_data_in_bin": 1})
+    assert ds.num_total_features == 2
+    assert ds.num_features == 1
+    assert ds.used_feature_idx == [1]
+
+
+def test_valid_set_uses_train_mappers():
+    rng = np.random.default_rng(1)
+    Xtr = rng.normal(size=(500, 3))
+    Xva = rng.normal(size=(100, 3)) * 2  # different distribution
+    dtr = Dataset.from_data(Xtr, label=np.zeros(500), config={})
+    dva = dtr.create_valid(Xva, label=np.zeros(100))
+    assert dva.mappers is dtr.mappers
+    # same value bins identically in both
+    v = Xtr[0, 1]
+    btr = dtr.mappers[1].values_to_bins(np.array([v]))[0]
+    assert dva.mappers[1].values_to_bins(np.array([v]))[0] == btr
+
+
+def test_serialization_roundtrip():
+    vals = np.array([1.0, 2.0, np.nan, 3.0] * 25)
+    m = BinMapper.find_bin(vals, len(vals), max_bin=8, min_data_in_bin=1,
+                           use_missing=True, zero_as_missing=False)
+    m2 = BinMapper.from_dict(m.to_dict())
+    test = np.array([0.5, 1.5, 2.5, np.nan, -1.0])
+    assert (m.values_to_bins(test) == m2.values_to_bins(test)).all()

@@ -1,0 +1,73 @@
+"""Boosting-mode bookkeeping tests: DART bias handling, rollback, cv
+(reference analogue: test_engine.py dart/rollback/cv cases)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+FAST = {"num_leaves": 7, "learning_rate": 0.2, "min_data_in_leaf": 5,
+        "max_bin": 63, "verbosity": 0}
+
+
+def test_dart_bias_preserved():
+    """DART with a large boost-from-average bias: scores must track
+    predictions exactly even after drops rescale the first tree."""
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(800, 4))
+    y = 100.0 + X[:, 0] * 2 + rng.normal(scale=0.2, size=800)  # big mean
+    ds = lgb.Dataset(X, label=y, params=FAST)
+    bst = lgb.train({**FAST, "objective": "regression", "boosting": "dart",
+                     "drop_rate": 0.5, "skip_drop": 0.0},
+                    ds, num_boost_round=8)
+    p = bst.predict(X)
+    s = bst._gbdt._host_scores(bst._gbdt.scores)
+    np.testing.assert_allclose(p, s, atol=1e-3)
+    # and it improves on the constant-mean baseline (heavy dropout at only
+    # 8 rounds fits slowly; the point here is score bookkeeping, not fit)
+    assert np.mean((p - y) ** 2) < np.var(y)
+
+
+def test_rollback_one_iter():
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(600, 4))
+    y = 50.0 + X @ rng.normal(size=4)
+    ds = lgb.Dataset(X, label=y, params=FAST)
+    bst = lgb.Booster(params={**FAST, "objective": "regression"},
+                      train_set=ds)
+    for _ in range(5):
+        bst.update()
+    s5 = np.asarray(bst._gbdt.scores).copy()
+    bst.update()
+    bst.rollback_one_iter()
+    np.testing.assert_allclose(np.asarray(bst._gbdt.scores), s5, atol=1e-4)
+    assert bst._gbdt.num_trees() == 5
+
+
+def test_goss_zero_other_rate(synthetic_binary):
+    X, y = synthetic_binary
+    ds = lgb.Dataset(X, label=y, params=FAST)
+    bst = lgb.train({**FAST, "objective": "binary", "boosting": "goss",
+                     "learning_rate": 0.5, "other_rate": 0.0,
+                     "top_rate": 0.3}, ds, num_boost_round=8)
+    assert bst.num_trees() == 8
+
+
+def test_cv_regression(synthetic_regression):
+    X, y = synthetic_regression
+    ds = lgb.Dataset(X, label=y, params=FAST, free_raw_data=False)
+    res = lgb.cv({**FAST, "objective": "regression", "metric": ["l2"]},
+                 ds, num_boost_round=8, nfold=3)
+    key = [k for k in res if "l2-mean" in k]
+    assert key and res[key[0]][0] < np.var(y)
+
+
+def test_cv_ranking(synthetic_ranking):
+    X, y, group = synthetic_ranking
+    ds = lgb.Dataset(X, label=y, group=group, params=FAST,
+                     free_raw_data=False)
+    res = lgb.cv({**FAST, "objective": "lambdarank", "metric": ["ndcg"],
+                  "eval_at": [5]},
+                 ds, num_boost_round=8, nfold=3)
+    key = [k for k in res if "ndcg@5-mean" in k]
+    assert key and res[key[0]][0] > 0.5

@@ -1,0 +1,85 @@
+"""Metric unit tests against sklearn oracles (reference analogue:
+metric assertions inside test_engine.py, SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import Metadata
+from lightgbm_tpu.metrics import (AUCMetric, AveragePrecisionMetric,
+                                  BinaryLoglossMetric, L2Metric, NDCGMetric,
+                                  _weighted_auc, create_metrics)
+
+
+def _meta(y, w=None, group=None):
+    m = Metadata(len(y))
+    m.set_label(y)
+    m.set_weight(w)
+    m.set_group(group)
+    return m
+
+
+def test_auc_matches_sklearn():
+    from sklearn.metrics import roc_auc_score
+    rng = np.random.default_rng(0)
+    y = (rng.random(500) > 0.4).astype(float)
+    s = rng.normal(size=500) + y
+    assert abs(_weighted_auc(y, s, None) - roc_auc_score(y, s)) < 1e-10
+    # with ties
+    s_t = np.round(s)
+    assert abs(_weighted_auc(y, s_t, None) - roc_auc_score(y, s_t)) < 1e-10
+    # weighted
+    w = rng.random(500) + 0.5
+    assert abs(_weighted_auc(y, s, w) -
+               roc_auc_score(y, s, sample_weight=w)) < 1e-10
+
+
+def test_binary_logloss_matches_sklearn():
+    from sklearn.metrics import log_loss
+    rng = np.random.default_rng(1)
+    y = (rng.random(300) > 0.5).astype(float)
+    p = np.clip(rng.random(300), 0.01, 0.99)
+    raw = np.log(p / (1 - p))
+    m = BinaryLoglossMetric(Config({"objective": "binary"}))
+    m.init(_meta(y), len(y))
+    from lightgbm_tpu.objectives import BinaryLogloss
+    obj = BinaryLogloss(Config({"objective": "binary"}))
+    obj.init(_meta(y), len(y))
+    (name, val), = m.eval(raw, obj)
+    assert abs(val - log_loss(y, p)) < 1e-6
+
+
+def test_ndcg():
+    y = np.array([3, 2, 1, 0, 0, 1, 2, 3], float)
+    group = np.array([4, 4])
+    cfg = Config({"eval_at": [2, 4], "objective": "lambdarank"})
+    m = NDCGMetric(cfg)
+    m.init(_meta(y, group=group), len(y))
+    # perfect ranking scores
+    perfect = np.array([4, 3, 2, 1, 1, 2, 3, 4], float)
+    res = dict(m.eval(perfect))
+    assert res["ndcg@2"] == pytest.approx(1.0)
+    assert res["ndcg@4"] == pytest.approx(1.0)
+    # inverted ranking is worse
+    res_bad = dict(m.eval(-perfect))
+    assert res_bad["ndcg@4"] < 0.8
+
+
+def test_average_precision_matches_sklearn():
+    from sklearn.metrics import average_precision_score
+    rng = np.random.default_rng(2)
+    y = (rng.random(400) > 0.6).astype(float)
+    s = rng.normal(size=400) + 0.8 * y
+    m = AveragePrecisionMetric(Config({"objective": "binary"}))
+    m.init(_meta(y), len(y))
+    (_, val), = m.eval(s)
+    assert abs(val - average_precision_score(y, s)) < 0.02
+
+
+def test_default_metric_for_objective():
+    ms = create_metrics(Config({"objective": "binary"}))
+    assert ms and ms[0].NAME == "binary_logloss"
+    ms = create_metrics(Config({"objective": "lambdarank"}))
+    assert ms and ms[0].NAME == "ndcg"
+    ms = create_metrics(Config({"objective": "regression", "metric": "rmse"}))
+    assert ms and ms[0].NAME == "rmse"

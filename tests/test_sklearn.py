@@ -1,0 +1,65 @@
+"""sklearn-wrapper tests (reference analogue: test_sklearn.py)."""
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu import LGBMClassifier, LGBMRanker, LGBMRegressor
+
+FAST = dict(n_estimators=15, num_leaves=15, learning_rate=0.2,
+            min_child_samples=5, max_bin=63, verbosity=0)
+
+
+def test_classifier(synthetic_binary):
+    X, y = synthetic_binary
+    clf = LGBMClassifier(**FAST)
+    clf.fit(X, y)
+    acc = (clf.predict(X) == y).mean()
+    assert acc > 0.85
+    proba = clf.predict_proba(X)
+    assert proba.shape == (len(y), 2)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-6)
+    assert clf.n_classes_ == 2
+    assert len(clf.feature_importances_) == X.shape[1]
+
+
+def test_classifier_multiclass():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(1200, 4))
+    y = np.argmax(X[:, :3], axis=1)
+    clf = LGBMClassifier(**FAST)
+    clf.fit(X, y)
+    assert clf.n_classes_ == 3
+    assert (clf.predict(X) == y).mean() > 0.8
+    assert clf.predict_proba(X).shape == (1200, 3)
+
+
+def test_regressor(synthetic_regression):
+    X, y = synthetic_regression
+    reg = LGBMRegressor(**FAST)
+    reg.fit(X, y)
+    p = reg.predict(X)
+    assert np.mean((p - y) ** 2) < 0.5 * np.var(y)
+
+
+def test_ranker(synthetic_ranking):
+    X, y, group = synthetic_ranking
+    rk = LGBMRanker(**FAST)
+    rk.fit(X, y, group=group)
+    p = rk.predict(X)
+    assert np.isfinite(p).all()
+
+
+def test_eval_set_early_stopping(synthetic_binary):
+    X, y = synthetic_binary
+    clf = LGBMClassifier(**{**FAST, "n_estimators": 100})
+    clf.fit(X[:1500], y[:1500], eval_set=[(X[1500:], y[1500:])],
+            eval_metric=["binary_logloss"], early_stopping_rounds=5)
+    assert clf.best_iteration_ < 100
+
+
+def test_get_set_params():
+    clf = LGBMClassifier(num_leaves=7)
+    assert clf.get_params()["num_leaves"] == 7
+    clf.set_params(num_leaves=9, some_extra=1)
+    assert clf.num_leaves == 9
+    assert clf.get_params()["some_extra"] == 1
