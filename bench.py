@@ -1,0 +1,90 @@
+"""Benchmark: Higgs-shaped GBDT training throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline anchor (BASELINE.md): reference LightGBM CPU trains HIGGS
+(10.5M rows x 28 features, num_leaves=255, max_bin=255, 500 iters) in
+130.094 s on 2x E5-2690v4 => 2.477e-8 s per row-iteration.  This bench
+trains on BENCH_ROWS x 28 synthetic rows for BENCH_ITERS iterations with the
+same num_leaves/max_bin and reports seconds normalized to the reference's
+per-row-iteration cost:
+
+    vs_baseline = (baseline_s_per_row_iter * rows * iters) / measured_s
+
+(> 1.0 means faster than the reference CPU run per unit work).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+BENCH_ROWS = int(os.environ.get("BENCH_ROWS", 1_000_000))
+BENCH_ITERS = int(os.environ.get("BENCH_ITERS", 20))
+NUM_LEAVES = int(os.environ.get("BENCH_LEAVES", 255))
+MAX_BIN = int(os.environ.get("BENCH_BIN", 255))
+BASELINE_S_PER_ROW_ITER = 130.094 / (10_500_000 * 500)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from lightgbm_tpu.learner.grower import grow_tree
+    from lightgbm_tpu.ops.split import SplitHyper
+
+    rng = np.random.default_rng(0)
+    n, f = BENCH_ROWS, 28
+    # Higgs-like: continuous features, separable-ish labels
+    w = rng.normal(size=f)
+    feat = rng.normal(size=(n, f)).astype(np.float32)
+    logits = feat @ w * 0.5
+    label = (logits + rng.normal(scale=1.0, size=n) > 0).astype(np.float32)
+    # quantize host-side (binning is one-time preprocessing, excluded like
+    # the reference excludes data loading from train timing)
+    qs = np.quantile(feat[:100_000], np.linspace(0, 1, MAX_BIN)[1:-1], axis=0)
+    bins = np.empty((n, f), np.uint8)
+    for j in range(f):
+        bins[:, j] = np.searchsorted(qs[:, j], feat[:, j]).astype(np.uint8)
+
+    hp = SplitHyper(num_leaves=NUM_LEAVES, min_data_in_leaf=0,
+                    min_sum_hessian_in_leaf=100.0, n_bins=256,
+                    rows_per_block=8192)
+    bins_d = jnp.asarray(bins)
+    label_d = jnp.asarray(label)
+    num_bins = jnp.full((f,), MAX_BIN, jnp.int32)
+    nan_bin = jnp.full((f,), -1, jnp.int32)
+    is_cat = jnp.zeros((f,), bool)
+
+    @jax.jit
+    def step(scores):
+        sign = jnp.where(label_d > 0, 1.0, -1.0)
+        resp = -sign / (1.0 + jnp.exp(sign * scores))
+        grad = resp
+        hess = jnp.abs(resp) * (1.0 - jnp.abs(resp))
+        tree, leaf_of_row = grow_tree(bins_d, grad, hess, None, num_bins,
+                                      nan_bin, is_cat, None, hp)
+        return scores + 0.1 * tree.leaf_value[leaf_of_row]
+
+    scores = jnp.zeros(n, jnp.float32)
+    scores = step(scores)          # compile + warmup
+    scores.block_until_ready()
+
+    t0 = time.time()
+    for _ in range(BENCH_ITERS):
+        scores = step(scores)
+    scores.block_until_ready()
+    elapsed = time.time() - t0
+
+    baseline_equiv = BASELINE_S_PER_ROW_ITER * n * BENCH_ITERS
+    print(json.dumps({
+        "metric": f"higgs_synth_{n}rows_{BENCH_ITERS}iters_leaves{NUM_LEAVES}",
+        "value": round(elapsed, 3),
+        "unit": "seconds",
+        "vs_baseline": round(baseline_equiv / elapsed, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

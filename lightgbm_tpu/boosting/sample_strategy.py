@@ -110,8 +110,10 @@ class GOSSStrategy(SampleStrategy):
         a, b = self.config.top_rate, self.config.other_rate
         top_k = max(1, int(n * a))
         score = jnp.sum(jnp.abs(grad) * jnp.sqrt(jnp.abs(hess) + 1e-12), axis=1)
-        thresh = -jnp.sort(-score)[top_k - 1]
-        is_top = score >= thresh
+        # exact top-k membership (ties broken by index) — a >= threshold test
+        # floods the top set when gradients tie, e.g. constant-|grad| l1
+        order = jnp.argsort(-score, stable=True)
+        is_top = jnp.zeros(n, bool).at[order[:top_k]].set(True)
         if b <= 0.0:
             return is_top, grad, hess
         other_k = max(1, int(n * b))

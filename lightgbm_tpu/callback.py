@@ -111,6 +111,10 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
                      f"{stopping_rounds} rounds")
 
     def _callback(env: CallbackEnv) -> None:
+        # reset at the first iteration so one callback object can be reused
+        # across train() runs (cv() folds reuse the same instance)
+        if env.iteration == env.begin_iteration:
+            state.clear()
         if not state:
             _init(env)
         best_score = state["best_score"]

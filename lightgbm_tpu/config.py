@@ -370,9 +370,7 @@ class Config:
     def _post_process(self) -> None:
         # resolve objective-style aliases
         self.objective = resolve_objective_alias(self.objective)
-        if self.objective == "rmse":  # l2_root alias keeps reg_sqrt semantics
-            self.objective, self.reg_sqrt = "regression", True
-        self.boosting = {"gbdt": "gbdt", "gbrt": "gbdt", "dart": "dart",
+        self.boosting ={"gbdt": "gbdt", "gbrt": "gbdt", "dart": "dart",
                          "rf": "rf", "random_forest": "rf",
                          "goss": "gbdt"}.get(str(self.boosting).lower(), self.boosting)
         # reference: `boosting=goss` is sugar for data_sample_strategy=goss

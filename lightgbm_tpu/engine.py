@@ -60,6 +60,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
     cbs_after = [cb for cb in callbacks if not getattr(cb, "before_iteration",
                                                        False)]
 
+    evals: List = []
     for it in range(num_boost_round):
         for cb in cbs_before:
             cb(CallbackEnv(booster, params, it, 0, num_boost_round, None))
@@ -84,7 +85,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
             break
     if booster.best_iteration <= 0:
         booster.best_iteration = booster._gbdt.current_iteration()
-        _set_best_score(booster, evals if 'evals' in dir() else [])
+        _set_best_score(booster, evals)
     return booster
 
 
@@ -135,8 +136,16 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
     params = normalize_params(params)
     if metrics is not None:
         params["metric"] = metrics
+    # capture raw data BEFORE construct() — the default free_raw_data=True
+    # discards it during construction
+    raw = train_set.data
     train_set.construct()
     inner = train_set.inner
+    if raw is None:
+        raw = train_set.data  # may survive under free_raw_data=False
+    if raw is None:
+        log.fatal("cv() requires the Dataset raw data; construct with "
+                  "free_raw_data=False")
     n = inner.num_data
     label = np.asarray(inner.metadata.label)
 
@@ -185,10 +194,6 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
                      for p in parts]
 
     cvb = CVBooster()
-    raw = train_set.data
-    if raw is None:
-        log.fatal("cv() requires the Dataset raw data; construct with "
-                  "free_raw_data=False")
     X = np.asarray(raw, np.float64)
     weight = inner.metadata.weight
     init_score = inner.metadata.init_score

@@ -315,10 +315,9 @@ class BinMapper:
             return 0.0
         ub = self.bin_upper_bound
         idx = min(int(bin_idx), len(ub) - 1)
-        v = float(ub[idx])
-        if np.isinf(v) and idx > 0:
-            v = float(ub[idx - 1]) + 1.0
-        return v
+        # the last bin's bound stays +inf: a split there only sends missing
+        # values right, every real value goes left
+        return float(ub[idx])
 
     # --------------------------------------------------------- serialization
     def to_dict(self) -> dict:

@@ -1,0 +1,61 @@
+"""Device mesh helpers.
+
+TPU-native replacement for the reference network layer (reference:
+src/network/ — socket/MPI ``Network`` with hand-written Bruck /
+recursive-halving collectives, network.h:89-313).  On TPU the entire layer
+dissolves: a ``jax.sharding.Mesh`` over the row ('data') and feature
+('feature') axes plus XLA collectives (psum / psum_scatter / all_gather)
+under ``shard_map`` replace Allreduce/ReduceScatter/Allgather; XLA owns
+schedule selection over ICI/DCN, so the Bruck/halving topology code has no
+counterpart.  Multi-host: call ``jax.distributed.initialize`` before mesh
+construction (reference ``Network::Init`` equivalent, config.h:1086-1110
+``machines``/``num_machines``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+FEATURE_AXIS = "feature"
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              axis: str = DATA_AXIS) -> Mesh:
+    """1-D mesh over available devices (rows for data-parallel, features
+    for feature-parallel)."""
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (axis,))
+
+
+def make_mesh_2d(n_data: int, n_feature: int) -> Mesh:
+    devs = np.array(jax.devices()[:n_data * n_feature]).reshape(
+        n_data, n_feature)
+    return Mesh(devs, (DATA_AXIS, FEATURE_AXIS))
+
+
+def row_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard dim 0 (rows) over the data axis, replicate the rest."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def pad_rows_to_multiple(arr: np.ndarray, multiple: int,
+                         fill: int = 0) -> np.ndarray:
+    """Pad dim-0 so it divides the mesh size (padded rows must be masked
+    out by the caller via row_mask)."""
+    n = arr.shape[0]
+    pad = (-n) % multiple
+    if pad == 0:
+        return arr
+    width = [(0, pad)] + [(0, 0)] * (arr.ndim - 1)
+    return np.pad(arr, width, constant_values=fill)

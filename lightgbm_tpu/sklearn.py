@@ -194,6 +194,13 @@ class LGBMClassifier(LGBMModel):
             self.objective = self.objective or "multiclass"
             self._other_params["num_class"] = self._n_classes
         y_enc = np.searchsorted(self._classes, y)
+        eval_set = kwargs.get("eval_set")
+        if eval_set is not None:
+            if isinstance(eval_set, tuple):
+                eval_set = [eval_set]
+            kwargs["eval_set"] = [
+                (vx, np.searchsorted(self._classes, np.asarray(vy)))
+                for vx, vy in eval_set]
         return super().fit(X, y_enc, **kwargs)
 
     def predict(self, X, raw_score: bool = False, **kwargs):
