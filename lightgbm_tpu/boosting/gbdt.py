@@ -54,6 +54,7 @@ def _hp_from_config(cfg: Config, n_bins: int) -> SplitHyper:
         n_bins=n_bins,
         rows_per_block=int(cfg.tpu_rows_per_block),
         path_smooth=float(cfg.path_smooth),
+        hist_dtype=str(cfg.tpu_hist_dtype),
     )
 
 

@@ -36,7 +36,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..ops.histogram import histogram_for_leaf, root_histogram
+from ..ops.histogram import histogram_for_leaf_bucketed, root_histogram
 from ..ops.split import (NEG_INF, SplitHyper, SplitResult, find_best_split,
                          leaf_output)
 
@@ -129,7 +129,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     mask_f = jnp.ones_like(grad) if row_mask is None else row_mask.astype(grad.dtype)
 
     hist0 = root_histogram(bins, grad, hess, row_mask, n_bins=hp.n_bins,
-                           rows_per_block=hp.rows_per_block, axis_name=axis_name)
+                           rows_per_block=hp.rows_per_block,
+                           hist_dtype=hp.hist_dtype, axis_name=axis_name)
     g0 = jnp.sum(grad * mask_f)
     h0 = jnp.sum(hess * mask_f)
     c0 = jnp.sum(mask_f)
@@ -224,12 +225,14 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             lg, lh, lcn = st.best_lg[bl], st.best_lh[bl], st.best_lc[bl]
             rg, rh, rcn = pg - lg, ph - lh, pc - lcn
 
-            # -- histogram: data pass for the smaller child, subtract sibling
+            # -- histogram: data pass over ONLY the smaller child's rows
+            # (bucketed gather), subtract for the sibling
             smaller = jnp.where(lcn <= rcn, bl, new_leaf)
-            h_small = histogram_for_leaf(
-                bins, grad, hess, leaf_of_row, smaller, row_mask,
+            h_small = histogram_for_leaf_bucketed(
+                bins, grad, hess, leaf_of_row, smaller,
+                jnp.minimum(lcn, rcn), row_mask,
                 n_bins=hp.n_bins, rows_per_block=hp.rows_per_block,
-                axis_name=axis_name)
+                hist_dtype=hp.hist_dtype, axis_name=axis_name)
             h_parent = st.hist[bl]
             h_large = h_parent - h_small
             left_small = lcn <= rcn

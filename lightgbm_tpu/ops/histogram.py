@@ -38,6 +38,33 @@ def _round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
+def use_pallas() -> bool:
+    """Pallas kernel on TPU; XLA one-hot contraction elsewhere (CPU tests,
+    fallback)."""
+    try:
+        from .hist_pallas import HAS_PALLAS
+        return HAS_PALLAS and jax.default_backend() == "tpu"
+    except ImportError:  # pragma: no cover
+        return False
+
+
+def histogram_rows(bins: jax.Array, vals: jax.Array, *, n_bins: int,
+                   rows_per_block: int = 4096,
+                   hist_dtype: str = "bfloat16") -> jax.Array:
+    """Backend-dispatched histogram over a row set.
+
+    bins: uint8 [S, F]; vals: f32 [S, C] (masked rows zero).
+    Returns f32 [F, n_bins, C].
+    """
+    if use_pallas():
+        from .hist_pallas import histogram_pallas
+        return histogram_pallas(bins.T, vals.T, n_bins=n_bins,
+                                rows_per_block=min(rows_per_block, 2048),
+                                compute_dtype=jnp.dtype(hist_dtype).type)
+    return build_histogram(bins, vals, n_bins=n_bins,
+                           rows_per_block=rows_per_block)
+
+
 @functools.partial(jax.jit, static_argnames=("n_bins", "rows_per_block",
                                              "feats_per_chunk"))
 def build_histogram(bins: jax.Array, vals: jax.Array, *, n_bins: int = 256,
@@ -85,6 +112,7 @@ def histogram_for_leaf(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                        leaf_of_row: jax.Array, leaf: jax.Array,
                        row_mask: Optional[jax.Array] = None, *,
                        n_bins: int = 256, rows_per_block: int = 4096,
+                       hist_dtype: str = "bfloat16",
                        axis_name: Optional[str] = None) -> jax.Array:
     """Histogram of one leaf's rows via masking (dense row→leaf map — the
     TPU answer to CUDADataPartition: no data movement, rows never reorder)."""
@@ -93,7 +121,72 @@ def histogram_for_leaf(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         mask = mask & row_mask
     m = mask.astype(grad.dtype)
     vals = jnp.stack([grad * m, hess * m, m, jnp.zeros_like(m)], axis=1)
-    hist = build_histogram(bins, vals, n_bins=n_bins, rows_per_block=rows_per_block)
+    hist = histogram_rows(bins, vals, n_bins=n_bins,
+                          rows_per_block=rows_per_block,
+                          hist_dtype=hist_dtype)
+    if axis_name is not None:
+        hist = lax.psum(hist, axis_name)
+    return hist
+
+
+def histogram_for_leaf_bucketed(bins: jax.Array, grad: jax.Array,
+                                hess: jax.Array, leaf_of_row: jax.Array,
+                                leaf: jax.Array, leaf_count: jax.Array,
+                                row_mask: Optional[jax.Array] = None, *,
+                                n_bins: int = 256, rows_per_block: int = 4096,
+                                min_bucket: int = 8192, hist_dtype: str = "bfloat16",
+                                axis_name: Optional[str] = None) -> jax.Array:
+    """Histogram of one leaf touching only ~leaf_count rows.
+
+    The TPU reformulation of the reference's ordered-index iteration
+    (CUDADataPartition keeps rows physically grouped by leaf;
+    dense_bin.hpp iterates data_indices): rows stay in place, but the
+    leaf's row indices are compacted with a sized ``nonzero`` and gathered
+    into the smallest power-of-two buffer that fits (``lax.switch`` over
+    log2(n) precompiled bucket sizes), so histogram cost follows the
+    smaller child's size instead of the full dataset — preserving the
+    O(n log L) total work of leaf-wise growth with histogram subtraction
+    (serial_tree_learner.cpp:364-378).
+
+    ``leaf_count`` is the number of rows in ``leaf`` (device scalar).
+    """
+    n = bins.shape[0]
+    mask = (leaf_of_row == leaf)
+    if row_mask is not None:
+        mask = mask & row_mask
+
+    # bucket sizes n, n/2, n/4, ..., >= min_bucket
+    sizes = []
+    s = _round_up(n, 128)
+    while True:
+        sizes.append(s)
+        if s <= min_bucket:
+            break
+        s = _round_up((s + 1) // 2, 128)
+    # branch index: largest j with sizes[j] >= count
+    count = jnp.maximum(leaf_count.astype(jnp.int32), 1)
+    j = jnp.int32(0)
+    for k, sz in enumerate(sizes):
+        j = jnp.where(count <= sz, jnp.int32(k), j)
+
+    def make_branch(sz: int):
+        def branch(operands):
+            mask_, grad_, hess_ = operands
+            idx = jnp.nonzero(mask_, size=sz, fill_value=n)[0]
+            valid = (idx < n).astype(grad_.dtype)
+            idxc = jnp.minimum(idx, n - 1)
+            b_sub = bins[idxc]
+            g_sub = grad_[idxc] * valid
+            h_sub = hess_[idxc] * valid
+            vals = jnp.stack([g_sub, h_sub, valid, jnp.zeros_like(valid)],
+                             axis=1)
+            return histogram_rows(b_sub, vals, n_bins=n_bins,
+                                  rows_per_block=rows_per_block,
+                                  hist_dtype=hist_dtype)
+        return branch
+
+    hist = lax.switch(j, [make_branch(sz) for sz in sizes],
+                      (mask, grad, hess))
     if axis_name is not None:
         hist = lax.psum(hist, axis_name)
     return hist
@@ -102,10 +195,13 @@ def histogram_for_leaf(bins: jax.Array, grad: jax.Array, hess: jax.Array,
 def root_histogram(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                    row_mask: Optional[jax.Array] = None, *,
                    n_bins: int = 256, rows_per_block: int = 4096,
+                   hist_dtype: str = "bfloat16",
                    axis_name: Optional[str] = None) -> jax.Array:
     m = jnp.ones_like(grad) if row_mask is None else row_mask.astype(grad.dtype)
     vals = jnp.stack([grad * m, hess * m, m, jnp.zeros_like(m)], axis=1)
-    hist = build_histogram(bins, vals, n_bins=n_bins, rows_per_block=rows_per_block)
+    hist = histogram_rows(bins, vals, n_bins=n_bins,
+                          rows_per_block=rows_per_block,
+                          hist_dtype=hist_dtype)
     if axis_name is not None:
         hist = lax.psum(hist, axis_name)
     return hist

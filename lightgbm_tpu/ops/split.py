@@ -50,6 +50,7 @@ class SplitHyper:
     n_bins: int = 256
     rows_per_block: int = 4096
     path_smooth: float = 0.0
+    hist_dtype: str = "bfloat16"   # MXU contraction dtype for histograms
 
 
 class SplitResult(NamedTuple):
