@@ -29,6 +29,15 @@ from .objectives import create_objective
 from .utils import log
 
 
+def _margin_reached(out: np.ndarray, margin: float) -> np.ndarray:
+    """Per-row early-termination test (reference
+    prediction_early_stop.cpp — binary: 2*|raw|, multiclass: top-2 gap)."""
+    if out.shape[1] == 1:
+        return 2.0 * np.abs(out[:, 0]) >= margin
+    part = np.partition(out, -2, axis=1)
+    return (part[:, -1] - part[:, -2]) >= margin
+
+
 class Dataset:
     """Lazily-constructed binned dataset (reference basic.py:1764)."""
 
@@ -53,6 +62,10 @@ class Dataset:
         self.free_raw_data = free_raw_data
         self.position = position
         self._inner: Optional[_InnerDataset] = None
+        # continuation: a predictor whose raw predictions become this
+        # dataset's init_score (reference basic.py:2059
+        # _set_init_score_by_predictor)
+        self._predictor: Optional["Booster"] = None
 
     # ------------------------------------------------------------ plumbing
     def construct(self) -> "Dataset":
@@ -77,6 +90,17 @@ class Dataset:
         fn = None if self.feature_name in ("auto", None) else list(self.feature_name)
         cat = None if self.categorical_feature in ("auto", None) else \
             list(self.categorical_feature)
+        predictor = self._predictor
+        if predictor is None and self.reference is not None:
+            predictor = self.reference._predictor
+        if predictor is not None and self.init_score is None:
+            # ALL of the predictor's trees: they are merged wholesale into
+            # the new booster (gbdt.h MergeFrom), so residuals must be
+            # computed against the full model, not best_iteration
+            raw = predictor.predict(data, raw_score=True, num_iteration=-1)
+            # column-major flatten for multi-output (reference regroup,
+            # basic.py:2089)
+            self.init_score = np.asarray(raw, np.float64).reshape(-1, order="F")
         self._inner = _InnerDataset.from_data(
             data, label=self.label, config=cfg, weight=self.weight,
             group=self.group, init_score=self.init_score, feature_names=fn,
@@ -89,6 +113,21 @@ class Dataset:
 
     def create_valid(self, data, label=None, **kwargs) -> "Dataset":
         return Dataset(data, label=label, reference=self, **kwargs)
+
+    def _apply_predictor(self, predictor: Optional["Booster"]) -> None:
+        """Set the continuation predictor (reference basic.py:2576
+        ``_set_predictor``).  For an already-constructed dataset the init
+        score is injected immediately — requires the raw data."""
+        self._predictor = predictor
+        if predictor is None or self._inner is None:
+            return
+        if self.data is None:
+            log.fatal("Cannot use init_model with a constructed Dataset "
+                      "whose raw data was freed; create the Dataset with "
+                      "free_raw_data=False")
+        raw = predictor.predict(self.data, raw_score=True, num_iteration=-1)
+        self._inner.metadata.set_init_score(
+            np.asarray(raw, np.float64).reshape(-1, order="F"))
 
     # ------------------------------------------------------------ accessors
     @property
@@ -171,6 +210,11 @@ class Booster:
         cfg = Config(self.params)
         self._cfg = cfg
         self._gbdt = create_boosting(cfg, train_set.inner)
+        # continuation: merge the init model's trees so the booster is
+        # self-contained (reference basic.py:3675 LGBM_BoosterMerge →
+        # gbdt.h:70 MergeFrom)
+        if train_set._predictor is not None:
+            self._gbdt.merge_from(train_set._predictor._get_trees())
 
     # ------------------------------------------------------------ training
     def add_valid(self, data: Dataset, name: str) -> "Booster":
@@ -219,19 +263,23 @@ class Booster:
     def predict(self, data: Any, start_iteration: int = 0,
                 num_iteration: Optional[int] = None, raw_score: bool = False,
                 pred_leaf: bool = False, pred_contrib: bool = False,
+                pred_early_stop: bool = False, pred_early_stop_freq: int = 10,
+                pred_early_stop_margin: float = 10.0,
                 **kwargs) -> np.ndarray:
         X = self._to_matrix(data)
         if num_iteration is None:
             num_iteration = self.best_iteration if self.best_iteration > 0 else -1
+        if pred_contrib:
+            return self._predict_contrib(X, start_iteration, num_iteration)
+        early = (pred_early_stop, pred_early_stop_freq,
+                 pred_early_stop_margin) if pred_early_stop else None
         if self._gbdt is not None:
-            if pred_contrib:
-                return self._predict_contrib(X, start_iteration, num_iteration)
             return self._gbdt.predict(X, raw_score=raw_score,
                                       start_iteration=start_iteration,
                                       num_iteration=num_iteration,
-                                      pred_leaf=pred_leaf)
+                                      pred_leaf=pred_leaf, early=early)
         return self._predict_loaded(X, start_iteration, num_iteration,
-                                    raw_score, pred_leaf, pred_contrib)
+                                    raw_score, pred_leaf, early)
 
     def _to_matrix(self, data: Any) -> np.ndarray:
         if hasattr(data, "to_numpy"):
@@ -241,7 +289,7 @@ class Booster:
         return np.asarray(data, np.float64)
 
     def _predict_loaded(self, X, start_iteration, num_iteration, raw_score,
-                        pred_leaf, pred_contrib) -> np.ndarray:
+                        pred_leaf, early=None) -> np.ndarray:
         trees = self._loaded["trees"]
         k = self._loaded["num_tree_per_iteration"]
         total_iters = len(trees) // k if k else 0
@@ -254,9 +302,17 @@ class Booster:
                       for it in range(start_iteration, end) for c in range(k)]
             return np.stack(leaves, axis=1)
         out = np.zeros((X.shape[0], k))
+        active = np.ones(X.shape[0], bool) if early is not None else None
         for it in range(start_iteration, end):
             for c in range(k):
-                out[:, c] += trees[it * k + c].predict(X)
+                if early is not None:
+                    out[active, c] += trees[it * k + c].predict(X[active])
+                else:
+                    out[:, c] += trees[it * k + c].predict(X)
+            if early is not None and (it + 1) % early[1] == 0:
+                active &= ~_margin_reached(out, early[2])
+                if not active.any():
+                    break
         obj_tokens = self._loaded["objective"].split(" ")
         obj = obj_tokens[0]
         if not raw_score:
@@ -280,7 +336,81 @@ class Booster:
         return out[:, 0] if k == 1 else out
 
     def _predict_contrib(self, X, start_iteration, num_iteration):
-        log.fatal("pred_contrib (SHAP) is not implemented yet")
+        """SHAP contributions (reference PredictContrib,
+        gbdt_prediction.cpp:44; models/shap.py TreeSHAP)."""
+        from .models.shap import predict_contrib
+        trees = self._get_trees()
+        k = self.num_model_per_iteration()
+        nf = (self._gbdt.train_set.num_total_features if self._gbdt
+              else self._loaded["max_feature_idx"] + 1)
+        end = -1 if num_iteration is None or num_iteration <= 0 else \
+            start_iteration + num_iteration
+        return predict_contrib(trees, X, nf, k, start_iteration, end)
+
+    def _get_trees(self) -> List[Tree]:
+        return self._gbdt.models if self._gbdt is not None \
+            else self._loaded["trees"]
+
+    def refit(self, data: Any, label, decay_rate: Optional[float] = None,
+              weight=None, group=None, **kwargs) -> "Booster":
+        """Re-fit leaf values of the existing tree structures on new data
+        (reference GBDT::RefitTree gbdt.cpp:258, LGBM_BoosterRefit
+        c_api.h:776, FitByExistingTree serial_tree_learner.cpp:249-276).
+        Returns a new Booster; structures are unchanged, leaf outputs are
+        ``decay * old + (1 - decay) * new``."""
+        from .io.dataset import Metadata
+        from .models.model_io import objective_string_to_params
+
+        params = dict(self.params)
+        if self._gbdt is None and self._loaded is not None:
+            # file/string-loaded booster: recover the objective from the
+            # model header, not from (empty) construction params
+            params = {**objective_string_to_params(self._loaded["objective"]),
+                      **params}
+        cfg = Config(params)
+        if decay_rate is None:
+            decay_rate = float(cfg.refit_decay_rate)
+        X = self._to_matrix(data)
+        n = X.shape[0]
+        y = np.asarray(label, np.float64)
+        md = Metadata(n)
+        md.set_label(y)
+        if weight is not None:
+            md.set_weight(weight)
+        if group is not None:
+            md.set_group(group)
+        objective = create_objective(cfg)
+        if objective is None:
+            log.fatal("refit requires a built-in objective")
+        objective.init(md, n)
+
+        new_booster = Booster(model_str=self.model_to_string(num_iteration=-1))
+        trees = new_booster._loaded["trees"]
+        k = max(1, new_booster._loaded["num_tree_per_iteration"])
+        l1, l2 = float(cfg.lambda_l1), float(cfg.lambda_l2)
+        scores = np.zeros((n, k))
+        import jax.numpy as jnp
+        for it in range(len(trees) // k):
+            g, h = objective.get_gradients(
+                jnp.asarray(scores[:, 0] if k == 1 else scores, jnp.float32))
+            g = np.asarray(g, np.float64).reshape(n, k, order="F") \
+                if g.ndim == 1 else np.asarray(g, np.float64)
+            h = np.asarray(h, np.float64).reshape(n, k, order="F") \
+                if h.ndim == 1 else np.asarray(h, np.float64)
+            for c in range(k):
+                t = trees[it * k + c]
+                leaf = t.predict_leaf_index(X)
+                sg = np.bincount(leaf, weights=g[:, c],
+                                 minlength=t.num_leaves)
+                sh = np.bincount(leaf, weights=h[:, c],
+                                 minlength=t.num_leaves)
+                # CalculateSplittedLeafOutput with L1 thresholding
+                sg_reg = np.sign(sg) * np.maximum(np.abs(sg) - l1, 0.0)
+                new_out = -sg_reg / (sh + l2 + 1e-15) * t.shrinkage
+                t.leaf_value = decay_rate * t.leaf_value + \
+                    (1.0 - decay_rate) * new_out
+                scores[:, c] += t.leaf_value[leaf]
+        return new_booster
 
     # ------------------------------------------------------------- im/export
     def model_to_string(self, num_iteration: Optional[int] = None,

@@ -82,6 +82,7 @@ class GBDT:
         self.shrinkage_rate = float(config.learning_rate)
         self.models: List[Tree] = []          # iter-major, one per class
         self.iter_ = 0
+        self.num_init_iteration = 0
         self.best_iteration = -1
 
         # device operands
@@ -113,7 +114,10 @@ class GBDT:
 
     # ------------------------------------------------------------- helpers
     def _init_base_score(self) -> None:
-        if self.objective is None:
+        has_init_score = self.train_set.metadata.init_score is not None
+        if self.objective is None or has_init_score:
+            # reference gbdt.cpp:308 — no boost-from-average when the
+            # dataset carries init scores (e.g. train continuation)
             init = np.zeros(self.num_tree_per_iteration)
         elif self.config.boost_from_average or \
                 self.objective.NAME in ("mape",):
@@ -134,6 +138,20 @@ class GBDT:
                 if md.init_score.size != md.num_data else \
                 md.init_score.reshape(-1, 1)
             self.scores = self.scores + jnp.asarray(isc, jnp.float32)
+
+    def merge_from(self, trees: List[Tree]) -> None:
+        """Seed this booster with an init model's trees (reference
+        gbdt.h:70 ``MergeFrom``; train continuation).  The init model's
+        predictions are already in ``scores`` via the dataset init_score,
+        so only the model list and iteration counters move."""
+        import copy
+        k = self.num_tree_per_iteration
+        if len(trees) % k != 0:
+            log.fatal("init model has %d trees, not divisible by "
+                      "num_tree_per_iteration=%d" % (len(trees), k))
+        self.models = [copy.deepcopy(t) for t in trees] + self.models
+        self.num_init_iteration = len(trees) // k
+        self.iter_ = self.num_init_iteration
 
     def add_valid(self, valid_set: Dataset, name: str) -> None:
         """reference GBDT::AddValidDataset (gbdt.cpp:184)."""
@@ -259,7 +277,7 @@ class GBDT:
 
     # ------------------------------------------------------------- predict
     def predict_raw(self, X: np.ndarray, start_iteration: int = 0,
-                    num_iteration: int = -1) -> np.ndarray:
+                    num_iteration: int = -1, early=None) -> np.ndarray:
         X = np.asarray(X, dtype=np.float64)
         if X.ndim == 1:
             X = X.reshape(1, -1)
@@ -268,14 +286,23 @@ class GBDT:
         end = total_iters if num_iteration <= 0 else \
             min(total_iters, start_iteration + num_iteration)
         out = np.zeros((X.shape[0], k))
+        active = np.ones(X.shape[0], bool) if early is not None else None
         for it in range(start_iteration, end):
             for c in range(k):
-                out[:, c] += self.models[it * k + c].predict(X)
+                if early is not None:
+                    out[active, c] += self.models[it * k + c].predict(X[active])
+                else:
+                    out[:, c] += self.models[it * k + c].predict(X)
+            if early is not None and (it + 1) % early[1] == 0:
+                from ..basic import _margin_reached
+                active &= ~_margin_reached(out, early[2])
+                if not active.any():
+                    break
         return out[:, 0] if k == 1 else out
 
     def predict(self, X: np.ndarray, raw_score: bool = False,
                 start_iteration: int = 0, num_iteration: int = -1,
-                pred_leaf: bool = False) -> np.ndarray:
+                pred_leaf: bool = False, early=None) -> np.ndarray:
         if pred_leaf:
             X = np.asarray(X, dtype=np.float64)
             if X.ndim == 1:
@@ -288,7 +315,7 @@ class GBDT:
                       for it in range(start_iteration, end) for c in range(k)]
             return np.stack(leaves, axis=1) if leaves else \
                 np.zeros((X.shape[0], 0), np.int32)
-        raw = self.predict_raw(X, start_iteration, num_iteration)
+        raw = self.predict_raw(X, start_iteration, num_iteration, early=early)
         if raw_score or self.objective is None or \
                 not self.objective.need_convert_output:
             return raw
@@ -305,7 +332,7 @@ class GBDT:
         """reference GBDT::RollbackOneIter (gbdt.cpp:454) — pop the last
         iteration's trees and subtract their scores (excluding any folded
         boost-from-average bias, which self.scores tracks separately)."""
-        if self.iter_ <= 0:
+        if self.iter_ <= self.num_init_iteration:
             return
         k = self.num_tree_per_iteration
         for c in reversed(range(k)):

@@ -37,10 +37,14 @@ def train(params: Dict[str, Any], train_set: Dataset,
     if fobj is not None:
         params["objective"] = "none"
 
-    booster = Booster(params=params, train_set=train_set)
     if init_model is not None:
-        log.warning("init_model continuation is not yet supported; starting "
-                    "fresh")
+        # continuation (reference engine.py:233-244): the init model's raw
+        # predictions become the train/valid datasets' init_score, and its
+        # trees are merged into the new booster (basic.py Booster.__init__)
+        predictor = init_model if isinstance(init_model, Booster) \
+            else Booster(model_file=str(init_model))
+        train_set._apply_predictor(predictor)
+    booster = Booster(params=params, train_set=train_set)
 
     valid_sets = list(valid_sets or [])
     names = list(valid_names or [])

@@ -1,0 +1,186 @@
+"""SHAP feature contributions (TreeSHAP, path-dependent).
+
+TPU-native framework's equivalent of the reference ``PredictContrib`` path
+(reference: src/io/tree.cpp ``Tree::TreeSHAP`` recursive algorithm invoked
+from gbdt_prediction.cpp:44 ``PredictContrib``; Lundberg & Lee's exact
+polynomial-time tree SHAP).  Operates on the host-side ``Tree`` model; the
+output layout matches the reference: one column per feature plus a final
+"expected value" column, summed over all trees.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from .tree import _CAT_MASK, _DEFAULT_LEFT_MASK, Tree
+from ..io.binning import K_ZERO_THRESHOLD, MISSING_NONE, MISSING_ZERO
+
+
+class _PathElement:
+    __slots__ = ("feature_index", "zero_fraction", "one_fraction",
+                 "pweight")
+
+    def __init__(self, feature_index=-1, zero_fraction=0.0, one_fraction=0.0,
+                 pweight=0.0):
+        self.feature_index = feature_index
+        self.zero_fraction = zero_fraction
+        self.one_fraction = one_fraction
+        self.pweight = pweight
+
+    def copy(self) -> "_PathElement":
+        return _PathElement(self.feature_index, self.zero_fraction,
+                            self.one_fraction, self.pweight)
+
+
+def _extend(path: List[_PathElement], zero_fraction: float,
+            one_fraction: float, feature_index: int) -> None:
+    path.append(_PathElement(feature_index, zero_fraction, one_fraction,
+                             1.0 if len(path) == 0 else 0.0))
+    d = len(path) - 1
+    for i in range(d - 1, -1, -1):
+        path[i + 1].pweight += one_fraction * path[i].pweight * (i + 1) / (d + 1)
+        path[i].pweight = zero_fraction * path[i].pweight * (d - i) / (d + 1)
+
+
+def _unwind(path: List[_PathElement], index: int) -> None:
+    d = len(path) - 1
+    one_fraction = path[index].one_fraction
+    zero_fraction = path[index].zero_fraction
+    next_one_portion = path[d].pweight
+    for i in range(d - 1, -1, -1):
+        if one_fraction != 0.0:
+            tmp = path[i].pweight
+            path[i].pweight = next_one_portion * (d + 1) / \
+                ((i + 1) * one_fraction)
+            next_one_portion = tmp - path[i].pweight * zero_fraction * \
+                (d - i) / (d + 1)
+        else:
+            path[i].pweight = path[i].pweight * (d + 1) / \
+                (zero_fraction * (d - i))
+    for i in range(index, d):
+        path[i].feature_index = path[i + 1].feature_index
+        path[i].zero_fraction = path[i + 1].zero_fraction
+        path[i].one_fraction = path[i + 1].one_fraction
+    path.pop()
+
+
+def _unwound_path_sum(path: List[_PathElement], index: int) -> float:
+    d = len(path) - 1
+    one_fraction = path[index].one_fraction
+    zero_fraction = path[index].zero_fraction
+    next_one_portion = path[d].pweight
+    total = 0.0
+    for i in range(d - 1, -1, -1):
+        if one_fraction != 0.0:
+            tmp = next_one_portion * (d + 1) / ((i + 1) * one_fraction)
+            total += tmp
+            next_one_portion = path[i].pweight - tmp * zero_fraction * \
+                (d - i) / (d + 1)
+        elif zero_fraction != 0.0:
+            total += (path[i].pweight / zero_fraction) * (d + 1) / (d - i)
+    return total
+
+
+def _decide_left(tree: Tree, node: int, x: np.ndarray) -> bool:
+    """Scalar split decision (mirrors Tree.predict_leaf_index semantics)."""
+    f = int(tree.split_feature[node])
+    v = x[f]
+    dt = int(tree.decision_type[node])
+    if dt & _CAT_MASK:
+        csi = int(tree.cat_split_index[node])
+        if np.isnan(v):
+            return bool(tree.cat_nan_left[csi]) \
+                if csi < len(tree.cat_nan_left) else False
+        return int(v) in tree.cat_threshold[csi]
+    mtype = (dt >> 2) & 3
+    isnan = np.isnan(v)
+    miss = isnan or (mtype == MISSING_ZERO and abs(v) <= K_ZERO_THRESHOLD)
+    if miss and mtype != MISSING_NONE:
+        return bool(dt & _DEFAULT_LEFT_MASK)
+    v_safe = 0.0 if isnan else v
+    return v_safe <= tree.threshold[node]
+
+
+def _node_cover(tree: Tree, node: int) -> float:
+    if node < 0:
+        return max(float(tree.leaf_count[-node - 1]), 1.0)
+    return max(float(tree.internal_count[node]), 1.0)
+
+
+def tree_expected_value(tree: Tree) -> float:
+    total = tree.leaf_count.sum()
+    if total <= 0:
+        return float(tree.leaf_value.mean())
+    return float((tree.leaf_value * tree.leaf_count).sum() / total)
+
+
+def tree_shap_row(tree: Tree, x: np.ndarray, phi: np.ndarray) -> None:
+    """Accumulate one tree's SHAP values for one row into ``phi`` (len F+1)."""
+    phi[-1] += tree_expected_value(tree)
+    if tree.num_leaves == 1:
+        return
+
+    def recurse(node: int, path: List[_PathElement], zero_fraction: float,
+                one_fraction: float, feature_index: int) -> None:
+        path = [p.copy() for p in path]
+        _extend(path, zero_fraction, one_fraction, feature_index)
+        if node < 0:  # leaf
+            leaf_value = float(tree.leaf_value[-node - 1])
+            for i in range(1, len(path)):
+                w = _unwound_path_sum(path, i)
+                el = path[i]
+                phi[el.feature_index] += w * (el.one_fraction -
+                                              el.zero_fraction) * leaf_value
+        else:
+            go_left = _decide_left(tree, node, x)
+            hot = int(tree.left_child[node] if go_left
+                      else tree.right_child[node])
+            cold = int(tree.right_child[node] if go_left
+                       else tree.left_child[node])
+            w = _node_cover(tree, node)
+            hot_zero = _node_cover(tree, hot) / w
+            cold_zero = _node_cover(tree, cold) / w
+            incoming_zero = 1.0
+            incoming_one = 1.0
+            split_f = int(tree.split_feature[node])
+            k = next((i for i in range(len(path))
+                      if path[i].feature_index == split_f), -1)
+            if k >= 0:
+                incoming_zero = path[k].zero_fraction
+                incoming_one = path[k].one_fraction
+                _unwind(path, k)
+            recurse(hot, path, incoming_zero * hot_zero, incoming_one, split_f)
+            recurse(cold, path, incoming_zero * cold_zero, 0.0, split_f)
+
+    recurse(0, [], 1.0, 1.0, -1)
+
+
+def predict_contrib(trees: List[Tree], X: np.ndarray, num_features: int,
+                    num_tree_per_iteration: int = 1,
+                    start_iteration: int = 0,
+                    end_iteration: int = -1) -> np.ndarray:
+    """SHAP contributions summed over trees.
+
+    Returns ``[n, F + 1]`` for single-output models, ``[n, k * (F + 1)]``
+    flattened class-major for ``k``-output models (reference
+    PredictContrib layout, c_api.h predict_type=C_API_PREDICT_CONTRIB).
+    """
+    X = np.asarray(X, np.float64)
+    if X.ndim == 1:
+        X = X.reshape(1, -1)
+    n = X.shape[0]
+    k = max(1, num_tree_per_iteration)
+    total_iters = len(trees) // k if k else 0
+    end = total_iters if end_iteration is None or end_iteration <= 0 else \
+        min(total_iters, end_iteration)
+    phi = np.zeros((n, k, num_features + 1))
+    for it in range(start_iteration, end):
+        for c in range(k):
+            t = trees[it * k + c]
+            for r in range(n):
+                tree_shap_row(t, X[r], phi[r, c])
+    if k == 1:
+        return phi[:, 0, :]
+    return phi.reshape(n, k * (num_features + 1))

@@ -17,12 +17,12 @@ Layouts put the row dimension last (lane dim, 128-aligned):
 The sequential TPU grid revisits the same output block, giving cheap
 cross-block accumulation (zeroed at step 0 via pl.when).
 
-Values are cast to bf16 for the MXU contraction by default (the one-hot is
-exact; only grad/hess suffer ~2^-9 relative input rounding — the count
-channel stays exact since 1.0 is representable).  Set
-``tpu_hist_dtype=float32`` in the Config for full-precision contraction at
-~4x the MXU cost (reference parity note: CUDA accumulates fp64,
-config.h:1129 gpu_use_dp).
+The contraction dtype defaults to float32 for split-decision parity with
+the reference (its CUDA learner accumulates fp64 by default, config.h:1129
+``gpu_use_dp``).  Set ``tpu_hist_dtype=bfloat16`` in the Config to run the
+MXU contraction at ~8x rate: the one-hot stays exact and accumulation is
+f32, only grad/hess suffer ~2^-9 relative input rounding — the count
+channel stays exact since 1.0 is representable.
 """
 
 from __future__ import annotations

@@ -50,7 +50,7 @@ def use_pallas() -> bool:
 
 def histogram_rows(bins: jax.Array, vals: jax.Array, *, n_bins: int,
                    rows_per_block: int = 4096,
-                   hist_dtype: str = "bfloat16") -> jax.Array:
+                   hist_dtype: str = "float32") -> jax.Array:
     """Backend-dispatched histogram over a row set.
 
     bins: uint8 [S, F]; vals: f32 [S, C] (masked rows zero).
@@ -108,33 +108,12 @@ def build_histogram(bins: jax.Array, vals: jax.Array, *, n_bins: int = 256,
     return hist[:num_feat]
 
 
-def histogram_for_leaf(bins: jax.Array, grad: jax.Array, hess: jax.Array,
-                       leaf_of_row: jax.Array, leaf: jax.Array,
-                       row_mask: Optional[jax.Array] = None, *,
-                       n_bins: int = 256, rows_per_block: int = 4096,
-                       hist_dtype: str = "bfloat16",
-                       axis_name: Optional[str] = None) -> jax.Array:
-    """Histogram of one leaf's rows via masking (dense row→leaf map — the
-    TPU answer to CUDADataPartition: no data movement, rows never reorder)."""
-    mask = (leaf_of_row == leaf)
-    if row_mask is not None:
-        mask = mask & row_mask
-    m = mask.astype(grad.dtype)
-    vals = jnp.stack([grad * m, hess * m, m, jnp.zeros_like(m)], axis=1)
-    hist = histogram_rows(bins, vals, n_bins=n_bins,
-                          rows_per_block=rows_per_block,
-                          hist_dtype=hist_dtype)
-    if axis_name is not None:
-        hist = lax.psum(hist, axis_name)
-    return hist
-
-
 def histogram_for_leaf_bucketed(bins: jax.Array, grad: jax.Array,
                                 hess: jax.Array, leaf_of_row: jax.Array,
                                 leaf: jax.Array, leaf_count: jax.Array,
                                 row_mask: Optional[jax.Array] = None, *,
                                 n_bins: int = 256, rows_per_block: int = 4096,
-                                min_bucket: int = 8192, hist_dtype: str = "bfloat16",
+                                min_bucket: int = 8192, hist_dtype: str = "float32",
                                 axis_name: Optional[str] = None) -> jax.Array:
     """Histogram of one leaf touching only ~leaf_count rows.
 
@@ -195,7 +174,7 @@ def histogram_for_leaf_bucketed(bins: jax.Array, grad: jax.Array,
 def root_histogram(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                    row_mask: Optional[jax.Array] = None, *,
                    n_bins: int = 256, rows_per_block: int = 4096,
-                   hist_dtype: str = "bfloat16",
+                   hist_dtype: str = "float32",
                    axis_name: Optional[str] = None) -> jax.Array:
     m = jnp.ones_like(grad) if row_mask is None else row_mask.astype(grad.dtype)
     vals = jnp.stack([grad * m, hess * m, m, jnp.zeros_like(m)], axis=1)

@@ -50,7 +50,7 @@ class SplitHyper:
     n_bins: int = 256
     rows_per_block: int = 4096
     path_smooth: float = 0.0
-    hist_dtype: str = "bfloat16"   # MXU contraction dtype for histograms
+    hist_dtype: str = "float32"   # MXU contraction dtype; "bfloat16" opts into 8x MXU rate
 
 
 class SplitResult(NamedTuple):

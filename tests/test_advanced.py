@@ -1,0 +1,162 @@
+"""Continuation, SHAP, refit, prediction early stop.
+
+Mirrors reference test coverage: test_engine.py continuation tests,
+test_basic.py pred_contrib additivity, refit tests.
+"""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _logloss(y, raw):
+    return float(np.mean(np.log1p(np.exp(-(2 * y - 1) * raw))))
+
+
+def test_continuation_init_model(synthetic_binary):
+    X, y = synthetic_binary
+    p = {"objective": "binary", "num_leaves": 15, "verbose": -1}
+    b1 = lgb.train(p, lgb.Dataset(X, y, free_raw_data=False),
+                   num_boost_round=8)
+    b2 = lgb.train(p, lgb.Dataset(X, y, free_raw_data=False),
+                   num_boost_round=8, init_model=b1)
+    assert b2.num_trees() == 16
+    l1 = _logloss(y, b1.predict(X, raw_score=True))
+    l2 = _logloss(y, b2.predict(X, raw_score=True))
+    assert l2 < l1
+
+
+def test_continuation_from_file(tmp_path, synthetic_regression):
+    X, y = synthetic_regression
+    p = {"objective": "regression", "num_leaves": 15, "verbose": -1}
+    b1 = lgb.train(p, lgb.Dataset(X, y, free_raw_data=False),
+                   num_boost_round=5)
+    f = str(tmp_path / "m.txt")
+    b1.save_model(f)
+    b2 = lgb.train(p, lgb.Dataset(X, y, free_raw_data=False),
+                   num_boost_round=5, init_model=f)
+    assert b2.num_trees() == 10
+    # continued model is self-contained after save/load
+    f2 = str(tmp_path / "m2.txt")
+    b2.save_model(f2)
+    b3 = lgb.Booster(model_file=f2)
+    np.testing.assert_allclose(b2.predict(X[:100]), b3.predict(X[:100]),
+                               rtol=1e-6)
+
+
+def test_shap_additivity_binary(synthetic_binary):
+    X, y = synthetic_binary
+    b = lgb.train({"objective": "binary", "num_leaves": 15, "verbose": -1},
+                  lgb.Dataset(X, y), num_boost_round=8)
+    contrib = b.predict(X[:64], pred_contrib=True)
+    raw = b.predict(X[:64], raw_score=True)
+    assert contrib.shape == (64, X.shape[1] + 1)
+    np.testing.assert_allclose(contrib.sum(axis=1), raw, atol=1e-9)
+
+
+def test_shap_additivity_multiclass():
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(600, 5))
+    y = (X[:, 0] + X[:, 1] > 0).astype(int) + (X[:, 2] > 0.5).astype(int)
+    b = lgb.train({"objective": "multiclass", "num_class": 3,
+                   "num_leaves": 7, "verbose": -1},
+                  lgb.Dataset(X, y), num_boost_round=5)
+    contrib = b.predict(X[:32], pred_contrib=True)
+    raw = b.predict(X[:32], raw_score=True)
+    nfp1 = X.shape[1] + 1
+    assert contrib.shape == (32, 3 * nfp1)
+    for c in range(3):
+        np.testing.assert_allclose(
+            contrib[:, c * nfp1:(c + 1) * nfp1].sum(axis=1), raw[:, c],
+            atol=1e-9)
+
+
+def test_shap_loaded_model(tmp_path, synthetic_binary):
+    X, y = synthetic_binary
+    b = lgb.train({"objective": "binary", "num_leaves": 7, "verbose": -1},
+                  lgb.Dataset(X, y), num_boost_round=4)
+    f = str(tmp_path / "m.txt")
+    b.save_model(f)
+    b2 = lgb.Booster(model_file=f)
+    np.testing.assert_allclose(b.predict(X[:16], pred_contrib=True),
+                               b2.predict(X[:16], pred_contrib=True),
+                               rtol=1e-6, atol=1e-9)
+
+
+def test_refit(synthetic_binary):
+    X, y = synthetic_binary
+    b = lgb.train({"objective": "binary", "num_leaves": 15, "verbose": -1},
+                  lgb.Dataset(X, y), num_boost_round=8)
+    # refit on a disjoint resample of the data
+    rng = np.random.default_rng(1)
+    idx = rng.permutation(len(y))[:1000]
+    b2 = b.refit(X[idx], y[idx], decay_rate=0.5)
+    assert b2.num_trees() == b.num_trees()
+    # structures unchanged: leaf assignment identical
+    np.testing.assert_array_equal(
+        b.predict(X[:64], pred_leaf=True), b2.predict(X[:64], pred_leaf=True))
+    # leaf values changed
+    assert np.abs(b.predict(X[:64], raw_score=True) -
+                  b2.predict(X[:64], raw_score=True)).max() > 1e-8
+    # still a sane model
+    assert _logloss(y, b2.predict(X, raw_score=True)) < 0.69
+
+
+def test_pred_early_stop(synthetic_binary):
+    X, y = synthetic_binary
+    b = lgb.train({"objective": "binary", "num_leaves": 15, "verbose": -1},
+                  lgb.Dataset(X, y), num_boost_round=20)
+    full = b.predict(X) > 0.5
+    fast = b.predict(X, pred_early_stop=True, pred_early_stop_freq=5,
+                     pred_early_stop_margin=4.0) > 0.5
+    # high margin => almost no disagreement
+    assert np.mean(full == fast) > 0.98
+
+
+def test_continuation_reused_constructed_dataset(synthetic_binary):
+    """Same Dataset object trained twice with init_model: the predictor's
+    init_score must be injected even though construct() already ran."""
+    X, y = synthetic_binary
+    p = {"objective": "binary", "num_leaves": 15, "verbose": -1}
+    d = lgb.Dataset(X, y, free_raw_data=False)
+    b1 = lgb.train(p, d, num_boost_round=8)
+    b2 = lgb.train(p, d, num_boost_round=8, init_model=b1)
+    # without init_score injection the merged model double-counts:
+    # raw scores would be ~2x and logloss would blow up
+    l1 = _logloss(y, b1.predict(X, raw_score=True))
+    l2 = _logloss(y, b2.predict(X, raw_score=True))
+    assert l2 < l1
+
+
+def test_continuation_freed_raw_data_fatal(synthetic_binary):
+    X, y = synthetic_binary
+    p = {"objective": "binary", "num_leaves": 7, "verbose": -1}
+    d = lgb.Dataset(X, y)  # free_raw_data=True
+    b1 = lgb.train(p, d, num_boost_round=2)
+    with pytest.raises(Exception):
+        lgb.train(p, d, num_boost_round=2, init_model=b1)
+
+
+def test_refit_loaded_booster_uses_model_objective(tmp_path, synthetic_binary):
+    X, y = synthetic_binary
+    b = lgb.train({"objective": "binary", "num_leaves": 7, "verbose": -1},
+                  lgb.Dataset(X, y), num_boost_round=5)
+    f = str(tmp_path / "m.txt")
+    b.save_model(f)
+    loaded = lgb.Booster(model_file=f)  # no params — objective from header
+    b2 = loaded.refit(X, y, decay_rate=0.0)
+    # fully renewed leaves under the correct (binary) objective stay sane
+    assert _logloss(y, b2.predict(X, raw_score=True)) < 0.69
+
+
+def test_rollback_respects_init_model(synthetic_binary):
+    X, y = synthetic_binary
+    p = {"objective": "binary", "num_leaves": 7, "verbose": -1}
+    b1 = lgb.train(p, lgb.Dataset(X, y, free_raw_data=False),
+                   num_boost_round=3)
+    b2 = lgb.train(p, lgb.Dataset(X, y, free_raw_data=False),
+                   num_boost_round=2, init_model=b1)
+    for _ in range(5):  # attempts below the init boundary are no-ops
+        b2.rollback_one_iter()
+    assert b2.num_trees() == 3
