@@ -18,6 +18,7 @@ so saved models are self-contained.
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -51,11 +52,76 @@ def _hp_from_config(cfg: Config, n_bins: int) -> SplitHyper:
         cat_l2=float(cfg.cat_l2),
         cat_smooth=float(cfg.cat_smooth),
         max_cat_threshold=int(cfg.max_cat_threshold),
+        max_cat_to_onehot=int(cfg.max_cat_to_onehot),
+        min_data_per_group=int(cfg.min_data_per_group),
         n_bins=n_bins,
         rows_per_block=int(cfg.tpu_rows_per_block),
         path_smooth=float(cfg.path_smooth),
         hist_dtype=str(cfg.tpu_hist_dtype),
+        extra_trees=bool(cfg.extra_trees),
+        feature_fraction_bynode=float(cfg.feature_fraction_bynode),
     )
+
+
+def _parse_forced_splits(filename: str, dataset: Dataset, num_leaves: int):
+    """forcedsplits_filename JSON -> (leaf, feature, bin_thr) i32 arrays in
+    BFS order (reference serial_tree_learner.cpp:620 ForceSplits BFS; node
+    format {"feature": orig_idx, "threshold": value, "left": ..., "right":
+    ...}).  Leaf numbering matches the grower: at BFS step i the right child
+    becomes leaf i+1."""
+    import json
+    with open(filename) as fh:
+        root = json.load(fh)
+    if not root:
+        return None
+    orig_to_packed = {int(o): p for p, o in enumerate(dataset.used_feature_idx)}
+    K = num_leaves - 1
+    f_leaf = np.full(K, -1, np.int32)
+    f_feat = np.zeros(K, np.int32)
+    f_thr = np.zeros(K, np.int32)
+    queue = [(root, 0)]
+    i = 0
+    while queue and i < K:
+        node, leaf = queue.pop(0)
+        p = orig_to_packed.get(int(node["feature"]))
+        if p is None:
+            log.warning("forced split on unused feature %s ignored; "
+                        "aborting remaining forced splits" % node["feature"])
+            break
+        mapper = dataset.mappers[int(node["feature"])]
+        thr_bin = int(mapper.values_to_bins(
+            np.array([float(node["threshold"])], np.float64))[0])
+        f_leaf[i], f_feat[i], f_thr[i] = leaf, p, thr_bin
+        if node.get("left"):
+            queue.append((node["left"], leaf))
+        if node.get("right"):
+            queue.append((node["right"], i + 1))
+        i += 1
+    if i == 0:
+        return None
+    return (jnp.asarray(f_leaf), jnp.asarray(f_feat), jnp.asarray(f_thr))
+
+
+def _parse_interaction_sets(spec, used_feature_idx) -> Optional[np.ndarray]:
+    """interaction_constraints "[0,1,2],[2,3]" -> bool [S, F_packed]
+    (reference config interaction_constraints_vector; col_sampler.hpp)."""
+    if not spec:
+        return None
+    if isinstance(spec, str):
+        import json
+        sets = json.loads("[" + spec + "]")
+    else:
+        sets = [list(s) for s in spec]
+    if not sets:
+        return None
+    orig_to_packed = {int(o): p for p, o in enumerate(used_feature_idx)}
+    out = np.zeros((len(sets), len(used_feature_idx)), bool)
+    for si, s in enumerate(sets):
+        for f in s:
+            p = orig_to_packed.get(int(f))
+            if p is not None:
+                out[si, p] = True
+    return out
 
 
 class GBDT:
@@ -86,14 +152,39 @@ class GBDT:
         self.best_iteration = -1
 
         # device operands
-        n_bins = 1 << max(1, (train_set.max_num_bin() - 1).bit_length())
-        n_bins = max(n_bins, 4)
-        self.hp = _hp_from_config(config, n_bins)
+        self.hp = _hp_from_config(config, train_set.device_n_bins())
+        if bool(train_set.categorical_array().any()):
+            self.hp = dataclasses.replace(self.hp, has_categorical=True)
         self.bins = jnp.asarray(train_set.bins)
         self.num_bins_arr = jnp.asarray(train_set.num_bins_array())
         self.nan_bin_arr = jnp.asarray(train_set.nan_bin_array())
         self.is_cat_arr = jnp.asarray(train_set.categorical_array())
         self.num_features = train_set.num_features
+
+        # monotone constraints: per-ORIGINAL-feature directions from config,
+        # remapped to packed (used) features; categorical features forced 0
+        self.monotone_arr = None
+        mono_cfg = list(config.monotone_constraints or [])
+        if any(int(m) != 0 for m in mono_cfg):
+            full = np.zeros(train_set.num_total_features, np.int32)
+            full[:len(mono_cfg)] = np.asarray(mono_cfg, np.int32)[
+                :train_set.num_total_features]
+            packed = full[np.asarray(train_set.used_feature_idx)]
+            packed[np.asarray(train_set.categorical_array())] = 0
+            self.monotone_arr = jnp.asarray(packed)
+            self.hp = dataclasses.replace(
+                self.hp, use_monotone=True,
+                monotone_penalty=float(config.monotone_penalty))
+
+        isets = _parse_interaction_sets(config.interaction_constraints,
+                                        train_set.used_feature_idx)
+        self.interaction_sets = None if isets is None else jnp.asarray(isets)
+        self._needs_node_rng = (self.hp.extra_trees
+                                or self.hp.feature_fraction_bynode < 1.0)
+        self.forced_splits = None
+        if config.forcedsplits_filename:
+            self.forced_splits = _parse_forced_splits(
+                config.forcedsplits_filename, train_set, self.hp.num_leaves)
 
         n = train_set.num_data
         k = self.num_tree_per_iteration
@@ -198,10 +289,17 @@ class GBDT:
 
         finished = True
         for cls_idx in range(k):
+            node_key = None
+            if self._needs_node_rng:
+                node_key = jax.random.PRNGKey(
+                    int(self.config.extra_seed) * 1000003
+                    + self.iter_ * k + cls_idx)
             arrays, leaf_of_row = grow_tree(
                 self.bins, g[:, cls_idx], h[:, cls_idx], row_mask,
                 self.num_bins_arr, self.nan_bin_arr, self.is_cat_arr,
-                feature_mask, self.hp)
+                feature_mask, self.hp, monotone=self.monotone_arr,
+                rng_key=node_key, interaction_sets=self.interaction_sets,
+                forced=self.forced_splits)
             num_leaves = int(arrays.num_leaves)
             if num_leaves > 1:
                 finished = False
@@ -360,6 +458,21 @@ def _tree_to_arrays_stub(tree: Tree, dataset: Dataset,
         out[:len(a)] = a[:ni]
         return out
 
+    n_bins = dataset.device_n_bins()
+    bitset = np.zeros((ni, n_bins), bool)
+    for i in range(min(len(tree.split_feature), ni)):
+        if not (tree.decision_type[i] & 1):
+            continue
+        csi = int(tree.cat_split_index[i])
+        if csi < 0 or csi >= len(tree.cat_threshold):
+            continue
+        mapper = dataset.mappers[int(tree.split_feature[i])]
+        table = mapper._cat_2_bin or {}
+        for c in tree.cat_threshold[csi]:
+            b = table.get(int(c))
+            if b is not None and b < n_bins:
+                bitset[i, b] = True
+
     return TreeArrays(
         split_feature=jnp.asarray(pad(sf, 0, np.int32)),
         split_bin=jnp.asarray(pad(tree.threshold_bin, 0, np.int32)),
@@ -368,6 +481,7 @@ def _tree_to_arrays_stub(tree: Tree, dataset: Dataset,
         left_child=jnp.asarray(pad(tree.left_child, -1, np.int32)),
         right_child=jnp.asarray(pad(tree.right_child, -1, np.int32)),
         split_gain=jnp.zeros(ni, jnp.float32),
+        cat_bitset=jnp.asarray(bitset),
         internal_value=jnp.zeros(ni, jnp.float32),
         internal_count=jnp.zeros(ni, jnp.float32),
         leaf_value=jnp.asarray(np.concatenate(

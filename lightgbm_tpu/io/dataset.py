@@ -150,6 +150,13 @@ class Dataset:
         return int(max((self.mappers[i].num_bin for i in self.used_feature_idx),
                        default=1))
 
+    def device_n_bins(self) -> int:
+        """Bin-axis width of device histograms / cat bitsets: max_num_bin
+        rounded up to a power of two (lane-friendly), floor 4.  Single source
+        of truth — trees and their cat_bitset widths must agree with it."""
+        n_bins = 1 << max(1, (self.max_num_bin() - 1).bit_length())
+        return max(n_bins, 4)
+
     # ---------------------------------------------------------- construction
     @classmethod
     def from_data(cls, data: Any, label: Optional[Sequence[float]] = None,

@@ -37,8 +37,12 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..ops.histogram import histogram_for_leaf_bucketed, root_histogram
-from ..ops.split import (NEG_INF, SplitHyper, SplitResult, find_best_split,
-                         leaf_output)
+from ..ops.split import (NEG_INF, VAR_CAT_BWD, VAR_CAT_FWD, VAR_CAT_ONEHOT,
+                         VAR_NUM_RIGHT, SplitHyper, SplitResult,
+                         categorical_left_bitset, find_best_split, leaf_gain,
+                         leaf_output, smoothed_output)
+
+_INF_BOUND = 3.0e38  # leaf-output bound sentinel (±"infinity" in f32)
 
 
 class TreeArrays(NamedTuple):
@@ -50,6 +54,7 @@ class TreeArrays(NamedTuple):
     left_child: jax.Array      # i32 [L-1]; >=0 node, negative -(leaf+1)
     right_child: jax.Array     # i32 [L-1]
     split_gain: jax.Array      # f32 [L-1]
+    cat_bitset: jax.Array      # bool [L-1, B] — bins going left (cat splits)
     internal_value: jax.Array  # f32 [L-1] node output before split (SHAP)
     internal_count: jax.Array  # f32 [L-1]
     leaf_value: jax.Array      # f32 [L]
@@ -71,15 +76,20 @@ class _GrowState(NamedTuple):
     best_thr: jax.Array
     best_dl: jax.Array         # bool [L]
     best_cat: jax.Array        # bool [L]
+    best_var: jax.Array        # i32 [L] winning VAR_* variant
     best_lg: jax.Array         # f32 [L] left child sums of cached best split
     best_lh: jax.Array
     best_lc: jax.Array
     parent_node: jax.Array     # i32 [L] internal node owning this leaf (-1 root)
     parent_side: jax.Array     # i32 [L] 0 left / 1 right
+    leaf_min: jax.Array        # f32 [L] output lower bound (monotone)
+    leaf_max: jax.Array        # f32 [L] output upper bound
+    path_feats: jax.Array      # bool [L, F] features used on leaf's path
+    force_failed: jax.Array    # bool scalar — forced-split BFS aborted
     done: jax.Array            # bool scalar
 
 
-def _empty_tree(num_leaves: int) -> TreeArrays:
+def _empty_tree(num_leaves: int, n_bins: int) -> TreeArrays:
     li = num_leaves - 1
     return TreeArrays(
         split_feature=jnp.full((li,), -1, jnp.int32),
@@ -89,6 +99,7 @@ def _empty_tree(num_leaves: int) -> TreeArrays:
         left_child=jnp.full((li,), -1, jnp.int32),
         right_child=jnp.full((li,), -1, jnp.int32),
         split_gain=jnp.zeros((li,), jnp.float32),
+        cat_bitset=jnp.zeros((li, n_bins), bool),
         internal_value=jnp.zeros((li,), jnp.float32),
         internal_count=jnp.zeros((li,), jnp.float32),
         leaf_value=jnp.zeros((num_leaves,), jnp.float32),
@@ -101,9 +112,12 @@ def _empty_tree(num_leaves: int) -> TreeArrays:
 
 def _child_best(hist: jax.Array, g: jax.Array, h: jax.Array, c: jax.Array,
                 depth: jax.Array, num_bins, nan_bin, is_cat, feature_mask,
-                hp: SplitHyper) -> SplitResult:
+                hp: SplitHyper, monotone=None, parent_output=0.0,
+                leaf_min=None, leaf_max=None, rng_key=None) -> SplitResult:
     res = find_best_split(hist, g, h, c, num_bins, nan_bin, is_cat,
-                          feature_mask, hp)
+                          feature_mask, hp, monotone=monotone,
+                          parent_output=parent_output, leaf_min=leaf_min,
+                          leaf_max=leaf_max, depth=depth, rng_key=rng_key)
     depth_ok = (hp.max_depth <= 0) | (depth < hp.max_depth)
     return res._replace(gain=jnp.where(depth_ok, res.gain, NEG_INF))
 
@@ -113,13 +127,26 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
               row_mask: Optional[jax.Array], num_bins: jax.Array,
               nan_bin: jax.Array, is_cat: jax.Array,
               feature_mask: Optional[jax.Array], hp: SplitHyper,
-              axis_name: Optional[str] = None
+              axis_name: Optional[str] = None,
+              monotone: Optional[jax.Array] = None,
+              rng_key: Optional[jax.Array] = None,
+              interaction_sets: Optional[jax.Array] = None,
+              forced: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None
               ) -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree; returns (TreeArrays, leaf_of_row).
 
     bins: uint8 [n, F]; grad/hess: f32 [n]; row_mask: bool [n] or None
     (bagging); num_bins/nan_bin: i32 [F]; is_cat: bool [F];
     feature_mask: bool [F] or None (feature_fraction).
+    rng_key: PRNG key for per-node feature sampling / extra_trees (must be
+    identical on all shards under shard_map).  interaction_sets: bool [S, F]
+    allowed-together feature sets (reference col_sampler.hpp:91 GetByNode —
+    a leaf may only split on features from sets containing its whole path).
+    forced: (leaf, feature, bin_threshold) i32 [L-1] arrays (−1 padded) —
+    host-precomputed BFS order of forcedsplits_filename JSON (reference
+    serial_tree_learner.cpp:620 ForceSplits); a forced entry that fails
+    validity (min_data / non-positive gain) aborts the remaining schedule,
+    mirroring the reference's ignore-with-warning.
     ``leaf_of_row`` is returned for ALL rows (bagged-out rows included), so the
     boosting score update is a pure gather — the reference's train-score
     shortcut through DataPartition (score_updater.hpp).
@@ -127,6 +154,27 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     n, num_f = bins.shape
     L = hp.num_leaves
     mask_f = jnp.ones_like(grad) if row_mask is None else row_mask.astype(grad.dtype)
+
+    use_bynode = hp.feature_fraction_bynode < 1.0 and rng_key is not None
+
+    def node_feature_mask(path_f: jax.Array, key) -> Optional[jax.Array]:
+        """Per-node allowed features: tree-level mask ∧ interaction
+        constraints ∧ by-node random subset."""
+        m = feature_mask
+        if interaction_sets is not None:
+            fits = jnp.all(interaction_sets | ~path_f[None, :], axis=1)  # [S]
+            allowed = jnp.any(interaction_sets & fits[:, None],
+                              axis=0) | path_f
+            m = allowed if m is None else (m & allowed)
+        if use_bynode:
+            base = jnp.ones((num_f,), bool) if m is None else m
+            u = jax.random.uniform(key, (num_f,))
+            u = jnp.where(base, u, -1.0)
+            cnt = jnp.maximum(
+                (base.sum() * hp.feature_fraction_bynode).astype(jnp.int32), 1)
+            kth = jnp.sort(u)[num_f - cnt]
+            m = base & (u >= kth) & (u >= 0)
+        return m
 
     hist0 = root_histogram(bins, grad, hess, row_mask, n_bins=hp.n_bins,
                            rows_per_block=hp.rows_per_block,
@@ -139,13 +187,23 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         h0 = lax.psum(h0, axis_name)
         c0 = lax.psum(c0, axis_name)
 
+    root_out = leaf_output(g0, h0, hp.lambda_l1, hp.lambda_l2,
+                           hp.max_delta_step)
+    inf = jnp.float32(_INF_BOUND)
+    empty_path = jnp.zeros((num_f,), bool)
+    if rng_key is not None:
+        key_root, key_er = jax.random.split(jax.random.fold_in(rng_key, L))
+    else:
+        key_root = key_er = None
+    fm_root = node_feature_mask(empty_path, key_root)
     best0 = _child_best(hist0, g0, h0, c0, jnp.int32(0), num_bins, nan_bin,
-                        is_cat, feature_mask, hp)
+                        is_cat, fm_root, hp, monotone=monotone,
+                        parent_output=root_out, leaf_min=-inf, leaf_max=inf,
+                        rng_key=key_er)
 
-    tree = _empty_tree(L)
+    tree = _empty_tree(L, hp.n_bins)
     tree = tree._replace(
-        leaf_value=tree.leaf_value.at[0].set(
-            leaf_output(g0, h0, hp.lambda_l1, hp.lambda_l2, hp.max_delta_step)),
+        leaf_value=tree.leaf_value.at[0].set(root_out),
         leaf_count=tree.leaf_count.at[0].set(c0),
         leaf_weight=tree.leaf_weight.at[0].set(h0),
     )
@@ -162,28 +220,86 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         best_thr=jnp.zeros((L,), jnp.int32).at[0].set(best0.threshold),
         best_dl=jnp.zeros((L,), bool).at[0].set(best0.default_left),
         best_cat=jnp.zeros((L,), bool).at[0].set(best0.is_categorical),
+        best_var=jnp.zeros((L,), jnp.int32).at[0].set(best0.variant),
         best_lg=jnp.zeros((L,), jnp.float32).at[0].set(best0.left_sum_g),
         best_lh=jnp.zeros((L,), jnp.float32).at[0].set(best0.left_sum_h),
         best_lc=jnp.zeros((L,), jnp.float32).at[0].set(best0.left_count),
         parent_node=jnp.full((L,), -1, jnp.int32),
         parent_side=jnp.zeros((L,), jnp.int32),
+        leaf_min=jnp.full((L,), -_INF_BOUND, jnp.float32),
+        leaf_max=jnp.full((L,), _INF_BOUND, jnp.float32),
+        path_feats=jnp.zeros((L, num_f), bool),
+        force_failed=jnp.bool_(False),
         done=jnp.bool_(False),
     )
 
     def body(i, st: _GrowState) -> _GrowState:
         bl = jnp.argmax(st.best_gain).astype(jnp.int32)
-        do = (~st.done) & (st.best_gain[bl] > 0.0)
+        feat = st.best_feat[bl]
+        thr = st.best_thr[bl]
+        dl = st.best_dl[bl]
+        catl = st.best_cat[bl]
+        var = st.best_var[bl]
+        gain_rec = st.best_gain[bl]
+        ch_lg, ch_lh, ch_lc = st.best_lg[bl], st.best_lh[bl], st.best_lc[bl]
+        do = (~st.done) & (gain_rec > 0.0)
+
+        if forced is not None:
+            f_leaf, f_feat, f_thr = forced
+            f_active = (f_leaf[i] >= 0) & ~st.force_failed & ~st.done
+            fl = jnp.maximum(f_leaf[i], 0)
+            ff, ft = f_feat[i], f_thr[i]
+            hf = st.hist[fl, ff]                               # [B, C]
+            b_i = lax.iota(jnp.int32, hp.n_bins)
+            lm = jnp.where(is_cat[ff], b_i == ft,
+                           (b_i <= ft) & (b_i != nan_bin[ff]))
+            lmf = lm.astype(hf.dtype)
+            lgf = jnp.sum(hf[:, 0] * lmf)
+            lhf = jnp.sum(hf[:, 1] * lmf)
+            lcf = jnp.sum(hf[:, 2] * lmf)
+            pgf, phf, pcf = st.sum_g[fl], st.sum_h[fl], st.count[fl]
+            rgf, rhf, rcf = pgf - lgf, phf - lhf, pcf - lcf
+            gf = (leaf_gain(lgf, lhf, hp.lambda_l1, hp.lambda_l2)
+                  + leaf_gain(rgf, rhf, hp.lambda_l1, hp.lambda_l2)
+                  - leaf_gain(pgf, phf, hp.lambda_l1, hp.lambda_l2)
+                  - hp.min_gain_to_split)
+            ok_f = ((lcf >= hp.min_data_in_leaf)
+                    & (rcf >= hp.min_data_in_leaf)
+                    & (lhf >= hp.min_sum_hessian_in_leaf)
+                    & (rhf >= hp.min_sum_hessian_in_leaf)
+                    & (gf > 0.0))
+            use_f = f_active & ok_f
+            st = st._replace(force_failed=st.force_failed
+                             | (f_active & ~ok_f))
+            bl = jnp.where(use_f, fl, bl)
+            feat = jnp.where(use_f, ff, feat)
+            thr = jnp.where(use_f, ft, thr)
+            dl = jnp.where(use_f, False, dl)
+            catl = jnp.where(use_f, is_cat[ff], catl)
+            var = jnp.where(use_f,
+                            jnp.where(is_cat[ff], VAR_CAT_ONEHOT,
+                                      VAR_NUM_RIGHT), var)
+            gain_rec = jnp.where(use_f, gf, gain_rec)
+            ch_lg = jnp.where(use_f, lgf, st.best_lg[bl])
+            ch_lh = jnp.where(use_f, lhf, st.best_lh[bl])
+            ch_lc = jnp.where(use_f, lcf, st.best_lc[bl])
+            do = (~st.done) & (use_f | (st.best_gain[bl] > 0.0))
 
         def no_split(st: _GrowState) -> _GrowState:
             return st._replace(done=jnp.bool_(True))
 
         def split(st: _GrowState) -> _GrowState:
             t = st.tree
-            feat = st.best_feat[bl]
-            thr = st.best_thr[bl]
-            dl = st.best_dl[bl]
-            catl = st.best_cat[bl]
             new_leaf = i + 1
+
+            # left-category bitset, derived from the PARENT histogram (still
+            # at st.hist[bl] at this point)
+            if hp.has_categorical:
+                bitset = categorical_left_bitset(st.hist[bl, feat],
+                                                 num_bins[feat], var, thr, hp)
+                bitset = bitset & catl
+            else:
+                bitset = jnp.zeros((hp.n_bins,), bool)
 
             # -- link the parent's child pointer to the new internal node i
             p = st.parent_node[bl]
@@ -204,7 +320,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                 default_left=t.default_left.at[i].set(dl),
                 split_cat=t.split_cat.at[i].set(catl),
                 left_child=lc_arr, right_child=rc_arr,
-                split_gain=t.split_gain.at[i].set(st.best_gain[bl]),
+                split_gain=t.split_gain.at[i].set(gain_rec),
+                cat_bitset=t.cat_bitset.at[i].set(bitset),
                 internal_value=t.internal_value.at[i].set(
                     leaf_output(pg, ph, hp.lambda_l1, hp.lambda_l2,
                                 hp.max_delta_step)),
@@ -216,14 +333,43 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             col = jnp.take(bins, feat, axis=1).astype(jnp.int32)
             nb = nan_bin[feat]
             go_left_num = jnp.where(col == nb, dl, col <= thr)
-            go_left = jnp.where(catl, col == thr, go_left_num)
+            go_left = jnp.where(catl, bitset[col], go_left_num)
             active = st.leaf_of_row == bl
             leaf_of_row = jnp.where(
                 active, jnp.where(go_left, bl, new_leaf), st.leaf_of_row)
 
-            # -- children stats from the cached best split
-            lg, lh, lcn = st.best_lg[bl], st.best_lh[bl], st.best_lc[bl]
+            # -- children stats from the cached best split (or forced gather)
+            lg, lh, lcn = ch_lg, ch_lh, ch_lc
             rg, rh, rcn = pg - lg, ph - lh, pc - lcn
+
+            # -- children outputs: variant-dependent l2 (sorted-subset adds
+            # cat_l2, feature_histogram.cpp:250), path smoothing toward the
+            # parent, monotone [min,max] clipping (basic method)
+            l2_eff = hp.lambda_l2 + jnp.where(
+                (var == VAR_CAT_FWD) | (var == VAR_CAT_BWD), hp.cat_l2, 0.0)
+            parent_out = t.leaf_value[bl]
+            lo = smoothed_output(lg, lh, lcn, parent_out, hp.lambda_l1,
+                                 l2_eff, hp)
+            ro = smoothed_output(rg, rh, rcn, parent_out, hp.lambda_l1,
+                                 l2_eff, hp)
+            lmin_p, lmax_p = st.leaf_min[bl], st.leaf_max[bl]
+            if hp.use_monotone:
+                lo = jnp.clip(lo, lmin_p, lmax_p)
+                ro = jnp.clip(ro, lmin_p, lmax_p)
+                mono_f = monotone[feat]
+                is_num = ~catl
+                mid = (lo + ro) * 0.5
+                lmax_l = jnp.where(is_num & (mono_f > 0),
+                                   jnp.minimum(lmax_p, mid), lmax_p)
+                lmin_l = jnp.where(is_num & (mono_f < 0),
+                                   jnp.maximum(lmin_p, mid), lmin_p)
+                lmin_r = jnp.where(is_num & (mono_f > 0),
+                                   jnp.maximum(lmin_p, mid), lmin_p)
+                lmax_r = jnp.where(is_num & (mono_f < 0),
+                                   jnp.minimum(lmax_p, mid), lmax_p)
+            else:
+                lmin_l = lmin_r = lmin_p
+                lmax_l = lmax_r = lmax_p
 
             # -- histogram: data pass over ONLY the smaller child's rows
             # (bucketed gather), subtract for the sibling
@@ -243,20 +389,27 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             d = t.leaf_depth[bl] + 1
             t = t._replace(
                 leaf_depth=t.leaf_depth.at[bl].set(d).at[new_leaf].set(d),
-                leaf_value=t.leaf_value
-                    .at[bl].set(leaf_output(lg, lh, hp.lambda_l1, hp.lambda_l2,
-                                            hp.max_delta_step))
-                    .at[new_leaf].set(leaf_output(rg, rh, hp.lambda_l1,
-                                                  hp.lambda_l2,
-                                                  hp.max_delta_step)),
+                leaf_value=t.leaf_value.at[bl].set(lo).at[new_leaf].set(ro),
                 leaf_count=t.leaf_count.at[bl].set(lcn).at[new_leaf].set(rcn),
                 leaf_weight=t.leaf_weight.at[bl].set(lh).at[new_leaf].set(rh),
             )
 
+            child_path = st.path_feats[bl].at[feat].set(True)
+            if rng_key is not None:
+                k_l, k_r, k_el, k_er2 = jax.random.split(
+                    jax.random.fold_in(rng_key, i), 4)
+            else:
+                k_l = k_r = k_el = k_er2 = None
+            fm_l = node_feature_mask(child_path, k_l)
+            fm_r = node_feature_mask(child_path, k_r)
             bs_l = _child_best(h_left, lg, lh, lcn, d, num_bins, nan_bin,
-                               is_cat, feature_mask, hp)
+                               is_cat, fm_l, hp, monotone=monotone,
+                               parent_output=lo, leaf_min=lmin_l,
+                               leaf_max=lmax_l, rng_key=k_el)
             bs_r = _child_best(h_right, rg, rh, rcn, d, num_bins, nan_bin,
-                               is_cat, feature_mask, hp)
+                               is_cat, fm_r, hp, monotone=monotone,
+                               parent_output=ro, leaf_min=lmin_r,
+                               leaf_max=lmax_r, rng_key=k_er2)
 
             return st._replace(
                 tree=t,
@@ -275,6 +428,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                                    .at[new_leaf].set(bs_r.default_left),
                 best_cat=st.best_cat.at[bl].set(bs_l.is_categorical)
                                      .at[new_leaf].set(bs_r.is_categorical),
+                best_var=st.best_var.at[bl].set(bs_l.variant)
+                                     .at[new_leaf].set(bs_r.variant),
                 best_lg=st.best_lg.at[bl].set(bs_l.left_sum_g)
                                    .at[new_leaf].set(bs_r.left_sum_g),
                 best_lh=st.best_lh.at[bl].set(bs_l.left_sum_h)
@@ -283,6 +438,10 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                                    .at[new_leaf].set(bs_r.left_count),
                 parent_node=st.parent_node.at[bl].set(i).at[new_leaf].set(i),
                 parent_side=st.parent_side.at[bl].set(0).at[new_leaf].set(1),
+                leaf_min=st.leaf_min.at[bl].set(lmin_l).at[new_leaf].set(lmin_r),
+                leaf_max=st.leaf_max.at[bl].set(lmax_l).at[new_leaf].set(lmax_r),
+                path_feats=st.path_feats.at[bl].set(child_path)
+                                        .at[new_leaf].set(child_path),
             )
 
         return lax.cond(do, split, no_split, st)

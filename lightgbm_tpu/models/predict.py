@@ -49,8 +49,9 @@ def predict_bins_leaf(tree: TreeArrays, bins: jax.Array,
         cat = tree.split_cat[safe]
         col = bins[rows, feat].astype(jnp.int32)
         nb = nan_bin[feat]
+        cat_left = tree.cat_bitset[safe, col]
         go_left = jnp.where(col == nb, dl,
-                            jnp.where(cat, col == thr, col <= thr))
+                            jnp.where(cat, cat_left, col <= thr))
         nxt = jnp.where(go_left, tree.left_child[safe], tree.right_child[safe])
         return jnp.where(active, nxt, node)
 

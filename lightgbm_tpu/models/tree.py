@@ -100,6 +100,7 @@ class Tree:
         t.leaf_weight = np.asarray(arrays.leaf_weight)[:num_leaves].astype(np.float64)
 
         used = dataset.used_feature_idx
+        bitsets = np.asarray(arrays.cat_bitset)[:ni]
         for i in range(ni):
             pf = int(sf_packed[i])
             orig = used[pf]
@@ -108,12 +109,12 @@ class Tree:
             is_cat = bool(cat[i]) and mapper.bin_type == BIN_CATEGORICAL
             if is_cat:
                 t.cat_split_index[i] = len(t.cat_threshold)
+                left_bins = np.nonzero(bitsets[i])[0]
                 t.cat_threshold.append(
-                    [mapper.bin_2_categorical[int(t.threshold_bin[i])]]
-                    if int(t.threshold_bin[i]) < len(mapper.bin_2_categorical)
-                    else [])
-                # NaN was binned as bin 0 during training
-                t.cat_nan_left.append(int(t.threshold_bin[i]) == 0)
+                    [mapper.bin_2_categorical[int(b)] for b in left_bins
+                     if int(b) < len(mapper.bin_2_categorical)])
+                # NaN was binned as bin 0 (most frequent cat) during training
+                t.cat_nan_left.append(bool(bitsets[i][0]))
                 t.threshold[i] = float(t.cat_split_index[i])
             else:
                 t.threshold[i] = mapper.bin_to_value(int(t.threshold_bin[i]))

@@ -47,19 +47,44 @@ class SplitHyper:
     cat_l2: float = 10.0
     cat_smooth: float = 10.0
     max_cat_threshold: int = 32
+    max_cat_to_onehot: int = 4
+    min_data_per_group: int = 100
+    # monotone constraints (basic method, monotone_constraints.hpp:465);
+    # use_monotone is the static gate — the per-feature direction vector is a
+    # runtime array argument
+    use_monotone: bool = False
+    monotone_penalty: float = 0.0
+    # extra-trees mode: one random threshold per (feature, node)
+    # (reference USE_RAND template paths in feature_histogram)
+    extra_trees: bool = False
+    feature_fraction_bynode: float = 1.0
+    # static gate: skip the categorical argsort/cumsum machinery entirely
+    # on all-numeric datasets (argsort is expensive on TPU)
+    has_categorical: bool = False
     n_bins: int = 256
     rows_per_block: int = 4096
     path_smooth: float = 0.0
     hist_dtype: str = "float32"   # MXU contraction dtype; "bfloat16" opts into 8x MXU rate
 
 
+#: candidate-variant indices along the last axis of the gain tensor
+VAR_NUM_RIGHT = 0    # numerical, missing goes right
+VAR_NUM_LEFT = 1     # numerical, missing goes left
+VAR_CAT_ONEHOT = 2   # categorical one-hot: {bin == t} left
+VAR_CAT_FWD = 3      # categorical sorted-subset, ascending-score prefix
+VAR_CAT_BWD = 4      # categorical sorted-subset, descending-score prefix
+NUM_VARIANTS = 5
+
+
 class SplitResult(NamedTuple):
     """Chosen split for one leaf (reference split_info.hpp:294 ``SplitInfo``)."""
     gain: jax.Array          # f32 — improvement; <= 0 means "don't split"
     feature: jax.Array       # i32 packed feature index
-    threshold: jax.Array     # i32 bin threshold (left = bin <= threshold)
+    threshold: jax.Array     # i32 bin threshold (left = bin <= threshold);
+                             # for sorted-subset variants: prefix length - 1
     default_left: jax.Array  # bool — missing goes left
-    is_categorical: jax.Array  # bool — one-hot categorical split (bin == thr)
+    is_categorical: jax.Array  # bool — any categorical variant
+    variant: jax.Array       # i32 VAR_* of the winner
     left_sum_g: jax.Array
     left_sum_h: jax.Array
     left_count: jax.Array
@@ -89,14 +114,43 @@ def leaf_output(g: jax.Array, h: jax.Array, l1: float, l2: float,
     return out
 
 
+def gain_given_output(g: jax.Array, h: jax.Array, out: jax.Array,
+                      l1: float, l2: float) -> jax.Array:
+    """GetLeafGainGivenOutput (feature_histogram.hpp): the split objective
+    evaluated at an arbitrary (clipped / smoothed) output."""
+    return -(2.0 * threshold_l1(g, l1) * out + (h + l2) * out * out)
+
+
+def smoothed_output(g: jax.Array, h: jax.Array, n: jax.Array,
+                    parent_output, l1: float, l2: float,
+                    hp: "SplitHyper") -> jax.Array:
+    """Leaf output with max_delta_step clipping and path smoothing toward the
+    parent (feature_histogram.hpp CalculateSplittedLeafOutput USE_SMOOTHING:
+    out' = (n*out + path_smooth*parent) / (n + path_smooth))."""
+    out = leaf_output(g, h, l1, l2, hp.max_delta_step)
+    if hp.path_smooth > 0.0:
+        w = n / (n + hp.path_smooth)
+        out = out * w + parent_output * (1.0 - w)
+    return out
+
+
 def find_best_split(hist: jax.Array, sum_g: jax.Array, sum_h: jax.Array,
                     count: jax.Array, num_bins: jax.Array, nan_bin: jax.Array,
                     is_cat: jax.Array, feature_mask: Optional[jax.Array],
-                    hp: SplitHyper) -> SplitResult:
+                    hp: SplitHyper,
+                    monotone: Optional[jax.Array] = None,
+                    parent_output=0.0,
+                    leaf_min=None, leaf_max=None,
+                    depth=None,
+                    rng_key: Optional[jax.Array] = None) -> SplitResult:
     """Pick the best (feature, threshold, default-dir) for one leaf.
 
     hist: f32 [F, B, C>=3] (grad, hess, count); sum_g/sum_h/count: leaf totals.
     num_bins/nan_bin: i32 [F]; is_cat: bool [F]; feature_mask: bool [F] or None.
+    monotone: i8/i32 [F] direction per feature (0 none; categorical features
+    MUST be 0) when ``hp.use_monotone``; leaf_min/leaf_max: this leaf's output
+    bounds (basic-method constraint entry); parent_output: this leaf's own
+    output (path smoothing target); depth: leaf depth (monotone penalty).
     """
     num_f, n_b = hist.shape[0], hist.shape[1]
     g, h, n = hist[..., 0], hist[..., 1], hist[..., 2]
@@ -117,14 +171,43 @@ def find_best_split(hist: jax.Array, sum_g: jax.Array, sum_h: jax.Array,
     has_missing = nan_bin[:, None] >= 0
 
     l1, l2 = hp.lambda_l1, hp.lambda_l2
-    parent_gain = leaf_gain(sum_g, sum_h, l1, l2)
+    # the closed form g²/(h+l2) is exact only when the output is the
+    # unconstrained optimum; smoothing / clipping force the evaluated form.
+    # The parent-side gain shift must be evaluated the same way, at the
+    # parent's ACTUAL output (feature_histogram.hpp gain_shift: given-output
+    # under smoothing, clipped GetLeafGain under max_delta_step) — otherwise
+    # a clipped parent looks artificially good and no split ever clears it.
+    output_path = (hp.use_monotone or hp.path_smooth > 0.0
+                   or hp.max_delta_step > 0.0)
+    if hp.path_smooth > 0.0:
+        parent_gain = gain_given_output(sum_g, sum_h, parent_output, l1, l2)
+    elif hp.max_delta_step > 0.0:
+        po = leaf_output(sum_g, sum_h, l1, l2, hp.max_delta_step)
+        parent_gain = gain_given_output(sum_g, sum_h, po, l1, l2)
+    else:
+        parent_gain = leaf_gain(sum_g, sum_h, l1, l2)
     min_shift = parent_gain + hp.min_gain_to_split
 
-    def variant_gain(gl_v, hl_v, nl_v):
+    def variant_gain(gl_v, hl_v, nl_v, l2_v):
         gr = sum_g - gl_v
         hr = sum_h - hl_v
         nr = count - nl_v
-        gain = leaf_gain(gl_v, hl_v, l1, l2) + leaf_gain(gr, hr, l1, l2)
+        if not output_path:
+            gain = leaf_gain(gl_v, hl_v, l1, l2_v) + leaf_gain(gr, hr, l1, l2_v)
+        else:
+            lo = smoothed_output(gl_v, hl_v, nl_v, parent_output, l1, l2_v, hp)
+            ro = smoothed_output(gr, hr, nr, parent_output, l1, l2_v, hp)
+            if hp.use_monotone:
+                lo = jnp.clip(lo, leaf_min, leaf_max)
+                ro = jnp.clip(ro, leaf_min, leaf_max)
+            gain = (gain_given_output(gl_v, hl_v, lo, l1, l2_v)
+                    + gain_given_output(gr, hr, ro, l1, l2_v))
+            if hp.use_monotone:
+                # monotone direction violated → split forbidden
+                # (feature_histogram.hpp:788-791 returns 0 = below gain_shift)
+                mono = monotone[:, None] if gl_v.ndim == 2 else monotone
+                bad = ((mono > 0) & (lo > ro)) | ((mono < 0) & (lo < ro))
+                gain = jnp.where(bad, NEG_INF, gain)
         ok = ((nl_v >= hp.min_data_in_leaf) & (nr >= hp.min_data_in_leaf)
               & (hl_v >= hp.min_sum_hessian_in_leaf)
               & (hr >= hp.min_sum_hessian_in_leaf))
@@ -134,43 +217,123 @@ def find_best_split(hist: jax.Array, sum_g: jax.Array, sum_h: jax.Array,
     # only splits off the missing bin, t at the nan bin itself is invalid
     thr_ok = valid_bin & (bin_idx < num_bins[:, None] - 1) & ~is_nan
     thr_ok = thr_ok & ~is_cat[:, None]
-    gain_right = jnp.where(thr_ok, variant_gain(gl, hl, nl), NEG_INF)
+    gain_right = jnp.where(thr_ok, variant_gain(gl, hl, nl, l2), NEG_INF)
     gain_left = jnp.where(thr_ok & has_missing,
-                          variant_gain(gl + gm, hl + hm, nl + nm), NEG_INF)
+                          variant_gain(gl + gm, hl + hm, nl + nm, l2), NEG_INF)
 
-    # one-hot categorical: {bin == t} goes left (reference
-    # FindBestThresholdCategoricalInner one-hot branch, l2 += cat_l2)
-    l2c = l2 + hp.cat_l2
-    gl_cat, hl_cat, nl_cat = g, h, n
+    if hp.has_categorical:
+        # one-hot categorical: {bin == t} goes left, gated to low-cardinality
+        # features (reference feature_histogram.cpp:179 ``use_onehot =
+        # num_bin <= max_cat_to_onehot``; plain lambda_l2 in this branch)
+        onehot_ok = is_cat[:, None] & (num_bins[:, None]
+                                       <= hp.max_cat_to_onehot)
+        gain_cat = jnp.where(valid_bin & onehot_ok,
+                             variant_gain(g, h, n, l2), NEG_INF)
 
-    def cat_gain():
-        gr = sum_g - gl_cat
-        hr = sum_h - hl_cat
-        nr = count - nl_cat
-        gain = leaf_gain(gl_cat, hl_cat, l1, l2c) + leaf_gain(gr, hr, l1, l2c)
-        ok = ((nl_cat >= hp.min_data_in_leaf) & (nr >= hp.min_data_in_leaf)
-              & (hl_cat >= hp.min_sum_hessian_in_leaf)
-              & (hr >= hp.min_sum_hessian_in_leaf))
-        return jnp.where(ok, gain, NEG_INF)
+        # sorted-subset categorical (reference feature_histogram.cpp:241-340):
+        # candidate bins with count >= cat_smooth, sorted by
+        # g/(h+cat_smooth); prefixes of the ascending and descending orders
+        # are the left sets, capped at max_cat_threshold, evaluated with
+        # l2 + cat_l2 and gated by min_data_per_group.  Vectorized: argsort +
+        # cumsum per direction, the reference's sequential ``cnt_cur_group``
+        # reset becoming "left count crosses a multiple of
+        # min_data_per_group" (a static approximation of the same evaluation
+        # density).
+        l2c = l2 + hp.cat_l2
+        subset_feat_ok = is_cat & (num_bins > hp.max_cat_to_onehot)   # [F]
+        cand_bin = valid_bin & subset_feat_ok[:, None] & (n >= hp.cat_smooth)
+        used_bin = jnp.sum(cand_bin, axis=1)                          # [F]
+        max_num_cat = jnp.minimum(hp.max_cat_threshold, (used_bin + 1) // 2)
+        k_limit = jnp.minimum(used_bin, max_num_cat)[:, None]         # [F, 1]
+        score = g / (h + hp.cat_smooth)
+        INF = jnp.float32(1e30)
 
-    gain_cat = jnp.where(valid_bin & is_cat[:, None], cat_gain(), NEG_INF)
+        def subset_scan(descending: bool):
+            key = jnp.where(cand_bin, -score if descending else score, INF)
+            order = jnp.argsort(key, axis=1)                          # [F, B]
+            gs = jnp.take_along_axis(g * cand_bin, order, axis=1)
+            hs = jnp.take_along_axis(h * cand_bin, order, axis=1)
+            ns = jnp.take_along_axis(n * cand_bin, order, axis=1)
+            glv = jnp.cumsum(gs, axis=1)
+            hlv = jnp.cumsum(hs, axis=1)
+            nlv = jnp.cumsum(ns, axis=1)
+            ok = bin_idx < k_limit
+            if hp.min_data_per_group > 1:
+                mdpg = jnp.float32(hp.min_data_per_group)
+                crossed = jnp.floor(nlv / mdpg) > jnp.floor((nlv - ns) / mdpg)
+                ok = ok & crossed & ((count - nlv) >= mdpg)
+            gain = jnp.where(ok, variant_gain(glv, hlv, nlv, l2c), NEG_INF)
+            return gain, glv, hlv, nlv
 
-    cand = jnp.stack([gain_right, gain_left, gain_cat], axis=-1)  # [F, B, 3]
+        gain_fwd, gl_f, hl_f, nl_f = subset_scan(False)
+        gain_bwd, gl_b, hl_b, nl_b = subset_scan(True)
+    else:
+        neg = jnp.full((num_f, n_b), NEG_INF)
+        gain_cat = gain_fwd = gain_bwd = neg
+        gl_f = hl_f = nl_f = gl_b = hl_b = nl_b = jnp.zeros_like(g)
+        used_bin = max_num_cat = jnp.zeros((num_f,), jnp.int32)
+
+    if hp.extra_trees and rng_key is not None:
+        # extremely-randomized mode: per (feature, node) keep exactly ONE
+        # random candidate threshold per variant family (reference
+        # feature_histogram.cpp USE_RAND rand_threshold draws)
+        kn, kc, ks = jax.random.split(rng_key, 3)
+        u_num = jax.random.uniform(kn, (num_f,))
+        rand_num = jnp.floor(
+            u_num * jnp.maximum(num_bins - 1, 1).astype(jnp.float32)
+        ).astype(jnp.int32)
+        keep_num = bin_idx == rand_num[:, None]
+        gain_right = jnp.where(keep_num, gain_right, NEG_INF)
+        gain_left = jnp.where(keep_num, gain_left, NEG_INF)
+        if hp.has_categorical:
+            u_cat = jax.random.uniform(kc, (num_f,))
+            rand_cat = jnp.floor(
+                u_cat * num_bins.astype(jnp.float32)).astype(jnp.int32)
+            gain_cat = jnp.where(bin_idx == rand_cat[:, None], gain_cat,
+                                 NEG_INF)
+            u_sub = jax.random.uniform(ks, (num_f,))
+            max_thr = jnp.maximum(jnp.minimum(max_num_cat, used_bin) - 1, 0)
+            rand_k = jnp.floor(
+                u_sub * (max_thr + 1).astype(jnp.float32)).astype(jnp.int32)
+            keep_sub = bin_idx == rand_k[:, None]
+            gain_fwd = jnp.where(keep_sub, gain_fwd, NEG_INF)
+            gain_bwd = jnp.where(keep_sub, gain_bwd, NEG_INF)
+
+    cand = jnp.stack([gain_right, gain_left, gain_cat, gain_fwd, gain_bwd],
+                     axis=-1)                                  # [F, B, V]
     if feature_mask is not None:
         cand = jnp.where(feature_mask[:, None, None], cand, NEG_INF)
+
+    if hp.use_monotone and hp.monotone_penalty > 0.0:
+        # depth-decaying gain penalty on monotone features, applied to the
+        # FINAL gain before cross-feature argmax (serial_tree_learner.cpp:994,
+        # monotone_constraints.hpp:357 ComputeMonotoneSplitGainPenalty)
+        d = jnp.float32(0 if depth is None else depth)
+        p = jnp.float32(hp.monotone_penalty)
+        eps = jnp.float32(1e-10)
+        pen = jnp.where(p >= d + 1.0, eps,
+                        jnp.where(p <= 1.0, 1.0 - p / (2.0 ** d) + eps,
+                                  1.0 - 2.0 ** (p - 1.0 - d) + eps))
+        pen_f = jnp.where(monotone != 0, pen, 1.0)[:, None, None]
+        final = cand - min_shift
+        cand = jnp.where(final > 0, final * pen_f, NEG_INF)
+        min_shift = jnp.float32(0.0)
 
     flat = cand.reshape(-1)
     best = jnp.argmax(flat)
     best_gain_raw = flat[best]
-    feat = (best // (n_b * 3)).astype(jnp.int32)
-    rem = best % (n_b * 3)
-    thr = (rem // 3).astype(jnp.int32)
-    variant = (rem % 3).astype(jnp.int32)
+    feat = (best // (n_b * NUM_VARIANTS)).astype(jnp.int32)
+    rem = best % (n_b * NUM_VARIANTS)
+    thr = (rem // NUM_VARIANTS).astype(jnp.int32)
+    variant = (rem % NUM_VARIANTS).astype(jnp.int32)
 
     # recover the winner's left-side stats
-    glw = jnp.stack([gl[feat, thr], gl[feat, thr] + gm[feat, 0], g[feat, thr]])
-    hlw = jnp.stack([hl[feat, thr], hl[feat, thr] + hm[feat, 0], h[feat, thr]])
-    nlw = jnp.stack([nl[feat, thr], nl[feat, thr] + nm[feat, 0], n[feat, thr]])
+    glw = jnp.stack([gl[feat, thr], gl[feat, thr] + gm[feat, 0], g[feat, thr],
+                     gl_f[feat, thr], gl_b[feat, thr]])
+    hlw = jnp.stack([hl[feat, thr], hl[feat, thr] + hm[feat, 0], h[feat, thr],
+                     hl_f[feat, thr], hl_b[feat, thr]])
+    nlw = jnp.stack([nl[feat, thr], nl[feat, thr] + nm[feat, 0], n[feat, thr],
+                     nl_f[feat, thr], nl_b[feat, thr]])
     lg = glw[variant]
     lh = hlw[variant]
     ln = nlw[variant]
@@ -180,8 +343,38 @@ def find_best_split(hist: jax.Array, sum_g: jax.Array, sum_h: jax.Array,
         gain=jnp.where(best_gain_raw <= NEG_INF / 2, jnp.float32(NEG_INF), gain),
         feature=feat,
         threshold=thr,
-        default_left=(variant == 1),
-        is_categorical=(variant == 2),
+        default_left=(variant == VAR_NUM_LEFT),
+        is_categorical=(variant >= VAR_CAT_ONEHOT),
+        variant=variant,
         left_sum_g=lg, left_sum_h=lh, left_count=ln,
         right_sum_g=sum_g - lg, right_sum_h=sum_h - lh, right_count=count - ln,
     )
+
+
+def categorical_left_bitset(hist_f: jax.Array, num_bins_f: jax.Array,
+                            variant: jax.Array, threshold: jax.Array,
+                            hp: SplitHyper) -> jax.Array:
+    """Materialize the set of bins going LEFT for a categorical split.
+
+    hist_f: f32 [B, C] — the PARENT leaf's histogram of the split feature;
+    variant/threshold: the winning ``SplitResult`` fields.  Returns bool [B].
+    For one-hot the set is {threshold}; for sorted-subset it re-derives the
+    score ordering (deterministic given the histogram) and takes the first
+    ``threshold + 1`` bins of the winning direction — the device-side twin of
+    the reference's ``output->cat_threshold`` bitset write
+    (feature_histogram.cpp:354-377).
+    """
+    n_b = hist_f.shape[0]
+    g, h, n = hist_f[..., 0], hist_f[..., 1], hist_f[..., 2]
+    bin_idx = lax.iota(jnp.int32, n_b)
+    cand = (bin_idx < num_bins_f) & (n >= hp.cat_smooth)
+    score = g / (h + hp.cat_smooth)
+    INF = jnp.float32(1e30)
+    key_f = jnp.where(cand, score, INF)
+    key_b = jnp.where(cand, -score, INF)
+    order = jnp.where(variant == VAR_CAT_BWD, jnp.argsort(key_b),
+                      jnp.argsort(key_f))
+    rank = jnp.zeros((n_b,), jnp.int32).at[order].set(bin_idx)
+    subset_bits = (rank <= threshold) & cand
+    onehot_bits = bin_idx == threshold
+    return jnp.where(variant == VAR_CAT_ONEHOT, onehot_bits, subset_bits)

@@ -1,0 +1,174 @@
+"""Monotone constraints + path smoothing (reference
+src/treelearner/monotone_constraints.hpp basic method;
+tests modeled on tests/python_package_test/test_engine.py
+test_monotone_constraints)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+FAST = {"min_data_in_leaf": 5, "verbose": -1}
+
+
+def _monotone_data(seed=21, n=3000):
+    rng = np.random.default_rng(seed)
+    x_inc = rng.uniform(-1, 1, n)
+    x_dec = rng.uniform(-1, 1, n)
+    x_free = rng.uniform(-1, 1, n)
+    y = (5 * x_inc + np.sin(3 * x_inc) - 4 * x_dec + np.cos(2 * x_dec)
+         + np.sign(x_free) + rng.normal(scale=0.2, size=n))
+    return np.stack([x_inc, x_dec, x_free], axis=1), y
+
+
+def _is_monotone(bst, feature, direction, n_grid=60):
+    """Sweep one feature over its range with the others fixed; check the
+    prediction moves only in ``direction`` (reference test
+    is_increasing/is_decreasing sweep)."""
+    rng = np.random.default_rng(0)
+    for _ in range(5):
+        base = rng.uniform(-1, 1, 3)
+        grid = np.linspace(-1, 1, n_grid)
+        X = np.tile(base, (n_grid, 1))
+        X[:, feature] = grid
+        pred = bst.predict(X)
+        diffs = np.diff(pred)
+        if direction > 0 and (diffs < -1e-9).any():
+            return False
+        if direction < 0 and (diffs > 1e-9).any():
+            return False
+    return True
+
+
+def test_monotone_constraints_enforced():
+    X, y = _monotone_data()
+    ds = lgb.Dataset(X, label=y, params=FAST)
+    bst = lgb.train({**FAST, "objective": "regression",
+                     "monotone_constraints": [1, -1, 0],
+                     "num_leaves": 31}, ds, num_boost_round=40)
+    assert _is_monotone(bst, 0, +1)
+    assert _is_monotone(bst, 1, -1)
+    # unconstrained feature still contributes (model isn't degenerate)
+    pred = bst.predict(X)
+    assert np.corrcoef(pred, y)[0, 1] > 0.8
+
+
+def test_unconstrained_violates():
+    """Sanity: without constraints the sweep DOES violate monotonicity,
+    so the test above is meaningful."""
+    X, y = _monotone_data()
+    ds = lgb.Dataset(X, label=y, params=FAST)
+    bst = lgb.train({**FAST, "objective": "regression", "num_leaves": 31},
+                    ds, num_boost_round=40)
+    assert not (_is_monotone(bst, 0, +1) and _is_monotone(bst, 1, -1))
+
+
+def test_monotone_penalty_reduces_early_use():
+    X, y = _monotone_data()
+    ds = lgb.Dataset(X, label=y, params=FAST)
+    params = {**FAST, "objective": "regression",
+              "monotone_constraints": [1, -1, 0], "num_leaves": 15}
+    bst_pen = lgb.train({**params, "monotone_penalty": 2.0}, ds,
+                        num_boost_round=5)
+    # first splits (depth 0/1) should avoid monotone features under a heavy
+    # penalty; root split feature of tree 0 must be the free feature
+    t0 = bst_pen._gbdt.models[0]
+    assert t0.split_feature[0] == 2
+    assert _is_monotone(bst_pen, 0, +1)
+
+
+def test_path_smooth_trains():
+    X, y = _monotone_data(seed=5)
+    ds = lgb.Dataset(X, label=y, params=FAST)
+    bst = lgb.train({**FAST, "objective": "regression", "path_smooth": 10.0,
+                     "num_leaves": 31}, ds, num_boost_round=30)
+    pred = bst.predict(X)
+    assert np.corrcoef(pred, y)[0, 1] > 0.9
+    # smoothing shrinks leaves toward parents: predictions less extreme
+    bst0 = lgb.train({**FAST, "objective": "regression", "num_leaves": 31},
+                     ds, num_boost_round=30)
+    assert np.abs(bst.predict(X)).max() <= np.abs(bst0.predict(X)).max() + 1e-6
+
+
+def _paths_features(tree):
+    """All root->leaf paths as feature sets."""
+    out = []
+
+    def walk(node, acc):
+        if node < 0:
+            out.append(acc)
+            return
+        acc2 = acc | {int(tree.split_feature[node])}
+        walk(int(tree.left_child[node]), acc2)
+        walk(int(tree.right_child[node]), acc2)
+
+    if tree.num_leaves > 1:
+        walk(0, set())
+    return out
+
+
+def test_interaction_constraints_respected():
+    """Every root->leaf path must stay inside a single constraint set
+    (reference col_sampler.hpp:91 GetByNode)."""
+    rng = np.random.default_rng(31)
+    X = rng.normal(size=(2000, 4))
+    y = (X[:, 0] * X[:, 1] + X[:, 2] * X[:, 3]
+         + rng.normal(scale=0.1, size=2000))
+    ds = lgb.Dataset(X, label=y, params=FAST)
+    bst = lgb.train({**FAST, "objective": "regression",
+                     "interaction_constraints": "[0,1],[2,3]",
+                     "num_leaves": 15}, ds, num_boost_round=20)
+    sets = [{0, 1}, {2, 3}]
+    for t in bst._gbdt.models:
+        for path in _paths_features(t):
+            assert any(path <= s for s in sets), path
+
+
+def test_extra_trees_and_bynode():
+    rng = np.random.default_rng(32)
+    X = rng.normal(size=(2000, 8))
+    y = X @ rng.normal(size=8) + rng.normal(scale=0.2, size=2000)
+    ds = lgb.Dataset(X, label=y, params=FAST)
+    b1 = lgb.train({**FAST, "objective": "regression", "extra_trees": True},
+                   ds, num_boost_round=25)
+    assert np.corrcoef(b1.predict(X), y)[0, 1] > 0.9
+    b2 = lgb.train({**FAST, "objective": "regression",
+                    "feature_fraction_bynode": 0.5}, ds, num_boost_round=25)
+    assert np.corrcoef(b2.predict(X), y)[0, 1] > 0.9
+    # extra_trees is deterministic given extra_seed
+    b3 = lgb.train({**FAST, "objective": "regression", "extra_trees": True},
+                   ds, num_boost_round=25)
+    np.testing.assert_allclose(b1.predict(X), b3.predict(X))
+
+
+def test_forced_splits(tmp_path):
+    """forcedsplits_filename JSON forces the top of every tree (reference
+    serial_tree_learner.cpp:620 ForceSplits)."""
+    import json
+    rng = np.random.default_rng(41)
+    X = rng.normal(size=(2000, 4))
+    y = X @ rng.normal(size=4) + rng.normal(scale=0.2, size=2000)
+    fs = {"feature": 2, "threshold": 0.0,
+          "left": {"feature": 3, "threshold": 0.5}}
+    p = tmp_path / "forced.json"
+    p.write_text(json.dumps(fs))
+    ds = lgb.Dataset(X, label=y, params=FAST)
+    bst = lgb.train({**FAST, "objective": "regression",
+                     "forcedsplits_filename": str(p), "num_leaves": 15},
+                    ds, num_boost_round=5)
+    for t in bst._gbdt.models:
+        assert t.split_feature[0] == 2
+        assert abs(t.threshold[0] - 0.0) < 0.1
+        # node 1 = BFS-forced left-child split on feature 3
+        assert t.split_feature[1] == 3
+        assert t.left_child[0] == 1
+    assert np.corrcoef(bst.predict(X), y)[0, 1] > 0.8
+
+
+def test_max_delta_step_limits_outputs():
+    X, y = _monotone_data(seed=9)
+    ds = lgb.Dataset(X, label=y, params=FAST)
+    bst = lgb.train({**FAST, "objective": "regression", "max_delta_step": 0.01,
+                     "learning_rate": 1.0}, ds, num_boost_round=3)
+    for t in bst._gbdt.models:
+        assert np.all(np.abs(t.leaf_value - t.bias) <= 0.01 + 1e-6)

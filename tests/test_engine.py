@@ -263,6 +263,44 @@ def test_categorical_feature():
     assert _auc(y, bst.predict(X)) > 0.9
 
 
+def test_categorical_sorted_subset():
+    """High-cardinality categorical must use many-vs-many splits (reference
+    feature_histogram.cpp:241 sorted-subset scan), not just one-hot."""
+    rng = np.random.default_rng(11)
+    n, k = 4000, 40
+    cat = rng.integers(0, k, size=n)
+    effect = rng.normal(size=k)
+    other = rng.normal(size=(n, 3))
+    y = (effect[cat] + 0.2 * other[:, 0] +
+         rng.normal(scale=0.3, size=n) > 0).astype(float)
+    X = np.column_stack([cat.astype(float), other])
+    ds = lgb.Dataset(X, label=y, categorical_feature=[0], params=FAST)
+    bst = lgb.train({**FAST, "objective": "binary"}, ds, num_boost_round=30)
+    assert _auc(y, bst.predict(X)) > 0.93
+    # sorted-subset splits put >1 category on the left
+    assert any(len(c) > 1 for t in bst._gbdt.models for c in t.cat_threshold)
+    # text round-trip preserves the bitsets exactly
+    bst2 = lgb.Booster(model_str=bst.model_to_string())
+    np.testing.assert_allclose(bst.predict(X), bst2.predict(X), rtol=1e-6)
+
+
+def test_categorical_nan_and_unseen():
+    rng = np.random.default_rng(12)
+    n = 2000
+    cat = rng.integers(0, 12, size=n).astype(float)
+    cat[rng.random(n) < 0.1] = np.nan
+    effect = rng.normal(size=12)
+    y = np.where(np.isnan(cat), 0.5, effect[np.nan_to_num(cat).astype(int)])
+    y = (y + rng.normal(scale=0.3, size=n) > 0).astype(float)
+    X = cat.reshape(-1, 1)
+    ds = lgb.Dataset(X, label=y, categorical_feature=[0], params=FAST)
+    bst = lgb.train({**FAST, "objective": "binary"}, ds, num_boost_round=15)
+    # unseen category at predict time routes like the default and is finite
+    Xq = np.array([[99.0], [np.nan], [3.0]])
+    out = bst.predict(Xq)
+    assert np.all(np.isfinite(out))
+
+
 def test_reset_parameter(synthetic_binary):
     X, y = synthetic_binary
     ds = lgb.Dataset(X, label=y, params=FAST)
