@@ -490,5 +490,6 @@ def _tree_to_arrays_stub(tree: Tree, dataset: Dataset,
         leaf_count=jnp.zeros(L, jnp.float32),
         leaf_weight=jnp.zeros(L, jnp.float32),
         leaf_depth=jnp.zeros(L, jnp.int32),
+        leaf_path=jnp.zeros((L, dataset.num_features), bool),
         num_leaves=jnp.int32(tree.num_leaves),
     )

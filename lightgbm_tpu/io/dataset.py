@@ -119,6 +119,9 @@ class Dataset:
         self.metadata: Metadata = Metadata(0)
         self.config: Config = Config()
         self._reference: Optional["Dataset"] = None
+        # raw values of the packed (used) features, kept only when
+        # linear_tree is on (reference Dataset raw_data_ for linear leaves)
+        self.raw: Optional[np.ndarray] = None
 
     # ------------------------------------------------------------ properties
     @property
@@ -195,11 +198,15 @@ class Dataset:
             ds.feature_names = reference.feature_names
             ds._reference = reference
             ds._bin_all(arr)
+            if bool(cfg.linear_tree):
+                ds.raw = arr[:, ds.used_feature_idx].astype(np.float32)
             return ds
 
         cat_idx = _resolve_categorical(categorical_feature, ds.feature_names)
         ds._construct_mappers(arr, cfg, cat_idx)
         ds._bin_all(arr)
+        if bool(cfg.linear_tree):
+            ds.raw = arr[:, ds.used_feature_idx].astype(np.float32)
         return ds
 
     def create_valid(self, data: Any, label: Optional[Sequence[float]] = None,

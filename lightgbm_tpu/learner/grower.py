@@ -61,6 +61,7 @@ class TreeArrays(NamedTuple):
     leaf_count: jax.Array      # f32 [L]
     leaf_weight: jax.Array     # f32 [L] sum of hessians
     leaf_depth: jax.Array      # i32 [L]
+    leaf_path: jax.Array       # bool [L, F] features on each leaf's path
     num_leaves: jax.Array      # i32 scalar — actual leaves grown
 
 
@@ -89,7 +90,7 @@ class _GrowState(NamedTuple):
     done: jax.Array            # bool scalar
 
 
-def _empty_tree(num_leaves: int, n_bins: int) -> TreeArrays:
+def _empty_tree(num_leaves: int, n_bins: int, num_f: int) -> TreeArrays:
     li = num_leaves - 1
     return TreeArrays(
         split_feature=jnp.full((li,), -1, jnp.int32),
@@ -106,6 +107,7 @@ def _empty_tree(num_leaves: int, n_bins: int) -> TreeArrays:
         leaf_count=jnp.zeros((num_leaves,), jnp.float32),
         leaf_weight=jnp.zeros((num_leaves,), jnp.float32),
         leaf_depth=jnp.zeros((num_leaves,), jnp.int32),
+        leaf_path=jnp.zeros((num_leaves, num_f), bool),
         num_leaves=jnp.int32(1),
     )
 
@@ -201,7 +203,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                         parent_output=root_out, leaf_min=-inf, leaf_max=inf,
                         rng_key=key_er)
 
-    tree = _empty_tree(L, hp.n_bins)
+    tree = _empty_tree(L, hp.n_bins, num_f)
     tree = tree._replace(
         leaf_value=tree.leaf_value.at[0].set(root_out),
         leaf_count=tree.leaf_count.at[0].set(c0),
@@ -447,4 +449,5 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         return lax.cond(do, split, no_split, st)
 
     state = lax.fori_loop(0, L - 1, body, state)
-    return state.tree, state.leaf_of_row
+    tree_out = state.tree._replace(leaf_path=state.path_feats)
+    return tree_out, state.leaf_of_row

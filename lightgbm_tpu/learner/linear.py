@@ -1,0 +1,141 @@
+"""Linear-in-the-leaves model fitting (``linear_tree=true``).
+
+TPU-native re-design of the reference linear tree learner (reference:
+src/treelearner/linear_tree_learner.cpp:180 ``CalculateLinear`` — per-leaf
+ridge regression over the leaf's path features, Eq. 3 of the GBDT-PL paper:
+coeffs = −(XᵀHX + λI)⁻¹ Xᵀg with X = [raw path features | 1], solved with
+Eigen on the CPU).  Here the per-leaf normal equations for ALL leaves are
+accumulated in one pass with the same one-hot-matmul trick as the histogram
+kernel (blockwise [rows → leaves] contraction on the MXU), then solved as one
+batched ``jnp.linalg.solve`` over [L, K+1, K+1] systems.
+
+Rows whose path features contain NaN are excluded from the fit and fall back
+to the ordinary leaf output at prediction (reference tree.h:587-606).
+Leaves with fewer usable rows than unknowns keep coeff 0 / const = leaf
+output (linear_tree_learner.cpp:330-338).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+@functools.partial(jax.jit, static_argnames=("max_feats", "rows_per_block"))
+def fit_linear_leaves(raw: jax.Array, leaf_of_row: jax.Array,
+                      leaf_path: jax.Array, is_numeric: jax.Array,
+                      grad: jax.Array, hess: jax.Array,
+                      row_mask, leaf_value: jax.Array,
+                      linear_lambda: float, *, max_feats: int = 16,
+                      rows_per_block: int = 4096
+                      ) -> Tuple[jax.Array, jax.Array]:
+    """Fit one linear model per leaf.
+
+    raw: f32 [n, F] raw feature values (NaN preserved); leaf_path: bool
+    [L, F]; is_numeric: bool [F]; grad/hess: f32 [n]; row_mask: bool [n] or
+    None; leaf_value: f32 [L] fallback constants.  Returns (const [L],
+    coeff [L, F] dense over packed features, zero where unused).
+    """
+    n, num_f = raw.shape
+    L = leaf_path.shape[0]
+    K = min(max_feats, num_f)
+
+    # per-leaf numeric path features, padded to K with index F
+    path_num = leaf_path & is_numeric[None, :]                     # [L, F]
+    feat_idx = jax.vmap(
+        lambda m: jnp.nonzero(m, size=K, fill_value=num_f)[0])(path_num)
+    active = feat_idx < num_f                                      # [L, K]
+    n_active = jnp.sum(active, axis=1)                             # [L]
+
+    raw_pad = jnp.concatenate([raw, jnp.zeros((n, 1), raw.dtype)], axis=1)
+    fi_row = feat_idx[leaf_of_row]                                 # [n, K]
+    x = jnp.take_along_axis(raw_pad, fi_row, axis=1)               # [n, K]
+    nan_row = jnp.any(jnp.isnan(x), axis=1)
+    x = jnp.nan_to_num(x)
+    xx = jnp.concatenate([x, jnp.ones((n, 1), x.dtype)], axis=1)   # [n, K+1]
+
+    w = (~nan_row).astype(raw.dtype)
+    if row_mask is not None:
+        w = w * row_mask.astype(raw.dtype)
+
+    # blockwise accumulation of XTHX [L, K+1, K+1], XTg [L, K+1], cnt [L]
+    D = K + 1
+    blk = min(rows_per_block, _round_up(max(n, 1), 128))
+    n_pad = _round_up(n, blk)
+    if n_pad != n:
+        pad = ((0, n_pad - n),)
+        xx = jnp.pad(xx, pad + ((0, 0),))
+        w = jnp.pad(w, pad)
+        grad = jnp.pad(grad, pad)
+        hess = jnp.pad(hess, pad)
+        leaf_of_row = jnp.pad(leaf_of_row, pad)
+    nb = n_pad // blk
+    xx_b = xx.reshape(nb, blk, D)
+    w_b = w.reshape(nb, blk)
+    g_b = (grad * w).reshape(nb, blk)
+    h_b = (hess * w).reshape(nb, blk)
+    lor_b = leaf_of_row.reshape(nb, blk)
+    iota_l = lax.iota(jnp.int32, L)
+
+    def block_step(acc, xs):
+        xtx, xtg, cnt = acc
+        xxb, wb, gb, hb, lb = xs
+        onehot = (lb[:, None] == iota_l).astype(xxb.dtype)         # [blk, L]
+        outer = (xxb[:, :, None] * xxb[:, None, :]
+                 * hb[:, None, None]).reshape(blk, D * D)
+        xtx = xtx + lax.dot_general(
+            onehot, outer, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).reshape(L, D, D)
+        xtg = xtg + lax.dot_general(
+            onehot, xxb * gb[:, None], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        cnt = cnt + onehot.T @ wb
+        return (xtx, xtg, cnt), None
+
+    acc0 = (jnp.zeros((L, D, D), jnp.float32),
+            jnp.zeros((L, D), jnp.float32), jnp.zeros((L,), jnp.float32))
+    (xthx, xtg, cnt), _ = lax.scan(block_step, acc0,
+                                   (xx_b, w_b, g_b, h_b, lor_b))
+
+    # regularize + neutralize inactive dims (identity row/col, rhs 0 ⇒
+    # coeff 0) so one batched solve covers every leaf's variable count
+    am = jnp.concatenate([active, jnp.ones((L, 1), bool)], axis=1)  # [L, D]
+    lam = jnp.concatenate([jnp.full((K,), linear_lambda, jnp.float32),
+                           jnp.zeros((1,), jnp.float32)])
+    a = xthx + jnp.diag(lam)[None, :, :]
+    pair = am[:, :, None] & am[:, None, :]
+    eye = jnp.eye(D, dtype=jnp.float32)[None, :, :]
+    a = jnp.where(pair, a, eye)
+    b = jnp.where(am, -xtg, 0.0)
+    coefs = jnp.linalg.solve(a, b[..., None])[..., 0]               # [L, D]
+    finite = jnp.all(jnp.isfinite(coefs), axis=1)
+
+    ok = (cnt >= (n_active + 1).astype(cnt.dtype)) & finite & (n_active > 0)
+    const = jnp.where(ok, coefs[:, K], leaf_value)
+    coeff_k = jnp.where(ok[:, None] & active, coefs[:, :K], 0.0)
+    coeff = jnp.zeros((L, num_f + 1), jnp.float32)
+    coeff = coeff.at[jnp.arange(L)[:, None], feat_idx].set(coeff_k)[:, :num_f]
+    return const, coeff
+
+
+@jax.jit
+def linear_leaf_scores(raw: jax.Array, leaf_of_row: jax.Array,
+                       const: jax.Array, coeff: jax.Array,
+                       leaf_value: jax.Array) -> jax.Array:
+    """Per-row linear-tree contribution: const[leaf] + coeff[leaf]·raw, with
+    NaN-in-used-feature rows falling back to the plain leaf output
+    (reference tree.h:587 Predict is_linear_ branch)."""
+    cf = coeff[leaf_of_row]                                        # [n, F]
+    use = cf != 0.0
+    nan_row = jnp.any(jnp.isnan(raw) & use, axis=1)
+    contrib = jnp.sum(jnp.where(use, cf * jnp.nan_to_num(raw), 0.0), axis=1) \
+        + const[leaf_of_row]
+    return jnp.where(nan_row, leaf_value[leaf_of_row], contrib)

@@ -68,6 +68,12 @@ class Tree:
         self.cat_nan_left: List[bool] = []
         self.shrinkage = 1.0
         self.is_linear = False
+        # linear leaves (reference tree.h leaf_const_/leaf_coeff_/
+        # leaf_features_): per-leaf constant, coefficient list, and the
+        # ORIGINAL feature index list the coefficients apply to
+        self.leaf_const = np.zeros(n, np.float64)
+        self.leaf_features: List[List[int]] = [[] for _ in range(n)]
+        self.leaf_coeff: List[List[float]] = [[] for _ in range(n)]
         # boost-from-average bias folded into leaf values (AddBias); tracked
         # so DART drop/rescale and rollback can separate the tree's own
         # contribution from the global init score
@@ -122,18 +128,40 @@ class Tree:
                 is_cat, bool(dl[i]), mapper.missing_type)
         return t
 
+    def set_linear(self, const: np.ndarray, coeff_dense: np.ndarray,
+                   used_feature_idx, is_numeric: np.ndarray) -> None:
+        """Attach device linear-leaf results (learner/linear.py): dense
+        [L, F_packed] coefficients are compacted to per-leaf sparse lists
+        with ORIGINAL feature indices (reference SetLeafFeatures /
+        SetLeafCoeffs, linear_tree_learner.cpp:373-380)."""
+        self.is_linear = True
+        self.leaf_const = np.asarray(const, np.float64)[:self.num_leaves]
+        cd = np.asarray(coeff_dense, np.float64)
+        self.leaf_features = []
+        self.leaf_coeff = []
+        for l in range(self.num_leaves):
+            nz = np.nonzero(cd[l] != 0.0)[0] if l < cd.shape[0] else []
+            self.leaf_features.append([int(used_feature_idx[p]) for p in nz])
+            self.leaf_coeff.append([float(cd[l, p]) for p in nz])
+
     # ---------------------------------------------------------- operations
     def apply_shrinkage(self, rate: float) -> None:
-        """reference tree.h:188 ``Shrinkage``."""
+        """reference tree.h:188 ``Shrinkage`` (scales linear const/coeffs
+        too, tree.cpp:194-205)."""
         self.leaf_value *= rate
         self.internal_value *= rate
         self.shrinkage *= rate
+        if self.is_linear:
+            self.leaf_const *= rate
+            self.leaf_coeff = [[c * rate for c in cs] for cs in self.leaf_coeff]
 
     def add_bias(self, val: float) -> None:
         """reference tree.h:213 ``AddBias`` (boost-from-average folding)."""
         self.leaf_value += val
         self.internal_value += val
         self.bias += val
+        if self.is_linear:
+            self.leaf_const += val
 
     def scale_contribution(self, factor: float) -> None:
         """Scale this tree's own contribution (leaf values minus folded
@@ -143,6 +171,10 @@ class Tree:
         self.internal_value = (self.internal_value - self.bias) * factor + \
             self.bias
         self.shrinkage *= factor
+        if self.is_linear:
+            self.leaf_const = (self.leaf_const - self.bias) * factor + self.bias
+            self.leaf_coeff = [[c * factor for c in cs]
+                               for cs in self.leaf_coeff]
 
     def set_leaf_values(self, values: Sequence[float]) -> None:
         self.leaf_value = np.asarray(values, np.float64)[:self.num_leaves]
@@ -151,9 +183,26 @@ class Tree:
     def predict(self, X: np.ndarray) -> np.ndarray:
         """Vectorized traversal over rows (reference tree.h:137 Predict /
         gbdt_prediction.cpp) — frontier of node ids, numerical + categorical
-        decisions with missing handling."""
+        decisions with missing handling; linear leaves add coeff·x with NaN
+        fallback to the plain output (tree.h:587)."""
         leaf = self.predict_leaf_index(X)
-        return self.leaf_value[leaf]
+        base = self.leaf_value[leaf]
+        if not self.is_linear:
+            return base
+        out = self.leaf_const[leaf].copy()
+        nan_bad = np.zeros(len(leaf), bool)
+        for l in range(self.num_leaves):
+            feats = self.leaf_features[l]
+            if not feats:
+                continue
+            rows = leaf == l
+            if not rows.any():
+                continue
+            vals = X[np.ix_(rows, feats)]
+            bad = np.isnan(vals).any(axis=1)
+            out[rows] += np.nan_to_num(vals) @ np.asarray(self.leaf_coeff[l])
+            nan_bad[rows] = bad
+        return np.where(nan_bad, base, out)
 
     def predict_leaf_index(self, X: np.ndarray) -> np.ndarray:
         n = X.shape[0]
@@ -241,6 +290,18 @@ class Tree:
             lines.append(f"cat_boundaries={arr(boundaries)}")
             lines.append(f"cat_threshold={arr(words)}")
         lines.append(f"is_linear={int(self.is_linear)}")
+        if self.is_linear:
+            # reference gbdt_model_text flat layout (tree.cpp:384-400):
+            # per-leaf coefficient counts, then flat feature/coeff lists
+            nf = [len(c) for c in self.leaf_coeff]
+            lines.append(f"leaf_const={arr(self.leaf_const, '{:.17g}')}")
+            lines.append(f"num_feat={arr(nf)}")
+            lines.append("leaf_features="
+                         + " ".join(str(f) for fs in self.leaf_features
+                                    for f in fs))
+            lines.append("leaf_coeff="
+                         + " ".join(f"{c:.17g}" for cs in self.leaf_coeff
+                                    for c in cs))
         lines.append(f"shrinkage={self.shrinkage:g}")
         lines.append("")
         return "\n".join(lines)
@@ -297,4 +358,20 @@ class Tree:
                     t.cat_split_index[i] = int(t.threshold[i])
         t.shrinkage = float(kv.get("shrinkage", 1.0))
         t.is_linear = bool(int(kv.get("is_linear", 0)))
+        if t.is_linear and "leaf_const" in kv:
+            t.leaf_const = parse("leaf_const", np.float64,
+                                 np.zeros(num_leaves))
+            nf = parse("num_feat", np.int64,
+                       np.zeros(num_leaves, np.int64))
+            flat_f = parse("leaf_features", np.int64, np.zeros(0, np.int64))
+            flat_c = parse("leaf_coeff", np.float64, np.zeros(0))
+            flat_f = flat_f if flat_f is not None else np.zeros(0, np.int64)
+            flat_c = flat_c if flat_c is not None else np.zeros(0)
+            t.leaf_features, t.leaf_coeff = [], []
+            pos = 0
+            for l in range(num_leaves):
+                k = int(nf[l]) if l < len(nf) else 0
+                t.leaf_features.append([int(f) for f in flat_f[pos:pos + k]])
+                t.leaf_coeff.append([float(c) for c in flat_c[pos:pos + k]])
+                pos += k
         return t
