@@ -22,9 +22,17 @@ try:  # sklearn wrappers are optional (sklearn may be absent)
 except ImportError:  # pragma: no cover
     pass
 
+try:  # plotting is optional (matplotlib/graphviz may be absent)
+    from .plotting import (create_tree_digraph, plot_importance,  # noqa: E402
+                           plot_metric, plot_split_value_histogram, plot_tree)
+except ImportError:  # pragma: no cover
+    pass
+
 __all__ = [
     "Config", "Dataset", "Booster", "train", "cv",
     "early_stopping", "log_evaluation", "record_evaluation", "reset_parameter",
     "LGBMModel", "LGBMClassifier", "LGBMRegressor", "LGBMRanker",
     "LightGBMError", "register_logger",
+    "plot_importance", "plot_split_value_histogram", "plot_metric",
+    "plot_tree", "create_tree_digraph",
 ]

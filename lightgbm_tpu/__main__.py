@@ -1,0 +1,5 @@
+"""``python -m lightgbm_tpu`` — CLI entry (reference src/main.cpp)."""
+
+from .application import main
+
+main()

@@ -22,7 +22,7 @@ from .config import Config, as_config, normalize_params
 from .io.dataset import Dataset as _InnerDataset
 from .io.parser import load_text_file
 from .metrics import create_metrics
-from .models.model_io import (model_to_json, model_to_string,
+from .models.model_io import (model_to_dict, model_to_string,
                               objective_to_string, parse_model_string)
 from .models.tree import Tree
 from .objectives import create_objective
@@ -459,18 +459,20 @@ class Booster:
         return self
 
     def dump_model(self, num_iteration: Optional[int] = None,
-                   start_iteration: int = 0) -> str:
+                   start_iteration: int = 0) -> Dict[str, Any]:
+        """Model as a python dict (reference Booster.dump_model returns the
+        parsed JSON of LGBM_BoosterDumpModel, basic.py)."""
         if self._gbdt is not None:
             g = self._gbdt
             k = g.num_tree_per_iteration
-            return model_to_json(
+            return model_to_dict(
                 g.models, num_class=g.num_class, num_tree_per_iteration=k,
                 max_feature_idx=g.train_set.num_total_features - 1,
                 objective_str=objective_to_string(
                     g.objective.NAME if g.objective else "none", g.config),
                 feature_names=g.train_set.feature_names)
         d = self._loaded
-        return model_to_json(
+        return model_to_dict(
             d["trees"], num_class=d["num_class"],
             num_tree_per_iteration=d["num_tree_per_iteration"],
             max_feature_idx=d["max_feature_idx"],

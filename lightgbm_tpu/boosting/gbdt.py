@@ -28,8 +28,9 @@ import numpy as np
 from ..config import Config
 from ..io.dataset import Dataset
 from ..learner.grower import TreeArrays, grow_tree
+from ..learner.linear import fit_linear_leaves, linear_leaf_scores
 from ..metrics import Metric, create_metrics
-from ..models.predict import predict_bins_tree
+from ..models.predict import predict_bins_leaf, predict_bins_tree
 from ..models.tree import Tree
 from ..objectives import ObjectiveFunction, create_objective
 from ..ops.split import SplitHyper
@@ -186,6 +187,12 @@ class GBDT:
             self.forced_splits = _parse_forced_splits(
                 config.forcedsplits_filename, train_set, self.hp.num_leaves)
 
+        # linear leaves (linear_tree=true): raw feature values on device
+        # (reference LinearTreeLearner keeps Dataset raw_data_)
+        self.linear = bool(config.linear_tree) and train_set.raw is not None
+        self.raw_dev = jnp.asarray(train_set.raw) if self.linear else None
+        self._valid_raw: List[Optional[jnp.ndarray]] = []
+
         n = train_set.num_data
         k = self.num_tree_per_iteration
         self.scores = jnp.zeros((n, k), jnp.float32)
@@ -260,6 +267,9 @@ class GBDT:
                 if isc.size == vsc.size else isc.reshape(-1, 1)
         self.valid_scores.append(jnp.asarray(vsc))
         self._valid_bins.append(jnp.asarray(valid_set.bins))
+        self._valid_raw.append(jnp.asarray(valid_set.raw)
+                               if self.linear and valid_set.raw is not None
+                               else None)
 
     # ------------------------------------------------------------ training
     def boosting_gradients(self) -> Tuple[jax.Array, jax.Array]:
@@ -304,17 +314,47 @@ class GBDT:
             if num_leaves > 1:
                 finished = False
             arrays = self._renew_leaves(arrays, leaf_of_row, cls_idx)
-            shrunk = arrays.leaf_value * self.shrinkage_rate
-            # train score update: pure gather through leaf_of_row
-            self.scores = self.scores.at[:, cls_idx].add(shrunk[leaf_of_row])
-            # valid scores via frontier traversal (shrunk values)
-            arrays_shrunk = arrays._replace(leaf_value=shrunk)
-            for vi in range(len(self.valid_sets)):
-                contrib = predict_bins_tree(arrays_shrunk, self._valid_bins[vi],
-                                            self.nan_bin_arr)
-                self.valid_scores[vi] = \
-                    self.valid_scores[vi].at[:, cls_idx].add(contrib)
+            lin = None
+            if self.linear and num_leaves > 1:
+                # per-leaf ridge fit on the leaf's numeric path features
+                # (reference LinearTreeLearner::CalculateLinear)
+                lin = fit_linear_leaves(
+                    self.raw_dev, leaf_of_row, arrays.leaf_path,
+                    ~self.is_cat_arr, g[:, cls_idx], h[:, cls_idx], row_mask,
+                    arrays.leaf_value, float(self.config.linear_lambda))
+            if lin is not None:
+                const, coeff = lin
+                contrib = linear_leaf_scores(self.raw_dev, leaf_of_row, const,
+                                             coeff, arrays.leaf_value)
+                self.scores = self.scores.at[:, cls_idx].add(
+                    self.shrinkage_rate * contrib)
+                for vi in range(len(self.valid_sets)):
+                    leaf_v = predict_bins_leaf(arrays, self._valid_bins[vi],
+                                               self.nan_bin_arr)
+                    vraw = self._valid_raw[vi]
+                    vc = linear_leaf_scores(vraw, leaf_v, const, coeff,
+                                            arrays.leaf_value) \
+                        if vraw is not None else arrays.leaf_value[leaf_v]
+                    self.valid_scores[vi] = self.valid_scores[vi] \
+                        .at[:, cls_idx].add(self.shrinkage_rate * vc)
+            else:
+                shrunk = arrays.leaf_value * self.shrinkage_rate
+                # train score update: pure gather through leaf_of_row
+                self.scores = self.scores.at[:, cls_idx].add(shrunk[leaf_of_row])
+                # valid scores via frontier traversal (shrunk values)
+                arrays_shrunk = arrays._replace(leaf_value=shrunk)
+                for vi in range(len(self.valid_sets)):
+                    contrib = predict_bins_tree(arrays_shrunk,
+                                                self._valid_bins[vi],
+                                                self.nan_bin_arr)
+                    self.valid_scores[vi] = \
+                        self.valid_scores[vi].at[:, cls_idx].add(contrib)
             tree = Tree.from_arrays(arrays, self.train_set)
+            if lin is not None:
+                tree.set_linear(np.asarray(lin[0], np.float64),
+                                np.asarray(lin[1], np.float64),
+                                self.train_set.used_feature_idx,
+                                ~np.asarray(self.is_cat_arr))
             tree.apply_shrinkage(self.shrinkage_rate)
             if self.iter_ == 0 and abs(self.init_scores[cls_idx]) > 1e-10:
                 tree.add_bias(self.init_scores[cls_idx])

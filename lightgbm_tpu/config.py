@@ -64,6 +64,13 @@ def _parse_str_list(v: Any) -> List[str]:
 # reference's `// check = >0` annotations (config.h:202-253).
 _PARAMS: List[Tuple[str, Any, Tuple[str, ...], Tuple[Tuple[str, float], ...]]] = [
     # --- core (config.h "Core Parameters") ---
+    ("task", "train", ("task_type",), ()),
+    ("output_model", "LightGBM_model.txt", ("model_output", "model_out"), ()),
+    ("input_model", "", ("model_input", "model_in"), ()),
+    ("output_result", "LightGBM_predict_result.txt",
+     ("predict_result", "prediction_result", "predict_name", "pred_name",
+      "name_pred"), ()),
+    ("saved_feature_importance_type", 0, (), ()),
     ("objective", "regression", ("objective_type", "app", "application", "loss"), ()),
     ("boosting", "gbdt", ("boosting_type", "boost"), ()),
     ("data_sample_strategy", "bagging", (), ()),

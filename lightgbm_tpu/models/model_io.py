@@ -158,10 +158,11 @@ def parse_model_string(text: str) -> Dict[str, Any]:
     }
 
 
-def model_to_json(trees: List[Tree], *, num_class: int,
+def model_to_dict(trees: List[Tree], *, num_class: int,
                   num_tree_per_iteration: int, max_feature_idx: int,
-                  objective_str: str, feature_names: List[str]) -> str:
-    """DumpModel JSON (gbdt_model_text.cpp DumpModel)."""
+                  objective_str: str, feature_names: List[str]
+                  ) -> Dict[str, Any]:
+    """DumpModel structure (gbdt_model_text.cpp DumpModel) as a dict."""
 
     def node_json(t: Tree, node: int) -> Dict[str, Any]:
         if node < 0:
@@ -187,7 +188,7 @@ def model_to_json(trees: List[Tree], *, num_class: int,
             "right_child": node_json(t, int(t.right_child[node])),
         }
 
-    out = {
+    return {
         "name": "tree",
         "version": "v4",
         "num_class": num_class,
@@ -203,4 +204,72 @@ def model_to_json(trees: List[Tree], *, num_class: int,
             for i, t in enumerate(trees)
         ],
     }
-    return json.dumps(out, indent=2)
+
+
+def model_to_json(trees: List[Tree], **kwargs: Any) -> str:
+    return json.dumps(model_to_dict(trees, **kwargs), indent=2)
+
+
+def model_to_cpp(trees: List[Tree], *, num_tree_per_iteration: int = 1) -> str:
+    """Standalone C++ prediction code for a trained model (the reference's
+    ``convert_model`` task / ModelToIfElse, gbdt_model_text.cpp): one
+    ``double PredictTreeK(const double* arr)`` nested-ternary function per
+    tree plus a summing ``Predict`` entry.  NaN handling mirrors inference:
+    missing goes to the recorded default side."""
+
+    def node_code(t: Tree, node: int, indent: str) -> str:
+        if node < 0:
+            leaf = -node - 1
+            if t.is_linear:
+                terms = [f"{t.leaf_const[leaf]:.17g}"]
+                for fi, co in zip(t.leaf_features[leaf], t.leaf_coeff[leaf]):
+                    terms.append(f"({co:.17g}) * arr[{fi}]")
+                return f"{indent}return {' + '.join(terms)};\n"
+            return f"{indent}return {t.leaf_value[leaf]:.17g};\n"
+        f = int(t.split_feature[node])
+        dt = int(t.decision_type[node])
+        is_cat = bool(dt & 1)
+        default_left = bool(dt & 2)
+        mtype = (dt >> 2) & 3  # 0 none / 1 zero / 2 nan (tree.py encoding)
+        if is_cat:
+            # NaN categorical routes per the recorded cat_nan_left
+            # (predict_leaf_index in tree.py)
+            ci = int(t.cat_split_index[node])
+            cats = sorted(t.cat_threshold[ci]) if ci >= 0 else []
+            nan_left = (ci >= 0 and ci < len(t.cat_nan_left)
+                        and bool(t.cat_nan_left[ci]))
+            in_set = " || ".join(f"ivalue == {c}" for c in cats) or "false"
+            member = (f"[&]{{ int ivalue = (int)arr[{f}]; "
+                      f"return {in_set}; }}()")
+            cond = f"std::isnan(arr[{f}]) ? {str(nan_left).lower()} : {member}"
+        else:
+            thr = float(t.threshold[node])
+            nan = f"std::isnan(arr[{f}])"
+            base = f"arr[{f}] <= {thr:.17g}"
+            if mtype == 0:
+                # missing_type none: NaN falls back to 0.0 before comparing
+                cond = (f"({nan}) ? (0.0 <= {thr:.17g}) : ({base})")
+            else:
+                miss = nan if mtype == 2 else \
+                    f"(({nan}) || std::fabs(arr[{f}]) <= 1e-35)"
+                cond = f"({miss}) || ({base})" if default_left \
+                    else f"!({miss}) && ({base})"
+        left = node_code(t, int(t.left_child[node]), indent + "  ")
+        right = node_code(t, int(t.right_child[node]), indent + "  ")
+        return (f"{indent}if ({cond}) {{\n{left}{indent}}} else {{\n"
+                f"{right}{indent}}}\n")
+
+    parts = ["#include <cmath>", "", "// generated by lightgbm_tpu "
+             "convert_model (reference ModelToIfElse equivalent)", ""]
+    for i, t in enumerate(trees):
+        body = node_code(t, 0 if t.num_leaves > 1 else -1, "  ")
+        parts.append(f"double PredictTree{i}(const double* arr) {{\n{body}}}\n")
+    k = max(1, num_tree_per_iteration)
+    calls = [f"PredictTree{i}(arr)" for i in range(len(trees))]
+    parts.append("void Predict(const double* arr, double* out) {")
+    for c in range(k):
+        sub = [calls[j] for j in range(c, len(calls), k)]
+        expr = " + ".join(sub) if sub else "0.0"
+        parts.append(f"  out[{c}] = {expr};")
+    parts.append("}\n")
+    return "\n".join(parts)

@@ -295,7 +295,7 @@ class Tree:
             # per-leaf coefficient counts, then flat feature/coeff lists
             nf = [len(c) for c in self.leaf_coeff]
             lines.append(f"leaf_const={arr(self.leaf_const, '{:.17g}')}")
-            lines.append(f"num_feat={arr(nf)}")
+            lines.append(f"num_features={arr(nf)}")
             lines.append("leaf_features="
                          + " ".join(str(f) for fs in self.leaf_features
                                     for f in fs))
@@ -361,7 +361,7 @@ class Tree:
         if t.is_linear and "leaf_const" in kv:
             t.leaf_const = parse("leaf_const", np.float64,
                                  np.zeros(num_leaves))
-            nf = parse("num_feat", np.int64,
+            nf = parse("num_features", np.int64,
                        np.zeros(num_leaves, np.int64))
             flat_f = parse("leaf_features", np.int64, np.zeros(0, np.int64))
             flat_c = parse("leaf_coeff", np.float64, np.zeros(0))

@@ -531,8 +531,38 @@ class LambdarankNDCG(ObjectiveFunction):
         self._inv_max_dcg = jnp.asarray(inv, jnp.float32)
         self._gain_of_doc = jnp.asarray(
             self._label_gain[lbl.astype(int)], jnp.float32)
+        # position-debiased LTR (rank_objective.hpp:43-56,295: per-position
+        # additive bias factors on the score, Newton-updated each iteration
+        # with L2 regularization lambdarank_position_bias_regularization)
+        self._positions = None
+        if metadata.position is not None:
+            pos = np.asarray(metadata.position)
+            ids, inv_idx = np.unique(pos, return_inverse=True)
+            self._positions = inv_idx.astype(np.int32)
+            self._pos_biases = np.zeros(len(ids), np.float64)
+            self._pos_reg = float(
+                self.config.lambdarank_position_bias_regularization)
+
+    def _update_position_bias(self, g: np.ndarray, h: np.ndarray) -> None:
+        """Newton step on per-position bias factors (rank_objective.hpp:295
+        UpdatePositionBiasFactors): utility derivative w.r.t. a position's
+        bias is -sum(lambda) there, L2-regularized per instance."""
+        p = self._positions
+        first = np.zeros_like(self._pos_biases)
+        second = np.zeros_like(self._pos_biases)
+        counts = np.zeros_like(self._pos_biases)
+        np.add.at(first, p, -g)
+        np.add.at(second, p, -h)
+        np.add.at(counts, p, 1.0)
+        first -= self._pos_biases * self._pos_reg * counts
+        second -= self._pos_reg * counts
+        self._pos_biases += (float(self.config.learning_rate) * first
+                             / (np.abs(second) + 0.001))
 
     def get_gradients(self, score):
+        if self._positions is not None:
+            score = score + jnp.asarray(
+                self._pos_biases[self._positions], jnp.float32)
         s = self.config.sigmoid
         trunc = self.config.lambdarank_truncation_level
         norm = self.config.lambdarank_norm
@@ -588,7 +618,11 @@ class LambdarankNDCG(ObjectiveFunction):
             jnp.where(valid, g_doc, 0.0).reshape(-1))
         h = jnp.zeros_like(score).at[safe.reshape(-1)].add(
             jnp.where(valid, h_doc, 0.0).reshape(-1))
-        return self._apply_weight(g, h)
+        g, h = self._apply_weight(g, h)
+        if self._positions is not None:
+            self._update_position_bias(np.asarray(g, np.float64),
+                                       np.asarray(h, np.float64))
+        return g, h
 
 
 class RankXENDCG(ObjectiveFunction):

@@ -1,0 +1,311 @@
+"""Plotting utilities.
+
+Same user surface as the reference python package's plotting layer
+(reference: python-package/lightgbm/plotting.py — ``plot_importance``,
+``plot_split_value_histogram``, ``plot_metric``, ``plot_tree``,
+``create_tree_digraph``), rebuilt on this framework's Booster/Dataset.
+matplotlib and graphviz are optional and only imported at call time.
+"""
+
+from __future__ import annotations
+
+import math
+from copy import deepcopy
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+import numpy as np
+
+from .basic import Booster, Dataset
+from .utils.log import LightGBMError
+
+__all__ = [
+    "plot_importance", "plot_split_value_histogram", "plot_metric",
+    "plot_tree", "create_tree_digraph",
+]
+
+
+def _check_not_tuple_of_2_elements(obj: Any, obj_name: str) -> None:
+    if not isinstance(obj, tuple) or len(obj) != 2:
+        raise TypeError(f"{obj_name} must be a tuple of 2 elements.")
+
+
+def _float2str(value: float, precision: Optional[int] = None) -> str:
+    if precision is not None and not isinstance(value, str):
+        return f"{value:.{precision}f}"
+    return str(value)
+
+
+def _get_ax(ax, figsize, dpi):
+    import matplotlib.pyplot as plt
+    if ax is None:
+        if figsize is not None:
+            _check_not_tuple_of_2_elements(figsize, "figsize")
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    return ax
+
+
+def plot_importance(booster: Union[Booster, Any], ax=None, height: float = 0.2,
+                    xlim: Optional[Tuple[float, float]] = None,
+                    ylim: Optional[Tuple[float, float]] = None,
+                    title: Optional[str] = "Feature importance",
+                    xlabel: Optional[str] = "Feature importance",
+                    ylabel: Optional[str] = "Features",
+                    importance_type: str = "auto",
+                    max_num_features: Optional[int] = None,
+                    ignore_zero: bool = True, figsize=None, dpi=None,
+                    grid: bool = True, precision: Optional[int] = 3,
+                    **kwargs: Any):
+    """Horizontal bar chart of feature importances."""
+    if hasattr(booster, "booster_"):  # sklearn estimator
+        booster = booster.booster_
+    if not isinstance(booster, Booster):
+        raise TypeError("booster must be Booster or LGBMModel.")
+    if importance_type == "auto":
+        importance_type = "split"
+    importance = booster.feature_importance(importance_type=importance_type)
+    feature_name = booster.feature_name()
+
+    if not len(importance):
+        raise ValueError("Booster's feature_importance is empty.")
+
+    tuples = sorted(zip(feature_name, importance), key=lambda x: x[1])
+    if ignore_zero:
+        tuples = [x for x in tuples if x[1] > 0]
+    if max_num_features is not None and max_num_features > 0:
+        tuples = tuples[-max_num_features:]
+    labels, values = zip(*tuples)
+
+    ax = _get_ax(ax, figsize, dpi)
+    ylocs = np.arange(len(values))
+    ax.barh(ylocs, values, align="center", height=height, **kwargs)
+    for x, y in zip(values, ylocs):
+        ax.text(x + 1, y, _float2str(x, precision)
+                if importance_type == "gain" else str(int(x)),
+                va="center")
+    ax.set_yticks(ylocs)
+    ax.set_yticklabels(labels)
+    if xlim is not None:
+        _check_not_tuple_of_2_elements(xlim, "xlim")
+    else:
+        xlim = (0, max(values) * 1.1)
+    ax.set_xlim(xlim)
+    if ylim is not None:
+        _check_not_tuple_of_2_elements(ylim, "ylim")
+    else:
+        ylim = (-1, len(values))
+    ax.set_ylim(ylim)
+    if title is not None:
+        ax.set_title(title)
+    if xlabel is not None:
+        xlabel = xlabel.replace("@importance_type@", importance_type)
+        ax.set_xlabel(xlabel)
+    if ylabel is not None:
+        ax.set_ylabel(ylabel)
+    ax.grid(grid)
+    return ax
+
+
+def plot_split_value_histogram(booster: Union[Booster, Any],
+                               feature: Union[int, str], bins=None, ax=None,
+                               width_coef: float = 0.8, xlim=None, ylim=None,
+                               title: Optional[str] = "Split value histogram "
+                                                      "for feature with @index/name@ @feature@",
+                               xlabel: Optional[str] = "Feature split value",
+                               ylabel: Optional[str] = "Count",
+                               figsize=None, dpi=None, grid: bool = True,
+                               **kwargs: Any):
+    """Histogram of a feature's chosen split thresholds across the model."""
+    if hasattr(booster, "booster_"):
+        booster = booster.booster_
+    if not isinstance(booster, Booster):
+        raise TypeError("booster must be Booster or LGBMModel.")
+
+    names = booster.feature_name()
+    if isinstance(feature, str):
+        if feature not in names:
+            raise ValueError(f"Feature {feature} not found.")
+        fidx = names.index(feature)
+    else:
+        fidx = int(feature)
+
+    values: List[float] = []
+    model = booster.dump_model()
+    for tree_info in model["tree_info"]:
+        stack = [tree_info["tree_structure"]]
+        while stack:
+            node = stack.pop()
+            if "split_feature" in node:
+                if node["split_feature"] == fidx and \
+                        node.get("decision_type") == "<=":
+                    values.append(float(node["threshold"]))
+                for k in ("left_child", "right_child"):
+                    if isinstance(node.get(k), dict):
+                        stack.append(node[k])
+    if not values:
+        raise ValueError(
+            "Cannot plot split value histogram, "
+            f"because feature {feature} was not used in splitting.")
+    hist_values, bin_edges = np.histogram(values, bins=bins or "auto")
+    centers = (bin_edges[:-1] + bin_edges[1:]) / 2
+    width = width_coef * (bin_edges[1] - bin_edges[0])
+
+    ax = _get_ax(ax, figsize, dpi)
+    ax.bar(centers, hist_values, width=width, align="center", **kwargs)
+    if xlim is not None:
+        _check_not_tuple_of_2_elements(xlim, "xlim")
+        ax.set_xlim(xlim)
+    if ylim is not None:
+        _check_not_tuple_of_2_elements(ylim, "ylim")
+    else:
+        ylim = (0, max(hist_values) * 1.1)
+    ax.set_ylim(ylim)
+    if title is not None:
+        title = title.replace("@index/name@",
+                              "name" if isinstance(feature, str) else "index")
+        title = title.replace("@feature@", str(feature))
+        ax.set_title(title)
+    if xlabel is not None:
+        ax.set_xlabel(xlabel)
+    if ylabel is not None:
+        ax.set_ylabel(ylabel)
+    ax.grid(grid)
+    return ax
+
+
+def plot_metric(booster: Union[Dict, Any], metric: Optional[str] = None,
+                dataset_names: Optional[List[str]] = None, ax=None,
+                xlim=None, ylim=None,
+                title: Optional[str] = "Metric during training",
+                xlabel: Optional[str] = "Iterations",
+                ylabel: Optional[str] = "@metric@", figsize=None, dpi=None,
+                grid: bool = True):
+    """Plot a metric recorded by ``record_evaluation`` during training."""
+    if isinstance(booster, dict):
+        eval_results = deepcopy(booster)
+    elif hasattr(booster, "evals_result_"):
+        eval_results = deepcopy(booster.evals_result_)
+    else:
+        raise TypeError("booster must be dict or LGBMModel with "
+                        "recorded eval results.")
+    if not eval_results:
+        raise ValueError("eval results cannot be empty.")
+
+    if dataset_names is None:
+        dataset_names = list(eval_results.keys())
+    name = dataset_names[0]
+    metrics_for_one = eval_results[name]
+    if metric is None:
+        if len(metrics_for_one) > 1:
+            raise ValueError("more than one metric available, "
+                             "pick one metric via metric arg.")
+        metric, results = list(metrics_for_one.items())[0]
+    else:
+        if metric not in metrics_for_one:
+            raise ValueError("No given metric in eval results.")
+        results = metrics_for_one[metric]
+
+    ax = _get_ax(ax, figsize, dpi)
+    num_iteration = len(results)
+    x_ = range(num_iteration)
+    for name in dataset_names:
+        ax.plot(x_, eval_results[name][metric], label=name)
+    ax.legend(loc="best")
+    if xlim is not None:
+        _check_not_tuple_of_2_elements(xlim, "xlim")
+    else:
+        xlim = (0, num_iteration)
+    ax.set_xlim(xlim)
+    if ylim is not None:
+        _check_not_tuple_of_2_elements(ylim, "ylim")
+        ax.set_ylim(ylim)
+    if title is not None:
+        ax.set_title(title)
+    if xlabel is not None:
+        ax.set_xlabel(xlabel)
+    if ylabel is not None:
+        ax.set_ylabel(ylabel.replace("@metric@", metric))
+    ax.grid(grid)
+    return ax
+
+
+def _to_graphviz(tree_info: Dict[str, Any], show_info: List[str],
+                 feature_names: List[str], precision: Optional[int] = 3,
+                 orientation: str = "horizontal", **kwargs: Any):
+    try:
+        from graphviz import Digraph
+    except ImportError as e:
+        raise ImportError("You must install graphviz for plot_tree.") from e
+
+    graph = Digraph(**kwargs)
+    rankdir = "LR" if orientation == "horizontal" else "TB"
+    graph.attr(rankdir=rankdir)
+
+    def add(node: Dict[str, Any], parent: Optional[str] = None,
+            decision: Optional[str] = None) -> None:
+        if "split_index" in node:
+            name = f"split{node['split_index']}"
+            if node["split_feature"] < len(feature_names):
+                feat = feature_names[node["split_feature"]]
+            else:
+                feat = f"Column_{node['split_feature']}"
+            label = f"{feat} {node['decision_type']} " \
+                    f"{_float2str(node['threshold'], precision)}"
+            for info in ("split_gain", "internal_value", "internal_count"):
+                if info in show_info and info in node:
+                    label += f"\n{info.split('_')[-1]}: " \
+                             f"{_float2str(node[info], precision)}"
+            graph.node(name, label=label)
+            add(node["left_child"], name, "yes")
+            add(node["right_child"], name, "no")
+        else:
+            name = f"leaf{node['leaf_index']}"
+            label = f"leaf {node['leaf_index']}: " \
+                    f"{_float2str(node['leaf_value'], precision)}"
+            if "leaf_count" in show_info and "leaf_count" in node:
+                label += f"\ncount: {int(node['leaf_count'])}"
+            if "leaf_weight" in show_info and "leaf_weight" in node:
+                label += f"\nweight: {_float2str(node['leaf_weight'], precision)}"
+            graph.node(name, label=label)
+        if parent is not None:
+            graph.edge(parent, name, decision)
+
+    add(tree_info["tree_structure"])
+    return graph
+
+
+def create_tree_digraph(booster: Union[Booster, Any], tree_index: int = 0,
+                        show_info: Optional[List[str]] = None,
+                        precision: Optional[int] = 3,
+                        orientation: str = "horizontal", **kwargs: Any):
+    """Create a graphviz Digraph of one tree."""
+    if hasattr(booster, "booster_"):
+        booster = booster.booster_
+    if not isinstance(booster, Booster):
+        raise TypeError("booster must be Booster or LGBMModel.")
+    model = booster.dump_model()
+    tree_infos = model["tree_info"]
+    if tree_index >= len(tree_infos):
+        raise IndexError("tree_index is out of range.")
+    if show_info is None:
+        show_info = []
+    return _to_graphviz(tree_infos[tree_index], show_info,
+                        model.get("feature_names", []), precision,
+                        orientation, **kwargs)
+
+
+def plot_tree(booster: Union[Booster, Any], ax=None, tree_index: int = 0,
+              figsize=None, dpi=None, show_info: Optional[List[str]] = None,
+              precision: Optional[int] = 3, orientation: str = "horizontal",
+              **kwargs: Any):
+    """Render one tree with matplotlib (via graphviz)."""
+    import matplotlib.image as mimage
+    ax = _get_ax(ax, figsize, dpi)
+    graph = create_tree_digraph(booster=booster, tree_index=tree_index,
+                                show_info=show_info, precision=precision,
+                                orientation=orientation, **kwargs)
+    from io import BytesIO
+    s = BytesIO(graph.pipe(format="png"))
+    img = mimage.imread(s)
+    ax.imshow(img)
+    ax.axis("off")
+    return ax

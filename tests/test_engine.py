@@ -121,6 +121,59 @@ def test_lambdarank(synthetic_ranking):
     assert hist[-1] > hist[0]
 
 
+def test_linear_tree(synthetic_regression):
+    """linear_tree=true fits ridge models in the leaves
+    (linear_tree_learner.cpp CalculateLinear): on a piecewise-linear target
+    it beats constant leaves, and predictions round-trip through save/load."""
+    X, y = synthetic_regression
+    p = {**FAST, "objective": "regression", "linear_tree": True,
+         "num_leaves": 7}
+    ds = lgb.Dataset(X, label=y, params=p)
+    bst = lgb.train(p, ds, num_boost_round=12)
+    pred_lin = bst.predict(X)
+    mse_lin = float(np.mean((pred_lin - y) ** 2))
+
+    p0 = {**FAST, "objective": "regression", "num_leaves": 7}
+    ds0 = lgb.Dataset(X, label=y, params=p0)
+    bst0 = lgb.train(p0, ds0, num_boost_round=12)
+    mse_const = float(np.mean((bst0.predict(X) - y) ** 2))
+    assert mse_lin < mse_const  # linear leaves strictly help here
+
+    # model text round-trip preserves the linear leaves
+    s = bst.model_to_string()
+    assert "is_linear=1" in s and "num_features=" in s
+    bst2 = lgb.Booster(model_str=s)
+    np.testing.assert_allclose(pred_lin, bst2.predict(X), rtol=1e-5,
+                               atol=1e-6)
+    # NaN rows fall back to the constant leaf output, not garbage
+    Xn = X.copy()
+    Xn[:5, :] = np.nan
+    pn = bst2.predict(Xn)
+    assert np.isfinite(pn).all()
+
+
+def test_lambdarank_position_bias(synthetic_ranking):
+    """Position-debiased LTR (rank_objective.hpp positions_/pos_biases_):
+    training with a position column still learns, and the per-position bias
+    factors move away from zero."""
+    X, y, group = synthetic_ranking
+    rng = np.random.default_rng(11)
+    # synthetic presentation positions 0..9, lower position = more exposure
+    position = np.concatenate([rng.permutation(20) % 10 for _ in group])
+    ds = lgb.Dataset(X, label=y, group=group, position=position, params=FAST)
+    res = {}
+    bst = lgb.train({**FAST, "objective": "lambdarank", "metric": ["ndcg"],
+                     "eval_at": [5],
+                     "lambdarank_position_bias_regularization": 0.1},
+                    ds, num_boost_round=15, valid_sets=[ds],
+                    callbacks=[lgb.record_evaluation(res)])
+    hist = res["training"]["ndcg@5"]
+    assert hist[-1] > hist[0]
+    obj = bst._gbdt.objective
+    assert obj._positions is not None
+    assert np.abs(obj._pos_biases).max() > 0
+
+
 def test_rank_xendcg(synthetic_ranking):
     X, y, group = synthetic_ranking
     ds = lgb.Dataset(X, label=y, group=group, params=FAST)
@@ -193,11 +246,10 @@ def test_save_load_roundtrip(synthetic_binary, tmp_path):
 
 
 def test_dump_model_json(synthetic_binary):
-    import json
     X, y = synthetic_binary
     ds = lgb.Dataset(X, label=y, params=FAST)
     bst = lgb.train({**FAST, "objective": "binary"}, ds, num_boost_round=3)
-    d = json.loads(bst.dump_model())
+    d = bst.dump_model()  # dict, like the reference Booster.dump_model
     assert d["num_class"] == 1
     assert len(d["tree_info"]) == 3
     assert "tree_structure" in d["tree_info"][0]

@@ -1,0 +1,82 @@
+"""Plotting surface tests (reference test strategy: test_plotting.py)."""
+
+import matplotlib
+
+matplotlib.use("Agg")
+
+import matplotlib.pyplot as plt  # noqa: E402
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+import lightgbm_tpu as lgb  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def trained(synthetic_binary_mod):
+    X, y = synthetic_binary_mod
+    ds = lgb.Dataset(X, label=y)
+    evals = {}
+    bst = lgb.train({"objective": "binary", "num_leaves": 7, "verbose": -1,
+                     "metric": ["binary_logloss", "auc"]},
+                    ds, num_boost_round=8, valid_sets=[ds],
+                    valid_names=["train"],
+                    callbacks=[lgb.record_evaluation(evals)])
+    return bst, evals
+
+
+@pytest.fixture(scope="module")
+def synthetic_binary_mod():
+    rng = np.random.default_rng(42)
+    n, f = 500, 6
+    X = rng.normal(size=(n, f))
+    y = ((X @ rng.normal(size=f)) > 0).astype(np.float64)
+    return X, y
+
+
+def test_plot_importance(trained):
+    bst, _ = trained
+    ax = lgb.plot_importance(bst)
+    assert ax.get_title() == "Feature importance"
+    assert len(ax.patches) >= 1
+    ax2 = lgb.plot_importance(bst, importance_type="gain",
+                              max_num_features=3, title="gain imp")
+    assert ax2.get_title() == "gain imp"
+    assert len(ax2.patches) <= 3
+    plt.close("all")
+
+
+def test_plot_metric(trained):
+    _, evals = trained
+    ax = lgb.plot_metric(evals, metric="binary_logloss")
+    assert ax.get_ylabel() == "binary_logloss"
+    with pytest.raises(ValueError):
+        lgb.plot_metric(evals)  # ambiguous: two metrics recorded
+    plt.close("all")
+
+
+def test_plot_split_value_histogram(trained):
+    bst, _ = trained
+    ax = lgb.plot_split_value_histogram(bst, feature=0)
+    assert len(ax.patches) >= 1
+    plt.close("all")
+
+
+def test_create_tree_digraph(trained):
+    bst, _ = trained
+    graph = lgb.create_tree_digraph(
+        bst, tree_index=0,
+        show_info=["split_gain", "internal_count", "leaf_count"])
+    src = graph.source
+    assert "split0" in src
+    assert "leaf" in src
+    with pytest.raises(IndexError):
+        lgb.create_tree_digraph(bst, tree_index=999)
+
+
+def test_plot_importance_sklearn(synthetic_binary_mod):
+    X, y = synthetic_binary_mod
+    clf = lgb.LGBMClassifier(n_estimators=5, num_leaves=7, verbose=-1)
+    clf.fit(X, y)
+    ax = lgb.plot_importance(clf)
+    assert len(ax.patches) >= 1
+    plt.close("all")
