@@ -27,12 +27,13 @@ import numpy as np
 
 from ..config import Config
 from ..io.dataset import Dataset
-from ..learner.grower import TreeArrays, grow_tree
+from ..learner.grower import DeviceBundle, TreeArrays, grow_tree
 from ..learner.linear import fit_linear_leaves, linear_leaf_scores
 from ..metrics import Metric, create_metrics
 from ..models.predict import predict_bins_leaf, predict_bins_tree
 from ..models.tree import Tree
 from ..objectives import ObjectiveFunction, create_objective
+from ..ops.quantize import discretize_gradients, renew_leaf_values
 from ..ops.split import SplitHyper
 from ..utils import log
 from .sample_strategy import create_sample_strategy
@@ -161,6 +162,70 @@ class GBDT:
         self.nan_bin_arr = jnp.asarray(train_set.nan_bin_array())
         self.is_cat_arr = jnp.asarray(train_set.categorical_array())
         self.num_features = train_set.num_features
+        ba = train_set.device_bundle_arrays()
+        self.bundle = None if ba is None else \
+            DeviceBundle(*(jnp.asarray(a) for a in ba))
+
+        # distributed tree learner over all visible devices
+        # (reference tree_learner=serial/data/feature/voting,
+        # tree_learner.cpp:15-57; here = shard_map over a device mesh)
+        self.parallel_mode: Optional[str] = None
+        self.mesh = None
+        self._pad_rows = 0
+        self._pad_cols = 0
+        tl = {"data_parallel": "data", "voting_parallel": "voting",
+              "feature_parallel": "feature"}.get(str(config.tree_learner),
+                                                 str(config.tree_learner))
+        n_dev = jax.device_count()
+        if tl in ("data", "voting", "feature") and n_dev > 1:
+            from jax.sharding import Mesh
+            from ..parallel.feature_parallel import FEATURE_AXIS
+            from ..parallel.mesh import DATA_AXIS
+            axis = FEATURE_AXIS if tl == "feature" else DATA_AXIS
+            self.mesh = Mesh(np.array(jax.devices()), (axis,))
+            self.parallel_mode = tl
+            if tl == "feature":
+                if self.bundle is not None:
+                    log.fatal("tree_learner=feature is incompatible with "
+                              "enable_bundle=true (set enable_bundle=false)")
+                # unsupported-feature conflicts fail loudly (reference
+                # CheckParamConflict style) instead of silently dropping
+                if any(int(m) != 0 for m in (config.monotone_constraints
+                                             or [])):
+                    log.fatal("tree_learner=feature does not support "
+                              "monotone_constraints")
+                if config.forcedsplits_filename:
+                    log.fatal("tree_learner=feature does not support "
+                              "forcedsplits_filename")
+                if config.interaction_constraints:
+                    log.fatal("tree_learner=feature does not support "
+                              "interaction_constraints")
+                if bool(config.extra_trees) or \
+                        float(config.feature_fraction_bynode) < 1.0:
+                    log.warning("extra_trees/feature_fraction_bynode under "
+                                "tree_learner=feature sample per feature "
+                                "shard, not globally")
+                # pad feature columns so F divides the mesh (trivial
+                # single-bin columns can never be chosen for a split)
+                pad_f = (-self.bins.shape[1]) % n_dev
+                self._pad_cols = pad_f
+                if pad_f:
+                    self.bins = jnp.pad(self.bins, ((0, 0), (0, pad_f)))
+                    self.num_bins_arr = jnp.pad(self.num_bins_arr,
+                                                (0, pad_f),
+                                                constant_values=1)
+                    self.nan_bin_arr = jnp.pad(self.nan_bin_arr, (0, pad_f),
+                                               constant_values=-1)
+                    self.is_cat_arr = jnp.pad(self.is_cat_arr, (0, pad_f))
+            else:
+                # pad rows so n divides the mesh (padded rows masked out)
+                self._pad_rows = (-train_set.num_data) % n_dev
+                if self._pad_rows:
+                    self.bins = jnp.pad(self.bins,
+                                        ((0, self._pad_rows), (0, 0)))
+        elif tl not in ("serial",):
+            log.warning(f"tree_learner={tl} requested but only {n_dev} "
+                        "device(s) visible; using serial")
 
         # monotone constraints: per-ORIGINAL-feature directions from config,
         # remapped to packed (used) features; categorical features forced 0
@@ -297,6 +362,26 @@ class GBDT:
                                                      self.train_set.metadata)
         feature_mask = self._feature_mask_for_tree()
 
+        # gradient quantization (gradient_discretizer.cpp): tree STRUCTURE
+        # is found on the discretized grid; leaf values optionally renewed
+        # from the true gradients below
+        g_true, h_true = g, h
+        if bool(self.config.use_quantized_grad):
+            qkey = jax.random.PRNGKey(
+                (self.config.seed or 0) * 7919 + self.iter_)
+            gq, hq = [], []
+            for c in range(k):
+                gc, hc = discretize_gradients(
+                    g[:, c], h[:, c], jax.random.fold_in(qkey, c),
+                    n_levels=int(self.config.num_grad_quant_bins),
+                    stochastic=bool(self.config.stochastic_rounding),
+                    constant_hessian=bool(self.objective is not None
+                                          and self.objective.is_constant_hessian))
+                gq.append(gc)
+                hq.append(hc)
+            g = jnp.stack(gq, axis=1)
+            h = jnp.stack(hq, axis=1)
+
         finished = True
         for cls_idx in range(k):
             node_key = None
@@ -304,15 +389,18 @@ class GBDT:
                 node_key = jax.random.PRNGKey(
                     int(self.config.extra_seed) * 1000003
                     + self.iter_ * k + cls_idx)
-            arrays, leaf_of_row = grow_tree(
-                self.bins, g[:, cls_idx], h[:, cls_idx], row_mask,
-                self.num_bins_arr, self.nan_bin_arr, self.is_cat_arr,
-                feature_mask, self.hp, monotone=self.monotone_arr,
-                rng_key=node_key, interaction_sets=self.interaction_sets,
-                forced=self.forced_splits)
+            arrays, leaf_of_row = self._grow(g[:, cls_idx], h[:, cls_idx],
+                                             row_mask, feature_mask, node_key)
             num_leaves = int(arrays.num_leaves)
             if num_leaves > 1:
                 finished = False
+            if bool(self.config.use_quantized_grad) and \
+                    bool(self.config.quant_train_renew_leaf) and num_leaves > 1:
+                renewed = renew_leaf_values(
+                    leaf_of_row, g_true[:, cls_idx], h_true[:, cls_idx],
+                    row_mask, num_leaves=self.hp.num_leaves,
+                    lambda_l1=self.hp.lambda_l1, lambda_l2=self.hp.lambda_l2)
+                arrays = arrays._replace(leaf_value=renewed)
             arrays = self._renew_leaves(arrays, leaf_of_row, cls_idx)
             lin = None
             if self.linear and num_leaves > 1:
@@ -330,7 +418,7 @@ class GBDT:
                     self.shrinkage_rate * contrib)
                 for vi in range(len(self.valid_sets)):
                     leaf_v = predict_bins_leaf(arrays, self._valid_bins[vi],
-                                               self.nan_bin_arr)
+                                               self.nan_bin_arr, self.bundle)
                     vraw = self._valid_raw[vi]
                     vc = linear_leaf_scores(vraw, leaf_v, const, coeff,
                                             arrays.leaf_value) \
@@ -346,7 +434,7 @@ class GBDT:
                 for vi in range(len(self.valid_sets)):
                     contrib = predict_bins_tree(arrays_shrunk,
                                                 self._valid_bins[vi],
-                                                self.nan_bin_arr)
+                                                self.nan_bin_arr, self.bundle)
                     self.valid_scores[vi] = \
                         self.valid_scores[vi].at[:, cls_idx].add(contrib)
             tree = Tree.from_arrays(arrays, self.train_set)
@@ -361,6 +449,42 @@ class GBDT:
             self.models.append(tree)
         self.iter_ += 1
         return finished
+
+    def _grow(self, g: jax.Array, h: jax.Array, row_mask, feature_mask,
+              node_key) -> Tuple[TreeArrays, jax.Array]:
+        """One tree via the configured tree learner (serial or a
+        shard_map-distributed mode; reference CreateTreeLearner
+        tree_learner.cpp:15)."""
+        if self.parallel_mode is None:
+            return grow_tree(
+                self.bins, g, h, row_mask, self.num_bins_arr,
+                self.nan_bin_arr, self.is_cat_arr, feature_mask, self.hp,
+                monotone=self.monotone_arr, rng_key=node_key,
+                interaction_sets=self.interaction_sets,
+                forced=self.forced_splits, bundle=self.bundle)
+        if self.parallel_mode == "feature":
+            from ..parallel.feature_parallel import grow_tree_feature_parallel
+            if feature_mask is not None and self._pad_cols:
+                feature_mask = jnp.pad(feature_mask, (0, self._pad_cols))
+            arrays, lor = grow_tree_feature_parallel(
+                self.mesh, self.bins, g, h, row_mask, self.num_bins_arr,
+                self.nan_bin_arr, self.is_cat_arr, feature_mask, self.hp)
+            return arrays, lor
+        from ..parallel.data_parallel import grow_tree_sharded
+        p = self._pad_rows
+        if p:
+            g = jnp.pad(g, (0, p))
+            h = jnp.pad(h, (0, p))
+            row_mask = jnp.pad(jnp.ones(g.shape[0] - p, bool)
+                               if row_mask is None else row_mask, (0, p))
+        arrays, lor = grow_tree_sharded(
+            self.mesh, self.bins, g, h, row_mask, self.num_bins_arr,
+            self.nan_bin_arr, self.is_cat_arr, feature_mask, self.hp,
+            bundle=self.bundle, parallel_mode=self.parallel_mode,
+            top_k=int(self.config.top_k), monotone=self.monotone_arr,
+            rng_key=node_key, interaction_sets=self.interaction_sets,
+            forced=self.forced_splits)
+        return arrays, (lor[:-p] if p else lor)
 
     def _renew_leaves(self, arrays: TreeArrays, leaf_of_row: jax.Array,
                       cls_idx: int) -> TreeArrays:
@@ -477,7 +601,8 @@ class GBDT:
             tree = self.models.pop()
             contrib = predict_bins_tree(
                 _tree_to_arrays_stub(tree, self.train_set, exclude_bias=True),
-                self.bins, self.nan_bin_arr)
+                self.bins, self.nan_bin_arr,
+                self.bundle)[:self.train_set.num_data]
             self.scores = self.scores.at[:, c].add(-contrib)
         self.iter_ -= 1
 

@@ -23,6 +23,7 @@ import numpy as np
 from ..config import Config, as_config
 from ..utils import log
 from .binning import BIN_CATEGORICAL, BinMapper
+from .bundling import BundlePlan, apply_bundles, plan_bundles
 
 MAX_UINT8_BINS = 256
 
@@ -122,6 +123,9 @@ class Dataset:
         # raw values of the packed (used) features, kept only when
         # linear_tree is on (reference Dataset raw_data_ for linear leaves)
         self.raw: Optional[np.ndarray] = None
+        # EFB (reference FastFeatureBundling dataset.cpp:246): when set,
+        # ``bins`` holds bundled physical columns [n, Fb]
+        self.bundle_plan: Optional[BundlePlan] = None
 
     # ------------------------------------------------------------ properties
     @property
@@ -130,8 +134,8 @@ class Dataset:
 
     @property
     def num_features(self) -> int:
-        """Packed (used) feature count."""
-        return self.bins.shape[1]
+        """Packed (used, virtual) feature count."""
+        return len(self.used_feature_idx)
 
     @property
     def label(self) -> np.ndarray:
@@ -155,10 +159,27 @@ class Dataset:
 
     def device_n_bins(self) -> int:
         """Bin-axis width of device histograms / cat bitsets: max_num_bin
-        rounded up to a power of two (lane-friendly), floor 4.  Single source
-        of truth — trees and their cat_bitset widths must agree with it."""
-        n_bins = 1 << max(1, (self.max_num_bin() - 1).bit_length())
+        (or the widest EFB bundle column) rounded up to a power of two
+        (lane-friendly), floor 4.  Single source of truth — trees and their
+        cat_bitset widths must agree with it."""
+        widest = self.max_num_bin()
+        if self.bundle_plan is not None:
+            for members in self.bundle_plan.bundles:
+                total = 1 + sum(self.mappers[self.used_feature_idx[f]].num_bin
+                                - 1 for f in members)
+                widest = max(widest, total)
+        n_bins = 1 << max(1, (widest - 1).bit_length())
         return max(n_bins, 4)
+
+    def device_bundle_arrays(self):
+        """EFB tables trimmed to ``device_n_bins`` width, or None
+        (learner/grower.py DeviceBundle operands)."""
+        p = self.bundle_plan
+        if p is None:
+            return None
+        B = self.device_n_bins()
+        return (p.feat_col, p.src_idx[:, :B], p.valid[:, :B],
+                p.default_bin, p.inv_table[:, :B])
 
     # ---------------------------------------------------------- construction
     @classmethod
@@ -191,13 +212,17 @@ class Dataset:
 
         if reference is not None:
             # valid set: reuse the training mappers (reference CreateValid,
-            # dataset.h:703 — bin boundaries must align with train)
+            # dataset.h:703 — bin boundaries must align with train) and the
+            # training EFB plan (bundle layouts must match)
             ds.mappers = reference.mappers
             ds.used_feature_idx = list(reference.used_feature_idx)
             ds.num_total_features = reference.num_total_features
             ds.feature_names = reference.feature_names
             ds._reference = reference
             ds._bin_all(arr)
+            if reference.bundle_plan is not None:
+                ds.bundle_plan = reference.bundle_plan
+                ds.bins = apply_bundles(ds.bins, ds.bundle_plan)
             if bool(cfg.linear_tree):
                 ds.raw = arr[:, ds.used_feature_idx].astype(np.float32)
             return ds
@@ -205,6 +230,18 @@ class Dataset:
         cat_idx = _resolve_categorical(categorical_feature, ds.feature_names)
         ds._construct_mappers(arr, cfg, cat_idx)
         ds._bin_all(arr)
+        if bool(cfg.enable_bundle) and cfg.tree_learner not in (
+                "feature", "feature_parallel"):
+            # cap bundle width at the pre-EFB histogram width so EFB can
+            # only shrink the histogram tensor, never widen its bin axis
+            plan = plan_bundles(ds.bins, ds.num_bins_array(),
+                                max_total_bins=ds.device_n_bins())
+            if plan is not None:
+                saved = ds.bins.shape[1] - plan.num_bundles
+                log.info(f"EFB bundled {ds.bins.shape[1]} features into "
+                         f"{plan.num_bundles} columns (saved {saved})")
+                ds.bundle_plan = plan
+                ds.bins = apply_bundles(ds.bins, plan)
         if bool(cfg.linear_tree):
             ds.raw = arr[:, ds.used_feature_idx].astype(np.float32)
         return ds

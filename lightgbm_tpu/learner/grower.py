@@ -29,6 +29,7 @@ ReduceScatter/Allreduce dance (data_parallel_tree_learner.cpp:281,441).
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 from typing import NamedTuple, Optional, Tuple
 
@@ -43,6 +44,57 @@ from ..ops.split import (NEG_INF, VAR_CAT_BWD, VAR_CAT_FWD, VAR_CAT_ONEHOT,
                          leaf_output, smoothed_output)
 
 _INF_BOUND = 3.0e38  # leaf-output bound sentinel (±"infinity" in f32)
+
+
+class DeviceBundle(NamedTuple):
+    """EFB expansion tables on device (io/bundling.py BundlePlan): the
+    physical bin matrix / histograms cover bundle columns, these map them
+    back to per-feature (virtual) bin space."""
+    feat_col: jax.Array     # i32 [Fv] — physical column of each feature
+    src_idx: jax.Array      # i32 [Fv, B] — virtual bin -> bundle bin
+    valid: jax.Array        # bool [Fv, B]
+    default_bin: jax.Array  # i32 [Fv] — implicit most-frequent bin
+    inv_table: jax.Array    # i32 [Fv, B] — bundle value -> virtual bin
+
+
+def _expand_hist(hist_b: jax.Array, bundle: DeviceBundle, sum_g, sum_h,
+                 count) -> jax.Array:
+    """Bundle-level leaf histogram [Fb, B, C] -> virtual [Fv, B, C].
+
+    Each feature's stored bins are gathered from its bundle column; the
+    implicit default bin is completed from the leaf totals (the reference's
+    most-freq-bin completion, Dataset::FixHistogram dataset.h:760)."""
+    B = hist_b.shape[1]
+    hv = hist_b[bundle.feat_col[:, None], bundle.src_idx]       # [Fv, B, C]
+    hv = hv * bundle.valid[..., None]
+    rest = jnp.sum(hv, axis=1)                                  # [Fv, C]
+    total = jnp.stack([sum_g, sum_h, count,
+                       jnp.zeros_like(count)])                  # [C]
+    onehot = (lax.iota(jnp.int32, B)[None, :]
+              == bundle.default_bin[:, None])                   # [Fv, B]
+    return hv + onehot[..., None] * (total[None, None, :] - rest[:, None, :])
+
+
+def _expand_hist_col(hcol: jax.Array, bundle: DeviceBundle,
+                     feat: jax.Array, sum_g, sum_h, count) -> jax.Array:
+    """One feature's virtual histogram [B, C] from its bundle COLUMN hist.
+
+    The column must already be globally reduced (psum) before expansion when
+    the totals are global — the default-bin completion is total − rest and
+    mixing global totals with a local rest double-counts."""
+    hv = hcol[bundle.src_idx[feat]] * bundle.valid[feat][:, None]
+    rest = jnp.sum(hv, axis=0)
+    total = jnp.stack([sum_g, sum_h, count, jnp.zeros_like(count)])
+    return hv.at[bundle.default_bin[feat]].add(total - rest)
+
+
+def _feature_bin_of_rows(bins: jax.Array, bundle: Optional[DeviceBundle],
+                         feat: jax.Array) -> jax.Array:
+    """Virtual bin of every row for feature ``feat`` (partition step)."""
+    if bundle is None:
+        return jnp.take(bins, feat, axis=1).astype(jnp.int32)
+    col = jnp.take(bins, bundle.feat_col[feat], axis=1).astype(jnp.int32)
+    return bundle.inv_table[feat, col]
 
 
 class TreeArrays(NamedTuple):
@@ -124,7 +176,9 @@ def _child_best(hist: jax.Array, g: jax.Array, h: jax.Array, c: jax.Array,
     return res._replace(gain=jnp.where(depth_ok, res.gain, NEG_INF))
 
 
-@functools.partial(jax.jit, static_argnames=("hp", "axis_name"))
+@functools.partial(jax.jit, static_argnames=("hp", "axis_name",
+                                             "parallel_mode", "top_k",
+                                             "num_shards"))
 def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
               row_mask: Optional[jax.Array], num_bins: jax.Array,
               nan_bin: jax.Array, is_cat: jax.Array,
@@ -133,7 +187,10 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
               monotone: Optional[jax.Array] = None,
               rng_key: Optional[jax.Array] = None,
               interaction_sets: Optional[jax.Array] = None,
-              forced: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None
+              forced: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None,
+              bundle: Optional[DeviceBundle] = None,
+              parallel_mode: str = "data", top_k: int = 20,
+              num_shards: int = 1
               ) -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree; returns (TreeArrays, leaf_of_row).
 
@@ -152,10 +209,40 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     ``leaf_of_row`` is returned for ALL rows (bagged-out rows included), so the
     boosting score update is a pure gather — the reference's train-score
     shortcut through DataPartition (score_updater.hpp).
+
+    ``bundle``: EFB tables (io/bundling.py).  When set, ``bins`` holds the
+    BUNDLED physical columns; histograms are built per bundle and expanded to
+    per-feature space only for split finding.
+
+    ``parallel_mode`` selects the distributed strategy under ``axis_name``
+    (SURVEY.md §2.7; all three reference parallel learners):
+      * "data"    — rows sharded; full-histogram psum (the reference's
+                    ReduceScatter+Allreduce dataflow).
+      * "voting"  — rows sharded; PV-Tree 2-phase vote: each shard proposes
+                    its local top-``top_k`` features by gain, the vote picks
+                    2·top_k candidates, and ONLY their histogram slices are
+                    psum-ed (voting_parallel_tree_learner.cpp:151,184 —
+                    O(top_k·bins) comm, independent of feature count).
+                    ``num_shards`` must equal the mesh axis size; local
+                    validity thresholds are scaled by 1/num_shards (:62-64).
+      * "feature" — FEATURES sharded (bins/num_bins/... hold this shard's
+                    columns; every shard holds ALL rows): local best split,
+                    cross-shard argmax sync, owner broadcasts the partition
+                    (feature_parallel_tree_learner.cpp:62-79
+                    SyncUpGlobalBestSplit).  EFB/monotone/forced/interaction
+                    are not supported in this mode.
     """
-    n, num_f = bins.shape
+    n = bins.shape[0]
+    num_f = bins.shape[1] if bundle is None else bundle.feat_col.shape[0]
     L = hp.num_leaves
     mask_f = jnp.ones_like(grad) if row_mask is None else row_mask.astype(grad.dtype)
+    mode = parallel_mode if axis_name is not None else "data"
+    if mode == "feature" and axis_name is not None:
+        assert bundle is None and forced is None and monotone is None \
+            and interaction_sets is None, \
+            "feature-parallel composes only with the core split path"
+    # axis passed to histogram builders: only the data mode psums full hists
+    hist_axis = axis_name if mode == "data" else None
 
     use_bynode = hp.feature_fraction_bynode < 1.0 and rng_key is not None
 
@@ -178,16 +265,97 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             m = base & (u >= kth) & (u >= 0)
         return m
 
-    hist0 = root_histogram(bins, grad, hess, row_mask, n_bins=hp.n_bins,
-                           rows_per_block=hp.rows_per_block,
-                           hist_dtype=hp.hist_dtype, axis_name=axis_name)
+    hist0_b = root_histogram(bins, grad, hess, row_mask, n_bins=hp.n_bins,
+                             rows_per_block=hp.rows_per_block,
+                             hist_dtype=hp.hist_dtype, axis_name=hist_axis)
     g0 = jnp.sum(grad * mask_f)
     h0 = jnp.sum(hess * mask_f)
     c0 = jnp.sum(mask_f)
-    if axis_name is not None:
+    if axis_name is not None and mode != "feature":
+        # feature mode holds ALL rows on every shard: sums already global
         g0 = lax.psum(g0, axis_name)
         h0 = lax.psum(h0, axis_name)
         c0 = lax.psum(c0, axis_name)
+
+    if mode == "voting" and axis_name is not None:
+        # locally relaxed validity thresholds
+        # (voting_parallel_tree_learner.cpp:62-64)
+        hp_vote = dataclasses.replace(
+            hp, min_data_in_leaf=max(1, hp.min_data_in_leaf // num_shards),
+            min_sum_hessian_in_leaf=hp.min_sum_hessian_in_leaf / num_shards)
+
+    def child_best(h_phys, g_, h_, c_, depth, fm, parent_output, lmin, lmax,
+                   key) -> SplitResult:
+        """Best split for one leaf from its PHYSICAL (bundle-column)
+        histogram — local shard hist under voting/feature modes, global
+        otherwise.  Returns a SplitResult whose ``feature`` is the virtual
+        (voting) / global (feature-parallel) index."""
+        if mode == "voting" and axis_name is not None:
+            # phase 1: local per-feature gains on the LOCAL histogram (any
+            # physical column's bins sum to the local leaf totals)
+            lg_ = jnp.sum(h_phys[0, :, 0])
+            lh_ = jnp.sum(h_phys[0, :, 1])
+            lc_ = jnp.sum(h_phys[0, :, 2])
+            hv_local = h_phys if bundle is None else \
+                _expand_hist(h_phys, bundle, lg_, lh_, lc_)
+            pf: list = []
+            find_best_split(hv_local, lg_, lh_, lc_, num_bins, nan_bin,
+                            is_cat, fm, hp_vote, monotone=monotone,
+                            parent_output=parent_output, leaf_min=lmin,
+                            leaf_max=lmax, depth=depth, rng_key=key,
+                            per_feature_out=pf)
+            gains_local = pf[0]                                # [F]
+            k = min(top_k, num_f)
+            _, local_top = lax.top_k(gains_local, k)
+            votes = lax.psum(jnp.zeros((num_f,), jnp.float32)
+                             .at[local_top].set(1.0), axis_name)
+            gain_sum = lax.psum(jnp.clip(gains_local, -1e9, 1e9), axis_name)
+            # phase 2: psum ONLY the globally voted candidates' histograms
+            score = votes * 1e12 + gain_sum
+            sel_k = min(2 * top_k, num_f)
+            _, sel = lax.top_k(score, sel_k)                   # [2k]
+            h_sel = lax.psum(hv_local[sel], axis_name)         # [2k, B, C]
+            res = find_best_split(
+                h_sel, g_, h_, c_, num_bins[sel], nan_bin[sel], is_cat[sel],
+                None if fm is None else fm[sel], hp,
+                monotone=None if monotone is None else monotone[sel],
+                parent_output=parent_output, leaf_min=lmin, leaf_max=lmax,
+                depth=depth, rng_key=key)
+            res = res._replace(feature=sel[res.feature])
+            depth_ok = (hp.max_depth <= 0) | (depth < hp.max_depth)
+            return res._replace(gain=jnp.where(depth_ok, res.gain, NEG_INF))
+        if mode == "feature" and axis_name is not None:
+            res = _child_best(h_phys, g_, h_, c_, depth, num_bins, nan_bin,
+                              is_cat, fm, hp, parent_output=parent_output,
+                              leaf_min=lmin, leaf_max=lmax, rng_key=key)
+            # cross-shard best-split argmax (SyncUpGlobalBestSplit,
+            # feature_parallel_tree_learner.cpp:62-79): gather the packed
+            # candidate of every shard, keep the best, globalize the index
+            rank = lax.axis_index(axis_name)
+            gfeat = res.feature + rank * num_f
+            packed = jnp.stack([
+                res.gain, gfeat.astype(jnp.float32),
+                res.threshold.astype(jnp.float32),
+                res.default_left.astype(jnp.float32),
+                res.is_categorical.astype(jnp.float32),
+                res.variant.astype(jnp.float32),
+                res.left_sum_g, res.left_sum_h, res.left_count,
+                res.right_sum_g, res.right_sum_h, res.right_count])
+            allp = lax.all_gather(packed, axis_name)           # [d, 12]
+            b = allp[jnp.argmax(allp[:, 0])]
+            return SplitResult(
+                gain=b[0], feature=b[1].astype(jnp.int32),
+                threshold=b[2].astype(jnp.int32),
+                default_left=b[3] > 0.5, is_categorical=b[4] > 0.5,
+                variant=b[5].astype(jnp.int32),
+                left_sum_g=b[6], left_sum_h=b[7], left_count=b[8],
+                right_sum_g=b[9], right_sum_h=b[10], right_count=b[11])
+        hv = h_phys if bundle is None else \
+            _expand_hist(h_phys, bundle, g_, h_, c_)
+        return _child_best(hv, g_, h_, c_, depth, num_bins, nan_bin, is_cat,
+                           fm, hp, monotone=monotone,
+                           parent_output=parent_output, leaf_min=lmin,
+                           leaf_max=lmax, rng_key=key)
 
     root_out = leaf_output(g0, h0, hp.lambda_l1, hp.lambda_l2,
                            hp.max_delta_step)
@@ -198,10 +366,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     else:
         key_root = key_er = None
     fm_root = node_feature_mask(empty_path, key_root)
-    best0 = _child_best(hist0, g0, h0, c0, jnp.int32(0), num_bins, nan_bin,
-                        is_cat, fm_root, hp, monotone=monotone,
-                        parent_output=root_out, leaf_min=-inf, leaf_max=inf,
-                        rng_key=key_er)
+    best0 = child_best(hist0_b, g0, h0, c0, jnp.int32(0), fm_root,
+                       root_out, -inf, inf, key_er)
 
     tree = _empty_tree(L, hp.n_bins, num_f)
     tree = tree._replace(
@@ -209,11 +375,13 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         leaf_count=tree.leaf_count.at[0].set(c0),
         leaf_weight=tree.leaf_weight.at[0].set(h0),
     )
-    C = hist0.shape[-1]
+    C = hist0_b.shape[-1]
+    n_cols = bins.shape[1]  # physical histogram columns (== num_f unbundled)
     state = _GrowState(
         tree=tree,
         leaf_of_row=jnp.zeros((n,), jnp.int32),
-        hist=jnp.zeros((L, num_f, hp.n_bins, C), jnp.float32).at[0].set(hist0),
+        hist=jnp.zeros((L, n_cols, hp.n_bins, C),
+                       jnp.float32).at[0].set(hist0_b),
         sum_g=jnp.zeros((L,), jnp.float32).at[0].set(g0),
         sum_h=jnp.zeros((L,), jnp.float32).at[0].set(h0),
         count=jnp.zeros((L,), jnp.float32).at[0].set(c0),
@@ -251,7 +419,13 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             f_active = (f_leaf[i] >= 0) & ~st.force_failed & ~st.done
             fl = jnp.maximum(f_leaf[i], 0)
             ff, ft = f_feat[i], f_thr[i]
-            hf = st.hist[fl, ff]                               # [B, C]
+            hf_col = st.hist[fl, ff if bundle is None
+                             else bundle.feat_col[ff]]         # [B, C]
+            if mode == "voting" and axis_name is not None:
+                hf_col = lax.psum(hf_col, axis_name)  # local -> global
+            hf = hf_col if bundle is None else \
+                _expand_hist_col(hf_col, bundle, ff, st.sum_g[fl],
+                                 st.sum_h[fl], st.count[fl])
             b_i = lax.iota(jnp.int32, hp.n_bins)
             lm = jnp.where(is_cat[ff], b_i == ft,
                            (b_i <= ft) & (b_i != nan_bin[ff]))
@@ -294,11 +468,36 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             t = st.tree
             new_leaf = i + 1
 
+            # feature-parallel: locate the owning shard of the winning
+            # (global) feature; only it holds the column/histogram
+            if mode == "feature" and axis_name is not None:
+                rank = lax.axis_index(axis_name)
+                f_local = feat - rank * num_f
+                owns = (f_local >= 0) & (f_local < num_f)
+                f_safe = jnp.clip(f_local, 0, num_f - 1)
+            else:
+                owns = jnp.bool_(True)
+                f_safe = feat
+
             # left-category bitset, derived from the PARENT histogram (still
             # at st.hist[bl] at this point)
             if hp.has_categorical:
-                bitset = categorical_left_bitset(st.hist[bl, feat],
-                                                 num_bins[feat], var, thr, hp)
+                pf_col = st.hist[bl, f_safe if bundle is None
+                                 else bundle.feat_col[f_safe]]
+                if mode == "voting" and axis_name is not None:
+                    pf_col = lax.psum(pf_col, axis_name)
+                hist_pf = pf_col if bundle is None else \
+                    _expand_hist_col(pf_col, bundle, f_safe,
+                                     st.sum_g[bl], st.sum_h[bl],
+                                     st.count[bl])
+                bitset = categorical_left_bitset(hist_pf,
+                                                 num_bins[f_safe], var, thr,
+                                                 hp)
+                if mode == "feature" and axis_name is not None:
+                    # owner broadcasts its bitset
+                    bitset = lax.psum(
+                        jnp.where(owns, bitset.astype(jnp.float32), 0.0),
+                        axis_name) > 0.5
                 bitset = bitset & catl
             else:
                 bitset = jnp.zeros((hp.n_bins,), bool)
@@ -331,11 +530,19 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                 num_leaves=jnp.int32(i + 2),
             )
 
-            # -- partition (dense map update, no data movement)
-            col = jnp.take(bins, feat, axis=1).astype(jnp.int32)
-            nb = nan_bin[feat]
+            # -- partition (dense map update, no data movement); under
+            # feature-parallel only the owner has the column, so its go-left
+            # vector is broadcast (the reference instead re-splits from the
+            # synced SplitInfo since every rank holds all features' data —
+            # here columns are truly sharded, so one [n] psum replaces it)
+            col = _feature_bin_of_rows(bins, bundle, f_safe)
+            nb = nan_bin[f_safe]
             go_left_num = jnp.where(col == nb, dl, col <= thr)
             go_left = jnp.where(catl, bitset[col], go_left_num)
+            if mode == "feature" and axis_name is not None:
+                go_left = lax.psum(
+                    jnp.where(owns, go_left.astype(jnp.float32), 0.0),
+                    axis_name) > 0.5
             active = st.leaf_of_row == bl
             leaf_of_row = jnp.where(
                 active, jnp.where(go_left, bl, new_leaf), st.leaf_of_row)
@@ -380,7 +587,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                 bins, grad, hess, leaf_of_row, smaller,
                 jnp.minimum(lcn, rcn), row_mask,
                 n_bins=hp.n_bins, rows_per_block=hp.rows_per_block,
-                hist_dtype=hp.hist_dtype, axis_name=axis_name)
+                hist_dtype=hp.hist_dtype, axis_name=hist_axis)
             h_parent = st.hist[bl]
             h_large = h_parent - h_small
             left_small = lcn <= rcn
@@ -396,7 +603,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                 leaf_weight=t.leaf_weight.at[bl].set(lh).at[new_leaf].set(rh),
             )
 
-            child_path = st.path_feats[bl].at[feat].set(True)
+            child_path = st.path_feats[bl].at[f_safe].set(True)
             if rng_key is not None:
                 k_l, k_r, k_el, k_er2 = jax.random.split(
                     jax.random.fold_in(rng_key, i), 4)
@@ -404,14 +611,10 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                 k_l = k_r = k_el = k_er2 = None
             fm_l = node_feature_mask(child_path, k_l)
             fm_r = node_feature_mask(child_path, k_r)
-            bs_l = _child_best(h_left, lg, lh, lcn, d, num_bins, nan_bin,
-                               is_cat, fm_l, hp, monotone=monotone,
-                               parent_output=lo, leaf_min=lmin_l,
-                               leaf_max=lmax_l, rng_key=k_el)
-            bs_r = _child_best(h_right, rg, rh, rcn, d, num_bins, nan_bin,
-                               is_cat, fm_r, hp, monotone=monotone,
-                               parent_output=ro, leaf_min=lmin_r,
-                               leaf_max=lmax_r, rng_key=k_er2)
+            bs_l = child_best(h_left, lg, lh, lcn, d, fm_l, lo, lmin_l,
+                              lmax_l, k_el)
+            bs_r = child_best(h_right, rg, rh, rcn, d, fm_r, ro, lmin_r,
+                              lmax_r, k_er2)
 
             return st._replace(
                 tree=t,

@@ -20,19 +20,20 @@ from ..learner.grower import TreeArrays
 
 @jax.jit
 def predict_bins_tree(tree: TreeArrays, bins: jax.Array,
-                      nan_bin: jax.Array) -> jax.Array:
+                      nan_bin: jax.Array, bundle=None) -> jax.Array:
     """Leaf VALUE per row for one device tree over binned features.
 
     tree: TreeArrays (packed feature indices, bin thresholds);
-    bins: uint8 [n, F]; nan_bin: i32 [F].
+    bins: uint8 [n, F]; nan_bin: i32 [F]; bundle: optional EFB tables
+    (learner/grower.py DeviceBundle) when ``bins`` is bundled.
     """
-    leaf = predict_bins_leaf(tree, bins, nan_bin)
+    leaf = predict_bins_leaf(tree, bins, nan_bin, bundle)
     return tree.leaf_value[leaf]
 
 
 @jax.jit
 def predict_bins_leaf(tree: TreeArrays, bins: jax.Array,
-                      nan_bin: jax.Array) -> jax.Array:
+                      nan_bin: jax.Array, bundle=None) -> jax.Array:
     n = bins.shape[0]
     rows = lax.iota(jnp.int32, n)
     node0 = jnp.zeros((n,), jnp.int32)
@@ -47,7 +48,11 @@ def predict_bins_leaf(tree: TreeArrays, bins: jax.Array,
         thr = tree.split_bin[safe]
         dl = tree.default_left[safe]
         cat = tree.split_cat[safe]
-        col = bins[rows, feat].astype(jnp.int32)
+        if bundle is None:
+            col = bins[rows, feat].astype(jnp.int32)
+        else:
+            phys = bins[rows, bundle.feat_col[feat]].astype(jnp.int32)
+            col = bundle.inv_table[feat, phys]
         nb = nan_bin[feat]
         cat_left = tree.cat_bitset[safe, col]
         go_left = jnp.where(col == nb, dl,

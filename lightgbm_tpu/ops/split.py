@@ -142,7 +142,8 @@ def find_best_split(hist: jax.Array, sum_g: jax.Array, sum_h: jax.Array,
                     parent_output=0.0,
                     leaf_min=None, leaf_max=None,
                     depth=None,
-                    rng_key: Optional[jax.Array] = None) -> SplitResult:
+                    rng_key: Optional[jax.Array] = None,
+                    per_feature_out: Optional[list] = None) -> SplitResult:
     """Pick the best (feature, threshold, default-dir) for one leaf.
 
     hist: f32 [F, B, C>=3] (grad, hess, count); sum_g/sum_h/count: leaf totals.
@@ -303,6 +304,12 @@ def find_best_split(hist: jax.Array, sum_g: jax.Array, sum_h: jax.Array,
                      axis=-1)                                  # [F, B, V]
     if feature_mask is not None:
         cand = jnp.where(feature_mask[:, None, None], cand, NEG_INF)
+
+    if per_feature_out is not None:
+        # voting-parallel hook: per-feature best gain before the global
+        # argmax (reference voting_parallel_tree_learner.cpp:344 votes on
+        # per-feature local split gains)
+        per_feature_out.append(jnp.max(cand, axis=(1, 2)) - min_shift)
 
     if hp.use_monotone and hp.monotone_penalty > 0.0:
         # depth-decaying gain penalty on monotone features, applied to the

@@ -33,7 +33,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 from ..learner.grower import TreeArrays, grow_tree
 from ..ops.split import SplitHyper
@@ -44,13 +44,23 @@ def grow_tree_sharded(mesh: Mesh, bins: jax.Array, grad: jax.Array,
                       hess: jax.Array, row_mask: Optional[jax.Array],
                       num_bins: jax.Array, nan_bin: jax.Array,
                       is_cat: jax.Array, feature_mask: Optional[jax.Array],
-                      hp: SplitHyper) -> Tuple[TreeArrays, jax.Array]:
-    """Grow one tree data-parallel: rows sharded over ``mesh``'s data axis.
+                      hp: SplitHyper,
+                      bundle=None, parallel_mode: str = "data",
+                      top_k: int = 20, monotone=None, rng_key=None,
+                      interaction_sets=None,
+                      forced=None) -> Tuple[TreeArrays, jax.Array]:
+    """Grow one tree with rows sharded over ``mesh``'s data axis.
 
     bins [n, F] uint8, grad/hess [n] — n must divide the mesh size (pad +
-    mask otherwise).  Returns (replicated TreeArrays, row-sharded
-    leaf_of_row).
+    mask otherwise).  ``bundle``: replicated EFB tables (DeviceBundle).
+    ``parallel_mode``: "data" (full-histogram psum) or "voting" (PV-Tree
+    top-k vote, voting_parallel_tree_learner.cpp — psums only the voted
+    features' histogram slices).  Returns (replicated TreeArrays,
+    row-sharded leaf_of_row).
     """
+    def rep(x):
+        return None if x is None else jax.tree.map(lambda _: P(), x)
+
     in_specs = (
         P(DATA_AXIS),                       # bins
         P(DATA_AXIS),                       # grad
@@ -60,21 +70,30 @@ def grow_tree_sharded(mesh: Mesh, bins: jax.Array, grad: jax.Array,
         P(),                                # nan_bin
         P(),                                # is_cat
         P() if feature_mask is not None else None,
+        rep(bundle),
+        rep(monotone),
+        rep(rng_key),
+        rep(interaction_sets),
+        rep(forced),
     )
     out_specs = (
         jax.tree.map(lambda _: P(), TreeArrays(*[0] * len(TreeArrays._fields))),
         P(DATA_AXIS),                       # leaf_of_row
     )
 
-    def local(b, g, h, m, nb, nanb, cat, fm):
+    def local(b, g, h, m, nb, nanb, cat, fm, bd, mono, key, isets, fsp):
         return grow_tree(b, g, h, m, nb, nanb, cat, fm, hp,
-                         axis_name=DATA_AXIS)
+                         axis_name=DATA_AXIS, bundle=bd, monotone=mono,
+                         rng_key=key, interaction_sets=isets, forced=fsp,
+                         parallel_mode=parallel_mode, top_k=top_k,
+                         num_shards=mesh.devices.size)
 
     fn = shard_map(local, mesh=mesh,
                    in_specs=tuple(s for s in in_specs),
-                   out_specs=out_specs, check_rep=False)
+                   out_specs=out_specs, check_vma=False)
     return fn(bins, grad, hess, row_mask, num_bins, nan_bin, is_cat,
-              feature_mask)
+              feature_mask, bundle, monotone, rng_key, interaction_sets,
+              forced)
 
 
 def train_step_sharded(mesh: Mesh, bins: jax.Array, scores: jax.Array,
@@ -111,5 +130,5 @@ def train_step_sharded(mesh: Mesh, bins: jax.Array, scores: jax.Array,
         return tree, new_scores
 
     fn = shard_map(local, mesh=mesh, in_specs=in_specs,
-                   out_specs=out_specs, check_rep=False)
+                   out_specs=out_specs, check_vma=False)
     return fn(bins, scores, label, row_mask, num_bins, nan_bin, is_cat)

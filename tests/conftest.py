@@ -14,11 +14,29 @@ plain case.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# FORCE cpu: under axon the sitecustomize pre-imports jax with
+# JAX_PLATFORMS=axon (the TPU tunnel) before conftest runs; tests over the
+# tunnel are ~10x slower and flaky.  The backend is not initialized until the
+# first jax.devices()/jit call, so overriding here still takes effect.
+# Set LIGHTGBM_TPU_TEST_BACKEND=tpu to run the suite on real hardware.
+_backend = os.environ.get("LIGHTGBM_TPU_TEST_BACKEND", "cpu")
+os.environ["JAX_PLATFORMS"] = _backend
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# jax snapshots JAX_PLATFORMS at import, so the env write above is a no-op
+# when sitecustomize imported jax first — override through the config API
+# (safe while no backend is live yet).
+if jax._src.xla_bridge._backends:
+    raise RuntimeError(
+        "jax backend initialized before conftest could force "
+        f"platform={_backend}; run pytest as "
+        "`env -u PYTHONPATH python -m pytest`")
+jax.config.update("jax_platforms", _backend)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

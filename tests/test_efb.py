@@ -1,0 +1,108 @@
+"""Exclusive Feature Bundling tests (reference dataset.cpp FindGroups /
+FastFeatureBundling; test strategy: reference test_basic.py bundling cases)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.io.bundling import apply_bundles, plan_bundles
+
+FAST = {"num_leaves": 15, "learning_rate": 0.15, "min_data_in_leaf": 5,
+        "verbose": -1}
+
+
+def _onehot_data(n=2000, groups=4, levels=8, seed=0):
+    """Sparse one-hot blocks: perfectly exclusive within each block."""
+    rng = np.random.default_rng(seed)
+    cols = []
+    idxs = []
+    for g in range(groups):
+        idx = rng.integers(0, levels, size=n)
+        idxs.append(idx)
+        block = np.zeros((n, levels))
+        block[np.arange(n), idx] = rng.normal(1.5, 0.2, size=n)
+        cols.append(block)
+    dense = rng.normal(size=(n, 2))
+    X = np.concatenate(cols + [dense], axis=1)
+    y = ((idxs[0] % 2) + 0.5 * (idxs[1] % 3) + dense[:, 0]
+         + 0.1 * rng.normal(size=n) > 1.0).astype(np.float64)
+    return X, y
+
+
+def test_plan_bundles_merges_exclusive_columns():
+    X, _ = _onehot_data()
+    ds = lgb.Dataset(X, label=np.zeros(len(X)),
+                     params={**FAST, "enable_bundle": False}).construct()
+    inner = ds._inner
+    plan = plan_bundles(inner.bins, inner.num_bins_array())
+    assert plan is not None
+    # 4 blocks of 8 exclusive one-hot columns collapse into few bundles
+    assert plan.num_bundles < inner.bins.shape[1] - 10
+    bundled = apply_bundles(inner.bins, plan)
+    assert bundled.shape == (inner.bins.shape[0], plan.num_bundles)
+    # round-trip: every virtual bin is recoverable from the bundle value
+    f = int(np.argmax([len(m) > 1 for m in plan.bundles]))
+    members = plan.bundles[f]
+    for feat in members[:3]:
+        vb = inner.bins[:, feat].astype(np.int64)
+        recon = plan.inv_table[feat][bundled[:, f]]
+        nz = vb != plan.default_bin[feat]
+        conflict_free = recon[nz] == vb[nz]
+        assert conflict_free.mean() > 0.99  # first-writer wins rare conflicts
+        assert (recon[~nz] == plan.default_bin[feat]).all()
+
+
+def test_efb_training_parity():
+    """Conflict-free bundling must not change what the learner sees:
+    predictions with and without EFB agree."""
+    X, y = _onehot_data()
+    p_off = {**FAST, "objective": "binary", "enable_bundle": False}
+    p_on = {**FAST, "objective": "binary", "enable_bundle": True}
+    bst_off = lgb.train(p_off, lgb.Dataset(X, label=y, params=p_off),
+                        num_boost_round=10)
+    bst_on = lgb.train(p_on, lgb.Dataset(X, label=y, params=p_on),
+                       num_boost_round=10)
+    po = bst_off.predict(X)
+    pb = bst_on.predict(X)
+    # same splits modulo fp reassociation in histogram accumulation
+    assert np.abs(po - pb).max() < 5e-3
+    assert float(np.mean((pb > 0.5) == y)) > 0.85
+
+
+def test_efb_valid_and_model_roundtrip(tmp_path):
+    X, y = _onehot_data(seed=5)
+    Xv, yv = _onehot_data(seed=6)
+    p = {**FAST, "objective": "binary", "enable_bundle": True,
+         "metric": ["auc"]}
+    ds = lgb.Dataset(X, label=y, params=p)
+    dv = ds.create_valid(Xv, label=yv)
+    res = {}
+    bst = lgb.train(p, ds, num_boost_round=10, valid_sets=[dv],
+                    valid_names=["v"], callbacks=[lgb.record_evaluation(res)])
+    assert res["v"]["auc"][-1] > 0.8
+    # in-training valid-score path (bundled traversal) == host predict
+    # (f32 device scores vs f64 host accumulation -> tiny drift)
+    np.testing.assert_allclose(
+        res["v"]["auc"][-1],
+        _auc(yv, bst.predict(Xv)), atol=1e-3)
+    f = tmp_path / "m.txt"
+    bst.save_model(str(f))
+    bst2 = lgb.Booster(model_file=str(f))
+    np.testing.assert_allclose(bst.predict(Xv), bst2.predict(Xv), atol=1e-6)
+
+
+def _auc(y, p):
+    order = np.argsort(p)
+    y = np.asarray(y)[order]
+    n_pos = y.sum()
+    n_neg = len(y) - n_pos
+    ranks = np.arange(1, len(y) + 1)
+    return (ranks[y > 0].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg)
+
+
+def test_efb_dense_data_is_noop(synthetic_binary):
+    """Dense features can't bundle: plan is None, fast path untouched."""
+    X, y = synthetic_binary
+    ds = lgb.Dataset(X, label=y, params={**FAST, "enable_bundle": True})
+    ds.construct()
+    assert ds._inner.bundle_plan is None

@@ -1,0 +1,60 @@
+"""Quantized-gradient training tests (reference gradient_discretizer.cpp;
+test strategy: reference test_engine.py quantized_grad cases)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.ops.quantize import discretize_gradients
+
+FAST = {"num_leaves": 15, "learning_rate": 0.15, "min_data_in_leaf": 5,
+        "verbose": -1}
+
+
+def test_discretize_levels():
+    import jax
+    rng = np.random.default_rng(0)
+    g = rng.normal(size=5000).astype(np.float32)
+    h = np.abs(rng.normal(size=5000)).astype(np.float32)
+    gq, hq = discretize_gradients(jax.numpy.asarray(g), jax.numpy.asarray(h),
+                                  jax.random.PRNGKey(0), n_levels=4,
+                                  stochastic=False)
+    gq, hq = np.asarray(gq), np.asarray(hq)
+    # fake-quant: only (levels+1) distinct grad values, scaled integers
+    g_scale = np.abs(g).max() / 2
+    levels = np.unique(np.round(gq / g_scale))
+    assert len(levels) <= 5
+    np.testing.assert_allclose(gq, np.round(g / g_scale) * g_scale, rtol=1e-5)
+    # hessian nonnegative, quantized to at most levels+1 values
+    assert (hq >= 0).all()
+    # stochastic rounding is unbiased-ish: mean close to true mean
+    gq_s, _ = discretize_gradients(jax.numpy.asarray(g), jax.numpy.asarray(h),
+                                   jax.random.PRNGKey(1), n_levels=4,
+                                   stochastic=True)
+    assert abs(float(np.mean(np.asarray(gq_s))) - g.mean()) < 0.05
+
+
+@pytest.mark.parametrize("renew", [False, True])
+def test_quantized_training_quality(synthetic_binary, renew):
+    """Quantized training reaches near the full-precision quality
+    (reference test: logloss within a small margin)."""
+    X, y = synthetic_binary
+    p = {**FAST, "objective": "binary"}
+    full = lgb.train(p, lgb.Dataset(X, label=y, params=p), num_boost_round=20)
+    acc_full = float(((full.predict(X) > 0.5) == y).mean())
+
+    pq = {**p, "use_quantized_grad": True, "num_grad_quant_bins": 4,
+          "quant_train_renew_leaf": renew, "seed": 7}
+    quant = lgb.train(pq, lgb.Dataset(X, label=y, params=pq),
+                      num_boost_round=20)
+    acc_q = float(((quant.predict(X) > 0.5) == y).mean())
+    assert acc_q > acc_full - 0.03
+
+
+def test_quantized_regression(synthetic_regression):
+    X, y = synthetic_regression
+    p = {**FAST, "objective": "regression", "use_quantized_grad": True,
+         "quant_train_renew_leaf": True, "seed": 3}
+    bst = lgb.train(p, lgb.Dataset(X, label=y, params=p), num_boost_round=25)
+    r2 = 1 - np.mean((bst.predict(X) - y) ** 2) / np.var(y)
+    assert r2 > 0.8
