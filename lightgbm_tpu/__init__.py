@@ -11,7 +11,7 @@ from .utils.log import LightGBMError, register_logger
 
 __version__ = "0.1.0"
 
-from .basic import Booster, Dataset  # noqa: E402
+from .basic import Booster, Dataset, Sequence  # noqa: E402
 from .engine import cv, train  # noqa: E402
 from .callback import (early_stopping, log_evaluation,  # noqa: E402
                        record_evaluation, reset_parameter)
@@ -32,7 +32,7 @@ __all__ = [
     "Config", "Dataset", "Booster", "train", "cv",
     "early_stopping", "log_evaluation", "record_evaluation", "reset_parameter",
     "LGBMModel", "LGBMClassifier", "LGBMRegressor", "LGBMRanker",
-    "LightGBMError", "register_logger",
+    "LightGBMError", "register_logger", "Sequence",
     "plot_importance", "plot_split_value_histogram", "plot_metric",
     "plot_tree", "create_tree_digraph",
 ]

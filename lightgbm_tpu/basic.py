@@ -13,7 +13,7 @@ save/load surface.
 from __future__ import annotations
 
 import os
-from typing import Any, Dict, List, Optional, Sequence, Union
+from typing import Any, Dict, List, Optional, Sequence as TSeq, Union
 
 import numpy as np
 
@@ -29,6 +29,93 @@ from .objectives import create_objective
 from .utils import log
 
 
+class Sequence:
+    """Generic data access interface for batched/streaming construction
+    (reference basic.py:915 ``Sequence`` ABC: user subclasses implement
+    ``__getitem__`` — row or slice — and ``__len__``; the loader reads
+    ``batch_size`` rows at a time so the raw source never needs a single
+    contiguous materialization)."""
+
+    batch_size = 4096
+
+    def __getitem__(self, idx):  # pragma: no cover - interface
+        raise NotImplementedError("Sub-classes of lightgbm_tpu.Sequence "
+                                  "must implement __getitem__")
+
+    def __len__(self) -> int:  # pragma: no cover - interface
+        raise NotImplementedError("Sub-classes of lightgbm_tpu.Sequence "
+                                  "must implement __len__")
+
+
+def _sequence_to_array(seqs) -> np.ndarray:
+    parts = []
+    for s in seqs:
+        n = len(s)
+        for lo in range(0, n, int(getattr(s, "batch_size", 4096) or 4096)):
+            hi = min(n, lo + int(getattr(s, "batch_size", 4096) or 4096))
+            batch = np.asarray(s[slice(lo, hi)], dtype=np.float64)
+            parts.append(batch.reshape(hi - lo, -1))
+    return np.concatenate(parts, axis=0) if parts else np.zeros((0, 0))
+
+
+def _convert_pandas_categorical(df, stored: Optional[list] = None):
+    """Convert categorical-dtype columns to float codes (NaN = unseen /
+    missing).  Returns (converted df, category lists in DataFrame column
+    order, categorical column names).  ``stored`` aligns conversion to the
+    TRAINING category lists — the reference's ``pandas_categorical`` model
+    field, zipped positionally with the frame's categorical columns."""
+    import pandas as pd
+    cat_cols = [c for c in df.columns
+                if isinstance(df[c].dtype, pd.CategoricalDtype)]
+    if not cat_cols:
+        return df, None, []
+    if stored is not None and len(stored) != len(cat_cols):
+        log.fatal(f"train data had {len(stored)} categorical column(s), "
+                  f"this data has {len(cat_cols)}")
+    df = df.copy()
+    out = []
+    for i, c in enumerate(cat_cols):
+        cats = list(stored[i]) if stored is not None \
+            else list(df[c].cat.categories)
+        codes = pd.Categorical(df[c],
+                               categories=cats).codes.astype(np.float64)
+        df[c] = np.where(codes < 0, np.nan, codes)
+        out.append(cats)
+    return df, out, [str(c) for c in cat_cols]
+
+
+def _coerce_data(data: Any, categorical_feature, category_maps=None):
+    """Normalize input data to (float64 ndarray, feature_names or None,
+    categorical_feature, pandas_categorical or None).
+
+    Handles: numpy, list-of-rows, scipy CSR/CSC (densified — bins are dense
+    uint8 on device anyway), pandas DataFrame (category dtypes -> codes with
+    NaN = missing; 'auto' categorical resolves to those columns, reference
+    basic.py _data_from_pandas), pyarrow Table, Sequence / list of Sequence.
+    ``category_maps``: training category lists for valid-set alignment."""
+    pandas_categorical = None
+    feature_names = None
+    if isinstance(data, Sequence):
+        data = _sequence_to_array([data])
+    elif isinstance(data, list) and data and \
+            all(isinstance(s, Sequence) for s in data):
+        data = _sequence_to_array(data)
+    if hasattr(data, "column_names") and hasattr(data, "to_pandas"):
+        data = data.to_pandas()  # pyarrow Table
+    if hasattr(data, "columns") and hasattr(data, "dtypes"):  # DataFrame
+        feature_names = [str(c) for c in data.columns]
+        data, pandas_categorical, cat_names = _convert_pandas_categorical(
+            data, stored=category_maps)
+        if cat_names and categorical_feature in ("auto", None):
+            categorical_feature = cat_names
+        arr = data.to_numpy(dtype=np.float64, na_value=np.nan)
+        return arr, feature_names, categorical_feature, pandas_categorical
+    if hasattr(data, "toarray"):  # scipy sparse
+        data = data.toarray()
+    return (np.asarray(data, dtype=np.float64), feature_names,
+            categorical_feature, pandas_categorical)
+
+
 def _margin_reached(out: np.ndarray, margin: float) -> np.ndarray:
     """Per-row early-termination test (reference
     prediction_early_stop.cpp — binary: 2*|raw|, multiclass: top-2 gap)."""
@@ -41,11 +128,11 @@ def _margin_reached(out: np.ndarray, margin: float) -> np.ndarray:
 class Dataset:
     """Lazily-constructed binned dataset (reference basic.py:1764)."""
 
-    def __init__(self, data: Any, label: Optional[Sequence[float]] = None,
+    def __init__(self, data: Any, label: Optional[TSeq[float]] = None,
                  reference: Optional["Dataset"] = None,
-                 weight: Optional[Sequence[float]] = None,
-                 group: Optional[Sequence[int]] = None,
-                 init_score: Optional[Sequence[float]] = None,
+                 weight: Optional[TSeq[float]] = None,
+                 group: Optional[TSeq[int]] = None,
+                 init_score: Optional[TSeq[float]] = None,
                  feature_name: Union[str, List[str], None] = "auto",
                  categorical_feature: Union[str, List, None] = "auto",
                  params: Optional[Dict[str, Any]] = None,
@@ -61,6 +148,7 @@ class Dataset:
         self.params = dict(params or {})
         self.free_raw_data = free_raw_data
         self.position = position
+        self.pandas_categorical: Optional[list] = None
         self._inner: Optional[_InnerDataset] = None
         # continuation: a predictor whose raw predictions become this
         # dataset's init_score (reference basic.py:2059
@@ -87,6 +175,15 @@ class Dataset:
                 if getattr(self, k, None) is None:
                     setattr(self, k, v)
             data = arr
+        else:
+            ref_cats = self.reference.pandas_categorical \
+                if self.reference is not None else None
+            data, fn_auto, catf, pcats = _coerce_data(
+                data, self.categorical_feature, category_maps=ref_cats)
+            if self.feature_name in ("auto", None) and fn_auto:
+                self.feature_name = fn_auto
+            self.categorical_feature = catf
+            self.pandas_categorical = pcats
         fn = None if self.feature_name in ("auto", None) else list(self.feature_name)
         cat = None if self.categorical_feature in ("auto", None) else \
             list(self.categorical_feature)
@@ -196,17 +293,20 @@ class Booster:
         self._gbdt = None
         self._loaded: Optional[Dict[str, Any]] = None
         self.train_set = train_set
+        self.pandas_categorical: Optional[list] = None
         if model_file is not None:
             with open(model_file) as f:
                 model_str = f.read()
         if model_str is not None:
             self._loaded = parse_model_string(model_str)
+            self.pandas_categorical = self._loaded.get("pandas_categorical")
             return
         if train_set is None:
             log.fatal("Booster requires train_set or a model to load")
         train_set.params = {**train_set.params, **{
             k: v for k, v in self.params.items()}}
         train_set.construct()
+        self.pandas_categorical = train_set.pandas_categorical
         cfg = Config(self.params)
         self._cfg = cfg
         self._gbdt = create_boosting(cfg, train_set.inner)
@@ -282,7 +382,13 @@ class Booster:
                                     raw_score, pred_leaf, early)
 
     def _to_matrix(self, data: Any) -> np.ndarray:
-        if hasattr(data, "to_numpy"):
+        if hasattr(data, "column_names") and hasattr(data, "to_pandas"):
+            data = data.to_pandas()  # pyarrow Table
+        if hasattr(data, "columns") and hasattr(data, "dtypes"):
+            # pandas: categorical columns convert through the TRAINING
+            # category lists (reference pandas_categorical round-trip)
+            data, _, _ = _convert_pandas_categorical(
+                data, stored=self.pandas_categorical)
             return data.to_numpy(dtype=np.float64, na_value=np.nan)
         if hasattr(data, "toarray"):
             return np.asarray(data.toarray(), np.float64)
@@ -423,7 +529,8 @@ class Booster:
                 num_tree_per_iteration=d["num_tree_per_iteration"],
                 max_feature_idx=d["max_feature_idx"],
                 objective_str=d["objective"], feature_names=d["feature_names"],
-                feature_infos=d["feature_infos"], params={})
+                feature_infos=d["feature_infos"], params={},
+                pandas_categorical=self.pandas_categorical)
         g = self._gbdt
         ds = g.train_set
         k = g.num_tree_per_iteration
@@ -450,7 +557,8 @@ class Booster:
             trees, num_class=g.num_class, num_tree_per_iteration=k,
             max_feature_idx=ds.num_total_features - 1, objective_str=obj_str,
             feature_names=ds.feature_names, feature_infos=feature_infos,
-            params=g.config._explicit)
+            params=g.config._explicit,
+            pandas_categorical=self.pandas_categorical)
 
     def save_model(self, filename: str, num_iteration: Optional[int] = None,
                    start_iteration: int = 0, **kwargs) -> "Booster":

@@ -275,8 +275,20 @@ class BinMapper:
         return -1
 
     def values_to_bins(self, values: np.ndarray) -> np.ndarray:
-        """Vectorized ValueToBin (reference bin.h / bin.cpp)."""
+        """Vectorized ValueToBin (reference bin.h / bin.cpp); numerical
+        columns route through the native C++ kernel when built."""
         values = np.asarray(values, dtype=np.float64)
+        if self.bin_type != BIN_CATEGORICAL and len(values) >= 65536:
+            try:
+                from ..native import apply_bins_numerical
+                nb = self.num_bin - 1 if self.missing_type == MISSING_NAN \
+                    else -1
+                return apply_bins_numerical(
+                    values, np.asarray(self.bin_upper_bound),
+                    self.missing_type, nb,
+                    self.default_bin).astype(np.int32)
+            except ImportError:
+                pass
         if self.bin_type == BIN_CATEGORICAL:
             out = np.zeros(len(values), dtype=np.int32)
             isnan = np.isnan(values)

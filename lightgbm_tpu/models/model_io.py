@@ -70,7 +70,8 @@ def model_to_string(trees: List[Tree], *, num_class: int,
                     num_tree_per_iteration: int, max_feature_idx: int,
                     objective_str: str, feature_names: List[str],
                     feature_infos: List[str], params: Dict[str, Any],
-                    label_index: int = 0) -> str:
+                    label_index: int = 0,
+                    pandas_categorical: Optional[list] = None) -> str:
     """Assemble the full model file (gbdt_model_text.cpp SaveModelToString)."""
     header = [
         "tree",
@@ -101,9 +102,12 @@ def model_to_string(trees: List[Tree], *, num_class: int,
     for fi in order:
         if imp[fi] > 0:
             imp_lines.append(f"{feature_names[fi]}={int(imp[fi])}")
+    # pandas category lists ride the model file as trailing JSON, exactly
+    # like the reference python package (basic.py pandas_categorical)
+    pc_json = json.dumps(pandas_categorical) if pandas_categorical else "null"
     trailer = "\n".join(imp_lines) + "\n\nparameters:\n" + "\n".join(
         f"[{k}: {_fmt_param(v)}]" for k, v in params.items()) + \
-        "\nend of parameters\n\npandas_categorical:null\n"
+        f"\nend of parameters\n\npandas_categorical:{pc_json}\n"
     return "\n".join(header) + "\n" + body + "\nend of trees\n\n" + trailer
 
 
@@ -146,6 +150,13 @@ def parse_model_string(text: str) -> Dict[str, Any]:
             if line.startswith("[") and ": " in line:
                 k, v = line[1:-1].split(": ", 1)
                 params[k] = v
+    pandas_categorical = None
+    if "\npandas_categorical:" in text:
+        pc_line = text.rsplit("\npandas_categorical:", 1)[1].splitlines()[0]
+        try:
+            pandas_categorical = json.loads(pc_line)
+        except (json.JSONDecodeError, ValueError):
+            pandas_categorical = None
     return {
         "trees": trees,
         "num_class": int(meta.get("num_class", 1)),
@@ -155,6 +166,7 @@ def parse_model_string(text: str) -> Dict[str, Any]:
         "feature_names": feature_names,
         "feature_infos": meta.get("feature_infos", "").split(" "),
         "params": params,
+        "pandas_categorical": pandas_categorical,
     }
 
 

@@ -1,0 +1,101 @@
+"""Native (C++) host data-plane, loaded via ctypes.
+
+The reference's hot host paths — text parsing and value->bin pushing — are
+C++ (reference: src/io/parser.cpp, src/io/dataset_loader.cpp, bin.h
+ValueToBin); this package compiles the equivalent ``fast_parser.cpp`` on
+first use with the system g++ (no pip/pybind11 dependency) and exposes:
+
+  * ``parse_text(path, sep, skip_header) -> np.ndarray [rows, cols] f64``
+  * ``apply_bins_numerical(col, uppers, missing_type, nan_bin, default_bin)``
+
+Import raises ImportError when no compiler/library is available; callers
+(io/parser.py, io/binning.py) fall back to the NumPy path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "fast_parser.cpp")
+_LIB = os.path.join(_DIR, "libfastparser.so")
+_lock = threading.Lock()
+_lib = None
+
+
+def _build() -> None:
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           _SRC, "-o", _LIB + ".tmp"]
+    subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    os.replace(_LIB + ".tmp", _LIB)
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        stale = (not os.path.exists(_LIB)
+                 or os.path.getmtime(_LIB) < os.path.getmtime(_SRC))
+        if stale:
+            try:
+                _build()
+            except (OSError, subprocess.SubprocessError) as e:
+                raise ImportError(f"native build failed: {e}") from e
+        lib = ctypes.CDLL(_LIB)
+        lib.lgbtpu_parse_delim.restype = ctypes.c_int
+        lib.lgbtpu_parse_delim.argtypes = [
+            ctypes.c_char_p, ctypes.c_char, ctypes.c_int,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64)]
+        lib.lgbtpu_free.argtypes = [ctypes.c_void_p]
+        lib.lgbtpu_apply_bins.argtypes = [
+            ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_double), ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_uint8)]
+        _lib = lib
+        return lib
+
+
+def parse_text(path: str, sep: str = ",", skip_header: int = 0) -> np.ndarray:
+    """Parse a delimited numeric file natively -> f64 [rows, cols]."""
+    lib = _load()
+    out = ctypes.POINTER(ctypes.c_double)()
+    rows = ctypes.c_int64()
+    cols = ctypes.c_int64()
+    rc = lib.lgbtpu_parse_delim(path.encode(), sep.encode(),
+                                int(skip_header), ctypes.byref(out),
+                                ctypes.byref(rows), ctypes.byref(cols))
+    if rc != 0:
+        raise OSError(f"native parse of {path} failed (rc={rc})")
+    try:
+        if rows.value == 0:
+            return np.zeros((0, 0))
+        arr = np.ctypeslib.as_array(out, shape=(rows.value, cols.value)).copy()
+    finally:
+        lib.lgbtpu_free(out)
+    return arr
+
+
+def apply_bins_numerical(col: np.ndarray, uppers: np.ndarray,
+                         missing_type: int, nan_bin: int,
+                         default_bin: int) -> np.ndarray:
+    """Native ValueToBin for one numerical feature column -> uint8 bins."""
+    lib = _load()
+    col = np.ascontiguousarray(col, dtype=np.float64)
+    uppers = np.ascontiguousarray(uppers, dtype=np.float64)
+    out = np.empty(len(col), dtype=np.uint8)
+    lib.lgbtpu_apply_bins(
+        col.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), len(col),
+        uppers.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), len(uppers),
+        int(missing_type), int(nan_bin), int(default_bin),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    return out

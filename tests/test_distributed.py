@@ -1,0 +1,83 @@
+"""Multi-process distributed training test (reference
+tests/distributed/_test_distributed.py DistributedMockup: N real processes
+on localhost, row-sharded data, assert accuracy and identical models)."""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+N_PROC = 2
+
+WORKER = textwrap.dedent("""
+    import os, sys
+    import numpy as np
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    sys.path.insert(0, "/root/repo")
+    from lightgbm_tpu.parallel import launcher
+
+    rank = int(os.environ["LGBTPU_RANK"])
+    machines = os.environ["LGBTPU_MACHINES"]
+    outdir = os.environ["LGBTPU_OUT"]
+    launcher.initialize(machines=machines)
+
+    rng = np.random.default_rng(123)  # same stream on both ranks
+    n, f = 4000, 8
+    X = rng.normal(size=(n, f))
+    w = rng.normal(size=f)
+    y = ((X @ w) > 0).astype(np.float64)
+    lo, hi = rank * n // 2, (rank + 1) * n // 2  # row shard for this rank
+
+    bst = launcher.train_multihost(
+        {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 5,
+         "verbose": -1}, X[lo:hi], y[lo:hi], num_boost_round=10)
+    preds = bst.predict(X)
+    acc = float(((preds > 0.5) == y).mean())
+    bst.save_model(f"{outdir}/model_rank{rank}.txt")
+    np.save(f"{outdir}/preds_rank{rank}.npy", preds)
+    print(f"rank {rank} acc {acc:.4f}")
+    assert acc > 0.85, acc
+""")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.slow
+def test_two_process_data_parallel(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    port = _free_port()
+    machines = f"127.0.0.1:{port},127.0.0.1:{_free_port()}"
+    procs = []
+    for rank in range(N_PROC):
+        env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+        env.update(LGBTPU_RANK=str(rank), LGBTPU_MACHINES=machines,
+                   LGBTPU_OUT=str(tmp_path))
+        procs.append(subprocess.Popen([sys.executable, str(script)],
+                                      env=env, stdout=subprocess.PIPE,
+                                      stderr=subprocess.STDOUT, text=True))
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=600)
+        outs.append(out)
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out[-3000:]
+
+    # all ranks produce the same model and the same predictions
+    m0 = (tmp_path / "model_rank0.txt").read_text()
+    m1 = (tmp_path / "model_rank1.txt").read_text()
+    assert m0 == m1
+    p0 = np.load(tmp_path / "preds_rank0.npy")
+    p1 = np.load(tmp_path / "preds_rank1.npy")
+    np.testing.assert_allclose(p0, p1, atol=1e-12)
