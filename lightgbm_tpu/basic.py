@@ -116,6 +116,16 @@ def _coerce_data(data: Any, categorical_feature, category_maps=None):
             categorical_feature, pandas_categorical)
 
 
+def _is_binary_dataset(path) -> bool:
+    """True when ``path`` is a lightgbm_tpu binary dataset (npz with our
+    marker — the analogue of the reference's binary-file magic check)."""
+    try:
+        with np.load(str(path), allow_pickle=False) as z:
+            return "lgbtpu_dataset" in z
+    except (OSError, ValueError):
+        return False
+
+
 def _margin_reached(out: np.ndarray, margin: float) -> np.ndarray:
     """Per-row early-termination test (reference
     prediction_early_stop.cpp — binary: 2*|raw|, multiclass: top-2 gap)."""
@@ -167,6 +177,28 @@ class Dataset:
             params = {**self.reference.params, **params}
         cfg = Config(params)
         data = self.data
+        if isinstance(data, (str, os.PathLike)) and _is_binary_dataset(data):
+            # binned binary dataset (reference LGBM_DatasetCreateFromFile on
+            # a save_binary file): skips parsing AND binning entirely;
+            # constructor-supplied metadata overrides what the file carries
+            self._inner = _InnerDataset.load_binary(str(data), cfg)
+            md = self._inner.metadata
+            if self.label is not None:
+                md.set_label(self.label)
+            if self.weight is not None:
+                md.set_weight(self.weight)
+            if self.group is not None:
+                md.set_group(self.group)
+            if self.init_score is not None:
+                md.set_init_score(self.init_score)
+            if self.position is not None:
+                md.set_position(self.position)
+            if self._predictor is not None:
+                log.fatal("init_model continuation requires raw data; "
+                          "binary datasets store only binned values")
+            if self.free_raw_data:
+                self.data = None
+            return self
         if isinstance(data, (str, os.PathLike)):
             arr, label, meta = load_text_file(str(data), cfg)
             if self.label is None:
@@ -210,6 +242,14 @@ class Dataset:
 
     def create_valid(self, data, label=None, **kwargs) -> "Dataset":
         return Dataset(data, label=label, reference=self, **kwargs)
+
+    def save_binary(self, filename: str) -> "Dataset":
+        """Write the BINNED dataset to disk (reference
+        Dataset.save_binary -> LGBM_DatasetSaveBinary c_api.h:516); loading
+        it back skips parsing and binning."""
+        self.construct()
+        self._inner.save_binary(str(filename))
+        return self
 
     def _apply_predictor(self, predictor: Optional["Booster"]) -> None:
         """Set the continuation predictor (reference basic.py:2576

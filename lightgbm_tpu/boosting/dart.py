@@ -32,11 +32,13 @@ class DART(GBDT):
         score tensors."""
         arrs = _tree_to_arrays_stub(tree, self.train_set, exclude_bias=True)
         # self.bins may carry distributed-mode padding rows/columns
-        contrib = predict_bins_tree(arrs, self.bins, self.nan_bin_arr,
-                                    self.bundle)[:self.train_set.num_data]
+        contrib = predict_bins_tree(
+            arrs, self.bins, self.nan_bin_arr, self.bundle,
+            self.hp.has_categorical)[:self.train_set.num_data]
         self.scores = self.scores.at[:, cls_idx].add(contrib * factor)
         for vi in range(len(self.valid_sets)):
-            vc = predict_bins_tree(arrs, self._valid_bins[vi], self.nan_bin_arr, self.bundle)
+            vc = predict_bins_tree(arrs, self._valid_bins[vi], self.nan_bin_arr,
+                                   self.bundle, self.hp.has_categorical)
             self.valid_scores[vi] = \
                 self.valid_scores[vi].at[:, cls_idx].add(vc * factor)
 

@@ -27,7 +27,7 @@ import numpy as np
 
 from ..config import Config
 from ..io.dataset import Dataset
-from ..learner.grower import DeviceBundle, TreeArrays, grow_tree
+from ..learner.grower import CegbInput, DeviceBundle, TreeArrays, grow_tree
 from ..learner.linear import fit_linear_leaves, linear_leaf_scores
 from ..metrics import Metric, create_metrics
 from ..models.predict import predict_bins_leaf, predict_bins_tree
@@ -60,6 +60,7 @@ def _hp_from_config(cfg: Config, n_bins: int) -> SplitHyper:
         rows_per_block=int(cfg.tpu_rows_per_block),
         path_smooth=float(cfg.path_smooth),
         hist_dtype=str(cfg.tpu_hist_dtype),
+        leaf_hist=str(cfg.tpu_leaf_hist),
         extra_trees=bool(cfg.extra_trees),
         feature_fraction_bynode=float(cfg.feature_fraction_bynode),
     )
@@ -258,6 +259,33 @@ class GBDT:
         self.raw_dev = jnp.asarray(train_set.raw) if self.linear else None
         self._valid_raw: List[Optional[jnp.ndarray]] = []
 
+        # CEGB penalties (cost_effective_gradient_boosting.hpp): acquisition
+        # state persists across ALL trees like the reference learner's
+        self.cegb: Optional[CegbInput] = None
+        if (float(config.cegb_penalty_split) > 0.0
+                or list(config.cegb_penalty_feature_lazy or [])
+                or list(config.cegb_penalty_feature_coupled or [])):
+            if self.parallel_mode is not None:
+                log.fatal("cegb_* penalties are supported with "
+                          "tree_learner=serial only")
+            tr = float(config.cegb_tradeoff)
+
+            def _vec(lst):
+                full = np.zeros(train_set.num_total_features, np.float64)
+                a = np.asarray(list(lst or []), np.float64)
+                full[:len(a)] = a[:train_set.num_total_features]
+                return full[np.asarray(train_set.used_feature_idx)] * tr
+
+            lazy = _vec(config.cegb_penalty_feature_lazy)
+            self.cegb = CegbInput(
+                split_pen=jnp.float32(tr * float(config.cegb_penalty_split)),
+                coupled_pen=jnp.asarray(
+                    _vec(config.cegb_penalty_feature_coupled), jnp.float32),
+                lazy_pen=jnp.asarray(lazy, jnp.float32),
+                feature_used=jnp.zeros(self.num_features, bool),
+                used_rows=jnp.zeros((train_set.num_data, self.num_features),
+                                    bool) if (lazy != 0).any() else None)
+
         n = train_set.num_data
         k = self.num_tree_per_iteration
         self.scores = jnp.zeros((n, k), jnp.float32)
@@ -418,7 +446,8 @@ class GBDT:
                     self.shrinkage_rate * contrib)
                 for vi in range(len(self.valid_sets)):
                     leaf_v = predict_bins_leaf(arrays, self._valid_bins[vi],
-                                               self.nan_bin_arr, self.bundle)
+                                               self.nan_bin_arr, self.bundle,
+                                               self.hp.has_categorical)
                     vraw = self._valid_raw[vi]
                     vc = linear_leaf_scores(vraw, leaf_v, const, coeff,
                                             arrays.leaf_value) \
@@ -434,7 +463,8 @@ class GBDT:
                 for vi in range(len(self.valid_sets)):
                     contrib = predict_bins_tree(arrays_shrunk,
                                                 self._valid_bins[vi],
-                                                self.nan_bin_arr, self.bundle)
+                                                self.nan_bin_arr, self.bundle,
+                                                self.hp.has_categorical)
                     self.valid_scores[vi] = \
                         self.valid_scores[vi].at[:, cls_idx].add(contrib)
             tree = Tree.from_arrays(arrays, self.train_set)
@@ -456,12 +486,16 @@ class GBDT:
         shard_map-distributed mode; reference CreateTreeLearner
         tree_learner.cpp:15)."""
         if self.parallel_mode is None:
-            return grow_tree(
-                self.bins, g, h, row_mask, self.num_bins_arr,
-                self.nan_bin_arr, self.is_cat_arr, feature_mask, self.hp,
-                monotone=self.monotone_arr, rng_key=node_key,
-                interaction_sets=self.interaction_sets,
-                forced=self.forced_splits, bundle=self.bundle)
+            args = (self.bins, g, h, row_mask, self.num_bins_arr,
+                    self.nan_bin_arr, self.is_cat_arr, feature_mask, self.hp)
+            kwargs = dict(monotone=self.monotone_arr, rng_key=node_key,
+                          interaction_sets=self.interaction_sets,
+                          forced=self.forced_splits, bundle=self.bundle)
+            if self.cegb is not None:
+                arrays, lor, self.cegb = grow_tree(*args, cegb=self.cegb,
+                                                   **kwargs)
+                return arrays, lor
+            return grow_tree(*args, **kwargs)
         if self.parallel_mode == "feature":
             from ..parallel.feature_parallel import grow_tree_feature_parallel
             if feature_mask is not None and self._pad_cols:
@@ -601,8 +635,8 @@ class GBDT:
             tree = self.models.pop()
             contrib = predict_bins_tree(
                 _tree_to_arrays_stub(tree, self.train_set, exclude_bias=True),
-                self.bins, self.nan_bin_arr,
-                self.bundle)[:self.train_set.num_data]
+                self.bins, self.nan_bin_arr, self.bundle,
+                self.hp.has_categorical)[:self.train_set.num_data]
             self.scores = self.scores.at[:, c].add(-contrib)
         self.iter_ -= 1
 

@@ -340,6 +340,8 @@ class BinMapper:
             "bin_upper_bound": [float(x) for x in self.bin_upper_bound],
             "bin_2_categorical": list(self.bin_2_categorical),
             "default_bin": self.default_bin,
+            "min_val": self.min_val,
+            "max_val": self.max_val,
         }
 
     @classmethod
@@ -352,4 +354,6 @@ class BinMapper:
         m.bin_2_categorical = [int(c) for c in d.get("bin_2_categorical", [])]
         m._cat_2_bin = {c: i for i, c in enumerate(m.bin_2_categorical)}
         m.default_bin = int(d.get("default_bin", 0))
+        m.min_val = float(d.get("min_val", 0.0))
+        m.max_val = float(d.get("max_val", 0.0))
         return m

@@ -305,6 +305,85 @@ class Dataset:
         """Convert a learner bin threshold to the real-valued model threshold."""
         return self.mappers[self.used_feature_idx[packed_feature]].bin_to_value(bin_thr)
 
+    # ------------------------------------------------------- binary format
+    def save_binary(self, path: str) -> None:
+        """Persist the BINNED dataset so the expensive binning/EFB pass is
+        checkpointable (reference Dataset::SaveBinaryFile /
+        LGBM_DatasetSaveBinary c_api.h:516).  Format: npz with a marker
+        entry, the packed bin matrix, JSON-serialized mappers and the
+        bundle plan."""
+        import json
+        mappers_json = json.dumps([m.to_dict() for m in self.mappers])
+        md = self.metadata
+        extra: Dict[str, Any] = {}
+        if md.weight is not None:
+            extra["weight"] = md.weight
+        if md.query_boundaries is not None:
+            extra["query_boundaries"] = md.query_boundaries
+        if md.init_score is not None:
+            extra["init_score"] = md.init_score
+        if md.position is not None:
+            extra["position"] = md.position
+        if self.raw is not None:
+            extra["raw"] = self.raw
+        if self.bundle_plan is not None:
+            p = self.bundle_plan
+            extra["bundle_json"] = json.dumps(p.bundles)
+            extra["bundle_feat_col"] = p.feat_col
+            extra["bundle_src_idx"] = p.src_idx
+            extra["bundle_valid"] = p.valid
+            extra["bundle_default_bin"] = p.default_bin
+            extra["bundle_inv_table"] = p.inv_table
+        with open(path, "wb") as fh:  # keep the exact name (np appends .npz)
+            np.savez_compressed(
+                fh, lgbtpu_dataset=np.int32(1), bins=self.bins,
+                label=md.label, mappers_json=mappers_json,
+                used_feature_idx=np.asarray(self.used_feature_idx, np.int64),
+                num_total_features=np.int64(self.num_total_features),
+                feature_names=np.asarray(self.feature_names, dtype=object),
+                **extra)
+
+    @classmethod
+    def load_binary(cls, path: str, config: Optional[Config] = None
+                    ) -> "Dataset":
+        """Load a dataset written by :meth:`save_binary`."""
+        import json
+        from .binning import BinMapper
+        z = np.load(path, allow_pickle=True)
+        if "lgbtpu_dataset" not in z:
+            log.fatal(f"{path} is not a lightgbm_tpu binary dataset")
+        ds = cls()
+        ds.config = config or Config()
+        ds.bins = z["bins"]
+        ds.used_feature_idx = [int(i) for i in z["used_feature_idx"]]
+        ds.num_total_features = int(z["num_total_features"])
+        ds.feature_names = [str(s) for s in z["feature_names"]]
+        ds.mappers = [BinMapper.from_dict(d)
+                      for d in json.loads(str(z["mappers_json"]))]
+        ds.metadata = Metadata(ds.bins.shape[0])
+        ds.metadata.set_label(z["label"])
+        if "weight" in z:
+            ds.metadata.set_weight(z["weight"])
+        if "query_boundaries" in z:
+            ds.metadata.query_boundaries = z["query_boundaries"]
+        if "init_score" in z:
+            ds.metadata.set_init_score(z["init_score"])
+        if "position" in z:
+            ds.metadata.set_position(z["position"])
+        if "raw" in z:
+            ds.raw = z["raw"]
+        if "bundle_json" in z:
+            from .bundling import BundlePlan
+            bundles = json.loads(str(z["bundle_json"]))
+            ds.bundle_plan = BundlePlan(
+                bundles=bundles,
+                feat_col=z["bundle_feat_col"],
+                src_idx=z["bundle_src_idx"], valid=z["bundle_valid"],
+                default_bin=z["bundle_default_bin"],
+                inv_table=z["bundle_inv_table"],
+                num_bundles=len(bundles))
+        return ds
+
 
 def _resolve_categorical(categorical_feature: Optional[Sequence[Union[int, str]]],
                          feature_names: List[str]) -> List[int]:

@@ -37,7 +37,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..ops.histogram import histogram_for_leaf_bucketed, root_histogram
+from ..ops.histogram import (histogram_for_leaf_bucketed,
+                             histogram_for_leaf_masked, root_histogram)
 from ..ops.split import (NEG_INF, VAR_CAT_BWD, VAR_CAT_FWD, VAR_CAT_ONEHOT,
                          VAR_NUM_RIGHT, SplitHyper, SplitResult,
                          categorical_left_bitset, find_best_split, leaf_gain,
@@ -88,12 +89,14 @@ def _expand_hist_col(hcol: jax.Array, bundle: DeviceBundle,
     return hv.at[bundle.default_bin[feat]].add(total - rest)
 
 
-def _feature_bin_of_rows(bins: jax.Array, bundle: Optional[DeviceBundle],
+def _feature_bin_of_rows(bins_t: jax.Array, bundle: Optional[DeviceBundle],
                          feat: jax.Array) -> jax.Array:
-    """Virtual bin of every row for feature ``feat`` (partition step)."""
+    """Virtual bin of every row for feature ``feat`` (partition step).
+    ``bins_t`` is the TRANSPOSED [F, n] matrix so the dynamic column access
+    is one contiguous row read, not an n-element strided gather."""
     if bundle is None:
-        return jnp.take(bins, feat, axis=1).astype(jnp.int32)
-    col = jnp.take(bins, bundle.feat_col[feat], axis=1).astype(jnp.int32)
+        return jnp.take(bins_t, feat, axis=0).astype(jnp.int32)
+    col = jnp.take(bins_t, bundle.feat_col[feat], axis=0).astype(jnp.int32)
     return bundle.inv_table[feat, col]
 
 
@@ -115,6 +118,17 @@ class TreeArrays(NamedTuple):
     leaf_depth: jax.Array      # i32 [L]
     leaf_path: jax.Array       # bool [L, F] features on each leaf's path
     num_leaves: jax.Array      # i32 scalar — actual leaves grown
+
+
+class CegbInput(NamedTuple):
+    """Cost-Effective Gradient Boosting penalties + acquisition state
+    (reference cost_effective_gradient_boosting.hpp): all pre-multiplied by
+    cegb_tradeoff.  ``used_rows`` is None unless lazy penalties are set."""
+    split_pen: jax.Array       # f32 scalar — cegb_penalty_split
+    coupled_pen: jax.Array     # f32 [F] — once-per-feature penalty
+    lazy_pen: jax.Array        # f32 [F] — per-(row,feature) penalty
+    feature_used: jax.Array    # bool [F] — features already in the model
+    used_rows: Optional[jax.Array]  # bool [n, F] — (row, feature) acquired
 
 
 class _GrowState(NamedTuple):
@@ -140,6 +154,8 @@ class _GrowState(NamedTuple):
     path_feats: jax.Array      # bool [L, F] features used on leaf's path
     force_failed: jax.Array    # bool scalar — forced-split BFS aborted
     done: jax.Array            # bool scalar
+    cegb_used: jax.Array       # bool [F] (dummy [1] when CEGB off)
+    cegb_rows: jax.Array       # bool [n, F] (dummy [1, 1] when off/no lazy)
 
 
 def _empty_tree(num_leaves: int, n_bins: int, num_f: int) -> TreeArrays:
@@ -190,8 +206,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
               forced: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None,
               bundle: Optional[DeviceBundle] = None,
               parallel_mode: str = "data", top_k: int = 20,
-              num_shards: int = 1
-              ) -> Tuple[TreeArrays, jax.Array]:
+              num_shards: int = 1,
+              cegb: Optional[CegbInput] = None):
     """Grow one tree; returns (TreeArrays, leaf_of_row).
 
     bins: uint8 [n, F]; grad/hess: f32 [n]; row_mask: bool [n] or None
@@ -241,6 +257,22 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         assert bundle is None and forced is None and monotone is None \
             and interaction_sets is None, \
             "feature-parallel composes only with the core split path"
+    if cegb is not None:
+        assert axis_name is None or mode == "data", \
+            "CEGB composes with serial/data-parallel modes only"
+
+    def cegb_penalty(used_f, used_rows, leaf_mask, leaf_count):
+        """Per-feature gain penalty for one leaf (CEGB DeltaGain:
+        split_pen scales with the leaf's data count)."""
+        pen = cegb.split_pen * leaf_count \
+            + jnp.where(used_f, 0.0, cegb.coupled_pen)
+        if cegb.used_rows is not None:
+            cnt = jnp.einsum("n,nf->f", leaf_mask.astype(jnp.float32),
+                             (~used_rows).astype(jnp.float32))
+            if axis_name is not None:
+                cnt = lax.psum(cnt, axis_name)
+            pen = pen + cegb.lazy_pen * cnt
+        return pen
     # axis passed to histogram builders: only the data mode psums full hists
     hist_axis = axis_name if mode == "data" else None
 
@@ -265,7 +297,13 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             m = base & (u >= kth) & (u >= 0)
         return m
 
-    hist0_b = root_histogram(bins, grad, hess, row_mask, n_bins=hp.n_bins,
+    # transposed layout once per tree: the histogram kernel and the
+    # partition column reads both want rows on the minor (lane) dimension.
+    # optimization_barrier forces ONE materialization — without it XLA
+    # rematerializes the 28-byte-strided transpose inside every split
+    # iteration (measured 2.5x on the whole tree loop)
+    bins_t = lax.optimization_barrier(bins.T)
+    hist0_b = root_histogram(bins_t, grad, hess, row_mask, n_bins=hp.n_bins,
                              rows_per_block=hp.rows_per_block,
                              hist_dtype=hp.hist_dtype, axis_name=hist_axis)
     g0 = jnp.sum(grad * mask_f)
@@ -285,7 +323,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             min_sum_hessian_in_leaf=hp.min_sum_hessian_in_leaf / num_shards)
 
     def child_best(h_phys, g_, h_, c_, depth, fm, parent_output, lmin, lmax,
-                   key) -> SplitResult:
+                   key, pen=None) -> SplitResult:
         """Best split for one leaf from its PHYSICAL (bundle-column)
         histogram — local shard hist under voting/feature modes, global
         otherwise.  Returns a SplitResult whose ``feature`` is the virtual
@@ -352,10 +390,13 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                 right_sum_g=b[9], right_sum_h=b[10], right_count=b[11])
         hv = h_phys if bundle is None else \
             _expand_hist(h_phys, bundle, g_, h_, c_)
-        return _child_best(hv, g_, h_, c_, depth, num_bins, nan_bin, is_cat,
-                           fm, hp, monotone=monotone,
-                           parent_output=parent_output, leaf_min=lmin,
-                           leaf_max=lmax, rng_key=key)
+        res = find_best_split(hv, g_, h_, c_, num_bins, nan_bin, is_cat,
+                              fm, hp, monotone=monotone,
+                              parent_output=parent_output, leaf_min=lmin,
+                              leaf_max=lmax, depth=depth, rng_key=key,
+                              gain_penalty=pen)
+        depth_ok = (hp.max_depth <= 0) | (depth < hp.max_depth)
+        return res._replace(gain=jnp.where(depth_ok, res.gain, NEG_INF))
 
     root_out = leaf_output(g0, h0, hp.lambda_l1, hp.lambda_l2,
                            hp.max_delta_step)
@@ -366,8 +407,17 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     else:
         key_root = key_er = None
     fm_root = node_feature_mask(empty_path, key_root)
+    if cegb is not None:
+        cegb_used0 = cegb.feature_used
+        cegb_rows0 = cegb.used_rows if cegb.used_rows is not None \
+            else jnp.zeros((1, 1), bool)
+        pen0 = cegb_penalty(cegb_used0, cegb_rows0, mask_f, c0)
+    else:
+        cegb_used0 = jnp.zeros((1,), bool)
+        cegb_rows0 = jnp.zeros((1, 1), bool)
+        pen0 = None
     best0 = child_best(hist0_b, g0, h0, c0, jnp.int32(0), fm_root,
-                       root_out, -inf, inf, key_er)
+                       root_out, -inf, inf, key_er, pen=pen0)
 
     tree = _empty_tree(L, hp.n_bins, num_f)
     tree = tree._replace(
@@ -401,6 +451,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         path_feats=jnp.zeros((L, num_f), bool),
         force_failed=jnp.bool_(False),
         done=jnp.bool_(False),
+        cegb_used=cegb_used0,
+        cegb_rows=cegb_rows0,
     )
 
     def body(i, st: _GrowState) -> _GrowState:
@@ -535,10 +587,13 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             # vector is broadcast (the reference instead re-splits from the
             # synced SplitInfo since every rank holds all features' data —
             # here columns are truly sharded, so one [n] psum replaces it)
-            col = _feature_bin_of_rows(bins, bundle, f_safe)
+            col = _feature_bin_of_rows(bins_t, bundle, f_safe)
             nb = nan_bin[f_safe]
             go_left_num = jnp.where(col == nb, dl, col <= thr)
-            go_left = jnp.where(catl, bitset[col], go_left_num)
+            # bitset[col] is an n-row table gather — skip it entirely on
+            # all-numeric datasets (gathers are the slowest TPU primitive)
+            go_left = jnp.where(catl, bitset[col], go_left_num) \
+                if hp.has_categorical else go_left_num
             if mode == "feature" and axis_name is not None:
                 go_left = lax.psum(
                     jnp.where(owns, go_left.astype(jnp.float32), 0.0),
@@ -583,11 +638,17 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             # -- histogram: data pass over ONLY the smaller child's rows
             # (bucketed gather), subtract for the sibling
             smaller = jnp.where(lcn <= rcn, bl, new_leaf)
-            h_small = histogram_for_leaf_bucketed(
-                bins, grad, hess, leaf_of_row, smaller,
-                jnp.minimum(lcn, rcn), row_mask,
-                n_bins=hp.n_bins, rows_per_block=hp.rows_per_block,
-                hist_dtype=hp.hist_dtype, axis_name=hist_axis)
+            if hp.leaf_hist == "masked":
+                h_small = histogram_for_leaf_masked(
+                    bins_t, grad, hess, leaf_of_row, smaller, row_mask,
+                    n_bins=hp.n_bins, rows_per_block=hp.rows_per_block,
+                    hist_dtype=hp.hist_dtype, axis_name=hist_axis)
+            else:
+                h_small = histogram_for_leaf_bucketed(
+                    bins, grad, hess, leaf_of_row, smaller,
+                    jnp.minimum(lcn, rcn), row_mask,
+                    n_bins=hp.n_bins, rows_per_block=hp.rows_per_block,
+                    hist_dtype=hp.hist_dtype, axis_name=hist_axis)
             h_parent = st.hist[bl]
             h_large = h_parent - h_small
             left_small = lcn <= rcn
@@ -611,10 +672,28 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                 k_l = k_r = k_el = k_er2 = None
             fm_l = node_feature_mask(child_path, k_l)
             fm_r = node_feature_mask(child_path, k_r)
+            if cegb is not None:
+                # this split acquires `feat` for the whole parent leaf
+                cegb_used = st.cegb_used.at[feat].set(True)
+                if cegb.used_rows is not None:
+                    in_parent = active  # rows of the just-split leaf
+                    cegb_rows = st.cegb_rows | (
+                        in_parent[:, None]
+                        & (lax.iota(jnp.int32, num_f)[None, :] == feat))
+                else:
+                    cegb_rows = st.cegb_rows
+                pen_l = cegb_penalty(cegb_used, cegb_rows,
+                                     (leaf_of_row == bl) & (mask_f > 0), lcn)
+                pen_r = cegb_penalty(cegb_used, cegb_rows,
+                                     (leaf_of_row == new_leaf) & (mask_f > 0),
+                                     rcn)
+            else:
+                cegb_used, cegb_rows = st.cegb_used, st.cegb_rows
+                pen_l = pen_r = None
             bs_l = child_best(h_left, lg, lh, lcn, d, fm_l, lo, lmin_l,
-                              lmax_l, k_el)
+                              lmax_l, k_el, pen=pen_l)
             bs_r = child_best(h_right, rg, rh, rcn, d, fm_r, ro, lmin_r,
-                              lmax_r, k_er2)
+                              lmax_r, k_er2, pen=pen_r)
 
             return st._replace(
                 tree=t,
@@ -647,10 +726,17 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                 leaf_max=st.leaf_max.at[bl].set(lmax_l).at[new_leaf].set(lmax_r),
                 path_feats=st.path_feats.at[bl].set(child_path)
                                         .at[new_leaf].set(child_path),
+                cegb_used=cegb_used,
+                cegb_rows=cegb_rows,
             )
 
         return lax.cond(do, split, no_split, st)
 
     state = lax.fori_loop(0, L - 1, body, state)
     tree_out = state.tree._replace(leaf_path=state.path_feats)
+    if cegb is not None:
+        new_cegb = cegb._replace(
+            feature_used=state.cegb_used,
+            used_rows=None if cegb.used_rows is None else state.cegb_rows)
+        return tree_out, state.leaf_of_row, new_cegb
     return tree_out, state.leaf_of_row

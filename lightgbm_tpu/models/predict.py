@@ -11,6 +11,8 @@ tree costs depth × O(n) gathers instead of per-row branching.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -18,22 +20,26 @@ from jax import lax
 from ..learner.grower import TreeArrays
 
 
-@jax.jit
+@functools.partial(jax.jit, static_argnames=("has_categorical",))
 def predict_bins_tree(tree: TreeArrays, bins: jax.Array,
-                      nan_bin: jax.Array, bundle=None) -> jax.Array:
+                      nan_bin: jax.Array, bundle=None,
+                      has_categorical: bool = True) -> jax.Array:
     """Leaf VALUE per row for one device tree over binned features.
 
     tree: TreeArrays (packed feature indices, bin thresholds);
     bins: uint8 [n, F]; nan_bin: i32 [F]; bundle: optional EFB tables
     (learner/grower.py DeviceBundle) when ``bins`` is bundled.
+    ``has_categorical=False`` skips the per-row cat-bitset table gather
+    (the slowest TPU primitive) on all-numeric models.
     """
-    leaf = predict_bins_leaf(tree, bins, nan_bin, bundle)
+    leaf = predict_bins_leaf(tree, bins, nan_bin, bundle, has_categorical)
     return tree.leaf_value[leaf]
 
 
-@jax.jit
+@functools.partial(jax.jit, static_argnames=("has_categorical",))
 def predict_bins_leaf(tree: TreeArrays, bins: jax.Array,
-                      nan_bin: jax.Array, bundle=None) -> jax.Array:
+                      nan_bin: jax.Array, bundle=None,
+                      has_categorical: bool = True) -> jax.Array:
     n = bins.shape[0]
     rows = lax.iota(jnp.int32, n)
     node0 = jnp.zeros((n,), jnp.int32)
@@ -54,9 +60,11 @@ def predict_bins_leaf(tree: TreeArrays, bins: jax.Array,
             phys = bins[rows, bundle.feat_col[feat]].astype(jnp.int32)
             col = bundle.inv_table[feat, phys]
         nb = nan_bin[feat]
-        cat_left = tree.cat_bitset[safe, col]
-        go_left = jnp.where(col == nb, dl,
-                            jnp.where(cat, cat_left, col <= thr))
+        go_num = col <= thr
+        if has_categorical:
+            cat_left = tree.cat_bitset[safe, col]
+            go_num = jnp.where(cat, cat_left, go_num)
+        go_left = jnp.where(col == nb, dl, go_num)
         nxt = jnp.where(go_left, tree.left_child[safe], tree.right_child[safe])
         return jnp.where(active, nxt, node)
 

@@ -56,12 +56,26 @@ def histogram_rows(bins: jax.Array, vals: jax.Array, *, n_bins: int,
     bins: uint8 [S, F]; vals: f32 [S, C] (masked rows zero).
     Returns f32 [F, n_bins, C].
     """
+    return histogram_rows_t(bins.T, vals.T, n_bins=n_bins,
+                            rows_per_block=rows_per_block,
+                            hist_dtype=hist_dtype)
+
+
+def histogram_rows_t(bins_t: jax.Array, vals_t: jax.Array, *, n_bins: int,
+                     rows_per_block: int = 4096,
+                     hist_dtype: str = "float32") -> jax.Array:
+    """Histogram from TRANSPOSED operands — the layout the TPU kernel wants
+    (row dim on lanes).  Callers on the hot path keep ``bins_t`` [F, n]
+    resident so no per-call 28-byte-strided transpose happens.
+
+    bins_t: uint8 [F, S]; vals_t: f32 [C, S].  Returns f32 [F, n_bins, C].
+    """
     if use_pallas():
         from .hist_pallas import histogram_pallas
-        return histogram_pallas(bins.T, vals.T, n_bins=n_bins,
+        return histogram_pallas(bins_t, vals_t, n_bins=n_bins,
                                 rows_per_block=min(rows_per_block, 2048),
                                 compute_dtype=jnp.dtype(hist_dtype).type)
-    return build_histogram(bins, vals, n_bins=n_bins,
+    return build_histogram(bins_t.T, vals_t.T, n_bins=n_bins,
                            rows_per_block=rows_per_block)
 
 
@@ -106,6 +120,33 @@ def build_histogram(bins: jax.Array, vals: jax.Array, *, n_bins: int = 256,
     acc0 = jnp.zeros((f_pad, n_bins, c), dtype=jnp.float32)
     hist, _ = lax.scan(block_step, acc0, (bins_b, vals_b))
     return hist[:num_feat]
+
+
+def histogram_for_leaf_masked(bins_t: jax.Array, grad: jax.Array,
+                              hess: jax.Array, leaf_of_row: jax.Array,
+                              leaf: jax.Array,
+                              row_mask: Optional[jax.Array] = None, *,
+                              n_bins: int = 256, rows_per_block: int = 4096,
+                              hist_dtype: str = "float32",
+                              axis_name: Optional[str] = None) -> jax.Array:
+    """Leaf histogram by masking: one full-data pass with non-leaf rows
+    zeroed.  O(n) per call but with NO compaction machinery — on TPU the
+    histogram kernel is one-hot-construction bound, so this flat cost beats
+    the gather path except for very small leaves (the nonzero compaction
+    itself costs a full O(n) cumsum+scatter, which is already ~the masked
+    pass).  ``bins_t`` is the TRANSPOSED [F, n] matrix."""
+    m = (leaf_of_row == leaf)
+    if row_mask is not None:
+        m = m & row_mask
+    mf = m.astype(grad.dtype)
+    vals_t = jnp.stack([grad * mf, hess * mf, mf, jnp.zeros_like(mf)],
+                       axis=0)
+    hist = histogram_rows_t(bins_t, vals_t, n_bins=n_bins,
+                            rows_per_block=rows_per_block,
+                            hist_dtype=hist_dtype)
+    if axis_name is not None:
+        hist = lax.psum(hist, axis_name)
+    return hist
 
 
 def histogram_for_leaf_bucketed(bins: jax.Array, grad: jax.Array,
@@ -171,16 +212,17 @@ def histogram_for_leaf_bucketed(bins: jax.Array, grad: jax.Array,
     return hist
 
 
-def root_histogram(bins: jax.Array, grad: jax.Array, hess: jax.Array,
+def root_histogram(bins_t: jax.Array, grad: jax.Array, hess: jax.Array,
                    row_mask: Optional[jax.Array] = None, *,
                    n_bins: int = 256, rows_per_block: int = 4096,
                    hist_dtype: str = "float32",
                    axis_name: Optional[str] = None) -> jax.Array:
+    """Root histogram from the TRANSPOSED [F, n] bin matrix."""
     m = jnp.ones_like(grad) if row_mask is None else row_mask.astype(grad.dtype)
-    vals = jnp.stack([grad * m, hess * m, m, jnp.zeros_like(m)], axis=1)
-    hist = histogram_rows(bins, vals, n_bins=n_bins,
-                          rows_per_block=rows_per_block,
-                          hist_dtype=hist_dtype)
+    vals_t = jnp.stack([grad * m, hess * m, m, jnp.zeros_like(m)], axis=0)
+    hist = histogram_rows_t(bins_t, vals_t, n_bins=n_bins,
+                            rows_per_block=rows_per_block,
+                            hist_dtype=hist_dtype)
     if axis_name is not None:
         hist = lax.psum(hist, axis_name)
     return hist

@@ -65,6 +65,11 @@ class SplitHyper:
     rows_per_block: int = 4096
     path_smooth: float = 0.0
     hist_dtype: str = "float32"   # MXU contraction dtype; "bfloat16" opts into 8x MXU rate
+    # per-leaf histogram strategy: "masked" = flat full-data pass with
+    # non-leaf rows zeroed (no compaction; TPU-friendly), "bucketed" =
+    # nonzero+gather into power-of-two buckets (wins only when leaves are
+    # tiny relative to n AND gathers are cheap)
+    leaf_hist: str = "masked"
 
 
 #: candidate-variant indices along the last axis of the gain tensor
@@ -143,7 +148,8 @@ def find_best_split(hist: jax.Array, sum_g: jax.Array, sum_h: jax.Array,
                     leaf_min=None, leaf_max=None,
                     depth=None,
                     rng_key: Optional[jax.Array] = None,
-                    per_feature_out: Optional[list] = None) -> SplitResult:
+                    per_feature_out: Optional[list] = None,
+                    gain_penalty: Optional[jax.Array] = None) -> SplitResult:
     """Pick the best (feature, threshold, default-dir) for one leaf.
 
     hist: f32 [F, B, C>=3] (grad, hess, count); sum_g/sum_h/count: leaf totals.
@@ -304,6 +310,11 @@ def find_best_split(hist: jax.Array, sum_g: jax.Array, sum_h: jax.Array,
                      axis=-1)                                  # [F, B, V]
     if feature_mask is not None:
         cand = jnp.where(feature_mask[:, None, None], cand, NEG_INF)
+    if gain_penalty is not None:
+        # CEGB: per-feature acquisition cost subtracted from the split gain
+        # before the argmax (cost_effective_gradient_boosting.hpp DeltaGain)
+        cand = jnp.where(cand > NEG_INF / 2,
+                         cand - gain_penalty[:, None, None], cand)
 
     if per_feature_out is not None:
         # voting-parallel hook: per-feature best gain before the global

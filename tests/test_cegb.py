@@ -1,0 +1,67 @@
+"""Cost-Effective Gradient Boosting tests (reference
+cost_effective_gradient_boosting.hpp; reference test strategy:
+test_engine.py test_cegb)."""
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+FAST = {"num_leaves": 15, "min_data_in_leaf": 5, "verbose": -1,
+        "enable_bundle": False}
+
+
+def _data(seed=0):
+    rng = np.random.default_rng(seed)
+    n = 2000
+    X = rng.normal(size=(n, 6))
+    # features 0 and 1 are equally informative duplicates
+    X[:, 1] = X[:, 0] + rng.normal(scale=0.01, size=n)
+    y = ((X[:, 0] + 0.5 * X[:, 2]) > 0).astype(np.float64)
+    return X, y
+
+
+def test_cegb_coupled_penalty_steers_feature_choice():
+    """A large coupled penalty on feature 0 makes the model use its
+    duplicate (feature 1) instead."""
+    X, y = _data()
+    p0 = {**FAST, "objective": "binary"}
+    b0 = lgb.train(p0, lgb.Dataset(X, label=y, params=p0), num_boost_round=8)
+    imp0 = b0.feature_importance()
+    assert imp0[0] > 0  # baseline uses feature 0
+
+    p1 = {**FAST, "objective": "binary", "cegb_tradeoff": 1.0,
+          "cegb_penalty_feature_coupled": [1e6, 0, 0, 0, 0, 0]}
+    b1 = lgb.train(p1, lgb.Dataset(X, label=y, params=p1), num_boost_round=8)
+    imp1 = b1.feature_importance()
+    assert imp1[0] == 0          # feature 0 priced out
+    assert imp1[1] > 0           # duplicate takes over
+    acc = float(((b1.predict(X) > 0.5) == y).mean())
+    assert acc > 0.9             # quality survives
+
+
+def test_cegb_split_penalty_prunes():
+    """cegb_penalty_split makes low-gain splits unprofitable -> fewer
+    leaves than the unpenalized model."""
+    X, y = _data(seed=3)
+    p0 = {**FAST, "objective": "binary"}
+    b0 = lgb.train(p0, lgb.Dataset(X, label=y, params=p0), num_boost_round=5)
+    n_leaves0 = sum(t["num_leaves"] for t in b0.dump_model()["tree_info"])
+
+    p1 = {**FAST, "objective": "binary", "cegb_tradeoff": 1.0,
+          "cegb_penalty_split": 0.05}  # x num_data_in_leaf (DeltaGain)
+    b1 = lgb.train(p1, lgb.Dataset(X, label=y, params=p1), num_boost_round=5)
+    n_leaves1 = sum(t["num_leaves"] for t in b1.dump_model()["tree_info"])
+    assert n_leaves1 < n_leaves0
+
+
+def test_cegb_lazy_penalty_trains():
+    """Lazy per-(row, feature) penalties run end-to-end and decay once rows
+    have acquired a feature (second tree reuses feature 0 cheaply)."""
+    X, y = _data(seed=5)
+    p = {**FAST, "objective": "binary", "cegb_tradeoff": 1.0,
+         "cegb_penalty_feature_lazy": [0.01] * 6}
+    b = lgb.train(p, lgb.Dataset(X, label=y, params=p), num_boost_round=6)
+    acc = float(((b.predict(X) > 0.5) == y).mean())
+    assert acc > 0.9
+    assert b._gbdt.cegb.used_rows is not None
+    assert bool(np.asarray(b._gbdt.cegb.feature_used).any())

