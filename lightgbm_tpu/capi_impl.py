@@ -1,0 +1,117 @@
+"""Python side of the C ABI (consumed by native/capi.cpp).
+
+The reference exposes its C++ core through ~100 ``LGBM_*`` C functions
+(reference: src/c_api.cpp, include/LightGBM/c_api.h) that every language
+binding consumes.  Here the runtime core is this package, so the C ABI is a
+thin native shim (native/capi.cpp) that embeds CPython and dispatches to
+the functions below; handles are integer ids into a registry.  Buffers
+cross the boundary as raw addresses wrapped with numpy — no copies on the
+input side.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import itertools
+import json
+from typing import Any, Dict
+
+import numpy as np
+
+_handles: Dict[int, Any] = {}
+_next_id = itertools.count(1)
+
+
+def _new_handle(obj: Any) -> int:
+    h = next(_next_id)
+    _handles[h] = obj
+    return h
+
+
+def _arr_f64(ptr: int, n: int) -> np.ndarray:
+    return np.ctypeslib.as_array(
+        ctypes.cast(ptr, ctypes.POINTER(ctypes.c_double)), shape=(n,))
+
+
+def dataset_from_mat(data_ptr: int, nrow: int, ncol: int, label_ptr: int,
+                     params_json: str) -> int:
+    """LGBM_DatasetCreateFromMat (c_api.h:409) equivalent."""
+    import lightgbm_tpu as lgb
+    data = _arr_f64(data_ptr, nrow * ncol).reshape(nrow, ncol).copy()
+    label = _arr_f64(label_ptr, nrow).copy() if label_ptr else None
+    params = json.loads(params_json) if params_json else {}
+    ds = lgb.Dataset(data, label=label, params=params)
+    ds.construct()
+    return _new_handle(ds)
+
+
+def dataset_set_field(ds_id: int, field: str, ptr: int, n: int) -> None:
+    """LGBM_DatasetSetField equivalent (weight/init_score/group/position)."""
+    ds = _handles[ds_id]
+    vals = _arr_f64(ptr, n).copy()
+    if field == "weight":
+        ds.set_weight(vals)
+    elif field == "group":
+        ds.set_group(vals.astype(np.int64))
+    elif field == "label":
+        ds.set_label(vals)
+    elif field == "init_score":
+        ds.set_init_score(vals)
+    elif field == "position":
+        ds.position = vals.astype(np.int32)
+    else:
+        raise ValueError(f"unknown field {field}")
+
+
+def booster_create(ds_id: int, params_json: str) -> int:
+    """LGBM_BoosterCreate (c_api.h:656) equivalent."""
+    import lightgbm_tpu as lgb
+    params = json.loads(params_json) if params_json else {}
+    return _new_handle(lgb.Booster(params=params, train_set=_handles[ds_id]))
+
+
+def booster_create_from_modelfile(path: str) -> int:
+    """LGBM_BoosterCreateFromModelfile equivalent."""
+    import lightgbm_tpu as lgb
+    return _new_handle(lgb.Booster(model_file=path))
+
+
+def booster_update_one_iter(b_id: int) -> int:
+    """LGBM_BoosterUpdateOneIter (c_api.h:765): returns 1 when finished."""
+    return 1 if _handles[b_id].update() else 0
+
+
+def booster_predict_for_mat(b_id: int, data_ptr: int, nrow: int, ncol: int,
+                            raw_score: int, out_ptr: int,
+                            out_capacity: int) -> int:
+    """LGBM_BoosterPredictForMat (c_api.h:1281): writes into out_ptr
+    (capacity checked — multiclass needs nrow * num_class doubles),
+    returns the number of doubles written."""
+    data = _arr_f64(data_ptr, nrow * ncol).reshape(nrow, ncol)
+    preds = np.asarray(_handles[b_id].predict(data,
+                                              raw_score=bool(raw_score)),
+                       np.float64).reshape(-1)
+    if preds.size > out_capacity:
+        raise ValueError(
+            f"prediction needs {preds.size} doubles but the out buffer "
+            f"holds {out_capacity}; allocate nrow * num_class "
+            f"(LGBMTPU_BoosterNumClasses)")
+    out = _arr_f64(out_ptr, preds.size)
+    out[:] = preds
+    return int(preds.size)
+
+
+def booster_save_model(b_id: int, path: str) -> None:
+    _handles[b_id].save_model(path)
+
+
+def booster_num_trees(b_id: int) -> int:
+    return int(_handles[b_id].num_trees())
+
+
+def booster_num_classes(b_id: int) -> int:
+    return int(_handles[b_id].num_model_per_iteration())
+
+
+def free_handle(h: int) -> None:
+    _handles.pop(h, None)

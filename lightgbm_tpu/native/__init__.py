@@ -28,27 +28,33 @@ _lock = threading.Lock()
 _lib = None
 
 
-def _build() -> None:
-    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-           _SRC, "-o", _LIB + ".tmp"]
-    subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-    os.replace(_LIB + ".tmp", _LIB)
+def _compile_if_stale(src: str, lib_path: str, extra_flags, timeout: int
+                      ) -> None:
+    """Serialized stale-check + compile-to-tmp + atomic replace (shared by
+    the parser lib and the C ABI lib).  The tmp name embeds the pid so
+    concurrent builders (pytest-xdist workers) can't corrupt each other."""
+    with _lock:
+        if os.path.exists(lib_path) and \
+                os.path.getmtime(lib_path) >= os.path.getmtime(src):
+            return
+        tmp = f"{lib_path}.{os.getpid()}.tmp"
+        cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", src,
+               *extra_flags, "-o", tmp]
+        subprocess.run(cmd, check=True, capture_output=True, timeout=timeout)
+        os.replace(tmp, lib_path)
 
 
 def _load() -> ctypes.CDLL:
     global _lib
     if _lib is not None:
         return _lib
+    try:
+        _compile_if_stale(_SRC, _LIB, ["-O3", "-pthread"], 120)
+    except (OSError, subprocess.SubprocessError) as e:
+        raise ImportError(f"native build failed: {e}") from e
     with _lock:
         if _lib is not None:
             return _lib
-        stale = (not os.path.exists(_LIB)
-                 or os.path.getmtime(_LIB) < os.path.getmtime(_SRC))
-        if stale:
-            try:
-                _build()
-            except (OSError, subprocess.SubprocessError) as e:
-                raise ImportError(f"native build failed: {e}") from e
         lib = ctypes.CDLL(_LIB)
         lib.lgbtpu_parse_delim.restype = ctypes.c_int
         lib.lgbtpu_parse_delim.argtypes = [
@@ -63,6 +69,24 @@ def _load() -> ctypes.CDLL:
             ctypes.POINTER(ctypes.c_uint8)]
         _lib = lib
         return lib
+
+
+_CAPI_SRC = os.path.join(_DIR, "capi.cpp")
+_CAPI_LIB = os.path.join(_DIR, "liblgbtpu_capi.so")
+
+
+def build_capi() -> str:
+    """Build the embedded-CPython C ABI library (capi.cpp) and return its
+    path.  Consumers link it like the reference's lib_lightgbm."""
+    import sysconfig
+    inc = sysconfig.get_path("include")
+    libdir = sysconfig.get_config_var("LIBDIR")
+    pyver = sysconfig.get_config_var("LDVERSION")
+    _compile_if_stale(
+        _CAPI_SRC, _CAPI_LIB,
+        [f"-I{inc}", f"-L{libdir}", f"-Wl,-rpath,{libdir}",
+         f"-lpython{pyver}"], 180)
+    return _CAPI_LIB
 
 
 def parse_text(path: str, sep: str = ",", skip_header: int = 0) -> np.ndarray:

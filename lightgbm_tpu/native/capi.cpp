@@ -1,0 +1,232 @@
+// C ABI for lightgbm_tpu — the counterpart of the reference's LGBM_* C API
+// (reference: src/c_api.cpp, include/LightGBM/c_api.h).  The reference's C
+// API fronts a C++ core; here the core is the JAX/XLA framework, so this
+// shim embeds CPython and dispatches to lightgbm_tpu/capi_impl.py.  Any
+// C/C++/C#/Java consumer links this .so exactly like the reference's
+// lib_lightgbm.
+//
+// Conventions follow the reference ABI: every function returns 0 on success
+// and -1 on failure, with LGBMTPU_GetLastError() returning the message.
+//
+// Build (native/__init__.py build_capi): g++ -O2 -shared -fPIC capi.cpp
+//   $(python3-config --includes --embed --ldflags) -o liblgbtpu_capi.so
+
+#include <Python.h>
+
+#include <cstdint>
+#include <mutex>
+#include <string>
+
+namespace {
+
+// thread-local so the pointer returned by GetLastError stays valid while
+// other threads fail (the reference ABI does the same)
+thread_local std::string g_last_error;
+PyThreadState* g_main_state = nullptr;
+
+void SetError(const std::string& msg) { g_last_error = msg; }
+
+// Initialize the embedded interpreter once; release the GIL afterwards so
+// API calls can come from any thread (each call re-acquires it).
+bool EnsurePython() {
+  static std::once_flag once;
+  static bool ok = false;
+  std::call_once(once, [] {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      g_main_state = PyEval_SaveThread();
+    }
+    ok = true;
+  });
+  return ok;
+}
+
+// Call lightgbm_tpu.capi_impl.<fn>(args...); returns new ref or nullptr.
+PyObject* CallImpl(const char* fn, PyObject* args) {
+  PyObject* mod = PyImport_ImportModule("lightgbm_tpu.capi_impl");
+  if (!mod) return nullptr;
+  PyObject* f = PyObject_GetAttrString(mod, fn);
+  Py_DECREF(mod);
+  if (!f) return nullptr;
+  PyObject* r = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  return r;
+}
+
+std::string FetchPyError() {
+  PyObject *type, *value, *tb;
+  PyErr_Fetch(&type, &value, &tb);
+  std::string msg = "unknown python error";
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      const char* u = PyUnicode_AsUTF8(s);
+      if (u) msg = u;
+      else PyErr_Clear();  // undecodable message; keep the fallback
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  return msg;
+}
+
+// Run fn under the GIL; on python error captures the message, returns -1.
+template <typename F>
+int WithGIL(F&& body) {
+  if (!EnsurePython()) {
+    SetError("python initialization failed");
+    return -1;
+  }
+  PyGILState_STATE st = PyGILState_Ensure();
+  int rc = body();
+  if (rc != 0 && PyErr_Occurred()) {
+    SetError(FetchPyError());
+    PyErr_Clear();
+  }
+  PyGILState_Release(st);
+  return rc;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* LGBMTPU_GetLastError() { return g_last_error.c_str(); }
+
+int LGBMTPU_DatasetCreateFromMat(const double* data, int64_t nrow,
+                                 int64_t ncol, const double* label,
+                                 const char* params_json, int64_t* out) {
+  return WithGIL([&] {
+    PyObject* args = Py_BuildValue(
+        "(LLLLs)", (long long)(intptr_t)data, (long long)nrow,
+        (long long)ncol, (long long)(intptr_t)label,
+        params_json ? params_json : "{}");
+    PyObject* r = CallImpl("dataset_from_mat", args);
+    Py_XDECREF(args);
+    if (!r) return -1;
+    *out = PyLong_AsLongLong(r);
+    Py_DECREF(r);
+    return 0;
+  });
+}
+
+int LGBMTPU_DatasetSetField(int64_t dataset, const char* field,
+                            const double* vals, int64_t n) {
+  return WithGIL([&] {
+    PyObject* args = Py_BuildValue("(LsLL)", (long long)dataset, field,
+                                   (long long)(intptr_t)vals, (long long)n);
+    PyObject* r = CallImpl("dataset_set_field", args);
+    Py_XDECREF(args);
+    if (!r) return -1;
+    Py_DECREF(r);
+    return 0;
+  });
+}
+
+int LGBMTPU_BoosterCreate(int64_t dataset, const char* params_json,
+                          int64_t* out) {
+  return WithGIL([&] {
+    PyObject* args = Py_BuildValue("(Ls)", (long long)dataset,
+                                   params_json ? params_json : "{}");
+    PyObject* r = CallImpl("booster_create", args);
+    Py_XDECREF(args);
+    if (!r) return -1;
+    *out = PyLong_AsLongLong(r);
+    Py_DECREF(r);
+    return 0;
+  });
+}
+
+int LGBMTPU_BoosterCreateFromModelfile(const char* path, int64_t* out) {
+  return WithGIL([&] {
+    PyObject* args = Py_BuildValue("(s)", path);
+    PyObject* r = CallImpl("booster_create_from_modelfile", args);
+    Py_XDECREF(args);
+    if (!r) return -1;
+    *out = PyLong_AsLongLong(r);
+    Py_DECREF(r);
+    return 0;
+  });
+}
+
+int LGBMTPU_BoosterUpdateOneIter(int64_t booster, int* is_finished) {
+  return WithGIL([&] {
+    PyObject* args = Py_BuildValue("(L)", (long long)booster);
+    PyObject* r = CallImpl("booster_update_one_iter", args);
+    Py_XDECREF(args);
+    if (!r) return -1;
+    *is_finished = (int)PyLong_AsLong(r);
+    Py_DECREF(r);
+    return 0;
+  });
+}
+
+// ``out_len`` is in/out: pass the out buffer's capacity in doubles
+// (like the reference's out_len contract); the call fails rather than
+// overflow (multiclass writes nrow * num_class doubles).
+int LGBMTPU_BoosterPredictForMat(int64_t booster, const double* data,
+                                 int64_t nrow, int64_t ncol, int raw_score,
+                                 double* out, int64_t* out_len) {
+  return WithGIL([&] {
+    PyObject* args = Py_BuildValue(
+        "(LLLLiLL)", (long long)booster, (long long)(intptr_t)data,
+        (long long)nrow, (long long)ncol, raw_score,
+        (long long)(intptr_t)out, (long long)*out_len);
+    PyObject* r = CallImpl("booster_predict_for_mat", args);
+    Py_XDECREF(args);
+    if (!r) return -1;
+    *out_len = PyLong_AsLongLong(r);
+    Py_DECREF(r);
+    return 0;
+  });
+}
+
+int LGBMTPU_BoosterSaveModel(int64_t booster, const char* path) {
+  return WithGIL([&] {
+    PyObject* args = Py_BuildValue("(Ls)", (long long)booster, path);
+    PyObject* r = CallImpl("booster_save_model", args);
+    Py_XDECREF(args);
+    if (!r) return -1;
+    Py_DECREF(r);
+    return 0;
+  });
+}
+
+int LGBMTPU_BoosterNumClasses(int64_t booster, int* out) {
+  return WithGIL([&] {
+    PyObject* args = Py_BuildValue("(L)", (long long)booster);
+    PyObject* r = CallImpl("booster_num_classes", args);
+    Py_XDECREF(args);
+    if (!r) return -1;
+    *out = (int)PyLong_AsLong(r);
+    Py_DECREF(r);
+    return 0;
+  });
+}
+
+int LGBMTPU_BoosterNumTrees(int64_t booster, int* out) {
+  return WithGIL([&] {
+    PyObject* args = Py_BuildValue("(L)", (long long)booster);
+    PyObject* r = CallImpl("booster_num_trees", args);
+    Py_XDECREF(args);
+    if (!r) return -1;
+    *out = (int)PyLong_AsLong(r);
+    Py_DECREF(r);
+    return 0;
+  });
+}
+
+int LGBMTPU_FreeHandle(int64_t handle) {
+  return WithGIL([&] {
+    PyObject* args = Py_BuildValue("(L)", (long long)handle);
+    PyObject* r = CallImpl("free_handle", args);
+    Py_XDECREF(args);
+    if (!r) return -1;
+    Py_DECREF(r);
+    return 0;
+  });
+}
+
+}  // extern "C"

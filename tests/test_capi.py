@@ -1,0 +1,135 @@
+"""C ABI tests: a PURE C consumer program trains and predicts through
+liblgbtpu_capi.so (the analogue of the reference's tests/c_api_test)."""
+
+import os
+import subprocess
+import sys
+import sysconfig
+import textwrap
+
+import numpy as np
+import pytest
+
+try:
+    from lightgbm_tpu.native import build_capi
+    CAPI = build_capi()
+except Exception as e:  # no compiler / headers
+    CAPI = None
+    _err = str(e)
+
+pytestmark = pytest.mark.skipif(CAPI is None,
+                                reason="C API library unavailable")
+
+C_PROGRAM = r"""
+#include <stdio.h>
+#include <stdlib.h>
+#include <stdint.h>
+#include <math.h>
+
+extern const char* LGBMTPU_GetLastError(void);
+extern int LGBMTPU_DatasetCreateFromMat(const double*, int64_t, int64_t,
+                                        const double*, const char*, int64_t*);
+extern int LGBMTPU_BoosterCreate(int64_t, const char*, int64_t*);
+extern int LGBMTPU_BoosterUpdateOneIter(int64_t, int*);
+extern int LGBMTPU_BoosterPredictForMat(int64_t, const double*, int64_t,
+                                        int64_t, int, double*, int64_t*);
+extern int LGBMTPU_BoosterSaveModel(int64_t, const char*);
+extern int LGBMTPU_BoosterNumClasses(int64_t, int*);
+extern int LGBMTPU_BoosterCreateFromModelfile(const char*, int64_t*);
+extern int LGBMTPU_BoosterNumTrees(int64_t, int*);
+extern int LGBMTPU_FreeHandle(int64_t);
+
+#define CHECK(call) do { if ((call) != 0) { \
+  fprintf(stderr, "FAIL %s: %s\n", #call, LGBMTPU_GetLastError()); \
+  return 1; } } while (0)
+
+int main(int argc, char** argv) {
+  const int64_t n = 600, f = 4;
+  double* X = malloc(sizeof(double) * n * f);
+  double* y = malloc(sizeof(double) * n);
+  unsigned s = 42;
+  for (int64_t i = 0; i < n; ++i) {
+    double row_sum = 0.0;
+    for (int64_t j = 0; j < f; ++j) {
+      s = s * 1103515245u + 12345u;
+      double v = ((double)(s >> 8) / (1 << 24)) * 2.0 - 1.0;
+      X[i * f + j] = v;
+      row_sum += v;
+    }
+    y[i] = row_sum > 0.0 ? 1.0 : 0.0;
+  }
+
+  int64_t ds, bst;
+  CHECK(LGBMTPU_DatasetCreateFromMat(
+      X, n, f, y,
+      "{\"objective\":\"binary\",\"num_leaves\":7,"
+      "\"min_data_in_leaf\":5,\"verbose\":-1}", &ds));
+  CHECK(LGBMTPU_BoosterCreate(
+      ds, "{\"objective\":\"binary\",\"num_leaves\":7,"
+          "\"min_data_in_leaf\":5,\"verbose\":-1}", &bst));
+  int finished = 0;
+  for (int it = 0; it < 10 && !finished; ++it)
+    CHECK(LGBMTPU_BoosterUpdateOneIter(bst, &finished));
+  int n_trees = 0;
+  CHECK(LGBMTPU_BoosterNumTrees(bst, &n_trees));
+  if (n_trees < 5) { fprintf(stderr, "too few trees: %d\n", n_trees); return 1; }
+
+  int num_class = 0;
+  CHECK(LGBMTPU_BoosterNumClasses(bst, &num_class));
+  if (num_class != 1) { fprintf(stderr, "num_class %d\n", num_class); return 1; }
+  double* preds = malloc(sizeof(double) * n * num_class);
+  int64_t out_len = n * num_class;  /* in: capacity, out: written */
+  CHECK(LGBMTPU_BoosterPredictForMat(bst, X, n, f, 0, preds, &out_len));
+  int correct = 0;
+  for (int64_t i = 0; i < n; ++i)
+    if ((preds[i] > 0.5) == (y[i] > 0.5)) ++correct;
+  double acc = (double)correct / n;
+  printf("accuracy %.4f trees %d\n", acc, n_trees);
+  if (acc < 0.85) { fprintf(stderr, "bad accuracy\n"); return 1; }
+
+  CHECK(LGBMTPU_BoosterSaveModel(bst, argv[1]));
+  int64_t bst2;
+  CHECK(LGBMTPU_BoosterCreateFromModelfile(argv[1], &bst2));
+  double* preds2 = malloc(sizeof(double) * n);
+  out_len = n;
+  CHECK(LGBMTPU_BoosterPredictForMat(bst2, X, n, f, 0, preds2, &out_len));
+  /* capacity too small must FAIL, not overflow */
+  int64_t tiny = 3;
+  if (LGBMTPU_BoosterPredictForMat(bst2, X, n, f, 0, preds2, &tiny) == 0) {
+    fprintf(stderr, "undersized buffer not rejected\n");
+    return 1;
+  }
+  for (int64_t i = 0; i < n; ++i)
+    if (fabs(preds[i] - preds2[i]) > 1e-5) {
+      fprintf(stderr, "reload mismatch at %lld\n", (long long)i);
+      return 1;
+    }
+  CHECK(LGBMTPU_FreeHandle(bst2));
+  CHECK(LGBMTPU_FreeHandle(bst));
+  CHECK(LGBMTPU_FreeHandle(ds));
+  printf("C API OK\n");
+  return 0;
+}
+"""
+
+
+def test_c_consumer_end_to_end(tmp_path):
+    src = tmp_path / "consumer.c"
+    src.write_text(C_PROGRAM)
+    exe = tmp_path / "consumer"
+    libdir = sysconfig.get_config_var("LIBDIR")
+    subprocess.run(
+        ["gcc", "-O1", str(src), CAPI, f"-Wl,-rpath,{os.path.dirname(CAPI)}",
+         f"-Wl,-rpath,{libdir}", "-lm", "-o", str(exe)],
+        check=True, capture_output=True)
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+    import lightgbm_tpu
+    pkg_root = os.path.dirname(os.path.dirname(
+        os.path.abspath(lightgbm_tpu.__file__)))
+    env["PYTHONPATH"] = pkg_root
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run([str(exe), str(tmp_path / "model.txt")], env=env,
+                       capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stderr + r.stdout
+    assert "C API OK" in r.stdout
+    assert "accuracy" in r.stdout
