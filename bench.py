@@ -25,12 +25,15 @@ BENCH_ROWS = int(os.environ.get("BENCH_ROWS", 1_000_000))
 BENCH_ITERS = int(os.environ.get("BENCH_ITERS", 20))
 NUM_LEAVES = int(os.environ.get("BENCH_LEAVES", 255))
 MAX_BIN = int(os.environ.get("BENCH_BIN", 255))
+# splits per histogram pass (learner/batch_grower.py); 1 = strict leaf-wise
+SPLIT_BATCH = int(os.environ.get("BENCH_SPLIT_BATCH", 16))
 BASELINE_S_PER_ROW_ITER = 130.094 / (10_500_000 * 500)
 
 
 def main():
     import jax
     import jax.numpy as jnp
+    from lightgbm_tpu.learner.batch_grower import grow_tree_batched
     from lightgbm_tpu.learner.grower import grow_tree
     from lightgbm_tpu.ops.split import SplitHyper
 
@@ -63,8 +66,13 @@ def main():
         resp = -sign / (1.0 + jnp.exp(sign * scores))
         grad = resp
         hess = jnp.abs(resp) * (1.0 - jnp.abs(resp))
-        tree, leaf_of_row = grow_tree(bins_d, grad, hess, None, num_bins,
-                                      nan_bin, is_cat, None, hp)
+        if SPLIT_BATCH > 1:
+            tree, leaf_of_row = grow_tree_batched(
+                bins_d, grad, hess, None, num_bins, nan_bin, is_cat, None,
+                hp, batch=SPLIT_BATCH)
+        else:
+            tree, leaf_of_row = grow_tree(bins_d, grad, hess, None, num_bins,
+                                          nan_bin, is_cat, None, hp)
         return scores + 0.1 * tree.leaf_value[leaf_of_row]
 
     scores = jnp.zeros(n, jnp.float32)

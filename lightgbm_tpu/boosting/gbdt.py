@@ -488,6 +488,11 @@ class GBDT:
         if self.parallel_mode is None:
             args = (self.bins, g, h, row_mask, self.num_bins_arr,
                     self.nan_bin_arr, self.is_cat_arr, feature_mask, self.hp)
+            if self._use_batched_grower():
+                from ..learner.batch_grower import grow_tree_batched
+                return grow_tree_batched(
+                    *args, batch=int(self.config.tpu_split_batch),
+                    bundle=self.bundle)
             kwargs = dict(monotone=self.monotone_arr, rng_key=node_key,
                           interaction_sets=self.interaction_sets,
                           forced=self.forced_splits, bundle=self.bundle)
@@ -504,13 +509,20 @@ class GBDT:
                 self.mesh, self.bins, g, h, row_mask, self.num_bins_arr,
                 self.nan_bin_arr, self.is_cat_arr, feature_mask, self.hp)
             return arrays, lor
-        from ..parallel.data_parallel import grow_tree_sharded
+        from ..parallel.data_parallel import (grow_tree_batched_sharded,
+                                              grow_tree_sharded)
         p = self._pad_rows
         if p:
             g = jnp.pad(g, (0, p))
             h = jnp.pad(h, (0, p))
             row_mask = jnp.pad(jnp.ones(g.shape[0] - p, bool)
                                if row_mask is None else row_mask, (0, p))
+        if self.parallel_mode == "data" and self._use_batched_grower():
+            arrays, lor = grow_tree_batched_sharded(
+                self.mesh, self.bins, g, h, row_mask, self.num_bins_arr,
+                self.nan_bin_arr, self.is_cat_arr, feature_mask, self.hp,
+                batch=int(self.config.tpu_split_batch), bundle=self.bundle)
+            return arrays, (lor[:-p] if p else lor)
         arrays, lor = grow_tree_sharded(
             self.mesh, self.bins, g, h, row_mask, self.num_bins_arr,
             self.nan_bin_arr, self.is_cat_arr, feature_mask, self.hp,
@@ -519,6 +531,30 @@ class GBDT:
             rng_key=node_key, interaction_sets=self.interaction_sets,
             forced=self.forced_splits)
         return arrays, (lor[:-p] if p else lor)
+
+    def _use_batched_grower(self) -> bool:
+        """Batched split rounds (learner/batch_grower.py) when requested and
+        the tree uses only its supported feature set."""
+        if int(self.config.tpu_split_batch) <= 1:
+            return False
+        unsupported = (self.hp.has_categorical or self.monotone_arr is not None
+                       or self.interaction_sets is not None
+                       or self.forced_splits is not None
+                       or self.cegb is not None or self.hp.use_monotone
+                       or self.hp.extra_trees
+                       or self.hp.feature_fraction_bynode < 1.0
+                       or self.hp.path_smooth > 0.0 or self.linear
+                       or self.parallel_mode not in (None, "data"))
+        if unsupported:
+            if not getattr(self, "_warned_batch", False):
+                log.warning("tpu_split_batch > 1 ignored: categorical/"
+                            "monotone/forced/interaction/cegb/extra_trees/"
+                            "path_smooth/linear_tree and voting/feature "
+                            "parallel modes require the strict leaf-wise "
+                            "learner")
+                self._warned_batch = True
+            return False
+        return True
 
     def _renew_leaves(self, arrays: TreeArrays, leaf_of_row: jax.Array,
                       cls_idx: int) -> TreeArrays:

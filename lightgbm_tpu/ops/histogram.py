@@ -149,6 +149,44 @@ def histogram_for_leaf_masked(bins_t: jax.Array, grad: jax.Array,
     return hist
 
 
+def histogram_for_leaves_masked(bins_t: jax.Array, grad: jax.Array,
+                                hess: jax.Array, leaf_of_row: jax.Array,
+                                leaves: jax.Array,
+                                row_mask: Optional[jax.Array] = None, *,
+                                n_bins: int = 256,
+                                rows_per_block: int = 4096,
+                                hist_dtype: str = "float32",
+                                axis_name: Optional[str] = None
+                                ) -> jax.Array:
+    """Histograms of K leaves in ONE data pass -> f32 [K, F, B, C].
+
+    The one-hot construction (the TPU kernel's dominant cost) is built once
+    and contracted against K x C masked value channels, so K leaves cost
+    barely more than one — the enabler of batched split rounds
+    (learner/batch_grower.py).  Widening channels also fills the MXU's
+    sublane dimension (M = 4K instead of 4).  ``leaves``: i32 [K]; invalid
+    slots may repeat a leaf (their histograms are simply unused).
+    """
+    K = leaves.shape[0]
+    sel = leaf_of_row[None, :] == leaves[:, None]             # [K, n]
+    if row_mask is not None:
+        sel = sel & row_mask[None, :]
+    m = sel.astype(grad.dtype)
+    # channel layout [C, K, n] -> flatten to [C*K, n]
+    vals_t = jnp.stack([grad[None, :] * m, hess[None, :] * m, m,
+                        jnp.zeros_like(m)], axis=0)
+    C = vals_t.shape[0]
+    vals_t = vals_t.reshape(C * K, -1)
+    hist = histogram_rows_t(bins_t, vals_t, n_bins=n_bins,
+                            rows_per_block=rows_per_block,
+                            hist_dtype=hist_dtype)            # [F, B, C*K]
+    F, B = hist.shape[0], hist.shape[1]
+    hist = hist.reshape(F, B, C, K).transpose(3, 0, 1, 2)     # [K, F, B, C]
+    if axis_name is not None:
+        hist = lax.psum(hist, axis_name)
+    return hist
+
+
 def histogram_for_leaf_bucketed(bins: jax.Array, grad: jax.Array,
                                 hess: jax.Array, leaf_of_row: jax.Array,
                                 leaf: jax.Array, leaf_count: jax.Array,

@@ -132,3 +132,41 @@ def train_step_sharded(mesh: Mesh, bins: jax.Array, scores: jax.Array,
     fn = shard_map(local, mesh=mesh, in_specs=in_specs,
                    out_specs=out_specs, check_vma=False)
     return fn(bins, scores, label, row_mask, num_bins, nan_bin, is_cat)
+
+
+def grow_tree_batched_sharded(mesh: Mesh, bins: jax.Array, grad: jax.Array,
+                              hess: jax.Array,
+                              row_mask: Optional[jax.Array],
+                              num_bins: jax.Array, nan_bin: jax.Array,
+                              is_cat: jax.Array,
+                              feature_mask: Optional[jax.Array],
+                              hp: SplitHyper, batch: int,
+                              bundle=None) -> Tuple[TreeArrays, jax.Array]:
+    """Batched-round grower (learner/batch_grower.py) under the data mesh:
+    K splits per psum-ed widened histogram pass."""
+    from ..learner.batch_grower import grow_tree_batched
+
+    def rep(x):
+        return None if x is None else jax.tree.map(lambda _: P(), x)
+
+    in_specs = (
+        P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+        P(DATA_AXIS) if row_mask is not None else None,
+        P(), P(), P(),
+        P() if feature_mask is not None else None,
+        rep(bundle),
+    )
+    out_specs = (
+        jax.tree.map(lambda _: P(), TreeArrays(*[0] * len(TreeArrays._fields))),
+        P(DATA_AXIS),
+    )
+
+    def local(b, g, h, m, nb, nanb, cat, fm, bd):
+        return grow_tree_batched(b, g, h, m, nb, nanb, cat, fm, hp,
+                                 batch=batch, bundle=bd,
+                                 axis_name=DATA_AXIS)
+
+    fn = shard_map(local, mesh=mesh, in_specs=in_specs,
+                   out_specs=out_specs, check_vma=False)
+    return fn(bins, grad, hess, row_mask, num_bins, nan_bin, is_cat,
+              feature_mask, bundle)

@@ -1,0 +1,135 @@
+"""Batched-round grower tests (learner/batch_grower.py)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.learner.batch_grower import grow_tree_batched
+from lightgbm_tpu.learner.grower import grow_tree
+from lightgbm_tpu.ops.split import SplitHyper
+
+HP = SplitHyper(num_leaves=31, min_data_in_leaf=5, n_bins=64,
+                rows_per_block=2048)
+
+
+@pytest.fixture(scope="module")
+def problem():
+    rng = np.random.default_rng(7)
+    n, f = 6000, 10
+    bins = rng.integers(0, 63, size=(n, f)).astype(np.uint8)
+    logit = (bins[:, 0] / 32.0 - 1.0) + 0.6 * (bins[:, 1] > 40) \
+        - 0.4 * (bins[:, 2] < 20)
+    y = (logit + rng.normal(scale=0.4, size=n) > 0).astype(np.float32)
+    g = (1 / (1 + np.exp(-logit)) - y).astype(np.float32)
+    h = np.full(n, 0.25, np.float32)
+    nb = np.full(f, 63, np.int32)
+    nanb = np.full(f, -1, np.int32)
+    cat = np.zeros(f, bool)
+    return tuple(map(jnp.asarray, (bins, g, h, nb, nanb, cat)))
+
+
+def test_batch1_identical_to_strict(problem):
+    bins, g, h, nb, nanb, cat = problem
+    t0, lor0 = grow_tree(bins, g, h, None, nb, nanb, cat, None, HP)
+    t1, lor1 = grow_tree_batched(bins, g, h, None, nb, nanb, cat, None, HP,
+                                 batch=1)
+    assert int(t1.num_leaves) == int(t0.num_leaves)
+    np.testing.assert_array_equal(np.asarray(t1.split_feature),
+                                  np.asarray(t0.split_feature))
+    np.testing.assert_array_equal(np.asarray(t1.split_bin),
+                                  np.asarray(t0.split_bin))
+    np.testing.assert_allclose(np.asarray(t1.leaf_value),
+                               np.asarray(t0.leaf_value), atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(lor1), np.asarray(lor0))
+
+
+def test_batch8_consistent_tree(problem):
+    """batch=8 relaxes split ORDER, not split validity: the tree is full
+    size, partitions are consistent, and leaf stats match the row map."""
+    bins, g, h, nb, nanb, cat = problem
+    t, lor = grow_tree_batched(bins, g, h, None, nb, nanb, cat, None, HP,
+                               batch=8)
+    nl = int(t.num_leaves)
+    assert nl == HP.num_leaves
+    counts = np.bincount(np.asarray(lor), minlength=HP.num_leaves)
+    np.testing.assert_array_equal(counts[:nl],
+                                  np.asarray(t.leaf_count)[:nl].astype(int))
+    assert (counts[:nl] >= HP.min_data_in_leaf).all()
+
+
+@pytest.mark.parametrize("batch", [4, 8])
+def test_batched_training_quality(synthetic_binary, batch):
+    """End-to-end through params: same ballpark logloss as strict."""
+    X, y = synthetic_binary
+    p0 = {"objective": "binary", "num_leaves": 31, "min_data_in_leaf": 5,
+          "verbose": -1}
+    b0 = lgb.train(p0, lgb.Dataset(X, label=y, params=p0),
+                   num_boost_round=15)
+    p1 = {**p0, "tpu_split_batch": batch}
+    b1 = lgb.train(p1, lgb.Dataset(X, label=y, params=p1),
+                   num_boost_round=15)
+
+    def logloss(b):
+        pr = np.clip(b.predict(X), 1e-9, 1 - 1e-9)
+        return float(-np.mean(y * np.log(pr) + (1 - y) * np.log(1 - pr)))
+
+    l0, l1 = logloss(b0), logloss(b1)
+    assert l1 < l0 * 1.15 + 0.01
+
+
+def test_batched_narrow_frontier_completes():
+    """Chain-shaped trees (one positive-gain leaf per round) must still
+    reach num_leaves — the round loop runs until no progress, not a fixed
+    ceil((L-1)/K) budget."""
+    rng = np.random.default_rng(1)
+    n = 4096
+    # single informative monotone feature -> deep chain growth
+    x = np.sort(rng.normal(size=n))
+    bins = np.clip((np.searchsorted(np.quantile(x, np.linspace(0, 1, 63)[1:-1]), x)), 0, 62).astype(np.uint8)[:, None]
+    g = np.exp(x).astype(np.float32) - 1.0  # skewed gradients
+    h = np.ones(n, np.float32)
+    hp = SplitHyper(num_leaves=33, min_data_in_leaf=1, n_bins=64)
+    t, _ = grow_tree_batched(jnp.asarray(bins), jnp.asarray(g),
+                             jnp.asarray(h), None,
+                             jnp.asarray(np.array([63], np.int32)),
+                             jnp.asarray(np.array([-1], np.int32)),
+                             jnp.asarray(np.array([False])), None, hp,
+                             batch=16)
+    ts, _ = grow_tree(jnp.asarray(bins), jnp.asarray(g), jnp.asarray(h),
+                      None, jnp.asarray(np.array([63], np.int32)),
+                      jnp.asarray(np.array([-1], np.int32)),
+                      jnp.asarray(np.array([False])), None, hp)
+    assert int(t.num_leaves) == int(ts.num_leaves)
+
+
+def test_batched_data_parallel(synthetic_binary):
+    """tpu_split_batch composes with tree_learner=data over the mesh."""
+    X, y = synthetic_binary
+    p = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 5,
+         "verbose": -1, "tpu_split_batch": 8, "tree_learner": "data"}
+    b = lgb.train(p, lgb.Dataset(X, label=y, params=p), num_boost_round=10)
+    assert float(((b.predict(X) > 0.5) == y).mean()) > 0.9
+
+
+def test_batched_fallback_for_path_smooth(synthetic_binary):
+    """path_smooth routes through the strict learner (and still smooths)."""
+    X, y = synthetic_binary
+    p = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 5,
+         "verbose": -1, "tpu_split_batch": 8, "path_smooth": 5.0}
+    b = lgb.train(p, lgb.Dataset(X, label=y, params=p), num_boost_round=5)
+    assert not b._gbdt._use_batched_grower()
+    assert np.isfinite(b.predict(X)).all()
+
+
+def test_batched_fallback_for_categorical():
+    """Categorical data silently routes through the strict learner."""
+    rng = np.random.default_rng(0)
+    n = 1000
+    X = np.column_stack([rng.normal(size=n), rng.integers(0, 5, size=n)])
+    y = ((X[:, 0] > 0) ^ (X[:, 1] == 2)).astype(np.float64)
+    p = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 5,
+         "verbose": -1, "tpu_split_batch": 8, "categorical_feature": [1]}
+    b = lgb.train(p, lgb.Dataset(X, label=y, params=p), num_boost_round=10)
+    assert float(((b.predict(X) > 0.5) == y).mean()) > 0.9
