@@ -185,10 +185,20 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             st["best_gain"] = st["best_gain"].at[bl].set(
                 jnp.where(ok, NEG_INF, st["best_gain"][bl]))
 
-            col = _feature_bin_of_rows(bins_t, bundle, feat)
-            go_left = jnp.where(col == nan_bin[feat], dl, col <= thr)
-            active = ok & (lor == bl)
-            lor = jnp.where(active & ~go_left, nl, lor)
+        # ---- all K partitions in ONE widened pass (each row belongs to at
+        # most one split parent, so the K moves compose by summation)
+        feats_k = st["best_feat"][parents]                      # [K]
+        cols_k = jax.vmap(
+            lambda f: _feature_bin_of_rows(bins_t, bundle, f))(feats_k)
+        thr_k = st["best_thr"][parents][:, None]
+        dl_k = st["best_dl"][parents][:, None]
+        nanb_k = nan_bin[feats_k][:, None]
+        go_left_k = jnp.where(cols_k == nanb_k, dl_k, cols_k <= thr_k)
+        in_parent = (lor[None, :] == parents[:, None]) \
+            & valid[:, None]                                    # [K, n]
+        move = in_parent & ~go_left_k                           # [K, n]
+        target = jnp.sum(move * new_leaves[:, None], axis=0)    # [n]
+        lor = jnp.where(jnp.any(move, axis=0), target, lor)
 
         st["tree"] = t
         st["leaf_of_row"] = lor
