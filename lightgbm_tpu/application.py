@@ -108,6 +108,10 @@ def _run_train(cfg: Config, params: Dict[str, Any]) -> None:
                         init_model=init_model, callbacks=callbacks)
     booster.save_model(cfg.output_model)
     log.info(f"Finished training; model saved to {cfg.output_model}")
+    if int(cfg.verbosity) >= 2:
+        # reference USE_TIMETAG aggregate table at exit
+        from .utils.timer import global_timer
+        log.info("phase timings:\n" + global_timer.summary())
 
 
 def _run_predict(cfg: Config, params: Dict[str, Any]) -> None:

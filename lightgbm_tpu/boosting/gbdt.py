@@ -36,6 +36,7 @@ from ..objectives import ObjectiveFunction, create_objective
 from ..ops.quantize import discretize_gradients, renew_leaf_values
 from ..ops.split import SplitHyper
 from ..utils import log
+from ..utils.timer import global_timer
 from .sample_strategy import create_sample_strategy
 
 GradFn = Callable[[np.ndarray, Any], Tuple[np.ndarray, np.ndarray]]
@@ -144,6 +145,11 @@ class GBDT:
         for m in self.train_metrics:
             m.init(train_set.metadata, train_set.num_data)
 
+        # reference USE_TIMETAG phase table (utils/common.h Timer); set
+        # unconditionally so a later non-verbose run disables it again,
+        # and reset so the table covers only THIS training run
+        global_timer.enabled = int(config.verbosity) >= 2
+        global_timer.reset()
         self.num_class = max(1, int(config.num_class))
         self.num_tree_per_iteration = (
             self.objective.num_model_per_iteration
@@ -381,7 +387,8 @@ class GBDT:
         n = self.train_set.num_data
         k = self.num_tree_per_iteration
         if grad is None or hess is None:
-            g, h = self.boosting_gradients()
+            with global_timer.timer("boosting_gradients"):
+                g, h = self.boosting_gradients()
         else:
             g = jnp.asarray(np.asarray(grad, np.float32).reshape(n, k, order="F"))
             h = jnp.asarray(np.asarray(hess, np.float32).reshape(n, k, order="F"))
@@ -417,8 +424,10 @@ class GBDT:
                 node_key = jax.random.PRNGKey(
                     int(self.config.extra_seed) * 1000003
                     + self.iter_ * k + cls_idx)
-            arrays, leaf_of_row = self._grow(g[:, cls_idx], h[:, cls_idx],
-                                             row_mask, feature_mask, node_key)
+            with global_timer.timer("tree_growth"):
+                arrays, leaf_of_row = self._grow(g[:, cls_idx],
+                                                 h[:, cls_idx], row_mask,
+                                                 feature_mask, node_key)
             num_leaves = int(arrays.num_leaves)
             if num_leaves > 1:
                 finished = False
@@ -467,7 +476,8 @@ class GBDT:
                                                 self.hp.has_categorical)
                     self.valid_scores[vi] = \
                         self.valid_scores[vi].at[:, cls_idx].add(contrib)
-            tree = Tree.from_arrays(arrays, self.train_set)
+            with global_timer.timer("tree_finalize"):
+                tree = Tree.from_arrays(arrays, self.train_set)
             if lin is not None:
                 tree.set_linear(np.asarray(lin[0], np.float64),
                                 np.asarray(lin[1], np.float64),

@@ -18,6 +18,7 @@ from .basic import Booster, Dataset
 from .callback import CallbackEnv, EarlyStopException
 from .config import normalize_params
 from .utils import log
+from .utils.timer import global_timer
 
 
 def train(params: Dict[str, Any], train_set: Dataset,
@@ -70,9 +71,11 @@ def train(params: Dict[str, Any], train_set: Dataset,
             cb(CallbackEnv(booster, params, it, 0, num_boost_round, None))
         finished = booster.update(fobj=fobj)
         evals = []
-        if train_in_valid or booster._gbdt.config.is_provide_training_metric:
-            evals.extend(booster.eval_train())
-        evals.extend(booster.eval_valid())
+        with global_timer.timer("metric_eval"):
+            if train_in_valid or \
+                    booster._gbdt.config.is_provide_training_metric:
+                evals.extend(booster.eval_train())
+            evals.extend(booster.eval_valid())
         if feval is not None:
             evals.extend(_eval_custom(feval, booster, train_set, valid_pairs,
                                       train_in_valid))

@@ -1,0 +1,60 @@
+"""Phase timing (reference utils/common.h:973 ``Common::Timer`` /
+``FunctionTimer`` — RAII accumulation per named phase, aggregate table
+printed at exit when built with USE_TIMETAG).
+
+Here timing is always available and cheap: a global accumulator with a
+context manager, enabled per-run via ``Config.verbosity >= 2`` (the CLI
+prints the table after training) or programmatically via
+``global_timer.enable()``.  Device work is asynchronous under jit, so
+phases that end with a host sync (eval, metric reads) absorb queued device
+time — same caveat as any wall-clock profile of an async runtime; use
+``jax.profiler`` traces for kernel-level attribution.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import time
+from typing import Dict, Iterator
+
+
+class PhaseTimer:
+    def __init__(self) -> None:
+        self._acc: Dict[str, float] = collections.defaultdict(float)
+        self._count: Dict[str, int] = collections.defaultdict(int)
+        self.enabled = False
+
+    def enable(self) -> None:
+        self.enabled = True
+
+    def reset(self) -> None:
+        self._acc.clear()
+        self._count.clear()
+
+    @contextlib.contextmanager
+    def timer(self, name: str) -> Iterator[None]:
+        if not self.enabled:
+            yield
+            return
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._acc[name] += time.perf_counter() - t0
+            self._count[name] += 1
+
+    def summary(self) -> str:
+        if not self._acc:
+            return "no phases timed"
+        width = max(len(k) for k in self._acc)
+        lines = [f"{'phase'.ljust(width)}   total_s     calls   avg_ms"]
+        for name, total in sorted(self._acc.items(), key=lambda kv: -kv[1]):
+            c = self._count[name]
+            lines.append(f"{name.ljust(width)}  {total:8.3f}  {c:8d}  "
+                         f"{total / c * 1e3:7.2f}")
+        return "\n".join(lines)
+
+
+#: process-wide accumulator (reference ``global_timer``, gbdt.cpp:22)
+global_timer = PhaseTimer()

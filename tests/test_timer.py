@@ -1,0 +1,44 @@
+"""Phase-timer tests (reference Common::Timer / USE_TIMETAG aggregate
+table, utils/common.h:973)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.utils.timer import global_timer
+
+
+@pytest.fixture(autouse=True)
+def _clean_timer():
+    global_timer.enabled = False
+    global_timer.reset()
+    yield
+    global_timer.enabled = False
+    global_timer.reset()
+
+
+def test_phase_table_collected_when_verbose(synthetic_binary):
+    X, y = synthetic_binary
+    p = {"objective": "binary", "num_leaves": 7, "min_data_in_leaf": 5,
+         "verbosity": 2, "metric": ["binary_logloss"]}
+    lgb.train(p, lgb.Dataset(X, label=y, params=p), num_boost_round=3)
+    s = global_timer.summary()
+    assert "tree_growth" in s
+    assert "boosting_gradients" in s
+    assert "metric_eval" in s
+
+
+def test_timer_state_scoped_per_training(synthetic_binary):
+    """A verbose run followed by a quiet run: the quiet run disables and
+    clears the accumulator (no cross-run leakage)."""
+    X, y = synthetic_binary
+    pv = {"objective": "binary", "num_leaves": 7, "min_data_in_leaf": 5,
+          "verbosity": 2}
+    lgb.train(pv, lgb.Dataset(X, label=y, params=pv), num_boost_round=2)
+    assert global_timer.enabled and "tree_growth" in global_timer.summary()
+
+    pq = {"objective": "binary", "num_leaves": 7, "min_data_in_leaf": 5,
+          "verbose": -1}
+    lgb.train(pq, lgb.Dataset(X, label=y, params=pq), num_boost_round=2)
+    assert not global_timer.enabled
+    assert global_timer.summary() == "no phases timed"
