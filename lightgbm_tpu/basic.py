@@ -243,6 +243,24 @@ class Dataset:
     def create_valid(self, data, label=None, **kwargs) -> "Dataset":
         return Dataset(data, label=label, reference=self, **kwargs)
 
+    @classmethod
+    def from_inner(cls, inner: _InnerDataset,
+                   params: Optional[Dict[str, Any]] = None) -> "Dataset":
+        """Wrap an already-constructed inner dataset (subset/binary-load
+        paths — the reference's handle-around-existing-Dataset pattern)."""
+        d = cls(data=None, params=params)
+        d._inner = inner
+        d.label = inner.metadata.label
+        return d
+
+    def subset(self, used_indices, params: Optional[Dict[str, Any]] = None
+               ) -> "Dataset":
+        """Row subset sharing bin mappers (reference Dataset.subset ->
+        LGBM_DatasetGetSubset)."""
+        self.construct()
+        return Dataset.from_inner(self._inner.subset(used_indices),
+                                  params or dict(self.params))
+
     def save_binary(self, filename: str) -> "Dataset":
         """Write the BINNED dataset to disk (reference
         Dataset.save_binary -> LGBM_DatasetSaveBinary c_api.h:516); loading

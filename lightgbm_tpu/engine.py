@@ -143,16 +143,12 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
     params = normalize_params(params)
     if metrics is not None:
         params["metric"] = metrics
-    # capture raw data BEFORE construct() — the default free_raw_data=True
-    # discards it during construction
-    raw = train_set.data
+    # construction-affecting params (max_bin, linear_tree, enable_bundle...)
+    # must reach the shared binning pass (the reference merges params into
+    # the train set before building folds, engine.py _make_n_folds)
+    train_set.params = {**train_set.params, **params}
     train_set.construct()
     inner = train_set.inner
-    if raw is None:
-        raw = train_set.data  # may survive under free_raw_data=False
-    if raw is None:
-        log.fatal("cv() requires the Dataset raw data; construct with "
-                  "free_raw_data=False")
     n = inner.num_data
     label = np.asarray(inner.metadata.label)
 
@@ -201,24 +197,18 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
                      for p in parts]
 
     cvb = CVBooster()
-    X = np.asarray(raw, np.float64)
-    weight = inner.metadata.weight
-    init_score = inner.metadata.init_score
     for fi, (train_idx, test_idx) in enumerate(folds):
-        gtr = gte = None
+        # fold datasets are SUBSETS of the binned data — bin mappers (and
+        # the EFB plan) are shared, nothing is re-binned (reference cv
+        # builds folds with Dataset.subset, engine.py _make_n_folds)
+        dtrain = Dataset.from_inner(inner.subset(train_idx),
+                                    dict(train_set.params))
+        dtest = Dataset.from_inner(inner.subset(test_idx),
+                                   dict(train_set.params))
         if fold_groups is not None:
             gtr, gte = fold_groups[fi]
-        dtrain = Dataset(X[train_idx], label=label[train_idx],
-                         params=dict(train_set.params),
-                         weight=None if weight is None else weight[train_idx],
-                         group=gtr,
-                         init_score=None if init_score is None else
-                         init_score[train_idx])
-        dtest = dtrain.create_valid(
-            X[test_idx], label=label[test_idx],
-            weight=None if weight is None else weight[test_idx],
-            group=gte,
-            init_score=None if init_score is None else init_score[test_idx])
+            dtrain.inner.metadata.set_group(gtr)
+            dtest.inner.metadata.set_group(gte)
         bst = train(params, dtrain, num_boost_round,
                     valid_sets=[dtest], valid_names=["valid"],
                     feval=feval, callbacks=list(callbacks or []))

@@ -305,6 +305,41 @@ class Dataset:
         """Convert a learner bin threshold to the real-valued model threshold."""
         return self.mappers[self.used_feature_idx[packed_feature]].bin_to_value(bin_thr)
 
+    def subset(self, indices) -> "Dataset":
+        """Row subset SHARING mappers and the EFB plan — no re-binning
+        (reference Dataset::CopySubrow dataset.h:661 / GetSubset; used by
+        cv folds).  ``indices``: i64 row indices into this dataset."""
+        idx = np.asarray(indices, np.int64)
+        ds = Dataset()
+        ds.mappers = self.mappers
+        ds.used_feature_idx = list(self.used_feature_idx)
+        ds.num_total_features = self.num_total_features
+        ds.feature_names = self.feature_names
+        ds.config = self.config
+        ds.bundle_plan = self.bundle_plan
+        ds.bins = self.bins[idx]
+        md = Metadata(len(idx))
+        md.set_label(self.metadata.label[idx])
+        if self.metadata.weight is not None:
+            md.set_weight(self.metadata.weight[idx])
+        if self.metadata.init_score is not None:
+            isc = self.metadata.init_score
+            if isc.size == self.num_data:
+                md.set_init_score(isc[idx])
+            else:  # column-major multiclass flatten
+                k = isc.size // self.num_data
+                md.set_init_score(
+                    isc.reshape(self.num_data, k, order="F")[idx]
+                    .reshape(-1, order="F"))
+        if self.metadata.position is not None:
+            md.set_position(self.metadata.position[idx])
+        # query boundaries don't survive arbitrary subsets; callers that
+        # fold over whole queries re-set group sizes afterwards
+        ds.metadata = md
+        if self.raw is not None:
+            ds.raw = self.raw[idx]
+        return ds
+
     # ------------------------------------------------------- binary format
     def save_binary(self, path: str) -> None:
         """Persist the BINNED dataset so the expensive binning/EFB pass is

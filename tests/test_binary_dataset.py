@@ -27,6 +27,21 @@ def test_save_binary_roundtrip(tmp_path, synthetic_binary):
     np.testing.assert_allclose(b1.predict(X), b2.predict(X), atol=1e-12)
 
 
+def test_subset_shares_mappers(synthetic_binary):
+    """Dataset.subset slices binned rows sharing mappers/EFB plan
+    (reference Dataset::CopySubrow) — no re-binning."""
+    X, y = synthetic_binary
+    ds = lgb.Dataset(X, label=y, params=FAST)
+    ds.construct()
+    idx = np.arange(0, len(X), 2)
+    sub = ds.subset(idx)
+    assert sub.inner.mappers is ds.inner.mappers          # shared, not rebuilt
+    np.testing.assert_array_equal(sub.inner.bins, ds.inner.bins[idx])
+    np.testing.assert_array_equal(sub.get_label(), y[idx])
+    bst = lgb.train({**FAST, "objective": "binary"}, sub, num_boost_round=5)
+    assert float(((bst.predict(X[idx]) > 0.5) == y[idx]).mean()) > 0.85
+
+
 def test_save_binary_with_bundles_and_weights(tmp_path):
     rng = np.random.default_rng(0)
     n = 1500
