@@ -644,6 +644,70 @@ class Booster:
             max_feature_idx=d["max_feature_idx"],
             objective_str=d["objective"], feature_names=d["feature_names"])
 
+    def trees_to_dataframe(self):
+        """Flatten the model into a pandas DataFrame, one row per node
+        (reference Booster.trees_to_dataframe, basic.py): columns
+        tree_index, node_depth, node_index, left_child, right_child,
+        parent_index, split_feature, split_gain, threshold, decision_type,
+        missing_direction, missing_type, value, weight, count."""
+        import pandas as pd
+        from .models.tree import _decode_decision_type
+        rows = []
+        names = self.feature_name()
+
+        def visit(t, ti, node, depth, parent):
+            """Emit one node's row; returns its tag (iterative caller)."""
+            if node < 0:
+                leaf = -node - 1
+                tag = f"{ti}-L{leaf}"
+                rows.append(dict(
+                    tree_index=ti, node_depth=depth, node_index=tag,
+                    left_child=None, right_child=None, parent_index=parent,
+                    split_feature=None, split_gain=None, threshold=None,
+                    decision_type=None, missing_direction=None,
+                    missing_type=None, value=float(t.leaf_value[leaf]),
+                    weight=float(t.leaf_weight[leaf]),
+                    count=int(t.leaf_count[leaf])))
+                return tag, None
+            tag = f"{ti}-S{node}"
+            is_cat, default_left, missing_type = _decode_decision_type(
+                int(t.decision_type[node]))
+            row = dict(
+                tree_index=ti, node_depth=depth, node_index=tag,
+                parent_index=parent,
+                split_feature=names[int(t.split_feature[node])],
+                split_gain=float(t.split_gain[node]),
+                threshold=float(t.threshold[node]),
+                decision_type="==" if is_cat else "<=",
+                missing_direction="left" if default_left else "right",
+                missing_type=["None", "Zero", "NaN"][missing_type],
+                value=float(t.internal_value[node]),
+                weight=float(t.internal_weight[node])
+                if len(t.internal_weight) > node else 0.0,
+                count=int(t.internal_count[node]))
+            rows.append(row)
+            return tag, row
+
+        for ti, t in enumerate(self._get_trees()):
+            # explicit stack: leaf-wise trees can be num_leaves deep, which
+            # would blow Python's recursion limit
+            stack = [(0 if t.num_leaves > 1 else -1, 1, None, None, None)]
+            while stack:
+                node, depth, parent, prow, side = stack.pop()
+                tag, row = visit(t, ti, node, depth, parent)
+                if prow is not None:
+                    prow[side] = tag
+                if row is not None:
+                    stack.append((int(t.right_child[node]), depth + 1, tag,
+                                  row, "right_child"))
+                    stack.append((int(t.left_child[node]), depth + 1, tag,
+                                  row, "left_child"))
+        cols = ["tree_index", "node_depth", "node_index", "left_child",
+                "right_child", "parent_index", "split_feature", "split_gain",
+                "threshold", "decision_type", "missing_direction",
+                "missing_type", "value", "weight", "count"]
+        return pd.DataFrame(rows).reindex(columns=cols)
+
     def feature_importance(self, importance_type: str = "split",
                            iteration: Optional[int] = None) -> np.ndarray:
         trees = (self._gbdt.models if self._gbdt else self._loaded["trees"])

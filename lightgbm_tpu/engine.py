@@ -146,7 +146,18 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
     # construction-affecting params (max_bin, linear_tree, enable_bundle...)
     # must reach the shared binning pass (the reference merges params into
     # the train set before building folds, engine.py _make_n_folds)
-    train_set.params = {**train_set.params, **params}
+    if train_set._inner is None:
+        train_set.params = {**train_set.params, **params}
+    else:
+        # binning is already fixed; warn like the reference's
+        # _update_params on a constructed Dataset
+        stale = [k for k in ("max_bin", "linear_tree", "enable_bundle",
+                             "max_bin_by_feature", "min_data_in_bin")
+                 if k in params
+                 and params[k] != train_set.params.get(k, params[k])]
+        if stale:
+            log.warning(f"cv params {stale} ignored: the Dataset is "
+                        "already constructed with its own binning")
     train_set.construct()
     inner = train_set.inner
     n = inner.num_data

@@ -93,6 +93,26 @@ def test_scipy_csr(synthetic_binary):
     np.testing.assert_allclose(p1, p2, atol=1e-12)
 
 
+def test_trees_to_dataframe(synthetic_binary):
+    """reference Booster.trees_to_dataframe: one row per node, parent/child
+    links consistent, leaf counts match training data."""
+    X, y = synthetic_binary
+    bst = lgb.train({**FAST, "objective": "binary"},
+                    lgb.Dataset(X, label=y, params=FAST), num_boost_round=3)
+    df = bst.trees_to_dataframe()
+    assert set(df.tree_index.unique()) == {0, 1, 2}
+    t0 = df[df.tree_index == 0]
+    splits = t0[t0.split_feature.notna()]
+    leaves = t0[t0.split_feature.isna()]
+    assert len(leaves) == len(splits) + 1          # binary tree invariant
+    assert leaves["count"].sum() == len(X)
+    # every child pointer resolves to a node with the right parent
+    for _, r in splits.iterrows():
+        for child in (r.left_child, r.right_child):
+            assert (t0[t0.node_index == child].parent_index
+                    == r.node_index).all()
+
+
 def test_sequence_streaming(synthetic_binary):
     """lgb.Sequence subclass feeds batched rows (reference basic.py:915)."""
     X, y = synthetic_binary
