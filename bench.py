@@ -60,8 +60,7 @@ def main():
     nan_bin = jnp.full((f,), -1, jnp.int32)
     is_cat = jnp.zeros((f,), bool)
 
-    @jax.jit
-    def step(scores):
+    def step(scores, _):
         sign = jnp.where(label_d > 0, 1.0, -1.0)
         resp = -sign / (1.0 + jnp.exp(sign * scores))
         grad = resp
@@ -73,16 +72,26 @@ def main():
         else:
             tree, leaf_of_row = grow_tree(bins_d, grad, hess, None, num_bins,
                                           nan_bin, is_cat, None, hp)
-        return scores + 0.1 * tree.leaf_value[leaf_of_row]
+        from lightgbm_tpu.ops.table import take_small_table
+        return scores + 0.1 * take_small_table(tree.leaf_value,
+                                               leaf_of_row), None
+
+    # All iterations inside ONE jit (docs/PERF_NOTES.md: the tunnel adds
+    # ~100 ms per dispatched computation, so a Python-side loop times the
+    # tunnel, not the learner; scores carry a data dependency across steps
+    # so iterations cannot be pipelined into an optimistic overlap).
+    @jax.jit
+    def run(scores):
+        scores, _ = jax.lax.scan(step, scores, None, length=BENCH_ITERS)
+        return scores
 
     scores = jnp.zeros(n, jnp.float32)
-    scores = step(scores)          # compile + warmup
-    scores.block_until_ready()
+    out = run(scores)              # compile + warmup
+    float(out[0])                  # force readback through the tunnel
 
     t0 = time.time()
-    for _ in range(BENCH_ITERS):
-        scores = step(scores)
-    scores.block_until_ready()
+    out = run(scores)
+    float(out[0])
     elapsed = time.time() - t0
 
     baseline_equiv = BASELINE_S_PER_ROW_ITER * n * BENCH_ITERS

@@ -38,6 +38,7 @@ from ..ops.split import SplitHyper
 from ..utils import log
 from ..utils.timer import global_timer
 from .sample_strategy import create_sample_strategy
+from ..ops.table import take_small_table
 
 GradFn = Callable[[np.ndarray, Any], Tuple[np.ndarray, np.ndarray]]
 
@@ -465,8 +466,10 @@ class GBDT:
                         .at[:, cls_idx].add(self.shrinkage_rate * vc)
             else:
                 shrunk = arrays.leaf_value * self.shrinkage_rate
-                # train score update: pure gather through leaf_of_row
-                self.scores = self.scores.at[:, cls_idx].add(shrunk[leaf_of_row])
+                # train score update: one-hot contraction beats the [n] table
+                # gather ~25x on TPU (ops/table.py)
+                self.scores = self.scores.at[:, cls_idx].add(
+                    take_small_table(shrunk, leaf_of_row))
                 # valid scores via frontier traversal (shrunk values)
                 arrays_shrunk = arrays._replace(leaf_value=shrunk)
                 for vi in range(len(self.valid_sets)):

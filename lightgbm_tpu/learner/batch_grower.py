@@ -33,7 +33,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..ops.histogram import histogram_for_leaves_masked, root_histogram
+from ..ops.histogram import histogram_for_leaves_auto, root_histogram
 from ..ops.split import NEG_INF, SplitHyper, find_best_split, leaf_output
 from .grower import (DeviceBundle, TreeArrays, _empty_tree, _expand_hist,
                      _feature_bin_of_rows)
@@ -187,18 +187,19 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
 
         # ---- all K partitions in ONE widened pass (each row belongs to at
         # most one split parent, so the K moves compose by summation)
-        feats_k = st["best_feat"][parents]                      # [K]
-        cols_k = jax.vmap(
-            lambda f: _feature_bin_of_rows(bins_t, bundle, f))(feats_k)
-        thr_k = st["best_thr"][parents][:, None]
-        dl_k = st["best_dl"][parents][:, None]
-        nanb_k = nan_bin[feats_k][:, None]
-        go_left_k = jnp.where(cols_k == nanb_k, dl_k, cols_k <= thr_k)
-        in_parent = (lor[None, :] == parents[:, None]) \
-            & valid[:, None]                                    # [K, n]
-        move = in_parent & ~go_left_k                           # [K, n]
-        target = jnp.sum(move * new_leaves[:, None], axis=0)    # [n]
-        lor = jnp.where(jnp.any(move, axis=0), target, lor)
+        with jax.named_scope("partition"):
+            feats_k = st["best_feat"][parents]                      # [K]
+            cols_k = jax.vmap(
+                lambda f: _feature_bin_of_rows(bins_t, bundle, f))(feats_k)
+            thr_k = st["best_thr"][parents][:, None]
+            dl_k = st["best_dl"][parents][:, None]
+            nanb_k = nan_bin[feats_k][:, None]
+            go_left_k = jnp.where(cols_k == nanb_k, dl_k, cols_k <= thr_k)
+            in_parent = (lor[None, :] == parents[:, None]) \
+                & valid[:, None]                                    # [K, n]
+            move = in_parent & ~go_left_k                           # [K, n]
+            target = jnp.sum(move * new_leaves[:, None], axis=0)    # [n]
+            lor = jnp.where(jnp.any(move, axis=0), target, lor)
 
         st["tree"] = t
         st["leaf_of_row"] = lor
@@ -206,45 +207,47 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         st["progress"] = jnp.any(valid)
 
         # ---- ONE widened pass: histograms of the K smaller children
-        safe_nl = jnp.where(valid, new_leaves, L - 1)
-        l_cnt = st["count"][parents]
-        r_cnt = st["count"][safe_nl]
-        smaller = jnp.where(l_cnt <= r_cnt, parents, safe_nl)
-        h_small = histogram_for_leaves_masked(
-            bins_t, grad, hess, lor, smaller, row_mask, n_bins=hp.n_bins,
-            rows_per_block=hp.rows_per_block, hist_dtype=hp.hist_dtype,
-            axis_name=axis_name)                                # [K,Fb,B,C]
-        h_parent = st["hist"][parents]
-        h_large = h_parent - h_small
-        left_small = (l_cnt <= r_cnt)[:, None, None, None]
-        h_left = jnp.where(left_small, h_small, h_large)
-        h_right = jnp.where(left_small, h_large, h_small)
-        hist = st["hist"]
-        hist = hist.at[parents].set(jnp.where(valid[:, None, None, None],
-                                              h_left, hist[parents]))
-        hist = hist.at[safe_nl].set(jnp.where(valid[:, None, None, None],
-                                              h_right, hist[safe_nl]))
-        st["hist"] = hist
+        with jax.named_scope("round_hist"):
+            safe_nl = jnp.where(valid, new_leaves, L - 1)
+            l_cnt = st["count"][parents]
+            r_cnt = st["count"][safe_nl]
+            smaller = jnp.where(l_cnt <= r_cnt, parents, safe_nl)
+            h_small = histogram_for_leaves_auto(
+                bins, bins_t, grad, hess, lor, smaller, row_mask,
+                n_bins=hp.n_bins, rows_per_block=hp.rows_per_block,
+                hist_dtype=hp.hist_dtype, axis_name=axis_name)      # [K,Fb,B,C]
+            h_parent = st["hist"][parents]
+            h_large = h_parent - h_small
+            left_small = (l_cnt <= r_cnt)[:, None, None, None]
+            h_left = jnp.where(left_small, h_small, h_large)
+            h_right = jnp.where(left_small, h_large, h_small)
+            hist = st["hist"]
+            hist = hist.at[parents].set(jnp.where(valid[:, None, None, None],
+                                                  h_left, hist[parents]))
+            hist = hist.at[safe_nl].set(jnp.where(valid[:, None, None, None],
+                                                  h_right, hist[safe_nl]))
+            st["hist"] = hist
 
         # ---- child best splits, vmapped over the 2K children
-        kids = jnp.concatenate([parents, safe_nl])              # [2K]
-        kid_hist = jnp.concatenate([h_left, h_right], axis=0)
-        depths = st["tree"].leaf_depth[kids]
-        res = jax.vmap(child_best)(kid_hist, st["sum_g"][kids],
-                                   st["sum_h"][kids], st["count"][kids],
-                                   depths)
-        ok2 = jnp.concatenate([valid, valid])
-        gains2 = jnp.where(ok2, res.gain, st["best_gain"][kids])
-        st["best_gain"] = st["best_gain"].at[kids].set(gains2)
-        for name, field in (("best_feat", res.feature),
-                            ("best_thr", res.threshold),
-                            ("best_lg", res.left_sum_g),
-                            ("best_lh", res.left_sum_h),
-                            ("best_lc", res.left_count)):
-            st[name] = st[name].at[kids].set(
-                jnp.where(ok2, field, st[name][kids]))
-        st["best_dl"] = st["best_dl"].at[kids].set(
-            jnp.where(ok2, res.default_left, st["best_dl"][kids]))
+        with jax.named_scope("find_splits"):
+            kids = jnp.concatenate([parents, safe_nl])              # [2K]
+            kid_hist = jnp.concatenate([h_left, h_right], axis=0)
+            depths = st["tree"].leaf_depth[kids]
+            res = jax.vmap(child_best)(kid_hist, st["sum_g"][kids],
+                                       st["sum_h"][kids], st["count"][kids],
+                                       depths)
+            ok2 = jnp.concatenate([valid, valid])
+            gains2 = jnp.where(ok2, res.gain, st["best_gain"][kids])
+            st["best_gain"] = st["best_gain"].at[kids].set(gains2)
+            for name, field in (("best_feat", res.feature),
+                                ("best_thr", res.threshold),
+                                ("best_lg", res.left_sum_g),
+                                ("best_lh", res.left_sum_h),
+                                ("best_lc", res.left_count)):
+                st[name] = st[name].at[kids].set(
+                    jnp.where(ok2, field, st[name][kids]))
+            st["best_dl"] = st["best_dl"].at[kids].set(
+                jnp.where(ok2, res.default_left, st["best_dl"][kids]))
         return st
 
     # loop until the tree is full or a round makes no progress — a fixed

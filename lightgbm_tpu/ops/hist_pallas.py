@@ -45,6 +45,18 @@ def _round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
+def _prec(compute_dtype):
+    """MXU precision for the one-hot contraction.
+
+    The TPU's default f32 matmul runs ONE bf16 pass (~2^-8 product
+    rounding), which silently breaks the float32 split-parity contract
+    (docs/PERF_NOTES.md).  HIGHEST makes products exact so f32 accumulation
+    is the only rounding left (Mosaic supports only DEFAULT/HIGHEST).
+    """
+    return (lax.Precision.HIGHEST if jnp.dtype(compute_dtype) == jnp.float32
+            else lax.Precision.DEFAULT)
+
+
 @functools.partial(jax.jit,
                    static_argnames=("n_bins", "rows_per_block",
                                     "feats_per_chunk", "compute_dtype",
@@ -88,7 +100,8 @@ def histogram_pallas(bins_t: jax.Array, vals_t: jax.Array, *, n_bins: int,
             oh = onehot.reshape(fc * n_bins, blk)
             acc = lax.dot_general(
                 v_blk, oh, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32)    # [c, fc*B]
+                preferred_element_type=jnp.float32,
+                precision=_prec(compute_dtype))        # [c, fc*B]
             out_ref[:, f0 * n_bins:(f0 + fc) * n_bins] += acc
 
     out = pl.pallas_call(
@@ -105,3 +118,125 @@ def histogram_pallas(bins_t: jax.Array, vals_t: jax.Array, *, n_bins: int,
     # [C, F*B] -> [F, B, C]
     out = out.reshape(c, f_pad, n_bins).transpose(1, 2, 0)
     return out[:num_f]
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n_bins", "rows_per_block",
+                                    "feats_per_chunk", "compute_dtype",
+                                    "rows_major", "interpret"))
+def _histogram_leaves_impl(bins: jax.Array, grad: jax.Array,
+                           hess: jax.Array, leaf_of_row: jax.Array,
+                           leaves: jax.Array, *, n_bins: int,
+                           rows_per_block: int = 2048,
+                           feats_per_chunk: int = 8,
+                           compute_dtype=jnp.bfloat16,
+                           rows_major: bool = False,
+                           interpret: bool = False) -> jax.Array:
+    """Fused masked multi-leaf histogram: f32 [K, F, n_bins, 4].
+
+    Builds the per-leaf (grad, hess, count) value channels INSIDE the kernel
+    (sel masks live only in VMEM), so K leaves cost one one-hot pass with no
+    [3K, n] HBM materialization — the separate mask+stack stage measured
+    ~12 ms/round at K=16 on 1M rows, ~2x the whole kernel (docs/PERF_NOTES.md).
+
+    ``bins``: u8 [F, n] transposed (``rows_major=False``, the resident
+    training layout) or u8 [S, F] row-major (``rows_major=True``, the layout
+    a compacted-frontier row gather produces — row gathers from [n, F] are
+    contiguous DMAs; lane-dim gathers from [F, n] are the slowest TPU
+    primitive).  grad/hess: f32 [n]; leaf_of_row: i32 [n] (-1 = excluded
+    row, e.g. bagging); leaves: i32 [K] (dummy slots may repeat).  Channel 3
+    of the output is zero padding for API parity.
+    """
+    if rows_major:
+        n, num_f = bins.shape
+    else:
+        num_f, n = bins.shape
+    K = leaves.shape[0]
+    blk = min(rows_per_block, max(128, _round_up(n, 128)))
+    n_pad = _round_up(max(n, 1), blk)
+    if n_pad != n:
+        row_pad = ((0, n_pad - n), (0, 0)) if rows_major \
+            else ((0, 0), (0, n_pad - n))
+        bins = jnp.pad(bins, row_pad)
+        grad = jnp.pad(grad, (0, n_pad - n))
+        hess = jnp.pad(hess, (0, n_pad - n))
+        leaf_of_row = jnp.pad(leaf_of_row, (0, n_pad - n),
+                              constant_values=-1)
+    fc = min(feats_per_chunk, num_f)
+    f_pad = _round_up(num_f, fc)
+    if f_pad != num_f:
+        feat_pad = ((0, 0), (0, f_pad - num_f)) if rows_major \
+            else ((0, f_pad - num_f), (0, 0))
+        bins = jnp.pad(bins, feat_pad)
+    nb = n_pad // blk
+    grad2 = grad[None, :]
+    hess2 = hess[None, :]
+    lor2 = leaf_of_row[None, :]
+    leaves2 = leaves[None, :]
+
+    def kernel(bins_ref, g_ref, h_ref, lor_ref, leaves_ref, out_ref):
+        step = pl.program_id(0)
+
+        @pl.when(step == 0)
+        def _():
+            out_ref[:] = jnp.zeros_like(out_ref)
+
+        lor_b = lor_ref[0, :]                               # [blk] i32
+        sel = lor_b[None, :] == leaves_ref[0, :][:, None]   # [K, blk]
+        m = sel.astype(jnp.float32)
+        gm = g_ref[0, :][None, :] * m                       # [K, blk]
+        hm = h_ref[0, :][None, :] * m
+        vals = jnp.concatenate([gm, hm, m], axis=0).astype(compute_dtype)
+        b_blk = bins_ref[:].astype(jnp.int32)
+        iota = lax.iota(jnp.int32, n_bins)
+        for f0 in range(0, f_pad, fc):
+            # the one-hot is always built in the [fc*B, blk] orientation —
+            # for row-major input the small [blk, fc] chunk is transposed
+            # in-VMEM (building [blk, fc*B] instead needs a relayout copy of
+            # the one-hot that blows the VMEM scoped-allocation budget)
+            if rows_major:
+                chunk = b_blk[:, f0:f0 + fc].T              # [fc, blk]
+            else:
+                chunk = b_blk[f0:f0 + fc]                   # [fc, blk]
+            onehot = (chunk[:, None, :] == iota[None, :, None]
+                      ).astype(compute_dtype)               # [fc, B, blk]
+            oh = onehot.reshape(fc * n_bins, blk)
+            acc = lax.dot_general(
+                vals, oh, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=_prec(compute_dtype))             # [3K, fc*B]
+            out_ref[:, f0 * n_bins:(f0 + fc) * n_bins] += acc
+
+    bins_spec = pl.BlockSpec((blk, f_pad), lambda i: (i, 0)) if rows_major \
+        else pl.BlockSpec((f_pad, blk), lambda i: (0, i))
+    out = pl.pallas_call(
+        kernel,
+        grid=(nb,),
+        in_specs=[
+            bins_spec,
+            pl.BlockSpec((1, blk), lambda i: (0, i)),
+            pl.BlockSpec((1, blk), lambda i: (0, i)),
+            pl.BlockSpec((1, blk), lambda i: (0, i)),
+            pl.BlockSpec((1, K), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((3 * K, f_pad * n_bins), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((3 * K, f_pad * n_bins), jnp.float32),
+        interpret=interpret,
+    )(bins, grad2, hess2, lor2, leaves2)
+    # [3K, F*B] -> [K, F, B, 3] -> pad channel dim to 4
+    out = out.reshape(3, K, f_pad, n_bins)[:, :, :num_f]
+    out = out.transpose(1, 2, 3, 0)
+    return jnp.pad(out, ((0, 0), (0, 0), (0, 0), (0, 1)))
+
+
+def histogram_leaves_pallas(bins_t, grad, hess, leaf_of_row, leaves, **kw):
+    """Fused masked multi-leaf histogram from TRANSPOSED [F, n] bins."""
+    return _histogram_leaves_impl(bins_t, grad, hess, leaf_of_row, leaves,
+                                  rows_major=False, **kw)
+
+
+def histogram_leaves_rows_pallas(bins_rows, grad, hess, leaf_of_row, leaves,
+                                 **kw):
+    """Fused masked multi-leaf histogram from ROW-major [S, F] bins."""
+    return _histogram_leaves_impl(bins_rows, grad, hess, leaf_of_row, leaves,
+                                  rows_major=True, **kw)

@@ -113,7 +113,8 @@ def build_histogram(bins: jax.Array, vals: jax.Array, *, n_bins: int = 256,
             onehot = (chunk[:, :, None] == iota).astype(vals.dtype)  # [blk, fc, B]
             lhs = onehot.reshape(blk, fc * n_bins)
             h = lax.dot_general(lhs, v_blk, (((0,), (0,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32,
+                                precision=lax.Precision.HIGHEST)
             parts.append(h.reshape(fc, n_bins, c))
         return acc + jnp.concatenate(parts, axis=0), None
 
@@ -135,18 +136,12 @@ def histogram_for_leaf_masked(bins_t: jax.Array, grad: jax.Array,
     the gather path except for very small leaves (the nonzero compaction
     itself costs a full O(n) cumsum+scatter, which is already ~the masked
     pass).  ``bins_t`` is the TRANSPOSED [F, n] matrix."""
-    m = (leaf_of_row == leaf)
-    if row_mask is not None:
-        m = m & row_mask
-    mf = m.astype(grad.dtype)
-    vals_t = jnp.stack([grad * mf, hess * mf, mf, jnp.zeros_like(mf)],
-                       axis=0)
-    hist = histogram_rows_t(bins_t, vals_t, n_bins=n_bins,
-                            rows_per_block=rows_per_block,
-                            hist_dtype=hist_dtype)
-    if axis_name is not None:
-        hist = lax.psum(hist, axis_name)
-    return hist
+    leaf_arr = jnp.asarray(leaf, jnp.int32).reshape(1)
+    hist = histogram_for_leaves_masked(
+        bins_t, grad, hess, leaf_of_row, leaf_arr, row_mask, n_bins=n_bins,
+        rows_per_block=rows_per_block, hist_dtype=hist_dtype,
+        axis_name=axis_name)
+    return hist[0]
 
 
 def histogram_for_leaves_masked(bins_t: jax.Array, grad: jax.Array,
@@ -168,20 +163,146 @@ def histogram_for_leaves_masked(bins_t: jax.Array, grad: jax.Array,
     slots may repeat a leaf (their histograms are simply unused).
     """
     K = leaves.shape[0]
-    sel = leaf_of_row[None, :] == leaves[:, None]             # [K, n]
+    leaves = jnp.asarray(leaves, jnp.int32)
+    lor = jnp.asarray(leaf_of_row, jnp.int32)
     if row_mask is not None:
-        sel = sel & row_mask[None, :]
+        lor = jnp.where(row_mask, lor, -1)
+    if use_pallas():
+        from .hist_pallas import histogram_leaves_pallas
+        hist = histogram_leaves_pallas(
+            bins_t, grad, hess, lor, leaves, n_bins=n_bins,
+            rows_per_block=min(rows_per_block, 2048),
+            compute_dtype=jnp.dtype(hist_dtype).type)         # [K, F, B, C]
+    else:
+        sel = lor[None, :] == leaves[:, None]                 # [K, n]
+        m = sel.astype(grad.dtype)
+        # channel layout [C, K, n] -> flatten to [C*K, n]
+        vals_t = jnp.stack([grad[None, :] * m, hess[None, :] * m, m,
+                            jnp.zeros_like(m)], axis=0)
+        C = vals_t.shape[0]
+        vals_t = vals_t.reshape(C * K, -1)
+        hist = histogram_rows_t(bins_t, vals_t, n_bins=n_bins,
+                                rows_per_block=rows_per_block,
+                                hist_dtype=hist_dtype)        # [F, B, C*K]
+        F, B = hist.shape[0], hist.shape[1]
+        hist = hist.reshape(F, B, C, K).transpose(3, 0, 1, 2)  # [K, F, B, C]
+    if axis_name is not None:
+        hist = lax.psum(hist, axis_name)
+    return hist
+
+
+def _rows_leaves_hist(bins_rows: jax.Array, grad: jax.Array,
+                      hess: jax.Array, lor: jax.Array, leaves: jax.Array, *,
+                      n_bins: int, rows_per_block: int,
+                      hist_dtype: str) -> jax.Array:
+    """[K, F, B, C] histograms from row-major bins (backend-dispatched)."""
+    if use_pallas():
+        from .hist_pallas import histogram_leaves_rows_pallas
+        return histogram_leaves_rows_pallas(
+            bins_rows, grad, hess, lor, leaves, n_bins=n_bins,
+            rows_per_block=min(rows_per_block, 2048),
+            compute_dtype=jnp.dtype(hist_dtype).type)
+    K = leaves.shape[0]
+    sel = lor[None, :] == leaves[:, None]                     # [K, S]
     m = sel.astype(grad.dtype)
-    # channel layout [C, K, n] -> flatten to [C*K, n]
-    vals_t = jnp.stack([grad[None, :] * m, hess[None, :] * m, m,
-                        jnp.zeros_like(m)], axis=0)
-    C = vals_t.shape[0]
-    vals_t = vals_t.reshape(C * K, -1)
-    hist = histogram_rows_t(bins_t, vals_t, n_bins=n_bins,
+    vals = jnp.stack([grad[None, :] * m, hess[None, :] * m, m,
+                      jnp.zeros_like(m)], axis=0)             # [C, K, S]
+    C = vals.shape[0]
+    hist = histogram_rows_t(jnp.asarray(bins_rows).T,
+                            vals.reshape(C * K, -1), n_bins=n_bins,
                             rows_per_block=rows_per_block,
                             hist_dtype=hist_dtype)            # [F, B, C*K]
     F, B = hist.shape[0], hist.shape[1]
-    hist = hist.reshape(F, B, C, K).transpose(3, 0, 1, 2)     # [K, F, B, C]
+    return hist.reshape(F, B, C, K).transpose(3, 0, 1, 2)
+
+
+def histogram_for_leaves_auto(bins_rows: jax.Array, bins_t: jax.Array,
+                              grad: jax.Array, hess: jax.Array,
+                              leaf_of_row: jax.Array, leaves: jax.Array,
+                              row_mask: Optional[jax.Array] = None, *,
+                              n_bins: int = 256, rows_per_block: int = 2048,
+                              hist_dtype: str = "float32",
+                              axis_name: Optional[str] = None,
+                              buckets=(4, 8, 16, 64)) -> jax.Array:
+    """K-leaf histograms with frontier compaction -> f32 [K, F, B, C].
+
+    The TPU reformulation of the reference's O(smaller-child) histogram cost
+    (serial_tree_learner.cpp:364-378 iterates only the leaf's data indices):
+    when the rows belonging to ``leaves`` fit a power-of-two bucket, they are
+    compacted with a sized ``nonzero`` + contiguous row gather from the
+    ROW-major bin matrix and the kernel runs on the bucket; otherwise one
+    full masked pass (``histogram_for_leaves_masked``).  Total histogram work
+    per tree drops from O(n x rounds) to ~O(n log L), which the flat masked
+    pass cannot do.  Exact: the same rows contribute either way.
+
+    ``bins_rows``: u8 [n, F] row-major; ``bins_t``: u8 [F, n] transposed.
+    """
+    n = grad.shape[0]
+    leaves = jnp.asarray(leaves, jnp.int32)
+    lor = jnp.asarray(leaf_of_row, jnp.int32)
+    if row_mask is not None:
+        lor = jnp.where(row_mask, lor, -1)
+    sel = jnp.any(lor[None, :] == leaves[:, None], axis=0)    # [n]
+    cnt = jnp.sum(sel.astype(jnp.int32))
+    assert n < (1 << 30), "compaction packing needs n < 2^30 rows per shard"
+    num_f = bins_rows.shape[1]
+
+    blk = min(rows_per_block, 2048)
+    sizes = []
+    for d in buckets:
+        s = _round_up(max(n // d, 1), blk)
+        if s < n and s not in sizes:
+            sizes.append(s)
+
+    def full_branch(_):
+        return histogram_for_leaves_masked(
+            bins_t, grad, hess, lor, leaves, None, n_bins=n_bins,
+            rows_per_block=rows_per_block, hist_dtype=hist_dtype)
+
+    def make_branch(S: int):
+        def branch(operands):
+            sel_, grad_, hess_, lor_ = operands
+            # One u8 payload matrix holding (bins row, grad, hess, leaf) so
+            # the branch does a SINGLE contiguous row gather — separate
+            # gathers are DMA-descriptor bound (~9 ns/row each) and XLA lays
+            # an f32 [n, 4] stack out column-major, turning its row gather
+            # into lane gathers (docs/PERF_NOTES.md).  Built INSIDE the
+            # branch so full-pass rounds skip it and the sort entirely.
+            packed_ = jnp.concatenate([
+                bins_rows,
+                lax.bitcast_convert_type(grad_, jnp.uint8),   # [n, 4]
+                lax.bitcast_convert_type(hess_, jnp.uint8),
+                lax.bitcast_convert_type(lor_, jnp.uint8),
+            ], axis=1)                                        # [n, F+12]
+            # frontier indices: pack (selected?, row) into ONE i32 and
+            # single-sort — the first ``cnt`` entries are exactly the
+            # selected rows in order.  A non-stable single-operand sort
+            # costs ~0.4 ms/1M on TPU vs ~1.4 ms for stable argsort and
+            # ~9 ms for sized ``nonzero`` (docs/PERF_NOTES.md).
+            iota_n = lax.iota(jnp.int32, n)
+            idxc = jnp.sort(jnp.where(sel_, iota_n, iota_n | (1 << 30)),
+                            stable=False)[:S] & ((1 << 30) - 1)
+            valid = lax.iota(jnp.int32, S) < cnt
+            pc = packed_[idxc]                                # [S, F+12] u8
+            rows_c = pc[:, :num_f]
+            grad_c = lax.bitcast_convert_type(
+                pc[:, num_f:num_f + 4], jnp.float32)
+            hess_c = lax.bitcast_convert_type(
+                pc[:, num_f + 4:num_f + 8], jnp.float32)
+            lor_g = lax.bitcast_convert_type(
+                pc[:, num_f + 8:num_f + 12], jnp.int32)
+            lor_c = jnp.where(valid, lor_g, -1)
+            return _rows_leaves_hist(rows_c, grad_c, hess_c, lor_c,
+                                     leaves, n_bins=n_bins,
+                                     rows_per_block=rows_per_block,
+                                     hist_dtype=hist_dtype)
+        return branch
+
+    branches = [full_branch] + [make_branch(s) for s in sizes]
+    j = jnp.int32(0)
+    for k, s in enumerate(sizes):  # sizes descending: smallest fit wins
+        j = jnp.where(cnt <= s, jnp.int32(k + 1), j)
+    hist = lax.switch(j, branches, (sel, grad, hess, lor))
     if axis_name is not None:
         hist = lax.psum(hist, axis_name)
     return hist
@@ -256,6 +377,12 @@ def root_histogram(bins_t: jax.Array, grad: jax.Array, hess: jax.Array,
                    hist_dtype: str = "float32",
                    axis_name: Optional[str] = None) -> jax.Array:
     """Root histogram from the TRANSPOSED [F, n] bin matrix."""
+    if use_pallas():
+        lor = jnp.zeros(grad.shape, jnp.int32)
+        return histogram_for_leaf_masked(
+            bins_t, grad, hess, lor, jnp.int32(0), row_mask, n_bins=n_bins,
+            rows_per_block=rows_per_block, hist_dtype=hist_dtype,
+            axis_name=axis_name)
     m = jnp.ones_like(grad) if row_mask is None else row_mask.astype(grad.dtype)
     vals_t = jnp.stack([grad * m, hess * m, m, jnp.zeros_like(m)], axis=0)
     hist = histogram_rows_t(bins_t, vals_t, n_bins=n_bins,

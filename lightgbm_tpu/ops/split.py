@@ -64,7 +64,12 @@ class SplitHyper:
     n_bins: int = 256
     rows_per_block: int = 4096
     path_smooth: float = 0.0
-    hist_dtype: str = "float32"   # MXU contraction dtype; "bfloat16" opts into 8x MXU rate
+    # MXU contraction dtype.  "bfloat16" (default): exact {0,1} one-hot,
+    # f32 accumulation, only grad/hess products take ~2^-9 input rounding
+    # (measured AUC-neutral, docs/PERF_NOTES.md).  "float32": fully exact
+    # products via 6-pass MXU (Precision.HIGHEST), ~3x slower — the
+    # split-parity mode matching the reference's fp64 histograms.
+    hist_dtype: str = "bfloat16"
     # per-leaf histogram strategy: "masked" = flat full-data pass with
     # non-leaf rows zeroed (no compaction; TPU-friendly), "bucketed" =
     # nonzero+gather into power-of-two buckets (wins only when leaves are

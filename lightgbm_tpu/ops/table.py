@@ -1,0 +1,85 @@
+"""Small-table row lookups without lane-dim gathers.
+
+``table[idx]`` for a [n]-sized index vector is the slowest primitive on TPU
+(~8 ms per 1M rows through XLA's gather, docs/PERF_NOTES.md) yet the GBDT
+score update needs exactly that: ``scores += lr * leaf_value[leaf_of_row]``
+(reference score_updater.hpp:21 AddScore).  For tables bounded by num_leaves
+(<= a few hundred) the lookup is reformulated as a VMEM one-hot contraction:
+per row block, onehot(idx) @ table rides the MXU and costs ~0.3 ms/1M —
+~25x faster than the gather.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+try:
+    from jax.experimental import pallas as pl
+    from .hist_pallas import HAS_PALLAS, _round_up
+except ImportError:  # pragma: no cover
+    HAS_PALLAS = False
+
+# keep the in-kernel one-hot under ~4 MB so the scoped-VMEM budget holds at
+# any admitted table size
+_ONEHOT_BUDGET = 1 << 20  # f32 elements
+
+
+@functools.partial(jax.jit, static_argnames=("rows_per_block", "interpret"))
+def _take_pallas(idx: jax.Array, table: jax.Array, *,
+                 rows_per_block: int = 8192,
+                 interpret: bool = False) -> jax.Array:
+    n = idx.shape[0]
+    t = table.shape[0]
+    t_pad = _round_up(max(t, 1), 128)
+    if t_pad != t:
+        table = jnp.pad(table, (0, t_pad - t))
+    blk = min(rows_per_block, max(128, _ONEHOT_BUDGET // t_pad // 128 * 128))
+    blk = min(blk, max(128, _round_up(n, 128)))
+    n_pad = _round_up(max(n, 1), blk)
+    if n_pad != n:
+        idx = jnp.pad(idx, (0, n_pad - n))
+    nb = n_pad // blk
+    idx2 = idx[None, :]
+    table2 = table[None, :]
+
+    def kernel(idx_ref, tab_ref, out_ref):
+        ix = idx_ref[0, :]                                   # [blk] i32
+        iota = lax.iota(jnp.int32, t_pad)
+        onehot = (ix[:, None] == iota[None, :]).astype(jnp.float32)
+        # HIGHEST precision: the default TPU f32 matmul runs bf16 passes
+        # (~1e-3 rel error) — the one-hot payload must come through exact
+        out_ref[0, :] = lax.dot_general(
+            onehot, tab_ref[0, :][:, None], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=lax.Precision.HIGHEST)[:, 0]
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((1, blk), lambda i: (0, i)),
+            pl.BlockSpec((1, t_pad), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, blk), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((1, n_pad), jnp.float32),
+        interpret=interpret,
+    )(idx2, table2)
+    return out[0, :n]
+
+
+def take_small_table(table: jax.Array, idx: jax.Array) -> jax.Array:
+    """``table[idx]`` for f32 ``table`` [T<=2048] and i32 ``idx`` [n].
+
+    Out-of-range indices (e.g. -1) return 0.0.
+    """
+    if (HAS_PALLAS and jax.default_backend() == "tpu"
+            and table.shape[0] <= 2048 and idx.ndim == 1):
+        return _take_pallas(jnp.asarray(idx, jnp.int32),
+                            jnp.asarray(table, jnp.float32))
+    safe = jnp.clip(idx, 0, table.shape[0] - 1)
+    ok = (idx >= 0) & (idx < table.shape[0])
+    return jnp.where(ok, table[safe], 0.0)

@@ -38,6 +38,7 @@ from jax import shard_map
 from ..learner.grower import TreeArrays, grow_tree
 from ..ops.split import SplitHyper
 from .mesh import DATA_AXIS
+from ..ops.table import take_small_table
 
 
 def grow_tree_sharded(mesh: Mesh, bins: jax.Array, grad: jax.Array,
@@ -126,7 +127,8 @@ def train_step_sharded(mesh: Mesh, bins: jax.Array, scores: jax.Array,
             h = jnp.ones_like(sc)
         tree, leaf_of_row = grow_tree(b, g, h, m, nb, nanb, cat, None, hp,
                                       axis_name=DATA_AXIS)
-        new_scores = sc + learning_rate * tree.leaf_value[leaf_of_row]
+        new_scores = sc + learning_rate * take_small_table(tree.leaf_value,
+                                                           leaf_of_row)
         return tree, new_scores
 
     fn = shard_map(local, mesh=mesh, in_specs=in_specs,

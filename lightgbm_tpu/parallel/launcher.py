@@ -26,6 +26,7 @@ from typing import Any, Dict, Optional, Sequence
 import numpy as np
 
 from ..utils import log
+from ..ops.table import take_small_table
 
 
 def initialize(machines: Optional[str] = None,
@@ -158,7 +159,8 @@ def train_multihost(params: Dict[str, Any], data: np.ndarray,
             tree, leaf_of_row = grow_tree(b, g, h, mm > 0, num_bins, nan_bin,
                                           is_cat, None, hp,
                                           axis_name=DATA_AXIS)
-            return tree, sc + lr * tree.leaf_value[leaf_of_row]
+            return tree, sc + lr * take_small_table(tree.leaf_value,
+                                                    leaf_of_row)
 
         return shard_map(
             local_step, mesh=mesh,
