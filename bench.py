@@ -60,37 +60,41 @@ def main():
     nan_bin = jnp.full((f,), -1, jnp.int32)
     is_cat = jnp.zeros((f,), bool)
 
-    def step(scores, _):
-        sign = jnp.where(label_d > 0, 1.0, -1.0)
-        resp = -sign / (1.0 + jnp.exp(sign * scores))
-        grad = resp
-        hess = jnp.abs(resp) * (1.0 - jnp.abs(resp))
-        if SPLIT_BATCH > 1:
-            tree, leaf_of_row = grow_tree_batched(
-                bins_d, grad, hess, None, num_bins, nan_bin, is_cat, None,
-                hp, batch=SPLIT_BATCH)
-        else:
-            tree, leaf_of_row = grow_tree(bins_d, grad, hess, None, num_bins,
-                                          nan_bin, is_cat, None, hp)
-        from lightgbm_tpu.ops.table import take_small_table
-        return scores + 0.1 * take_small_table(tree.leaf_value,
-                                               leaf_of_row), None
-
     # All iterations inside ONE jit (docs/PERF_NOTES.md: the tunnel adds
     # ~100 ms per dispatched computation, so a Python-side loop times the
     # tunnel, not the learner; scores carry a data dependency across steps
-    # so iterations cannot be pipelined into an optimistic overlap).
+    # so iterations cannot be pipelined into an optimistic overlap).  Big
+    # arrays are ARGUMENTS, not closure constants — closure constants get
+    # embedded in the HLO and shipped through the tunnel's remote-compile
+    # on every compilation (294 MB of bins at Higgs scale).
     @jax.jit
-    def run(scores):
+    def run(scores, bins_a, label_a):
+        def step(scores, _):
+            sign = jnp.where(label_a > 0, 1.0, -1.0)
+            resp = -sign / (1.0 + jnp.exp(sign * scores))
+            grad = resp
+            hess = jnp.abs(resp) * (1.0 - jnp.abs(resp))
+            if SPLIT_BATCH > 1:
+                tree, leaf_of_row = grow_tree_batched(
+                    bins_a, grad, hess, None, num_bins, nan_bin, is_cat,
+                    None, hp, batch=SPLIT_BATCH)
+            else:
+                tree, leaf_of_row = grow_tree(bins_a, grad, hess, None,
+                                              num_bins, nan_bin, is_cat,
+                                              None, hp)
+            from lightgbm_tpu.ops.table import take_small_table
+            return scores + 0.1 * take_small_table(tree.leaf_value,
+                                                   leaf_of_row), None
+
         scores, _ = jax.lax.scan(step, scores, None, length=BENCH_ITERS)
         return scores
 
     scores = jnp.zeros(n, jnp.float32)
-    out = run(scores)              # compile + warmup
+    out = run(scores, bins_d, label_d)    # compile + warmup
     float(out[0])                  # force readback through the tunnel
 
     t0 = time.time()
-    out = run(scores)
+    out = run(scores, bins_d, label_d)
     float(out[0])
     elapsed = time.time() - t0
 

@@ -672,12 +672,20 @@ class Booster:
             tag = f"{ti}-S{node}"
             is_cat, default_left, missing_type = _decode_decision_type(
                 int(t.decision_type[node]))
+            if is_cat:
+                # reference reports the '||'-joined category set, not the
+                # internal cat-list index (reference basic.py
+                # trees_to_dataframe)
+                csi = int(t.cat_split_index[node])
+                thr_out = "||".join(str(c) for c in t.cat_threshold[csi])
+            else:
+                thr_out = float(t.threshold[node])
             row = dict(
                 tree_index=ti, node_depth=depth, node_index=tag,
                 parent_index=parent,
                 split_feature=names[int(t.split_feature[node])],
                 split_gain=float(t.split_gain[node]),
-                threshold=float(t.threshold[node]),
+                threshold=thr_out,
                 decision_type="==" if is_cat else "<=",
                 missing_direction="left" if default_left else "right",
                 missing_type=["None", "Zero", "NaN"][missing_type],

@@ -249,6 +249,12 @@ class GBDT:
             self.hp = dataclasses.replace(
                 self.hp, use_monotone=True,
                 monotone_penalty=float(config.monotone_penalty))
+            if str(config.monotone_constraints_method) not in ("basic",):
+                log.warning(
+                    "monotone_constraints_method=%s is not implemented; "
+                    "falling back to 'basic' (constraints are still "
+                    "enforced, splits are just gated more conservatively)"
+                    % config.monotone_constraints_method)
 
         isets = _parse_interaction_sets(config.interaction_constraints,
                                         train_set.used_feature_idx)

@@ -436,3 +436,54 @@ def as_config(params: ParamsLike) -> Config:
     if isinstance(params, Config):
         return params
     return Config(params or {})
+
+
+def generate_parameter_docs() -> str:
+    """Render docs/Parameters.md from the ``_PARAMS`` registry.
+
+    The registry is the single source of truth for names, aliases, defaults
+    and checks; the docs file is generated from it and CI-enforced to stay
+    in sync (reference: .ci/parameter-generator.py renders
+    docs/Parameters.rst from config.h structured comments, checked by
+    .ci/test.sh:155-158).  Regenerate with
+    ``python -m lightgbm_tpu.config``.
+    """
+    lines = [
+        "# Parameters",
+        "",
+        "Generated from `lightgbm_tpu/config.py` `_PARAMS` — do not edit by",
+        "hand; run `python -m lightgbm_tpu.config` after changing the",
+        "registry (a test asserts this file is in sync).",
+        "",
+        "Alias resolution is first-wins per canonical name; values accept",
+        "strings or typed values; constraints are enforced at `Config()`",
+        "construction.",
+        "",
+        "| Parameter | Default | Aliases | Constraints |",
+        "|---|---|---|---|",
+    ]
+    for name, default, aliases, checks in _PARAMS:
+        d = repr(default) if default != "" else "`\"\"`"
+        a = ", ".join(aliases) if aliases else "—"
+        c = ", ".join(f"{op} {val:g}" for op, val in checks) if checks \
+            else "—"
+        lines.append(f"| `{name}` | {d} | {a} | {c} |")
+    lines += [
+        "",
+        "## Objective aliases",
+        "",
+        "| Alias | Objective |",
+        "|---|---|",
+    ]
+    for alias in sorted(_OBJECTIVE_ALIASES):
+        lines.append(f"| `{alias}` | `{_OBJECTIVE_ALIASES[alias]}` |")
+    lines.append("")
+    return "\n".join(lines)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    import pathlib
+    out = pathlib.Path(__file__).resolve().parent.parent / "docs" / \
+        "Parameters.md"
+    out.write_text(generate_parameter_docs())
+    print(f"wrote {out}")

@@ -202,18 +202,9 @@ def _rows_leaves_hist(bins_rows: jax.Array, grad: jax.Array,
             bins_rows, grad, hess, lor, leaves, n_bins=n_bins,
             rows_per_block=min(rows_per_block, 2048),
             compute_dtype=jnp.dtype(hist_dtype).type)
-    K = leaves.shape[0]
-    sel = lor[None, :] == leaves[:, None]                     # [K, S]
-    m = sel.astype(grad.dtype)
-    vals = jnp.stack([grad[None, :] * m, hess[None, :] * m, m,
-                      jnp.zeros_like(m)], axis=0)             # [C, K, S]
-    C = vals.shape[0]
-    hist = histogram_rows_t(jnp.asarray(bins_rows).T,
-                            vals.reshape(C * K, -1), n_bins=n_bins,
-                            rows_per_block=rows_per_block,
-                            hist_dtype=hist_dtype)            # [F, B, C*K]
-    F, B = hist.shape[0], hist.shape[1]
-    return hist.reshape(F, B, C, K).transpose(3, 0, 1, 2)
+    return histogram_for_leaves_masked(
+        jnp.asarray(bins_rows).T, grad, hess, lor, leaves, None,
+        n_bins=n_bins, rows_per_block=rows_per_block, hist_dtype=hist_dtype)
 
 
 def histogram_for_leaves_auto(bins_rows: jax.Array, bins_t: jax.Array,

@@ -61,8 +61,59 @@ def initialize(machines: Optional[str] = None,
              f"{jax.device_count()} global device(s)")
 
 
-def train_multihost(params: Dict[str, Any], data: np.ndarray,
-                    label: np.ndarray,
+def load_rank_shard(path: str, params: Optional[Dict[str, Any]] = None,
+                    rank: Optional[int] = None,
+                    num_machines: Optional[int] = None):
+    """Load THIS rank's row shard of a text data file.
+
+    Parity with the reference's distributed loading
+    (``DatasetLoader::LoadFromFile(filename, rank, num_machines)``,
+    dataset_loader.h:23): when ``pre_partition=true`` the file is assumed to
+    already contain only this machine's rows and is loaded whole; otherwise
+    every rank reads the shared file and keeps its deterministic row stripe
+    (round-robin by row index — the reference uses a seeded random
+    assignment, dataset_loader.cpp; any agreed disjoint cover works because
+    the shards are only ever consumed by order-insensitive histogram sums).
+
+    Returns ``(features, label, meta)`` — feed to :func:`train_multihost`.
+    ``rank``/``num_machines`` default to the live jax.distributed process.
+    """
+    import jax
+
+    from ..config import Config, normalize_params
+    from ..io.parser import load_text_file
+
+    cfg = Config(normalize_params(params or {}))
+    if rank is None:
+        rank = jax.process_index()
+    if num_machines is None:
+        num_machines = jax.process_count()
+    feats, label, meta = load_text_file(path, cfg)
+    if bool(cfg.pre_partition) or num_machines <= 1:
+        return feats, label, meta
+    n = feats.shape[0]
+    if meta.get("group") is not None and len(meta["group"]):
+        # ranking data: stripe whole QUERIES, not rows — a query's rows must
+        # stay on one rank (reference distributed loading keeps query
+        # boundaries intact; per-query lambda gradients need them together)
+        sizes = np.asarray(meta["group"], np.int64)
+        qid_of_row = np.repeat(np.arange(sizes.shape[0]), sizes)
+        keep_q = np.arange(sizes.shape[0]) % num_machines == rank
+        keep = keep_q[qid_of_row]
+        meta = dict(meta)
+        meta["group"] = sizes[keep_q]
+    else:
+        keep = np.arange(n) % num_machines == rank
+    feats = feats[keep]
+    label = label[keep] if label is not None else None
+    meta = {k: (np.asarray(v)[keep] if np.ndim(v) and
+                hasattr(v, "__len__") and len(v) == n else v)
+            for k, v in meta.items()}
+    return feats, label, meta
+
+
+def train_multihost(params: Dict[str, Any], data,
+                    label: Optional[np.ndarray] = None,
                     weight: Optional[np.ndarray] = None,
                     num_boost_round: int = 100):
     """Data-parallel training from per-process row shards.
@@ -89,13 +140,27 @@ def train_multihost(params: Dict[str, Any], data: np.ndarray,
 
     params = normalize_params(params)
     cfg = Config(params)
+    if isinstance(data, (str, os.PathLike)):
+        data, flabel, fmeta = load_rank_shard(str(data), params)
+        if label is None:
+            label = flabel
+        if weight is None:
+            weight = fmeta.get("weight")
+    if label is None:
+        log.fatal("train_multihost: label is required (pass label= or a "
+                  "data file whose label column is set)")
     data = np.asarray(data, np.float64)
     label = np.asarray(label)
     n_local = data.shape[0]
     n_proc = jax.process_count()
 
-    # ---- agree on bin mappers: gather a per-process sample of raw rows
-    per = max(1, min(n_local, int(cfg.bin_construct_sample_cnt) // n_proc))
+    # ---- agree on bin mappers: gather a per-process sample of raw rows.
+    # The sample size must be identical on every rank (allgather needs equal
+    # shapes), so agree on the global MIN shard size first.
+    n_all = np.asarray(multihost_utils.process_allgather(
+        jnp.asarray([n_local], jnp.int32)))
+    n_min = int(n_all.min())
+    per = max(1, min(n_min, int(cfg.bin_construct_sample_cnt) // n_proc))
     rng = np.random.default_rng(int(cfg.data_random_seed))
     idx = rng.choice(n_local, size=per, replace=False) if per < n_local \
         else np.arange(n_local)
@@ -113,12 +178,16 @@ def train_multihost(params: Dict[str, Any], data: np.ndarray,
     mesh = Mesh(np.array(jax.devices()), (DATA_AXIS,))
     sharding = NamedSharding(mesh, P(DATA_AXIS))
     n_dev = jax.device_count()
-    # pad local rows so every process shard splits evenly over its devices
+    # every process pads to the GLOBAL max shard size (rounded up to its
+    # device count) so all ranks agree on the assembled global shape even
+    # when row striping left them unequal row counts
     dev_per_proc = max(1, n_dev // n_proc)
-    pad = (-n_local) % dev_per_proc
+    n_max = int(n_all.max())
+    per_proc = n_max + ((-n_max) % dev_per_proc)
+    pad = per_proc - n_local
     bins_l = np.pad(local.bins, ((0, pad), (0, 0)))
     mask_l = np.pad(np.ones(n_local, bool), (0, pad))
-    g_shape = (bins_l.shape[0] * n_proc,)
+    g_shape = (per_proc * n_proc,)
 
     bins_g = jax.make_array_from_process_local_data(
         sharding, bins_l, (g_shape[0], bins_l.shape[1]))

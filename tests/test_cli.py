@@ -98,3 +98,15 @@ def test_python_dash_m_entry(tmp_path):
         timeout=300)
     assert r.returncode == 0, r.stderr
     assert model.exists()
+
+
+def test_parameter_docs_in_sync():
+    """docs/Parameters.md is generated from the _PARAMS registry and must
+    not drift (reference .ci/test.sh:155-158 regenerates config_auto.cpp and
+    fails CI on diff)."""
+    import pathlib
+    from lightgbm_tpu.config import generate_parameter_docs
+    doc = pathlib.Path(__file__).resolve().parent.parent / "docs" / \
+        "Parameters.md"
+    assert doc.read_text() == generate_parameter_docs(), \
+        "docs/Parameters.md is stale; run python -m lightgbm_tpu.config"

@@ -27,15 +27,17 @@ WORKER = textwrap.dedent("""
     launcher.initialize(machines=machines)
 
     rng = np.random.default_rng(123)  # same stream on both ranks
-    n, f = 4000, 8
+    n, f = 4001, 8
     X = rng.normal(size=(n, f))
     w = rng.normal(size=f)
     y = ((X @ w) > 0).astype(np.float64)
-    lo, hi = rank * n // 2, (rank + 1) * n // 2  # row shard for this rank
 
+    # reference-CLI-style path: every rank opens the SHARED data file and
+    # keeps its own row stripe (DatasetLoader::LoadFromFile(file, rank,
+    # num_machines) parity)
     bst = launcher.train_multihost(
         {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 5,
-         "verbose": -1}, X[lo:hi], y[lo:hi], num_boost_round=10)
+         "verbose": -1}, os.environ["LGBTPU_DATA"], num_boost_round=10)
     preds = bst.predict(X)
     acc = float(((preds > 0.5) == y).mean())
     bst.save_model(f"{outdir}/model_rank{rank}.txt")
@@ -57,13 +59,20 @@ def _free_port():
 def test_two_process_data_parallel(tmp_path):
     script = tmp_path / "worker.py"
     script.write_text(WORKER)
+    # shared train file (TSV, label col 0) that every rank stripe-loads
+    rng = np.random.default_rng(123)
+    n, f = 4001, 8
+    X = rng.normal(size=(n, f))
+    y = ((X @ rng.normal(size=f)) > 0).astype(np.float64)
+    datafile = tmp_path / "train.tsv"
+    np.savetxt(datafile, np.column_stack([y, X]), delimiter="\t", fmt="%.9g")
     port = _free_port()
     machines = f"127.0.0.1:{port},127.0.0.1:{_free_port()}"
     procs = []
     for rank in range(N_PROC):
         env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
         env.update(LGBTPU_RANK=str(rank), LGBTPU_MACHINES=machines,
-                   LGBTPU_OUT=str(tmp_path))
+                   LGBTPU_OUT=str(tmp_path), LGBTPU_DATA=str(datafile))
         procs.append(subprocess.Popen([sys.executable, str(script)],
                                       env=env, stdout=subprocess.PIPE,
                                       stderr=subprocess.STDOUT, text=True))
