@@ -246,15 +246,21 @@ class GBDT:
             packed = full[np.asarray(train_set.used_feature_idx)]
             packed[np.asarray(train_set.categorical_array())] = 0
             self.monotone_arr = jnp.asarray(packed)
+            method = str(config.monotone_constraints_method)
+            if method not in ("basic", "intermediate", "advanced"):
+                log.fatal("unknown monotone_constraints_method=%r (expected "
+                          "basic/intermediate/advanced)" % method)
+            if method == "advanced":
+                # the advanced method's extra is per-THRESHOLD constraint
+                # refinement inside split finding
+                # (monotone_constraints.hpp:858); intermediate bounds are the
+                # closest implemented semantics
+                log.warning("monotone_constraints_method=advanced is not "
+                            "implemented; using 'intermediate'")
+                method = "intermediate"
             self.hp = dataclasses.replace(
-                self.hp, use_monotone=True,
+                self.hp, use_monotone=True, monotone_method=method,
                 monotone_penalty=float(config.monotone_penalty))
-            if str(config.monotone_constraints_method) not in ("basic",):
-                log.warning(
-                    "monotone_constraints_method=%s is not implemented; "
-                    "falling back to 'basic' (constraints are still "
-                    "enforced, splits are just gated more conservatively)"
-                    % config.monotone_constraints_method)
 
         isets = _parse_interaction_sets(config.interaction_constraints,
                                         train_set.used_feature_idx)

@@ -151,6 +151,8 @@ class _GrowState(NamedTuple):
     parent_side: jax.Array     # i32 [L] 0 left / 1 right
     leaf_min: jax.Array        # f32 [L] output lower bound (monotone)
     leaf_max: jax.Array        # f32 [L] output upper bound
+    leaf_lo: jax.Array         # i32 [L, F] bin-space box lower (intermediate
+    leaf_hi: jax.Array         # i32 [L, F] monotone method; dummy [1,1] else)
     path_feats: jax.Array      # bool [L, F] features used on leaf's path
     force_failed: jax.Array    # bool scalar — forced-split BFS aborted
     done: jax.Array            # bool scalar
@@ -419,6 +421,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     best0 = child_best(hist0_b, g0, h0, c0, jnp.int32(0), fm_root,
                        root_out, -inf, inf, key_er, pen=pen0)
 
+    use_boxes = hp.use_monotone and hp.monotone_method == "intermediate"
     tree = _empty_tree(L, hp.n_bins, num_f)
     tree = tree._replace(
         leaf_value=tree.leaf_value.at[0].set(root_out),
@@ -448,6 +451,11 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         parent_side=jnp.zeros((L,), jnp.int32),
         leaf_min=jnp.full((L,), -_INF_BOUND, jnp.float32),
         leaf_max=jnp.full((L,), _INF_BOUND, jnp.float32),
+        leaf_lo=(jnp.zeros((L, num_f), jnp.int32)
+                 if use_boxes else jnp.zeros((1, 1), jnp.int32)),
+        leaf_hi=(jnp.zeros((L, num_f), jnp.int32)
+                 .at[0].set(num_bins.astype(jnp.int32))
+                 if use_boxes else jnp.zeros((1, 1), jnp.int32)),
         path_feats=jnp.zeros((L, num_f), bool),
         force_failed=jnp.bool_(False),
         done=jnp.bool_(False),
@@ -617,9 +625,11 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             ro = smoothed_output(rg, rh, rcn, parent_out, hp.lambda_l1,
                                  l2_eff, hp)
             lmin_p, lmax_p = st.leaf_min[bl], st.leaf_max[bl]
+            use_boxes = hp.use_monotone and hp.monotone_method == "intermediate"
             if hp.use_monotone:
                 lo = jnp.clip(lo, lmin_p, lmax_p)
                 ro = jnp.clip(ro, lmin_p, lmax_p)
+            if hp.use_monotone and not use_boxes:
                 mono_f = monotone[feat]
                 is_num = ~catl
                 mid = (lo + ro) * 0.5
@@ -663,6 +673,32 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                 leaf_count=t.leaf_count.at[bl].set(lcn).at[new_leaf].set(rcn),
                 leaf_weight=t.leaf_weight.at[bl].set(lh).at[new_leaf].set(rh),
             )
+
+            if use_boxes:
+                # intermediate monotone: update bin-space boxes, then refresh
+                # EVERY leaf's [min, max] from the actual current outputs via
+                # dense box adjacency (learner/monotone.py — the TPU-native
+                # equivalent of the reference's GoUp/GoDown constraint walks,
+                # monotone_constraints.hpp:516+).  Cached best-split GAINS of
+                # other leaves may lag one refresh (the reference re-queues
+                # them); output CLIPPING always uses fresh bounds, so grown
+                # trees stay monotone either way.
+                from .monotone import box_bounds, split_boxes
+                leaf_lo, leaf_hi = split_boxes(
+                    st.leaf_lo, st.leaf_hi, bl, new_leaf, f_safe, thr, ~catl)
+                mono_lower, mono_upper = box_bounds(
+                    leaf_lo, leaf_hi, t.leaf_value, monotone,
+                    jnp.int32(new_leaf) + 1)
+                lmin_l, lmax_l = mono_lower[bl], mono_upper[bl]
+                lmin_r, lmax_r = mono_lower[new_leaf], mono_upper[new_leaf]
+                leaf_min_new = mono_lower
+                leaf_max_new = mono_upper
+            else:
+                leaf_lo, leaf_hi = st.leaf_lo, st.leaf_hi
+                leaf_min_new = st.leaf_min.at[bl].set(lmin_l) \
+                                          .at[new_leaf].set(lmin_r)
+                leaf_max_new = st.leaf_max.at[bl].set(lmax_l) \
+                                          .at[new_leaf].set(lmax_r)
 
             child_path = st.path_feats[bl].at[f_safe].set(True)
             if rng_key is not None:
@@ -722,8 +758,10 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                                    .at[new_leaf].set(bs_r.left_count),
                 parent_node=st.parent_node.at[bl].set(i).at[new_leaf].set(i),
                 parent_side=st.parent_side.at[bl].set(0).at[new_leaf].set(1),
-                leaf_min=st.leaf_min.at[bl].set(lmin_l).at[new_leaf].set(lmin_r),
-                leaf_max=st.leaf_max.at[bl].set(lmax_l).at[new_leaf].set(lmax_r),
+                leaf_min=leaf_min_new,
+                leaf_max=leaf_max_new,
+                leaf_lo=leaf_lo,
+                leaf_hi=leaf_hi,
                 path_feats=st.path_feats.at[bl].set(child_path)
                                         .at[new_leaf].set(child_path),
                 cegb_used=cegb_used,

@@ -45,6 +45,26 @@ def _round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
+def _pick_fc(num_f: int, requested: int = 0) -> int:
+    """Feature-chunk size minimizing feature padding (0 = auto).
+
+    The kernel pads F up to a multiple of the chunk; a chunk that divides F
+    exactly (e.g. 14 for Higgs' 28 features instead of a fixed 8, which
+    padded to 32) cuts ~15% of one-hot work — measured ~5.5 vs ~7.3 ms per
+    full 1M-row pass (docs/PERF_NOTES.md).
+    """
+    if requested:
+        return min(requested, num_f)
+    if num_f <= 16:
+        return num_f
+    best, best_pad = 8, _round_up(num_f, 8)
+    for fc in (16, 15, 14, 13, 12, 11, 10, 9, 8, 7, 6, 5, 4):
+        pad = _round_up(num_f, fc)
+        if pad < best_pad or (pad == best_pad and fc > best):
+            best, best_pad = fc, pad
+    return best
+
+
 def _prec(compute_dtype):
     """MXU precision for the one-hot contraction.
 
@@ -62,7 +82,7 @@ def _prec(compute_dtype):
                                     "feats_per_chunk", "compute_dtype",
                                     "interpret"))
 def histogram_pallas(bins_t: jax.Array, vals_t: jax.Array, *, n_bins: int,
-                     rows_per_block: int = 2048, feats_per_chunk: int = 8,
+                     rows_per_block: int = 2048, feats_per_chunk: int = 0,
                      compute_dtype=jnp.bfloat16,
                      interpret: bool = False) -> jax.Array:
     """hist[f, b, c] from transposed operands.
@@ -77,7 +97,7 @@ def histogram_pallas(bins_t: jax.Array, vals_t: jax.Array, *, n_bins: int,
     if n_pad != n:
         bins_t = jnp.pad(bins_t, ((0, 0), (0, n_pad - n)))
         vals_t = jnp.pad(vals_t, ((0, 0), (0, n_pad - n)))
-    fc = min(feats_per_chunk, num_f)
+    fc = _pick_fc(num_f, feats_per_chunk)
     f_pad = _round_up(num_f, fc)
     if f_pad != num_f:
         bins_t = jnp.pad(bins_t, ((0, f_pad - num_f), (0, 0)))
@@ -128,7 +148,7 @@ def _histogram_leaves_impl(bins: jax.Array, grad: jax.Array,
                            hess: jax.Array, leaf_of_row: jax.Array,
                            leaves: jax.Array, *, n_bins: int,
                            rows_per_block: int = 2048,
-                           feats_per_chunk: int = 8,
+                           feats_per_chunk: int = 0,
                            compute_dtype=jnp.bfloat16,
                            rows_major: bool = False,
                            interpret: bool = False) -> jax.Array:
@@ -162,7 +182,7 @@ def _histogram_leaves_impl(bins: jax.Array, grad: jax.Array,
         hess = jnp.pad(hess, (0, n_pad - n))
         leaf_of_row = jnp.pad(leaf_of_row, (0, n_pad - n),
                               constant_values=-1)
-    fc = min(feats_per_chunk, num_f)
+    fc = _pick_fc(num_f, feats_per_chunk)
     f_pad = _round_up(num_f, fc)
     if f_pad != num_f:
         feat_pad = ((0, 0), (0, f_pad - num_f)) if rows_major \

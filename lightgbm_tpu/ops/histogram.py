@@ -73,7 +73,7 @@ def histogram_rows_t(bins_t: jax.Array, vals_t: jax.Array, *, n_bins: int,
     if use_pallas():
         from .hist_pallas import histogram_pallas
         return histogram_pallas(bins_t, vals_t, n_bins=n_bins,
-                                rows_per_block=min(rows_per_block, 2048),
+                                rows_per_block=min(rows_per_block, 1024),
                                 compute_dtype=jnp.dtype(hist_dtype).type)
     return build_histogram(bins_t.T, vals_t.T, n_bins=n_bins,
                            rows_per_block=rows_per_block)
@@ -171,7 +171,7 @@ def histogram_for_leaves_masked(bins_t: jax.Array, grad: jax.Array,
         from .hist_pallas import histogram_leaves_pallas
         hist = histogram_leaves_pallas(
             bins_t, grad, hess, lor, leaves, n_bins=n_bins,
-            rows_per_block=min(rows_per_block, 2048),
+            rows_per_block=min(rows_per_block, 1024),
             compute_dtype=jnp.dtype(hist_dtype).type)         # [K, F, B, C]
     else:
         sel = lor[None, :] == leaves[:, None]                 # [K, n]
@@ -200,7 +200,7 @@ def _rows_leaves_hist(bins_rows: jax.Array, grad: jax.Array,
         from .hist_pallas import histogram_leaves_rows_pallas
         return histogram_leaves_rows_pallas(
             bins_rows, grad, hess, lor, leaves, n_bins=n_bins,
-            rows_per_block=min(rows_per_block, 2048),
+            rows_per_block=min(rows_per_block, 1024),
             compute_dtype=jnp.dtype(hist_dtype).type)
     return histogram_for_leaves_masked(
         jnp.asarray(bins_rows).T, grad, hess, lor, leaves, None,

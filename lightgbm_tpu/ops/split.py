@@ -54,6 +54,11 @@ class SplitHyper:
     # runtime array argument
     use_monotone: bool = False
     monotone_penalty: float = 0.0
+    # "basic": midpoint bounds inherited down the path
+    # (monotone_constraints.hpp:465); "intermediate": per-leaf bounds from
+    # actual adjacent-leaf outputs via dense box adjacency, refreshed every
+    # split (learner/monotone.py; reference :516 IntermediateLeafConstraints)
+    monotone_method: str = "basic"
     # extra-trees mode: one random threshold per (feature, node)
     # (reference USE_RAND template paths in feature_histogram)
     extra_trees: bool = False

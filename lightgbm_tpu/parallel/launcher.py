@@ -146,6 +146,10 @@ def train_multihost(params: Dict[str, Any], data,
             label = flabel
         if weight is None:
             weight = fmeta.get("weight")
+        if fmeta.get("group") is not None and len(fmeta["group"]):
+            log.fatal("train_multihost does not support ranking objectives "
+                      "yet; load_rank_shard stripes whole queries, but the "
+                      "multihost step only implements binary/l2")
     if label is None:
         log.fatal("train_multihost: label is required (pass label= or a "
                   "data file whose label column is set)")

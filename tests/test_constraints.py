@@ -172,3 +172,73 @@ def test_max_delta_step_limits_outputs():
                      "learning_rate": 1.0}, ds, num_boost_round=3)
     for t in bst._gbdt.models:
         assert np.all(np.abs(t.leaf_value - t.bias) <= 0.01 + 1e-6)
+
+
+@pytest.mark.parametrize("method", ["intermediate", "advanced"])
+def test_monotone_intermediate_enforced(method):
+    """Intermediate method (dense box-adjacency bounds, learner/monotone.py;
+    reference monotone_constraints.hpp:516) keeps predictions monotone;
+    'advanced' falls back to intermediate with a warning."""
+    X, y = _monotone_data()
+    ds = lgb.Dataset(X, label=y, params=FAST)
+    bst = lgb.train({**FAST, "objective": "regression",
+                     "monotone_constraints": [1, -1, 0],
+                     "monotone_constraints_method": method,
+                     "num_leaves": 31}, ds, num_boost_round=40)
+    assert _is_monotone(bst, 0, +1)
+    assert _is_monotone(bst, 1, -1)
+
+
+def test_monotone_intermediate_not_worse_than_basic():
+    """Looser-but-sound bounds from actual outputs should fit at least as
+    well as basic's midpoint bounds (reference test_monotone_constraints
+    quality ordering basic <= intermediate <= advanced)."""
+    X, y = _monotone_data()
+    fits = {}
+    for method in ("basic", "intermediate"):
+        ds = lgb.Dataset(X, label=y, params=FAST)
+        bst = lgb.train({**FAST, "objective": "regression",
+                         "monotone_constraints": [1, -1, 0],
+                         "monotone_constraints_method": method,
+                         "num_leaves": 31}, ds, num_boost_round=40)
+        pred = bst.predict(X)
+        fits[method] = float(np.mean((pred - y) ** 2))
+    assert fits["intermediate"] <= fits["basic"] * 1.02, fits
+
+
+def test_box_bounds_identical_boxes_no_constraint():
+    """Siblings of a categorical split keep the parent box (identical
+    boxes overlap in ALL features) — they are ordered along nothing and must
+    not constrain each other (learner/monotone.py)."""
+    import jax.numpy as jnp
+    from lightgbm_tpu.learner.monotone import box_bounds
+    lo = jnp.zeros((2, 2), jnp.int32)
+    hi = jnp.full((2, 2), 10, jnp.int32)
+    lower, upper = box_bounds(lo, hi, jnp.asarray([0.3, -0.7]),
+                              jnp.asarray([-1, 0]), jnp.int32(2))
+    assert float(upper[0]) > 1e29 and float(upper[1]) > 1e29
+    assert float(lower[0]) < -1e29 and float(lower[1]) < -1e29
+
+
+def test_monotone_intermediate_with_categorical():
+    """Intermediate bounds stay sound across categorical splits (children
+    keep the parent box — conservative, like the reference's unconditional
+    walk through categorical splits)."""
+    rng = np.random.default_rng(5)
+    n = 2500
+    cat = rng.integers(0, 5, n).astype(float)
+    x = rng.uniform(-1, 1, n)
+    y = 3 * x + np.sin(3 * x) + 0.8 * (cat % 2) + \
+        rng.normal(scale=0.15, size=n)
+    X = np.stack([x, cat], axis=1)
+    ds = lgb.Dataset(X, label=y, categorical_feature=[1], params=FAST)
+    bst = lgb.train({**FAST, "objective": "regression",
+                     "monotone_constraints": [1, 0],
+                     "monotone_constraints_method": "intermediate",
+                     "num_leaves": 31}, ds, num_boost_round=40)
+    # sweep x at each category value
+    for c in range(5):
+        grid = np.linspace(-1, 1, 50)
+        Xs = np.stack([grid, np.full(50, float(c))], axis=1)
+        pred = bst.predict(Xs)
+        assert (np.diff(pred) >= -1e-9).all(), c
