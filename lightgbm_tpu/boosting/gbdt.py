@@ -393,6 +393,38 @@ class GBDT:
             return g[:, None], h[:, None]
         return self.objective.get_gradients(self.scores)
 
+    def _debug_check_tree(self, arrays, leaf_of_row, row_mask) -> None:
+        """Per-tree invariant checks (reference cuda_single_gpu_tree_learner
+        DEBUG CheckSplitValid :571 and host/device cross-checks :93-95):
+        leaf assignment bounds, leaf-count bookkeeping vs the actual
+        partition, and child-pointer sanity.  Enabled by
+        ``tpu_debug_checks=true``; costs one device->host sync per tree."""
+        nl = int(arrays.num_leaves)
+        lor = np.asarray(leaf_of_row)
+        if lor.min() < 0 or lor.max() >= nl:
+            log.fatal("debug check: leaf_of_row out of range [0, %d): "
+                      "min=%d max=%d" % (nl, lor.min(), lor.max()))
+        mask = np.ones(lor.shape[0], bool) if row_mask is None \
+            else np.asarray(row_mask)
+        counts = np.bincount(lor[mask], minlength=self.hp.num_leaves)
+        stored = np.asarray(arrays.leaf_count)
+        if not np.allclose(counts[:nl], stored[:nl], atol=0.5):
+            bad = np.nonzero(~np.isclose(counts[:nl], stored[:nl],
+                                         atol=0.5))[0]
+            log.fatal("debug check: leaf_count mismatch at leaves %s "
+                      "(partition %s vs stored %s)"
+                      % (bad[:5], counts[bad[:5]], stored[bad[:5]]))
+        lc = np.asarray(arrays.left_child)[:nl - 1]
+        rc = np.asarray(arrays.right_child)[:nl - 1]
+        for side, arr in (("left", lc), ("right", rc)):
+            # child encoding: negative = leaf (-(leaf+1)), positive = node
+            if (arr >= nl - 1).any():
+                log.fatal("debug check: %s child node index out of range"
+                          % side)
+            if (-arr - 1 >= self.hp.num_leaves).any():
+                log.fatal("debug check: %s child leaf index out of range"
+                          % side)
+
     def train_one_iter(self, grad: Optional[np.ndarray] = None,
                        hess: Optional[np.ndarray] = None) -> bool:
         """One boosting iteration (reference gbdt.cpp:344 TrainOneIter).
@@ -444,6 +476,8 @@ class GBDT:
             num_leaves = int(arrays.num_leaves)
             if num_leaves > 1:
                 finished = False
+            if bool(self.config.tpu_debug_checks):
+                self._debug_check_tree(arrays, leaf_of_row, row_mask)
             if bool(self.config.use_quantized_grad) and \
                     bool(self.config.quant_train_renew_leaf) and num_leaves > 1:
                 renewed = renew_leaf_values(

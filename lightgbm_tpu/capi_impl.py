@@ -115,3 +115,148 @@ def booster_num_classes(b_id: int) -> int:
 
 def free_handle(h: int) -> None:
     _handles.pop(h, None)
+
+
+def _arr_i32(ptr: int, n: int) -> np.ndarray:
+    return np.ctypeslib.as_array(
+        ctypes.cast(ptr, ctypes.POINTER(ctypes.c_int32)), shape=(n,))
+
+
+def dataset_from_csr(indptr_ptr: int, indices_ptr: int, data_ptr: int,
+                     nrow: int, nnz: int, ncol: int, label_ptr: int,
+                     params_json: str) -> int:
+    """LGBM_DatasetCreateFromCSR (c_api.h:340) equivalent."""
+    import lightgbm_tpu as lgb
+    indptr = _arr_i32(indptr_ptr, nrow + 1)
+    indices = _arr_i32(indices_ptr, nnz)
+    vals = _arr_f64(data_ptr, nnz)
+    dense = np.zeros((nrow, ncol), np.float64)
+    rows = np.repeat(np.arange(nrow), np.diff(indptr))
+    dense[rows, indices] = vals
+    label = _arr_f64(label_ptr, nrow).copy() if label_ptr else None
+    params = json.loads(params_json) if params_json else {}
+    ds = lgb.Dataset(dense, label=label, params=params)
+    ds.construct()
+    return _new_handle(ds)
+
+
+class _StreamCollector:
+    """Streaming push target (reference LGBM_DatasetInitStreaming
+    c_api.h:177 + LGBM_DatasetPushRows :203): rows arrive in chunks from
+    any producer; MarkFinished constructs the binned Dataset."""
+
+    def __init__(self, ncol: int, params: Dict[str, Any]):
+        self.ncol = ncol
+        self.params = params
+        self.chunks = []
+        self.labels = []
+        self.finished = None
+
+    def push(self, rows: np.ndarray, label) -> None:
+        if self.finished is not None:
+            raise ValueError("dataset already marked finished")
+        if rows.shape[1] != self.ncol:
+            raise ValueError(f"pushed ncol {rows.shape[1]} != declared "
+                             f"ncol {self.ncol}")
+        if self.chunks and (label is None) != (not self.labels):
+            raise ValueError("label must be passed on every push or none "
+                             "(chunk labels would misalign)")
+        self.chunks.append(rows.copy())
+        if label is not None:
+            self.labels.append(label.copy())
+
+    def finish(self):
+        import lightgbm_tpu as lgb
+        data = np.concatenate(self.chunks, axis=0) if self.chunks \
+            else np.zeros((0, self.ncol))
+        label = np.concatenate(self.labels) if self.labels else None
+        if label is not None and len(label) != data.shape[0]:
+            raise ValueError(f"{len(label)} labels for {data.shape[0]} rows")
+        ds = lgb.Dataset(data, label=label, params=self.params)
+        ds.construct()
+        self.finished = ds
+        return ds
+
+
+def dataset_init_streaming(ncol: int, params_json: str) -> int:
+    params = json.loads(params_json) if params_json else {}
+    return _new_handle(_StreamCollector(ncol, params))
+
+
+def dataset_push_rows(h: int, data_ptr: int, nrow: int, ncol: int,
+                      label_ptr: int) -> None:
+    col = _handles[h]
+    if not isinstance(col, _StreamCollector):
+        raise TypeError("handle is not a streaming dataset")
+    rows = _arr_f64(data_ptr, nrow * ncol).reshape(nrow, ncol)
+    label = _arr_f64(label_ptr, nrow) if label_ptr else None
+    col.push(rows, label)
+
+
+def dataset_mark_finished(h: int) -> None:
+    """After this, the handle behaves as a constructed Dataset."""
+    col = _handles[h]
+    if not isinstance(col, _StreamCollector):
+        raise TypeError("handle is not a streaming dataset")
+    _handles[h] = col.finish()
+
+
+def dataset_num_data(ds_id: int) -> int:
+    return int(_handles[ds_id].num_data())
+
+
+def dataset_num_feature(ds_id: int) -> int:
+    return int(_handles[ds_id].num_feature())
+
+
+def booster_add_valid_data(b_id: int, ds_id: int) -> None:
+    """LGBM_BoosterAddValidData (c_api.h:703) equivalent."""
+    _handles[b_id].add_valid(_handles[ds_id], f"valid_{ds_id}")
+
+
+def booster_get_eval(b_id: int, data_idx: int, out_ptr: int,
+                     out_capacity: int) -> int:
+    """LGBM_BoosterGetEval (c_api.h:910): data_idx 0 = train, 1.. = valid;
+    writes metric values, returns how many."""
+    b = _handles[b_id]
+    if data_idx == 0:
+        res = b.eval_train()
+    else:
+        names = list(getattr(b._gbdt, "valid_names", []))
+        if data_idx - 1 >= len(names):
+            raise IndexError(f"data_idx {data_idx} out of range: "
+                             f"{len(names)} valid set(s)")
+        name = names[data_idx - 1]
+        res = [r for r in b.eval_valid() if r[0] == name]
+    vals = [float(r[2]) for r in res]
+    if len(vals) > out_capacity:
+        raise ValueError(f"eval needs {len(vals)} doubles, buffer holds "
+                         f"{out_capacity}")
+    out = _arr_f64(out_ptr, len(vals))
+    out[:] = vals
+    return len(vals)
+
+
+def booster_rollback_one_iter(b_id: int) -> None:
+    """LGBM_BoosterRollbackOneIter (c_api.h:817) equivalent."""
+    _handles[b_id].rollback_one_iter()
+
+
+def booster_current_iteration(b_id: int) -> int:
+    return int(_handles[b_id].current_iteration())
+
+
+def booster_save_model_to_string(b_id: int, out_ptr: int,
+                                 out_capacity: int) -> int:
+    """LGBM_BoosterSaveModelToString: writes NUL-terminated model text,
+    returns required size INCLUDING the terminator (call with capacity 0 to
+    size the buffer, like the reference's out_len contract)."""
+    s = _handles[b_id].model_to_string().encode()
+    need = len(s) + 1
+    if out_capacity >= need:
+        buf = np.ctypeslib.as_array(
+            ctypes.cast(out_ptr, ctypes.POINTER(ctypes.c_char)),
+            shape=(need,))
+        buf[:need - 1] = np.frombuffer(s, dtype="S1")
+        buf[need - 1] = b"\x00"
+    return need

@@ -231,6 +231,7 @@ _PARAMS: List[Tuple[str, Any, Tuple[str, ...], Tuple[Tuple[str, float], ...]]] =
     ("gpu_use_dp", False, (), ()),
     ("num_gpu", 1, (), ((">", 0),)),
     ("tpu_hist_dtype", "bfloat16", (), ()),      # hist product dtype (float32 = exact parity mode)
+    ("tpu_debug_checks", False, (), ()),         # per-tree invariant checks (reference DEBUG CheckSplitValid)
     ("tpu_rows_per_block", 16384, (), ()),        # histogram kernel row tile
     ("tpu_leaf_hist", "masked", (), ()),          # per-leaf hist: masked|bucketed
     ("tpu_split_batch", 1, (), ((">", 0),)),      # splits per histogram pass

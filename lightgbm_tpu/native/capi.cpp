@@ -218,6 +218,161 @@ int LGBMTPU_BoosterNumTrees(int64_t booster, int* out) {
   });
 }
 
+int LGBMTPU_DatasetCreateFromCSR(const int32_t* indptr,
+                                 const int32_t* indices, const double* data,
+                                 int64_t nrow, int64_t nnz, int64_t ncol,
+                                 const double* label,
+                                 const char* params_json, int64_t* out) {
+  return WithGIL([&] {
+    PyObject* args = Py_BuildValue(
+        "(LLLLLLLs)", (long long)(intptr_t)indptr,
+        (long long)(intptr_t)indices, (long long)(intptr_t)data,
+        (long long)nrow, (long long)nnz, (long long)ncol,
+        (long long)(intptr_t)label, params_json ? params_json : "{}");
+    PyObject* r = CallImpl("dataset_from_csr", args);
+    Py_XDECREF(args);
+    if (!r) return -1;
+    *out = PyLong_AsLongLong(r);
+    Py_DECREF(r);
+    return 0;
+  });
+}
+
+// Streaming ingestion (reference LGBM_DatasetInitStreaming c_api.h:177,
+// LGBM_DatasetPushRows :203): push chunks from any producer, then
+// MarkFinished to bin and finalize the dataset in place.
+int LGBMTPU_DatasetInitStreaming(int64_t ncol, const char* params_json,
+                                 int64_t* out) {
+  return WithGIL([&] {
+    PyObject* args = Py_BuildValue("(Ls)", (long long)ncol,
+                                   params_json ? params_json : "{}");
+    PyObject* r = CallImpl("dataset_init_streaming", args);
+    Py_XDECREF(args);
+    if (!r) return -1;
+    *out = PyLong_AsLongLong(r);
+    Py_DECREF(r);
+    return 0;
+  });
+}
+
+int LGBMTPU_DatasetPushRows(int64_t dataset, const double* data,
+                            int64_t nrow, int64_t ncol,
+                            const double* label) {
+  return WithGIL([&] {
+    PyObject* args = Py_BuildValue(
+        "(LLLLL)", (long long)dataset, (long long)(intptr_t)data,
+        (long long)nrow, (long long)ncol, (long long)(intptr_t)label);
+    PyObject* r = CallImpl("dataset_push_rows", args);
+    Py_XDECREF(args);
+    if (!r) return -1;
+    Py_DECREF(r);
+    return 0;
+  });
+}
+
+int LGBMTPU_DatasetMarkFinished(int64_t dataset) {
+  return WithGIL([&] {
+    PyObject* args = Py_BuildValue("(L)", (long long)dataset);
+    PyObject* r = CallImpl("dataset_mark_finished", args);
+    Py_XDECREF(args);
+    if (!r) return -1;
+    Py_DECREF(r);
+    return 0;
+  });
+}
+
+int LGBMTPU_DatasetGetNumData(int64_t dataset, int64_t* out) {
+  return WithGIL([&] {
+    PyObject* args = Py_BuildValue("(L)", (long long)dataset);
+    PyObject* r = CallImpl("dataset_num_data", args);
+    Py_XDECREF(args);
+    if (!r) return -1;
+    *out = PyLong_AsLongLong(r);
+    Py_DECREF(r);
+    return 0;
+  });
+}
+
+int LGBMTPU_DatasetGetNumFeature(int64_t dataset, int64_t* out) {
+  return WithGIL([&] {
+    PyObject* args = Py_BuildValue("(L)", (long long)dataset);
+    PyObject* r = CallImpl("dataset_num_feature", args);
+    Py_XDECREF(args);
+    if (!r) return -1;
+    *out = PyLong_AsLongLong(r);
+    Py_DECREF(r);
+    return 0;
+  });
+}
+
+int LGBMTPU_BoosterAddValidData(int64_t booster, int64_t dataset) {
+  return WithGIL([&] {
+    PyObject* args = Py_BuildValue("(LL)", (long long)booster,
+                                   (long long)dataset);
+    PyObject* r = CallImpl("booster_add_valid_data", args);
+    Py_XDECREF(args);
+    if (!r) return -1;
+    Py_DECREF(r);
+    return 0;
+  });
+}
+
+// data_idx 0 = train, 1.. = valid sets; out_len in: capacity, out: count.
+int LGBMTPU_BoosterGetEval(int64_t booster, int data_idx, double* out,
+                           int64_t* out_len) {
+  return WithGIL([&] {
+    PyObject* args = Py_BuildValue(
+        "(LiLL)", (long long)booster, data_idx, (long long)(intptr_t)out,
+        (long long)*out_len);
+    PyObject* r = CallImpl("booster_get_eval", args);
+    Py_XDECREF(args);
+    if (!r) return -1;
+    *out_len = PyLong_AsLongLong(r);
+    Py_DECREF(r);
+    return 0;
+  });
+}
+
+int LGBMTPU_BoosterRollbackOneIter(int64_t booster) {
+  return WithGIL([&] {
+    PyObject* args = Py_BuildValue("(L)", (long long)booster);
+    PyObject* r = CallImpl("booster_rollback_one_iter", args);
+    Py_XDECREF(args);
+    if (!r) return -1;
+    Py_DECREF(r);
+    return 0;
+  });
+}
+
+int LGBMTPU_BoosterGetCurrentIteration(int64_t booster, int* out) {
+  return WithGIL([&] {
+    PyObject* args = Py_BuildValue("(L)", (long long)booster);
+    PyObject* r = CallImpl("booster_current_iteration", args);
+    Py_XDECREF(args);
+    if (!r) return -1;
+    *out = (int)PyLong_AsLong(r);
+    Py_DECREF(r);
+    return 0;
+  });
+}
+
+// out_len in: buffer capacity in bytes; out: required size including the
+// NUL terminator.  Call with capacity 0 to size the buffer.
+int LGBMTPU_BoosterSaveModelToString(int64_t booster, char* out,
+                                     int64_t* out_len) {
+  return WithGIL([&] {
+    PyObject* args = Py_BuildValue("(LLL)", (long long)booster,
+                                   (long long)(intptr_t)out,
+                                   (long long)*out_len);
+    PyObject* r = CallImpl("booster_save_model_to_string", args);
+    Py_XDECREF(args);
+    if (!r) return -1;
+    *out_len = PyLong_AsLongLong(r);
+    Py_DECREF(r);
+    return 0;
+  });
+}
+
 int LGBMTPU_FreeHandle(int64_t handle) {
   return WithGIL([&] {
     PyObject* args = Py_BuildValue("(L)", (long long)handle);

@@ -133,3 +133,159 @@ def test_c_consumer_end_to_end(tmp_path):
     assert r.returncode == 0, r.stderr + r.stdout
     assert "C API OK" in r.stdout
     assert "accuracy" in r.stdout
+
+
+C_PROGRAM_V2 = r"""
+#include <stdio.h>
+#include <stdlib.h>
+#include <stdint.h>
+#include <string.h>
+#include <math.h>
+
+extern const char* LGBMTPU_GetLastError(void);
+extern int LGBMTPU_DatasetInitStreaming(int64_t, const char*, int64_t*);
+extern int LGBMTPU_DatasetPushRows(int64_t, const double*, int64_t, int64_t,
+                                   const double*);
+extern int LGBMTPU_DatasetMarkFinished(int64_t);
+extern int LGBMTPU_DatasetGetNumData(int64_t, int64_t*);
+extern int LGBMTPU_DatasetGetNumFeature(int64_t, int64_t*);
+extern int LGBMTPU_DatasetCreateFromCSR(const int32_t*, const int32_t*,
+                                        const double*, int64_t, int64_t,
+                                        int64_t, const double*, const char*,
+                                        int64_t*);
+extern int LGBMTPU_BoosterCreate(int64_t, const char*, int64_t*);
+extern int LGBMTPU_BoosterAddValidData(int64_t, int64_t);
+extern int LGBMTPU_BoosterUpdateOneIter(int64_t, int*);
+extern int LGBMTPU_BoosterGetEval(int64_t, int, double*, int64_t*);
+extern int LGBMTPU_BoosterGetCurrentIteration(int64_t, int*);
+extern int LGBMTPU_BoosterRollbackOneIter(int64_t);
+extern int LGBMTPU_BoosterSaveModelToString(int64_t, char*, int64_t*);
+extern int LGBMTPU_FreeHandle(int64_t);
+
+#define CHECK(call) do { if ((call) != 0) { \
+  fprintf(stderr, "FAIL %s: %s\n", #call, LGBMTPU_GetLastError()); \
+  return 1; } } while (0)
+
+static double frand(unsigned* s) {
+  *s = *s * 1103515245u + 12345u;
+  return ((double)(*s >> 8) / (1 << 24)) * 2.0 - 1.0;
+}
+
+int main(void) {
+  const int64_t n = 500, f = 3, chunk = 120;
+  const char* params = "{\"objective\":\"regression\",\"num_leaves\":7,"
+                       "\"min_data_in_leaf\":5,\"metric\":[\"l2\"],"
+                       "\"verbose\":-1}";
+  /* ---- streaming ingestion in chunks */
+  int64_t ds;
+  CHECK(LGBMTPU_DatasetInitStreaming(f, params, &ds));
+  unsigned s = 7;
+  double buf[chunk * 3], yb[chunk];
+  int64_t pushed = 0;
+  while (pushed < n) {
+    int64_t m = (n - pushed) < chunk ? (n - pushed) : chunk;
+    for (int64_t i = 0; i < m; ++i) {
+      double acc = 0;
+      for (int64_t j = 0; j < f; ++j) { buf[i*f+j] = frand(&s); acc += buf[i*f+j]; }
+      yb[i] = 2.0 * acc + 0.1 * frand(&s);
+    }
+    CHECK(LGBMTPU_DatasetPushRows(ds, buf, m, f, yb));
+    pushed += m;
+  }
+  CHECK(LGBMTPU_DatasetMarkFinished(ds));
+  int64_t nd = 0, nf = 0;
+  CHECK(LGBMTPU_DatasetGetNumData(ds, &nd));
+  CHECK(LGBMTPU_DatasetGetNumFeature(ds, &nf));
+  if (nd != n || nf != f) { fprintf(stderr, "dims %lld %lld\n",
+                                    (long long)nd, (long long)nf); return 1; }
+
+  /* ---- CSR valid set (same distribution) */
+  int32_t* indptr = malloc(sizeof(int32_t) * (n + 1));
+  int32_t* indices = malloc(sizeof(int32_t) * n * f);
+  double* vals = malloc(sizeof(double) * n * f);
+  double* yv = malloc(sizeof(double) * n);
+  int64_t nnz = 0;
+  indptr[0] = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    double acc = 0;
+    for (int64_t j = 0; j < f; ++j) {
+      double v = frand(&s);
+      acc += v;
+      if (j != 1 || v > 0) { indices[nnz] = (int32_t)j; vals[nnz++] = v; }
+      else acc -= v;  /* dropped value acts as 0 */
+    }
+    yv[i] = 2.0 * acc + 0.1 * frand(&s);
+    indptr[i + 1] = (int32_t)nnz;
+  }
+  int64_t dsv;
+  CHECK(LGBMTPU_DatasetCreateFromCSR(indptr, indices, vals, n, nnz, f, yv,
+                                     params, &dsv));
+
+  int64_t bst;
+  CHECK(LGBMTPU_BoosterCreate(ds, params, &bst));
+  CHECK(LGBMTPU_BoosterAddValidData(bst, dsv));
+  int fin = 0;
+  for (int it = 0; it < 20 && !fin; ++it)
+    CHECK(LGBMTPU_BoosterUpdateOneIter(bst, &fin));
+
+  double evals[8];
+  int64_t elen = 8;
+  CHECK(LGBMTPU_BoosterGetEval(bst, 1, evals, &elen));
+  if (elen < 1) { fprintf(stderr, "no eval values\n"); return 1; }
+  printf("valid l2 %.5f\n", evals[0]);
+  if (!(evals[0] < 3.0)) { fprintf(stderr, "weak fit\n"); return 1; }
+
+  int cur = 0;
+  CHECK(LGBMTPU_BoosterGetCurrentIteration(bst, &cur));
+  CHECK(LGBMTPU_BoosterRollbackOneIter(bst));
+  int cur2 = 0;
+  CHECK(LGBMTPU_BoosterGetCurrentIteration(bst, &cur2));
+  if (cur2 != cur - 1) { fprintf(stderr, "rollback %d->%d\n", cur, cur2);
+                         return 1; }
+
+  int64_t need = 0;
+  CHECK(LGBMTPU_BoosterSaveModelToString(bst, NULL, &need));
+  char* text = malloc(need);
+  int64_t cap = need;
+  CHECK(LGBMTPU_BoosterSaveModelToString(bst, text, &cap));
+  if (strstr(text, "tree") == NULL) { fprintf(stderr, "bad model text\n");
+                                      return 1; }
+  CHECK(LGBMTPU_FreeHandle(bst));
+  CHECK(LGBMTPU_FreeHandle(ds));
+  CHECK(LGBMTPU_FreeHandle(dsv));
+  printf("C API v2 OK\n");
+  return 0;
+}
+"""
+
+
+def test_c_consumer_streaming_csr_eval(tmp_path):
+    """Streaming push + CSR + eval/rollback/save-to-string through the raw
+    C ABI (reference c_api.h:177 InitStreaming, :203 PushRows, :340
+    CreateFromCSR, :910 GetEval, :817 RollbackOneIter)."""
+    src = tmp_path / "consumer2.c"
+    src.write_text(C_PROGRAM_V2)
+    exe = tmp_path / "consumer2"
+    libdir = sysconfig.get_config_var("LIBDIR")
+    subprocess.run(
+        ["gcc", "-O1", str(src), CAPI, f"-Wl,-rpath,{os.path.dirname(CAPI)}",
+         f"-Wl,-rpath,{libdir}", "-lm", "-o", str(exe)],
+        check=True, capture_output=True)
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+    import lightgbm_tpu
+    pkg_root = os.path.dirname(os.path.dirname(
+        os.path.abspath(lightgbm_tpu.__file__)))
+    env["PYTHONPATH"] = pkg_root
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run([str(exe)], env=env, capture_output=True, text=True,
+                       timeout=600)
+    assert r.returncode == 0, r.stderr + r.stdout
+    assert "C API v2 OK" in r.stdout
+
+
+def test_dual_parity_script_gated():
+    """CPU<->TPU dual parity (reference test_dual.py) runs on TPU machines:
+    `python tests/dual_parity.py`.  Here just assert the script parses."""
+    import ast, pathlib
+    src = pathlib.Path(__file__).parent / "dual_parity.py"
+    ast.parse(src.read_text())
