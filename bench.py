@@ -26,7 +26,7 @@ BENCH_ITERS = int(os.environ.get("BENCH_ITERS", 20))
 NUM_LEAVES = int(os.environ.get("BENCH_LEAVES", 255))
 MAX_BIN = int(os.environ.get("BENCH_BIN", 255))
 # splits per histogram pass (learner/batch_grower.py); 1 = strict leaf-wise
-SPLIT_BATCH = int(os.environ.get("BENCH_SPLIT_BATCH", 16))
+SPLIT_BATCH = int(os.environ.get("BENCH_SPLIT_BATCH", 20))
 BASELINE_S_PER_ROW_ITER = 130.094 / (10_500_000 * 500)
 
 

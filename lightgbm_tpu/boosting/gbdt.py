@@ -421,7 +421,7 @@ class GBDT:
             if (arr >= nl - 1).any():
                 log.fatal("debug check: %s child node index out of range"
                           % side)
-            if (-arr - 1 >= self.hp.num_leaves).any():
+            if (-arr - 1 >= nl).any():
                 log.fatal("debug check: %s child leaf index out of range"
                           % side)
 
@@ -728,11 +728,19 @@ class GBDT:
         k = self.num_tree_per_iteration
         for c in reversed(range(k)):
             tree = self.models.pop()
+            arrays = _tree_to_arrays_stub(tree, self.train_set,
+                                          exclude_bias=True)
             contrib = predict_bins_tree(
-                _tree_to_arrays_stub(tree, self.train_set, exclude_bias=True),
-                self.bins, self.nan_bin_arr, self.bundle,
+                arrays, self.bins, self.nan_bin_arr, self.bundle,
                 self.hp.has_categorical)[:self.train_set.num_data]
             self.scores = self.scores.at[:, c].add(-contrib)
+            # valid scores got this tree in train_one_iter; pop it there too
+            for vi in range(len(self.valid_sets)):
+                vc = predict_bins_tree(
+                    arrays, self._valid_bins[vi], self.nan_bin_arr,
+                    self.bundle, self.hp.has_categorical)
+                self.valid_scores[vi] = \
+                    self.valid_scores[vi].at[:, c].add(-vc)
         self.iter_ -= 1
 
 

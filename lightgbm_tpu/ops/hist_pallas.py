@@ -204,8 +204,10 @@ def _histogram_leaves_impl(bins: jax.Array, grad: jax.Array,
         lor_b = lor_ref[0, :]                               # [blk] i32
         sel = lor_b[None, :] == leaves_ref[0, :][:, None]   # [K, blk]
         m = sel.astype(jnp.float32)
-        gm = g_ref[0, :][None, :] * m                       # [K, blk]
-        hm = h_ref[0, :][None, :] * m
+        # where(), not multiply: 0 * NaN = NaN would let one bad row (e.g.
+        # a custom objective emitting NaN on an excluded row) poison sums
+        gm = jnp.where(sel, g_ref[0, :][None, :], 0.0)      # [K, blk]
+        hm = jnp.where(sel, h_ref[0, :][None, :], 0.0)
         vals = jnp.concatenate([gm, hm, m], axis=0).astype(compute_dtype)
         b_blk = bins_ref[:].astype(jnp.int32)
         iota = lax.iota(jnp.int32, n_bins)

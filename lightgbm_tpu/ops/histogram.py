@@ -176,8 +176,10 @@ def histogram_for_leaves_masked(bins_t: jax.Array, grad: jax.Array,
     else:
         sel = lor[None, :] == leaves[:, None]                 # [K, n]
         m = sel.astype(grad.dtype)
-        # channel layout [C, K, n] -> flatten to [C*K, n]
-        vals_t = jnp.stack([grad[None, :] * m, hess[None, :] * m, m,
+        # where(), not multiply: 0 * NaN = NaN would let one bad excluded
+        # row poison the sums (matches the Pallas kernel's masking)
+        vals_t = jnp.stack([jnp.where(sel, grad[None, :], 0.0),
+                            jnp.where(sel, hess[None, :], 0.0), m,
                             jnp.zeros_like(m)], axis=0)
         C = vals_t.shape[0]
         vals_t = vals_t.reshape(C * K, -1)
@@ -375,7 +377,9 @@ def root_histogram(bins_t: jax.Array, grad: jax.Array, hess: jax.Array,
             rows_per_block=rows_per_block, hist_dtype=hist_dtype,
             axis_name=axis_name)
     m = jnp.ones_like(grad) if row_mask is None else row_mask.astype(grad.dtype)
-    vals_t = jnp.stack([grad * m, hess * m, m, jnp.zeros_like(m)], axis=0)
+    vals_t = jnp.stack([jnp.where(m > 0, grad, 0.0),
+                        jnp.where(m > 0, hess, 0.0), m,
+                        jnp.zeros_like(m)], axis=0)
     hist = histogram_rows_t(bins_t, vals_t, n_bins=n_bins,
                             rows_per_block=rows_per_block,
                             hist_dtype=hist_dtype)

@@ -70,7 +70,9 @@ def renew_leaf_values(leaf_of_row: jax.Array, grad: jax.Array,
     out[l] = -T(sum g_l) / (sum h_l + l2) with L1 soft-threshold T."""
     L = num_leaves
     m = jnp.ones_like(grad) if row_mask is None else row_mask.astype(grad.dtype)
-    gsum = jnp.zeros((L,), grad.dtype).at[leaf_of_row].add(grad * m)
-    hsum = jnp.zeros((L,), hess.dtype).at[leaf_of_row].add(hess * m)
+    gsum = jnp.zeros((L,), grad.dtype).at[leaf_of_row].add(
+        jnp.where(m > 0, grad, 0.0))
+    hsum = jnp.zeros((L,), hess.dtype).at[leaf_of_row].add(
+        jnp.where(m > 0, hess, 0.0))
     t = jnp.sign(gsum) * jnp.maximum(jnp.abs(gsum) - lambda_l1, 0.0)
     return -t / (hsum + lambda_l2 + 1e-15)

@@ -360,3 +360,61 @@ def test_reset_parameter(synthetic_binary):
                     callbacks=[lgb.reset_parameter(
                         learning_rate=lambda i: 0.2 * (0.9 ** i))])
     assert bst.num_trees() == 10
+
+
+@pytest.mark.parametrize("objective,extra", [
+    ("regression", {}),
+    ("regression_l1", {}),
+    ("huber", {}),
+    ("poisson", {}),
+    ("quantile", {"alpha": 0.7}),
+    ("binary", {}),
+    ("multiclass", {"num_class": 3}),
+    ("multiclassova", {"num_class": 3}),
+    ("cross_entropy", {}),
+])
+def test_save_load_all_objectives(objective, extra, tmp_path):
+    """Model-reload prediction equivalence for every objective family
+    (reference test_engine.py asserts exact reload parity per objective)."""
+    rng = np.random.default_rng(11)
+    n, f = 900, 5
+    X = rng.normal(size=(n, f))
+    raw = X @ rng.normal(size=f)
+    if objective in ("multiclass", "multiclassova"):
+        y = np.digitize(raw, np.quantile(raw, [0.33, 0.66]))
+    elif objective == "binary":
+        y = (raw > 0).astype(float)
+    elif objective == "cross_entropy":
+        y = 1.0 / (1.0 + np.exp(-raw))
+    else:
+        y = raw + rng.normal(scale=0.1, size=n)
+        if objective == "poisson":
+            y = np.exp(y / 4)
+    params = {"objective": objective, "num_leaves": 15, "verbose": -1,
+              "min_data_in_leaf": 5, **extra}
+    bst = lgb.train(params, lgb.Dataset(X, label=y, params=params),
+                    num_boost_round=8)
+    p1 = bst.predict(X)
+    path = tmp_path / f"{objective}.txt"
+    bst.save_model(str(path))
+    p2 = lgb.Booster(model_file=str(path)).predict(X)
+    np.testing.assert_allclose(p1, p2, rtol=1e-5, atol=1e-6)
+
+
+def test_init_score_training(synthetic_binary):
+    """init_score offsets gradients (reference Metadata init_score path);
+    a strong init_score should yield better early logloss than none."""
+    X, y = synthetic_binary
+    base = np.where(y > 0, 2.0, -2.0) * 0.9   # informative margin
+    d0 = lgb.Dataset(X, label=y, params={"verbose": -1})
+    d1 = lgb.Dataset(X, label=y, init_score=base, params={"verbose": -1})
+    p = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+         "metric": ["binary_logloss"]}
+    r0, r1 = {}, {}
+    lgb.train(p, d0, num_boost_round=3, valid_sets=[d0], valid_names=["t"],
+              callbacks=[lgb.record_evaluation(r0)])
+    lgb.train(p, d1, num_boost_round=3, valid_sets=[d1], valid_names=["t"],
+              callbacks=[lgb.record_evaluation(r1)])
+    key0 = next(iter(r0))
+    key1 = next(iter(r1))
+    assert r1[key1]["binary_logloss"][0] < r0[key0]["binary_logloss"][0]
