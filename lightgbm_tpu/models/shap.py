@@ -10,6 +10,7 @@ output layout matches the reference: one column per feature plus a final
 
 from __future__ import annotations
 
+import functools
 from typing import List
 
 import numpy as np
@@ -157,15 +158,247 @@ def tree_shap_row(tree: Tree, x: np.ndarray, phi: np.ndarray) -> None:
     recurse(0, [], 1.0, 1.0, -1)
 
 
+# --------------------------------------------------------------------------
+# Vectorized TreeSHAP
+#
+# The recursion above (kept as the small-input/oracle path) is rewritten as
+# whole-array recurrences so contribs scale to datasets (reference: the C++
+# TreeSHAP in src/io/tree.cpp runs the same per-row algorithm in compiled
+# code; a Python per-row walk is interpreter-bound).  Key identity: at each
+# leaf the recursion's path state consists of the root dummy element plus ONE
+# consolidated element per unique feature on the root->leaf path, with
+#   zero_fraction = prod(cover(child_toward_leaf) / cover(node))
+#   one_fraction  = prod(row decision at node == direction toward leaf)
+# and the extend recurrence is commutative in the elements, so the state can
+# be computed slot-by-slot in first-occurrence order for ALL (row, leaf)
+# pairs at once.  The extend / unwound-sum loops then run over the slot axis
+# with [rows, leaves] array steps.
+
+
+class _TreePaths:
+    """Host-side per-tree decomposition (cached on the Tree instance)."""
+
+    __slots__ = ("S", "feats", "z", "m", "values", "expected",
+                 "edge_sort_slot", "edge_node", "edge_dirleft",
+                 "edge_seg_starts", "edge_slot_ids", "featoh")
+
+    def __init__(self, tree: Tree, num_features: int):
+        L = tree.num_leaves
+        # iterative DFS; path = ordered slots [feat, z, [(node, dir_left)]]
+        leaf_slots: List[list] = [None] * L
+        if L == 1:
+            leaf_slots = [[]]
+        else:
+            stack = [(0, [])]
+            while stack:
+                node, slots = stack.pop()
+                if node < 0:
+                    leaf_slots[-node - 1] = slots
+                    continue
+                f = int(tree.split_feature[node])
+                w = _node_cover(tree, node)
+                for child, dir_left in ((int(tree.left_child[node]), True),
+                                        (int(tree.right_child[node]), False)):
+                    ratio = _node_cover(tree, child) / w
+                    new = [s[:] for s in slots]
+                    for s in new:
+                        s[2] = list(s[2])
+                    hit = next((s for s in new if s[0] == f), None)
+                    if hit is None:
+                        new.append([f, ratio, [(node, dir_left)]])
+                    else:
+                        hit[1] *= ratio
+                        hit[2].append((node, dir_left))
+                    stack.append((child, new))
+        # pad the slot axis to a multiple of 4 and the leaf axis to a
+        # multiple of 32 so trees of similar shape share one jitted program
+        # (per-tree exact shapes would trigger a recompile per tree); pad
+        # leaves carry m=0 / value=0 and contribute exactly nothing
+        S = max(1, max(len(s) for s in leaf_slots))
+        S = -(-S // 4) * 4
+        L = -(-L // 32) * 32
+        self.S = S
+        self.feats = np.full((L, S), -1, np.int32)
+        self.z = np.ones((L, S), np.float64)
+        self.m = np.zeros(L, np.int32)
+        e_slot, e_node, e_dir = [], [], []
+        for li, slots in enumerate(leaf_slots):
+            self.m[li] = len(slots)
+            for si, (f, zf, edges) in enumerate(slots):
+                self.feats[li, si] = f
+                self.z[li, si] = zf
+                for node, dl in edges:
+                    e_slot.append(li * S + si)
+                    e_node.append(node)
+                    e_dir.append(dl)
+        # edges sorted by flat slot id -> segment-AND via minimum.reduceat
+        order = np.argsort(np.asarray(e_slot, np.int64), kind="stable") \
+            if e_slot else np.zeros(0, np.int64)
+        es = np.asarray(e_slot, np.int64)[order]
+        self.edge_node = np.asarray(e_node, np.int32)[order]
+        self.edge_dirleft = np.asarray(e_dir, bool)[order]
+        starts = np.flatnonzero(np.r_[True, es[1:] != es[:-1]]) \
+            if es.size else np.zeros(0, np.int64)
+        self.edge_seg_starts = starts
+        self.edge_slot_ids = es[starts] if es.size else es
+        self.edge_sort_slot = es
+        self.values = np.zeros(L, np.float64)
+        self.values[:tree.num_leaves] = tree.leaf_value[:tree.num_leaves]
+        self.expected = tree_expected_value(tree)
+        # slot feature -> output column one-hot (pad slots all-zero)
+        oh = np.zeros((L, S, num_features + 1), np.float32)
+        valid = self.feats >= 0
+        li, si = np.nonzero(valid)
+        oh[li, si, self.feats[li, si]] = 1.0
+        self.featoh = oh
+
+
+def _paths_of(tree: Tree, num_features: int) -> _TreePaths:
+    cached = getattr(tree, "_shap_paths", None)
+    if cached is None or cached.featoh.shape[-1] != num_features + 1:
+        cached = _TreePaths(tree, num_features)
+        tree._shap_paths = cached
+    return cached
+
+
+def _go_left_matrix(tree: Tree, X: np.ndarray) -> np.ndarray:
+    """Vectorized split decisions: bool [n, num_internal] (f64 compares,
+    mirroring ``_decide_left`` / Tree.predict semantics exactly)."""
+    ni = tree.num_leaves - 1
+    if ni == 0:
+        return np.zeros((X.shape[0], 0), bool)
+    xv = X[:, tree.split_feature[:ni]]                     # [n, ni]
+    dt = tree.decision_type[:ni]
+    mtype = (dt >> 2) & 3
+    isnan = np.isnan(xv)
+    miss = isnan | ((mtype[None, :] == MISSING_ZERO)
+                    & (np.abs(xv) <= K_ZERO_THRESHOLD))
+    use_default = miss & (mtype[None, :] != MISSING_NONE)
+    gl = np.where(use_default, (dt & _DEFAULT_LEFT_MASK)[None, :] > 0,
+                  np.where(isnan, 0.0, xv) <= tree.threshold[None, :][:, :ni])
+    for s in np.flatnonzero(dt & _CAT_MASK):
+        csi = int(tree.cat_split_index[s])
+        cats = np.asarray(tree.cat_threshold[csi], np.int64)
+        v = xv[:, s]
+        nan_s = np.isnan(v)
+        member = np.isin(np.where(nan_s, -1, v).astype(np.int64), cats)
+        nl = bool(tree.cat_nan_left[csi]) \
+            if csi < len(tree.cat_nan_left) else False
+        gl[:, s] = np.where(nan_s, nl, member)
+    return gl.astype(bool)
+
+
+def _one_fractions(tp: _TreePaths, gl: np.ndarray) -> np.ndarray:
+    """o [n, L, S] u8: per (row, leaf, slot) AND of toward-leaf decisions."""
+    n = gl.shape[0]
+    L, S = tp.feats.shape
+    o = np.ones((n, L * S), np.uint8)
+    if tp.edge_node.size:
+        toward = (gl[:, tp.edge_node] == tp.edge_dirleft[None, :]) \
+            .astype(np.uint8)                              # [n, E] sorted
+        reduced = np.minimum.reduceat(toward, tp.edge_seg_starts, axis=1)
+        o[:, tp.edge_slot_ids] = reduced
+    return o.reshape(n, L, S)
+
+
+def _phi_slots(xp, o, z, m, values, S):
+    """The extend + unwound-sum recurrences over the slot axis.
+
+    ``xp`` is numpy (f64 exact) or jax.numpy (f32, jit/device); shapes:
+    o [n, L, S] (0/1), z [L, S], m [L] int, values [L].  Returns
+    phi_slots [n, L, S] = per-slot SHAP contribution of every leaf.
+    """
+    n, L = o.shape[0], o.shape[1]
+    dtype = z.dtype
+    # ---- extend: p[pos] over positions 0..S (pos 0 = root dummy element)
+    p = xp.zeros((n, L, S + 1), dtype)
+    if xp is np:
+        p[:, :, 0] = 1.0
+    else:
+        p = p.at[:, :, 0].set(1.0)
+    for j in range(S):
+        d = j + 1                      # path last-index after this extend
+        pos = np.arange(S + 1)
+        ck = ((d - pos) / (d + 1.0)).clip(min=0.0).astype(dtype)  # keep coef
+        cs = (pos / (d + 1.0)).astype(dtype)                      # shift coef
+        if xp is np:
+            p_shift = np.concatenate(
+                [np.zeros((n, L, 1), dtype), p[:, :, :-1]], axis=2)
+        else:
+            p_shift = xp.pad(p[:, :, :-1], ((0, 0), (0, 0), (1, 0)))
+        zj = z[None, :, j, None]
+        oj = o[:, :, j, None].astype(dtype)
+        p_new = zj * p * ck[None, None, :] + oj * p_shift * cs[None, None, :]
+        act = (j < m)[None, :, None]
+        p = xp.where(act, p_new, p)
+    # ---- per-slot unwound path sum (variable path length D = m per leaf)
+    D = m.astype(np.int32)             # [L]
+    Dp1 = (D + 1).astype(dtype)        # [L]
+    if xp is np:
+        p_at_D = np.take_along_axis(p, D[None, :, None].astype(np.int64),
+                                    axis=2)[:, :, 0]
+    else:
+        p_at_D = xp.take_along_axis(p, xp.asarray(D)[None, :, None], axis=2
+                                    )[:, :, 0]
+    phi = xp.zeros((n, L, S), dtype)
+    for i in range(S):
+        oi = o[:, :, i].astype(dtype)              # [n, L] 0/1
+        zi = z[None, :, i]                         # [1, L]
+        nxt = p_at_D
+        tot = xp.zeros((n, L), dtype)
+        for jj in range(S - 1, -1, -1):
+            live = (jj < D)[None, :]               # position exists
+            denom_o = (jj + 1.0)
+            tmp = nxt * Dp1[None, :] / denom_o     # o==1 branch (oi is 0/1)
+            contrib1 = tmp
+            nxt_new = p[:, :, jj] - tmp * zi * \
+                ((D[None, :] - jj) / Dp1[None, :])
+            # dead positions (jj >= D) have p[..jj] == 0, so contrib0 is 0
+            # there; the denominator guard only avoids 0/0
+            contrib0 = p[:, :, jj] / zi * \
+                (Dp1[None, :] / xp.maximum(
+                    (D[None, :] - jj).astype(dtype), dtype.type(0.5)))
+            is_one = oi > 0.5
+            step_tot = xp.where(is_one, contrib1, contrib0)
+            tot = xp.where(live, tot + step_tot, tot)
+            nxt = xp.where(live & is_one, nxt_new, nxt)
+        w_i = xp.where((i < m)[None, :], tot, 0.0)
+        col = (oi - zi) * w_i * values[None, :]
+        if xp is np:
+            phi[:, :, i] = col
+        else:
+            phi = phi.at[:, :, i].set(col)
+    return phi
+
+
+_JAX_CHUNK_ROWS = 4096
+
+
+@functools.lru_cache(maxsize=32)
+def _jit_phi(S: int, L: int, F1: int):
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def run(o, z, m, values, featoh):
+        phi_slots = _phi_slots(jnp, o, z, m, values, S)
+        return jnp.einsum("nls,lsf->nf", phi_slots, featoh)
+    return run
+
+
 def predict_contrib(trees: List[Tree], X: np.ndarray, num_features: int,
                     num_tree_per_iteration: int = 1,
                     start_iteration: int = 0,
                     end_iteration: int = -1) -> np.ndarray:
-    """SHAP contributions summed over trees.
+    """SHAP contributions summed over trees (vectorized TreeSHAP).
 
     Returns ``[n, F + 1]`` for single-output models, ``[n, k * (F + 1)]``
     flattened class-major for ``k``-output models (reference
     PredictContrib layout, c_api.h predict_type=C_API_PREDICT_CONTRIB).
+
+    Small inputs run the recurrences in numpy float64 (bit-comparable to the
+    reference's double TreeSHAP); large inputs run the same recurrences as a
+    jitted float32 program on the default jax backend.
     """
     X = np.asarray(X, np.float64)
     if X.ndim == 1:
@@ -176,11 +409,35 @@ def predict_contrib(trees: List[Tree], X: np.ndarray, num_features: int,
     end = total_iters if end_iteration is None or end_iteration <= 0 else \
         min(total_iters, end_iteration)
     phi = np.zeros((n, k, num_features + 1))
+    use_jax = n * max((t.num_leaves for t in trees), default=1) > 2_000_000
     for it in range(start_iteration, end):
         for c in range(k):
             t = trees[it * k + c]
-            for r in range(n):
-                tree_shap_row(t, X[r], phi[r, c])
+            tp = _paths_of(t, num_features)
+            phi[:, c, -1] += tp.expected
+            if t.num_leaves <= 1:
+                continue
+            if not use_jax:
+                featoh64 = tp.featoh.astype(np.float64)
+                for r0 in range(0, n, _JAX_CHUNK_ROWS):
+                    sl = slice(r0, min(n, r0 + _JAX_CHUNK_ROWS))
+                    gl = _go_left_matrix(t, X[sl])
+                    o = _one_fractions(tp, gl)
+                    ps = _phi_slots(np, o, tp.z, tp.m, tp.values, tp.S)
+                    phi[sl, c, :] += np.einsum("nls,lsf->nf", ps, featoh64)
+            else:
+                import jax.numpy as jnp
+                run = _jit_phi(tp.S, tp.z.shape[0], num_features + 1)
+                zj = jnp.asarray(tp.z, jnp.float32)
+                mj = jnp.asarray(tp.m)
+                vj = jnp.asarray(tp.values, jnp.float32)
+                fj = jnp.asarray(tp.featoh)
+                for r0 in range(0, n, _JAX_CHUNK_ROWS):
+                    sl = slice(r0, min(n, r0 + _JAX_CHUNK_ROWS))
+                    gl = _go_left_matrix(t, X[sl])
+                    o = jnp.asarray(_one_fractions(tp, gl))
+                    out = run(o, zj, mj, vj, fj)
+                    phi[sl, c, :] += np.asarray(out, np.float64)
     if k == 1:
         return phi[:, 0, :]
     return phi.reshape(n, k * (num_features + 1))
