@@ -61,7 +61,11 @@ def _hp_from_config(cfg: Config, n_bins: int) -> SplitHyper:
         n_bins=n_bins,
         rows_per_block=int(cfg.tpu_rows_per_block),
         path_smooth=float(cfg.path_smooth),
-        hist_dtype=str(cfg.tpu_hist_dtype),
+        # deterministic=true pins the exact-parity contraction regardless of
+        # the user's tpu_hist_dtype (ADVICE r1: bfloat16 silently broke the
+        # deterministic contract)
+        hist_dtype=("float32" if cfg.deterministic
+                    else str(cfg.tpu_hist_dtype)),
         leaf_hist=str(cfg.tpu_leaf_hist),
         extra_trees=bool(cfg.extra_trees),
         feature_fraction_bynode=float(cfg.feature_fraction_bynode),
@@ -408,9 +412,11 @@ class GBDT:
             else np.asarray(row_mask)
         counts = np.bincount(lor[mask], minlength=self.hp.num_leaves)
         stored = np.asarray(arrays.leaf_count)
-        if not np.allclose(counts[:nl], stored[:nl], atol=0.5):
+        # rtol guards against f32-accumulated counts drifting by >0.5 on
+        # very large leaves (>2^24 rows) — ADVICE r1
+        if not np.allclose(counts[:nl], stored[:nl], rtol=1e-6, atol=0.5):
             bad = np.nonzero(~np.isclose(counts[:nl], stored[:nl],
-                                         atol=0.5))[0]
+                                         rtol=1e-6, atol=0.5))[0]
             log.fatal("debug check: leaf_count mismatch at leaves %s "
                       "(partition %s vs stored %s)"
                       % (bad[:5], counts[bad[:5]], stored[bad[:5]]))

@@ -125,14 +125,20 @@ def _arr_i32(ptr: int, n: int) -> np.ndarray:
 def dataset_from_csr(indptr_ptr: int, indices_ptr: int, data_ptr: int,
                      nrow: int, nnz: int, ncol: int, label_ptr: int,
                      params_json: str) -> int:
-    """LGBM_DatasetCreateFromCSR (c_api.h:340) equivalent."""
+    """LGBM_DatasetCreateFromCSR (c_api.h:340) equivalent.
+
+    NOTE: the CSR input is densified into a full [nrow, ncol] float64
+    matrix before binning (O(nrow*ncol) host memory — the TPU training
+    layout is dense; see native/capi.cpp header comment).  Duplicate
+    (row, col) entries are summed, matching scipy.sparse semantics.
+    """
     import lightgbm_tpu as lgb
     indptr = _arr_i32(indptr_ptr, nrow + 1)
     indices = _arr_i32(indices_ptr, nnz)
     vals = _arr_f64(data_ptr, nnz)
-    dense = np.zeros((nrow, ncol), np.float64)
-    rows = np.repeat(np.arange(nrow), np.diff(indptr))
-    dense[rows, indices] = vals
+    rows = np.repeat(np.arange(nrow, dtype=np.int64), np.diff(indptr))
+    dense = np.bincount(rows * ncol + indices, weights=vals,
+                        minlength=nrow * ncol).reshape(nrow, ncol)
     label = _arr_f64(label_ptr, nrow).copy() if label_ptr else None
     params = json.loads(params_json) if params_json else {}
     ds = lgb.Dataset(dense, label=label, params=params)

@@ -629,6 +629,20 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             if hp.use_monotone:
                 lo = jnp.clip(lo, lmin_p, lmax_p)
                 ro = jnp.clip(ro, lmin_p, lmax_p)
+            if hp.use_monotone and use_boxes:
+                # sibling-ordering repair: clipping both children to the
+                # parent's [min, max] can leave out[left] > out[right] under
+                # mono>0 (or the mirror) when the raw outputs were inverted
+                # but clipped equal at evaluation time; the box refresh below
+                # bounds OTHER leaves but not this pair's relative order, so
+                # collapse inverted siblings to their midpoint like the basic
+                # method's swap (monotone_constraints.hpp BasicLeafConstraints)
+                mono_sf = monotone[feat]
+                inv = (~catl) & (((mono_sf > 0) & (lo > ro))
+                                 | ((mono_sf < 0) & (lo < ro)))
+                mid_sib = jnp.clip((lo + ro) * 0.5, lmin_p, lmax_p)
+                lo = jnp.where(inv, mid_sib, lo)
+                ro = jnp.where(inv, mid_sib, ro)
             if hp.use_monotone and not use_boxes:
                 mono_f = monotone[feat]
                 is_num = ~catl

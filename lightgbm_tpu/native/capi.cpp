@@ -218,6 +218,10 @@ int LGBMTPU_BoosterNumTrees(int64_t booster, int* out) {
   });
 }
 
+// NOTE: the CSR payload is densified host-side into a full [nrow, ncol]
+// float64 matrix before binning (the TPU training layout is dense), so
+// peak host memory is O(nrow * ncol) regardless of sparsity.  Duplicate
+// (row, col) entries are summed (scipy.sparse semantics).
 int LGBMTPU_DatasetCreateFromCSR(const int32_t* indptr,
                                  const int32_t* indices, const double* data,
                                  int64_t nrow, int64_t nnz, int64_t ncol,

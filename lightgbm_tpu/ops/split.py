@@ -69,12 +69,14 @@ class SplitHyper:
     n_bins: int = 256
     rows_per_block: int = 4096
     path_smooth: float = 0.0
-    # MXU contraction dtype.  "bfloat16" (default): exact {0,1} one-hot,
-    # f32 accumulation, only grad/hess products take ~2^-9 input rounding
-    # (measured AUC-neutral, docs/PERF_NOTES.md).  "float32": fully exact
-    # products via 6-pass MXU (Precision.HIGHEST), ~3x slower — the
-    # split-parity mode matching the reference's fp64 histograms.
-    hist_dtype: str = "bfloat16"
+    # MXU contraction dtype.  "float32" (default): fully exact products via
+    # multi-pass MXU (Precision.HIGHEST) — the split-parity mode matching
+    # the reference's fp64 histograms bit-for-metric.  "bfloat16": exact
+    # {0,1} one-hot, f32 accumulation, only grad/hess products take ~2^-9
+    # input rounding (measured ~1.1e-4 AUC drift, ~3x faster kernels —
+    # docs/PERF_NOTES.md; the speed mode the benchmark uses, analogous to
+    # the reference GPU docs recommending single precision).
+    hist_dtype: str = "float32"
     # per-leaf histogram strategy: "masked" = flat full-data pass with
     # non-leaf rows zeroed (no compaction; TPU-friendly), "bucketed" =
     # nonzero+gather into power-of-two buckets (wins only when leaves are

@@ -5,11 +5,14 @@ Same user surface as the reference python package's plotting layer
 ``plot_split_value_histogram``, ``plot_metric``, ``plot_tree``,
 ``create_tree_digraph``), rebuilt on this framework's Booster/Dataset.
 matplotlib and graphviz are optional and only imported at call time.
+
+Label strings may carry ``@...@`` placeholder tokens (``@importance_type@``,
+``@metric@``, ``@feature@``, ``@index/name@``) that are substituted at
+render time — an API behavior the reference documents, so it is kept.
 """
 
 from __future__ import annotations
 
-import math
 from copy import deepcopy
 from typing import Any, Dict, List, Optional, Tuple, Union
 
@@ -24,24 +27,58 @@ __all__ = [
 ]
 
 
-def _check_not_tuple_of_2_elements(obj: Any, obj_name: str) -> None:
-    if not isinstance(obj, tuple) or len(obj) != 2:
-        raise TypeError(f"{obj_name} must be a tuple of 2 elements.")
+def _fmt(value: Any, precision: Optional[int]) -> str:
+    """Number -> display string at the requested decimal precision."""
+    if isinstance(value, str) or precision is None:
+        return str(value)
+    return f"{value:.{precision}f}"
 
 
-def _float2str(value: float, precision: Optional[int] = None) -> str:
-    if precision is not None and not isinstance(value, str):
-        return f"{value:.{precision}f}"
-    return str(value)
+def _as_booster(obj: Any) -> Booster:
+    """Accept a Booster or a fitted sklearn estimator."""
+    b = getattr(obj, "booster_", obj)
+    if not isinstance(b, Booster):
+        raise TypeError("booster must be Booster or LGBMModel.")
+    return b
 
 
-def _get_ax(ax, figsize, dpi):
-    import matplotlib.pyplot as plt
-    if ax is None:
-        if figsize is not None:
-            _check_not_tuple_of_2_elements(figsize, "figsize")
-        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
-    return ax
+class _AxesCanvas:
+    """One matplotlib Axes plus the shared decoration logic (limits, title,
+    labels with token substitution, grid) every plot entry point applies."""
+
+    def __init__(self, ax, figsize, dpi):
+        if ax is None:
+            import matplotlib.pyplot as plt
+            if figsize is not None:
+                _pair(figsize, "figsize")
+            _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+        self.ax = ax
+
+    def decorate(self, *, xlim=None, ylim=None, title=None, xlabel=None,
+                 ylabel=None, grid=True, tokens: Dict[str, str] = {}):
+        def subst(text):
+            for token, repl in tokens.items():
+                text = text.replace(f"@{token}@", repl)
+            return text
+
+        if xlim is not None:
+            self.ax.set_xlim(_pair(xlim, "xlim"))
+        if ylim is not None:
+            self.ax.set_ylim(_pair(ylim, "ylim"))
+        if title is not None:
+            self.ax.set_title(subst(title))
+        if xlabel is not None:
+            self.ax.set_xlabel(subst(xlabel))
+        if ylabel is not None:
+            self.ax.set_ylabel(subst(ylabel))
+        self.ax.grid(grid)
+        return self.ax
+
+
+def _pair(value: Any, name: str) -> Tuple[float, float]:
+    if not (isinstance(value, tuple) and len(value) == 2):
+        raise TypeError(f"{name} must be a tuple of 2 elements.")
+    return value
 
 
 def plot_importance(booster: Union[Booster, Any], ax=None, height: float = 0.2,
@@ -56,53 +93,47 @@ def plot_importance(booster: Union[Booster, Any], ax=None, height: float = 0.2,
                     grid: bool = True, precision: Optional[int] = 3,
                     **kwargs: Any):
     """Horizontal bar chart of feature importances."""
-    if hasattr(booster, "booster_"):  # sklearn estimator
-        booster = booster.booster_
-    if not isinstance(booster, Booster):
-        raise TypeError("booster must be Booster or LGBMModel.")
     if importance_type == "auto":
-        importance_type = "split"
-    importance = booster.feature_importance(importance_type=importance_type)
-    feature_name = booster.feature_name()
-
-    if not len(importance):
+        importance_type = getattr(booster, "importance_type", "split")
+    b = _as_booster(booster)
+    scores = b.feature_importance(importance_type=importance_type)
+    if not len(scores):
         raise ValueError("Booster's feature_importance is empty.")
 
-    tuples = sorted(zip(feature_name, importance), key=lambda x: x[1])
-    if ignore_zero:
-        tuples = [x for x in tuples if x[1] > 0]
+    # ascending so the biggest bar lands on top of the barh chart
+    order = np.argsort(scores, kind="stable")
+    names = b.feature_name()
+    keep = [i for i in order if scores[i] > 0] if ignore_zero else list(order)
     if max_num_features is not None and max_num_features > 0:
-        tuples = tuples[-max_num_features:]
-    labels, values = zip(*tuples)
+        keep = keep[-max_num_features:]
+    values = scores[keep]
+    is_int_scores = importance_type != "gain"
 
-    ax = _get_ax(ax, figsize, dpi)
-    ylocs = np.arange(len(values))
-    ax.barh(ylocs, values, align="center", height=height, **kwargs)
-    for x, y in zip(values, ylocs):
-        ax.text(x + 1, y, _float2str(x, precision)
-                if importance_type == "gain" else str(int(x)),
-                va="center")
-    ax.set_yticks(ylocs)
-    ax.set_yticklabels(labels)
-    if xlim is not None:
-        _check_not_tuple_of_2_elements(xlim, "xlim")
-    else:
-        xlim = (0, max(values) * 1.1)
-    ax.set_xlim(xlim)
-    if ylim is not None:
-        _check_not_tuple_of_2_elements(ylim, "ylim")
-    else:
-        ylim = (-1, len(values))
-    ax.set_ylim(ylim)
-    if title is not None:
-        ax.set_title(title)
-    if xlabel is not None:
-        xlabel = xlabel.replace("@importance_type@", importance_type)
-        ax.set_xlabel(xlabel)
-    if ylabel is not None:
-        ax.set_ylabel(ylabel)
-    ax.grid(grid)
-    return ax
+    canvas = _AxesCanvas(ax, figsize, dpi)
+    ypos = np.arange(len(keep))
+    canvas.ax.barh(ypos, values, align="center", height=height, **kwargs)
+    for yi, v in enumerate(values):
+        text = str(int(v)) if is_int_scores else _fmt(v, precision)
+        canvas.ax.text(v + 1, yi, text, va="center")
+    canvas.ax.set_yticks(ypos)
+    canvas.ax.set_yticklabels([names[i] for i in keep])
+    return canvas.decorate(
+        xlim=xlim if xlim is not None else (0, float(values.max()) * 1.1),
+        ylim=ylim if ylim is not None else (-1, len(keep)),
+        title=title, xlabel=xlabel, ylabel=ylabel, grid=grid,
+        tokens={"importance_type": importance_type})
+
+
+def _iter_tree_nodes(tree_structure: Dict[str, Any]):
+    """Yield every node dict of a dumped tree, root first."""
+    todo = [tree_structure]
+    while todo:
+        node = todo.pop()
+        yield node
+        for side in ("left_child", "right_child"):
+            child = node.get(side)
+            if isinstance(child, dict):
+                todo.append(child)
 
 
 def plot_split_value_histogram(booster: Union[Booster, Any],
@@ -115,61 +146,38 @@ def plot_split_value_histogram(booster: Union[Booster, Any],
                                figsize=None, dpi=None, grid: bool = True,
                                **kwargs: Any):
     """Histogram of a feature's chosen split thresholds across the model."""
-    if hasattr(booster, "booster_"):
-        booster = booster.booster_
-    if not isinstance(booster, Booster):
-        raise TypeError("booster must be Booster or LGBMModel.")
-
-    names = booster.feature_name()
+    b = _as_booster(booster)
     if isinstance(feature, str):
+        names = b.feature_name()
         if feature not in names:
             raise ValueError(f"Feature {feature} not found.")
         fidx = names.index(feature)
     else:
         fidx = int(feature)
 
-    values: List[float] = []
-    model = booster.dump_model()
-    for tree_info in model["tree_info"]:
-        stack = [tree_info["tree_structure"]]
-        while stack:
-            node = stack.pop()
-            if "split_feature" in node:
-                if node["split_feature"] == fidx and \
-                        node.get("decision_type") == "<=":
-                    values.append(float(node["threshold"]))
-                for k in ("left_child", "right_child"):
-                    if isinstance(node.get(k), dict):
-                        stack.append(node[k])
-    if not values:
+    thresholds = [
+        float(node["threshold"])
+        for info in b.dump_model()["tree_info"]
+        for node in _iter_tree_nodes(info["tree_structure"])
+        if node.get("split_feature") == fidx
+        and node.get("decision_type") == "<="
+    ]
+    if not thresholds:
         raise ValueError(
             "Cannot plot split value histogram, "
             f"because feature {feature} was not used in splitting.")
-    hist_values, bin_edges = np.histogram(values, bins=bins or "auto")
-    centers = (bin_edges[:-1] + bin_edges[1:]) / 2
-    width = width_coef * (bin_edges[1] - bin_edges[0])
+    counts, edges = np.histogram(thresholds, bins=bins or "auto")
 
-    ax = _get_ax(ax, figsize, dpi)
-    ax.bar(centers, hist_values, width=width, align="center", **kwargs)
-    if xlim is not None:
-        _check_not_tuple_of_2_elements(xlim, "xlim")
-        ax.set_xlim(xlim)
-    if ylim is not None:
-        _check_not_tuple_of_2_elements(ylim, "ylim")
-    else:
-        ylim = (0, max(hist_values) * 1.1)
-    ax.set_ylim(ylim)
-    if title is not None:
-        title = title.replace("@index/name@",
-                              "name" if isinstance(feature, str) else "index")
-        title = title.replace("@feature@", str(feature))
-        ax.set_title(title)
-    if xlabel is not None:
-        ax.set_xlabel(xlabel)
-    if ylabel is not None:
-        ax.set_ylabel(ylabel)
-    ax.grid(grid)
-    return ax
+    canvas = _AxesCanvas(ax, figsize, dpi)
+    canvas.ax.bar((edges[:-1] + edges[1:]) / 2, counts,
+                  width=width_coef * (edges[1] - edges[0]),
+                  align="center", **kwargs)
+    return canvas.decorate(
+        xlim=xlim,
+        ylim=ylim if ylim is not None else (0, float(counts.max()) * 1.1),
+        title=title, xlabel=xlabel, ylabel=ylabel, grid=grid,
+        tokens={"index/name": "name" if isinstance(feature, str) else "index",
+                "feature": str(feature)})
 
 
 def plot_metric(booster: Union[Dict, Any], metric: Optional[str] = None,
@@ -181,96 +189,57 @@ def plot_metric(booster: Union[Dict, Any], metric: Optional[str] = None,
                 grid: bool = True):
     """Plot a metric recorded by ``record_evaluation`` during training."""
     if isinstance(booster, dict):
-        eval_results = deepcopy(booster)
+        history = deepcopy(booster)
     elif hasattr(booster, "evals_result_"):
-        eval_results = deepcopy(booster.evals_result_)
+        history = deepcopy(booster.evals_result_)
     else:
         raise TypeError("booster must be dict or LGBMModel with "
                         "recorded eval results.")
-    if not eval_results:
+    if not history:
         raise ValueError("eval results cannot be empty.")
 
-    if dataset_names is None:
-        dataset_names = list(eval_results.keys())
-    name = dataset_names[0]
-    metrics_for_one = eval_results[name]
+    names = dataset_names if dataset_names is not None else list(history)
+    first = history[names[0]]
     if metric is None:
-        if len(metrics_for_one) > 1:
+        if len(first) > 1:
             raise ValueError("more than one metric available, "
                              "pick one metric via metric arg.")
-        metric, results = list(metrics_for_one.items())[0]
+        metric = next(iter(first))
+    elif metric not in first:
+        raise ValueError("No given metric in eval results.")
+
+    canvas = _AxesCanvas(ax, figsize, dpi)
+    n_iter = len(first[metric])
+    for name in names:
+        canvas.ax.plot(range(n_iter), history[name][metric], label=name)
+    canvas.ax.legend(loc="best")
+    return canvas.decorate(
+        xlim=xlim if xlim is not None else (0, n_iter),
+        ylim=ylim, title=title, xlabel=xlabel, ylabel=ylabel, grid=grid,
+        tokens={"metric": metric})
+
+
+def _node_label(node: Dict[str, Any], feature_names: List[str],
+                show_info: List[str], precision: Optional[int]) -> str:
+    """Multi-line graphviz label for one dumped node."""
+    if "split_index" in node:
+        fidx = node["split_feature"]
+        feat = feature_names[fidx] if fidx < len(feature_names) \
+            else f"Column_{fidx}"
+        lines = [f"{feat} {node['decision_type']} "
+                 f"{_fmt(node['threshold'], precision)}"]
+        for key in ("split_gain", "internal_value", "internal_count"):
+            if key in show_info and key in node:
+                lines.append(
+                    f"{key.rsplit('_', 1)[-1]}: {_fmt(node[key], precision)}")
     else:
-        if metric not in metrics_for_one:
-            raise ValueError("No given metric in eval results.")
-        results = metrics_for_one[metric]
-
-    ax = _get_ax(ax, figsize, dpi)
-    num_iteration = len(results)
-    x_ = range(num_iteration)
-    for name in dataset_names:
-        ax.plot(x_, eval_results[name][metric], label=name)
-    ax.legend(loc="best")
-    if xlim is not None:
-        _check_not_tuple_of_2_elements(xlim, "xlim")
-    else:
-        xlim = (0, num_iteration)
-    ax.set_xlim(xlim)
-    if ylim is not None:
-        _check_not_tuple_of_2_elements(ylim, "ylim")
-        ax.set_ylim(ylim)
-    if title is not None:
-        ax.set_title(title)
-    if xlabel is not None:
-        ax.set_xlabel(xlabel)
-    if ylabel is not None:
-        ax.set_ylabel(ylabel.replace("@metric@", metric))
-    ax.grid(grid)
-    return ax
-
-
-def _to_graphviz(tree_info: Dict[str, Any], show_info: List[str],
-                 feature_names: List[str], precision: Optional[int] = 3,
-                 orientation: str = "horizontal", **kwargs: Any):
-    try:
-        from graphviz import Digraph
-    except ImportError as e:
-        raise ImportError("You must install graphviz for plot_tree.") from e
-
-    graph = Digraph(**kwargs)
-    rankdir = "LR" if orientation == "horizontal" else "TB"
-    graph.attr(rankdir=rankdir)
-
-    def add(node: Dict[str, Any], parent: Optional[str] = None,
-            decision: Optional[str] = None) -> None:
-        if "split_index" in node:
-            name = f"split{node['split_index']}"
-            if node["split_feature"] < len(feature_names):
-                feat = feature_names[node["split_feature"]]
-            else:
-                feat = f"Column_{node['split_feature']}"
-            label = f"{feat} {node['decision_type']} " \
-                    f"{_float2str(node['threshold'], precision)}"
-            for info in ("split_gain", "internal_value", "internal_count"):
-                if info in show_info and info in node:
-                    label += f"\n{info.split('_')[-1]}: " \
-                             f"{_float2str(node[info], precision)}"
-            graph.node(name, label=label)
-            add(node["left_child"], name, "yes")
-            add(node["right_child"], name, "no")
-        else:
-            name = f"leaf{node['leaf_index']}"
-            label = f"leaf {node['leaf_index']}: " \
-                    f"{_float2str(node['leaf_value'], precision)}"
-            if "leaf_count" in show_info and "leaf_count" in node:
-                label += f"\ncount: {int(node['leaf_count'])}"
-            if "leaf_weight" in show_info and "leaf_weight" in node:
-                label += f"\nweight: {_float2str(node['leaf_weight'], precision)}"
-            graph.node(name, label=label)
-        if parent is not None:
-            graph.edge(parent, name, decision)
-
-    add(tree_info["tree_structure"])
-    return graph
+        lines = [f"leaf {node['leaf_index']}: "
+                 f"{_fmt(node['leaf_value'], precision)}"]
+        if "leaf_count" in show_info and "leaf_count" in node:
+            lines.append(f"count: {int(node['leaf_count'])}")
+        if "leaf_weight" in show_info and "leaf_weight" in node:
+            lines.append(f"weight: {_fmt(node['leaf_weight'], precision)}")
+    return "\n".join(lines)
 
 
 def create_tree_digraph(booster: Union[Booster, Any], tree_index: int = 0,
@@ -278,19 +247,34 @@ def create_tree_digraph(booster: Union[Booster, Any], tree_index: int = 0,
                         precision: Optional[int] = 3,
                         orientation: str = "horizontal", **kwargs: Any):
     """Create a graphviz Digraph of one tree."""
-    if hasattr(booster, "booster_"):
-        booster = booster.booster_
-    if not isinstance(booster, Booster):
-        raise TypeError("booster must be Booster or LGBMModel.")
-    model = booster.dump_model()
-    tree_infos = model["tree_info"]
-    if tree_index >= len(tree_infos):
+    try:
+        from graphviz import Digraph
+    except ImportError as e:
+        raise ImportError("You must install graphviz for plot_tree.") from e
+    b = _as_booster(booster)
+    model = b.dump_model()
+    if tree_index >= len(model["tree_info"]):
         raise IndexError("tree_index is out of range.")
-    if show_info is None:
-        show_info = []
-    return _to_graphviz(tree_infos[tree_index], show_info,
-                        model.get("feature_names", []), precision,
-                        orientation, **kwargs)
+    info = show_info or []
+    feature_names = model.get("feature_names", [])
+
+    graph = Digraph(**kwargs)
+    graph.attr(rankdir="LR" if orientation == "horizontal" else "TB")
+    # explicit worklist of (node, parent_name, edge_label); graphviz output
+    # order follows insertion, so children are pushed right before left
+    todo = [(model["tree_info"][tree_index]["tree_structure"], None, None)]
+    while todo:
+        node, parent, edge = todo.pop()
+        name = f"split{node['split_index']}" if "split_index" in node \
+            else f"leaf{node['leaf_index']}"
+        graph.node(name, label=_node_label(node, feature_names, info,
+                                           precision))
+        if parent is not None:
+            graph.edge(parent, name, edge)
+        if "split_index" in node:
+            todo.append((node["right_child"], name, "no"))
+            todo.append((node["left_child"], name, "yes"))
+    return graph
 
 
 def plot_tree(booster: Union[Booster, Any], ax=None, tree_index: int = 0,
@@ -298,14 +282,13 @@ def plot_tree(booster: Union[Booster, Any], ax=None, tree_index: int = 0,
               precision: Optional[int] = 3, orientation: str = "horizontal",
               **kwargs: Any):
     """Render one tree with matplotlib (via graphviz)."""
+    from io import BytesIO
+
     import matplotlib.image as mimage
-    ax = _get_ax(ax, figsize, dpi)
+    canvas = _AxesCanvas(ax, figsize, dpi)
     graph = create_tree_digraph(booster=booster, tree_index=tree_index,
                                 show_info=show_info, precision=precision,
                                 orientation=orientation, **kwargs)
-    from io import BytesIO
-    s = BytesIO(graph.pipe(format="png"))
-    img = mimage.imread(s)
-    ax.imshow(img)
-    ax.axis("off")
-    return ax
+    canvas.ax.imshow(mimage.imread(BytesIO(graph.pipe(format="png"))))
+    canvas.ax.axis("off")
+    return canvas.ax

@@ -68,7 +68,8 @@ def run_backend(backend: str, params) -> dict:
 
 def main():
     cpu = run_backend("cpu", PARAMS)
-    tpu_bf16 = run_backend("axon", PARAMS)
+    tpu_bf16 = run_backend("axon", dict(PARAMS, tpu_hist_dtype="bfloat16"))
+    # float32 is the library default; spelled out for clarity
     strict = dict(PARAMS, tpu_hist_dtype="float32")
     tpu_f32 = run_backend("axon", strict)
     print(f"cpu      auc={cpu['auc']:.6f} logloss={cpu['logloss']:.6f}")
