@@ -18,10 +18,12 @@ strict learner closely (tests/test_batch_grower.py) at up to ~k× the
 throughput.  The reference has no counterpart; its CPU learner pays
 O(child rows) per split and needs no such amortization.
 
-Supported feature set: numerical splits with missing handling, EFB bundles,
-bagging row masks, per-tree feature sampling, depth limits, data-parallel
-``shard_map`` (axis psum).  Categorical/monotone/forced/interaction/CEGB
-training routes through the strict learner (boosting/gbdt.py dispatch).
+Supported feature set: numerical splits with missing handling, categorical
+splits (one-hot + sorted-subset, applied via per-split bitsets), basic-method
+monotone constraints, EFB bundles, bagging row masks, per-tree feature
+sampling, depth limits, data-parallel ``shard_map`` (axis psum).
+Intermediate/advanced monotone, forced splits, interaction constraints and
+CEGB route through the strict learner (boosting/gbdt.py dispatch).
 """
 
 from __future__ import annotations
@@ -34,9 +36,11 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..ops.histogram import histogram_for_leaves_auto, root_histogram
-from ..ops.split import NEG_INF, SplitHyper, find_best_split, leaf_output
-from .grower import (DeviceBundle, TreeArrays, _empty_tree, _expand_hist,
-                     _feature_bin_of_rows)
+from ..ops.split import (NEG_INF, VAR_CAT_BWD, VAR_CAT_FWD, SplitHyper,
+                         categorical_left_bitset, find_best_split,
+                         leaf_output)
+from .grower import (DeviceBundle, TreeArrays, _INF_BOUND, _empty_tree,
+                     _expand_hist, _expand_hist_col, _feature_bin_of_rows)
 
 
 @functools.partial(jax.jit, static_argnames=("hp", "batch", "axis_name"))
@@ -46,14 +50,16 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                       feature_mask: Optional[jax.Array], hp: SplitHyper,
                       batch: int = 8,
                       bundle: Optional[DeviceBundle] = None,
+                      monotone: Optional[jax.Array] = None,
                       axis_name: Optional[str] = None
                       ) -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree with ``batch`` splits per histogram pass.
 
     Same operands and return contract as ``grow_tree``.
     """
-    assert not hp.has_categorical, \
-        "batched grower: categorical data routes through the strict learner"
+    if hp.use_monotone:
+        assert monotone is not None and hp.monotone_method == "basic", \
+            "batched grower supports monotone_constraints_method=basic only"
     n = bins.shape[0]
     num_f = bins.shape[1] if bundle is None else bundle.feat_col.shape[0]
     L = hp.num_leaves
@@ -61,12 +67,14 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     mask_f = jnp.ones_like(grad) if row_mask is None \
         else row_mask.astype(grad.dtype)
     bins_t = lax.optimization_barrier(bins.T)
+    INF = jnp.float32(_INF_BOUND)
 
-    def child_best(h_phys, g_, h_, c_, depth):
+    def child_best(h_phys, g_, h_, c_, depth, lmin, lmax):
         hv = h_phys if bundle is None else \
             _expand_hist(h_phys, bundle, g_, h_, c_)
         res = find_best_split(hv, g_, h_, c_, num_bins, nan_bin, is_cat,
-                              feature_mask, hp)
+                              feature_mask, hp, monotone=monotone,
+                              leaf_min=lmin, leaf_max=lmax, depth=depth)
         depth_ok = (hp.max_depth <= 0) | (depth < hp.max_depth)
         return res._replace(gain=jnp.where(depth_ok, res.gain, NEG_INF))
 
@@ -83,7 +91,7 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         c0 = lax.psum(c0, axis_name)
     root_out = leaf_output(g0, h0, hp.lambda_l1, hp.lambda_l2,
                            hp.max_delta_step)
-    best0 = child_best(hist0_b, g0, h0, c0, jnp.int32(0))
+    best0 = child_best(hist0_b, g0, h0, c0, jnp.int32(0), -INF, INF)
 
     tree = _empty_tree(L, hp.n_bins, num_f)
     tree = tree._replace(
@@ -104,9 +112,12 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         best_feat=jnp.zeros((L,), jnp.int32).at[0].set(best0.feature),
         best_thr=jnp.zeros((L,), jnp.int32).at[0].set(best0.threshold),
         best_dl=jnp.zeros((L,), bool).at[0].set(best0.default_left),
+        best_var=jnp.zeros((L,), jnp.int32).at[0].set(best0.variant),
         best_lg=jnp.zeros((L,), jnp.float32).at[0].set(best0.left_sum_g),
         best_lh=jnp.zeros((L,), jnp.float32).at[0].set(best0.left_sum_h),
         best_lc=jnp.zeros((L,), jnp.float32).at[0].set(best0.left_count),
+        leaf_min=jnp.full((L,), -INF, jnp.float32),
+        leaf_max=jnp.full((L,), INF, jnp.float32),
         parent_node=jnp.full((L,), -1, jnp.int32),
         parent_side=jnp.zeros((L,), jnp.int32),
         n_splits=jnp.int32(0),
@@ -124,6 +135,7 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         t = st["tree"]
         lor = st["leaf_of_row"]
         # record + partition each slot (cheap [L]/[n] ops, no data passes)
+        bitsets = []
         for j in range(K):
             ok = valid[j]
             bl = parents[j]
@@ -132,10 +144,26 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             feat = st["best_feat"][bl]
             thr = st["best_thr"][bl]
             dl = st["best_dl"][bl]
+            var = st["best_var"][bl]
+            catl = is_cat[feat]
             pg, ph, pc = st["sum_g"][bl], st["sum_h"][bl], st["count"][bl]
             lg, lh, lcn = st["best_lg"][bl], st["best_lh"][bl], \
                 st["best_lc"][bl]
             rg, rh, rcn = pg - lg, ph - lh, pc - lcn
+
+            # left-category bitset from the PARENT histogram (st["hist"][bl]
+            # still holds the parent at record time; the strict learner does
+            # the same, grower.py split())
+            if hp.has_categorical:
+                col_of = feat if bundle is None else bundle.feat_col[feat]
+                pf_col = st["hist"][bl, col_of]
+                hist_pf = pf_col if bundle is None else \
+                    _expand_hist_col(pf_col, bundle, feat, pg, ph, pc)
+                bitset = categorical_left_bitset(
+                    hist_pf, num_bins[feat], var, thr, hp) & catl
+            else:
+                bitset = jnp.zeros((hp.n_bins,), bool)
+            bitsets.append(bitset)
 
             p, side = st["parent_node"][bl], st["parent_side"][bl]
             ps = jnp.maximum(p, 0)
@@ -150,10 +178,32 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             rc_arr = rc_arr.at[nid].set(
                 jnp.where(ok, -(nl + 1), rc_arr[nid]))
 
-            lo = leaf_output(lg, lh, hp.lambda_l1, hp.lambda_l2,
+            # sorted-subset categorical children use l2 + cat_l2, matching
+            # the strict learner and feature_histogram.cpp:250
+            l2_eff = hp.lambda_l2 + jnp.where(
+                (var == VAR_CAT_FWD) | (var == VAR_CAT_BWD), hp.cat_l2, 0.0)
+            lo = leaf_output(lg, lh, hp.lambda_l1, l2_eff,
                              hp.max_delta_step)
-            ro = leaf_output(rg, rh, hp.lambda_l1, hp.lambda_l2,
+            ro = leaf_output(rg, rh, hp.lambda_l1, l2_eff,
                              hp.max_delta_step)
+            if hp.use_monotone:
+                # basic method (monotone_constraints.hpp BasicLeafConstraints):
+                # clip children into the parent's box, then tighten each
+                # child's box at the midpoint along the split direction
+                lmin_p, lmax_p = st["leaf_min"][bl], st["leaf_max"][bl]
+                lo = jnp.clip(lo, lmin_p, lmax_p)
+                ro = jnp.clip(ro, lmin_p, lmax_p)
+                mono_f = monotone[feat]
+                is_num = ~catl
+                mid = (lo + ro) * 0.5
+                lmax_l = jnp.where(is_num & (mono_f > 0),
+                                   jnp.minimum(lmax_p, mid), lmax_p)
+                lmin_l = jnp.where(is_num & (mono_f < 0),
+                                   jnp.maximum(lmin_p, mid), lmin_p)
+                lmin_r = jnp.where(is_num & (mono_f > 0),
+                                   jnp.maximum(lmin_p, mid), lmin_p)
+                lmax_r = jnp.where(is_num & (mono_f < 0),
+                                   jnp.minimum(lmax_p, mid), lmax_p)
             d = t.leaf_depth[bl] + 1
 
             def w(arr, idx, val):
@@ -163,6 +213,9 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                 split_feature=w(t.split_feature, nid, feat),
                 split_bin=w(t.split_bin, nid, thr),
                 default_left=w(t.default_left, nid, dl),
+                split_cat=w(t.split_cat, nid, catl),
+                cat_bitset=t.cat_bitset.at[nid].set(
+                    jnp.where(ok, bitset, t.cat_bitset[nid])),
                 left_child=lc_arr, right_child=rc_arr,
                 split_gain=w(t.split_gain, nid, st["best_gain"][bl]),
                 internal_value=w(t.internal_value, nid,
@@ -181,6 +234,9 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             st["count"] = w(w(st["count"], bl, lcn), nl, rcn)
             st["parent_node"] = w(w(st["parent_node"], bl, nid), nl, nid)
             st["parent_side"] = w(w(st["parent_side"], bl, 0), nl, 1)
+            if hp.use_monotone:
+                st["leaf_min"] = w(w(st["leaf_min"], bl, lmin_l), nl, lmin_r)
+                st["leaf_max"] = w(w(st["leaf_max"], bl, lmax_l), nl, lmax_r)
             # split leaves' cached gains are consumed
             st["best_gain"] = st["best_gain"].at[bl].set(
                 jnp.where(ok, NEG_INF, st["best_gain"][bl]))
@@ -195,6 +251,11 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             dl_k = st["best_dl"][parents][:, None]
             nanb_k = nan_bin[feats_k][:, None]
             go_left_k = jnp.where(cols_k == nanb_k, dl_k, cols_k <= thr_k)
+            if hp.has_categorical:
+                bitsets_k = jnp.stack(bitsets)                      # [K, B]
+                cat_k = is_cat[feats_k][:, None]                    # [K, 1]
+                go_cat_k = jnp.take_along_axis(bitsets_k, cols_k, axis=1)
+                go_left_k = jnp.where(cat_k, go_cat_k, go_left_k)
             in_parent = (lor[None, :] == parents[:, None]) \
                 & valid[:, None]                                    # [K, n]
             move = in_parent & ~go_left_k                           # [K, n]
@@ -235,12 +296,14 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             depths = st["tree"].leaf_depth[kids]
             res = jax.vmap(child_best)(kid_hist, st["sum_g"][kids],
                                        st["sum_h"][kids], st["count"][kids],
-                                       depths)
+                                       depths, st["leaf_min"][kids],
+                                       st["leaf_max"][kids])
             ok2 = jnp.concatenate([valid, valid])
             gains2 = jnp.where(ok2, res.gain, st["best_gain"][kids])
             st["best_gain"] = st["best_gain"].at[kids].set(gains2)
             for name, field in (("best_feat", res.feature),
                                 ("best_thr", res.threshold),
+                                ("best_var", res.variant),
                                 ("best_lg", res.left_sum_g),
                                 ("best_lh", res.left_sum_h),
                                 ("best_lc", res.left_count)):

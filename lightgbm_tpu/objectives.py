@@ -577,42 +577,65 @@ class LambdarankNDCG(ObjectiveFunction):
         order = jnp.argsort(-sc, axis=1, stable=True)      # positions -> doc slot
         rank = jnp.argsort(order, axis=1)                  # doc slot -> position
 
-        disc = 1.0 / jnp.log2(rank.astype(jnp.float32) + 2.0)  # [nq, Q]
-        inv_dcg = self._inv_max_dcg[:, None]
+        # -- truncation-aware pair enumeration in SORTED space.  The
+        # reference (rank_objective.hpp:138-292) iterates i over sorted
+        # positions [0, trunc) and j over (i, cnt): every pair has its
+        # higher-scored member inside the truncation level, so the pair set
+        # is O(Q * trunc), not O(Q^2).  Materializing [nq, T, Q] instead of
+        # [nq, Q, Q] is what makes MS-LTR-scale query lengths (thousands of
+        # docs) fit in memory (VERDICT r1 #7).
+        Q = sc.shape[1]
+        T = int(min(trunc, Q))
+        s_srt = jnp.take_along_axis(sc, order, axis=1)      # [nq, Q] desc
+        g_srt = jnp.take_along_axis(gains, order, axis=1)
+        l_srt = jnp.take_along_axis(lbl, order, axis=1)
+        v_srt = jnp.take_along_axis(valid, order, axis=1)
+        disc = 1.0 / jnp.log2(jnp.arange(Q, dtype=jnp.float32) + 2.0)  # [Q]
+        inv_dcg = self._inv_max_dcg[:, None, None]           # [nq, 1, 1]
 
-        # pairwise: delta NDCG for swapping i and j
-        di = disc[:, :, None]
-        dj = disc[:, None, :]
-        gi = gains[:, :, None]
-        gj = gains[:, None, :]
-        delta = jnp.abs((gi - gj) * (di - dj)) * inv_dcg[..., None]
-        si = sc[:, :, None]
-        sj = sc[:, None, :]
-        better = (lbl[:, :, None] > lbl[:, None, :])
-        # truncation: the higher-ranked doc of the pair within trunc level
-        in_trunc = jnp.minimum(rank[:, :, None], rank[:, None, :]) < trunc
-        pair_ok = better & in_trunc & valid[:, :, None] & valid[:, None, :]
+        sa = s_srt[:, :T, None]                              # [nq, T, 1]
+        sb = s_srt[:, None, :]                               # [nq, 1, Q]
+        ga_ = g_srt[:, :T, None]
+        gb_ = g_srt[:, None, :]
+        la_ = l_srt[:, :T, None]
+        lb_ = l_srt[:, None, :]
+        delta = jnp.abs((ga_ - gb_)
+                        * (disc[None, :T, None] - disc[None, None, :])) \
+            * inv_dcg                                        # [nq, T, Q]
+        # each unordered pair once: position b strictly below position a
+        tri = (jnp.arange(Q)[None, None, :]
+               > jnp.arange(T)[None, :, None])
+        pair_ok = (la_ != lb_) & tri & v_srt[:, :T, None] & v_srt[:, None, :]
 
-        diff = jnp.clip(si - sj, -50.0 / s, 50.0 / s)
-        rho = 1.0 / (1.0 + jnp.exp(s * diff))    # sigmoid(-(si-sj)*s)
-        lam = -s * rho * delta                    # dL/ds_i for the better doc
+        a_better = la_ > lb_
+        diff_hl = jnp.where(a_better, sa - sb, sb - sa)      # s_high - s_low
+        diff_hl = jnp.clip(diff_hl, -50.0 / s, 50.0 / s)
+        rho = 1.0 / (1.0 + jnp.exp(s * diff_hl))
+        lam = -s * rho * delta                    # dL/ds for the better doc
         hes = s * s * rho * (1.0 - rho) * delta
         lam = jnp.where(pair_ok, lam, 0.0)
         hes = jnp.where(pair_ok, hes, 0.0)
 
-        g_doc = jnp.sum(lam, axis=2) - jnp.sum(jnp.where(
-            jnp.swapaxes(pair_ok, 1, 2), jnp.swapaxes(lam, 1, 2), 0.0), axis=2)
-        h_doc = jnp.sum(hes, axis=2) + jnp.sum(jnp.where(
-            jnp.swapaxes(pair_ok, 1, 2), jnp.swapaxes(hes, 1, 2), 0.0), axis=2)
+        # accumulate onto sorted positions: a gets +/-lam per label order,
+        # b the negation; hessians add on both ends
+        g_a = jnp.where(a_better, lam, -lam)
+        g_pos = jnp.zeros_like(s_srt).at[:, :T].add(jnp.sum(g_a, axis=2))
+        g_pos = g_pos - jnp.sum(g_a, axis=1)
+        h_pos = jnp.zeros_like(s_srt).at[:, :T].add(jnp.sum(hes, axis=2))
+        h_pos = h_pos + jnp.sum(hes, axis=1)
 
         if norm:
             # reference norm_: scale by log2(1 + |sum lambda|) / |sum lambda|
-            sum_lam = jnp.sum(jnp.abs(lam), axis=(1, 2), keepdims=False)
+            sum_lam = jnp.sum(jnp.abs(lam), axis=(1, 2))
             nf = jnp.where(sum_lam > 0,
                            jnp.log2(1.0 + sum_lam) / jnp.maximum(sum_lam, 1e-20),
                            1.0)
-            g_doc = g_doc * nf[:, None]
-            h_doc = h_doc * nf[:, None]
+            g_pos = g_pos * nf[:, None]
+            h_pos = h_pos * nf[:, None]
+
+        # sorted positions back to padded doc slots
+        g_doc = jnp.take_along_axis(g_pos, rank, axis=1)
+        h_doc = jnp.take_along_axis(h_pos, rank, axis=1)
 
         g = jnp.zeros_like(score).at[safe.reshape(-1)].add(
             jnp.where(valid, g_doc, 0.0).reshape(-1))

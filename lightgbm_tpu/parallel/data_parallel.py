@@ -143,7 +143,9 @@ def grow_tree_batched_sharded(mesh: Mesh, bins: jax.Array, grad: jax.Array,
                               is_cat: jax.Array,
                               feature_mask: Optional[jax.Array],
                               hp: SplitHyper, batch: int,
-                              bundle=None) -> Tuple[TreeArrays, jax.Array]:
+                              bundle=None,
+                              monotone: Optional[jax.Array] = None
+                              ) -> Tuple[TreeArrays, jax.Array]:
     """Batched-round grower (learner/batch_grower.py) under the data mesh:
     K splits per psum-ed widened histogram pass."""
     from ..learner.batch_grower import grow_tree_batched
@@ -157,18 +159,19 @@ def grow_tree_batched_sharded(mesh: Mesh, bins: jax.Array, grad: jax.Array,
         P(), P(), P(),
         P() if feature_mask is not None else None,
         rep(bundle),
+        P() if monotone is not None else None,
     )
     out_specs = (
         jax.tree.map(lambda _: P(), TreeArrays(*[0] * len(TreeArrays._fields))),
         P(DATA_AXIS),
     )
 
-    def local(b, g, h, m, nb, nanb, cat, fm, bd):
+    def local(b, g, h, m, nb, nanb, cat, fm, bd, mono):
         return grow_tree_batched(b, g, h, m, nb, nanb, cat, fm, hp,
-                                 batch=batch, bundle=bd,
+                                 batch=batch, bundle=bd, monotone=mono,
                                  axis_name=DATA_AXIS)
 
     fn = shard_map(local, mesh=mesh, in_specs=in_specs,
                    out_specs=out_specs, check_vma=False)
     return fn(bins, grad, hess, row_mask, num_bins, nan_bin, is_cat,
-              feature_mask, bundle)
+              feature_mask, bundle, monotone)

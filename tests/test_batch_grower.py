@@ -133,3 +133,103 @@ def test_batched_fallback_for_categorical():
          "verbose": -1, "tpu_split_batch": 8, "categorical_feature": [1]}
     b = lgb.train(p, lgb.Dataset(X, label=y, params=p), num_boost_round=10)
     assert float(((b.predict(X) > 0.5) == y).mean()) > 0.9
+
+
+def test_batch1_categorical_identical_to_strict():
+    """batch=1 with categorical features reproduces the strict learner's
+    trees exactly (split set, bitsets, partition)."""
+    rng = np.random.default_rng(3)
+    n, f = 5000, 6
+    bins = rng.integers(0, 31, size=(n, f)).astype(np.uint8)
+    # feature 1 and 4 categorical; signal on specific categories
+    logit = (bins[:, 0] / 16.0 - 1.0) + 0.8 * np.isin(bins[:, 1], [3, 7, 11]) \
+        - 0.5 * np.isin(bins[:, 4], [0, 2])
+    y = (logit + rng.normal(scale=0.4, size=n) > 0).astype(np.float32)
+    g = (1 / (1 + np.exp(-logit)) - y).astype(np.float32)
+    h = np.full(n, 0.25, np.float32)
+    nb = np.full(f, 31, np.int32)
+    nanb = np.full(f, -1, np.int32)
+    cat = np.zeros(f, bool)
+    cat[[1, 4]] = True
+    hp = SplitHyper(num_leaves=15, min_data_in_leaf=5, n_bins=32,
+                    has_categorical=True, max_cat_to_onehot=4)
+    args = tuple(map(jnp.asarray, (bins, g, h)))
+    consts = tuple(map(jnp.asarray, (nb, nanb, cat)))
+    t0, lor0 = grow_tree(*args[:3], None, *consts, None, hp)
+    t1, lor1 = grow_tree_batched(*args[:3], None, *consts, None, hp, batch=1)
+    assert int(t1.num_leaves) == int(t0.num_leaves)
+    np.testing.assert_array_equal(np.asarray(t1.split_feature),
+                                  np.asarray(t0.split_feature))
+    np.testing.assert_array_equal(np.asarray(t1.split_bin),
+                                  np.asarray(t0.split_bin))
+    np.testing.assert_array_equal(np.asarray(t1.split_cat),
+                                  np.asarray(t0.split_cat))
+    np.testing.assert_array_equal(np.asarray(t1.cat_bitset),
+                                  np.asarray(t0.cat_bitset))
+    np.testing.assert_allclose(np.asarray(t1.leaf_value),
+                               np.asarray(t0.leaf_value), atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(lor1), np.asarray(lor0))
+
+
+def test_batched_categorical_quality():
+    """batch=8 on categorical data trains to the same quality ballpark as
+    strict, through the public params surface (the perf-representative
+    path: VERDICT r1 #3)."""
+    rng = np.random.default_rng(9)
+    n = 4000
+    X = rng.normal(size=(n, 5))
+    X[:, 2] = rng.integers(0, 20, size=n)
+    y = ((X[:, 0] + 1.2 * np.isin(X[:, 2], [4, 9, 13])
+          + rng.normal(scale=0.4, size=n)) > 0.5).astype(np.float64)
+    base = {"objective": "binary", "num_leaves": 31, "min_data_in_leaf": 5,
+            "verbose": -1, "categorical_feature": [2]}
+    b0 = lgb.train(base, lgb.Dataset(X, label=y, categorical_feature=[2],
+                                     params=base), num_boost_round=15)
+    p1 = {**base, "tpu_split_batch": 8}
+    b1 = lgb.train(p1, lgb.Dataset(X, label=y, categorical_feature=[2],
+                                   params=p1), num_boost_round=15)
+
+    def logloss(b):
+        pr = np.clip(b.predict(X), 1e-9, 1 - 1e-9)
+        return float(-np.mean(y * np.log(pr) + (1 - y) * np.log(1 - pr)))
+
+    l0, l1 = logloss(b0), logloss(b1)
+    assert l1 < l0 * 1.15 + 0.01
+
+
+def test_batch1_monotone_basic_identical_to_strict(problem):
+    bins, g, h, nb, nanb, cat = problem
+    mono = jnp.asarray(np.array([1, -1, 0, 0, 0, 0, 0, 0, 0, 0], np.int32))
+    hp = SplitHyper(num_leaves=31, min_data_in_leaf=5, n_bins=64,
+                    rows_per_block=2048, use_monotone=True,
+                    monotone_method="basic")
+    t0, lor0 = grow_tree(bins, g, h, None, nb, nanb, cat, None, hp,
+                         monotone=mono)
+    t1, lor1 = grow_tree_batched(bins, g, h, None, nb, nanb, cat, None, hp,
+                                 batch=1, monotone=mono)
+    assert int(t1.num_leaves) == int(t0.num_leaves)
+    np.testing.assert_array_equal(np.asarray(t1.split_feature),
+                                  np.asarray(t0.split_feature))
+    np.testing.assert_array_equal(np.asarray(t1.split_bin),
+                                  np.asarray(t0.split_bin))
+    np.testing.assert_allclose(np.asarray(t1.leaf_value),
+                               np.asarray(t0.leaf_value), atol=1e-5)
+
+
+def test_batched_monotone_respected():
+    """batch=8 + monotone_constraints=basic: predictions are monotone in
+    the constrained feature (sweep test, strict learner's own gate)."""
+    rng = np.random.default_rng(12)
+    n = 4000
+    X = rng.normal(size=(n, 4))
+    y = (2.0 * X[:, 0] + np.sin(X[:, 1] * 2) +
+         rng.normal(scale=0.3, size=n))
+    p = {"objective": "regression", "num_leaves": 31, "min_data_in_leaf": 5,
+         "verbose": -1, "monotone_constraints": [1, 0, 0, 0],
+         "monotone_constraints_method": "basic", "tpu_split_batch": 8}
+    b = lgb.train(p, lgb.Dataset(X, label=y, params=p), num_boost_round=20)
+    base = np.zeros((64, 4))
+    base[:, 1:] = rng.normal(size=(1, 3))
+    base[:, 0] = np.linspace(-3, 3, 64)
+    pred = b.predict(base)
+    assert (np.diff(pred) >= -1e-6).all()

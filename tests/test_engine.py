@@ -121,6 +121,97 @@ def test_lambdarank(synthetic_ranking):
     assert hist[-1] > hist[0]
 
 
+def test_lambdarank_truncation_pairs_match_dense():
+    """The O(nq*T*Q) sorted-space pair enumeration (rank_objective.hpp
+    truncation loop) produces the SAME gradients as a brute-force dense
+    [Q, Q] enumeration on small queries."""
+    import jax.numpy as jnp
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.objectives import create_objective
+
+    rng = np.random.default_rng(5)
+    nq, per_q = 8, 12
+    n = nq * per_q
+    y = rng.integers(0, 4, size=n).astype(np.float64)
+    score = rng.normal(size=n).astype(np.float32)
+    cfg = Config({"objective": "lambdarank",
+                  "lambdarank_truncation_level": 5, "verbose": -1})
+
+    class Meta:
+        pass
+
+    m = Meta()
+    m.label = y
+    m.weight = None
+    m.query_boundaries = np.arange(0, n + 1, per_q)
+    m.position = None
+    obj = create_objective(cfg)
+    obj.init(m, n)
+    g, h = obj.get_gradients(jnp.asarray(score))
+    g, h = np.asarray(g, np.float64), np.asarray(h, np.float64)
+
+    # brute force: all pairs, truncation by min sorted position, exactly
+    # the reference's FindBestThreshold-free lambda math
+    s = float(cfg.sigmoid)
+    trunc = int(cfg.lambdarank_truncation_level)
+    gains = np.power(2.0, y) - 1.0
+    g_ref = np.zeros(n)
+    h_ref = np.zeros(n)
+    for q in range(nq):
+        sl = slice(q * per_q, (q + 1) * per_q)
+        ys, ss_, gg = y[sl], score[sl].astype(np.float64), gains[sl]
+        order = np.argsort(-ss_, kind="stable")
+        rank = np.argsort(order)
+        top = np.sort(gg)[::-1][:trunc]
+        maxdcg = np.sum(top / np.log2(np.arange(2, len(top) + 2)))
+        inv = 1.0 / maxdcg if maxdcg > 0 else 0.0
+        lam_sum = 0.0
+        gq = np.zeros(per_q)
+        hq = np.zeros(per_q)
+        for i in range(per_q):
+            for j in range(per_q):
+                if ys[i] <= ys[j] or min(rank[i], rank[j]) >= trunc:
+                    continue
+                di = 1.0 / np.log2(rank[i] + 2.0)
+                dj = 1.0 / np.log2(rank[j] + 2.0)
+                delta = abs((gg[i] - gg[j]) * (di - dj)) * inv
+                rho = 1.0 / (1.0 + np.exp(s * np.clip(
+                    ss_[i] - ss_[j], -50.0 / s, 50.0 / s)))
+                lam = -s * rho * delta
+                hes = s * s * rho * (1.0 - rho) * delta
+                gq[i] += lam
+                gq[j] -= lam
+                hq[i] += hes
+                hq[j] += hes
+                lam_sum += abs(lam)
+        if cfg.lambdarank_norm and lam_sum > 0:
+            nf = np.log2(1.0 + lam_sum) / lam_sum
+            gq *= nf
+            hq *= nf
+        g_ref[sl], h_ref[sl] = gq, hq
+    np.testing.assert_allclose(g, g_ref, rtol=2e-4, atol=2e-6)
+    np.testing.assert_allclose(h, h_ref, rtol=2e-4, atol=2e-6)
+
+
+def test_lambdarank_long_queries_memory_bounded():
+    """5k-doc queries train without materializing [nq, Q, Q] (VERDICT r1
+    #7: the dense tensor would be nq*Q^2*4B = 2 GB per channel here)."""
+    rng = np.random.default_rng(11)
+    nq, per_q = 10, 5000
+    n = nq * per_q
+    X = rng.normal(size=(n, 4)).astype(np.float32)
+    w = rng.normal(size=4)
+    y = np.clip((X @ w + rng.normal(scale=0.8, size=n)) * 0.8 + 1.5,
+                0, 4).round()
+    ds = lgb.Dataset(X, label=y, group=np.full(nq, per_q),
+                     params={**FAST})
+    bst = lgb.train({**FAST, "objective": "lambdarank",
+                     "metric": ["ndcg"], "eval_at": [10]},
+                    ds, num_boost_round=2, valid_sets=[ds])
+    (_, _, val, _), = bst.eval_train()
+    assert val > 0.3
+
+
 def test_linear_tree(synthetic_regression):
     """linear_tree=true fits ridge models in the leaves
     (linear_tree_learner.cpp CalculateLinear): on a piecewise-linear target
