@@ -135,6 +135,32 @@ def _margin_reached(out: np.ndarray, margin: float) -> np.ndarray:
     return (part[:, -1] - part[:, -2]) >= margin
 
 
+def _objective_string_transform(out: np.ndarray, obj_str: str) -> np.ndarray:
+    """Raw scores [n, k] -> output space, from a model-text objective string
+    like ``"binary sigmoid:1"`` (reference ConvertOutput dispatch for
+    text-loaded models, objective_function.h)."""
+    obj_tokens = obj_str.split(" ")
+    obj = obj_tokens[0]
+    if obj == "binary":
+        sig = 1.0
+        for tok in obj_tokens[1:]:
+            if tok.startswith("sigmoid:"):
+                sig = float(tok.split(":")[1])
+        return 1.0 / (1.0 + np.exp(-sig * out))
+    if obj == "multiclass":
+        ex = np.exp(out - out.max(axis=1, keepdims=True))
+        return ex / ex.sum(axis=1, keepdims=True)
+    if obj in ("multiclassova", "cross_entropy"):
+        return 1.0 / (1.0 + np.exp(-out))
+    if obj in ("poisson", "gamma", "tweedie"):
+        return np.exp(out)
+    if obj == "cross_entropy_lambda":
+        return np.log1p(np.exp(out))
+    if obj == "regression" and "sqrt" in obj_tokens[1:]:
+        return np.sign(out) * out * out
+    return out
+
+
 class Dataset:
     """Lazily-constructed binned dataset (reference basic.py:1764)."""
 
@@ -452,6 +478,11 @@ class Booster:
             return np.asarray(data.toarray(), np.float64)
         return np.asarray(data, np.float64)
 
+    def num_feature(self) -> int:
+        """Number of features the model was trained on (reference
+        Booster.num_feature / LGBM_BoosterGetNumFeature c_api.h:876)."""
+        return len(self.feature_name())
+
     def _predict_loaded(self, X, start_iteration, num_iteration, raw_score,
                         pred_leaf, early=None) -> np.ndarray:
         trees = self._loaded["trees"]
@@ -477,26 +508,8 @@ class Booster:
                 active &= ~_margin_reached(out, early[2])
                 if not active.any():
                     break
-        obj_tokens = self._loaded["objective"].split(" ")
-        obj = obj_tokens[0]
         if not raw_score:
-            if obj == "binary":
-                sig = 1.0
-                for tok in obj_tokens[1:]:
-                    if tok.startswith("sigmoid:"):
-                        sig = float(tok.split(":")[1])
-                out = 1.0 / (1.0 + np.exp(-sig * out))
-            elif obj in ("multiclass",):
-                ex = np.exp(out - out.max(axis=1, keepdims=True))
-                out = ex / ex.sum(axis=1, keepdims=True)
-            elif obj in ("multiclassova", "cross_entropy"):
-                out = 1.0 / (1.0 + np.exp(-out))
-            elif obj in ("poisson", "gamma", "tweedie"):
-                out = np.exp(out)
-            elif obj == "cross_entropy_lambda":
-                out = np.log1p(np.exp(out))
-            elif obj == "regression" and "sqrt" in obj_tokens[1:]:
-                out = np.sign(out) * out * out
+            out = _objective_string_transform(out, self._loaded["objective"])
         return out[:, 0] if k == 1 else out
 
     def _predict_contrib(self, X, start_iteration, num_iteration):

@@ -656,21 +656,34 @@ class GBDT:
 
     # ------------------------------------------------------------- evaluate
     def eval_train(self) -> List[Tuple[str, str, float, bool]]:
-        out = []
-        score = self._host_scores(self.scores)
-        for m in self.train_metrics:
-            for name, val in m.eval(score, self.objective):
-                out.append(("training", name, val, m.bigger_is_better))
-        return out
+        return self._eval_metric_list("training", self.train_metrics,
+                                      self.scores)
 
     def eval_valid(self) -> List[Tuple[str, str, float, bool]]:
         out = []
         for vi, ms in enumerate(self.valid_metrics):
-            score = self._host_scores(self.valid_scores[vi])
-            for m in ms:
-                for name, val in m.eval(score, self.objective):
-                    out.append((self.valid_names[vi], name, val,
-                                m.bigger_is_better))
+            out.extend(self._eval_metric_list(
+                self.valid_names[vi], ms, self.valid_scores[vi]))
+        return out
+
+    def _eval_metric_list(self, set_name, metrics, scores_dev):
+        """Evaluate on device where supported (metrics.py eval_device —
+        scalars cross the boundary, not score arrays); host f64 otherwise
+        and always under deterministic=true."""
+        use_dev = (bool(self.config.tpu_device_eval)
+                   and not bool(self.config.deterministic)
+                   and scores_dev.shape[1] == 1)
+        out = []
+        score_host = None
+        for m in metrics:
+            res = m.eval_device(scores_dev[:, 0], self.objective) \
+                if use_dev else None
+            if res is None:
+                if score_host is None:
+                    score_host = self._host_scores(scores_dev)
+                res = m.eval(score_host, self.objective)
+            for name, val in res:
+                out.append((set_name, name, val, m.bigger_is_better))
         return out
 
     def _host_scores(self, scores: jax.Array) -> np.ndarray:

@@ -266,3 +266,178 @@ def booster_save_model_to_string(b_id: int, out_ptr: int,
         buf[:need - 1] = np.frombuffer(s, dtype="S1")
         buf[need - 1] = b"\x00"
     return need
+
+
+def dataset_from_csc(colptr_ptr: int, indices_ptr: int, data_ptr: int,
+                     ncol: int, nnz: int, nrow: int, label_ptr: int,
+                     params_json: str) -> int:
+    """LGBM_DatasetCreateFromCSC (c_api.h:479) equivalent.
+
+    Densified host-side like the CSR path (the TPU training layout is
+    dense); duplicate (row, col) entries are summed.
+    """
+    import lightgbm_tpu as lgb
+    colptr = _arr_i32(colptr_ptr, ncol + 1)
+    indices = _arr_i32(indices_ptr, nnz)
+    vals = _arr_f64(data_ptr, nnz)
+    cols = np.repeat(np.arange(ncol, dtype=np.int64), np.diff(colptr))
+    dense = np.bincount(indices.astype(np.int64) * ncol + cols, weights=vals,
+                        minlength=nrow * ncol).reshape(nrow, ncol)
+    label = _arr_f64(label_ptr, nrow).copy() if label_ptr else None
+    params = json.loads(params_json) if params_json else {}
+    ds = lgb.Dataset(dense, label=label, params=params)
+    ds.construct()
+    return _new_handle(ds)
+
+
+def booster_from_string(model_str: str) -> int:
+    """LGBM_BoosterLoadModelFromString (c_api.h:677) equivalent."""
+    import lightgbm_tpu as lgb
+    return _new_handle(lgb.Booster(model_str=model_str))
+
+
+def booster_num_feature(b_id: int) -> int:
+    """LGBM_BoosterGetNumFeature (c_api.h:876) equivalent."""
+    return int(_handles[b_id].num_feature())
+
+
+def booster_feature_names(b_id: int) -> str:
+    """LGBM_BoosterGetFeatureNames (c_api.h:845): newline-joined."""
+    return "\n".join(_handles[b_id].feature_name())
+
+
+def booster_eval_names(b_id: int) -> str:
+    """LGBM_BoosterGetEvalNames (c_api.h:826): newline-joined metric names
+    in the order booster_get_eval writes values.  Computed from the metric
+    objects, NOT by running an evaluation; boosters loaded from a model
+    string/file carry no metrics and report none (like the reference)."""
+    g = _handles[b_id]._gbdt
+    if g is None:
+        return ""
+    names = []
+    for m in g.train_metrics:
+        names.extend(m.display_names())
+    return "\n".join(names)
+
+
+class _FastPredictor:
+    """Single-row fast predict (reference c_api.h:1162
+    LGBM_BoosterPredictForMatSingleRowFastInit + SingleRowPredictor cache,
+    src/c_api.cpp): tree arrays are stacked ONCE at init so each row is a
+    handful of [T]-vector numpy steps instead of per-call model setup.
+    Falls back to the Booster's own per-tree path for models the stacked
+    walk does not cover (categorical splits, linear leaves) — results are
+    bit-identical to batch predict either way."""
+
+    def __init__(self, booster, ncol: int, raw_score: bool):
+        from .models.tree import _CAT_MASK, _DEFAULT_LEFT_MASK
+        self.booster = booster
+        self.ncol = ncol
+        self.raw = bool(raw_score)
+        self.k = booster.num_model_per_iteration()
+        trees = booster._get_trees()
+        self.trees = trees
+        self.fallback = any(t.is_linear or (t.decision_type & _CAT_MASK).any()
+                            for t in trees)
+        self.n_trees_snapshot = len(trees)
+        if self.fallback:
+            return
+        T = len(trees)
+        ni = max(max((t.num_leaves - 1 for t in trees), default=1), 1)
+        self.sf = np.zeros((T, ni), np.int32)
+        self.thr = np.zeros((T, ni), np.float64)
+        self.dleft = np.zeros((T, ni), bool)
+        self.mtype = np.zeros((T, ni), np.int8)
+        self.lc = np.full((T, ni), -1, np.int32)
+        self.rc = np.full((T, ni), -1, np.int32)
+        lmax = max(t.num_leaves for t in trees)
+        self.lv = np.zeros((T, lmax), np.float64)
+        self.start = np.zeros(T, np.int32)
+        for ti, t in enumerate(trees):
+            m = t.num_leaves - 1
+            if m <= 0:
+                self.start[ti] = -1  # 1-leaf tree: already at leaf 0
+            self.sf[ti, :m] = t.split_feature[:m]
+            self.thr[ti, :m] = t.threshold[:m]
+            self.dleft[ti, :m] = (t.decision_type[:m] & _DEFAULT_LEFT_MASK) > 0
+            self.mtype[ti, :m] = (t.decision_type[:m] >> 2) & 3
+            self.lc[ti, :m] = t.left_child[:m]
+            self.rc[ti, :m] = t.right_child[:m]
+            self.lv[ti, :t.num_leaves] = t.leaf_value[:t.num_leaves]
+        self.tids = np.arange(T)
+        # walk bound: a root-to-leaf path visits < num_leaves nodes (the
+        # same bound models/tree.py predict_leaf_index uses)
+        self.walk_bound = max((t.num_leaves for t in trees), default=1)
+
+    def predict_row(self, row: np.ndarray) -> np.ndarray:
+        if self.booster.num_trees() != self.n_trees_snapshot:
+            # booster trained further since init: refresh the stacked
+            # arrays so fast predict stays bit-identical to batch predict
+            self.__init__(self.booster, self.ncol, self.raw)
+        if self.fallback:
+            return np.atleast_1d(self.booster.predict(
+                row.reshape(1, -1), raw_score=self.raw))
+        from .io.binning import (K_ZERO_THRESHOLD, MISSING_NONE, MISSING_ZERO)
+        cur = self.start.copy()
+        for _ in range(self.walk_bound):
+            internal = cur >= 0
+            if not internal.any():
+                break
+            node = np.maximum(cur, 0)
+            f = self.sf[self.tids, node]
+            v = row[f]
+            mt = self.mtype[self.tids, node]
+            isnan = np.isnan(v)
+            miss = isnan | ((mt == MISSING_ZERO)
+                            & (np.abs(v) <= K_ZERO_THRESHOLD))
+            use_def = miss & (mt != MISSING_NONE)
+            gl = np.where(use_def, self.dleft[self.tids, node],
+                          np.where(isnan, 0.0, v)
+                          <= self.thr[self.tids, node])
+            nxt = np.where(gl, self.lc[self.tids, node],
+                           self.rc[self.tids, node])
+            cur = np.where(internal, nxt, cur)
+        leaf = -cur - 1
+        vals = self.lv[self.tids, leaf]
+        out = np.zeros(self.k)
+        np.add.at(out, self.tids % self.k, vals)
+        if not self.raw:
+            out = self._transform(out)
+        return out
+
+    def _transform(self, out: np.ndarray) -> np.ndarray:
+        # identical math to Booster.predict's conversion for a single row
+        g = self.booster._gbdt
+        if g is not None:
+            if g.objective is None or not g.objective.need_convert_output:
+                return out
+            import jax.numpy as jnp
+            arr = out if self.k == 1 else out[None, :]
+            conv = g.objective.convert_output(jnp.asarray(arr))
+            return np.asarray(conv, np.float64).reshape(-1)
+        from .basic import _objective_string_transform
+        return _objective_string_transform(
+            out[None, :], self.booster._loaded["objective"]).reshape(-1)
+
+
+def fastpredict_init(b_id: int, ncol: int, raw_score: int) -> int:
+    b = _handles[b_id]
+    nf = int(b.num_feature())
+    if ncol != nf:
+        raise ValueError(f"model expects {nf} features, fast config "
+                         f"declares {ncol}")
+    return _new_handle(_FastPredictor(b, ncol, bool(raw_score)))
+
+
+def fastpredict_row(f_id: int, row_ptr: int, out_ptr: int,
+                    out_capacity: int) -> int:
+    fp = _handles[f_id]
+    if not isinstance(fp, _FastPredictor):
+        raise TypeError("handle is not a fast-predict config")
+    row = _arr_f64(row_ptr, fp.ncol)
+    preds = np.asarray(fp.predict_row(row), np.float64).reshape(-1)
+    if preds.size > out_capacity:
+        raise ValueError(f"prediction needs {preds.size} doubles, buffer "
+                         f"holds {out_capacity}")
+    _arr_f64(out_ptr, preds.size)[:] = preds
+    return int(preds.size)

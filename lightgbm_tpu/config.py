@@ -232,6 +232,7 @@ _PARAMS: List[Tuple[str, Any, Tuple[str, ...], Tuple[Tuple[str, float], ...]]] =
     ("num_gpu", 1, (), ((">", 0),)),
     ("tpu_hist_dtype", "float32", (), ()),       # hist product dtype; float32 (default) = exact CPU/reference parity, bfloat16 = ~3x faster kernels with ~2^-9 grad/hess input rounding; deterministic=true always forces float32
     ("tpu_debug_checks", False, (), ()),         # per-tree invariant checks (reference DEBUG CheckSplitValid)
+    ("tpu_device_eval", True, (), ()),           # jitted device metric eval (l2/l1/rmse/logloss/error/auc/ndcg); host f64 when false or deterministic=true
     ("tpu_rows_per_block", 16384, (), ()),        # histogram kernel row tile
     ("tpu_leaf_hist", "masked", (), ()),          # per-leaf hist: masked|bucketed
     ("tpu_split_batch", 1, (), ((">", 0),)),      # splits per histogram pass

@@ -2,10 +2,19 @@
 
 TPU-native re-design of the reference metric layer (reference:
 include/LightGBM/metric.h:24 ``Metric`` — Init/Eval/factor_to_bigger_better;
-factory src/metric/metric.cpp:21-127).  Metrics run once per
-``metric_freq`` iterations on host NumPy over the (converted) score array —
-they are O(n) or O(n log n) passes whose cost is negligible next to training,
-matching the reference where metrics are OpenMP host code even in CUDA mode.
+factory src/metric/metric.cpp:21-127).
+
+Two evaluation paths:
+
+- **Device** (``eval_device``): the big metrics (pointwise regression
+  family, binary logloss/error, auc, ndcg) evaluate as jitted reductions on
+  the default jax backend, so per-iteration eval moves only SCALARS across
+  the device boundary instead of the full score array (the reference's CUDA
+  metrics, src/metric/cuda/cuda_pointwise_metric.cu, reduce on device for
+  the same reason).  f32 arithmetic; falls back to host automatically for
+  unsupported configurations.
+- **Host** (``eval``): float64 NumPy — exact, used for multiclass/xentropy/
+  map and whenever ``deterministic=true`` pins bit-reproducible eval.
 
 Families (reference files): regression_metric.hpp, binary_metric.hpp,
 multiclass_metric.hpp, rank_metric.hpp (+dcg_calculator.cpp), map_metric.hpp,
@@ -16,6 +25,7 @@ auc/ndcg/map (metric.h factor_to_bigger_better).
 
 from __future__ import annotations
 
+import functools
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -23,6 +33,85 @@ import numpy as np
 from .config import Config
 from .io.dataset import Metadata
 from .utils import log
+
+
+# --------------------------------------------------- jitted device kernels
+# one compiled program per (metric, n) — reused every iteration
+
+@functools.lru_cache(maxsize=None)
+def _dev_pointwise(kind: str):
+    import jax
+    import jax.numpy as jnp
+
+    def run(p, y, w, sw):
+        if kind == "l2" or kind == "rmse":
+            loss = (p - y) ** 2
+        elif kind == "l1":
+            loss = jnp.abs(p - y)
+        elif kind == "binary_logloss":
+            # f32-safe clip: 1 - 1e-15 is not representable in float32 (the
+            # host path clips at 1e-15 in f64)
+            pc = jnp.clip(p, 1e-7, 1 - 1e-7)
+            loss = -(y * jnp.log(pc) + (1 - y) * jnp.log(1 - pc))
+        elif kind == "binary_error":
+            loss = ((p > 0.5) != (y > 0)).astype(jnp.float32)
+        else:  # pragma: no cover
+            raise ValueError(kind)
+        avg = jnp.mean(loss) if w is None else jnp.sum(loss * w) / sw
+        return jnp.sqrt(avg) if kind == "rmse" else avg
+    return jax.jit(run, static_argnames=())
+
+
+@functools.lru_cache(maxsize=None)
+def _dev_auc():
+    import jax
+    import jax.numpy as jnp
+
+    def run(score, y, w):
+        n = score.shape[0]
+        order = jnp.argsort(score, stable=True)
+        ys = y[order]
+        ws = jnp.ones_like(ys) if w is None else w[order]
+        ss = score[order]
+        pos_w = jnp.where(ys > 0, ws, 0.0)
+        neg_w = jnp.where(ys > 0, 0.0, ws)
+        total_pos = jnp.sum(pos_w)
+        total_neg = jnp.sum(neg_w)
+        # tie groups by score value; half credit inside a group (mirrors the
+        # host _weighted_auc / reference binary_metric.hpp AUCMetric)
+        boundary = jnp.concatenate([
+            jnp.ones((1,), bool), ss[1:] != ss[:-1]])
+        gid = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+        gpos = jax.ops.segment_sum(pos_w, gid, num_segments=n)
+        gneg = jax.ops.segment_sum(neg_w, gid, num_segments=n)
+        neg_before = jnp.cumsum(gneg) - gneg
+        auc = jnp.sum(gpos * (neg_before + 0.5 * gneg))
+        denom = total_pos * total_neg
+        return jnp.where(denom > 0, auc / jnp.maximum(denom, 1e-30), 1.0)
+    return jax.jit(run)
+
+
+@functools.lru_cache(maxsize=None)
+def _dev_ndcg(ks: tuple):
+    import jax
+    import jax.numpy as jnp
+
+    def run(score, qidx, gain_doc, idcgs, disc):
+        valid = qidx >= 0
+        safe = jnp.maximum(qidx, 0)
+        sc = jnp.where(valid, score[safe], -jnp.inf)
+        order = jnp.argsort(-sc, axis=1, stable=True)
+        g = jnp.where(valid, gain_doc[safe], 0.0)
+        g_srt = jnp.take_along_axis(g, order, axis=1)
+        out = []
+        for i, k in enumerate(ks):
+            kk = min(k, sc.shape[1])
+            dcg = jnp.sum(g_srt[:, :kk] * disc[None, :kk], axis=1)
+            idcg = idcgs[i]
+            out.append(jnp.mean(jnp.where(idcg > 0, dcg
+                                          / jnp.maximum(idcg, 1e-30), 1.0)))
+        return jnp.stack(out)
+    return jax.jit(run)
 
 
 class Metric:
@@ -43,6 +132,42 @@ class Metric:
 
     def eval(self, score: np.ndarray, objective=None) -> List[Tuple[str, float]]:
         raise NotImplementedError
+
+    #: device-kernel id (_dev_pointwise) — None means no pointwise device
+    #: path; AUC/NDCG override eval_device with their own kernels
+    _DEV_KIND: Optional[str] = None
+
+    def eval_device(self, score_dev, objective=None
+                    ) -> Optional[List[Tuple[str, float]]]:
+        """Device-path evaluation over the resident score array; returns
+        None when this metric/config has no device path (the caller then
+        falls back to host ``eval``)."""
+        if self._DEV_KIND is None:
+            return None
+        import jax.numpy as jnp
+        y, w = self._dev_arrays()
+        p = self._dev_convert(score_dev, objective)
+        val = _dev_pointwise(self._DEV_KIND)(
+            p, y, w, jnp.float32(self.sum_weight))
+        return [(self.NAME, float(val))]
+
+    def display_names(self) -> List[str]:
+        """Metric display names in eval() output order, computable WITHOUT
+        running an evaluation (LGBM_BoosterGetEvalNames)."""
+        return [self.NAME]
+
+    def _dev_arrays(self):
+        import jax.numpy as jnp
+        if not hasattr(self, "_label_dev"):
+            self._label_dev = jnp.asarray(self.label, jnp.float32)
+            self._weight_dev = None if self.weight is None else \
+                jnp.asarray(self.weight, jnp.float32)
+        return self._label_dev, self._weight_dev
+
+    def _dev_convert(self, score, objective):
+        if objective is not None and objective.need_convert_output:
+            return objective.convert_output(score)
+        return score
 
     def _avg(self, losses: np.ndarray) -> float:
         if self.weight is not None:
@@ -65,11 +190,13 @@ class _PointwiseRegression(Metric):
 
 class L2Metric(_PointwiseRegression):
     NAME = "l2"
+    _DEV_KIND = "l2"
     def _loss(self, p, y): return (p - y) ** 2
 
 
 class RMSEMetric(_PointwiseRegression):
     NAME = "rmse"
+    _DEV_KIND = "rmse"
     def eval(self, score, objective=None):
         pred = self._convert(score, objective)
         return [(self.NAME, float(np.sqrt(self._avg((pred - self.label) ** 2))))]
@@ -77,6 +204,7 @@ class RMSEMetric(_PointwiseRegression):
 
 class L1Metric(_PointwiseRegression):
     NAME = "l1"
+    _DEV_KIND = "l1"
     def _loss(self, p, y): return np.abs(p - y)
 
 
@@ -146,6 +274,7 @@ class TweedieMetric(_PointwiseRegression):
 # ----------------------------------------------------------------- binary
 class BinaryLoglossMetric(Metric):
     NAME = "binary_logloss"
+    _DEV_KIND = "binary_logloss"
 
     def eval(self, score, objective=None):
         p = np.clip(self._convert(score, objective), 1e-15, 1 - 1e-15)
@@ -156,6 +285,7 @@ class BinaryLoglossMetric(Metric):
 
 class BinaryErrorMetric(Metric):
     NAME = "binary_error"
+    _DEV_KIND = "binary_error"
 
     def eval(self, score, objective=None):
         p = self._convert(score, objective)
@@ -195,6 +325,10 @@ class AUCMetric(Metric):
 
     def eval(self, score, objective=None):
         return [(self.NAME, _weighted_auc(self.label, score, self.weight))]
+
+    def eval_device(self, score_dev, objective=None):
+        y, w = self._dev_arrays()
+        return [(self.NAME, float(_dev_auc()(score_dev, y, w)))]
 
 
 class AveragePrecisionMetric(Metric):
@@ -321,6 +455,32 @@ class NDCGMetric(Metric):
         return [(f"ndcg@{k}", float(np.average(res[k], weights=qw)))
                 for k in self.ks]
 
+    def display_names(self):
+        return [f"ndcg@{k}" for k in self.ks]
+
+    def eval_device(self, score_dev, objective=None):
+        import jax.numpy as jnp
+        if not hasattr(self, "_qidx_dev"):
+            from .objectives import _pad_queries
+            qidx, _, qmax = _pad_queries(self.bounds)
+            self._qidx_dev = jnp.asarray(qidx)
+            self._gain_dev = jnp.asarray(
+                self.label_gain[self.label.astype(int)], jnp.float32)
+            self._disc_dev = jnp.asarray(
+                1.0 / np.log2(np.arange(max(qmax, 1)) + 2.0), jnp.float32)
+            idcgs = np.zeros((len(self.ks), len(self.bounds) - 1), np.float32)
+            for qi in range(len(self.bounds) - 1):
+                s, e = self.bounds[qi], self.bounds[qi + 1]
+                lbl = self.label[s:e]
+                ideal = np.argsort(-lbl, kind="mergesort")
+                for i, k in enumerate(self.ks):
+                    idcgs[i, qi] = _dcg_at_k(lbl, ideal, k, self.label_gain)
+            self._idcg_dev = jnp.asarray(idcgs)
+        vals = np.asarray(_dev_ndcg(tuple(self.ks))(
+            score_dev, self._qidx_dev, self._gain_dev, self._idcg_dev,
+            self._disc_dev))
+        return [(f"ndcg@{k}", float(vals[i])) for i, k in enumerate(self.ks)]
+
 
 class MapMetric(Metric):
     """reference map_metric.hpp MapMetric."""
@@ -349,6 +509,9 @@ class MapMetric(Metric):
                 ap = np.sum(prec[topk] * rel_sorted[topk]) / denom
                 res[k].append(ap if rel.sum() > 0 else 1.0)
         return [(f"map@{k}", float(np.mean(res[k]))) for k in self.ks]
+
+    def display_names(self):
+        return [f"map@{k}" for k in self.ks]
 
 
 # --------------------------------------------------------------- xentropy

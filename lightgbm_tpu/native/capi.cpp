@@ -14,6 +14,7 @@
 #include <Python.h>
 
 #include <cstdint>
+#include <cstring>
 #include <mutex>
 #include <string>
 
@@ -383,6 +384,134 @@ int LGBMTPU_FreeHandle(int64_t handle) {
     PyObject* r = CallImpl("free_handle", args);
     Py_XDECREF(args);
     if (!r) return -1;
+    Py_DECREF(r);
+    return 0;
+  });
+}
+
+// Like the CSR path: densified host-side, duplicates summed.
+// (reference LGBM_DatasetCreateFromCSC c_api.h:479)
+int LGBMTPU_DatasetCreateFromCSC(const int32_t* colptr,
+                                 const int32_t* indices, const double* data,
+                                 int64_t ncol, int64_t nnz, int64_t nrow,
+                                 const double* label,
+                                 const char* params_json, int64_t* out) {
+  return WithGIL([&] {
+    PyObject* args = Py_BuildValue(
+        "(LLLLLLLs)", (long long)(intptr_t)colptr,
+        (long long)(intptr_t)indices, (long long)(intptr_t)data,
+        (long long)ncol, (long long)nnz, (long long)nrow,
+        (long long)(intptr_t)label, params_json ? params_json : "");
+    PyObject* r = CallImpl("dataset_from_csc", args);
+    Py_XDECREF(args);
+    if (!r) return -1;
+    *out = PyLong_AsLongLong(r);
+    Py_DECREF(r);
+    return 0;
+  });
+}
+
+// reference LGBM_BoosterLoadModelFromString (c_api.h:677)
+int LGBMTPU_BoosterLoadModelFromString(const char* model_str, int64_t* out) {
+  return WithGIL([&] {
+    PyObject* args = Py_BuildValue("(s)", model_str);
+    PyObject* r = CallImpl("booster_from_string", args);
+    Py_XDECREF(args);
+    if (!r) return -1;
+    *out = PyLong_AsLongLong(r);
+    Py_DECREF(r);
+    return 0;
+  });
+}
+
+// reference LGBM_BoosterGetNumFeature (c_api.h:876)
+int LGBMTPU_BoosterGetNumFeature(int64_t booster, int* out) {
+  return WithGIL([&] {
+    PyObject* args = Py_BuildValue("(L)", (long long)booster);
+    PyObject* r = CallImpl("booster_num_feature", args);
+    Py_XDECREF(args);
+    if (!r) return -1;
+    *out = (int)PyLong_AsLong(r);
+    Py_DECREF(r);
+    return 0;
+  });
+}
+
+namespace {
+// Shared plumbing for the newline-joined string getters: writes a
+// NUL-terminated copy when the buffer fits; always reports the required
+// size INCLUDING the terminator (reference out_buffer_len contract).
+int StringCall(const char* impl_fn, long long handle, char* buffer,
+               int64_t buffer_len, int64_t* out_len) {
+  return WithGIL([&] {
+    PyObject* args = Py_BuildValue("(L)", handle);
+    PyObject* r = CallImpl(impl_fn, args);
+    Py_XDECREF(args);
+    if (!r) return -1;
+    Py_ssize_t n = 0;
+    const char* s = PyUnicode_AsUTF8AndSize(r, &n);
+    if (!s) {
+      Py_DECREF(r);
+      return -1;
+    }
+    *out_len = (int64_t)n + 1;
+    if (buffer && buffer_len >= n + 1) {
+      memcpy(buffer, s, n + 1);
+    }
+    Py_DECREF(r);
+    return 0;
+  });
+}
+}  // namespace
+
+// reference LGBM_BoosterGetFeatureNames (c_api.h:845); names are
+// newline-joined in one buffer (simpler ABI than char** + per-name sizes)
+int LGBMTPU_BoosterGetFeatureNames(int64_t booster, char* buffer,
+                                   int64_t buffer_len, int64_t* out_len) {
+  return StringCall("booster_feature_names", (long long)booster, buffer,
+                    buffer_len, out_len);
+}
+
+// reference LGBM_BoosterGetEvalNames (c_api.h:826)
+int LGBMTPU_BoosterGetEvalNames(int64_t booster, char* buffer,
+                                int64_t buffer_len, int64_t* out_len) {
+  return StringCall("booster_eval_names", (long long)booster, buffer,
+                    buffer_len, out_len);
+}
+
+// Fast single-row predict (reference c_api.h:1162
+// LGBM_BoosterPredictForMatSingleRowFastInit + ...SingleRowFast): the
+// returned config caches stacked tree arrays so per-row calls skip all
+// model setup.  Free with LGBMTPU_FreeHandle.
+int LGBMTPU_BoosterPredictForMatSingleRowFastInit(int64_t booster,
+                                                  int64_t ncol,
+                                                  int raw_score,
+                                                  int64_t* out_config) {
+  return WithGIL([&] {
+    PyObject* args = Py_BuildValue("(LLi)", (long long)booster,
+                                   (long long)ncol, raw_score);
+    PyObject* r = CallImpl("fastpredict_init", args);
+    Py_XDECREF(args);
+    if (!r) return -1;
+    *out_config = PyLong_AsLongLong(r);
+    Py_DECREF(r);
+    return 0;
+  });
+}
+
+int LGBMTPU_BoosterPredictForMatSingleRowFast(int64_t config,
+                                              const double* row,
+                                              double* out,
+                                              int64_t out_capacity,
+                                              int64_t* out_len) {
+  return WithGIL([&] {
+    PyObject* args = Py_BuildValue(
+        "(LLLL)", (long long)config, (long long)(intptr_t)row,
+        (long long)(intptr_t)out, (long long)out_capacity);
+    PyObject* r = CallImpl("fastpredict_row", args);
+    Py_XDECREF(args);
+    if (!r) return -1;
+    *out_len = PyLong_AsLongLong(r);
     Py_DECREF(r);
     return 0;
   });

@@ -306,3 +306,166 @@ def test_dual_parity_runs_on_tpu():
         dual_parity.main()
     finally:
         sys.path.pop(0)
+
+
+C_PROGRAM_V3 = r"""
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <stdint.h>
+#include <math.h>
+
+extern const char* LGBMTPU_GetLastError(void);
+extern int LGBMTPU_DatasetCreateFromMat(const double*, int64_t, int64_t,
+                                        const double*, const char*, int64_t*);
+extern int LGBMTPU_DatasetCreateFromCSC(const int32_t*, const int32_t*,
+                                        const double*, int64_t, int64_t,
+                                        int64_t, const double*, const char*,
+                                        int64_t*);
+extern int LGBMTPU_DatasetGetNumData(int64_t, int64_t*);
+extern int LGBMTPU_BoosterCreate(int64_t, const char*, int64_t*);
+extern int LGBMTPU_BoosterUpdateOneIter(int64_t, int*);
+extern int LGBMTPU_BoosterPredictForMat(int64_t, const double*, int64_t,
+                                        int64_t, int, double*, int64_t*);
+/* last arg: in = capacity, out = doubles written */
+extern int LGBMTPU_BoosterSaveModelToString(int64_t, char*, int64_t*);
+extern int LGBMTPU_BoosterLoadModelFromString(const char*, int64_t*);
+extern int LGBMTPU_BoosterGetNumFeature(int64_t, int*);
+extern int LGBMTPU_BoosterGetFeatureNames(int64_t, char*, int64_t, int64_t*);
+extern int LGBMTPU_BoosterGetEvalNames(int64_t, char*, int64_t, int64_t*);
+extern int LGBMTPU_BoosterPredictForMatSingleRowFastInit(int64_t, int64_t,
+                                                         int, int64_t*);
+extern int LGBMTPU_BoosterPredictForMatSingleRowFast(int64_t, const double*,
+                                                     double*, int64_t,
+                                                     int64_t*);
+extern int LGBMTPU_FreeHandle(int64_t);
+
+#define CHECK(call) do { if ((call) != 0) { \
+  fprintf(stderr, "FAIL %s: %s\n", #call, LGBMTPU_GetLastError()); \
+  return 1; } } while (0)
+
+int main(void) {
+  const int64_t n = 500, f = 4;
+  double* X = malloc(sizeof(double) * n * f);
+  double* y = malloc(sizeof(double) * n);
+  unsigned s = 7;
+  for (int64_t i = 0; i < n; ++i) {
+    double acc = 0.0;
+    for (int64_t j = 0; j < f; ++j) {
+      s = s * 1103515245u + 12345u;
+      double v = ((double)(s >> 8) / (1 << 24)) * 2.0 - 1.0;
+      X[i * f + j] = v;
+      acc += v;
+    }
+    y[i] = acc > 0.0 ? 1.0 : 0.0;
+  }
+
+  int64_t ds = 0, bst = 0;
+  CHECK(LGBMTPU_DatasetCreateFromMat(
+      X, n, f, y,
+      "{\"objective\":\"binary\",\"num_leaves\":15,\"verbose\":-1,"
+      "\"min_data_in_leaf\":5,\"metric\":[\"auc\",\"binary_logloss\"]}",
+      &ds));
+  CHECK(LGBMTPU_BoosterCreate(
+      ds,
+      "{\"objective\":\"binary\",\"num_leaves\":15,\"verbose\":-1,"
+      "\"min_data_in_leaf\":5,\"metric\":[\"auc\",\"binary_logloss\"]}",
+      &bst));
+  int fin = 0;
+  for (int it = 0; it < 8; ++it) CHECK(LGBMTPU_BoosterUpdateOneIter(bst, &fin));
+
+  /* num-feature + name queries */
+  int nf = 0;
+  CHECK(LGBMTPU_BoosterGetNumFeature(bst, &nf));
+  if (nf != (int)f) { fprintf(stderr, "num_feature %d != %d\n", nf, (int)f);
+                      return 1; }
+  int64_t need = 0;
+  CHECK(LGBMTPU_BoosterGetFeatureNames(bst, NULL, 0, &need));
+  char* names = malloc(need);
+  CHECK(LGBMTPU_BoosterGetFeatureNames(bst, names, need, &need));
+  if (strstr(names, "Column_0") == NULL) {
+    fprintf(stderr, "feature names missing: %s\n", names); return 1; }
+  CHECK(LGBMTPU_BoosterGetEvalNames(bst, NULL, 0, &need));
+  char* enames = malloc(need);
+  CHECK(LGBMTPU_BoosterGetEvalNames(bst, enames, need, &need));
+  if (strstr(enames, "auc") == NULL) {
+    fprintf(stderr, "eval names missing: %s\n", enames); return 1; }
+
+  /* model round trip through a string (in: capacity, out: required) */
+  need = 0;
+  CHECK(LGBMTPU_BoosterSaveModelToString(bst, NULL, &need));
+  char* model = malloc(need);
+  CHECK(LGBMTPU_BoosterSaveModelToString(bst, model, &need));
+  int64_t bst2 = 0;
+  CHECK(LGBMTPU_BoosterLoadModelFromString(model, &bst2));
+
+  /* batch vs fast single-row: bit-for-bit */
+  double* batch = malloc(sizeof(double) * n);
+  int64_t wrote = n;  /* in: capacity */
+  CHECK(LGBMTPU_BoosterPredictForMat(bst, X, n, f, 0, batch, &wrote));
+  int64_t fastc = 0;
+  CHECK(LGBMTPU_BoosterPredictForMatSingleRowFastInit(bst, f, 0, &fastc));
+  double rowout[4];
+  for (int64_t i = 0; i < n; ++i) {
+    CHECK(LGBMTPU_BoosterPredictForMatSingleRowFast(fastc, X + i * f, rowout,
+                                                    4, &wrote));
+    if (wrote != 1 || rowout[0] != batch[i]) {
+      fprintf(stderr, "fast row %lld mismatch %.17g vs %.17g\n",
+              (long long)i, rowout[0], batch[i]);
+      return 1;
+    }
+  }
+
+  /* CSC construction matches the dense dataset row count */
+  int64_t nnz = n * f;
+  int32_t* colptr = malloc(sizeof(int32_t) * (f + 1));
+  int32_t* rowind = malloc(sizeof(int32_t) * nnz);
+  double* vals = malloc(sizeof(double) * nnz);
+  for (int64_t j = 0; j <= f; ++j) colptr[j] = (int32_t)(j * n);
+  for (int64_t j = 0; j < f; ++j)
+    for (int64_t i = 0; i < n; ++i) {
+      rowind[j * n + i] = (int32_t)i;
+      vals[j * n + i] = X[i * f + j];
+    }
+  int64_t dsc = 0;
+  CHECK(LGBMTPU_DatasetCreateFromCSC(colptr, rowind, vals, f, nnz, n, y,
+                                     "{\"verbose\":-1}", &dsc));
+  int64_t ndc = 0;
+  CHECK(LGBMTPU_DatasetGetNumData(dsc, &ndc));
+  if (ndc != n) { fprintf(stderr, "csc num_data %lld\n", (long long)ndc);
+                  return 1; }
+
+  CHECK(LGBMTPU_FreeHandle(fastc));
+  CHECK(LGBMTPU_FreeHandle(bst2));
+  CHECK(LGBMTPU_FreeHandle(bst));
+  CHECK(LGBMTPU_FreeHandle(ds));
+  CHECK(LGBMTPU_FreeHandle(dsc));
+  printf("C API v3 OK\n");
+  return 0;
+}
+"""
+
+
+def test_c_consumer_fast_predict_csc_queries(tmp_path):
+    """Fast single-row predict (bit-exact vs batch), CSC create,
+    model-from-string, num-feature/feature-name/eval-name queries through
+    the raw C ABI (VERDICT r1 #6; reference c_api.h:1162, :479, :677,
+    :876, :845, :826)."""
+    src = tmp_path / "consumer3.c"
+    src.write_text(C_PROGRAM_V3)
+    exe = tmp_path / "consumer3"
+    libdir = sysconfig.get_config_var("LIBDIR")
+    subprocess.run(
+        ["gcc", "-O1", str(src), CAPI, f"-Wl,-rpath,{os.path.dirname(CAPI)}",
+         f"-Wl,-rpath,{libdir}", "-lm", "-o", str(exe)],
+        check=True, capture_output=True)
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+    import lightgbm_tpu
+    pkg_root = os.path.dirname(os.path.dirname(
+        os.path.abspath(lightgbm_tpu.__file__)))
+    env["PYTHONPATH"] = pkg_root
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run([str(exe)], env=env, capture_output=True, text=True,
+                       timeout=600)
+    assert r.returncode == 0, r.stderr + r.stdout
+    assert "C API v3 OK" in r.stdout

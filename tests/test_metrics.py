@@ -4,6 +4,7 @@ metric assertions inside test_engine.py, SURVEY.md §4)."""
 import numpy as np
 import pytest
 
+import lightgbm_tpu as lgb
 from lightgbm_tpu.config import Config
 from lightgbm_tpu.io.dataset import Metadata
 from lightgbm_tpu.metrics import (AUCMetric, AveragePrecisionMetric,
@@ -83,3 +84,66 @@ def test_default_metric_for_objective():
     assert ms and ms[0].NAME == "ndcg"
     ms = create_metrics(Config({"objective": "regression", "metric": "rmse"}))
     assert ms and ms[0].NAME == "rmse"
+
+
+def test_device_eval_matches_host():
+    """eval_device (jitted f32 reductions, metrics.py) matches the host f64
+    path within f32 tolerance for every device-capable metric, weighted and
+    unweighted (VERDICT r1 #9: per-iteration eval without score D2H)."""
+    import jax.numpy as jnp
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.metrics import create_metrics
+
+    rng = np.random.default_rng(0)
+    n = 20000
+    y = (rng.random(n) > 0.6).astype(np.float64)
+    raw = rng.normal(size=n)
+    prob = 1.0 / (1.0 + np.exp(-raw))
+    w = rng.random(n) + 0.5
+
+    class Meta:
+        pass
+
+    for weight in (None, w):
+        m = Meta()
+        m.label, m.weight, m.query_boundaries = y, weight, None
+        m.num_data, m.position, m.init_score = n, None, None
+        cfg = Config({"objective": "binary",
+                      "metric": ["auc", "binary_logloss", "binary_error",
+                                 "l2", "l1", "rmse"], "verbose": -1})
+        for mt in create_metrics(cfg):
+            s = raw if mt.NAME == "auc" else prob
+            mt.init(m, n)
+            host = dict(mt.eval(s, None))
+            dev = dict(mt.eval_device(jnp.asarray(s, jnp.float32), None))
+            for k in host:
+                assert abs(host[k] - dev[k]) < 5e-5, (k, host[k], dev[k])
+
+    yq = rng.integers(0, 4, size=n).astype(np.float64)
+    m = Meta()
+    m.label, m.weight = yq, None
+    m.query_boundaries = np.arange(0, n + 1, 100)
+    m.num_data, m.position, m.init_score = n, None, None
+    cfg = Config({"objective": "lambdarank", "metric": "ndcg",
+                  "eval_at": [1, 5, 10], "verbose": -1})
+    for mt in create_metrics(cfg):
+        mt.init(m, n)
+        host = dict(mt.eval(raw, None))
+        dev = dict(mt.eval_device(jnp.asarray(raw, jnp.float32), None))
+        for k in host:
+            assert abs(host[k] - dev[k]) < 5e-5, (k, host[k], dev[k])
+
+
+def test_unsupported_metrics_fall_back_to_host():
+    """Metrics without a device path return None from eval_device and the
+    booster transparently uses host eval (multi_logloss here)."""
+    rng = np.random.default_rng(1)
+    n = 600
+    X = rng.normal(size=(n, 5))
+    y = rng.integers(0, 3, size=n).astype(np.float64)
+    p = {"objective": "multiclass", "num_class": 3, "verbose": -1,
+         "metric": "multi_logloss", "num_leaves": 7}
+    ds = lgb.Dataset(X, label=y, params=p)
+    bst = lgb.train(p, ds, num_boost_round=3, valid_sets=[ds])
+    (_, name, val, _), = bst.eval_train()
+    assert name == "multi_logloss" and np.isfinite(val)
