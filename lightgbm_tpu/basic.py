@@ -110,8 +110,11 @@ def _coerce_data(data: Any, categorical_feature, category_maps=None):
             categorical_feature = cat_names
         arr = data.to_numpy(dtype=np.float64, na_value=np.nan)
         return arr, feature_names, categorical_feature, pandas_categorical
-    if hasattr(data, "toarray"):  # scipy sparse
-        data = data.toarray()
+    if hasattr(data, "toarray") and hasattr(data, "nnz"):  # scipy sparse
+        # passed through UN-densified: io/dataset.py _from_sparse bins the
+        # CSC columns directly (the dense f64 matrix for Allstate-class
+        # wide sparse data would be tens of GB)
+        return data, feature_names, categorical_feature, pandas_categorical
     return (np.asarray(data, dtype=np.float64), feature_names,
             categorical_feature, pandas_categorical)
 
@@ -450,6 +453,23 @@ class Booster:
                 pred_early_stop: bool = False, pred_early_stop_freq: int = 10,
                 pred_early_stop_margin: float = 10.0,
                 **kwargs) -> np.ndarray:
+        if hasattr(data, "toarray") and hasattr(data, "nnz") \
+                and data.shape[0] > 65536:
+            # large scipy input: densify in row blocks so prediction never
+            # allocates the full dense [n, F] float64 matrix (the sparse
+            # ingestion memory story holds at predict time too)
+            csr = data.tocsr()
+            blocks = [self.predict(csr[r0:r0 + 65536],
+                                   start_iteration=start_iteration,
+                                   num_iteration=num_iteration,
+                                   raw_score=raw_score, pred_leaf=pred_leaf,
+                                   pred_contrib=pred_contrib,
+                                   pred_early_stop=pred_early_stop,
+                                   pred_early_stop_freq=pred_early_stop_freq,
+                                   pred_early_stop_margin=pred_early_stop_margin,
+                                   **kwargs)
+                      for r0 in range(0, data.shape[0], 65536)]
+            return np.concatenate(blocks, axis=0)
         X = self._to_matrix(data)
         if num_iteration is None:
             num_iteration = self.best_iteration if self.best_iteration > 0 else -1

