@@ -453,23 +453,28 @@ class Booster:
                 pred_early_stop: bool = False, pred_early_stop_freq: int = 10,
                 pred_early_stop_margin: float = 10.0,
                 **kwargs) -> np.ndarray:
-        if hasattr(data, "toarray") and hasattr(data, "nnz") \
-                and data.shape[0] > 65536:
-            # large scipy input: densify in row blocks so prediction never
-            # allocates the full dense [n, F] float64 matrix (the sparse
-            # ingestion memory story holds at predict time too)
-            csr = data.tocsr()
-            blocks = [self.predict(csr[r0:r0 + 65536],
-                                   start_iteration=start_iteration,
-                                   num_iteration=num_iteration,
-                                   raw_score=raw_score, pred_leaf=pred_leaf,
-                                   pred_contrib=pred_contrib,
-                                   pred_early_stop=pred_early_stop,
-                                   pred_early_stop_freq=pred_early_stop_freq,
-                                   pred_early_stop_margin=pred_early_stop_margin,
-                                   **kwargs)
-                      for r0 in range(0, data.shape[0], 65536)]
-            return np.concatenate(blocks, axis=0)
+        if hasattr(data, "toarray") and hasattr(data, "nnz"):
+            # scipy input densifies in BYTE-bounded row blocks (~512 MB
+            # dense each) so prediction never allocates the full [n, F]
+            # float64 matrix — the sparse ingestion memory story holds at
+            # predict time too.  Wide matrices get proportionally fewer
+            # rows per block.
+            block = max(256, min(65536,
+                                 (512 << 20) // (8 * max(data.shape[1], 1))))
+            if data.shape[0] > block:
+                csr = data.tocsr()
+                blocks = [self.predict(
+                    csr[r0:r0 + block],
+                    start_iteration=start_iteration,
+                    num_iteration=num_iteration,
+                    raw_score=raw_score, pred_leaf=pred_leaf,
+                    pred_contrib=pred_contrib,
+                    pred_early_stop=pred_early_stop,
+                    pred_early_stop_freq=pred_early_stop_freq,
+                    pred_early_stop_margin=pred_early_stop_margin,
+                    **kwargs)
+                    for r0 in range(0, data.shape[0], block)]
+                return np.concatenate(blocks, axis=0)
         X = self._to_matrix(data)
         if num_iteration is None:
             num_iteration = self.best_iteration if self.best_iteration > 0 else -1

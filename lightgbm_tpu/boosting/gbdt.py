@@ -254,13 +254,14 @@ class GBDT:
             if method not in ("basic", "intermediate", "advanced"):
                 log.fatal("unknown monotone_constraints_method=%r (expected "
                           "basic/intermediate/advanced)" % method)
-            if method == "advanced":
-                # the advanced method's extra is per-THRESHOLD constraint
-                # refinement inside split finding
-                # (monotone_constraints.hpp:858); intermediate bounds are the
-                # closest implemented semantics
+            if method == "advanced" and self.parallel_mode in ("voting",
+                                                               "feature"):
+                # the per-threshold bound arrays are not plumbed through the
+                # voted-subset / cross-shard split sync; intermediate is the
+                # sound conservative superset there
                 log.warning("monotone_constraints_method=advanced is not "
-                            "implemented; using 'intermediate'")
+                            "supported with voting/feature parallel modes; "
+                            "using 'intermediate'")
                 method = "intermediate"
             self.hp = dataclasses.replace(
                 self.hp, use_monotone=True, monotone_method=method,

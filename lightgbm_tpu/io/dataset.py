@@ -270,7 +270,7 @@ class Dataset:
         columns, and the ONLY row-major materialization is the final
         bundled uint8 [n, n_bundles] matrix — never a dense [n, F] float64.
         """
-        csc = data.tocsc()
+        csc = data.tocsc(copy=True)  # copy: sum_duplicates mutates in place
         csc.sum_duplicates()
         n, f = csc.shape
         if bool(cfg.linear_tree):
@@ -335,6 +335,7 @@ class Dataset:
         max_bin = min(int(cfg.max_bin), MAX_UINT8_BINS)
         cap = int(cfg.bin_construct_sample_cnt)
         rng = np.random.default_rng(cfg.data_random_seed)
+        mbf = list(cfg.max_bin_by_feature or [])
         mappers = []
         for j in range(f):
             vals = csc.data[csc.indptr[j]:csc.indptr[j + 1]]
@@ -344,9 +345,11 @@ class Dataset:
                                              - csc.indptr[j])))
             else:
                 total = n
+            fmax = mbf[j] if j < len(mbf) and mbf[j] > 1 else max_bin
             mappers.append(BinMapper.find_bin(
                 vals, total_sample_cnt=max(total, len(vals)),
-                max_bin=max_bin, min_data_in_bin=int(cfg.min_data_in_bin),
+                max_bin=int(fmax),
+                min_data_in_bin=int(cfg.min_data_in_bin),
                 use_missing=bool(cfg.use_missing),
                 zero_as_missing=bool(cfg.zero_as_missing)))
         ds.mappers = mappers

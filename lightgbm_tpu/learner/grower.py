@@ -325,7 +325,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             min_sum_hessian_in_leaf=hp.min_sum_hessian_in_leaf / num_shards)
 
     def child_best(h_phys, g_, h_, c_, depth, fm, parent_output, lmin, lmax,
-                   key, pen=None) -> SplitResult:
+                   key, pen=None, adv=None) -> SplitResult:
         """Best split for one leaf from its PHYSICAL (bundle-column)
         histogram — local shard hist under voting/feature modes, global
         otherwise.  Returns a SplitResult whose ``feature`` is the virtual
@@ -396,7 +396,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                               fm, hp, monotone=monotone,
                               parent_output=parent_output, leaf_min=lmin,
                               leaf_max=lmax, depth=depth, rng_key=key,
-                              gain_penalty=pen)
+                              gain_penalty=pen, adv_bounds=adv)
         depth_ok = (hp.max_depth <= 0) | (depth < hp.max_depth)
         return res._replace(gain=jnp.where(depth_ok, res.gain, NEG_INF))
 
@@ -421,7 +421,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     best0 = child_best(hist0_b, g0, h0, c0, jnp.int32(0), fm_root,
                        root_out, -inf, inf, key_er, pen=pen0)
 
-    use_boxes = hp.use_monotone and hp.monotone_method == "intermediate"
+    use_boxes = hp.use_monotone and hp.monotone_method in ("intermediate", "advanced")
+    use_adv = hp.use_monotone and hp.monotone_method == "advanced"
     tree = _empty_tree(L, hp.n_bins, num_f)
     tree = tree._replace(
         leaf_value=tree.leaf_value.at[0].set(root_out),
@@ -625,7 +626,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             ro = smoothed_output(rg, rh, rcn, parent_out, hp.lambda_l1,
                                  l2_eff, hp)
             lmin_p, lmax_p = st.leaf_min[bl], st.leaf_max[bl]
-            use_boxes = hp.use_monotone and hp.monotone_method == "intermediate"
+            # use_boxes closes over grow_tree's definition — keep ONE source
             if hp.use_monotone:
                 lo = jnp.clip(lo, lmin_p, lmax_p)
                 ro = jnp.clip(ro, lmin_p, lmax_p)
@@ -740,10 +741,22 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             else:
                 cegb_used, cegb_rows = st.cegb_used, st.cegb_rows
                 pen_l = pen_r = None
+            if use_adv:
+                # advanced monotone: per-(feature, threshold) bounds for
+                # each child's upcoming split evaluation
+                from .monotone import advanced_split_bounds
+                adv_l = advanced_split_bounds(
+                    leaf_lo, leaf_hi, t.leaf_value, monotone,
+                    jnp.int32(i) + 2, bl, hp.n_bins)
+                adv_r = advanced_split_bounds(
+                    leaf_lo, leaf_hi, t.leaf_value, monotone,
+                    jnp.int32(i) + 2, new_leaf, hp.n_bins)
+            else:
+                adv_l = adv_r = None
             bs_l = child_best(h_left, lg, lh, lcn, d, fm_l, lo, lmin_l,
-                              lmax_l, k_el, pen=pen_l)
+                              lmax_l, k_el, pen=pen_l, adv=adv_l)
             bs_r = child_best(h_right, rg, rh, rcn, d, fm_r, ro, lmin_r,
-                              lmax_r, k_er2, pen=pen_r)
+                              lmax_r, k_er2, pen=pen_r, adv=adv_r)
 
             return st._replace(
                 tree=t,

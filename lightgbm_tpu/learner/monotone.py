@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 _INF = 1e30  # python scalar: a module-level jnp constant captured across
 # traces breaks the jit dispatch buffer count (missing hoisted-const buffer)
@@ -72,6 +73,87 @@ def box_bounds(leaf_lo: jax.Array, leaf_hi: jax.Array, out: jax.Array,
     upper = jnp.min(jnp.where(under, out[None, :], _INF), axis=1)
     lower = jnp.max(jnp.where(under.T, out[None, :], -_INF), axis=1)
     return lower, upper
+
+
+def advanced_split_bounds(leaf_lo: jax.Array, leaf_hi: jax.Array,
+                          out: jax.Array, monotone: jax.Array,
+                          num_leaves: jax.Array, leaf, n_bins: int):
+    """Per-(split-feature, threshold) child output bounds for splitting
+    ``leaf`` — the TPU formulation of the reference's
+    ``monotone_constraints_method=advanced``
+    (monotone_constraints.hpp:858 ``AdvancedLeafConstraints``).
+
+    The intermediate method applies a constraining neighbor's output to the
+    WHOLE leaf; but a neighbor adjacent along monotone feature ``fj`` that
+    only overlaps part of the leaf's range in split feature ``g`` bounds
+    only the child that still overlaps it.  With leaf boxes this is a
+    prefix/suffix structure over thresholds:
+
+      left child [lo_g, t]:  j applies iff lo_g(j) <= t    (prefix)
+      right child (t, hi_g): j applies iff hi_g(j) - 1 > t (suffix)
+
+    (a neighbor adjacent along ``g`` itself bounds both children at every
+    threshold).  Returns (lmin_left, lmax_left, lmin_right, lmax_right),
+    each f32 [F, n_bins].
+    """
+    L, F = leaf_lo.shape
+    inf = jnp.float32(_INF)
+    i_lo = leaf_lo[leaf]                                      # [F]
+    i_hi = leaf_hi[leaf]
+    inter = (leaf_lo < i_hi[None, :]) & (i_lo[None, :] < leaf_hi)  # [L, F]
+    n_inter = jnp.sum(inter.astype(jnp.int32), axis=1)        # [L]
+    one_apart = (n_inter == F - 1)                            # [L]
+    f_apart = jnp.argmax(~inter, axis=1)                      # [L]
+    ids = jnp.arange(L)
+    j_hi_f = jnp.take_along_axis(leaf_hi, f_apart[:, None], axis=1)[:, 0]
+    j_lo_f = jnp.take_along_axis(leaf_lo, f_apart[:, None], axis=1)[:, 0]
+    i_lo_f = i_lo[f_apart]
+    i_hi_f = i_hi[f_apart]
+    j_below = j_hi_f <= i_lo_f                                # [L]
+    # sanity: one_apart & ~j_below implies j above (boxes are disjoint)
+    mono_j = monotone[f_apart]                                # [L]
+    valid = one_apart & (ids < num_leaves) & (ids != leaf) \
+        & (mono_j != 0) & ((j_hi_f <= i_lo_f) | (j_lo_f >= i_hi_f))
+    # leaf must stay <= out[j] ("under"): increasing fj with j above, or
+    # decreasing fj with j below
+    under = valid & (((mono_j > 0) & ~j_below) | ((mono_j < 0) & j_below))
+    over = valid & (((mono_j > 0) & j_below) | ((mono_j < 0) & ~j_below))
+
+    # threshold ranges per (neighbor, split feature): left child [lo_g, t]
+    # overlaps j iff lo_g(j) <= t (prefix from ``starts``); right child
+    # (t, hi_g) overlaps j iff hi_g(j) >= t + 2, i.e. suffix positions up
+    # to hi_g(j) - 2 (``r_pos``); a neighbor adjacent along g itself bounds
+    # both children at every threshold
+    same_f = jax.nn.one_hot(f_apart, F, dtype=bool)           # [L, F]
+    starts = jnp.where(same_f, 0,
+                       jnp.clip(leaf_lo, 0, n_bins - 1))      # [L, F]
+    r_pos = jnp.where(same_f, n_bins - 1,
+                      jnp.clip(leaf_hi, 0, n_bins) - 2)       # [L, F]
+    # r_pos == -1 (hi_g(j) <= 1) never matches a bin: j drops out, correct
+
+    b_iota = jnp.arange(n_bins)
+
+    def scatter_reduce(mask, at, red_init, reduce_min):
+        # M[g, b] = reduce over j in mask with at[j, g] == b of out[j]
+        oh = (at[:, :, None] == b_iota[None, None, :]) \
+            & mask[:, None, None]                             # [L, F, B]
+        vals = jnp.where(oh, out[:, None, None], red_init)
+        return jnp.min(vals, axis=0) if reduce_min else jnp.max(vals, axis=0)
+
+    cummin = lambda x: lax.associative_scan(jnp.minimum, x, axis=1)
+    cummax = lambda x: lax.associative_scan(jnp.maximum, x, axis=1)
+
+    # upper bounds from the "under" set
+    m_left_u = scatter_reduce(under, starts, inf, True)        # [F, B]
+    lmax_left = cummin(m_left_u)
+    m_right_u = scatter_reduce(under, r_pos, inf, True)
+    lmax_right = cummin(m_right_u[:, ::-1])[:, ::-1]
+    # lower bounds from the "over" set
+    m_left_o = scatter_reduce(over, starts, -inf, False)
+    lmin_left = cummax(m_left_o)
+    m_right_o = scatter_reduce(over, r_pos, -inf, False)
+    lmin_right = cummax(m_right_o[:, ::-1])[:, ::-1]
+    return lmin_left, lmax_left, lmin_right, lmax_right
 
 
 def split_boxes(leaf_lo: jax.Array, leaf_hi: jax.Array, parent: jax.Array,

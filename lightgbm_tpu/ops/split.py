@@ -161,7 +161,8 @@ def find_best_split(hist: jax.Array, sum_g: jax.Array, sum_h: jax.Array,
                     depth=None,
                     rng_key: Optional[jax.Array] = None,
                     per_feature_out: Optional[list] = None,
-                    gain_penalty: Optional[jax.Array] = None) -> SplitResult:
+                    gain_penalty: Optional[jax.Array] = None,
+                    adv_bounds=None) -> SplitResult:
     """Pick the best (feature, threshold, default-dir) for one leaf.
 
     hist: f32 [F, B, C>=3] (grad, hess, count); sum_g/sum_h/count: leaf totals.
@@ -207,7 +208,7 @@ def find_best_split(hist: jax.Array, sum_g: jax.Array, sum_h: jax.Array,
         parent_gain = leaf_gain(sum_g, sum_h, l1, l2)
     min_shift = parent_gain + hp.min_gain_to_split
 
-    def variant_gain(gl_v, hl_v, nl_v, l2_v):
+    def variant_gain(gl_v, hl_v, nl_v, l2_v, bnds=None):
         gr = sum_g - gl_v
         hr = sum_h - hl_v
         nr = count - nl_v
@@ -216,7 +217,17 @@ def find_best_split(hist: jax.Array, sum_g: jax.Array, sum_h: jax.Array,
         else:
             lo = smoothed_output(gl_v, hl_v, nl_v, parent_output, l1, l2_v, hp)
             ro = smoothed_output(gr, hr, nr, parent_output, l1, l2_v, hp)
-            if hp.use_monotone:
+            if hp.use_monotone and bnds is not None:
+                # advanced method (monotone_constraints.hpp:858): the
+                # per-(feature, threshold) bounds REPLACE the whole-leaf
+                # bounds — a neighbor that does not overlap a child's
+                # subrange imposes nothing on that child, which is exactly
+                # the refinement (intersecting with leaf_min/leaf_max would
+                # cancel it: the leaf bound is the min over the superset)
+                bmin_l, bmax_l, bmin_r, bmax_r = bnds
+                lo = jnp.clip(lo, bmin_l, bmax_l)
+                ro = jnp.clip(ro, bmin_r, bmax_r)
+            elif hp.use_monotone:
                 lo = jnp.clip(lo, leaf_min, leaf_max)
                 ro = jnp.clip(ro, leaf_min, leaf_max)
             gain = (gain_given_output(gl_v, hl_v, lo, l1, l2_v)
@@ -236,9 +247,11 @@ def find_best_split(hist: jax.Array, sum_g: jax.Array, sum_h: jax.Array,
     # only splits off the missing bin, t at the nan bin itself is invalid
     thr_ok = valid_bin & (bin_idx < num_bins[:, None] - 1) & ~is_nan
     thr_ok = thr_ok & ~is_cat[:, None]
-    gain_right = jnp.where(thr_ok, variant_gain(gl, hl, nl, l2), NEG_INF)
+    gain_right = jnp.where(thr_ok, variant_gain(gl, hl, nl, l2,
+                                                bnds=adv_bounds), NEG_INF)
     gain_left = jnp.where(thr_ok & has_missing,
-                          variant_gain(gl + gm, hl + hm, nl + nm, l2), NEG_INF)
+                          variant_gain(gl + gm, hl + hm, nl + nm, l2,
+                                       bnds=adv_bounds), NEG_INF)
 
     if hp.has_categorical:
         # one-hot categorical: {bin == t} goes left, gated to low-cardinality
