@@ -101,7 +101,23 @@ def _coerce_data(data: Any, categorical_feature, category_maps=None):
             all(isinstance(s, Sequence) for s in data):
         data = _sequence_to_array(data)
     if hasattr(data, "column_names") and hasattr(data, "to_pandas"):
-        data = data.to_pandas()  # pyarrow Table
+        # pyarrow Table: numeric-only tables convert column-by-column from
+        # the arrow buffers into ONE [n, F] float64 matrix (no pandas
+        # block-manager intermediate doubling peak memory — the datasets
+        # Arrow exists for are exactly the ones that can't afford it;
+        # reference: include/LightGBM/arrow.h zero-copy ingestion).
+        # Dictionary (categorical) columns keep the pandas path, which owns
+        # the category-code round-trip logic.
+        import pyarrow as pa
+        if not any(pa.types.is_dictionary(f.type) for f in data.schema):
+            names = [str(c) for c in data.column_names]
+            n = data.num_rows
+            arr = np.empty((n, len(names)), np.float64)
+            for ci, col in enumerate(data.columns):
+                arr[:, ci] = col.cast(pa.float64()).to_numpy(
+                    zero_copy_only=False)
+            return arr, names, categorical_feature, None
+        data = data.to_pandas()
     if hasattr(data, "columns") and hasattr(data, "dtypes"):  # DataFrame
         feature_names = [str(c) for c in data.columns]
         data, pandas_categorical, cat_names = _convert_pandas_categorical(

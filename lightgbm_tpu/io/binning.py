@@ -181,50 +181,54 @@ class BinMapper:
         budget = max_bin - (1 if self.missing_type == MISSING_NAN else 0)
         budget = max(budget, 2)
 
-        if forced_bounds:
-            fb = sorted(float(b) for b in forced_bounds)
-            bounds = fb + [np.inf]
+        # forced bounds are GUARANTEED boundaries; the remaining budget is
+        # still filled with data-driven bins (reference forced-bins
+        # semantics, dataset_loader.cpp forced_upper_bounds: forcing a few
+        # boundaries must not collapse the feature's split resolution)
+        fb = sorted(float(b) for b in forced_bounds) if forced_bounds else []
+        if fb:
+            budget = max(budget - len(fb), 2)
+        neg = np.sort(nonzero[nonzero < 0])
+        pos = np.sort(nonzero[nonzero > 0])
+        n_neg, n_pos = len(neg), len(pos)
+        n_nonzero = n_neg + n_pos
+        bounds = []
+        if n_nonzero == 0:
+            bounds = [np.inf]
+        elif zero_cnt == 0:
+            # no zeros sampled (dense feature): bin the raw value range
+            # directly, no dedicated zero bin
+            dv, cnts = np.unique(np.sort(nonzero), return_counts=True)
+            bounds = _greedy_find_bin(dv, cnts, budget, n_nonzero,
+                                      min_data_in_bin)
         else:
-            neg = np.sort(nonzero[nonzero < 0])
-            pos = np.sort(nonzero[nonzero > 0])
-            n_neg, n_pos = len(neg), len(pos)
-            n_nonzero = n_neg + n_pos
-            bounds = []
-            if n_nonzero == 0:
-                bounds = [np.inf]
-            elif zero_cnt == 0:
-                # no zeros sampled (dense feature): bin the raw value range
-                # directly, no dedicated zero bin
-                dv, cnts = np.unique(np.sort(nonzero), return_counts=True)
-                bounds = _greedy_find_bin(dv, cnts, budget, n_nonzero,
-                                          min_data_in_bin)
+            # proportional budget split around the dedicated zero bin
+            # (reference FindBinWithZeroAsOneBin)
+            left_budget = int(round(n_neg / n_nonzero * (budget - 1)))
+            if n_neg > 0:
+                left_budget = max(left_budget, 1)
+            right_budget = budget - 1 - left_budget
+            if n_pos > 0:
+                right_budget = max(right_budget, 1)
+            if n_neg > 0:
+                dv, cnts = np.unique(neg, return_counts=True)
+                nb = _greedy_find_bin(dv, cnts, left_budget,
+                                      n_neg + zero_cnt // 2, min_data_in_bin)
+                if nb:
+                    nb[-1] = -K_ZERO_THRESHOLD  # close negatives below zero bin
+                bounds.extend(nb)
+            bounds.append(K_ZERO_THRESHOLD)  # zero bin upper bound
+            if n_pos > 0:
+                dv, cnts = np.unique(pos, return_counts=True)
+                pb = _greedy_find_bin(dv, cnts, right_budget,
+                                      n_pos + zero_cnt - zero_cnt // 2,
+                                      min_data_in_bin)
+                bounds.extend(pb)
             else:
-                # proportional budget split around the dedicated zero bin
-                # (reference FindBinWithZeroAsOneBin)
-                left_budget = int(round(n_neg / n_nonzero * (budget - 1)))
-                if n_neg > 0:
-                    left_budget = max(left_budget, 1)
-                right_budget = budget - 1 - left_budget
-                if n_pos > 0:
-                    right_budget = max(right_budget, 1)
-                if n_neg > 0:
-                    dv, cnts = np.unique(neg, return_counts=True)
-                    nb = _greedy_find_bin(dv, cnts, left_budget,
-                                          n_neg + zero_cnt // 2, min_data_in_bin)
-                    if nb:
-                        nb[-1] = -K_ZERO_THRESHOLD  # close negatives below zero bin
-                    bounds.extend(nb)
-                bounds.append(K_ZERO_THRESHOLD)  # zero bin upper bound
-                if n_pos > 0:
-                    dv, cnts = np.unique(pos, return_counts=True)
-                    pb = _greedy_find_bin(dv, cnts, right_budget,
-                                          n_pos + zero_cnt - zero_cnt // 2,
-                                          min_data_in_bin)
-                    bounds.extend(pb)
-                else:
-                    bounds[-1] = np.inf
-                if bounds[-1] != np.inf:
-                    bounds.append(np.inf)
+                bounds[-1] = np.inf
+            if bounds[-1] != np.inf:
+                bounds.append(np.inf)
+        bounds = list(bounds) + fb
         # dedupe while preserving order
         ub = np.array(sorted(set(bounds)), dtype=np.float64)
         self.bin_upper_bound = ub

@@ -336,6 +336,7 @@ class Dataset:
         cap = int(cfg.bin_construct_sample_cnt)
         rng = np.random.default_rng(cfg.data_random_seed)
         mbf = list(cfg.max_bin_by_feature or [])
+        forced = _load_forced_bins(cfg, f)
         mappers = []
         for j in range(f):
             vals = csc.data[csc.indptr[j]:csc.indptr[j + 1]]
@@ -351,7 +352,8 @@ class Dataset:
                 max_bin=int(fmax),
                 min_data_in_bin=int(cfg.min_data_in_bin),
                 use_missing=bool(cfg.use_missing),
-                zero_as_missing=bool(cfg.zero_as_missing)))
+                zero_as_missing=bool(cfg.zero_as_missing),
+                forced_bounds=forced.get(j)))
         ds.mappers = mappers
         ds.used_feature_idx = [j for j in range(f)
                                if not mappers[j].is_trivial()]
@@ -430,6 +432,7 @@ class Dataset:
         else:
             sample = arr
         mbf = list(cfg.max_bin_by_feature or [])
+        forced = _load_forced_bins(cfg, f)
         self.mappers = []
         cat_set = set(cat_idx)
         for j in range(f):
@@ -439,7 +442,8 @@ class Dataset:
                 min_data_in_bin=int(cfg.min_data_in_bin),
                 use_missing=bool(cfg.use_missing),
                 zero_as_missing=bool(cfg.zero_as_missing),
-                is_categorical=(j in cat_set))
+                is_categorical=(j in cat_set),
+                forced_bounds=forced.get(j))
             self.mappers.append(m)
         self.used_feature_idx = [j for j in range(f)
                                  if not self.mappers[j].is_trivial()]
@@ -638,4 +642,36 @@ def _sparse_bundled_matrix(csc, mappers, used_idx, plan, n: int) -> np.ndarray:
             write = stored & (out[rows, col] == 0)
             out[rows[write], col] = \
                 plan.src_idx[fv][b[write]].astype(np.uint8)
+    return out
+
+
+def _load_forced_bins(cfg: Config, num_features: int) -> dict:
+    """Read ``forcedbins_filename`` (reference dataset_loader.cpp forced-bins
+    JSON: ``[{"feature": i, "bin_upper_bound": [...]}, ...]``) into a
+    {feature_index: sorted bounds} dict; empty when unset."""
+    path = str(cfg.forcedbins_filename or "")
+    if not path:
+        return {}
+    import json
+    try:
+        with open(path) as fh:
+            entries = json.load(fh)
+    except (OSError, ValueError) as e:
+        log.warning(f"could not read forcedbins_filename={path!r}: {e}")
+        return {}
+    out = {}
+    try:
+        for e in entries:
+            j = int(e.get("feature", -1))
+            bounds = e.get("bin_upper_bound", [])
+            if 0 <= j < num_features and bounds:
+                out[j] = sorted(float(b) for b in bounds)
+            elif j >= num_features:
+                log.warning(f"forced bins: feature {j} out of range "
+                            f"({num_features} features)")
+    except (AttributeError, TypeError, ValueError) as e:
+        log.warning(f"malformed forced-bins file {path!r} "
+                    f"(expected [{{'feature': i, 'bin_upper_bound': "
+                    f"[...]}}, ...]): {e}")
+        return {}
     return out

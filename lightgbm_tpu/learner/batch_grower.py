@@ -43,7 +43,8 @@ from .grower import (DeviceBundle, TreeArrays, _INF_BOUND, _empty_tree,
                      _expand_hist, _expand_hist_col, _feature_bin_of_rows)
 
 
-@functools.partial(jax.jit, static_argnames=("hp", "batch", "axis_name"))
+@functools.partial(jax.jit, static_argnames=("hp", "batch", "axis_name",
+                                             "warmup"))
 def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                       row_mask: Optional[jax.Array], num_bins: jax.Array,
                       nan_bin: jax.Array, is_cat: jax.Array,
@@ -51,7 +52,8 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                       batch: int = 8,
                       bundle: Optional[DeviceBundle] = None,
                       monotone: Optional[jax.Array] = None,
-                      axis_name: Optional[str] = None
+                      axis_name: Optional[str] = None,
+                      warmup: bool = True
                       ) -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree with ``batch`` splits per histogram pass.
 
@@ -124,199 +126,216 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         progress=jnp.bool_(True),
     )
 
-    def round_body(st):
-        topg, parents = lax.top_k(st["best_gain"], K)          # [K]
-        room = st["n_splits"] + lax.iota(jnp.int32, K) < L - 1
-        valid = (topg > 0.0) & room
-        rank = jnp.cumsum(valid.astype(jnp.int32)) - 1          # [K]
-        node_ids = st["n_splits"] + rank                        # [K]
-        new_leaves = node_ids + 1                               # [K]
+    def make_round_body(Kr):
+      def round_body(st):
+          topg, parents = lax.top_k(st["best_gain"], Kr)          # [K]
+          room = st["n_splits"] + lax.iota(jnp.int32, Kr) < L - 1
+          valid = (topg > 0.0) & room
+          rank = jnp.cumsum(valid.astype(jnp.int32)) - 1          # [K]
+          node_ids = st["n_splits"] + rank                        # [K]
+          new_leaves = node_ids + 1                               # [K]
 
-        t = st["tree"]
-        lor = st["leaf_of_row"]
-        # record + partition each slot (cheap [L]/[n] ops, no data passes)
-        bitsets = []
-        for j in range(K):
-            ok = valid[j]
-            bl = parents[j]
-            nid = node_ids[j]
-            nl = jnp.where(ok, new_leaves[j], L - 1)  # safe dummy index
-            feat = st["best_feat"][bl]
-            thr = st["best_thr"][bl]
-            dl = st["best_dl"][bl]
-            var = st["best_var"][bl]
-            catl = is_cat[feat]
-            pg, ph, pc = st["sum_g"][bl], st["sum_h"][bl], st["count"][bl]
-            lg, lh, lcn = st["best_lg"][bl], st["best_lh"][bl], \
-                st["best_lc"][bl]
-            rg, rh, rcn = pg - lg, ph - lh, pc - lcn
+          t = st["tree"]
+          lor = st["leaf_of_row"]
+          # record + partition each slot (cheap [L]/[n] ops, no data passes)
+          bitsets = []
+          for j in range(Kr):
+              ok = valid[j]
+              bl = parents[j]
+              nid = node_ids[j]
+              nl = jnp.where(ok, new_leaves[j], L - 1)  # safe dummy index
+              feat = st["best_feat"][bl]
+              thr = st["best_thr"][bl]
+              dl = st["best_dl"][bl]
+              var = st["best_var"][bl]
+              catl = is_cat[feat]
+              pg, ph, pc = st["sum_g"][bl], st["sum_h"][bl], st["count"][bl]
+              lg, lh, lcn = st["best_lg"][bl], st["best_lh"][bl], \
+                  st["best_lc"][bl]
+              rg, rh, rcn = pg - lg, ph - lh, pc - lcn
 
-            # left-category bitset from the PARENT histogram (st["hist"][bl]
-            # still holds the parent at record time; the strict learner does
-            # the same, grower.py split())
-            if hp.has_categorical:
-                col_of = feat if bundle is None else bundle.feat_col[feat]
-                pf_col = st["hist"][bl, col_of]
-                hist_pf = pf_col if bundle is None else \
-                    _expand_hist_col(pf_col, bundle, feat, pg, ph, pc)
-                bitset = categorical_left_bitset(
-                    hist_pf, num_bins[feat], var, thr, hp) & catl
-            else:
-                bitset = jnp.zeros((hp.n_bins,), bool)
-            bitsets.append(bitset)
+              # left-category bitset from the PARENT histogram (st["hist"][bl]
+              # still holds the parent at record time; the strict learner does
+              # the same, grower.py split())
+              if hp.has_categorical:
+                  col_of = feat if bundle is None else bundle.feat_col[feat]
+                  pf_col = st["hist"][bl, col_of]
+                  hist_pf = pf_col if bundle is None else \
+                      _expand_hist_col(pf_col, bundle, feat, pg, ph, pc)
+                  bitset = categorical_left_bitset(
+                      hist_pf, num_bins[feat], var, thr, hp) & catl
+              else:
+                  bitset = jnp.zeros((hp.n_bins,), bool)
+              bitsets.append(bitset)
 
-            p, side = st["parent_node"][bl], st["parent_side"][bl]
-            ps = jnp.maximum(p, 0)
-            lc_arr = t.left_child.at[ps].set(
-                jnp.where(ok & (p >= 0) & (side == 0), nid,
-                          t.left_child[ps]))
-            rc_arr = t.right_child.at[ps].set(
-                jnp.where(ok & (p >= 0) & (side == 1), nid,
-                          t.right_child[ps]))
-            lc_arr = lc_arr.at[nid].set(
-                jnp.where(ok, -(bl + 1), lc_arr[nid]))
-            rc_arr = rc_arr.at[nid].set(
-                jnp.where(ok, -(nl + 1), rc_arr[nid]))
+              p, side = st["parent_node"][bl], st["parent_side"][bl]
+              ps = jnp.maximum(p, 0)
+              lc_arr = t.left_child.at[ps].set(
+                  jnp.where(ok & (p >= 0) & (side == 0), nid,
+                            t.left_child[ps]))
+              rc_arr = t.right_child.at[ps].set(
+                  jnp.where(ok & (p >= 0) & (side == 1), nid,
+                            t.right_child[ps]))
+              lc_arr = lc_arr.at[nid].set(
+                  jnp.where(ok, -(bl + 1), lc_arr[nid]))
+              rc_arr = rc_arr.at[nid].set(
+                  jnp.where(ok, -(nl + 1), rc_arr[nid]))
 
-            # sorted-subset categorical children use l2 + cat_l2, matching
-            # the strict learner and feature_histogram.cpp:250
-            l2_eff = hp.lambda_l2 + jnp.where(
-                (var == VAR_CAT_FWD) | (var == VAR_CAT_BWD), hp.cat_l2, 0.0)
-            lo = leaf_output(lg, lh, hp.lambda_l1, l2_eff,
-                             hp.max_delta_step)
-            ro = leaf_output(rg, rh, hp.lambda_l1, l2_eff,
-                             hp.max_delta_step)
-            if hp.use_monotone:
-                # basic method (monotone_constraints.hpp BasicLeafConstraints):
-                # clip children into the parent's box, then tighten each
-                # child's box at the midpoint along the split direction
-                lmin_p, lmax_p = st["leaf_min"][bl], st["leaf_max"][bl]
-                lo = jnp.clip(lo, lmin_p, lmax_p)
-                ro = jnp.clip(ro, lmin_p, lmax_p)
-                mono_f = monotone[feat]
-                is_num = ~catl
-                mid = (lo + ro) * 0.5
-                lmax_l = jnp.where(is_num & (mono_f > 0),
-                                   jnp.minimum(lmax_p, mid), lmax_p)
-                lmin_l = jnp.where(is_num & (mono_f < 0),
-                                   jnp.maximum(lmin_p, mid), lmin_p)
-                lmin_r = jnp.where(is_num & (mono_f > 0),
-                                   jnp.maximum(lmin_p, mid), lmin_p)
-                lmax_r = jnp.where(is_num & (mono_f < 0),
-                                   jnp.minimum(lmax_p, mid), lmax_p)
-            d = t.leaf_depth[bl] + 1
+              # sorted-subset categorical children use l2 + cat_l2, matching
+              # the strict learner and feature_histogram.cpp:250
+              l2_eff = hp.lambda_l2 + jnp.where(
+                  (var == VAR_CAT_FWD) | (var == VAR_CAT_BWD), hp.cat_l2, 0.0)
+              lo = leaf_output(lg, lh, hp.lambda_l1, l2_eff,
+                               hp.max_delta_step)
+              ro = leaf_output(rg, rh, hp.lambda_l1, l2_eff,
+                               hp.max_delta_step)
+              if hp.use_monotone:
+                  # basic method (monotone_constraints.hpp BasicLeafConstraints):
+                  # clip children into the parent's box, then tighten each
+                  # child's box at the midpoint along the split direction
+                  lmin_p, lmax_p = st["leaf_min"][bl], st["leaf_max"][bl]
+                  lo = jnp.clip(lo, lmin_p, lmax_p)
+                  ro = jnp.clip(ro, lmin_p, lmax_p)
+                  mono_f = monotone[feat]
+                  is_num = ~catl
+                  mid = (lo + ro) * 0.5
+                  lmax_l = jnp.where(is_num & (mono_f > 0),
+                                     jnp.minimum(lmax_p, mid), lmax_p)
+                  lmin_l = jnp.where(is_num & (mono_f < 0),
+                                     jnp.maximum(lmin_p, mid), lmin_p)
+                  lmin_r = jnp.where(is_num & (mono_f > 0),
+                                     jnp.maximum(lmin_p, mid), lmin_p)
+                  lmax_r = jnp.where(is_num & (mono_f < 0),
+                                     jnp.minimum(lmax_p, mid), lmax_p)
+              d = t.leaf_depth[bl] + 1
 
-            def w(arr, idx, val):
-                return arr.at[idx].set(jnp.where(ok, val, arr[idx]))
+              def w(arr, idx, val):
+                  return arr.at[idx].set(jnp.where(ok, val, arr[idx]))
 
-            t = t._replace(
-                split_feature=w(t.split_feature, nid, feat),
-                split_bin=w(t.split_bin, nid, thr),
-                default_left=w(t.default_left, nid, dl),
-                split_cat=w(t.split_cat, nid, catl),
-                cat_bitset=t.cat_bitset.at[nid].set(
-                    jnp.where(ok, bitset, t.cat_bitset[nid])),
-                left_child=lc_arr, right_child=rc_arr,
-                split_gain=w(t.split_gain, nid, st["best_gain"][bl]),
-                internal_value=w(t.internal_value, nid,
-                                 leaf_output(pg, ph, hp.lambda_l1,
-                                             hp.lambda_l2,
-                                             hp.max_delta_step)),
-                internal_count=w(t.internal_count, nid, pc),
-                leaf_depth=w(w(t.leaf_depth, bl, d), nl, d),
-                leaf_value=w(w(t.leaf_value, bl, lo), nl, ro),
-                leaf_count=w(w(t.leaf_count, bl, lcn), nl, rcn),
-                leaf_weight=w(w(t.leaf_weight, bl, lh), nl, rh),
-                num_leaves=jnp.where(ok, nl + 1, t.num_leaves),
-            )
-            st["sum_g"] = w(w(st["sum_g"], bl, lg), nl, rg)
-            st["sum_h"] = w(w(st["sum_h"], bl, lh), nl, rh)
-            st["count"] = w(w(st["count"], bl, lcn), nl, rcn)
-            st["parent_node"] = w(w(st["parent_node"], bl, nid), nl, nid)
-            st["parent_side"] = w(w(st["parent_side"], bl, 0), nl, 1)
-            if hp.use_monotone:
-                st["leaf_min"] = w(w(st["leaf_min"], bl, lmin_l), nl, lmin_r)
-                st["leaf_max"] = w(w(st["leaf_max"], bl, lmax_l), nl, lmax_r)
-            # split leaves' cached gains are consumed
-            st["best_gain"] = st["best_gain"].at[bl].set(
-                jnp.where(ok, NEG_INF, st["best_gain"][bl]))
+              t = t._replace(
+                  split_feature=w(t.split_feature, nid, feat),
+                  split_bin=w(t.split_bin, nid, thr),
+                  default_left=w(t.default_left, nid, dl),
+                  split_cat=w(t.split_cat, nid, catl),
+                  cat_bitset=t.cat_bitset.at[nid].set(
+                      jnp.where(ok, bitset, t.cat_bitset[nid])),
+                  left_child=lc_arr, right_child=rc_arr,
+                  split_gain=w(t.split_gain, nid, st["best_gain"][bl]),
+                  internal_value=w(t.internal_value, nid,
+                                   leaf_output(pg, ph, hp.lambda_l1,
+                                               hp.lambda_l2,
+                                               hp.max_delta_step)),
+                  internal_count=w(t.internal_count, nid, pc),
+                  leaf_depth=w(w(t.leaf_depth, bl, d), nl, d),
+                  leaf_value=w(w(t.leaf_value, bl, lo), nl, ro),
+                  leaf_count=w(w(t.leaf_count, bl, lcn), nl, rcn),
+                  leaf_weight=w(w(t.leaf_weight, bl, lh), nl, rh),
+                  num_leaves=jnp.where(ok, nl + 1, t.num_leaves),
+              )
+              st["sum_g"] = w(w(st["sum_g"], bl, lg), nl, rg)
+              st["sum_h"] = w(w(st["sum_h"], bl, lh), nl, rh)
+              st["count"] = w(w(st["count"], bl, lcn), nl, rcn)
+              st["parent_node"] = w(w(st["parent_node"], bl, nid), nl, nid)
+              st["parent_side"] = w(w(st["parent_side"], bl, 0), nl, 1)
+              if hp.use_monotone:
+                  st["leaf_min"] = w(w(st["leaf_min"], bl, lmin_l), nl, lmin_r)
+                  st["leaf_max"] = w(w(st["leaf_max"], bl, lmax_l), nl, lmax_r)
+              # split leaves' cached gains are consumed
+              st["best_gain"] = st["best_gain"].at[bl].set(
+                  jnp.where(ok, NEG_INF, st["best_gain"][bl]))
 
-        # ---- all K partitions in ONE widened pass (each row belongs to at
-        # most one split parent, so the K moves compose by summation)
-        with jax.named_scope("partition"):
-            feats_k = st["best_feat"][parents]                      # [K]
-            cols_k = jax.vmap(
-                lambda f: _feature_bin_of_rows(bins_t, bundle, f))(feats_k)
-            thr_k = st["best_thr"][parents][:, None]
-            dl_k = st["best_dl"][parents][:, None]
-            nanb_k = nan_bin[feats_k][:, None]
-            go_left_k = jnp.where(cols_k == nanb_k, dl_k, cols_k <= thr_k)
-            if hp.has_categorical:
-                bitsets_k = jnp.stack(bitsets)                      # [K, B]
-                cat_k = is_cat[feats_k][:, None]                    # [K, 1]
-                go_cat_k = jnp.take_along_axis(bitsets_k, cols_k, axis=1)
-                go_left_k = jnp.where(cat_k, go_cat_k, go_left_k)
-            in_parent = (lor[None, :] == parents[:, None]) \
-                & valid[:, None]                                    # [K, n]
-            move = in_parent & ~go_left_k                           # [K, n]
-            target = jnp.sum(move * new_leaves[:, None], axis=0)    # [n]
-            lor = jnp.where(jnp.any(move, axis=0), target, lor)
+          # ---- all K partitions in ONE widened pass (each row belongs to at
+          # most one split parent, so the K moves compose by summation)
+          with jax.named_scope("partition"):
+              feats_k = st["best_feat"][parents]                      # [K]
+              cols_k = jax.vmap(
+                  lambda f: _feature_bin_of_rows(bins_t, bundle, f))(feats_k)
+              thr_k = st["best_thr"][parents][:, None]
+              dl_k = st["best_dl"][parents][:, None]
+              nanb_k = nan_bin[feats_k][:, None]
+              go_left_k = jnp.where(cols_k == nanb_k, dl_k, cols_k <= thr_k)
+              if hp.has_categorical:
+                  bitsets_k = jnp.stack(bitsets)                      # [K, B]
+                  cat_k = is_cat[feats_k][:, None]                    # [K, 1]
+                  go_cat_k = jnp.take_along_axis(bitsets_k, cols_k, axis=1)
+                  go_left_k = jnp.where(cat_k, go_cat_k, go_left_k)
+              in_parent = (lor[None, :] == parents[:, None]) \
+                  & valid[:, None]                                    # [K, n]
+              move = in_parent & ~go_left_k                           # [K, n]
+              target = jnp.sum(move * new_leaves[:, None], axis=0)    # [n]
+              lor = jnp.where(jnp.any(move, axis=0), target, lor)
 
-        st["tree"] = t
-        st["leaf_of_row"] = lor
-        st["n_splits"] = st["n_splits"] + jnp.sum(valid.astype(jnp.int32))
-        st["progress"] = jnp.any(valid)
+          st["tree"] = t
+          st["leaf_of_row"] = lor
+          st["n_splits"] = st["n_splits"] + jnp.sum(valid.astype(jnp.int32))
+          st["progress"] = jnp.any(valid)
 
-        # ---- ONE widened pass: histograms of the K smaller children
-        with jax.named_scope("round_hist"):
-            safe_nl = jnp.where(valid, new_leaves, L - 1)
-            l_cnt = st["count"][parents]
-            r_cnt = st["count"][safe_nl]
-            smaller = jnp.where(l_cnt <= r_cnt, parents, safe_nl)
-            h_small = histogram_for_leaves_auto(
-                bins, bins_t, grad, hess, lor, smaller, row_mask,
-                n_bins=hp.n_bins, rows_per_block=hp.rows_per_block,
-                hist_dtype=hp.hist_dtype, axis_name=axis_name)      # [K,Fb,B,C]
-            h_parent = st["hist"][parents]
-            h_large = h_parent - h_small
-            left_small = (l_cnt <= r_cnt)[:, None, None, None]
-            h_left = jnp.where(left_small, h_small, h_large)
-            h_right = jnp.where(left_small, h_large, h_small)
-            hist = st["hist"]
-            hist = hist.at[parents].set(jnp.where(valid[:, None, None, None],
-                                                  h_left, hist[parents]))
-            hist = hist.at[safe_nl].set(jnp.where(valid[:, None, None, None],
-                                                  h_right, hist[safe_nl]))
-            st["hist"] = hist
+          # ---- ONE widened pass: histograms of the K smaller children
+          with jax.named_scope("round_hist"):
+              safe_nl = jnp.where(valid, new_leaves, L - 1)
+              l_cnt = st["count"][parents]
+              r_cnt = st["count"][safe_nl]
+              smaller = jnp.where(l_cnt <= r_cnt, parents, safe_nl)
+              h_small = histogram_for_leaves_auto(
+                  bins, bins_t, grad, hess, lor, smaller, row_mask,
+                  n_bins=hp.n_bins, rows_per_block=hp.rows_per_block,
+                  hist_dtype=hp.hist_dtype, axis_name=axis_name)      # [K,Fb,B,C]
+              h_parent = st["hist"][parents]
+              h_large = h_parent - h_small
+              left_small = (l_cnt <= r_cnt)[:, None, None, None]
+              h_left = jnp.where(left_small, h_small, h_large)
+              h_right = jnp.where(left_small, h_large, h_small)
+              hist = st["hist"]
+              hist = hist.at[parents].set(jnp.where(valid[:, None, None, None],
+                                                    h_left, hist[parents]))
+              hist = hist.at[safe_nl].set(jnp.where(valid[:, None, None, None],
+                                                    h_right, hist[safe_nl]))
+              st["hist"] = hist
 
-        # ---- child best splits, vmapped over the 2K children
-        with jax.named_scope("find_splits"):
-            kids = jnp.concatenate([parents, safe_nl])              # [2K]
-            kid_hist = jnp.concatenate([h_left, h_right], axis=0)
-            depths = st["tree"].leaf_depth[kids]
-            res = jax.vmap(child_best)(kid_hist, st["sum_g"][kids],
-                                       st["sum_h"][kids], st["count"][kids],
-                                       depths, st["leaf_min"][kids],
-                                       st["leaf_max"][kids])
-            ok2 = jnp.concatenate([valid, valid])
-            gains2 = jnp.where(ok2, res.gain, st["best_gain"][kids])
-            st["best_gain"] = st["best_gain"].at[kids].set(gains2)
-            for name, field in (("best_feat", res.feature),
-                                ("best_thr", res.threshold),
-                                ("best_var", res.variant),
-                                ("best_lg", res.left_sum_g),
-                                ("best_lh", res.left_sum_h),
-                                ("best_lc", res.left_count)):
-                st[name] = st[name].at[kids].set(
-                    jnp.where(ok2, field, st[name][kids]))
-            st["best_dl"] = st["best_dl"].at[kids].set(
-                jnp.where(ok2, res.default_left, st["best_dl"][kids]))
-        return st
+          # ---- child best splits, vmapped over the 2K children
+          with jax.named_scope("find_splits"):
+              kids = jnp.concatenate([parents, safe_nl])              # [2K]
+              kid_hist = jnp.concatenate([h_left, h_right], axis=0)
+              depths = st["tree"].leaf_depth[kids]
+              res = jax.vmap(child_best)(kid_hist, st["sum_g"][kids],
+                                         st["sum_h"][kids], st["count"][kids],
+                                         depths, st["leaf_min"][kids],
+                                         st["leaf_max"][kids])
+              ok2 = jnp.concatenate([valid, valid])
+              gains2 = jnp.where(ok2, res.gain, st["best_gain"][kids])
+              st["best_gain"] = st["best_gain"].at[kids].set(gains2)
+              for name, field in (("best_feat", res.feature),
+                                  ("best_thr", res.threshold),
+                                  ("best_var", res.variant),
+                                  ("best_lg", res.left_sum_g),
+                                  ("best_lh", res.left_sum_h),
+                                  ("best_lc", res.left_count)):
+                  st[name] = st[name].at[kids].set(
+                      jnp.where(ok2, field, st[name][kids]))
+              st["best_dl"] = st["best_dl"].at[kids].set(
+                  jnp.where(ok2, res.default_left, st["best_dl"][kids]))
+          return st
 
+      return round_body
+
+    # Warmup: the masked histogram kernel's MXU cost scales with its 3*K
+    # value channels, so rounds whose frontier holds < K splittable leaves
+    # burn ~K/frontier of a full pass for nothing (profiled: the first ~5
+    # rounds were 6 full-width passes = 35 ms of a 94 ms tree).  Early
+    # rounds therefore run width-matched bodies (K=1,2,4,...) — identical
+    # selection semantics, just fewer masked channels per pass.  Gated on
+    # data size (static at trace time): each width is its own kernel
+    # compilation, worth it only when passes are expensive.
+    if warmup and n >= 65536:
+        kw = 1
+        while kw < K:
+            state = lax.cond(state["progress"] & (state["n_splits"] < L - 1),
+                             make_round_body(kw), lambda st: st, state)
+            kw *= 2
     # loop until the tree is full or a round makes no progress — a fixed
     # ceil((L-1)/K) budget would starve narrow-frontier (chain-shaped) trees
     # where only ~1 leaf per round carries positive gain
     state = lax.while_loop(
         lambda st: st["progress"] & (st["n_splits"] < L - 1),
-        round_body, state)
+        make_round_body(K), state)
     return state["tree"], state["leaf_of_row"]

@@ -233,3 +233,36 @@ def test_batched_monotone_respected():
     base[:, 0] = np.linspace(-3, 3, 64)
     pred = b.predict(base)
     assert (np.diff(pred) >= -1e-6).all()
+
+
+def test_warmup_rounds_same_tree_large_n():
+    """The width-matched warmup rounds (n >= 65536 gate) change kernel
+    shapes, not selection: the grown tree matches the no-warmup result on
+    identical inputs."""
+    rng = np.random.default_rng(4)
+    n, f = 70_000, 6
+    bins = rng.integers(0, 31, size=(n, f)).astype(np.uint8)
+    logit = (bins[:, 0] / 16.0 - 1.0) + 0.5 * (bins[:, 1] > 20)
+    y = (logit + rng.normal(scale=0.5, size=n) > 0).astype(np.float32)
+    g = (1 / (1 + np.exp(-logit)) - y).astype(np.float32)
+    h = np.full(n, 0.25, np.float32)
+    hp = SplitHyper(num_leaves=15, min_data_in_leaf=5, n_bins=32)
+    args = (jnp.asarray(bins), jnp.asarray(g), jnp.asarray(h), None,
+            jnp.asarray(np.full(f, 31, np.int32)),
+            jnp.asarray(np.full(f, -1, np.int32)),
+            jnp.asarray(np.zeros(f, bool)), None, hp)
+    t_warm, lor_warm = grow_tree_batched(*args, batch=4)   # warmup active
+    t_ref, lor_ref = grow_tree_batched(*args, batch=4, warmup=False)
+    # the warmup widths always cover the whole frontier (frontier after r
+    # rounds <= 2^r), so the grown tree must be IDENTICAL, not just equal
+    # in size
+    assert int(t_warm.num_leaves) == int(t_ref.num_leaves)
+    np.testing.assert_array_equal(np.asarray(t_warm.split_feature),
+                                  np.asarray(t_ref.split_feature))
+    np.testing.assert_array_equal(np.asarray(t_warm.split_bin),
+                                  np.asarray(t_ref.split_bin))
+    np.testing.assert_array_equal(np.asarray(lor_warm), np.asarray(lor_ref))
+    counts = np.bincount(np.asarray(lor_warm), minlength=hp.num_leaves)
+    np.testing.assert_array_equal(
+        counts[:int(t_warm.num_leaves)],
+        np.asarray(t_warm.leaf_count)[:int(t_warm.num_leaves)].astype(int))

@@ -110,3 +110,32 @@ def test_serialization_roundtrip():
     m2 = BinMapper.from_dict(m.to_dict())
     test = np.array([0.5, 1.5, 2.5, np.nan, -1.0])
     assert (m.values_to_bins(test) == m2.values_to_bins(test)).all()
+
+
+def test_forcedbins_filename(tmp_path):
+    """forcedbins_filename JSON forces bin upper bounds (reference
+    dataset_loader.cpp forced-bins load; examples/regression/
+    forced_bins.json format)."""
+    import json
+    import lightgbm_tpu as lgb
+    rng = np.random.default_rng(0)
+    n = 2000
+    X = rng.uniform(0, 1, size=(n, 2))
+    y = (X[:, 0] > 0.3).astype(np.float64)
+    fb = tmp_path / "forced.json"
+    fb.write_text(json.dumps([
+        {"feature": 0, "bin_upper_bound": [0.3, 0.35, 0.4]},
+        {"feature": 99, "bin_upper_bound": [1.0]},   # out of range: warn
+    ]))
+    p = {"objective": "binary", "verbose": -1, "max_bin": 16,
+         "forcedbins_filename": str(fb)}
+    ds = lgb.Dataset(X, label=y, params=p)
+    ds.construct()
+    ub = ds._inner.mappers[0].bin_upper_bound
+    for forced in (0.3, 0.35, 0.4):
+        assert np.any(np.isclose(ub, forced)), (forced, ub)
+    # unforced feature keeps data-driven bounds
+    assert not np.any(np.isclose(ds._inner.mappers[1].bin_upper_bound, 0.35))
+    # trains fine
+    bst = lgb.train(p, ds, num_boost_round=3)
+    assert np.isfinite(bst.predict(X[:10])).all()

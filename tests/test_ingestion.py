@@ -246,3 +246,34 @@ def test_sparse_valid_against_dense_reference_falls_back():
                     valid_names=["sp", "dn"])
     vals = {name: v for name, _, v, _ in bst.eval_valid()}
     assert abs(vals["sp"] - vals["dn"]) < 1e-9, vals
+
+
+def test_arrow_direct_column_path():
+    """Numeric arrow Tables convert straight from the arrow buffers (no
+    pandas intermediate), with nulls as NaN and chunked columns handled."""
+    import pyarrow as pa
+    rng = np.random.default_rng(3)
+    n = 1200
+    c0 = rng.normal(size=n)
+    c1 = rng.integers(0, 100, size=n).astype(np.int64)
+    t1 = pa.table({"a": c0[:600], "b": c1[:600]})
+    t2 = pa.table({"a": c0[600:], "b": c1[600:]})
+    table = pa.concat_tables([t1, t2])          # chunked columns
+    # inject a null
+    col_with_null = pa.chunked_array([pa.array([1.0, None] +
+                                               list(c0[2:600])),
+                                      pa.array(c0[600:])])
+    table = table.set_column(0, "a", col_with_null)
+    y = (c1 > 50).astype(np.float64)
+    ds = lgb.Dataset(table, label=y, params=FAST)
+    bst = lgb.train({**FAST, "objective": "binary"}, ds, num_boost_round=5)
+    pred = bst.predict(table)
+    assert np.isfinite(pred).all()
+    # identical to the dense numpy equivalent
+    dense = np.column_stack([c0, c1.astype(np.float64)])
+    dense[1, 0] = np.nan
+    b2 = lgb.train({**FAST, "objective": "binary"},
+                   lgb.Dataset(dense, label=y, params=FAST),
+                   num_boost_round=5)
+    # predictions come back float32; identical trees within f32 epsilon
+    np.testing.assert_allclose(pred, b2.predict(dense), atol=1e-6)
