@@ -38,6 +38,13 @@ if jax._src.xla_bridge._backends:
         "`env -u PYTHONPATH python -m pytest`")
 jax.config.update("jax_platforms", _backend)
 
+# Persistent compilation cache: the suite is jit-compile bound (hundreds of
+# grower/kernel specializations), and XLA keys the cache by HLO hash so
+# reruns after unrelated edits skip most compiles.  ~halves repeat runs.
+_cache_dir = os.path.join(os.path.dirname(__file__), ".jax_cache")
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
