@@ -67,6 +67,7 @@ def _hp_from_config(cfg: Config, n_bins: int) -> SplitHyper:
         hist_dtype=("float32" if cfg.deterministic
                     else str(cfg.tpu_hist_dtype)),
         leaf_hist=str(cfg.tpu_leaf_hist),
+        grouped_hist=bool(cfg.tpu_grouped_hist),
         extra_trees=bool(cfg.extra_trees),
         feature_fraction_bynode=float(cfg.feature_fraction_bynode),
     )

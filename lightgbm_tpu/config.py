@@ -236,6 +236,7 @@ _PARAMS: List[Tuple[str, Any, Tuple[str, ...], Tuple[Tuple[str, float], ...]]] =
     ("tpu_rows_per_block", 16384, (), ()),        # histogram kernel row tile
     ("tpu_leaf_hist", "masked", (), ()),          # per-leaf hist: masked|bucketed
     ("tpu_split_batch", 1, (), ((">", 0),)),      # splits per histogram pass
+    ("tpu_grouped_hist", False, (), ()),          # leaf-grouped compacted histogram kernel (experimental)
     ("tpu_donate_scores", True, (), ()),
 ]
 

@@ -279,7 +279,8 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
               h_small = histogram_for_leaves_auto(
                   bins, bins_t, grad, hess, lor, smaller, row_mask,
                   n_bins=hp.n_bins, rows_per_block=hp.rows_per_block,
-                  hist_dtype=hp.hist_dtype, axis_name=axis_name)      # [K,Fb,B,C]
+                  hist_dtype=hp.hist_dtype, axis_name=axis_name,
+                  grouped=hp.grouped_hist)                            # [K,Fb,B,C]
               h_parent = st["hist"][parents]
               h_large = h_parent - h_small
               left_small = (l_cnt <= r_cnt)[:, None, None, None]

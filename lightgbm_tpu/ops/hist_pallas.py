@@ -262,3 +262,85 @@ def histogram_leaves_rows_pallas(bins_rows, grad, hess, leaf_of_row, leaves,
     """Fused masked multi-leaf histogram from ROW-major [S, F] bins."""
     return _histogram_leaves_impl(bins_rows, grad, hess, leaf_of_row, leaves,
                                   rows_major=True, **kw)
+
+
+def histogram_grouped_pallas(rows_c: jax.Array, grad_c: jax.Array,
+                             hess_c: jax.Array, valid_c: jax.Array,
+                             block_group: jax.Array, n_groups: int, *,
+                             n_bins: int, rows_per_block: int = 1024,
+                             compute_dtype=jnp.bfloat16,
+                             interpret: bool = False) -> jax.Array:
+    """Leaf-GROUPED histogram: f32 [K, F, n_bins, 4] from rows physically
+    sorted by output group.
+
+    The masked multi-leaf kernel pays MXU time proportional to its 3K value
+    channels even though each row belongs to ONE leaf.  When the compacted
+    rows arrive grouped by leaf (each group padded to whole row blocks),
+    every block contracts just C=3 channels and a scalar-prefetched
+    block->group map steers its accumulation into that group's output tile
+    — the K-channel multiplier disappears (the reference's CUDA kernel
+    gets the same effect from per-leaf data_indices,
+    cuda_histogram_constructor.cu:18).
+
+    rows_c: u8 [Sp, F] (pad rows arbitrary); grad_c/hess_c/valid_c: f32
+    [Sp] (pad rows MUST carry 0s / valid 0); block_group: i32
+    [Sp / rows_per_block] group id per block, nondecreasing (consecutive
+    blocks of a group revisit one output tile).
+    """
+    Sp, num_f = rows_c.shape
+    blk = rows_per_block
+    assert Sp % blk == 0, "caller pads groups to whole blocks"
+    fc = _pick_fc(num_f)
+    f_pad = _round_up(num_f, fc)
+    if f_pad != num_f:
+        rows_c = jnp.pad(rows_c, ((0, 0), (0, f_pad - num_f)))
+    nblk = Sp // blk
+
+    def kernel(bg_ref, bins_ref, g_ref, h_ref, v_ref, out_ref):
+        i = pl.program_id(0)
+        fresh = jnp.where(i == 0, True,
+                          bg_ref[jnp.maximum(i - 1, 0)] != bg_ref[i])
+
+        @pl.when(fresh)
+        def _():
+            out_ref[:] = jnp.zeros_like(out_ref)
+
+        vals = jnp.concatenate(
+            [g_ref[:], h_ref[:], v_ref[:]], axis=0).astype(compute_dtype)
+        b_blk = bins_ref[:].astype(jnp.int32)            # [blk, f_pad]
+        iota = lax.iota(jnp.int32, n_bins)
+        for f0 in range(0, f_pad, fc):
+            chunk = b_blk[:, f0:f0 + fc].T               # [fc, blk]
+            onehot = (chunk[:, None, :] == iota[None, :, None]
+                      ).astype(compute_dtype)            # [fc, B, blk]
+            oh = onehot.reshape(fc * n_bins, blk)
+            acc = lax.dot_general(
+                vals, oh, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=_prec(compute_dtype))          # [3, fc*B]
+            out_ref[0, :, f0 * n_bins:(f0 + fc) * n_bins] += acc
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nblk,),
+        in_specs=[
+            pl.BlockSpec((blk, f_pad), lambda i, bg: (i, 0)),
+            pl.BlockSpec((1, blk), lambda i, bg: (0, i)),
+            pl.BlockSpec((1, blk), lambda i, bg: (0, i)),
+            pl.BlockSpec((1, blk), lambda i, bg: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((1, 3, f_pad * n_bins),
+                               lambda i, bg: (bg[i], 0, 0)),
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n_groups, 3, f_pad * n_bins),
+                                       jnp.float32),
+        interpret=interpret,
+    )(block_group, rows_c, grad_c[None, :], hess_c[None, :],
+      valid_c[None, :])
+    # [K, 3, F*B] -> [K, F, B, 4]
+    out = out.reshape(n_groups, 3, f_pad, n_bins)[:, :, :num_f]
+    out = out.transpose(0, 2, 3, 1)
+    return jnp.pad(out, ((0, 0), (0, 0), (0, 0), (0, 1)))

@@ -33,6 +33,10 @@ from jax import lax
 
 NUM_CHANNELS = 4  # grad, hess, count, pad
 
+# test hook: lets the CPU suite exercise the grouped compaction path via the
+# pallas interpreter (use_pallas() is False off-TPU)
+_GROUPED_TEST_INTERPRET = False
+
 
 def _round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
@@ -209,6 +213,31 @@ def _rows_leaves_hist(bins_rows: jax.Array, grad: jax.Array,
         n_bins=n_bins, rows_per_block=rows_per_block, hist_dtype=hist_dtype)
 
 
+def _grouped_layout(cnt: jax.Array, n: int, s_pad: int, blk: int, K: int):
+    """Destination-side layout for the leaf-grouped kernel: where each
+    padded destination slot reads from in the (rank, row)-sorted order,
+    whether it is a real row, and each block's group id.
+
+    Every group owns >= 1 block (its output tile must be written at least
+    once) and a whole number of blocks, so consecutive-block accumulation
+    in the kernel is exact."""
+    pad_cnt = jnp.maximum((cnt + blk - 1) // blk, 1) * blk          # [K]
+    P = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                         jnp.cumsum(pad_cnt)])[:K].astype(jnp.int32)
+    cumc = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                            jnp.cumsum(cnt)])[:K].astype(jnp.int32)
+    d = jnp.arange(s_pad, dtype=jnp.int32)
+    k_of = jnp.sum((d[:, None] >= P[None, :]).astype(jnp.int32),
+                   axis=1) - 1                                       # [s_pad]
+    k_of = jnp.clip(k_of, 0, K - 1)
+    off = d - P[k_of]
+    valid = off < cnt[k_of]
+    src_pos = jnp.clip(cumc[k_of] + jnp.minimum(
+        off, jnp.maximum(cnt[k_of] - 1, 0)), 0, n - 1)
+    bg = k_of[::blk]
+    return src_pos, valid, bg
+
+
 def histogram_for_leaves_auto(bins_rows: jax.Array, bins_t: jax.Array,
                               grad: jax.Array, hess: jax.Array,
                               leaf_of_row: jax.Array, leaves: jax.Array,
@@ -216,7 +245,8 @@ def histogram_for_leaves_auto(bins_rows: jax.Array, bins_t: jax.Array,
                               n_bins: int = 256, rows_per_block: int = 2048,
                               hist_dtype: str = "float32",
                               axis_name: Optional[str] = None,
-                              buckets=(4, 8, 16, 64)) -> jax.Array:
+                              buckets=(4, 8, 16, 64),
+                              grouped: bool = False) -> jax.Array:
     """K-leaf histograms with frontier compaction -> f32 [K, F, B, C].
 
     The TPU reformulation of the reference's O(smaller-child) histogram cost
@@ -232,15 +262,24 @@ def histogram_for_leaves_auto(bins_rows: jax.Array, bins_t: jax.Array,
     """
     n = grad.shape[0]
     leaves = jnp.asarray(leaves, jnp.int32)
+    K = leaves.shape[0]
     lor = jnp.asarray(leaf_of_row, jnp.int32)
     if row_mask is not None:
         lor = jnp.where(row_mask, lor, -1)
-    sel = jnp.any(lor[None, :] == leaves[:, None], axis=0)    # [n]
+    eq = lor[None, :] == leaves[:, None]                      # [K, n]
+    sel = jnp.any(eq, axis=0)                                 # [n]
     cnt = jnp.sum(sel.astype(jnp.int32))
     assert n < (1 << 30), "compaction packing needs n < 2^30 rows per shard"
     num_f = bins_rows.shape[1]
 
+    rank_bits = max((K + 1).bit_length(), 1)
+    # fall back to the masked/sorted paths (not an error) when the
+    # (rank, row) key cannot pack into the i32 sort
+    use_grouped = grouped and (use_pallas() or _GROUPED_TEST_INTERPRET) \
+        and n < (1 << (30 - rank_bits))
+
     blk = min(rows_per_block, 2048)
+    kblk = min(1024, blk)
     sizes = []
     for d in buckets:
         s = _round_up(max(n // d, 1), blk)
@@ -253,6 +292,60 @@ def histogram_for_leaves_auto(bins_rows: jax.Array, bins_t: jax.Array,
             rows_per_block=rows_per_block, hist_dtype=hist_dtype)
 
     def make_branch(S: int):
+        if use_grouped:
+            def branch(operands):
+                # leaf-GROUPED compaction (ops/hist_pallas.py
+                # histogram_grouped_pallas): sort by (leaf rank, row) so
+                # each leaf's rows are contiguous, pad groups to whole
+                # kernel blocks, and contract C=3 channels per block into
+                # a scalar-prefetch-steered output tile — no K-channel
+                # multiplier on the MXU.
+                sel_, grad_, hess_, lor_ = operands
+                from .hist_pallas import histogram_grouped_pallas
+                # rank/count work lives INSIDE the branch so full-pass
+                # rounds never pay the O(K*n) reductions
+                eq_ = lor_[None, :] == leaves[:, None]
+                sel_b = jnp.any(eq_, axis=0)
+                # first-match rank (duplicate dummy leaves collapse onto
+                # the first slot; their unused hist tiles come back zero)
+                rank_of_row = jnp.where(
+                    sel_b, jnp.argmax(eq_, axis=0).astype(jnp.int32), K)
+                cnt_k = jax.vmap(lambda k: jnp.sum(
+                    (rank_of_row == k).astype(jnp.int32)))(jnp.arange(K))
+                row_bits = 30 - rank_bits
+                iota_n = lax.iota(jnp.int32, n)
+                key = (rank_of_row << row_bits) | iota_n
+                order = jnp.sort(key, stable=False)[:S] \
+                    & ((1 << row_bits) - 1)                  # [S]
+                packed_ = jnp.concatenate([
+                    bins_rows,
+                    lax.bitcast_convert_type(grad_, jnp.uint8),
+                    lax.bitcast_convert_type(hess_, jnp.uint8),
+                ], axis=1)                                   # [n, F+8]
+                # whole kernel blocks regardless of the bucket's blk
+                # rounding (rows_per_block need not be a kblk multiple)
+                s_pad = _round_up(S, kblk) + K * kblk
+                src_pos, valid_d, bg = _grouped_layout(
+                    cnt_k, n, s_pad, kblk, K)
+                src_row = order[jnp.minimum(src_pos, S - 1)]
+                pc = packed_[src_row]                        # [s_pad, F+8]
+                rows_c = pc[:, :num_f]
+                g_c = lax.bitcast_convert_type(
+                    pc[:, num_f:num_f + 4], jnp.float32)
+                h_c = lax.bitcast_convert_type(
+                    pc[:, num_f + 4:num_f + 8], jnp.float32)
+                vf = valid_d.astype(jnp.float32)
+                # where(), not multiply: a NaN gradient on a pad-clipped
+                # row must not poison sums
+                g_c = jnp.where(valid_d, g_c, 0.0)
+                h_c = jnp.where(valid_d, h_c, 0.0)
+                return histogram_grouped_pallas(
+                    rows_c, g_c, h_c, vf, bg, K, n_bins=n_bins,
+                    rows_per_block=kblk,
+                    compute_dtype=jnp.dtype(hist_dtype).type,
+                    interpret=not use_pallas())
+            return branch
+
         def branch(operands):
             sel_, grad_, hess_, lor_ = operands
             # One u8 payload matrix holding (bins row, grad, hess, leaf) so

@@ -82,6 +82,11 @@ class SplitHyper:
     # nonzero+gather into power-of-two buckets (wins only when leaves are
     # tiny relative to n AND gathers are cheap)
     leaf_hist: str = "masked"
+    # leaf-GROUPED compacted histograms (ops/hist_pallas.py
+    # histogram_grouped_pallas): rows sorted by leaf + scalar-prefetch
+    # steered accumulation, removing the 3K-channel MXU multiplier from
+    # compacted rounds.  Off by default until measured on hardware.
+    grouped_hist: bool = False
 
 
 #: candidate-variant indices along the last axis of the gain tensor
