@@ -30,7 +30,43 @@ SPLIT_BATCH = int(os.environ.get("BENCH_SPLIT_BATCH", 20))
 BASELINE_S_PER_ROW_ITER = 130.094 / (10_500_000 * 500)
 
 
+def _probe_backend(timeout_s: float = 240.0):
+    """None when the jax backend answers a small op within ``timeout_s``,
+    else a short failure tag.
+
+    The TPU tunnel can wedge so hard that every dispatch blocks forever
+    (observed in-round); a hung bench records nothing, a failed probe at
+    least records WHY.  240 s covers a healthy tunnel's slow first
+    compile with margin."""
+    import threading
+    result = []
+
+    def work():
+        try:
+            import jax.numpy as jnp
+            y = (jnp.ones((256, 256)) @ jnp.ones((256, 256)))
+            y.block_until_ready()
+            result.append(("ok", float(y[0, 0])))
+        except Exception as e:  # init failure is NOT a timeout; record it
+            result.append(("error", f"{type(e).__name__}: {e}"))
+
+    t = threading.Thread(target=work, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if not result:
+        return "probe_timeout"
+    tag, detail = result[0]
+    return None if tag == "ok" else f"probe_error_{detail[:60]}"
+
+
 def main():
+    fail = _probe_backend()
+    if fail is not None:
+        print(json.dumps({
+            "metric": f"backend_unreachable_{fail}",
+            "value": -1.0, "unit": "seconds", "vs_baseline": 0.0}),
+            flush=True)
+        os._exit(1)
     import jax
     import jax.numpy as jnp
     from lightgbm_tpu.learner.batch_grower import grow_tree_batched
