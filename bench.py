@@ -25,8 +25,11 @@ BENCH_ROWS = int(os.environ.get("BENCH_ROWS", 1_000_000))
 BENCH_ITERS = int(os.environ.get("BENCH_ITERS", 20))
 NUM_LEAVES = int(os.environ.get("BENCH_LEAVES", 255))
 MAX_BIN = int(os.environ.get("BENCH_BIN", 255))
-# splits per histogram pass (learner/batch_grower.py); 1 = strict leaf-wise
-SPLIT_BATCH = int(os.environ.get("BENCH_SPLIT_BATCH", 20))
+# splits per histogram pass (learner/batch_grower.py); 1 = strict leaf-wise.
+# K sweep on the live chip (docs/PERF_NOTES.md round 3): 20 -> 99.5, 28 ->
+# 92.7, 32 -> 91.9, 40 -> 95.0 ms/tree; 28 matches 32 within noise at half
+# the compile time.
+SPLIT_BATCH = int(os.environ.get("BENCH_SPLIT_BATCH", 28))
 BASELINE_S_PER_ROW_ITER = 130.094 / (10_500_000 * 500)
 
 
@@ -59,6 +62,67 @@ def _probe_backend(timeout_s: float = 240.0):
     return None if tag == "ok" else f"probe_error_{detail[:60]}"
 
 
+def _synth_higgs(n, f, rng, w=None):
+    """Higgs-shaped synthetic binary data (separable-ish continuous
+    features; BASELINE.md pairs its 130.094 s with AUC 0.845724 on the real
+    set — the synthetic task reports ITS OWN auc next to wall-clock so perf
+    is always gated on accuracy).  Pass ``w`` to draw train/test sets from
+    the SAME task."""
+    if w is None:
+        w = rng.normal(size=f)
+    feat = rng.normal(size=(n, f)).astype(np.float32)
+    logits = feat @ w * 0.5
+    label = (logits + rng.normal(scale=1.0, size=n) > 0).astype(np.float32)
+    return feat, label, w
+
+
+def main_e2e():
+    """BENCH_E2E=1: the path a user calls — Dataset + train() + AUC.
+
+    Times train() only (the reference's published numbers exclude data
+    loading, docs/Experiments.rst) and reports held-out AUC in the JSON so
+    the perf claim carries its accuracy (VERDICT r2 weak #3).  NOTE: each
+    boosting iteration is its own device dispatch; through the axon tunnel
+    that adds ~100 ms/iter of transport, so this mode under-reports
+    relative to the in-one-jit kernel bench on tunneled dev chips.
+    """
+    import lightgbm_tpu as lgb
+
+    rng = np.random.default_rng(0)
+    n, f = BENCH_ROWS, 28
+    feat, label, w = _synth_higgs(n, f, rng)
+    feat_te, label_te, _ = _synth_higgs(200_000, f, rng, w=w)
+    params = {
+        "objective": "binary", "metric": "auc", "verbose": -1,
+        "num_leaves": NUM_LEAVES, "learning_rate": 0.1,
+        "max_bin": MAX_BIN, "min_data_in_leaf": 0,
+        "min_sum_hessian_in_leaf": 100.0,
+        "tpu_hist_dtype": os.environ.get("BENCH_HIST_DTYPE", "bfloat16"),
+        "tpu_split_batch": SPLIT_BATCH,
+    }
+    ds = lgb.Dataset(feat, label=label, params=params)
+    ds.construct()
+    t0 = time.time()
+    bst = lgb.train(params, ds, num_boost_round=BENCH_ITERS)
+    elapsed = time.time() - t0
+    pred = bst.predict(feat_te)
+    order = np.argsort(pred)
+    ranks = np.empty(len(order))
+    ranks[order] = np.arange(1, len(order) + 1)
+    npos = label_te.sum()
+    nneg = len(label_te) - npos
+    auc = (ranks[label_te > 0].sum() - npos * (npos + 1) / 2) / (npos * nneg)
+    baseline_equiv = BASELINE_S_PER_ROW_ITER * n * BENCH_ITERS
+    print(json.dumps({
+        "metric": f"higgs_e2e_train_{n}rows_{BENCH_ITERS}iters_"
+                  f"leaves{NUM_LEAVES}",
+        "value": round(elapsed, 3),
+        "unit": "seconds",
+        "vs_baseline": round(baseline_equiv / elapsed, 4),
+        "auc": round(float(auc), 6),
+    }))
+
+
 def main():
     fail = _probe_backend()
     if fail is not None:
@@ -67,6 +131,9 @@ def main():
             "value": -1.0, "unit": "seconds", "vs_baseline": 0.0}),
             flush=True)
         os._exit(1)
+    if os.environ.get("BENCH_E2E"):
+        main_e2e()
+        return
     import jax
     import jax.numpy as jnp
     from lightgbm_tpu.learner.batch_grower import grow_tree_batched
@@ -75,11 +142,7 @@ def main():
 
     rng = np.random.default_rng(0)
     n, f = BENCH_ROWS, 28
-    # Higgs-like: continuous features, separable-ish labels
-    w = rng.normal(size=f)
-    feat = rng.normal(size=(n, f)).astype(np.float32)
-    logits = feat @ w * 0.5
-    label = (logits + rng.normal(scale=1.0, size=n) > 0).astype(np.float32)
+    feat, label, _ = _synth_higgs(n, f, rng)
     # quantize host-side (binning is one-time preprocessing, excluded like
     # the reference excludes data loading from train timing)
     qs = np.quantile(feat[:100_000], np.linspace(0, 1, MAX_BIN)[1:-1], axis=0)

@@ -382,6 +382,73 @@ class Dataset:
     def feature_names(self) -> List[str]:
         return self.inner.feature_names
 
+    def set_feature_names(self, names: List[str]) -> "Dataset":
+        """LGBM_DatasetSetFeatureNames (c_api.h:551)."""
+        names = [str(n) for n in names]
+        inner = self.inner
+        if len(names) != inner.num_total_features:
+            raise ValueError(
+                f"{len(names)} names for {inner.num_total_features} features")
+        inner.feature_names = names
+        return self
+
+    def add_features_from(self, other: "Dataset") -> "Dataset":
+        """Column-concatenate another CONSTRUCTED dataset's features
+        (reference LGBM_DatasetAddFeaturesFrom c_api.h:631 /
+        Dataset::AddFeaturesFrom)."""
+        a, b = self.inner, other.inner
+        if a.num_data != b.num_data:
+            raise ValueError("datasets hold different row counts")
+        if a.bundle_plan is not None or b.bundle_plan is not None:
+            raise ValueError(
+                "add_features_from does not compose with EFB bundles; "
+                "construct both datasets with enable_bundle=false")
+        na = a.num_total_features
+        a.bins = np.concatenate([a.bins, b.bins], axis=1)
+        a.used_feature_idx = list(a.used_feature_idx) + \
+            [na + i for i in b.used_feature_idx]
+        a.mappers = list(a.mappers) + list(b.mappers)
+        a.feature_names = list(a.feature_names) + list(b.feature_names)
+        a.num_total_features = na + b.num_total_features
+        return self
+
+    def serialize_reference(self) -> bytes:
+        """Binning reference (mappers + schema, no rows) as bytes
+        (reference LGBM_DatasetSerializeReferenceToBinary)."""
+        import json as _json
+        inner = self.inner
+        doc = {
+            "lgbtpu_reference": 1,
+            "mappers": [m.to_dict() for m in inner.mappers],
+            "used_feature_idx": list(map(int, inner.used_feature_idx)),
+            "num_total_features": int(inner.num_total_features),
+            "feature_names": list(inner.feature_names),
+            "params": {k: v for k, v in (self.params or {}).items()
+                       if isinstance(v, (str, int, float, bool))},
+        }
+        return _json.dumps(doc).encode()
+
+    @classmethod
+    def deserialize_reference(cls, raw: bytes) -> "Dataset":
+        """Rebuild a row-less reference Dataset whose ``create_valid``
+        bins new rows on the serialized mapper grid (reference
+        LGBM_DatasetCreateFromSerializedReference c_api.h:142)."""
+        import json as _json
+        from .io.binning import BinMapper
+        from .io.dataset import Dataset as _InnerDataset, Metadata
+        doc = _json.loads(raw.decode())
+        if not doc.get("lgbtpu_reference"):
+            raise ValueError("not a serialized dataset reference")
+        inner = _InnerDataset()
+        inner.mappers = [BinMapper.from_dict(d) for d in doc["mappers"]]
+        inner.used_feature_idx = doc["used_feature_idx"]
+        inner.num_total_features = doc["num_total_features"]
+        inner.feature_names = doc["feature_names"]
+        inner.bins = np.zeros((0, len(inner.used_feature_idx)), np.uint8)
+        inner.metadata = Metadata(0)
+        ds = cls.from_inner(inner, params=doc.get("params") or {})
+        return ds
+
 
 class Booster:
     """Trained/trainable model handle (reference basic.py:3586)."""
@@ -629,6 +696,103 @@ class Booster:
                     (1.0 - decay_rate) * new_out
                 scores[:, c] += t.leaf_value[leaf]
         return new_booster
+
+    def refit_from_leaf_preds(self, leaf_preds: np.ndarray,
+                              decay_rate: Optional[float] = None
+                              ) -> "Booster":
+        """Re-fit leaf values IN PLACE from a [n, num_trees] leaf-index
+        matrix on the TRAINING set (reference LGBM_BoosterRefit c_api.h:776
+        / GBDT::RefitTree gbdt.cpp:258; the Python wrapper predicts leaves
+        then calls this)."""
+        if self._gbdt is None:
+            log.fatal("refit_from_leaf_preds needs a booster with training "
+                      "state (use refit(data, label) on loaded models)")
+        g = self._gbdt
+        cfg = g.config
+        if decay_rate is None:
+            decay_rate = float(cfg.refit_decay_rate)
+        l1, l2 = float(cfg.lambda_l1), float(cfg.lambda_l2)
+        trees = g.models
+        k = max(1, g.num_tree_per_iteration)
+        n = leaf_preds.shape[0]
+        if leaf_preds.shape[1] != len(trees):
+            log.fatal(f"leaf matrix has {leaf_preds.shape[1]} columns for "
+                      f"{len(trees)} trees")
+        import jax.numpy as jnp
+        scores = np.zeros((n, k))
+        for it in range(len(trees) // k):
+            gj, hj = g.objective.get_gradients(
+                jnp.asarray(scores[:, 0] if k == 1 else scores, jnp.float32))
+            gr = np.asarray(gj, np.float64).reshape(n, k, order="F") \
+                if np.asarray(gj).ndim == 1 else np.asarray(gj, np.float64)
+            hs = np.asarray(hj, np.float64).reshape(n, k, order="F") \
+                if np.asarray(hj).ndim == 1 else np.asarray(hj, np.float64)
+            for c in range(k):
+                t = trees[it * k + c]
+                leaf = leaf_preds[:, it * k + c]
+                sg = np.bincount(leaf, weights=gr[:, c],
+                                 minlength=t.num_leaves)
+                sh = np.bincount(leaf, weights=hs[:, c],
+                                 minlength=t.num_leaves)
+                sg_reg = np.sign(sg) * np.maximum(np.abs(sg) - l1, 0.0)
+                new_out = -sg_reg / (sh + l2 + 1e-15) * t.shrinkage
+                t.leaf_value = decay_rate * t.leaf_value + \
+                    (1.0 - decay_rate) * new_out
+                scores[:, c] += t.leaf_value[leaf]
+        g.invalidate_score_cache()
+        return self
+
+    def merge_models(self, other: "Booster") -> "Booster":
+        """Append the other model's trees (reference LGBM_BoosterMerge
+        c_api.h:680)."""
+        import copy
+        trees = other._get_trees()
+        if self._gbdt is not None:
+            self._gbdt.append_models(trees)
+        else:
+            # deep copy: later leaf edits on this booster must not reach
+            # through to the source model (append_models copies too)
+            self._loaded["trees"].extend(copy.deepcopy(trees))
+        return self
+
+    def reset_parameter(self, params: Dict[str, Any]) -> "Booster":
+        """LGBM_BoosterResetParameter (c_api.h:853): swap learning-control
+        parameters on the live booster."""
+        self.params = {**self.params, **normalize_params(params)}
+        if self._gbdt is not None:
+            self._gbdt.reset_config(Config(self.params))
+        return self
+
+    def reset_training_data(self, train_set: Dataset) -> "Booster":
+        """LGBM_BoosterResetTrainingData (c_api.h:843)."""
+        train_set.construct()
+        if self._gbdt is None:
+            log.fatal("reset_training_data needs a training booster")
+        self._gbdt.reset_training_data(train_set.inner)
+        self.train_set = train_set
+        return self
+
+    def shuffle_models(self, start: int = 0, end: int = -1) -> "Booster":
+        """LGBM_BoosterShuffleModels (c_api.h:698): random-permute whole
+        iterations in [start, end)."""
+        k = max(1, self.num_model_per_iteration())
+        trees = self._get_trees()
+        n_iter = len(trees) // k
+        end = n_iter if end < 0 else min(end, n_iter)
+        start = max(0, start)
+        if end - start > 1:
+            rng = np.random.default_rng(int(self.params.get("seed") or 1))
+            perm = rng.permutation(end - start) + start
+            groups = [trees[i * k:(i + 1) * k] for i in range(n_iter)]
+            shuffled = groups[:start] + [groups[p] for p in perm] \
+                + groups[end:]
+            flat = [t for grp in shuffled for t in grp]
+            if self._gbdt is not None:
+                self._gbdt.models = flat
+                self._gbdt.invalidate_score_cache()
+            else:
+                self._loaded["trees"] = flat
+        return self
 
     # ------------------------------------------------------------- im/export
     def model_to_string(self, num_iteration: Optional[int] = None,

@@ -33,7 +33,8 @@ from ..metrics import Metric, create_metrics
 from ..models.predict import predict_bins_leaf, predict_bins_tree
 from ..models.tree import Tree
 from ..objectives import ObjectiveFunction, create_objective
-from ..ops.quantize import discretize_gradients, renew_leaf_values
+from ..ops.quantize import (discretize_gradients_levels,
+                            renew_leaf_values)
 from ..ops.split import SplitHyper
 from ..utils import log
 from ..utils.timer import global_timer
@@ -170,6 +171,28 @@ class GBDT:
         self.hp = _hp_from_config(config, train_set.device_n_bins())
         if bool(train_set.categorical_array().any()):
             self.hp = dataclasses.replace(self.hp, has_categorical=True)
+        # bounded histogram pool (reference histogram_pool_size MB,
+        # serial_tree_learner.cpp:36-47): translate the MB budget into
+        # batched-grower pool slots; evicted parents re-histogram both
+        # children directly (learner/batch_grower.py)
+        pool_mb = float(config.histogram_pool_size)
+        if pool_mb > 0:
+            n_cols = train_set.bins.shape[1]
+            bytes_per_leaf = n_cols * self.hp.n_bins * 4 * 4
+            slots = int(pool_mb * (1 << 20) // max(bytes_per_leaf, 1))
+            kbatch = max(1, int(config.tpu_split_batch))
+            slots = max(slots, 3 * kbatch + 2)
+            if slots < self.hp.num_leaves:
+                if self.hp.has_categorical:
+                    log.warning("histogram_pool_size ignored: the bounded "
+                                "pool does not compose with categorical "
+                                "features yet")
+                elif kbatch <= 1:
+                    log.warning("histogram_pool_size requires the batched "
+                                "grower (tpu_split_batch > 1); ignored")
+                else:
+                    self.hp = dataclasses.replace(
+                        self.hp, hist_pool_slots=slots)
         self.bins = jnp.asarray(train_set.bins)
         self.num_bins_arr = jnp.asarray(train_set.num_bins_array())
         self.nan_bin_arr = jnp.asarray(train_set.nan_bin_array())
@@ -201,6 +224,10 @@ class GBDT:
                 if self.bundle is not None:
                     log.fatal("tree_learner=feature is incompatible with "
                               "enable_bundle=true (set enable_bundle=false)")
+                if bool(config.use_quantized_grad):
+                    log.fatal("use_quantized_grad does not compose with "
+                              "tree_learner=feature (no level-scale "
+                              "plumbing in that mode)")
                 # unsupported-feature conflicts fail loudly (reference
                 # CheckParamConflict style) instead of silently dropping
                 if any(int(m) != 0 for m in (config.monotone_constraints
@@ -369,6 +396,102 @@ class GBDT:
         self.num_init_iteration = len(trees) // k
         self.iter_ = self.num_init_iteration
 
+    def append_models(self, trees: List[Tree]) -> None:
+        """Append another model's trees (reference LGBM_BoosterMerge ->
+        GBDT::MergeFrom at the tail).  Score caches go stale and are
+        rebuilt from the model list."""
+        import copy
+        k = self.num_tree_per_iteration
+        if len(trees) % k != 0:
+            log.fatal("merged model has %d trees, not divisible by "
+                      "num_tree_per_iteration=%d" % (len(trees), k))
+        self.models = self.models + [copy.deepcopy(t) for t in trees]
+        self.iter_ = len(self.models) // k
+        self.invalidate_score_cache()
+
+    def invalidate_score_cache(self) -> None:
+        """Rebuild cached train/valid scores from the current model list
+        (after leaf edits, merges or shuffles — the reference's
+        ScoreUpdater is re-driven the same way on BoosterSetLeafValue)."""
+        k = self.num_tree_per_iteration
+
+        def rebuild(n, bins_d, init_score):
+            sc = np.zeros((n, k), np.float32) + self.init_scores[None, :]
+            if init_score is not None:
+                sc += init_score.reshape(sc.shape, order="F") \
+                    if init_score.size == sc.size else \
+                    init_score.reshape(-1, 1)
+            for i, t in enumerate(self.models):
+                arrs = _tree_to_arrays_stub(t, self.train_set,
+                                            exclude_bias=True)
+                contrib = np.asarray(predict_bins_tree(
+                    arrs, bins_d, self.nan_bin_arr, self.bundle,
+                    self.hp.has_categorical), np.float32)[:n]
+                sc[:, i % k] += contrib
+            return jnp.asarray(sc)
+
+        self.scores = rebuild(self.train_set.num_data, self.bins,
+                              self.train_set.metadata.init_score)
+        for vi in range(len(self.valid_sets)):
+            vs = self.valid_sets[vi]
+            self.valid_scores[vi] = rebuild(
+                vs.num_data, self._valid_bins[vi], vs.metadata.init_score)
+
+    def reset_config(self, config: Config) -> None:
+        """Swap learning-control parameters on the live booster
+        (reference GBDT::ResetConfig gbdt.cpp): learner hyperparameters,
+        shrinkage and the sampling strategy follow the new config;
+        objective/metrics/dataset stay."""
+        self.config = config
+        self.shrinkage_rate = float(config.learning_rate)
+        hp = _hp_from_config(config, self.train_set.device_n_bins())
+        if bool(self.train_set.categorical_array().any()):
+            hp = dataclasses.replace(hp, has_categorical=True)
+        self.hp = hp
+        self.sample_strategy = create_sample_strategy(
+            config, self.train_set.num_data)
+
+    def reset_training_data(self, train_set: Dataset) -> None:
+        """Point the live booster at a new training set (reference
+        GBDT::ResetTrainingData gbdt.cpp); existing trees are kept and
+        their predictions rebuilt into the score cache.
+
+        The new dataset must be BIN-ALIGNED with the current one (same
+        mappers — construct it with ``create_valid``/``subset`` or from
+        the serialized reference); the reference's CheckAlign enforces the
+        same."""
+        if train_set.num_features != self.num_features:
+            log.fatal("new training data has %d features, model needs %d"
+                      % (train_set.num_features, self.num_features))
+        new_nb = np.asarray(train_set.num_bins_array())
+        new_nan = np.asarray(train_set.nan_bin_array())
+        new_cat = np.asarray(train_set.categorical_array())
+        old_nb = np.asarray(self.num_bins_arr)[:len(new_nb)]
+        old_nan = np.asarray(self.nan_bin_arr)[:len(new_nan)]
+        old_cat = np.asarray(self.is_cat_arr)[:len(new_cat)]
+        if not (np.array_equal(new_nb, old_nb)
+                and np.array_equal(new_nan, old_nan)
+                and np.array_equal(new_cat, old_cat)):
+            log.fatal("reset_training_data: the new dataset's bin mappers "
+                      "differ from the model's (construct it against the "
+                      "same reference binning)")
+        if self._pad_rows or self._pad_cols:
+            log.fatal("reset_training_data is not supported in distributed "
+                      "padded mode")
+        self.train_set = train_set
+        if self.objective is not None:
+            self.objective.init(train_set.metadata, train_set.num_data)
+        for m in self.train_metrics:
+            m.init(train_set.metadata, train_set.num_data)
+        self.bins = jnp.asarray(train_set.bins)
+        self.sample_strategy = create_sample_strategy(
+            self.config, train_set.num_data)
+        n = train_set.num_data
+        k = self.num_tree_per_iteration
+        self.scores = jnp.zeros((n, k), jnp.float32)
+        self._init_base_score()
+        self.invalidate_score_cache()
+
     def add_valid(self, valid_set: Dataset, name: str) -> None:
         """reference GBDT::AddValidDataset (gbdt.cpp:184)."""
         self.valid_sets.append(valid_set)
@@ -454,12 +577,17 @@ class GBDT:
         # is found on the discretized grid; leaf values optionally renewed
         # from the true gradients below
         g_true, h_true = g, h
+        hist_scales = [None] * k
         if bool(self.config.use_quantized_grad):
+            # integer-LEVEL quantization (ops/quantize.py): levels are
+            # exact in the bf16 histogram kernel, so the fast kernel's
+            # sums become bit-deterministic; the grower multiplies the
+            # scales back in after each histogram pass
             qkey = jax.random.PRNGKey(
                 (self.config.seed or 0) * 7919 + self.iter_)
             gq, hq = [], []
             for c in range(k):
-                gc, hc = discretize_gradients(
+                gc, hc, gs, hs = discretize_gradients_levels(
                     g[:, c], h[:, c], jax.random.fold_in(qkey, c),
                     n_levels=int(self.config.num_grad_quant_bins),
                     stochastic=bool(self.config.stochastic_rounding),
@@ -467,6 +595,7 @@ class GBDT:
                                           and self.objective.is_constant_hessian))
                 gq.append(gc)
                 hq.append(hc)
+                hist_scales[c] = jnp.stack([gs, hs])
             g = jnp.stack(gq, axis=1)
             h = jnp.stack(hq, axis=1)
 
@@ -480,7 +609,8 @@ class GBDT:
             with global_timer.timer("tree_growth"):
                 arrays, leaf_of_row = self._grow(g[:, cls_idx],
                                                  h[:, cls_idx], row_mask,
-                                                 feature_mask, node_key)
+                                                 feature_mask, node_key,
+                                                 hist_scales[cls_idx])
             num_leaves = int(arrays.num_leaves)
             if num_leaves > 1:
                 finished = False
@@ -497,11 +627,14 @@ class GBDT:
             lin = None
             if self.linear and num_leaves > 1:
                 # per-leaf ridge fit on the leaf's numeric path features
-                # (reference LinearTreeLearner::CalculateLinear)
+                # (reference LinearTreeLearner::CalculateLinear); TRUE
+                # gradients, not quantized levels — the ridge solution is
+                # not scale-invariant across g/h
                 lin = fit_linear_leaves(
                     self.raw_dev, leaf_of_row, arrays.leaf_path,
-                    ~self.is_cat_arr, g[:, cls_idx], h[:, cls_idx], row_mask,
-                    arrays.leaf_value, float(self.config.linear_lambda))
+                    ~self.is_cat_arr, g_true[:, cls_idx], h_true[:, cls_idx],
+                    row_mask, arrays.leaf_value,
+                    float(self.config.linear_lambda))
             if lin is not None:
                 const, coeff = lin
                 contrib = linear_leaf_scores(self.raw_dev, leaf_of_row, const,
@@ -548,10 +681,11 @@ class GBDT:
         return finished
 
     def _grow(self, g: jax.Array, h: jax.Array, row_mask, feature_mask,
-              node_key) -> Tuple[TreeArrays, jax.Array]:
+              node_key, hist_scale=None) -> Tuple[TreeArrays, jax.Array]:
         """One tree via the configured tree learner (serial or a
         shard_map-distributed mode; reference CreateTreeLearner
-        tree_learner.cpp:15)."""
+        tree_learner.cpp:15).  ``hist_scale``: [2] (g, h) scales in
+        quantized-levels mode."""
         if self.parallel_mode is None:
             args = (self.bins, g, h, row_mask, self.num_bins_arr,
                     self.nan_bin_arr, self.is_cat_arr, feature_mask, self.hp)
@@ -559,10 +693,12 @@ class GBDT:
                 from ..learner.batch_grower import grow_tree_batched
                 return grow_tree_batched(
                     *args, batch=int(self.config.tpu_split_batch),
-                    bundle=self.bundle, monotone=self.monotone_arr)
+                    bundle=self.bundle, monotone=self.monotone_arr,
+                    hist_scale=hist_scale)
             kwargs = dict(monotone=self.monotone_arr, rng_key=node_key,
                           interaction_sets=self.interaction_sets,
-                          forced=self.forced_splits, bundle=self.bundle)
+                          forced=self.forced_splits, bundle=self.bundle,
+                          hist_scale=hist_scale)
             if self.cegb is not None:
                 arrays, lor, self.cegb = grow_tree(*args, cegb=self.cegb,
                                                    **kwargs)
@@ -572,6 +708,8 @@ class GBDT:
             from ..parallel.feature_parallel import grow_tree_feature_parallel
             if feature_mask is not None and self._pad_cols:
                 feature_mask = jnp.pad(feature_mask, (0, self._pad_cols))
+            # quantized levels rejected at construction (__init__ fatal);
+            # hist_scale is always None on this path
             arrays, lor = grow_tree_feature_parallel(
                 self.mesh, self.bins, g, h, row_mask, self.num_bins_arr,
                 self.nan_bin_arr, self.is_cat_arr, feature_mask, self.hp)
@@ -589,7 +727,7 @@ class GBDT:
                 self.mesh, self.bins, g, h, row_mask, self.num_bins_arr,
                 self.nan_bin_arr, self.is_cat_arr, feature_mask, self.hp,
                 batch=int(self.config.tpu_split_batch), bundle=self.bundle,
-                monotone=self.monotone_arr)
+                monotone=self.monotone_arr, hist_scale=hist_scale)
             return arrays, (lor[:-p] if p else lor)
         arrays, lor = grow_tree_sharded(
             self.mesh, self.bins, g, h, row_mask, self.num_bins_arr,
@@ -597,7 +735,7 @@ class GBDT:
             bundle=self.bundle, parallel_mode=self.parallel_mode,
             top_k=int(self.config.top_k), monotone=self.monotone_arr,
             rng_key=node_key, interaction_sets=self.interaction_sets,
-            forced=self.forced_splits)
+            forced=self.forced_splits, hist_scale=hist_scale)
         return arrays, (lor[:-p] if p else lor)
 
     def _use_batched_grower(self) -> bool:

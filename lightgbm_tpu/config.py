@@ -62,6 +62,17 @@ def _parse_str_list(v: Any) -> List[str]:
 
 # (name, default, aliases, check) — check is (op, bound) pairs like the
 # reference's `// check = >0` annotations (config.h:202-253).
+# Parameters that BIND a constructed Dataset's binning and cannot change
+# afterwards (reference LGBM_DatasetUpdateParamChecking c_api.h:573 and the
+# python package's _compare_params_for_warning list).
+DATASET_BINDING_PARAMS = (
+    "max_bin", "max_bin_by_feature", "min_data_in_bin",
+    "bin_construct_sample_cnt", "enable_bundle", "linear_tree",
+    "data_random_seed", "is_enable_sparse", "feature_pre_filter",
+    "use_missing", "zero_as_missing", "categorical_feature",
+    "forcedbins_filename", "precise_float_parser",
+)
+
 _PARAMS: List[Tuple[str, Any, Tuple[str, ...], Tuple[Tuple[str, float], ...]]] = [
     # --- core (config.h "Core Parameters") ---
     ("task", "train", ("task_type",), ()),
@@ -69,8 +80,9 @@ _PARAMS: List[Tuple[str, Any, Tuple[str, ...], Tuple[Tuple[str, float], ...]]] =
     ("input_model", "", ("model_input", "model_in"), ()),
     ("output_result", "LightGBM_predict_result.txt",
      ("predict_result", "prediction_result", "predict_name", "pred_name",
-      "name_pred"), ()),
+      "name_pred", "prediction_name"), ()),
     ("saved_feature_importance_type", 0, (), ()),
+    ("config", "", ("config_file",), ()),
     ("objective", "regression", ("objective_type", "app", "application", "loss"), ()),
     ("boosting", "gbdt", ("boosting_type", "boost"), ()),
     ("data_sample_strategy", "bagging", (), ()),

@@ -53,7 +53,8 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                       bundle: Optional[DeviceBundle] = None,
                       monotone: Optional[jax.Array] = None,
                       axis_name: Optional[str] = None,
-                      warmup: bool = True
+                      warmup: bool = True,
+                      hist_scale: Optional[jax.Array] = None
                       ) -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree with ``batch`` splits per histogram pass.
 
@@ -70,6 +71,15 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         else row_mask.astype(grad.dtype)
     bins_t = lax.optimization_barrier(bins.T)
     INF = jnp.float32(_INF_BOUND)
+    # one [n, F+8] u8 payload (bins row + grad + hess) for the grouped
+    # compaction path's single-gather — tree-invariant, built once
+    packed_rows = None
+    if hp.grouped_hist:
+        packed_rows = lax.optimization_barrier(jnp.concatenate([
+            bins,
+            lax.bitcast_convert_type(grad, jnp.uint8),
+            lax.bitcast_convert_type(hess, jnp.uint8),
+        ], axis=1))
 
     def child_best(h_phys, g_, h_, c_, depth, lmin, lmax):
         hv = h_phys if bundle is None else \
@@ -80,13 +90,27 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         depth_ok = (hp.max_depth <= 0) | (depth < hp.max_depth)
         return res._replace(gain=jnp.where(depth_ok, res.gain, NEG_INF))
 
-    hist0_b = root_histogram(bins_t, grad, hess, row_mask,
-                             n_bins=hp.n_bins,
-                             rows_per_block=hp.rows_per_block,
-                             hist_dtype=hp.hist_dtype, axis_name=axis_name)
+    # quantized-levels mode (ops/quantize.py): grad/hess hold integer
+    # levels; one deterministic multiply restores real units right after
+    # each exact integer histogram accumulation
+    scale_vec = None
+    if hist_scale is not None:
+        scale_vec = jnp.concatenate(
+            [hist_scale.astype(jnp.float32), jnp.ones((2,), jnp.float32)])
+
+    def _scaled(h):
+        return h if scale_vec is None else h * scale_vec
+
+    hist0_b = _scaled(root_histogram(
+        bins_t, grad, hess, row_mask, n_bins=hp.n_bins,
+        rows_per_block=hp.rows_per_block,
+        hist_dtype=hp.hist_dtype, axis_name=axis_name))
     g0 = jnp.sum(grad * mask_f)
     h0 = jnp.sum(hess * mask_f)
     c0 = jnp.sum(mask_f)
+    if hist_scale is not None:
+        g0 = g0 * hist_scale[0]
+        h0 = h0 * hist_scale[1]
     if axis_name is not None:
         g0 = lax.psum(g0, axis_name)
         h0 = lax.psum(h0, axis_name)
@@ -102,11 +126,23 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         leaf_weight=tree.leaf_weight.at[0].set(h0))
     C = hist0_b.shape[-1]
     n_cols = bins.shape[1]
+    # bounded histogram pool (SplitHyper.hist_pool_slots): P slots + one
+    # trash row; leaf_slot/slot_leaf carry the mapping, with trash entries
+    # at index L / P so masked scatters need no branches
+    pooled = 0 < hp.hist_pool_slots < L
+    P = hp.hist_pool_slots
+    if pooled:
+        assert not hp.has_categorical, \
+            "hist_pool_slots does not compose with categorical splits yet"
+        assert P >= 3 * K + 2, \
+            "hist_pool_slots must be >= 3*batch+2 for worst-case rounds"
     state = dict(
         tree=tree,
         leaf_of_row=jnp.zeros((n,), jnp.int32),
-        hist=jnp.zeros((L, n_cols, hp.n_bins, C),
-                       jnp.float32).at[0].set(hist0_b),
+        hist=(jnp.zeros((P + 1, n_cols, hp.n_bins, C), jnp.float32)
+              .at[0].set(hist0_b) if pooled else
+              jnp.zeros((L, n_cols, hp.n_bins, C),
+                        jnp.float32).at[0].set(hist0_b)),
         sum_g=jnp.zeros((L,), jnp.float32).at[0].set(g0),
         sum_h=jnp.zeros((L,), jnp.float32).at[0].set(h0),
         count=jnp.zeros((L,), jnp.float32).at[0].set(c0),
@@ -125,6 +161,9 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         n_splits=jnp.int32(0),
         progress=jnp.bool_(True),
     )
+    if pooled:
+        state["leaf_slot"] = jnp.full((L + 1,), -1, jnp.int32).at[0].set(0)
+        state["slot_leaf"] = jnp.full((P + 1,), -1, jnp.int32).at[0].set(0)
 
     def make_round_body(Kr):
       def round_body(st):
@@ -276,22 +315,93 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
               l_cnt = st["count"][parents]
               r_cnt = st["count"][safe_nl]
               smaller = jnp.where(l_cnt <= r_cnt, parents, safe_nl)
-              h_small = histogram_for_leaves_auto(
-                  bins, bins_t, grad, hess, lor, smaller, row_mask,
-                  n_bins=hp.n_bins, rows_per_block=hp.rows_per_block,
-                  hist_dtype=hp.hist_dtype, axis_name=axis_name,
-                  grouped=hp.grouped_hist)                            # [K,Fb,B,C]
-              h_parent = st["hist"][parents]
-              h_large = h_parent - h_small
+              # masked row count of each smaller child (0 for invalid
+              # slots) — lets the grouped path skip its O(K*n) rank and
+              # count reductions (histogram_for_leaves_auto fast path)
+              small_cnt = jnp.where(valid, jnp.minimum(l_cnt, r_cnt), 0.0)
+
+              def hist_call(lv, cnts):
+                  return _scaled(histogram_for_leaves_auto(
+                      bins, bins_t, grad, hess, lor, lv, row_mask,
+                      n_bins=hp.n_bins, rows_per_block=hp.rows_per_block,
+                      hist_dtype=hp.hist_dtype, axis_name=axis_name,
+                      grouped=hp.grouped_hist, counts=cnts,
+                      packed_rows=packed_rows))
+
               left_small = (l_cnt <= r_cnt)[:, None, None, None]
-              h_left = jnp.where(left_small, h_small, h_large)
-              h_right = jnp.where(left_small, h_large, h_small)
-              hist = st["hist"]
-              hist = hist.at[parents].set(jnp.where(valid[:, None, None, None],
-                                                    h_left, hist[parents]))
-              hist = hist.at[safe_nl].set(jnp.where(valid[:, None, None, None],
-                                                    h_right, hist[safe_nl]))
-              st["hist"] = hist
+              if not pooled:
+                  h_small = hist_call(smaller, small_cnt)      # [K,Fb,B,C]
+                  h_parent = st["hist"][parents]
+                  h_large = h_parent - h_small
+                  h_left = jnp.where(left_small, h_small, h_large)
+                  h_right = jnp.where(left_small, h_large, h_small)
+                  hist = st["hist"]
+                  hist = hist.at[parents].set(
+                      jnp.where(valid[:, None, None, None], h_left,
+                                hist[parents]))
+                  hist = hist.at[safe_nl].set(
+                      jnp.where(valid[:, None, None, None], h_right,
+                                hist[safe_nl]))
+                  st["hist"] = hist
+              else:
+                  # -- bounded pool: parents with an evicted histogram get
+                  # BOTH children computed directly (no subtraction);
+                  # the widened pass carries K smaller + up-to-K larger
+                  p_slot = st["leaf_slot"][parents]            # [K]
+                  present = (p_slot >= 0) & valid
+                  larger = jnp.where(l_cnt <= r_cnt, safe_nl, parents)
+                  need_direct = valid & ~present
+                  large_cnt = jnp.where(need_direct,
+                                        jnp.maximum(l_cnt, r_cnt), 0.0)
+                  leaves_ext = jnp.concatenate(
+                      [smaller, jnp.where(need_direct, larger, L - 1)])
+                  h_ext = hist_call(leaves_ext,
+                                    jnp.concatenate([small_cnt, large_cnt]))
+                  h_small = h_ext[:Kr]
+                  h_parent = st["hist"][jnp.maximum(p_slot, 0)]
+                  h_large = jnp.where(present[:, None, None, None],
+                                      h_parent - h_small, h_ext[Kr:])
+                  h_left = jnp.where(left_small, h_small, h_large)
+                  h_right = jnp.where(left_small, h_large, h_small)
+
+                  # -- slot allocation: free slots first, then evict the
+                  # lowest-cached-gain occupants; this round's parent
+                  # slots are locked (they become the left children's)
+                  slot_leaf = st["slot_leaf"]                  # [P+1]
+                  leaf_slot = st["leaf_slot"]                  # [L+1]
+                  locked = jnp.zeros((P + 1,), bool).at[
+                      jnp.where(present, p_slot, P)].set(True)[:P]
+                  occ = slot_leaf[:P]
+                  occ_gain = jnp.where(occ >= 0,
+                                       st["best_gain"][jnp.maximum(occ, 0)],
+                                       -jnp.inf)
+                  order = jnp.argsort(
+                      jnp.where(locked, jnp.inf, occ_gain))    # [P]
+                  req = jnp.concatenate([need_direct, valid])  # [2K]
+                  pos = jnp.cumsum(req.astype(jnp.int32)) - 1
+                  alloc = jnp.where(req, order[jnp.clip(pos, 0, P - 1)], P)
+                  # evict old occupants of granted slots
+                  evicted = jnp.where(alloc < P,
+                                      slot_leaf[jnp.minimum(alloc, P)], -1)
+                  leaf_slot = leaf_slot.at[
+                      jnp.where(evicted >= 0, evicted, L)].set(-1)
+                  slot_l = jnp.where(present, p_slot, alloc[:Kr])
+                  slot_r = alloc[Kr:]
+                  tgt_l = jnp.where(valid, slot_l, P)
+                  tgt_r = jnp.where(valid, slot_r, P)
+                  hist = st["hist"].at[tgt_l].set(h_left)
+                  hist = hist.at[tgt_r].set(h_right)
+                  st["hist"] = hist
+                  slot_leaf = slot_leaf.at[tgt_l].set(
+                      jnp.where(valid, parents, -1))
+                  slot_leaf = slot_leaf.at[tgt_r].set(
+                      jnp.where(valid, safe_nl, -1))
+                  leaf_slot = leaf_slot.at[
+                      jnp.where(valid, parents, L)].set(slot_l)
+                  leaf_slot = leaf_slot.at[
+                      jnp.where(valid, safe_nl, L)].set(slot_r)
+                  st["slot_leaf"] = slot_leaf.at[P].set(-1)
+                  st["leaf_slot"] = leaf_slot.at[L].set(-1)
 
           # ---- child best splits, vmapped over the 2K children
           with jax.named_scope("find_splits"):

@@ -209,7 +209,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
               bundle: Optional[DeviceBundle] = None,
               parallel_mode: str = "data", top_k: int = 20,
               num_shards: int = 1,
-              cegb: Optional[CegbInput] = None):
+              cegb: Optional[CegbInput] = None,
+              hist_scale: Optional[jax.Array] = None):
     """Grow one tree; returns (TreeArrays, leaf_of_row).
 
     bins: uint8 [n, F]; grad/hess: f32 [n]; row_mask: bool [n] or None
@@ -305,12 +306,27 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     # rematerializes the 28-byte-strided transpose inside every split
     # iteration (measured 2.5x on the whole tree loop)
     bins_t = lax.optimization_barrier(bins.T)
-    hist0_b = root_histogram(bins_t, grad, hess, row_mask, n_bins=hp.n_bins,
-                             rows_per_block=hp.rows_per_block,
-                             hist_dtype=hp.hist_dtype, axis_name=hist_axis)
+    # quantized-levels mode (ops/quantize.py): grad/hess hold integer
+    # levels; one deterministic multiply restores real units right after
+    # each exact integer histogram accumulation
+    scale_vec = None
+    if hist_scale is not None:
+        scale_vec = jnp.concatenate(
+            [hist_scale.astype(jnp.float32), jnp.ones((2,), jnp.float32)])
+
+    def _scaled(h):
+        return h if scale_vec is None else h * scale_vec
+
+    hist0_b = _scaled(root_histogram(
+        bins_t, grad, hess, row_mask, n_bins=hp.n_bins,
+        rows_per_block=hp.rows_per_block,
+        hist_dtype=hp.hist_dtype, axis_name=hist_axis))
     g0 = jnp.sum(grad * mask_f)
     h0 = jnp.sum(hess * mask_f)
     c0 = jnp.sum(mask_f)
+    if hist_scale is not None:
+        g0 = g0 * hist_scale[0]
+        h0 = h0 * hist_scale[1]
     if axis_name is not None and mode != "feature":
         # feature mode holds ALL rows on every shard: sums already global
         g0 = lax.psum(g0, axis_name)
@@ -674,6 +690,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                     jnp.minimum(lcn, rcn), row_mask,
                     n_bins=hp.n_bins, rows_per_block=hp.rows_per_block,
                     hist_dtype=hp.hist_dtype, axis_name=hist_axis)
+            h_small = _scaled(h_small)
             h_parent = st.hist[bl]
             h_large = h_parent - h_small
             left_small = lcn <= rcn

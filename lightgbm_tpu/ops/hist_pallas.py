@@ -264,6 +264,292 @@ def histogram_leaves_rows_pallas(bins_rows, grad, hess, leaf_of_row, leaves,
                                   rows_major=True, **kw)
 
 
+def _radix_shapes(n_bins: int, p: int):
+    """Radix split of the bin axis: bin = hi * nlo + lo with nlo = 16.
+
+    Valid only when ``n_bins`` is a multiple of 16 (the production 256-bin
+    layout); callers fall back to the flat kernels otherwise.
+    """
+    nlo = 16
+    nhi = n_bins // nlo
+    return nhi, nlo, p * nhi, 3 * p * nlo
+
+
+def _radix_chunk_accum(chunk_i32, vals3, *, nhi, nlo, p, blk, compute_dtype,
+                       prec):
+    """One radix feature-chunk contraction: [p*nhi, 3*p*nlo] f32.
+
+    The 256-wide one-hot of the flat kernel costs ~2 VPU ops per
+    (feature, bin, row) element; splitting bin = 16*hi + lo builds two
+    16-wide one-hots instead (32 elements per feature-row instead of 256)
+    and recovers the joint histogram as an outer product ridden by one
+    MXU contraction per chunk:
+
+        acc[(f, hi), (c, f', lo)] = sum_r hi_oh[f,hi,r] * vals[c,r] * lo_oh[f',lo,r]
+
+    Only the f == f' diagonal blocks are kept (callers extract them); the
+    off-diagonal waste buys full 128-wide MXU tiles, which measured ~1.7x
+    faster than both the flat kernel and per-feature small matmuls
+    (docs/PERF_NOTES.md round-3 table).
+    """
+    hi = chunk_i32 >> 4                                     # [p, blk]
+    lo = chunk_i32 & 15
+    iota_h = lax.iota(jnp.int32, nhi)
+    iota_l = lax.iota(jnp.int32, nlo)
+    hi_oh = (hi[:, None, :] == iota_h[None, :, None]
+             ).astype(compute_dtype).reshape(p * nhi, blk)
+    lo_oh = (lo[:, None, :] == iota_l[None, :, None]
+             ).astype(compute_dtype).reshape(p * nlo, blk)
+    vlo = jnp.concatenate([lo_oh * vals3[0][None, :],
+                           lo_oh * vals3[1][None, :],
+                           lo_oh * vals3[2][None, :]], axis=0)
+    return lax.dot_general(hi_oh, vlo, (((1,), (1,)), ((), ())),
+                           preferred_element_type=jnp.float32,
+                           precision=prec)                  # [p*nhi, 3*p*nlo]
+
+
+def _radix_unpack(out: jax.Array, *, n_groups, num_f, f_pad, p, nhi, nlo,
+                  n_bins):
+    """[G, p*nhi, nch*3*p*nlo] -> [G, F, n_bins, 4] diagonal extraction."""
+    nch = f_pad // p
+    out = out.reshape(n_groups, p, nhi, nch, 3, p, nlo)
+    idx = jnp.arange(p)
+    # diag p_lhs == p_rhs -> leading axis p (vmapped-gather semantics)
+    out = out[:, idx, :, :, :, idx]          # [p, G, nhi, nch, 3, nlo]
+    out = out.transpose(1, 3, 0, 2, 5, 4)    # [G, nch, p, nhi, nlo, 3]
+    out = out.reshape(n_groups, f_pad, n_bins, 3)[:, :num_f]
+    return jnp.pad(out, ((0, 0), (0, 0), (0, 0), (0, 1)))
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n_bins", "rows_per_block", "p",
+                                    "compute_dtype", "interpret"))
+def histogram_radix_single_pallas(bins_t: jax.Array, grad: jax.Array,
+                                  hess: jax.Array, lor: jax.Array, *,
+                                  n_bins: int, rows_per_block: int = 2048,
+                                  p: int = 4, compute_dtype=jnp.bfloat16,
+                                  interpret: bool = False) -> jax.Array:
+    """Single-group full-data radix histogram: f32 [F, n_bins, 4].
+
+    The root-pass kernel (reference cuda_histogram_constructor.cu:18 builds
+    the root the same way it builds leaves; here the root gets the cheaper
+    radix formulation since it has no grouping to steer).  ``lor`` < 0
+    excludes a row (bagging mask); all other rows contribute.
+    """
+    num_f, n = bins_t.shape
+    nhi, nlo, M, NW = _radix_shapes(n_bins, p)
+    blk = min(rows_per_block, max(128, _round_up(n, 128)))
+    n_pad = _round_up(max(n, 1), blk)
+    if n_pad != n:
+        bins_t = jnp.pad(bins_t, ((0, 0), (0, n_pad - n)))
+        grad = jnp.pad(grad, (0, n_pad - n))
+        hess = jnp.pad(hess, (0, n_pad - n))
+        lor = jnp.pad(lor, (0, n_pad - n), constant_values=-1)
+    f_pad = _round_up(num_f, p)
+    if f_pad != num_f:
+        bins_t = jnp.pad(bins_t, ((0, f_pad - num_f), (0, 0)))
+    nch = f_pad // p
+    nb = n_pad // blk
+    prec = _prec(compute_dtype)
+
+    def kernel(bins_ref, g_ref, h_ref, lor_ref, out_ref):
+        step = pl.program_id(0)
+
+        @pl.when(step == 0)
+        def _():
+            out_ref[:] = jnp.zeros_like(out_ref)
+
+        valid = lor_ref[0, :] >= 0
+        gm = jnp.where(valid, g_ref[0, :], 0.0).astype(compute_dtype)
+        hm = jnp.where(valid, h_ref[0, :], 0.0).astype(compute_dtype)
+        mm = jnp.where(valid, 1.0, 0.0).astype(compute_dtype)
+        b_blk = bins_ref[:].astype(jnp.int32)
+        for c0 in range(nch):
+            acc = _radix_chunk_accum(
+                b_blk[c0 * p:(c0 + 1) * p], (gm, hm, mm), nhi=nhi, nlo=nlo,
+                p=p, blk=blk, compute_dtype=compute_dtype, prec=prec)
+            out_ref[:, c0 * NW:(c0 + 1) * NW] += acc
+
+    out = pl.pallas_call(
+        kernel, grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((f_pad, blk), lambda i: (0, i)),
+            pl.BlockSpec((1, blk), lambda i: (0, i)),
+            pl.BlockSpec((1, blk), lambda i: (0, i)),
+            pl.BlockSpec((1, blk), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((M, nch * NW), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((M, nch * NW), jnp.float32),
+        interpret=interpret,
+    )(bins_t, grad[None, :], hess[None, :], lor[None, :])
+    return _radix_unpack(out[None], n_groups=1, num_f=num_f, f_pad=f_pad,
+                         p=p, nhi=nhi, nlo=nlo, n_bins=n_bins)[0]
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n_bins", "rows_per_block", "p",
+                                    "compute_dtype", "interpret"))
+def histogram_radix_joint_pallas(bins_t: jax.Array, grad: jax.Array,
+                                 hess: jax.Array, lor: jax.Array,
+                                 leaves: jax.Array, *, n_bins: int,
+                                 rows_per_block: int = 2048, p: int = 4,
+                                 compute_dtype=jnp.bfloat16,
+                                 interpret: bool = False) -> jax.Array:
+    """Masked MULTI-leaf radix histogram: f32 [G, F, n_bins, 4], full-data
+    pass, no compaction.
+
+    The leaf dimension rides the matmul M side as a joint (leaf, hi)
+    one-hot — lhs rows = G*p*nhi — while the rhs keeps the 3 value
+    channels.  Profitable while G*p*nhi stays within a few MXU tiles
+    (warmup rounds, G <= ~16); beyond that the flat masked kernel's
+    K-independent cost wins.  ``leaves`` i32 [G]; duplicate slots receive
+    identical histogram copies (same as the flat masked kernel).
+    """
+    num_f, n = bins_t.shape
+    G = leaves.shape[0]
+    nhi, nlo, M1, NW = _radix_shapes(n_bins, p)
+    M = G * M1
+    blk = min(rows_per_block, max(128, _round_up(n, 128)))
+    n_pad = _round_up(max(n, 1), blk)
+    if n_pad != n:
+        bins_t = jnp.pad(bins_t, ((0, 0), (0, n_pad - n)))
+        grad = jnp.pad(grad, (0, n_pad - n))
+        hess = jnp.pad(hess, (0, n_pad - n))
+        lor = jnp.pad(lor, (0, n_pad - n), constant_values=-1)
+    f_pad = _round_up(num_f, p)
+    if f_pad != num_f:
+        bins_t = jnp.pad(bins_t, ((0, f_pad - num_f), (0, 0)))
+    nch = f_pad // p
+    nb = n_pad // blk
+    prec = _prec(compute_dtype)
+
+    def kernel(bins_ref, g_ref, h_ref, lor_ref, leaves_ref, out_ref):
+        step = pl.program_id(0)
+
+        @pl.when(step == 0)
+        def _():
+            out_ref[:] = jnp.zeros_like(out_ref)
+
+        lor_b = lor_ref[0, :]
+        lv = leaves_ref[0, :]
+        eq = lor_b[None, :] == lv[:, None]                  # [G, blk]
+        goh = eq.astype(compute_dtype)                      # [G, blk]
+        sel = jnp.any(eq, axis=0)
+        gm = jnp.where(sel, g_ref[0, :], 0.0).astype(compute_dtype)
+        hm = jnp.where(sel, h_ref[0, :], 0.0).astype(compute_dtype)
+        mm = jnp.where(sel, 1.0, 0.0).astype(compute_dtype)
+        b_blk = bins_ref[:].astype(jnp.int32)
+        iota_h = lax.iota(jnp.int32, nhi)
+        iota_l = lax.iota(jnp.int32, nlo)
+        for c0 in range(nch):
+            chunk = b_blk[c0 * p:(c0 + 1) * p]
+            hi_oh = ((chunk >> 4)[:, None, :] == iota_h[None, :, None]
+                     ).astype(compute_dtype)                # [p, nhi, blk]
+            lo_oh = ((chunk & 15)[:, None, :] == iota_l[None, :, None]
+                     ).astype(compute_dtype).reshape(p * nlo, blk)
+            joint = (goh[:, None, None, :] * hi_oh[None, :, :, :]
+                     ).reshape(M, blk)                      # [(G,p,hi), blk]
+            vlo = jnp.concatenate([lo_oh * gm[None, :],
+                                   lo_oh * hm[None, :],
+                                   lo_oh * mm[None, :]], axis=0)
+            acc = lax.dot_general(joint, vlo, (((1,), (1,)), ((), ())),
+                                  preferred_element_type=jnp.float32,
+                                  precision=prec)           # [M, NW]
+            out_ref[:, c0 * NW:(c0 + 1) * NW] += acc
+
+    out = pl.pallas_call(
+        kernel, grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((f_pad, blk), lambda i: (0, i)),
+            pl.BlockSpec((1, blk), lambda i: (0, i)),
+            pl.BlockSpec((1, blk), lambda i: (0, i)),
+            pl.BlockSpec((1, blk), lambda i: (0, i)),
+            pl.BlockSpec((1, G), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((M, nch * NW), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((M, nch * NW), jnp.float32),
+        interpret=interpret,
+    )(bins_t, grad[None, :], hess[None, :], lor[None, :], leaves[None, :])
+    # rows (G, p_l, nhi); cols (nch, 3c, p_r, nlo)
+    out = out.reshape(G, M1, nch * NW)
+    return _radix_unpack(out, n_groups=G, num_f=num_f, f_pad=f_pad, p=p,
+                         nhi=nhi, nlo=nlo, n_bins=n_bins)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n_groups", "n_bins", "rows_per_block",
+                                    "p", "compute_dtype", "interpret"))
+def histogram_radix_grouped_pallas(rows_c: jax.Array, grad_c: jax.Array,
+                                   hess_c: jax.Array, valid_c: jax.Array,
+                                   block_group: jax.Array, n_groups: int, *,
+                                   n_bins: int, rows_per_block: int = 1024,
+                                   p: int = 4, compute_dtype=jnp.bfloat16,
+                                   interpret: bool = False) -> jax.Array:
+    """Leaf-grouped radix histogram: f32 [K, F, n_bins, 4] from rows
+    physically sorted by group (each group padded to whole blocks).
+
+    Same contract as the flat grouped kernel it replaces: ``block_group``
+    [Sp/blk] nondecreasing steers each block's accumulation into its
+    group's output tile via scalar prefetch; rows of one block all belong
+    to that group (pad rows carry valid 0).
+    """
+    Sp, num_f = rows_c.shape
+    blk = rows_per_block
+    assert Sp % blk == 0, "caller pads groups to whole blocks"
+    nhi, nlo, M, NW = _radix_shapes(n_bins, p)
+    f_pad = _round_up(num_f, p)
+    if f_pad != num_f:
+        rows_c = jnp.pad(rows_c, ((0, 0), (0, f_pad - num_f)))
+    nch = f_pad // p
+    nblk = Sp // blk
+    prec = _prec(compute_dtype)
+
+    def kernel(bg_ref, bins_ref, g_ref, h_ref, v_ref, out_ref):
+        i = pl.program_id(0)
+        fresh = jnp.where(i == 0, True,
+                          bg_ref[jnp.maximum(i - 1, 0)] != bg_ref[i])
+
+        @pl.when(fresh)
+        def _():
+            out_ref[:] = jnp.zeros_like(out_ref)
+
+        # caller contract (same as the flat grouped kernel): grad/hess of
+        # invalid rows are pre-zeroed, valid is the 0/1 count channel
+        gm = g_ref[0, :].astype(compute_dtype)
+        hm = h_ref[0, :].astype(compute_dtype)
+        mm = v_ref[0, :].astype(compute_dtype)
+        b_blk = bins_ref[:].astype(jnp.int32)               # [blk, f_pad]
+        for c0 in range(nch):
+            chunk = b_blk[:, c0 * p:(c0 + 1) * p].T          # [p, blk]
+            acc = _radix_chunk_accum(
+                chunk, (gm, hm, mm), nhi=nhi, nlo=nlo, p=p, blk=blk,
+                compute_dtype=compute_dtype, prec=prec)
+            out_ref[0, :, c0 * NW:(c0 + 1) * NW] += acc
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nblk,),
+        in_specs=[
+            pl.BlockSpec((blk, f_pad), lambda i, bg: (i, 0)),
+            pl.BlockSpec((1, blk), lambda i, bg: (0, i)),
+            pl.BlockSpec((1, blk), lambda i, bg: (0, i)),
+            pl.BlockSpec((1, blk), lambda i, bg: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((1, M, nch * NW),
+                               lambda i, bg: (bg[i], 0, 0)),
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n_groups, M, nch * NW),
+                                       jnp.float32),
+        interpret=interpret,
+    )(block_group, rows_c, grad_c[None, :], hess_c[None, :],
+      valid_c[None, :])
+    return _radix_unpack(out, n_groups=n_groups, num_f=num_f, f_pad=f_pad,
+                         p=p, nhi=nhi, nlo=nlo, n_bins=n_bins)
+
+
 def histogram_grouped_pallas(rows_c: jax.Array, grad_c: jax.Array,
                              hess_c: jax.Array, valid_c: jax.Array,
                              block_group: jax.Array, n_groups: int, *,

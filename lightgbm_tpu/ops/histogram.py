@@ -127,6 +127,16 @@ def build_histogram(bins: jax.Array, vals: jax.Array, *, n_bins: int = 256,
     return hist[:num_feat]
 
 
+def _radix_ok(n_bins: int) -> bool:
+    """The radix kernels decompose bin = 16*hi + lo (ops/hist_pallas.py
+    ``_radix_shapes``); any other bin width falls back to the flat kernel.
+    ``LGBMTPU_NO_RADIX=1`` disables them (perf A/B escape hatch)."""
+    import os
+    if os.environ.get("LGBMTPU_NO_RADIX"):
+        return False
+    return n_bins % 16 == 0 and n_bins >= 32
+
+
 def histogram_for_leaf_masked(bins_t: jax.Array, grad: jax.Array,
                               hess: jax.Array, leaf_of_row: jax.Array,
                               leaf: jax.Array,
@@ -135,11 +145,24 @@ def histogram_for_leaf_masked(bins_t: jax.Array, grad: jax.Array,
                               hist_dtype: str = "float32",
                               axis_name: Optional[str] = None) -> jax.Array:
     """Leaf histogram by masking: one full-data pass with non-leaf rows
-    zeroed.  O(n) per call but with NO compaction machinery — on TPU the
-    histogram kernel is one-hot-construction bound, so this flat cost beats
-    the gather path except for very small leaves (the nonzero compaction
-    itself costs a full O(n) cumsum+scatter, which is already ~the masked
-    pass).  ``bins_t`` is the TRANSPOSED [F, n] matrix."""
+    zeroed.  O(n) per call but with NO compaction machinery.  On TPU the
+    single-group radix kernel carries it (~1.7x the flat one-hot kernel,
+    docs/PERF_NOTES.md round 3); ``bins_t`` is the TRANSPOSED [F, n]
+    matrix."""
+    if use_pallas() and _radix_ok(n_bins):
+        from .hist_pallas import histogram_radix_single_pallas
+        lor = jnp.asarray(leaf_of_row, jnp.int32)
+        sel = lor == jnp.asarray(leaf, jnp.int32)
+        if row_mask is not None:
+            sel = sel & row_mask
+        lor1 = jnp.where(sel, 0, -1)
+        hist = histogram_radix_single_pallas(
+            bins_t, grad, hess, lor1, n_bins=n_bins,
+            rows_per_block=min(rows_per_block, 2048),
+            compute_dtype=jnp.dtype(hist_dtype).type)
+        if axis_name is not None:
+            hist = lax.psum(hist, axis_name)
+        return hist
     leaf_arr = jnp.asarray(leaf, jnp.int32).reshape(1)
     hist = histogram_for_leaves_masked(
         bins_t, grad, hess, leaf_of_row, leaf_arr, row_mask, n_bins=n_bins,
@@ -171,6 +194,18 @@ def histogram_for_leaves_masked(bins_t: jax.Array, grad: jax.Array,
     lor = jnp.asarray(leaf_of_row, jnp.int32)
     if row_mask is not None:
         lor = jnp.where(row_mask, lor, -1)
+    if use_pallas() and _radix_ok(n_bins) and K <= 4:
+        # joint (leaf, hi) radix kernel: measured 4.0/5.0/7.5 ms per 1M-row
+        # pass at K=1/2/4 vs the flat kernel's K-independent ~9.8
+        # (docs/PERF_NOTES.md round 3) — the warmup-round accelerator
+        from .hist_pallas import histogram_radix_joint_pallas
+        hist = histogram_radix_joint_pallas(
+            bins_t, grad, hess, lor, leaves, n_bins=n_bins,
+            rows_per_block=min(rows_per_block, 2048),
+            compute_dtype=jnp.dtype(hist_dtype).type)
+        if axis_name is not None:
+            hist = lax.psum(hist, axis_name)
+        return hist
     if use_pallas():
         from .hist_pallas import histogram_leaves_pallas
         hist = histogram_leaves_pallas(
@@ -246,7 +281,10 @@ def histogram_for_leaves_auto(bins_rows: jax.Array, bins_t: jax.Array,
                               hist_dtype: str = "float32",
                               axis_name: Optional[str] = None,
                               buckets=(4, 8, 16, 64),
-                              grouped: bool = False) -> jax.Array:
+                              grouped: bool = False,
+                              counts: Optional[jax.Array] = None,
+                              packed_rows: Optional[jax.Array] = None
+                              ) -> jax.Array:
     """K-leaf histograms with frontier compaction -> f32 [K, F, B, C].
 
     The TPU reformulation of the reference's O(smaller-child) histogram cost
@@ -259,6 +297,11 @@ def histogram_for_leaves_auto(bins_rows: jax.Array, bins_t: jax.Array,
     pass cannot do.  Exact: the same rows contribute either way.
 
     ``bins_rows``: u8 [n, F] row-major; ``bins_t``: u8 [F, n] transposed.
+
+    ``counts`` (f32 [K], optional): the caller's known masked row count per
+    leaf slot (0 for dummy slots).  It enables the efficient grouped path:
+    leaf ranks come from one fused compare-sum over the K slot ids and the
+    per-slot count reductions disappear from every round.
     """
     n = grad.shape[0]
     leaves = jnp.asarray(leaves, jnp.int32)
@@ -266,9 +309,6 @@ def histogram_for_leaves_auto(bins_rows: jax.Array, bins_t: jax.Array,
     lor = jnp.asarray(leaf_of_row, jnp.int32)
     if row_mask is not None:
         lor = jnp.where(row_mask, lor, -1)
-    eq = lor[None, :] == leaves[:, None]                      # [K, n]
-    sel = jnp.any(eq, axis=0)                                 # [n]
-    cnt = jnp.sum(sel.astype(jnp.int32))
     assert n < (1 << 30), "compaction packing needs n < 2^30 rows per shard"
     num_f = bins_rows.shape[1]
 
@@ -277,6 +317,16 @@ def histogram_for_leaves_auto(bins_rows: jax.Array, bins_t: jax.Array,
     # (rank, row) key cannot pack into the i32 sort
     use_grouped = grouped and (use_pallas() or _GROUPED_TEST_INTERPRET) \
         and n < (1 << (30 - rank_bits))
+    use_fast_grouped = use_grouped and counts is not None
+    if use_fast_grouped:
+        cnt = jnp.sum(counts).astype(jnp.int32)
+        # fast-path branches never read sel; cheap stand-in keeps the
+        # switch operand structure uniform
+        sel = lor >= 0
+    else:
+        eq = lor[None, :] == leaves[:, None]                  # [K, n]
+        sel = jnp.any(eq, axis=0)                             # [n]
+        cnt = jnp.sum(sel.astype(jnp.int32))
 
     blk = min(rows_per_block, 2048)
     kblk = min(1024, blk)
@@ -291,17 +341,85 @@ def histogram_for_leaves_auto(bins_rows: jax.Array, bins_t: jax.Array,
             bins_t, grad, hess, lor, leaves, None, n_bins=n_bins,
             rows_per_block=rows_per_block, hist_dtype=hist_dtype)
 
+    def _grouped_hist_call(rows_c, g_c, h_c, vf, bg, kblk_b):
+        """Backend-dispatched grouped kernel (radix when bins allow)."""
+        if _radix_ok(n_bins):
+            from .hist_pallas import histogram_radix_grouped_pallas
+            return histogram_radix_grouped_pallas(
+                rows_c, g_c, h_c, vf, bg, K, n_bins=n_bins,
+                rows_per_block=kblk_b,
+                compute_dtype=jnp.dtype(hist_dtype).type,
+                interpret=not use_pallas())
+        from .hist_pallas import histogram_grouped_pallas
+        return histogram_grouped_pallas(
+            rows_c, g_c, h_c, vf, bg, K, n_bins=n_bins,
+            rows_per_block=kblk_b,
+            compute_dtype=jnp.dtype(hist_dtype).type,
+            interpret=not use_pallas())
+
+    if use_fast_grouped:
+        # Rank of each row among the K leaf slots.  Valid slots hold
+        # DISTINCT leaves (the batch grower's children are distinct), so
+        # first-match == sum-of-matches; dummy slots (count 0) are remapped
+        # to an id no row carries.  XLA fuses the [K, n] compare-multiply
+        # into one pass over lor — measured ~6x cheaper than a one-hot
+        # table lookup per round (docs/PERF_NOTES.md round 3).
+        counts_i = counts.astype(jnp.int32)
+        slot = jnp.arange(K, dtype=jnp.int32)
+        leaves_eff = jnp.where(counts_i > 0, leaves, -2)
+        match = lor[None, :] == leaves_eff[:, None]           # [K, n]
+        rank = jnp.sum(jnp.where(match, slot[:, None], 0), axis=0)
+        rank = jnp.where(jnp.any(match, axis=0), rank, K)
+        row_bits = 30 - rank_bits
+        iota_n = lax.iota(jnp.int32, n)
+        key = (rank << row_bits) | iota_n
+        order_full = jnp.sort(key, stable=False)
+
+    def make_fast_branch(S: int):
+        def branch(operands):
+            _, grad_, hess_, _ = operands
+            if packed_rows is not None:
+                # payload built ONCE per tree by the caller (bins/grad/hess
+                # never change across rounds)
+                packed_ = packed_rows
+            else:
+                packed_ = jnp.concatenate([
+                    bins_rows,
+                    lax.bitcast_convert_type(grad_, jnp.uint8),
+                    lax.bitcast_convert_type(hess_, jnp.uint8),
+                ], axis=1)                                   # [n, F+8]
+            order = order_full[:S] & ((1 << row_bits) - 1)   # [S]
+            # block size balancing per-group padding (<= S/4 total) against
+            # kernel block overhead
+            kblk_b = max(128, min(2048, S // max(4 * K, 1) // 128 * 128))
+            s_pad = _round_up(S, kblk_b) + K * kblk_b
+            src_pos, valid_d, bg = _grouped_layout(
+                counts_i, n, s_pad, kblk_b, K)
+            src_row = order[jnp.minimum(src_pos, S - 1)]
+            pc = packed_[src_row]                            # [s_pad, F+8]
+            rows_c = pc[:, :num_f]
+            g_c = lax.bitcast_convert_type(
+                pc[:, num_f:num_f + 4], jnp.float32)
+            h_c = lax.bitcast_convert_type(
+                pc[:, num_f + 4:num_f + 8], jnp.float32)
+            vf = valid_d.astype(jnp.float32)
+            # where(), not multiply: a NaN gradient on a pad-clipped row
+            # must not poison sums
+            g_c = jnp.where(valid_d, g_c, 0.0)
+            h_c = jnp.where(valid_d, h_c, 0.0)
+            return _grouped_hist_call(rows_c, g_c, h_c, vf, bg, kblk_b)
+        return branch
+
     def make_branch(S: int):
+        if use_fast_grouped:
+            return make_fast_branch(S)
         if use_grouped:
             def branch(operands):
-                # leaf-GROUPED compaction (ops/hist_pallas.py
-                # histogram_grouped_pallas): sort by (leaf rank, row) so
+                # leaf-GROUPED compaction: sort by (leaf rank, row) so
                 # each leaf's rows are contiguous, pad groups to whole
                 # kernel blocks, and contract C=3 channels per block into
-                # a scalar-prefetch-steered output tile — no K-channel
-                # multiplier on the MXU.
+                # a scalar-prefetch-steered output tile.
                 sel_, grad_, hess_, lor_ = operands
-                from .hist_pallas import histogram_grouped_pallas
                 # rank/count work lives INSIDE the branch so full-pass
                 # rounds never pay the O(K*n) reductions
                 eq_ = lor_[None, :] == leaves[:, None]
@@ -339,11 +457,7 @@ def histogram_for_leaves_auto(bins_rows: jax.Array, bins_t: jax.Array,
                 # row must not poison sums
                 g_c = jnp.where(valid_d, g_c, 0.0)
                 h_c = jnp.where(valid_d, h_c, 0.0)
-                return histogram_grouped_pallas(
-                    rows_c, g_c, h_c, vf, bg, K, n_bins=n_bins,
-                    rows_per_block=kblk,
-                    compute_dtype=jnp.dtype(hist_dtype).type,
-                    interpret=not use_pallas())
+                return _grouped_hist_call(rows_c, g_c, h_c, vf, bg, kblk)
             return branch
 
         def branch(operands):
@@ -464,6 +578,7 @@ def root_histogram(bins_t: jax.Array, grad: jax.Array, hess: jax.Array,
                    axis_name: Optional[str] = None) -> jax.Array:
     """Root histogram from the TRANSPOSED [F, n] bin matrix."""
     if use_pallas():
+        # single-leaf delegation picks the radix kernel when bins allow
         lor = jnp.zeros(grad.shape, jnp.int32)
         return histogram_for_leaf_masked(
             bins_t, grad, hess, lor, jnp.int32(0), row_mask, n_bins=n_bins,

@@ -4,13 +4,23 @@ TPU-native re-design of the reference gradient discretizer (reference:
 src/treelearner/gradient_discretizer.cpp ``DiscretizeGradients`` — scales
 gradients to ``num_grad_quant_bins`` integer levels, grad to
 [-bins/2, bins/2] and hessian to [0, bins], with optional stochastic
-rounding; histograms then accumulate small integers).
+rounding; histograms then accumulate int16/int32 integers,
+feature_histogram.hpp:177 ``FindBestThresholdInt``).
 
-On TPU the quantized values are carried as "fake-quantized" f32
-(integer_level x scale): every histogram entry is a sum of exact
-level-multiples, so histogram construction and the parent-minus-child
-subtraction trick become numerically stable and bit-identical across device
-meshes — the property the reference buys with int16/int32 histogram bins.
+The TPU realization: gradients are carried as INTEGER LEVELS in f32.
+Small integers are exactly representable in bfloat16, so the fast bf16
+MXU histogram kernel (ops/hist_pallas.py) accumulates them EXACTLY — f32
+accumulation of integer sums is exact below 2^24 — and one deterministic
+scale multiply on the [K, F, B, 4] histogram restores real units.  This
+is the reference's int-accumulation design mapped to the MXU: the speed
+of the bf16 mode with bit-deterministic split sums across devices and
+meshes (the Mosaic ISA here legalizes no int8/int16 vector ops, so an
+integer-MXU path is not available; exact-bf16 achieves the same
+contract).  Exactness bound: n_rows * (num_grad_quant_bins/2) < 2^24,
+i.e. ~8.3M rows at the default 4 levels — beyond that, sums round at
+1 ulp f32 (the reference's int32 histograms overflow-guard similarly by
+bit-width selection, gradient_discretizer.hpp).
+
 ``quant_train_renew_leaf`` recomputes final leaf outputs from the TRUE
 gradients (reference ``RenewIntGradTreeOutput``).
 """
@@ -58,6 +68,40 @@ def discretize_gradients(grad: jax.Array, hess: jax.Array,
         gi = jnp.round(grad / g_scale)
         hi = jnp.round(hess / h_scale)
     return gi * g_scale, hi * h_scale
+
+
+@functools.partial(jax.jit, static_argnames=("n_levels", "stochastic",
+                                             "constant_hessian", "axis_name"))
+def discretize_gradients_levels(grad: jax.Array, hess: jax.Array,
+                                key: jax.Array, *, n_levels: int = 4,
+                                stochastic: bool = True,
+                                constant_hessian: bool = False,
+                                axis_name: Optional[str] = None):
+    """Quantize to INTEGER LEVELS (f32) plus per-tree scales.
+
+    Returns (g_levels, h_levels, g_scale, h_scale): g_levels in
+    [-n_levels/2, n_levels/2], h_levels in [0, n_levels] — exactly
+    representable in bfloat16, the property the exact-bf16 histogram path
+    relies on.  real_value ~= level * scale.
+    """
+    max_g = jnp.max(jnp.abs(grad))
+    max_h = jnp.max(jnp.abs(hess))
+    if axis_name is not None:
+        max_g = lax.pmax(max_g, axis_name)
+        max_h = lax.pmax(max_h, axis_name)
+    g_scale = jnp.maximum(max_g / (n_levels // 2), 1e-20)
+    h_scale = jnp.maximum(max_h if constant_hessian
+                          else max_h / n_levels, 1e-20)
+    kg, kh = jax.random.split(key)
+    if stochastic:
+        ug = jax.random.uniform(kg, grad.shape)
+        uh = jax.random.uniform(kh, hess.shape)
+        gi = jnp.floor(grad / g_scale + ug)
+        hi = jnp.floor(hess / h_scale + uh)
+    else:
+        gi = jnp.round(grad / g_scale)
+        hi = jnp.round(hess / h_scale)
+    return gi, hi, g_scale, h_scale
 
 
 @functools.partial(jax.jit, static_argnames=("num_leaves",))

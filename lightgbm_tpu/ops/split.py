@@ -84,9 +84,19 @@ class SplitHyper:
     leaf_hist: str = "masked"
     # leaf-GROUPED compacted histograms (ops/hist_pallas.py
     # histogram_grouped_pallas): rows sorted by leaf + scalar-prefetch
-    # steered accumulation, removing the 3K-channel MXU multiplier from
-    # compacted rounds.  Off by default until measured on hardware.
+    # steered accumulation.  Measured SLOWER than the plain bucket path on
+    # hardware in round 3 (the assumed K-channel MXU multiplier does not
+    # exist below 128 channels, so the grouped glue is pure overhead —
+    # docs/PERF_NOTES.md); kept for re-evaluation.
     grouped_hist: bool = False
+    # bounded histogram pool (reference feature_histogram.hpp:1367
+    # HistogramPool, serial_tree_learner.cpp:36-47 histogram_pool_size):
+    # 0 = one resident histogram per leaf ([L, F, B, 4]); > 0 = that many
+    # pool slots with lowest-cached-gain eviction — split parents whose
+    # histogram was evicted get BOTH children histogrammed directly
+    # (jit-friendly replacement for the reference's LRU + re-fetch).
+    # Batched grower only.
+    hist_pool_slots: int = 0
 
 
 #: candidate-variant indices along the last axis of the gain tensor

@@ -48,8 +48,8 @@ def grow_tree_sharded(mesh: Mesh, bins: jax.Array, grad: jax.Array,
                       hp: SplitHyper,
                       bundle=None, parallel_mode: str = "data",
                       top_k: int = 20, monotone=None, rng_key=None,
-                      interaction_sets=None,
-                      forced=None) -> Tuple[TreeArrays, jax.Array]:
+                      interaction_sets=None, forced=None,
+                      hist_scale=None) -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree with rows sharded over ``mesh``'s data axis.
 
     bins [n, F] uint8, grad/hess [n] — n must divide the mesh size (pad +
@@ -76,25 +76,26 @@ def grow_tree_sharded(mesh: Mesh, bins: jax.Array, grad: jax.Array,
         rep(rng_key),
         rep(interaction_sets),
         rep(forced),
+        rep(hist_scale),
     )
     out_specs = (
         jax.tree.map(lambda _: P(), TreeArrays(*[0] * len(TreeArrays._fields))),
         P(DATA_AXIS),                       # leaf_of_row
     )
 
-    def local(b, g, h, m, nb, nanb, cat, fm, bd, mono, key, isets, fsp):
+    def local(b, g, h, m, nb, nanb, cat, fm, bd, mono, key, isets, fsp, hs):
         return grow_tree(b, g, h, m, nb, nanb, cat, fm, hp,
                          axis_name=DATA_AXIS, bundle=bd, monotone=mono,
                          rng_key=key, interaction_sets=isets, forced=fsp,
                          parallel_mode=parallel_mode, top_k=top_k,
-                         num_shards=mesh.devices.size)
+                         num_shards=mesh.devices.size, hist_scale=hs)
 
     fn = shard_map(local, mesh=mesh,
                    in_specs=tuple(s for s in in_specs),
                    out_specs=out_specs, check_vma=False)
     return fn(bins, grad, hess, row_mask, num_bins, nan_bin, is_cat,
               feature_mask, bundle, monotone, rng_key, interaction_sets,
-              forced)
+              forced, hist_scale)
 
 
 def train_step_sharded(mesh: Mesh, bins: jax.Array, scores: jax.Array,
@@ -144,7 +145,8 @@ def grow_tree_batched_sharded(mesh: Mesh, bins: jax.Array, grad: jax.Array,
                               feature_mask: Optional[jax.Array],
                               hp: SplitHyper, batch: int,
                               bundle=None,
-                              monotone: Optional[jax.Array] = None
+                              monotone: Optional[jax.Array] = None,
+                              hist_scale: Optional[jax.Array] = None
                               ) -> Tuple[TreeArrays, jax.Array]:
     """Batched-round grower (learner/batch_grower.py) under the data mesh:
     K splits per psum-ed widened histogram pass."""
@@ -160,18 +162,19 @@ def grow_tree_batched_sharded(mesh: Mesh, bins: jax.Array, grad: jax.Array,
         P() if feature_mask is not None else None,
         rep(bundle),
         P() if monotone is not None else None,
+        P() if hist_scale is not None else None,
     )
     out_specs = (
         jax.tree.map(lambda _: P(), TreeArrays(*[0] * len(TreeArrays._fields))),
         P(DATA_AXIS),
     )
 
-    def local(b, g, h, m, nb, nanb, cat, fm, bd, mono):
+    def local(b, g, h, m, nb, nanb, cat, fm, bd, mono, hs):
         return grow_tree_batched(b, g, h, m, nb, nanb, cat, fm, hp,
                                  batch=batch, bundle=bd, monotone=mono,
-                                 axis_name=DATA_AXIS)
+                                 axis_name=DATA_AXIS, hist_scale=hs)
 
     fn = shard_map(local, mesh=mesh, in_specs=in_specs,
                    out_specs=out_specs, check_vma=False)
     return fn(bins, grad, hess, row_mask, num_bins, nan_bin, is_cat,
-              feature_mask, bundle, monotone)
+              feature_mask, bundle, monotone, hist_scale)

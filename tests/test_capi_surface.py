@@ -1,0 +1,463 @@
+"""Round-3 C ABI surface tests through ctypes (the reference's own C API
+smoke test tests/c_api_test/test_.py is ctypes-level too).  The compiled
+liblgbtpu_capi.so is the object under test — every call crosses the real
+C boundary."""
+
+import ctypes
+import json
+import os
+
+import numpy as np
+import pytest
+
+try:
+    from lightgbm_tpu.native import build_capi
+    CAPI = build_capi()
+except Exception:
+    CAPI = None
+
+pytestmark = pytest.mark.skipif(CAPI is None,
+                                reason="C API library unavailable")
+
+
+@pytest.fixture(scope="module")
+def lib():
+    lib = ctypes.CDLL(CAPI)
+    lib.LGBMTPU_GetLastError.restype = ctypes.c_char_p
+    return lib
+
+
+def _check(lib, rc):
+    assert rc == 0, lib.LGBMTPU_GetLastError().decode()
+
+
+@pytest.fixture(scope="module")
+def trained(lib):
+    """A small trained booster + its dataset, built through the ABI."""
+    rng = np.random.default_rng(0)
+    n, f = 600, 5
+    X = rng.normal(size=(n, f))
+    y = ((X[:, 0] + 0.5 * X[:, 1]) > 0).astype(np.float64)
+    ds = ctypes.c_int64()
+    params = json.dumps({"objective": "binary", "num_leaves": 15,
+                         "min_data_in_leaf": 5, "verbose": -1})
+    _check(lib, lib.LGBMTPU_DatasetCreateFromMat(
+        X.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.c_int64(n), ctypes.c_int64(f),
+        y.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        params.encode(), ctypes.byref(ds)))
+    bst = ctypes.c_int64()
+    _check(lib, lib.LGBMTPU_BoosterCreate(ds, params.encode(),
+                                          ctypes.byref(bst)))
+    fin = ctypes.c_int()
+    for _ in range(8):
+        _check(lib, lib.LGBMTPU_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+    return lib, ds, bst, X, y
+
+
+def test_predict_types_and_calc_num(trained):
+    lib, ds, bst, X, y = trained
+    n, f = X.shape
+    need = ctypes.c_int64()
+    # leaf index: nrow * k * n_iter
+    _check(lib, lib.LGBMTPU_BoosterCalcNumPredict(
+        bst, ctypes.c_int64(n), 2, 0, -1, ctypes.byref(need)))
+    assert need.value == n * 8
+    out = np.zeros(need.value)
+    out_len = ctypes.c_int64(need.value)
+    _check(lib, lib.LGBMTPU_BoosterPredictForMat2(
+        bst, X.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.c_int64(n), ctypes.c_int64(f), 2, 0, -1,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.byref(out_len)))
+    assert out_len.value == n * 8
+    assert (out >= 0).all() and (out == np.round(out)).all()
+    # contrib: nrow * (f + 1)
+    _check(lib, lib.LGBMTPU_BoosterCalcNumPredict(
+        bst, ctypes.c_int64(n), 3, 0, -1, ctypes.byref(need)))
+    assert need.value == n * (f + 1)
+    contrib = np.zeros(need.value)
+    out_len = ctypes.c_int64(need.value)
+    _check(lib, lib.LGBMTPU_BoosterPredictForMat2(
+        bst, X.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.c_int64(n), ctypes.c_int64(f), 3, 0, -1,
+        contrib.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.byref(out_len)))
+    # SHAP sums to the raw score
+    raw = np.zeros(n)
+    out_len = ctypes.c_int64(n)
+    _check(lib, lib.LGBMTPU_BoosterPredictForMat2(
+        bst, X.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.c_int64(n), ctypes.c_int64(f), 1, 0, -1,
+        raw.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.byref(out_len)))
+    np.testing.assert_allclose(contrib.reshape(n, f + 1).sum(axis=1), raw,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_predict_csr_csc_match_dense(trained):
+    lib, ds, bst, X, y = trained
+    from scipy.sparse import csc_matrix, csr_matrix
+    n, f = X.shape
+    dense = np.zeros(n)
+    out_len = ctypes.c_int64(n)
+    _check(lib, lib.LGBMTPU_BoosterPredictForMat2(
+        bst, X.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.c_int64(n), ctypes.c_int64(f), 0, 0, -1,
+        dense.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.byref(out_len)))
+    csr = csr_matrix(X)
+    indptr = csr.indptr.astype(np.int32)
+    indices = csr.indices.astype(np.int32)
+    vals = csr.data.astype(np.float64)
+    out = np.zeros(n)
+    out_len = ctypes.c_int64(n)
+    _check(lib, lib.LGBMTPU_BoosterPredictForCSR(
+        bst, indptr.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        vals.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.c_int64(len(indptr)), ctypes.c_int64(len(vals)),
+        ctypes.c_int64(f), 0, 0, -1,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.byref(out_len)))
+    np.testing.assert_allclose(out, dense, rtol=1e-12)
+    csc = csc_matrix(X)
+    colptr = csc.indptr.astype(np.int32)
+    cindices = csc.indices.astype(np.int32)
+    cvals = csc.data.astype(np.float64)
+    out2 = np.zeros(n)
+    out_len = ctypes.c_int64(n)
+    _check(lib, lib.LGBMTPU_BoosterPredictForCSC(
+        bst, colptr.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        cindices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        cvals.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.c_int64(len(colptr)), ctypes.c_int64(len(cvals)),
+        ctypes.c_int64(n), 0, 0, -1,
+        out2.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.byref(out_len)))
+    np.testing.assert_allclose(out2, dense, rtol=1e-12)
+    # single-row CSR variants (plain + fast path)
+    row = X[3]
+    nz = np.nonzero(row)[0].astype(np.int32)
+    one = np.zeros(1)
+    out_len = ctypes.c_int64(1)
+    _check(lib, lib.LGBMTPU_BoosterPredictForCSRSingleRow(
+        bst, nz.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        row[nz].ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.c_int64(len(nz)), ctypes.c_int64(f), 0, 0, -1,
+        one.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.byref(out_len)))
+    np.testing.assert_allclose(one[0], dense[3], rtol=1e-12)
+    fh = ctypes.c_int64()
+    _check(lib, lib.LGBMTPU_BoosterPredictForCSRSingleRowFastInit(
+        bst, ctypes.c_int64(f), 0, ctypes.byref(fh)))
+    out_len = ctypes.c_int64(1)
+    _check(lib, lib.LGBMTPU_BoosterPredictForCSRSingleRowFast(
+        fh, nz.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        row[nz].ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.c_int64(len(nz)),
+        one.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.byref(out_len)))
+    np.testing.assert_allclose(one[0], dense[3], rtol=1e-12)
+    _check(lib, lib.LGBMTPU_FastConfigFree(fh))
+
+
+def test_booster_introspection(trained):
+    lib, ds, bst, X, y = trained
+    v = ctypes.c_int()
+    _check(lib, lib.LGBMTPU_BoosterGetEvalCounts(bst, ctypes.byref(v)))
+    _check(lib, lib.LGBMTPU_BoosterNumModelPerIteration(bst,
+                                                       ctypes.byref(v)))
+    assert v.value == 1
+    _check(lib, lib.LGBMTPU_BoosterNumberOfTotalModel(bst, ctypes.byref(v)))
+    assert v.value == 8
+    lo = ctypes.c_double()
+    hi = ctypes.c_double()
+    _check(lib, lib.LGBMTPU_BoosterGetLowerBoundValue(bst, ctypes.byref(lo)))
+    _check(lib, lib.LGBMTPU_BoosterGetUpperBoundValue(bst, ctypes.byref(hi)))
+    assert lo.value < hi.value
+    lin = ctypes.c_int()
+    _check(lib, lib.LGBMTPU_BoosterGetLinear(bst, ctypes.byref(lin)))
+    assert lin.value == 0
+    lv = ctypes.c_double()
+    _check(lib, lib.LGBMTPU_BoosterGetLeafValue(bst, 0, 1, ctypes.byref(lv)))
+    # loaded params round-trip as JSON
+    need = ctypes.c_int64()
+    _check(lib, lib.LGBMTPU_BoosterGetLoadedParam(bst, None,
+                                                  ctypes.c_int64(0),
+                                                  ctypes.byref(need)))
+    buf = ctypes.create_string_buffer(need.value)
+    _check(lib, lib.LGBMTPU_BoosterGetLoadedParam(bst, buf, need,
+                                                  ctypes.byref(need)))
+    assert json.loads(buf.value.decode())["objective"] == "binary"
+    # dump model JSON
+    _check(lib, lib.LGBMTPU_BoosterDumpModel(bst, -1, None,
+                                             ctypes.c_int64(0),
+                                             ctypes.byref(need)))
+    buf = ctypes.create_string_buffer(need.value)
+    _check(lib, lib.LGBMTPU_BoosterDumpModel(bst, -1, buf, need,
+                                             ctypes.byref(need)))
+    dumped = json.loads(buf.value.decode())
+    assert len(dumped["tree_info"]) == 8
+    # feature importance
+    imp = np.zeros(X.shape[1])
+    out_len = ctypes.c_int64(X.shape[1])
+    _check(lib, lib.LGBMTPU_BoosterFeatureImportance(
+        bst, 0, imp.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.byref(out_len)))
+    assert imp.sum() > 0
+    # cached train predictions
+    npred = ctypes.c_int64()
+    _check(lib, lib.LGBMTPU_BoosterGetNumPredict(bst, 0,
+                                                 ctypes.byref(npred)))
+    assert npred.value == X.shape[0]
+    preds = np.zeros(npred.value)
+    out_len = ctypes.c_int64(npred.value)
+    _check(lib, lib.LGBMTPU_BoosterGetPredict(
+        bst, 0, preds.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.byref(out_len)))
+    acc = ((preds > 0.5) == (y > 0)).mean()
+    assert acc > 0.8
+
+
+def test_refit_and_leaf_edit(trained):
+    lib, ds, bst, X, y = trained
+    n, f = X.shape
+    # leaf matrix via predict type 2
+    need = ctypes.c_int64()
+    _check(lib, lib.LGBMTPU_BoosterCalcNumPredict(
+        bst, ctypes.c_int64(n), 2, 0, -1, ctypes.byref(need)))
+    leaves = np.zeros(need.value)
+    out_len = ctypes.c_int64(need.value)
+    _check(lib, lib.LGBMTPU_BoosterPredictForMat2(
+        bst, X.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.c_int64(n), ctypes.c_int64(f), 2, 0, -1,
+        leaves.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.byref(out_len)))
+    lp = leaves.reshape(n, -1).astype(np.int32)
+    _check(lib, lib.LGBMTPU_BoosterRefit(
+        bst, lp.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        ctypes.c_int64(n), ctypes.c_int64(lp.shape[1])))
+    # set a leaf and read it back
+    _check(lib, lib.LGBMTPU_BoosterSetLeafValue(bst, 0, 1,
+                                                ctypes.c_double(0.123)))
+    lv = ctypes.c_double()
+    _check(lib, lib.LGBMTPU_BoosterGetLeafValue(bst, 0, 1, ctypes.byref(lv)))
+    assert abs(lv.value - 0.123) < 1e-12
+
+
+def test_dataset_surface(lib, tmp_path):
+    rng = np.random.default_rng(1)
+    n, f = 300, 4
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] > 0).astype(np.float64)
+    ds = ctypes.c_int64()
+    params = json.dumps({"verbose": -1}).encode()
+    _check(lib, lib.LGBMTPU_DatasetCreateFromMat(
+        X.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.c_int64(n), ctypes.c_int64(f),
+        y.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        params, ctypes.byref(ds)))
+    # feature names set/get
+    names = json.dumps([f"feat_{i}" for i in range(f)]).encode()
+    _check(lib, lib.LGBMTPU_DatasetSetFeatureNames(ds, names))
+    need = ctypes.c_int64()
+    _check(lib, lib.LGBMTPU_DatasetGetFeatureNames(ds, None,
+                                                   ctypes.c_int64(0),
+                                                   ctypes.byref(need)))
+    buf = ctypes.create_string_buffer(need.value)
+    _check(lib, lib.LGBMTPU_DatasetGetFeatureNames(ds, buf, need,
+                                                   ctypes.byref(need)))
+    assert buf.value.decode().split("\n")[0] == "feat_0"
+    # num bins of feature 0
+    nb = ctypes.c_int64()
+    _check(lib, lib.LGBMTPU_DatasetGetFeatureNumBin(ds, 0, ctypes.byref(nb)))
+    assert nb.value > 10
+    # field get
+    lab = np.zeros(n)
+    out_len = ctypes.c_int64(n)
+    _check(lib, lib.LGBMTPU_DatasetGetField(
+        ds, b"label", lab.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.byref(out_len)))
+    np.testing.assert_array_equal(lab, y)
+    # subset
+    idx = np.arange(0, n, 2, dtype=np.int32)
+    sub = ctypes.c_int64()
+    _check(lib, lib.LGBMTPU_DatasetGetSubset(
+        ds, idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        ctypes.c_int64(len(idx)), params, ctypes.byref(sub)))
+    nd = ctypes.c_int64()
+    _check(lib, lib.LGBMTPU_DatasetGetNumData(sub, ctypes.byref(nd)))
+    assert nd.value == len(idx)
+    # save binary + create-from-file round trip
+    binpath = str(tmp_path / "ds.bin").encode()
+    _check(lib, lib.LGBMTPU_DatasetSaveBinary(ds, binpath))
+    ds2 = ctypes.c_int64()
+    _check(lib, lib.LGBMTPU_DatasetCreateFromFile(binpath, params,
+                                                  ctypes.byref(ds2)))
+    _check(lib, lib.LGBMTPU_DatasetGetNumData(ds2, ctypes.byref(nd)))
+    assert nd.value == n
+    # dump text
+    txtpath = str(tmp_path / "ds.txt").encode()
+    _check(lib, lib.LGBMTPU_DatasetDumpText(ds, txtpath))
+    assert os.path.getsize(txtpath.decode()) > 0
+    # param checking: changing max_bin after construction must fail
+    rc = lib.LGBMTPU_DatasetUpdateParamChecking(
+        json.dumps({"max_bin": 255}).encode(),
+        json.dumps({"max_bin": 63}).encode())
+    assert rc != 0
+    for h in (ds, sub, ds2):
+        _check(lib, lib.LGBMTPU_FreeHandle(h))
+
+
+def test_serialized_reference_stream(lib):
+    rng = np.random.default_rng(2)
+    n, f = 400, 3
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] > 0).astype(np.float64)
+    ds = ctypes.c_int64()
+    params = json.dumps({"verbose": -1}).encode()
+    _check(lib, lib.LGBMTPU_DatasetCreateFromMat(
+        X.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.c_int64(n), ctypes.c_int64(f),
+        y.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        params, ctypes.byref(ds)))
+    buf_h = ctypes.c_int64()
+    size = ctypes.c_int64()
+    _check(lib, lib.LGBMTPU_DatasetSerializeReferenceToBinary(
+        ds, ctypes.byref(buf_h), ctypes.byref(size)))
+    assert size.value > 10
+    raw = bytearray(size.value)
+    b = ctypes.c_uint8()
+    for i in range(size.value):
+        _check(lib, lib.LGBMTPU_ByteBufferGetAt(buf_h, ctypes.c_int64(i),
+                                                ctypes.byref(b)))
+        raw[i] = b.value
+    _check(lib, lib.LGBMTPU_ByteBufferFree(buf_h))
+    # rebuild a streaming dataset from the serialized reference and push
+    # rows WITH metadata
+    ds2 = ctypes.c_int64()
+    _check(lib, lib.LGBMTPU_DatasetCreateFromSerializedReference(
+        bytes(raw), ctypes.c_int64(len(raw)), ctypes.c_int64(n), params,
+        ctypes.byref(ds2)))
+    w = np.ones(n)
+    _check(lib, lib.LGBMTPU_DatasetPushRowsWithMetadata(
+        ds2, X.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.c_int64(n), ctypes.c_int64(f),
+        y.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        w.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), None, None))
+    _check(lib, lib.LGBMTPU_DatasetMarkFinished(ds2))
+    nd = ctypes.c_int64()
+    _check(lib, lib.LGBMTPU_DatasetGetNumData(ds2, ctypes.byref(nd)))
+    assert nd.value == n
+    for h in (ds, ds2):
+        _check(lib, lib.LGBMTPU_FreeHandle(h))
+
+
+def test_misc_surface(lib):
+    # param aliases
+    need = ctypes.c_int64()
+    _check(lib, lib.LGBMTPU_DumpParamAliases(None, ctypes.c_int64(0),
+                                             ctypes.byref(need)))
+    buf = ctypes.create_string_buffer(need.value)
+    _check(lib, lib.LGBMTPU_DumpParamAliases(buf, need, ctypes.byref(need)))
+    aliases = json.loads(buf.value.decode())
+    assert "num_iterations" in aliases
+    # max threads round trip
+    _check(lib, lib.LGBMTPU_SetMaxThreads(7))
+    v = ctypes.c_int()
+    _check(lib, lib.LGBMTPU_GetMaxThreads(ctypes.byref(v)))
+    assert v.value == 7
+    # sampling
+    cnt = ctypes.c_int64()
+    _check(lib, lib.LGBMTPU_GetSampleCount(
+        ctypes.c_int64(1000),
+        json.dumps({"bin_construct_sample_cnt": 100}).encode(),
+        ctypes.byref(cnt)))
+    assert cnt.value == 100
+    idx = np.zeros(100, np.int32)
+    out_len = ctypes.c_int64(100)
+    _check(lib, lib.LGBMTPU_SampleIndices(
+        ctypes.c_int64(1000),
+        json.dumps({"bin_construct_sample_cnt": 100}).encode(),
+        idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        ctypes.byref(out_len)))
+    assert out_len.value == 100
+    assert len(np.unique(idx)) == 100 and idx.max() < 1000
+    # network init is a no-op at 1 machine; free always succeeds
+    _check(lib, lib.LGBMTPU_NetworkInit(b"127.0.0.1:12400", 12400, 120, 1))
+    _check(lib, lib.LGBMTPU_NetworkFree())
+
+
+def test_merge_shuffle_reset(lib):
+    rng = np.random.default_rng(3)
+    n, f = 400, 4
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] > 0).astype(np.float64)
+    params = json.dumps({"objective": "binary", "num_leaves": 7,
+                         "min_data_in_leaf": 5, "verbose": -1,
+                         "seed": 5}).encode()
+
+    def make_booster(rounds):
+        ds = ctypes.c_int64()
+        _check(lib, lib.LGBMTPU_DatasetCreateFromMat(
+            X.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            ctypes.c_int64(n), ctypes.c_int64(f),
+            y.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            params, ctypes.byref(ds)))
+        bst = ctypes.c_int64()
+        _check(lib, lib.LGBMTPU_BoosterCreate(ds, params,
+                                              ctypes.byref(bst)))
+        fin = ctypes.c_int()
+        for _ in range(rounds):
+            _check(lib, lib.LGBMTPU_BoosterUpdateOneIter(bst,
+                                                         ctypes.byref(fin)))
+        return ds, bst
+
+    ds1, b1 = make_booster(3)
+    ds2, b2 = make_booster(2)
+    _check(lib, lib.LGBMTPU_BoosterMerge(b1, b2))
+    total = ctypes.c_int()
+    _check(lib, lib.LGBMTPU_BoosterNumberOfTotalModel(b1,
+                                                      ctypes.byref(total)))
+    assert total.value == 5
+    _check(lib, lib.LGBMTPU_BoosterShuffleModels(b1, 0, -1))
+    _check(lib, lib.LGBMTPU_BoosterNumberOfTotalModel(b1,
+                                                      ctypes.byref(total)))
+    assert total.value == 5
+    # reset parameter then keep training
+    _check(lib, lib.LGBMTPU_BoosterResetParameter(
+        b1, json.dumps({"learning_rate": 0.02}).encode()))
+    fin = ctypes.c_int()
+    _check(lib, lib.LGBMTPU_BoosterUpdateOneIter(b1, ctypes.byref(fin)))
+    # reset training data to the other dataset
+    _check(lib, lib.LGBMTPU_BoosterResetTrainingData(b1, ds2))
+    _check(lib, lib.LGBMTPU_BoosterUpdateOneIter(b1, ctypes.byref(fin)))
+    # custom-gradient update
+    grad = (np.random.default_rng(4).normal(size=n) * 0.1).astype(np.float32)
+    hess = np.full(n, 0.25, np.float32)
+    ds3 = ctypes.c_int64()
+    p_none = json.dumps({"objective": "none", "num_leaves": 7,
+                         "min_data_in_leaf": 5, "verbose": -1}).encode()
+    _check(lib, lib.LGBMTPU_DatasetCreateFromMat(
+        X.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.c_int64(n), ctypes.c_int64(f),
+        y.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        p_none, ctypes.byref(ds3)))
+    b3 = ctypes.c_int64()
+    _check(lib, lib.LGBMTPU_BoosterCreate(ds3, p_none, ctypes.byref(b3)))
+    _check(lib, lib.LGBMTPU_BoosterUpdateOneIterCustom(
+        b3, grad.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        hess.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ctypes.c_int64(n), ctypes.byref(fin)))
+    _check(lib, lib.LGBMTPU_BoosterNumberOfTotalModel(b3,
+                                                      ctypes.byref(total)))
+    assert total.value == 1
+    # feature-name validation
+    _check(lib, lib.LGBMTPU_BoosterValidateFeatureNames(
+        b1, json.dumps([f"Column_{i}" for i in range(f)]).encode()))
+    rc = lib.LGBMTPU_BoosterValidateFeatureNames(
+        b1, json.dumps(["wrong"] * f).encode())
+    assert rc != 0
+    for h in (ds1, ds2, ds3, b1, b2, b3):
+        _check(lib, lib.LGBMTPU_FreeHandle(h))

@@ -76,3 +76,91 @@ def test_grouped_layout_covers_every_group():
     v = np.asarray(valid)
     for k in range(K):
         assert v[k_of == k].sum() == int(cnt[k])
+
+
+def test_fast_grouped_counts_lut_matches_masked(grouped_interpret):
+    """counts fast path (batch_grower's round call) == masked."""
+    bins, grad, hess, lor, leaves = _mk(seed=5)
+    L = 12
+    counts = jnp.asarray(
+        np.array([(np.asarray(lor) == int(l)).sum() for l in leaves],
+                 np.float32))
+    ref = H.histogram_for_leaves_masked(
+        bins.T, grad, hess, lor, leaves, n_bins=32, hist_dtype="float32")
+    got = H.histogram_for_leaves_auto(
+        bins, bins.T, grad, hess, lor, leaves, n_bins=32,
+        rows_per_block=512, hist_dtype="float32", grouped=True,
+        buckets=(2,), counts=counts)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-4)
+
+
+def test_fast_grouped_row_mask_and_dummy_slots(grouped_interpret):
+    bins, grad, hess, lor, leaves = _mk(seed=7)
+    L = 12
+    mask = jnp.asarray(np.random.default_rng(2).random(bins.shape[0]) > 0.4)
+    # slot 4/5 invalid (count 0) with duplicated leaf ids, as the batch
+    # grower's padded rounds produce
+    leaves = leaves.at[-1].set(leaves[0])
+    mlor = np.where(np.asarray(mask), np.asarray(lor), -1)
+    counts = np.array([(mlor == int(l)).sum() for l in leaves], np.float32)
+    counts[-1] = 0.0
+    ref = H.histogram_for_leaves_masked(
+        bins.T, grad, hess, lor, leaves, mask, n_bins=32,
+        hist_dtype="float32")
+    got = H.histogram_for_leaves_auto(
+        bins, bins.T, grad, hess, lor, leaves, mask, n_bins=32,
+        rows_per_block=512, hist_dtype="float32", grouped=True,
+        buckets=(2,), counts=jnp.asarray(counts))
+    np.testing.assert_allclose(np.asarray(got)[:-1], np.asarray(ref)[:-1],
+                               rtol=1e-5, atol=1e-4)
+    assert float(np.abs(np.asarray(got)[-1]).max()) == 0.0
+
+
+def test_radix_single_matches_flat():
+    """Radix root kernel (interpret) == XLA flat histogram."""
+    from lightgbm_tpu.ops.hist_pallas import histogram_radix_single_pallas
+    rng = np.random.default_rng(11)
+    n, f, B = 3000, 7, 32
+    bins = rng.integers(0, B - 1, size=(f, n)).astype(np.uint8)
+    grad = rng.normal(size=n).astype(np.float32)
+    hess = rng.random(n).astype(np.float32)
+    lor = rng.integers(-1, 2, size=n).astype(np.int32)  # -1 = excluded
+    got = histogram_radix_single_pallas(
+        jnp.asarray(bins), jnp.asarray(grad), jnp.asarray(hess),
+        jnp.asarray(lor), n_bins=B, rows_per_block=512,
+        compute_dtype=jnp.float32, interpret=True)
+    m = lor >= 0
+    want = np.zeros((f, B, 4), np.float32)
+    for j in range(f):
+        want[j, :, 0] = np.bincount(bins[j][m], weights=grad[m], minlength=B)
+        want[j, :, 1] = np.bincount(bins[j][m], weights=hess[m], minlength=B)
+        want[j, :, 2] = np.bincount(bins[j][m], minlength=B)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-4)
+
+
+def test_radix_joint_matches_flat():
+    """Joint (leaf, hi) radix kernel (interpret) == XLA masked reference,
+    including duplicate-slot copies and -1 exclusions."""
+    from lightgbm_tpu.ops.hist_pallas import histogram_radix_joint_pallas
+    rng = np.random.default_rng(13)
+    n, f, B, K = 4000, 6, 32, 4
+    bins = rng.integers(0, B - 1, size=(f, n)).astype(np.uint8)
+    grad = rng.normal(size=n).astype(np.float32)
+    hess = rng.random(n).astype(np.float32)
+    lor = rng.integers(-1, 5, size=n).astype(np.int32)
+    leaves = np.array([0, 3, 0, 2], np.int32)  # dup slot
+    got = histogram_radix_joint_pallas(
+        jnp.asarray(bins), jnp.asarray(grad), jnp.asarray(hess),
+        jnp.asarray(lor), jnp.asarray(leaves), n_bins=B, rows_per_block=512,
+        compute_dtype=jnp.float32, interpret=True)
+    want = np.zeros((K, f, B, 4), np.float32)
+    for k in range(K):
+        m = lor == leaves[k]
+        for j in range(f):
+            want[k, j, :, 0] = np.bincount(bins[j][m], weights=grad[m],
+                                           minlength=B)
+            want[k, j, :, 1] = np.bincount(bins[j][m], weights=hess[m],
+                                           minlength=B)
+            want[k, j, :, 2] = np.bincount(bins[j][m], minlength=B)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-4)

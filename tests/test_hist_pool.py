@@ -1,0 +1,87 @@
+"""Bounded histogram pool (SplitHyper.hist_pool_slots; reference
+feature_histogram.hpp:1367 HistogramPool + serial_tree_learner.cpp:36-47
+histogram_pool_size).
+
+The pool keeps P << num_leaves resident [F, B, 4] histograms with
+lowest-cached-gain eviction; a split parent whose histogram was evicted
+gets BOTH children histogrammed directly instead of by subtraction.  With
+integer-valued gradients every histogram sum is exact, so pooled and
+unpooled growth must produce IDENTICAL trees.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from lightgbm_tpu.learner.batch_grower import grow_tree_batched
+from lightgbm_tpu.ops.split import SplitHyper
+
+
+def _mk(n=6000, f=8, seed=0):
+    rng = np.random.default_rng(seed)
+    bins = rng.integers(0, 63, size=(n, f)).astype(np.uint8)
+    # integer-valued grad/hess: all sums exact in f32, so subtraction vs
+    # direct construction cannot diverge and trees compare bit-equal
+    grad = rng.integers(-2, 3, size=n).astype(np.float32)
+    hess = rng.integers(1, 5, size=n).astype(np.float32)
+    num_bins = jnp.full((f,), 64, jnp.int32)
+    nan_bin = jnp.full((f,), -1, jnp.int32)
+    is_cat = jnp.zeros((f,), bool)
+    return (jnp.asarray(bins), jnp.asarray(grad), jnp.asarray(hess),
+            num_bins, nan_bin, is_cat)
+
+
+@pytest.mark.parametrize("batch", [4, 8])
+def test_pooled_equals_unpooled(batch):
+    bins, grad, hess, num_bins, nan_bin, is_cat = _mk()
+    hp = SplitHyper(num_leaves=31, min_data_in_leaf=5, n_bins=64,
+                    hist_dtype="float32")
+    hp_pool = dataclasses.replace(hp, hist_pool_slots=3 * batch + 2)
+    assert hp_pool.hist_pool_slots < hp.num_leaves  # pool engages
+    t0, lor0 = grow_tree_batched(bins, grad, hess, None, num_bins, nan_bin,
+                                 is_cat, None, hp, batch=batch)
+    t1, lor1 = grow_tree_batched(bins, grad, hess, None, num_bins, nan_bin,
+                                 is_cat, None, hp_pool, batch=batch)
+    assert int(t0.num_leaves) > 8  # non-trivial tree
+    np.testing.assert_array_equal(np.asarray(t0.split_feature),
+                                  np.asarray(t1.split_feature))
+    np.testing.assert_array_equal(np.asarray(t0.split_bin),
+                                  np.asarray(t1.split_bin))
+    np.testing.assert_array_equal(np.asarray(t0.leaf_value),
+                                  np.asarray(t1.leaf_value))
+    np.testing.assert_array_equal(np.asarray(lor0), np.asarray(lor1))
+
+
+def test_pool_state_is_bounded():
+    """The jit-traced histogram state is [P+1, F, B, 4], not [L, ...]."""
+    import jax
+    bins, grad, hess, num_bins, nan_bin, is_cat = _mk(n=2000)
+    P = 14
+    hp = SplitHyper(num_leaves=63, min_data_in_leaf=5, n_bins=64,
+                    hist_dtype="float32", hist_pool_slots=P)
+    # trace only: any [L, F, B, 4] buffer would appear in the jaxpr text;
+    # the pooled state must appear as [P+1, F, B, 4]
+    jaxpr = jax.make_jaxpr(
+        lambda *a: grow_tree_batched(*a, hp, batch=4))(
+        bins, grad, hess, None, num_bins, nan_bin, is_cat, None)
+    text = str(jaxpr)
+    f = bins.shape[1]
+    assert f"f32[{P + 1},{f},64,4]" in text
+    assert f"f32[{hp.num_leaves},{f},64,4]" not in text
+
+
+def test_pool_via_train_params(synthetic_binary):
+    """histogram_pool_size MB flows from params into a working train()."""
+    import lightgbm_tpu as lgb
+    X, y = synthetic_binary
+    params = {"objective": "binary", "num_leaves": 31, "verbose": -1,
+              "min_data_in_leaf": 5, "tpu_split_batch": 4,
+              # tiny budget -> clamps to 3*batch+2 slots < 31 leaves
+              "histogram_pool_size": 0.001}
+    ds = lgb.Dataset(X, label=y, params=params)
+    bst = lgb.train(params, ds, num_boost_round=5)
+    pred = bst.predict(X[:100])
+    assert np.isfinite(pred).all()

@@ -58,3 +58,62 @@ def test_quantized_regression(synthetic_regression):
     bst = lgb.train(p, lgb.Dataset(X, label=y, params=p), num_boost_round=25)
     r2 = 1 - np.mean((bst.predict(X) - y) ** 2) / np.var(y)
     assert r2 > 0.8
+
+
+def test_levels_exact_bf16_accumulation():
+    """The quantized-levels design contract: integer levels accumulate
+    EXACTLY in the bf16-mode histogram (ops/quantize.py docstring), so a
+    bf16-kernel quantized tree must equal the f32-kernel quantized tree
+    decision-for-decision."""
+    import jax
+    import jax.numpy as jnp
+    from lightgbm_tpu.ops.quantize import discretize_gradients_levels
+    from lightgbm_tpu.ops.histogram import build_histogram
+
+    rng = np.random.default_rng(4)
+    n, f = 20000, 6
+    g = rng.normal(size=n).astype(np.float32)
+    h = np.abs(rng.normal(size=n)).astype(np.float32)
+    bins = rng.integers(0, 255, size=(n, f)).astype(np.uint8)
+    gl, hl, gs, hs = discretize_gradients_levels(
+        jnp.asarray(g), jnp.asarray(h), jax.random.PRNGKey(0), n_levels=4,
+        stochastic=False)
+    gl_n, hl_n = np.asarray(gl), np.asarray(hl)
+    assert np.all(gl_n == np.round(gl_n)) and np.abs(gl_n).max() <= 2
+    assert np.all(hl_n == np.round(hl_n)) and hl_n.max() <= 4
+    # bf16-cast levels are exact; f64 reference accumulation matches the
+    # f32 histogram of bf16-cast values bit-for-bit
+    vals = jnp.stack([gl, hl, jnp.ones_like(gl), jnp.zeros_like(gl)], axis=1)
+    hist = np.asarray(build_histogram(jnp.asarray(bins),
+                                      vals.astype(jnp.bfloat16)
+                                      .astype(jnp.float32), n_bins=256))
+    want_g = np.zeros((f, 256))
+    want_h = np.zeros((f, 256))
+    for j in range(f):
+        want_g[j] = np.bincount(bins[:, j], weights=gl_n.astype(np.float64),
+                                minlength=256)
+        want_h[j] = np.bincount(bins[:, j], weights=hl_n.astype(np.float64),
+                                minlength=256)
+    assert np.array_equal(hist[:, :, 0], want_g)
+    assert np.array_equal(hist[:, :, 1], want_h)
+
+
+def test_quantized_hist_scale_grower_parity(synthetic_binary):
+    """Quantized train() (levels + hist_scale plumbing) trains and stays
+    close to full precision; bf16 vs f32 kernel dtype give IDENTICAL
+    models in quantized mode (the exactness contract, CPU path)."""
+    X, y = synthetic_binary
+    params = dict(FAST, objective="binary", use_quantized_grad=True,
+                  stochastic_rounding=False, seed=7)
+    ds = lgb.Dataset(X, label=y, params=params)
+    b32 = lgb.train(dict(params, tpu_hist_dtype="float32"), ds,
+                    num_boost_round=8)
+    bbf = lgb.train(dict(params, tpu_hist_dtype="bfloat16"), ds,
+                    num_boost_round=8)
+
+    def trees_only(s):
+        # strip the embedded parameters dump (records the dtype knob)
+        return s.split("parameters:")[0]
+
+    assert trees_only(b32.model_to_string()) == \
+        trees_only(bbf.model_to_string())
