@@ -694,7 +694,8 @@ class GBDT:
                 return grow_tree_batched(
                     *args, batch=int(self.config.tpu_split_batch),
                     bundle=self.bundle, monotone=self.monotone_arr,
-                    hist_scale=hist_scale)
+                    hist_scale=hist_scale,
+                    interaction_sets=self.interaction_sets)
             kwargs = dict(monotone=self.monotone_arr, rng_key=node_key,
                           interaction_sets=self.interaction_sets,
                           forced=self.forced_splits, bundle=self.bundle,
@@ -727,7 +728,8 @@ class GBDT:
                 self.mesh, self.bins, g, h, row_mask, self.num_bins_arr,
                 self.nan_bin_arr, self.is_cat_arr, feature_mask, self.hp,
                 batch=int(self.config.tpu_split_batch), bundle=self.bundle,
-                monotone=self.monotone_arr, hist_scale=hist_scale)
+                monotone=self.monotone_arr, hist_scale=hist_scale,
+                interaction_sets=self.interaction_sets)
             return arrays, (lor[:-p] if p else lor)
         arrays, lor = grow_tree_sharded(
             self.mesh, self.bins, g, h, row_mask, self.num_bins_arr,
@@ -743,23 +745,23 @@ class GBDT:
         the tree uses only its supported feature set."""
         if int(self.config.tpu_split_batch) <= 1:
             return False
-        # categorical splits and basic-method monotone are batched-capable
+        # categorical splits, basic/intermediate monotone, interaction
+        # constraints and path smoothing are batched-capable
         # (learner/batch_grower.py); the rest still needs the strict learner
         mono_strict = self.hp.use_monotone \
-            and self.hp.monotone_method != "basic"
+            and self.hp.monotone_method == "advanced"
         unsupported = (mono_strict
-                       or self.interaction_sets is not None
                        or self.forced_splits is not None
                        or self.cegb is not None
                        or self.hp.extra_trees
                        or self.hp.feature_fraction_bynode < 1.0
-                       or self.hp.path_smooth > 0.0 or self.linear
+                       or self.linear
                        or self.parallel_mode not in (None, "data"))
         if unsupported:
             if not getattr(self, "_warned_batch", False):
-                log.warning("tpu_split_batch > 1 ignored: intermediate/"
-                            "advanced monotone, forced/interaction/cegb/"
-                            "extra_trees/path_smooth/linear_tree and "
+                log.warning("tpu_split_batch > 1 ignored: advanced "
+                            "monotone, forced splits, cegb, "
+                            "extra_trees, bynode sampling, linear_tree and "
                             "voting/feature parallel modes require the "
                             "strict leaf-wise learner")
                 self._warned_batch = True

@@ -485,21 +485,25 @@ PREDICT_LEAF_INDEX = 2
 PREDICT_CONTRIB = 3
 
 
-def _predict_any(b_id: int, X, predict_type: int, start_iteration: int,
-                 num_iteration: int, out_ptr: int, out_capacity: int) -> int:
-    b = _handles[b_id]
+def _predict_values(b, X, predict_type: int, start_iteration: int,
+                    num_iteration: int) -> np.ndarray:
+    """Shared predict-type dispatch (reference C_API_PREDICT_*)."""
     kw = dict(start_iteration=int(start_iteration),
               num_iteration=(None if num_iteration <= 0
                              else int(num_iteration)))
     if predict_type == PREDICT_RAW_SCORE:
-        preds = b.predict(X, raw_score=True, **kw)
-    elif predict_type == PREDICT_LEAF_INDEX:
-        preds = b.predict(X, pred_leaf=True, **kw)
-    elif predict_type == PREDICT_CONTRIB:
-        preds = b.predict(X, pred_contrib=True, **kw)
-    else:
-        preds = b.predict(X, **kw)
-    preds = np.asarray(preds, np.float64).reshape(-1)
+        return np.asarray(b.predict(X, raw_score=True, **kw), np.float64)
+    if predict_type == PREDICT_LEAF_INDEX:
+        return np.asarray(b.predict(X, pred_leaf=True, **kw), np.float64)
+    if predict_type == PREDICT_CONTRIB:
+        return np.asarray(b.predict(X, pred_contrib=True, **kw), np.float64)
+    return np.asarray(b.predict(X, **kw), np.float64)
+
+
+def _predict_any(b_id: int, X, predict_type: int, start_iteration: int,
+                 num_iteration: int, out_ptr: int, out_capacity: int) -> int:
+    preds = _predict_values(_handles[b_id], X, predict_type,
+                            start_iteration, num_iteration).reshape(-1)
     if preds.size > out_capacity:
         raise ValueError(
             f"prediction needs {preds.size} doubles but the out buffer "
@@ -560,20 +564,8 @@ def booster_predict_for_file(b_id: int, data_path: str, has_header: int,
     from .io.parser import load_text_file
     cfg = Config({"header": bool(has_header)})
     feats, _label, _meta = load_text_file(data_path, cfg)
-    X = feats
-    b = _handles[b_id]
-    kw = dict(start_iteration=int(start_iteration),
-              num_iteration=(None if num_iteration <= 0
-                             else int(num_iteration)))
-    if predict_type == PREDICT_RAW_SCORE:
-        preds = b.predict(X, raw_score=True, **kw)
-    elif predict_type == PREDICT_LEAF_INDEX:
-        preds = b.predict(X, pred_leaf=True, **kw)
-    elif predict_type == PREDICT_CONTRIB:
-        preds = b.predict(X, pred_contrib=True, **kw)
-    else:
-        preds = b.predict(X, **kw)
-    preds = np.asarray(preds, np.float64)
+    preds = _predict_values(_handles[b_id], feats, predict_type,
+                            start_iteration, num_iteration)
     with open(result_path, "w") as fh:
         for row in np.atleast_2d(preds.reshape(preds.shape[0], -1)):
             fh.write("\t".join(repr(float(v)) for v in row) + "\n")
@@ -656,14 +648,35 @@ def booster_get_leaf_value(b_id: int, tree_idx: int, leaf_idx: int) -> float:
 
 def booster_set_leaf_value(b_id: int, tree_idx: int, leaf_idx: int,
                            value: float) -> None:
-    """LGBM_BoosterSetLeafValue (c_api.h:952)."""
+    """LGBM_BoosterSetLeafValue (c_api.h:952).
+
+    Score caches follow INCREMENTALLY: only the edited tree's leaf
+    assignment is recomputed and the value delta added to the rows in
+    that leaf (the reference's score updater applies the same delta
+    trick) — O(one tree), not a full model re-predict."""
     b = _handles[b_id]
     t = b._get_trees()[tree_idx]
+    delta = float(value) - float(t.leaf_value[leaf_idx])
     t.leaf_value[leaf_idx] = value
-    if b._gbdt is not None:
-        # keep cached train/valid scores consistent like the reference's
-        # score updater would: simplest correct move is a full refresh
-        b._gbdt.invalidate_score_cache()
+    g = b._gbdt
+    if g is None or delta == 0.0:
+        return
+    import jax.numpy as jnp
+    from .boosting.gbdt import _tree_to_arrays_stub
+    from .models.predict import predict_bins_leaf
+    k = g.num_tree_per_iteration
+    c = tree_idx % k
+    arrs = _tree_to_arrays_stub(t, g.train_set)
+    leaf = predict_bins_leaf(arrs, g.bins, g.nan_bin_arr, g.bundle,
+                             g.hp.has_categorical)
+    upd = jnp.where(leaf[:g.train_set.num_data] == leaf_idx, delta, 0.0)
+    g.scores = g.scores.at[:, c].add(upd)
+    for vi in range(len(g.valid_sets)):
+        leaf_v = predict_bins_leaf(arrs, g._valid_bins[vi], g.nan_bin_arr,
+                                   g.bundle, g.hp.has_categorical)
+        upd_v = jnp.where(leaf_v[:g.valid_sets[vi].num_data] == leaf_idx,
+                          delta, 0.0)
+        g.valid_scores[vi] = g.valid_scores[vi].at[:, c].add(upd_v)
 
 
 def booster_get_linear(b_id: int) -> int:

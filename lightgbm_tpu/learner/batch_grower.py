@@ -54,15 +54,27 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                       monotone: Optional[jax.Array] = None,
                       axis_name: Optional[str] = None,
                       warmup: bool = True,
-                      hist_scale: Optional[jax.Array] = None
+                      hist_scale: Optional[jax.Array] = None,
+                      interaction_sets: Optional[jax.Array] = None
                       ) -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree with ``batch`` splits per histogram pass.
 
-    Same operands and return contract as ``grow_tree``.
+    Same operands and return contract as ``grow_tree``.  Supports
+    interaction constraints (per-leaf path-feature masks), basic AND
+    intermediate monotone methods (intermediate refreshes every leaf's
+    bounds from dense box adjacency once per ROUND — output clipping
+    always uses fresh bounds; cached candidate gains may lag one round,
+    the same class of lag the strict learner documents per split), and
+    path smoothing.
     """
     if hp.use_monotone:
-        assert monotone is not None and hp.monotone_method == "basic", \
-            "batched grower supports monotone_constraints_method=basic only"
+        assert monotone is not None and hp.monotone_method in (
+            "basic", "intermediate"), \
+            "batched grower supports monotone basic/intermediate " \
+            "(advanced needs the strict learner)"
+    use_boxes = hp.use_monotone and hp.monotone_method == "intermediate"
+    use_paths = interaction_sets is not None
+    use_smooth = hp.path_smooth > 0.0
     n = bins.shape[0]
     num_f = bins.shape[1] if bundle is None else bundle.feat_col.shape[0]
     L = hp.num_leaves
@@ -81,12 +93,25 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             lax.bitcast_convert_type(hess, jnp.uint8),
         ], axis=1))
 
-    def child_best(h_phys, g_, h_, c_, depth, lmin, lmax):
+    def node_mask(path_f):
+        """Per-leaf allowed features under interaction constraints
+        (reference col_sampler.hpp:91 GetByNode): a leaf may split only on
+        features from constraint sets containing its whole path."""
+        if not use_paths:
+            return feature_mask
+        fits = jnp.all(interaction_sets | ~path_f[None, :], axis=1)   # [S]
+        allowed = jnp.any(interaction_sets & fits[:, None],
+                          axis=0) | path_f
+        return allowed if feature_mask is None \
+            else (feature_mask & allowed)
+
+    def child_best(h_phys, g_, h_, c_, depth, lmin, lmax, fm, pout):
         hv = h_phys if bundle is None else \
             _expand_hist(h_phys, bundle, g_, h_, c_)
         res = find_best_split(hv, g_, h_, c_, num_bins, nan_bin, is_cat,
-                              feature_mask, hp, monotone=monotone,
-                              leaf_min=lmin, leaf_max=lmax, depth=depth)
+                              fm, hp, monotone=monotone,
+                              leaf_min=lmin, leaf_max=lmax, depth=depth,
+                              parent_output=pout)
         depth_ok = (hp.max_depth <= 0) | (depth < hp.max_depth)
         return res._replace(gain=jnp.where(depth_ok, res.gain, NEG_INF))
 
@@ -117,7 +142,9 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         c0 = lax.psum(c0, axis_name)
     root_out = leaf_output(g0, h0, hp.lambda_l1, hp.lambda_l2,
                            hp.max_delta_step)
-    best0 = child_best(hist0_b, g0, h0, c0, jnp.int32(0), -INF, INF)
+    empty_path = jnp.zeros((num_f,), bool)
+    best0 = child_best(hist0_b, g0, h0, c0, jnp.int32(0), -INF, INF,
+                       node_mask(empty_path), root_out)
 
     tree = _empty_tree(L, hp.n_bins, num_f)
     tree = tree._replace(
@@ -136,6 +163,9 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             "hist_pool_slots does not compose with categorical splits yet"
         assert P >= 3 * K + 2, \
             "hist_pool_slots must be >= 3*batch+2 for worst-case rounds"
+        assert axis_name is None, \
+            "hist_pool_slots does not compose with shard_map yet (its " \
+            "layout needs per-shard counts)"
     state = dict(
         tree=tree,
         leaf_of_row=jnp.zeros((n,), jnp.int32),
@@ -161,6 +191,14 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         n_splits=jnp.int32(0),
         progress=jnp.bool_(True),
     )
+    if use_paths:
+        state["path_f"] = jnp.zeros((L, num_f), bool)
+    if use_boxes:
+        # bin-space boxes: root spans every bin (hi exclusive); dead slots
+        # hold empty boxes so box_bounds ignores them
+        state["leaf_lo"] = jnp.zeros((L, num_f), jnp.int32)
+        state["leaf_hi"] = jnp.zeros((L, num_f), jnp.int32).at[0].set(
+            num_bins.astype(jnp.int32))
     if pooled:
         state["leaf_slot"] = jnp.full((L + 1,), -1, jnp.int32).at[0].set(0)
         state["slot_leaf"] = jnp.full((P + 1,), -1, jnp.int32).at[0].set(0)
@@ -221,31 +259,60 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                   jnp.where(ok, -(nl + 1), rc_arr[nid]))
 
               # sorted-subset categorical children use l2 + cat_l2, matching
-              # the strict learner and feature_histogram.cpp:250
+              # the strict learner and feature_histogram.cpp:250; path
+              # smoothing pulls children toward the parent's output exactly
+              # like the strict learner (grower.py smoothed_output)
               l2_eff = hp.lambda_l2 + jnp.where(
                   (var == VAR_CAT_FWD) | (var == VAR_CAT_BWD), hp.cat_l2, 0.0)
-              lo = leaf_output(lg, lh, hp.lambda_l1, l2_eff,
-                               hp.max_delta_step)
-              ro = leaf_output(rg, rh, hp.lambda_l1, l2_eff,
-                               hp.max_delta_step)
+              if use_smooth:
+                  from ..ops.split import smoothed_output
+                  parent_out_j = t.leaf_value[bl]
+                  lo = smoothed_output(lg, lh, lcn, parent_out_j,
+                                       hp.lambda_l1, l2_eff, hp)
+                  ro = smoothed_output(rg, rh, rcn, parent_out_j,
+                                       hp.lambda_l1, l2_eff, hp)
+              else:
+                  lo = leaf_output(lg, lh, hp.lambda_l1, l2_eff,
+                                   hp.max_delta_step)
+                  ro = leaf_output(rg, rh, hp.lambda_l1, l2_eff,
+                                   hp.max_delta_step)
               if hp.use_monotone:
-                  # basic method (monotone_constraints.hpp BasicLeafConstraints):
-                  # clip children into the parent's box, then tighten each
-                  # child's box at the midpoint along the split direction
+                  # both methods clip children into the parent's box
+                  # (monotone_constraints.hpp); basic additionally tightens
+                  # each child's box at the midpoint along the split
+                  # direction, intermediate refreshes boxes after the round
                   lmin_p, lmax_p = st["leaf_min"][bl], st["leaf_max"][bl]
                   lo = jnp.clip(lo, lmin_p, lmax_p)
                   ro = jnp.clip(ro, lmin_p, lmax_p)
-                  mono_f = monotone[feat]
-                  is_num = ~catl
-                  mid = (lo + ro) * 0.5
-                  lmax_l = jnp.where(is_num & (mono_f > 0),
-                                     jnp.minimum(lmax_p, mid), lmax_p)
-                  lmin_l = jnp.where(is_num & (mono_f < 0),
-                                     jnp.maximum(lmin_p, mid), lmin_p)
-                  lmin_r = jnp.where(is_num & (mono_f > 0),
-                                     jnp.maximum(lmin_p, mid), lmin_p)
-                  lmax_r = jnp.where(is_num & (mono_f < 0),
-                                     jnp.minimum(lmax_p, mid), lmax_p)
+                  if not use_boxes:
+                      mono_f = monotone[feat]
+                      is_num = ~catl
+                      mid = (lo + ro) * 0.5
+                      lmax_l = jnp.where(is_num & (mono_f > 0),
+                                         jnp.minimum(lmax_p, mid), lmax_p)
+                      lmin_l = jnp.where(is_num & (mono_f < 0),
+                                         jnp.maximum(lmin_p, mid), lmin_p)
+                      lmin_r = jnp.where(is_num & (mono_f > 0),
+                                         jnp.maximum(lmin_p, mid), lmin_p)
+                      lmax_r = jnp.where(is_num & (mono_f < 0),
+                                         jnp.minimum(lmax_p, mid), lmax_p)
+                  else:
+                      lmin_l = lmin_r = lmin_p
+                      lmax_l = lmax_r = lmax_p
+              if use_paths:
+                  # children inherit the path plus the split feature
+                  new_path = st["path_f"][bl].at[feat].set(True)
+                  st["path_f"] = st["path_f"].at[bl].set(
+                      jnp.where(ok, new_path, st["path_f"][bl]))
+                  st["path_f"] = st["path_f"].at[nl].set(
+                      jnp.where(ok, new_path, st["path_f"][nl]))
+              if use_boxes:
+                  from .monotone import split_boxes
+                  n_lo, n_hi = split_boxes(
+                      st["leaf_lo"], st["leaf_hi"], bl, nl, feat, thr,
+                      ~catl)
+                  st["leaf_lo"] = jnp.where(ok, n_lo, st["leaf_lo"])
+                  st["leaf_hi"] = jnp.where(ok, n_hi, st["leaf_hi"])
               d = t.leaf_depth[bl] + 1
 
               def w(arr, idx, val):
@@ -317,8 +384,12 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
               smaller = jnp.where(l_cnt <= r_cnt, parents, safe_nl)
               # masked row count of each smaller child (0 for invalid
               # slots) — lets the grouped path skip its O(K*n) rank and
-              # count reductions (histogram_for_leaves_auto fast path)
-              small_cnt = jnp.where(valid, jnp.minimum(l_cnt, r_cnt), 0.0)
+              # count reductions (histogram_for_leaves_auto fast path).
+              # Under shard_map the state counts are GLOBAL (psum-ed) while
+              # compaction is per-shard, so the fast path must recompute
+              # locally there: pass no counts.
+              small_cnt = (jnp.where(valid, jnp.minimum(l_cnt, r_cnt), 0.0)
+                           if axis_name is None else None)
 
               def hist_call(lv, cnts):
                   return _scaled(histogram_for_leaves_auto(
@@ -403,15 +474,38 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                   st["slot_leaf"] = slot_leaf.at[P].set(-1)
                   st["leaf_slot"] = leaf_slot.at[L].set(-1)
 
+          # intermediate monotone: refresh EVERY leaf's output bounds from
+          # dense box adjacency once per round (learner/monotone.py; the
+          # strict learner refreshes per split — clipping always uses the
+          # latest refresh either way)
+          if use_boxes:
+              from .monotone import box_bounds
+              lower, upper = box_bounds(
+                  st["leaf_lo"], st["leaf_hi"], st["tree"].leaf_value,
+                  monotone, st["tree"].num_leaves)
+              st["leaf_min"] = lower
+              st["leaf_max"] = upper
+
           # ---- child best splits, vmapped over the 2K children
           with jax.named_scope("find_splits"):
               kids = jnp.concatenate([parents, safe_nl])              # [2K]
               kid_hist = jnp.concatenate([h_left, h_right], axis=0)
               depths = st["tree"].leaf_depth[kids]
-              res = jax.vmap(child_best)(kid_hist, st["sum_g"][kids],
-                                         st["sum_h"][kids], st["count"][kids],
-                                         depths, st["leaf_min"][kids],
-                                         st["leaf_max"][kids])
+              if use_paths:
+                  fms = jax.vmap(node_mask)(st["path_f"][kids])
+              else:
+                  fms = (jnp.broadcast_to(feature_mask, (2 * Kr,)
+                                          + feature_mask.shape)
+                         if feature_mask is not None else None)
+              pouts = st["tree"].leaf_value[kids]
+              res = jax.vmap(
+                  child_best,
+                  in_axes=(0, 0, 0, 0, 0, 0, 0,
+                           None if fms is None else 0, 0))(
+                  kid_hist, st["sum_g"][kids],
+                  st["sum_h"][kids], st["count"][kids],
+                  depths, st["leaf_min"][kids],
+                  st["leaf_max"][kids], fms, pouts)
               ok2 = jnp.concatenate([valid, valid])
               gains2 = jnp.where(ok2, res.gain, st["best_gain"][kids])
               st["best_gain"] = st["best_gain"].at[kids].set(gains2)

@@ -1,0 +1,297 @@
+"""Cluster orchestration: the Dask-layer equivalent.
+
+The reference's Dask wrapper (reference: python-package/lightgbm/dask.py)
+is the layer that STARTS distributed training rather than participating in
+it: it maps workers to machines and open ports (``_machines_to_worker_map``
+dask.py:374), ships each worker its data partitions, runs ``_train_part``
+(:182-200 — plain ``train()`` with network params) on every worker, and
+returns the rank-0 model.  This module plays that role for the
+jax.distributed runtime:
+
+* :func:`launch` — spawn one process per rank (locally, or attach to a
+  ``machines`` list), negotiate a free coordinator port, shard the data,
+  run :func:`..launcher.train_multihost` everywhere, return rank 0's
+  Booster.
+* :class:`TPULGBMClassifier` / :class:`TPULGBMRegressor` /
+  :class:`TPULGBMRanker` — distributed sklearn estimators
+  (reference DaskLGBMClassifier/Regressor/Ranker dask.py:1113,1316,1483):
+  ``fit`` routes through :func:`launch`, everything else (predict,
+  attributes) is the plain in-process estimator surface on the returned
+  model.
+
+Worker protocol: the parent writes one npz shard + a JSON job spec per
+rank into a scratch directory and starts
+``python -m lightgbm_tpu.parallel.cluster <spec.json>``; rank 0 writes the
+trained model text back.  No environment variables need to be set by the
+caller — rank, coordinator and device flags travel in the spec (the
+reference's Dask layer likewise hides machines/ports from the user).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+from typing import Any, Dict, Optional, Sequence
+
+import numpy as np
+
+from ..utils import log
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _machines_to_worker_map(machines: Optional[str], n_workers: int,
+                            local_listen_port: int) -> list:
+    """Rank -> "host:port" assignment (reference dask.py:374).
+
+    With ``machines=None`` every rank runs locally on a fresh free port;
+    with a machines list, entries are assigned to ranks in order (missing
+    ports filled from ``local_listen_port``)."""
+    if machines:
+        hosts = [e.strip() for e in machines.split(",") if e.strip()]
+        if len(hosts) < n_workers:
+            log.fatal(f"machines lists {len(hosts)} entries for "
+                      f"{n_workers} workers")
+        return [h if ":" in h else f"{h}:{local_listen_port + i}"
+                for i, h in enumerate(hosts[:n_workers])]
+    return [f"127.0.0.1:{_free_port()}" for _ in range(n_workers)]
+
+
+def _shard_rows(n: int, n_workers: int,
+                group: Optional[np.ndarray]) -> list:
+    """Disjoint row index cover per rank; ranking data stripes whole
+    queries (a query's rows must stay on one rank)."""
+    if group is not None and len(group):
+        sizes = np.asarray(group, np.int64)
+        qid_of_row = np.repeat(np.arange(sizes.shape[0]), sizes)
+        return [np.flatnonzero(qid_of_row % n_workers == r)
+                for r in range(n_workers)]
+    return [np.arange(r, n, n_workers) for r in range(n_workers)]
+
+
+def launch(params: Dict[str, Any], data, label=None, *,
+           weight: Optional[np.ndarray] = None,
+           group: Optional[np.ndarray] = None,
+           num_boost_round: int = 100,
+           n_workers: int = 2,
+           machines: Optional[str] = None,
+           local_listen_port: int = 12400,
+           devices_per_worker: int = 0,
+           timeout_s: float = 3600.0):
+    """Run data-parallel training across ``n_workers`` fresh processes and
+    return the trained Booster (identical on every rank; rank 0's copy).
+
+    ``data`` may be a [n, F] array (the parent shards rows, ranking data
+    by whole queries) or a text-file path (every worker loads its own
+    stripe via ``load_rank_shard`` — nothing is shipped).
+    ``devices_per_worker`` > 0 forces that many virtual CPU devices per
+    worker (the CI configuration; leave 0 to inherit real accelerators).
+    """
+    from ..basic import Booster
+
+    worker_map = _machines_to_worker_map(machines, n_workers,
+                                         local_listen_port)
+    coordinator = worker_map[0]
+    with tempfile.TemporaryDirectory(prefix="lgbtpu_cluster_") as tmp:
+        specs = []
+        shards = None
+        if not isinstance(data, (str, os.PathLike)):
+            X = np.asarray(data, np.float64)
+            y = None if label is None else np.asarray(label)
+            shards = _shard_rows(X.shape[0], n_workers, group)
+        for rank in range(n_workers):
+            spec: Dict[str, Any] = {
+                "rank": rank, "num_machines": n_workers,
+                "machines": ",".join(worker_map),
+                "coordinator": coordinator,
+                "params": {k: v for k, v in params.items()},
+                "num_boost_round": int(num_boost_round),
+                "devices_per_worker": int(devices_per_worker),
+                "out_path": os.path.join(tmp, "model.txt"),
+            }
+            if shards is None:
+                spec["data_path"] = str(data)
+            else:
+                idx = shards[rank]
+                shard_path = os.path.join(tmp, f"shard_{rank}.npz")
+                payload = {"X": X[idx]}
+                if y is not None:
+                    payload["y"] = y[idx]
+                if weight is not None:
+                    payload["w"] = np.asarray(weight)[idx]
+                if group is not None and len(group):
+                    sizes = np.asarray(group, np.int64)
+                    qid = np.repeat(np.arange(sizes.shape[0]), sizes)
+                    keep_q = np.arange(sizes.shape[0]) % n_workers == rank
+                    payload["g"] = sizes[keep_q]
+                np.savez(shard_path, **payload)
+                spec["shard_path"] = shard_path
+            spec_path = os.path.join(tmp, f"spec_{rank}.json")
+            with open(spec_path, "w") as fh:
+                json.dump(spec, fh)
+            specs.append(spec_path)
+
+        procs = []
+        logs = []
+        for rank, spec_path in enumerate(specs):
+            env = dict(os.environ)
+            env.pop("PYTHONPATH", None)  # axon sitecustomize pre-registers
+            if devices_per_worker > 0:
+                # MUST happen before the worker imports jax (package import
+                # runs at interpreter start, before _worker_main executes),
+                # so the flags travel in the spawn env, not in-process
+                flags = env.get("XLA_FLAGS", "")
+                env["XLA_FLAGS"] = (
+                    flags + " --xla_force_host_platform_device_count="
+                    f"{devices_per_worker}").strip()
+                env["JAX_PLATFORMS"] = "cpu"
+            # per-rank log files, not pipes: a worker blocking on a full
+            # 64KB stdout pipe mid-collective would deadlock the job
+            lf = open(os.path.join(tmp, f"worker_{rank}.log"), "wb")
+            logs.append(lf)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "lightgbm_tpu.parallel.cluster",
+                 spec_path],
+                env=env, stdout=lf, stderr=subprocess.STDOUT))
+        fail = None
+        for rank, p in enumerate(procs):
+            try:
+                p.wait(timeout=timeout_s)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+                fail = fail or f"worker {rank} timed out"
+            if p.returncode != 0 and fail is None:
+                logs[rank].flush()
+                with open(logs[rank].name, errors="replace") as fh:
+                    tail = fh.read()[-2000:]
+                fail = f"worker {rank} exited {p.returncode}:\n{tail}"
+        for lf in logs:
+            lf.close()
+        if fail:
+            log.fatal(f"cluster launch failed: {fail}")
+        model_path = json.load(open(specs[0]))["out_path"]
+        with open(model_path) as fh:
+            return Booster(model_str=fh.read())
+
+
+def _worker_main(spec_path: str) -> None:
+    """Per-rank entry (the reference's _train_part, dask.py:182-200).
+
+    Device-count/platform env travels in the SPAWN env (set by launch());
+    by the time this runs, the package import has already imported jax.
+    """
+    with open(spec_path) as fh:
+        spec = json.load(fh)
+    from . import launcher
+
+    launcher.initialize(machines=spec["machines"],
+                        num_machines=spec["num_machines"],
+                        rank=spec["rank"])
+    kwargs: Dict[str, Any] = {}
+    if "shard_path" in spec:
+        z = np.load(spec["shard_path"])
+        data = z["X"]
+        kwargs["label"] = z["y"] if "y" in z else None
+        if "w" in z:
+            kwargs["weight"] = z["w"]
+        if "g" in z:
+            kwargs["group"] = z["g"]
+    else:
+        data = spec["data_path"]
+    booster = launcher.train_multihost(
+        spec["params"], data, num_boost_round=spec["num_boost_round"],
+        **kwargs)
+    if spec["rank"] == 0:
+        with open(spec["out_path"], "w") as fh:
+            fh.write(booster.model_to_string())
+
+
+class _DistributedMixin:
+    """fit() through :func:`launch`; predict stays in-process on the
+    trained model (reference DaskLGBM* return plain local predictions
+    when given local collections)."""
+
+    def _dist_fit(self, X, y, sample_weight=None, group=None, **launch_kw):
+        params = self._train_params()
+        self._Booster = launch(params, X, y, weight=sample_weight,
+                               group=group, **launch_kw)
+        self._n_features = np.asarray(X).shape[1]
+        return self
+
+
+def _estimators():
+    from ..sklearn import (LGBMClassifier, LGBMRanker, LGBMRegressor)
+    return LGBMClassifier, LGBMRegressor, LGBMRanker
+
+
+# resolve bases lazily to avoid a circular import at package load
+def _make_estimators():
+    LGBMClassifier, LGBMRegressor, LGBMRanker = _estimators()
+
+    class TPULGBMClassifier(_DistributedMixin, LGBMClassifier):
+        """Distributed classifier (reference DaskLGBMClassifier
+        dask.py:1113)."""
+
+        def fit(self, X, y, sample_weight=None, *, n_workers: int = 2,
+                machines: Optional[str] = None,
+                devices_per_worker: int = 0, **kwargs):
+            self._classes = np.unique(np.asarray(y))
+            self._n_classes = len(self._classes)
+            if self._n_classes > 2:
+                log.fatal("TPULGBMClassifier currently supports binary "
+                          "targets (multihost multiclass pending)")
+            y_enc = np.searchsorted(self._classes, np.asarray(y))
+            return self._dist_fit(X, y_enc, sample_weight,
+                                  n_workers=n_workers, machines=machines,
+                                  devices_per_worker=devices_per_worker,
+                                  num_boost_round=self.n_estimators)
+
+    class TPULGBMRegressor(_DistributedMixin, LGBMRegressor):
+        """Distributed regressor (reference DaskLGBMRegressor
+        dask.py:1316)."""
+
+        def fit(self, X, y, sample_weight=None, *, n_workers: int = 2,
+                machines: Optional[str] = None,
+                devices_per_worker: int = 0, **kwargs):
+            return self._dist_fit(X, y, sample_weight,
+                                  n_workers=n_workers, machines=machines,
+                                  devices_per_worker=devices_per_worker,
+                                  num_boost_round=self.n_estimators)
+
+    class TPULGBMRanker(_DistributedMixin, LGBMRanker):
+        """Distributed ranker (reference DaskLGBMRanker dask.py:1483)."""
+
+        def fit(self, X, y, sample_weight=None, group=None, *,
+                n_workers: int = 2, machines: Optional[str] = None,
+                devices_per_worker: int = 0, **kwargs):
+            if group is None:
+                log.fatal("TPULGBMRanker.fit requires group=")
+            return self._dist_fit(X, y, sample_weight, group=group,
+                                  n_workers=n_workers, machines=machines,
+                                  devices_per_worker=devices_per_worker,
+                                  num_boost_round=self.n_estimators)
+
+    return TPULGBMClassifier, TPULGBMRegressor, TPULGBMRanker
+
+
+def __getattr__(name):
+    if name in ("TPULGBMClassifier", "TPULGBMRegressor", "TPULGBMRanker"):
+        cls_map = dict(zip(
+            ("TPULGBMClassifier", "TPULGBMRegressor", "TPULGBMRanker"),
+            _make_estimators()))
+        globals().update(cls_map)
+        return cls_map[name]
+    raise AttributeError(name)
+
+
+if __name__ == "__main__":
+    _worker_main(sys.argv[1])

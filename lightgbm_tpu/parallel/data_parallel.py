@@ -146,7 +146,8 @@ def grow_tree_batched_sharded(mesh: Mesh, bins: jax.Array, grad: jax.Array,
                               hp: SplitHyper, batch: int,
                               bundle=None,
                               monotone: Optional[jax.Array] = None,
-                              hist_scale: Optional[jax.Array] = None
+                              hist_scale: Optional[jax.Array] = None,
+                              interaction_sets: Optional[jax.Array] = None
                               ) -> Tuple[TreeArrays, jax.Array]:
     """Batched-round grower (learner/batch_grower.py) under the data mesh:
     K splits per psum-ed widened histogram pass."""
@@ -163,18 +164,20 @@ def grow_tree_batched_sharded(mesh: Mesh, bins: jax.Array, grad: jax.Array,
         rep(bundle),
         P() if monotone is not None else None,
         P() if hist_scale is not None else None,
+        P() if interaction_sets is not None else None,
     )
     out_specs = (
         jax.tree.map(lambda _: P(), TreeArrays(*[0] * len(TreeArrays._fields))),
         P(DATA_AXIS),
     )
 
-    def local(b, g, h, m, nb, nanb, cat, fm, bd, mono, hs):
+    def local(b, g, h, m, nb, nanb, cat, fm, bd, mono, hs, isets):
         return grow_tree_batched(b, g, h, m, nb, nanb, cat, fm, hp,
                                  batch=batch, bundle=bd, monotone=mono,
-                                 axis_name=DATA_AXIS, hist_scale=hs)
+                                 axis_name=DATA_AXIS, hist_scale=hs,
+                                 interaction_sets=isets)
 
     fn = shard_map(local, mesh=mesh, in_specs=in_specs,
                    out_specs=out_specs, check_vma=False)
     return fn(bins, grad, hess, row_mask, num_bins, nan_bin, is_cat,
-              feature_mask, bundle, monotone, hist_scale)
+              feature_mask, bundle, monotone, hist_scale, interaction_sets)

@@ -115,6 +115,7 @@ def load_rank_shard(path: str, params: Optional[Dict[str, Any]] = None,
 def train_multihost(params: Dict[str, Any], data,
                     label: Optional[np.ndarray] = None,
                     weight: Optional[np.ndarray] = None,
+                    group: Optional[np.ndarray] = None,
                     num_boost_round: int = 100):
     """Data-parallel training from per-process row shards.
 
@@ -146,10 +147,9 @@ def train_multihost(params: Dict[str, Any], data,
             label = flabel
         if weight is None:
             weight = fmeta.get("weight")
-        if fmeta.get("group") is not None and len(fmeta["group"]):
-            log.fatal("train_multihost does not support ranking objectives "
-                      "yet; load_rank_shard stripes whole queries, but the "
-                      "multihost step only implements binary/l2")
+        if group is None and fmeta.get("group") is not None \
+                and len(fmeta["group"]):
+            group = fmeta["group"]
     if label is None:
         log.fatal("train_multihost: label is required (pass label= or a "
                   "data file whose label column is set)")
@@ -204,9 +204,23 @@ def train_multihost(params: Dict[str, Any], data,
 
     objective = create_objective(cfg)
     obj_name = objective.NAME if objective is not None else "regression"
-    if obj_name not in ("binary", "regression"):
-        log.fatal(f"train_multihost supports binary/regression objectives "
-                  f"for now, got {obj_name}")
+    fast_objs = ("binary", "regression")
+    if obj_name not in fast_objs:
+        # general path: gradients computed HOST-side per process on this
+        # rank's shard (any objective, incl. per-query lambdarank — the
+        # Dask wrapper's _train_part likewise runs the full local
+        # objective; queries stay whole per rank via load_rank_shard)
+        from ..io.dataset import Metadata
+        md = Metadata(n_local)
+        md.set_label(np.asarray(label, np.float64))
+        if weight is not None:
+            md.set_weight(np.asarray(weight, np.float64))
+        if group is not None:
+            md.set_group(np.asarray(group, np.int64))
+        objective.init(md, n_local)
+        if objective.num_model_per_iteration != 1:
+            log.fatal(f"train_multihost supports single-model-per-iteration "
+                      f"objectives, got {obj_name}")
     label_l = np.pad(np.asarray(label, np.float32), (0, pad))
     label_g = jax.make_array_from_process_local_data(sharding, label_l,
                                                      g_shape)
@@ -242,10 +256,41 @@ def train_multihost(params: Dict[str, Any], data,
             out_specs=(tree_specs, P(DATA_AXIS)),
             check_vma=False)(scores, bins_a, y, m)
 
+    @jax.jit
+    def step_with_grads(scores, bins_a, g_a, h_a, m):
+        def local_step(sc, b, g, h, mm):
+            tree, leaf_of_row = grow_tree(b, g * mm, h * mm + 1e-9, mm > 0,
+                                          num_bins, nan_bin, is_cat, None,
+                                          hp, axis_name=DATA_AXIS)
+            return tree, sc + lr * take_small_table(tree.leaf_value,
+                                                    leaf_of_row)
+
+        return shard_map(
+            local_step, mesh=mesh,
+            in_specs=(P(DATA_AXIS),) * 5,
+            out_specs=(tree_specs, P(DATA_AXIS)),
+            check_vma=False)(scores, bins_a, g_a, h_a, m)
+
+    def _local_scores(scores):
+        parts = sorted(scores.addressable_shards, key=lambda s: s.index)
+        return np.concatenate([np.asarray(s.data) for s in parts])[:n_local]
+
     scores = jax.device_put(jnp.zeros(g_shape, jnp.float32), sharding)
     trees = []
     for it in range(num_boost_round):
-        arrays, scores = step(scores, bins_g, label_g, mask_g)
+        if obj_name in fast_objs:
+            arrays, scores = step(scores, bins_g, label_g, mask_g)
+        else:
+            sc_local = _local_scores(scores)
+            gj, hj = objective.get_gradients(jnp.asarray(sc_local))
+            g_l = np.pad(np.asarray(gj, np.float32).reshape(-1), (0, pad))
+            h_l = np.pad(np.asarray(hj, np.float32).reshape(-1), (0, pad))
+            g_g = jax.make_array_from_process_local_data(sharding, g_l,
+                                                         g_shape)
+            h_g = jax.make_array_from_process_local_data(sharding, h_l,
+                                                         g_shape)
+            arrays, scores = step_with_grads(scores, bins_g, g_g, h_g,
+                                             mask_g)
         t = Tree.from_arrays(jax.tree.map(
             lambda x: np.asarray(jax.device_get(x)), arrays), local)
         t.apply_shrinkage(lr)
