@@ -62,10 +62,11 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     Same operands and return contract as ``grow_tree``.  Supports
     interaction constraints (per-leaf path-feature masks), basic AND
     intermediate monotone methods (intermediate refreshes every leaf's
-    bounds from dense box adjacency once per ROUND — output clipping
-    always uses fresh bounds; cached candidate gains may lag one round,
-    the same class of lag the strict learner documents per split), and
-    path smoothing.
+    bounds from dense box adjacency after EACH split, the strict
+    learner's cadence, so splits later in a round see earlier splits'
+    outputs; cached candidate GAINS of unsplit leaves may lag a round,
+    the same class of lag the strict learner documents), and path
+    smoothing.
     """
     if hp.use_monotone:
         assert monotone is not None and hp.monotone_method in (
@@ -307,7 +308,7 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                   st["path_f"] = st["path_f"].at[nl].set(
                       jnp.where(ok, new_path, st["path_f"][nl]))
               if use_boxes:
-                  from .monotone import split_boxes
+                  from .monotone import box_bounds, split_boxes
                   n_lo, n_hi = split_boxes(
                       st["leaf_lo"], st["leaf_hi"], bl, nl, feat, thr,
                       ~catl)
@@ -349,6 +350,17 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
               # split leaves' cached gains are consumed
               st["best_gain"] = st["best_gain"].at[bl].set(
                   jnp.where(ok, NEG_INF, st["best_gain"][bl]))
+              if use_boxes:
+                  # per-SPLIT bound refresh, same cadence as the strict
+                  # learner: a leaf split later in this round sees the
+                  # updated outputs of leaves split earlier (without this,
+                  # two order-adjacent leaves split in one round could
+                  # violate the constraint)
+                  lower, upper = box_bounds(
+                      st["leaf_lo"], st["leaf_hi"], t.leaf_value,
+                      monotone, t.num_leaves)
+                  st["leaf_min"] = jnp.where(ok, lower, st["leaf_min"])
+                  st["leaf_max"] = jnp.where(ok, upper, st["leaf_max"])
 
           # ---- all K partitions in ONE widened pass (each row belongs to at
           # most one split parent, so the K moves compose by summation)
@@ -474,18 +486,6 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                   st["slot_leaf"] = slot_leaf.at[P].set(-1)
                   st["leaf_slot"] = leaf_slot.at[L].set(-1)
 
-          # intermediate monotone: refresh EVERY leaf's output bounds from
-          # dense box adjacency once per round (learner/monotone.py; the
-          # strict learner refreshes per split — clipping always uses the
-          # latest refresh either way)
-          if use_boxes:
-              from .monotone import box_bounds
-              lower, upper = box_bounds(
-                  st["leaf_lo"], st["leaf_hi"], st["tree"].leaf_value,
-                  monotone, st["tree"].num_leaves)
-              st["leaf_min"] = lower
-              st["leaf_max"] = upper
-
           # ---- child best splits, vmapped over the 2K children
           with jax.named_scope("find_splits"):
               kids = jnp.concatenate([parents, safe_nl])              # [2K]
@@ -532,11 +532,16 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     # data size (static at trace time): each width is its own kernel
     # compilation, worth it only when passes are expensive.
     if warmup and n >= 65536:
+        # width QUADRUPLING (1, 4, 16, ...): each width always covers the
+        # frontier (it at most doubles per round), and since kernel cost
+        # is K-independent below 128 channels (docs/PERF_NOTES.md round
+        # 3), fewer warmup rounds beat finer width matching — profiled
+        # ~2 full passes saved per tree vs doubling
         kw = 1
         while kw < K:
             state = lax.cond(state["progress"] & (state["n_splits"] < L - 1),
                              make_round_body(kw), lambda st: st, state)
-            kw *= 2
+            kw *= 4
     # loop until the tree is full or a round makes no progress — a fixed
     # ceil((L-1)/K) budget would starve narrow-frontier (chain-shaped) trees
     # where only ~1 leaf per round carries positive gain

@@ -44,25 +44,35 @@ def _take_pallas(idx: jax.Array, table: jax.Array, *,
         idx = jnp.pad(idx, (0, n_pad - n))
     nb = n_pad // blk
     idx2 = idx[None, :]
-    table2 = table[None, :]
+    table2 = table.reshape(t_pad // 16, 16)   # radix rows (hi, lo)
+
+    nhi = t_pad // 16
 
     def kernel(idx_ref, tab_ref, out_ref):
         ix = idx_ref[0, :]                                   # [blk] i32
-        iota = lax.iota(jnp.int32, t_pad)
-        onehot = (ix[:, None] == iota[None, :]).astype(jnp.float32)
-        # HIGHEST precision: the default TPU f32 matmul runs bf16 passes
-        # (~1e-3 rel error) — the one-hot payload must come through exact
-        out_ref[0, :] = lax.dot_general(
-            onehot, tab_ref[0, :][:, None], (((1,), (0,)), ((), ())),
+        # radix-split lookup: idx = 16*hi + lo.  tmp = oh_hi @ TAB[nhi, 16]
+        # then a 16-wide elementwise select on lo — 2*(nhi+16) one-hot
+        # elements per row instead of t_pad (same trick as the histogram
+        # radix kernels; measured ~5x on the 1M-row score update).
+        # HIGHEST precision: the one-hot payload must come through exact.
+        hi = ix >> 4
+        lo = ix & 15
+        iota_h = lax.iota(jnp.int32, nhi)
+        oh_hi = (hi[:, None] == iota_h[None, :]).astype(jnp.float32)
+        tmp = lax.dot_general(
+            oh_hi, tab_ref[:, :], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
-            precision=lax.Precision.HIGHEST)[:, 0]
+            precision=lax.Precision.HIGHEST)                 # [blk, 16]
+        iota_l = lax.iota(jnp.int32, 16)
+        sel = (lo[:, None] == iota_l[None, :]).astype(jnp.float32)
+        out_ref[0, :] = jnp.sum(tmp * sel, axis=1)
 
     out = pl.pallas_call(
         kernel,
         grid=(nb,),
         in_specs=[
             pl.BlockSpec((1, blk), lambda i: (0, i)),
-            pl.BlockSpec((1, t_pad), lambda i: (0, 0)),
+            pl.BlockSpec((t_pad // 16, 16), lambda i: (0, 0)),
         ],
         out_specs=pl.BlockSpec((1, blk), lambda i: (0, i)),
         out_shape=jax.ShapeDtypeStruct((1, n_pad), jnp.float32),

@@ -65,16 +65,19 @@ def _machines_to_worker_map(machines: Optional[str], n_workers: int,
     return [f"127.0.0.1:{_free_port()}" for _ in range(n_workers)]
 
 
-def _shard_rows(n: int, n_workers: int,
-                group: Optional[np.ndarray]) -> list:
-    """Disjoint row index cover per rank; ranking data stripes whole
-    queries (a query's rows must stay on one rank)."""
+def _shard_rows(n: int, n_workers: int, group: Optional[np.ndarray]) -> list:
+    """Per-rank (row_indices, group_sizes) covers; ranking data stripes
+    whole queries (a query's rows must stay on one rank).  The single
+    source of the striping rule — worker payloads reuse its output."""
     if group is not None and len(group):
         sizes = np.asarray(group, np.int64)
         qid_of_row = np.repeat(np.arange(sizes.shape[0]), sizes)
-        return [np.flatnonzero(qid_of_row % n_workers == r)
-                for r in range(n_workers)]
-    return [np.arange(r, n, n_workers) for r in range(n_workers)]
+        out = []
+        for r in range(n_workers):
+            keep_q = np.arange(sizes.shape[0]) % n_workers == r
+            out.append((np.flatnonzero(keep_q[qid_of_row]), sizes[keep_q]))
+        return out
+    return [(np.arange(r, n, n_workers), None) for r in range(n_workers)]
 
 
 def launch(params: Dict[str, Any], data, label=None, *,
@@ -103,7 +106,12 @@ def launch(params: Dict[str, Any], data, label=None, *,
     with tempfile.TemporaryDirectory(prefix="lgbtpu_cluster_") as tmp:
         specs = []
         shards = None
-        if not isinstance(data, (str, os.PathLike)):
+        if isinstance(data, (str, os.PathLike)):
+            if label is not None or weight is not None or group is not None:
+                log.fatal("launch(data=<path>): label/weight/group must "
+                          "come from the file (each worker loads its own "
+                          "stripe); in-memory arrays would be ignored")
+        else:
             X = np.asarray(data, np.float64)
             y = None if label is None else np.asarray(label)
             shards = _shard_rows(X.shape[0], n_workers, group)
@@ -120,18 +128,15 @@ def launch(params: Dict[str, Any], data, label=None, *,
             if shards is None:
                 spec["data_path"] = str(data)
             else:
-                idx = shards[rank]
+                idx, grp_sizes = shards[rank]
                 shard_path = os.path.join(tmp, f"shard_{rank}.npz")
                 payload = {"X": X[idx]}
                 if y is not None:
                     payload["y"] = y[idx]
                 if weight is not None:
                     payload["w"] = np.asarray(weight)[idx]
-                if group is not None and len(group):
-                    sizes = np.asarray(group, np.int64)
-                    qid = np.repeat(np.arange(sizes.shape[0]), sizes)
-                    keep_q = np.arange(sizes.shape[0]) % n_workers == rank
-                    payload["g"] = sizes[keep_q]
+                if grp_sizes is not None:
+                    payload["g"] = grp_sizes
                 np.savez(shard_path, **payload)
                 spec["shard_path"] = shard_path
             spec_path = os.path.join(tmp, f"spec_{rank}.json")
@@ -143,7 +148,15 @@ def launch(params: Dict[str, Any], data, label=None, *,
         logs = []
         for rank, spec_path in enumerate(specs):
             env = dict(os.environ)
-            env.pop("PYTHONPATH", None)  # axon sitecustomize pre-registers
+            # drop only sitecustomize-injection entries (their premature
+            # jax import breaks platform forcing); user PYTHONPATH entries
+            # that make lightgbm_tpu importable must survive
+            pp = [e for e in env.get("PYTHONPATH", "").split(os.pathsep)
+                  if e and "axon" not in e]
+            if pp:
+                env["PYTHONPATH"] = os.pathsep.join(pp)
+            else:
+                env.pop("PYTHONPATH", None)
             if devices_per_worker > 0:
                 # MUST happen before the worker imports jax (package import
                 # runs at interpreter start, before _worker_main executes),
@@ -161,19 +174,34 @@ def launch(params: Dict[str, Any], data, label=None, *,
                 [sys.executable, "-m", "lightgbm_tpu.parallel.cluster",
                  spec_path],
                 env=env, stdout=lf, stderr=subprocess.STDOUT))
+        # poll ALL workers against one shared deadline: the first crash
+        # kills the survivors immediately (they would otherwise hang in
+        # the distributed barrier until the full timeout) and ITS log is
+        # the one surfaced
+        import time as _time
+        deadline = _time.monotonic() + timeout_s
         fail = None
-        for rank, p in enumerate(procs):
-            try:
-                p.wait(timeout=timeout_s)
-            except subprocess.TimeoutExpired:
+        live = dict(enumerate(procs))
+        while live and fail is None:
+            for rank in list(live):
+                rc = live[rank].poll()
+                if rc is None:
+                    continue
+                del live[rank]
+                if rc != 0:
+                    logs[rank].flush()
+                    with open(logs[rank].name, errors="replace") as fh:
+                        tail = fh.read()[-2000:]
+                    fail = f"worker {rank} exited {rc}:\n{tail}"
+            if live and fail is None:
+                if _time.monotonic() > deadline:
+                    fail = f"workers {sorted(live)} timed out"
+                else:
+                    _time.sleep(0.2)
+        for p in procs:
+            if p.poll() is None:
                 p.kill()
                 p.wait()
-                fail = fail or f"worker {rank} timed out"
-            if p.returncode != 0 and fail is None:
-                logs[rank].flush()
-                with open(logs[rank].name, errors="replace") as fh:
-                    tail = fh.read()[-2000:]
-                fail = f"worker {rank} exited {p.returncode}:\n{tail}"
         for lf in logs:
             lf.close()
         if fail:

@@ -36,12 +36,16 @@ def test_machines_map_and_sharding():
     m2 = _machines_to_worker_map("hostA,hostB:9000", 2, 12400)
     assert m2 == ["hostA:12400", "hostB:9000"]
     shards = _shard_rows(10, 3, None)
-    assert sorted(np.concatenate(shards).tolist()) == list(range(10))
-    # ranking: whole queries per rank
+    assert sorted(np.concatenate([s[0] for s in shards]).tolist()) \
+        == list(range(10))
+    assert all(g is None for _, g in shards)
+    # ranking: whole queries per rank, with per-rank group sizes
     shards_q = _shard_rows(10, 2, np.array([4, 3, 3]))
-    got = sorted(np.concatenate(shards_q).tolist())
+    got = sorted(np.concatenate([s[0] for s in shards_q]).tolist())
     assert got == list(range(10))
-    assert shards_q[0].tolist() == [0, 1, 2, 3, 7, 8, 9]  # queries 0 and 2
+    assert shards_q[0][0].tolist() == [0, 1, 2, 3, 7, 8, 9]  # queries 0, 2
+    assert shards_q[0][1].tolist() == [4, 3]
+    assert shards_q[1][1].tolist() == [3]
 
 
 def test_launch_trains_binary_2proc_4dev():
