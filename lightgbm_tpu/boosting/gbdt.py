@@ -695,7 +695,8 @@ class GBDT:
                     *args, batch=int(self.config.tpu_split_batch),
                     bundle=self.bundle, monotone=self.monotone_arr,
                     hist_scale=hist_scale,
-                    interaction_sets=self.interaction_sets)
+                    interaction_sets=self.interaction_sets,
+                    rng_key=node_key)
             kwargs = dict(monotone=self.monotone_arr, rng_key=node_key,
                           interaction_sets=self.interaction_sets,
                           forced=self.forced_splits, bundle=self.bundle,
@@ -753,15 +754,19 @@ class GBDT:
         unsupported = (mono_strict
                        or self.forced_splits is not None
                        or self.cegb is not None
-                       or self.hp.extra_trees
-                       or self.hp.feature_fraction_bynode < 1.0
                        or self.linear
                        or self.parallel_mode not in (None, "data"))
+        # extra_trees / by-node sampling need per-node rng keys, which the
+        # sharded batched wrapper does not plumb yet — serial only
+        rng_parallel = self.parallel_mode is not None and (
+            self.hp.extra_trees or self.hp.feature_fraction_bynode < 1.0)
+        unsupported = unsupported or rng_parallel
         if unsupported:
             if not getattr(self, "_warned_batch", False):
                 log.warning("tpu_split_batch > 1 ignored: advanced "
-                            "monotone, forced splits, cegb, "
-                            "extra_trees, bynode sampling, linear_tree and "
+                            "monotone, forced splits, cegb, linear_tree, "
+                            "extra_trees/bynode-sampling under distributed "
+                            "modes, and "
                             "voting/feature parallel modes require the "
                             "strict leaf-wise learner")
                 self._warned_batch = True

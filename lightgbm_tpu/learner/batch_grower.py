@@ -40,7 +40,8 @@ from ..ops.split import (NEG_INF, VAR_CAT_BWD, VAR_CAT_FWD, SplitHyper,
                          categorical_left_bitset, find_best_split,
                          leaf_output)
 from .grower import (DeviceBundle, TreeArrays, _INF_BOUND, _empty_tree,
-                     _expand_hist, _expand_hist_col, _feature_bin_of_rows)
+                     _expand_hist, _expand_hist_col, _feature_bin_of_rows,
+                     sample_features_bynode)
 
 
 @functools.partial(jax.jit, static_argnames=("hp", "batch", "axis_name",
@@ -55,7 +56,8 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                       axis_name: Optional[str] = None,
                       warmup: bool = True,
                       hist_scale: Optional[jax.Array] = None,
-                      interaction_sets: Optional[jax.Array] = None
+                      interaction_sets: Optional[jax.Array] = None,
+                      rng_key: Optional[jax.Array] = None
                       ) -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree with ``batch`` splits per histogram pass.
 
@@ -76,6 +78,8 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     use_boxes = hp.use_monotone and hp.monotone_method == "intermediate"
     use_paths = interaction_sets is not None
     use_smooth = hp.path_smooth > 0.0
+    use_bynode = hp.feature_fraction_bynode < 1.0 and rng_key is not None
+    use_rng = rng_key is not None and (hp.extra_trees or use_bynode)
     n = bins.shape[0]
     num_f = bins.shape[1] if bundle is None else bundle.feat_col.shape[0]
     L = hp.num_leaves
@@ -94,25 +98,30 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             lax.bitcast_convert_type(hess, jnp.uint8),
         ], axis=1))
 
-    def node_mask(path_f):
-        """Per-leaf allowed features under interaction constraints
-        (reference col_sampler.hpp:91 GetByNode): a leaf may split only on
-        features from constraint sets containing its whole path."""
-        if not use_paths:
-            return feature_mask
-        fits = jnp.all(interaction_sets | ~path_f[None, :], axis=1)   # [S]
-        allowed = jnp.any(interaction_sets & fits[:, None],
-                          axis=0) | path_f
-        return allowed if feature_mask is None \
-            else (feature_mask & allowed)
+    def node_mask(path_f, key=None):
+        """Per-leaf allowed features: interaction constraints (reference
+        col_sampler.hpp:91 GetByNode — a leaf may split only on features
+        from constraint sets containing its whole path) composed with the
+        per-node random subset (feature_fraction_bynode)."""
+        m = feature_mask
+        if use_paths:
+            fits = jnp.all(interaction_sets | ~path_f[None, :], axis=1)
+            allowed = jnp.any(interaction_sets & fits[:, None],
+                              axis=0) | path_f
+            m = allowed if m is None else (m & allowed)
+        if use_bynode and key is not None:
+            m = sample_features_bynode(m, key, hp.feature_fraction_bynode,
+                                       num_f)
+        return m
 
-    def child_best(h_phys, g_, h_, c_, depth, lmin, lmax, fm, pout):
+    def child_best(h_phys, g_, h_, c_, depth, lmin, lmax, fm, pout,
+                   key=None):
         hv = h_phys if bundle is None else \
             _expand_hist(h_phys, bundle, g_, h_, c_)
         res = find_best_split(hv, g_, h_, c_, num_bins, nan_bin, is_cat,
                               fm, hp, monotone=monotone,
                               leaf_min=lmin, leaf_max=lmax, depth=depth,
-                              parent_output=pout)
+                              parent_output=pout, rng_key=key)
         depth_ok = (hp.max_depth <= 0) | (depth < hp.max_depth)
         return res._replace(gain=jnp.where(depth_ok, res.gain, NEG_INF))
 
@@ -144,8 +153,9 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     root_out = leaf_output(g0, h0, hp.lambda_l1, hp.lambda_l2,
                            hp.max_delta_step)
     empty_path = jnp.zeros((num_f,), bool)
+    key_root = jax.random.fold_in(rng_key, 0) if use_rng else None
     best0 = child_best(hist0_b, g0, h0, c0, jnp.int32(0), -INF, INF,
-                       node_mask(empty_path), root_out)
+                       node_mask(empty_path, key_root), root_out, key_root)
 
     tree = _empty_tree(L, hp.n_bins, num_f)
     tree = tree._replace(
@@ -491,8 +501,23 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
               kids = jnp.concatenate([parents, safe_nl])              # [2K]
               kid_hist = jnp.concatenate([h_left, h_right], axis=0)
               depths = st["tree"].leaf_depth[kids]
-              if use_paths:
-                  fms = jax.vmap(node_mask)(st["path_f"][kids])
+              # deterministic per-node keys folded on (split node id, side)
+              # — unique per evaluation (a leaf id would COLLIDE between a
+              # parent and its left child, freezing the by-node subset down
+              # every left spine); same uniqueness source as the strict
+              # learner's split-counter fold
+              sides = jnp.concatenate([jnp.zeros((Kr,), jnp.int32),
+                                       jnp.ones((Kr,), jnp.int32)])
+              node2 = jnp.concatenate([node_ids, node_ids])
+              keys = (jax.vmap(lambda nd, sd: jax.random.fold_in(
+                          rng_key, nd * 2 + sd + 1))(node2, sides)
+                      if use_rng else None)
+              if use_paths or use_bynode:
+                  paths_k = (st["path_f"][kids] if use_paths else
+                             jnp.zeros((2 * Kr, num_f), bool))
+                  fms = jax.vmap(node_mask)(
+                      paths_k, keys) if use_bynode else \
+                      jax.vmap(node_mask)(paths_k)
               else:
                   fms = (jnp.broadcast_to(feature_mask, (2 * Kr,)
                                           + feature_mask.shape)
@@ -501,11 +526,12 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
               res = jax.vmap(
                   child_best,
                   in_axes=(0, 0, 0, 0, 0, 0, 0,
-                           None if fms is None else 0, 0))(
+                           None if fms is None else 0, 0,
+                           None if keys is None else 0))(
                   kid_hist, st["sum_g"][kids],
                   st["sum_h"][kids], st["count"][kids],
                   depths, st["leaf_min"][kids],
-                  st["leaf_max"][kids], fms, pouts)
+                  st["leaf_max"][kids], fms, pouts, keys)
               ok2 = jnp.concatenate([valid, valid])
               gains2 = jnp.where(ok2, res.gain, st["best_gain"][kids])
               st["best_gain"] = st["best_gain"].at[kids].set(gains2)

@@ -182,6 +182,20 @@ def _empty_tree(num_leaves: int, n_bins: int, num_f: int) -> TreeArrays:
     )
 
 
+def sample_features_bynode(mask: Optional[jax.Array], key: jax.Array,
+                           frac: float, num_f: int) -> jax.Array:
+    """Random per-node feature subset (reference col_sampler.hpp
+    feature_fraction_bynode): keep ceil-ish frac of the allowed features,
+    uniformly.  SINGLE implementation shared by the strict and batched
+    growers so their sampling stays bit-identical."""
+    base = jnp.ones((num_f,), bool) if mask is None else mask
+    u = jax.random.uniform(key, (num_f,))
+    u = jnp.where(base, u, -1.0)
+    cnt = jnp.maximum((base.sum() * frac).astype(jnp.int32), 1)
+    kth = jnp.sort(u)[num_f - cnt]
+    return base & (u >= kth) & (u >= 0)
+
+
 def _child_best(hist: jax.Array, g: jax.Array, h: jax.Array, c: jax.Array,
                 depth: jax.Array, num_bins, nan_bin, is_cat, feature_mask,
                 hp: SplitHyper, monotone=None, parent_output=0.0,
@@ -291,13 +305,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                               axis=0) | path_f
             m = allowed if m is None else (m & allowed)
         if use_bynode:
-            base = jnp.ones((num_f,), bool) if m is None else m
-            u = jax.random.uniform(key, (num_f,))
-            u = jnp.where(base, u, -1.0)
-            cnt = jnp.maximum(
-                (base.sum() * hp.feature_fraction_bynode).astype(jnp.int32), 1)
-            kth = jnp.sort(u)[num_f - cnt]
-            m = base & (u >= kth) & (u >= 0)
+            m = sample_features_bynode(m, key, hp.feature_fraction_bynode,
+                                       num_f)
         return m
 
     # transposed layout once per tree: the histogram kernel and the

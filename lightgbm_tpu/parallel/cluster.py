@@ -152,7 +152,7 @@ def launch(params: Dict[str, Any], data, label=None, *,
             # jax import breaks platform forcing); user PYTHONPATH entries
             # that make lightgbm_tpu importable must survive
             pp = [e for e in env.get("PYTHONPATH", "").split(os.pathsep)
-                  if e and "axon" not in e]
+                  if e and not e.rstrip("/").endswith(".axon_site")]
             if pp:
                 env["PYTHONPATH"] = os.pathsep.join(pp)
             else:

@@ -357,3 +357,27 @@ def test_batched_path_smooth_matches_strict(synthetic_binary):
     assert b_batch._gbdt._use_batched_grower()
     assert b_batch.model_to_string().split("parameters:")[0] != \
         b_nosmooth.model_to_string().split("parameters:")[0]
+
+
+def test_batched_extra_trees_and_bynode(synthetic_binary):
+    """extra_trees + feature_fraction_bynode through the batched grower:
+    trains, differs from the deterministic model, and stays accurate."""
+    X, y = synthetic_binary
+    base = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 5,
+            "verbose": -1, "tpu_split_batch": 4}
+    p = dict(base, extra_trees=True, feature_fraction_bynode=0.6,
+             extra_seed=11)
+    bst = lgb.train(p, lgb.Dataset(X, label=y, params=p),
+                    num_boost_round=8)
+    assert bst._gbdt._use_batched_grower()
+    acc = ((bst.predict(X) > 0.5) == (y > 0)).mean()
+    assert acc > 0.8
+    b0 = lgb.train(base, lgb.Dataset(X, label=y, params=base),
+                   num_boost_round=8)
+    assert bst.model_to_string().split("parameters:")[0] != \
+        b0.model_to_string().split("parameters:")[0]
+    # deterministic under the same seed
+    bst2 = lgb.train(p, lgb.Dataset(X, label=y, params=p),
+                     num_boost_round=8)
+    assert bst.model_to_string().split("parameters:")[0] == \
+        bst2.model_to_string().split("parameters:")[0]
