@@ -696,7 +696,7 @@ class GBDT:
                     bundle=self.bundle, monotone=self.monotone_arr,
                     hist_scale=hist_scale,
                     interaction_sets=self.interaction_sets,
-                    rng_key=node_key)
+                    rng_key=node_key, forced=self.forced_splits)
             kwargs = dict(monotone=self.monotone_arr, rng_key=node_key,
                           interaction_sets=self.interaction_sets,
                           forced=self.forced_splits, bundle=self.bundle,
@@ -751,15 +751,18 @@ class GBDT:
         # (learner/batch_grower.py); the rest still needs the strict learner
         mono_strict = self.hp.use_monotone \
             and self.hp.monotone_method == "advanced"
+        forced_pooled = self.forced_splits is not None \
+            and 0 < self.hp.hist_pool_slots < self.hp.num_leaves
         unsupported = (mono_strict
-                       or self.forced_splits is not None
+                       or forced_pooled
                        or self.cegb is not None
                        or self.linear
                        or self.parallel_mode not in (None, "data"))
         # extra_trees / by-node sampling need per-node rng keys, which the
         # sharded batched wrapper does not plumb yet — serial only
         rng_parallel = self.parallel_mode is not None and (
-            self.hp.extra_trees or self.hp.feature_fraction_bynode < 1.0)
+            self.hp.extra_trees or self.hp.feature_fraction_bynode < 1.0
+            or self.forced_splits is not None)
         unsupported = unsupported or rng_parallel
         if unsupported:
             if not getattr(self, "_warned_batch", False):

@@ -19,11 +19,13 @@ throughput.  The reference has no counterpart; its CPU learner pays
 O(child rows) per split and needs no such amortization.
 
 Supported feature set: numerical splits with missing handling, categorical
-splits (one-hot + sorted-subset, applied via per-split bitsets), basic-method
-monotone constraints, EFB bundles, bagging row masks, per-tree feature
+splits (one-hot + sorted-subset, applied via per-split bitsets),
+basic/intermediate monotone constraints, interaction constraints, path
+smoothing, forced splits (K=1 prefix phase), extra_trees + per-node
+feature sampling, EFB bundles, bagging row masks, per-tree feature
 sampling, depth limits, data-parallel ``shard_map`` (axis psum).
-Intermediate/advanced monotone, forced splits, interaction constraints and
-CEGB route through the strict learner (boosting/gbdt.py dispatch).
+Advanced monotone, CEGB and linear trees route through the strict
+learner (boosting/gbdt.py dispatch).
 """
 
 from __future__ import annotations
@@ -57,7 +59,9 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                       warmup: bool = True,
                       hist_scale: Optional[jax.Array] = None,
                       interaction_sets: Optional[jax.Array] = None,
-                      rng_key: Optional[jax.Array] = None
+                      rng_key: Optional[jax.Array] = None,
+                      forced: Optional[Tuple[jax.Array, jax.Array,
+                                             jax.Array]] = None
                       ) -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree with ``batch`` splits per histogram pass.
 
@@ -210,15 +214,70 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         state["leaf_lo"] = jnp.zeros((L, num_f), jnp.int32)
         state["leaf_hi"] = jnp.zeros((L, num_f), jnp.int32).at[0].set(
             num_bins.astype(jnp.int32))
+    if forced is not None:
+        assert not pooled, \
+            "forced splits do not compose with hist_pool_slots yet"
+        state["force_failed"] = jnp.bool_(False)
     if pooled:
         state["leaf_slot"] = jnp.full((L + 1,), -1, jnp.int32).at[0].set(0)
         state["slot_leaf"] = jnp.full((P + 1,), -1, jnp.int32).at[0].set(0)
 
-    def make_round_body(Kr):
+    def make_round_body(Kr, use_forced=False):
       def round_body(st):
+          if use_forced:
+              # forced-split round (reference serial_tree_learner.cpp:620
+              # ForceSplits; same math as the strict learner's forced
+              # gather): entry index == split counter, stats gathered at
+              # the PRESCRIBED threshold from the leaf's histogram, staged
+              # into the cached-best slots so the normal record machinery
+              # applies them
+              from ..ops.split import VAR_CAT_ONEHOT, VAR_NUM_RIGHT
+              from .grower import gather_forced_split
+              f_leaf, f_feat, f_thr = forced
+              i = jnp.minimum(st["n_splits"], f_leaf.shape[0] - 1)
+              f_active = (f_leaf[i] >= 0) & ~st["force_failed"]
+              fl = jnp.maximum(f_leaf[i], 0)
+              ff, ft = f_feat[i], f_thr[i]
+              hf_col = st["hist"][fl, ff if bundle is None
+                                  else bundle.feat_col[ff]]      # [B, C]
+              hf = hf_col if bundle is None else \
+                  _expand_hist_col(hf_col, bundle, ff, st["sum_g"][fl],
+                                   st["sum_h"][fl], st["count"][fl])
+              pgf, phf, pcf = st["sum_g"][fl], st["sum_h"][fl], \
+                  st["count"][fl]
+              lgf, lhf, lcf, gf, ok_f = gather_forced_split(
+                  hf, pgf, phf, pcf, ft, is_cat[ff], nan_bin[ff], hp)
+              use_f = f_active & ok_f
+              st = dict(st)
+              st["force_failed"] = st["force_failed"] | (f_active & ~ok_f)
+
+              def sset(name, val):
+                  st[name] = st[name].at[fl].set(
+                      jnp.where(use_f, val, st[name][fl]))
+
+              sset("best_gain", gf)
+              sset("best_feat", ff)
+              sset("best_thr", ft)
+              sset("best_dl", jnp.bool_(False))
+              sset("best_var", jnp.where(is_cat[ff], VAR_CAT_ONEHOT,
+                                         VAR_NUM_RIGHT))
+              sset("best_lg", lgf)
+              sset("best_lh", lhf)
+              sset("best_lc", lcf)
+              forced_sel = (fl, use_f)
+          else:
+              forced_sel = None
           topg, parents = lax.top_k(st["best_gain"], Kr)          # [K]
+          if forced_sel is not None:
+              # the forced leaf is the round's ONLY candidate (Kr == 1)
+              parents = jnp.where(forced_sel[1], forced_sel[0][None],
+                                  parents)
+              topg = jnp.where(forced_sel[1], st["best_gain"][parents[0]]
+                               [None], topg)
           room = st["n_splits"] + lax.iota(jnp.int32, Kr) < L - 1
           valid = (topg > 0.0) & room
+          if forced_sel is not None:
+              valid = valid & forced_sel[1][None]
           rank = jnp.cumsum(valid.astype(jnp.int32)) - 1          # [K]
           node_ids = st["n_splits"] + rank                        # [K]
           new_leaves = node_ids + 1                               # [K]
@@ -557,12 +616,26 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     # selection semantics, just fewer masked channels per pass.  Gated on
     # data size (static at trace time): each width is its own kernel
     # compilation, worth it only when passes are expensive.
-    if warmup and n >= 65536:
+    if forced is not None:
+        # forced-split phase: one K=1 round per schedule entry, in BFS
+        # order (entry index == split counter, as in the strict learner);
+        # a failed entry aborts the remaining schedule
+        f_leaf0 = forced[0]
+        state = lax.while_loop(
+            lambda st: (st["n_splits"] < L - 1) & ~st["force_failed"]
+            & (f_leaf0[jnp.minimum(st["n_splits"],
+                                   f_leaf0.shape[0] - 1)] >= 0),
+            make_round_body(1, use_forced=True), state)
+        # a failed/exhausted forced round leaves progress False; the
+        # gain-based loops below must still run
+        state["progress"] = jnp.bool_(True)
+    if warmup and n >= 65536 and forced is None:
         # width QUADRUPLING (1, 4, 16, ...): each width always covers the
         # frontier (it at most doubles per round), and since kernel cost
         # is K-independent below 128 channels (docs/PERF_NOTES.md round
         # 3), fewer warmup rounds beat finer width matching — profiled
-        # ~2 full passes saved per tree vs doubling
+        # ~2 full passes saved per tree vs doubling.  Skipped after a
+        # forced phase: the forced frontier can exceed the warmup widths.
         kw = 1
         while kw < K:
             state = lax.cond(state["progress"] & (state["n_splits"] < L - 1),

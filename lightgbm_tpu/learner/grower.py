@@ -182,6 +182,31 @@ def _empty_tree(num_leaves: int, n_bins: int, num_f: int) -> TreeArrays:
     )
 
 
+def gather_forced_split(hf: jax.Array, pg, ph, pc, ft, is_cat_f, nan_bin_f,
+                        hp: SplitHyper):
+    """Stats/validity of a PRESCRIBED split from a leaf's histogram column
+    (reference FeatureHistogram::GatherInfoForThreshold, invoked by
+    ForceSplits serial_tree_learner.cpp:620).  ``hf``: f32 [B, C] expanded
+    histogram of the forced feature.  Returns (lg, lh, lc, gain, ok) —
+    the SINGLE implementation shared by the strict and batched learners.
+    """
+    b_i = lax.iota(jnp.int32, hp.n_bins)
+    lm = jnp.where(is_cat_f, b_i == ft, (b_i <= ft) & (b_i != nan_bin_f))
+    lmf = lm.astype(hf.dtype)
+    lg = jnp.sum(hf[:, 0] * lmf)
+    lh = jnp.sum(hf[:, 1] * lmf)
+    lc = jnp.sum(hf[:, 2] * lmf)
+    rg, rh, rc = pg - lg, ph - lh, pc - lc
+    gain = (leaf_gain(lg, lh, hp.lambda_l1, hp.lambda_l2)
+            + leaf_gain(rg, rh, hp.lambda_l1, hp.lambda_l2)
+            - leaf_gain(pg, ph, hp.lambda_l1, hp.lambda_l2)
+            - hp.min_gain_to_split)
+    ok = ((lc >= hp.min_data_in_leaf) & (rc >= hp.min_data_in_leaf)
+          & (lh >= hp.min_sum_hessian_in_leaf)
+          & (rh >= hp.min_sum_hessian_in_leaf) & (gain > 0.0))
+    return lg, lh, lc, gain, ok
+
+
 def sample_features_bynode(mask: Optional[jax.Array], key: jax.Array,
                            frac: float, num_f: int) -> jax.Array:
     """Random per-node feature subset (reference col_sampler.hpp
@@ -512,24 +537,9 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             hf = hf_col if bundle is None else \
                 _expand_hist_col(hf_col, bundle, ff, st.sum_g[fl],
                                  st.sum_h[fl], st.count[fl])
-            b_i = lax.iota(jnp.int32, hp.n_bins)
-            lm = jnp.where(is_cat[ff], b_i == ft,
-                           (b_i <= ft) & (b_i != nan_bin[ff]))
-            lmf = lm.astype(hf.dtype)
-            lgf = jnp.sum(hf[:, 0] * lmf)
-            lhf = jnp.sum(hf[:, 1] * lmf)
-            lcf = jnp.sum(hf[:, 2] * lmf)
             pgf, phf, pcf = st.sum_g[fl], st.sum_h[fl], st.count[fl]
-            rgf, rhf, rcf = pgf - lgf, phf - lhf, pcf - lcf
-            gf = (leaf_gain(lgf, lhf, hp.lambda_l1, hp.lambda_l2)
-                  + leaf_gain(rgf, rhf, hp.lambda_l1, hp.lambda_l2)
-                  - leaf_gain(pgf, phf, hp.lambda_l1, hp.lambda_l2)
-                  - hp.min_gain_to_split)
-            ok_f = ((lcf >= hp.min_data_in_leaf)
-                    & (rcf >= hp.min_data_in_leaf)
-                    & (lhf >= hp.min_sum_hessian_in_leaf)
-                    & (rhf >= hp.min_sum_hessian_in_leaf)
-                    & (gf > 0.0))
+            lgf, lhf, lcf, gf, ok_f = gather_forced_split(
+                hf, pgf, phf, pcf, ft, is_cat[ff], nan_bin[ff], hp)
             use_f = f_active & ok_f
             st = st._replace(force_failed=st.force_failed
                              | (f_active & ~ok_f))

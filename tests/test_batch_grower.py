@@ -381,3 +381,37 @@ def test_batched_extra_trees_and_bynode(synthetic_binary):
                      num_boost_round=8)
     assert bst.model_to_string().split("parameters:")[0] == \
         bst2.model_to_string().split("parameters:")[0]
+
+
+def test_batched_forced_splits_match_strict(tmp_path, synthetic_binary):
+    """Forced splits through the batched grower: the forced prefix of the
+    tree matches the strict learner exactly (same BFS schedule, same
+    gathered stats)."""
+    import json
+    X, y = synthetic_binary
+    fpath = tmp_path / "forced.json"
+    fpath.write_text(json.dumps(
+        {"feature": 0, "threshold": 0.0,
+         "left": {"feature": 1, "threshold": 0.5}}))
+    base = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 5,
+            "verbose": -1, "forcedsplits_filename": str(fpath)}
+    p_strict = dict(base, tpu_split_batch=1)
+    p_batch = dict(base, tpu_split_batch=4)
+    bs = lgb.train(p_strict, lgb.Dataset(X, label=y, params=p_strict),
+                   num_boost_round=4)
+    bb = lgb.train(p_batch, lgb.Dataset(X, label=y, params=p_batch),
+                   num_boost_round=4)
+    assert bb._gbdt._use_batched_grower()
+    ds = bs.dump_model()["tree_info"]
+    db = bb.dump_model()["tree_info"]
+    for ts, tb in zip(ds, db):
+        # roots forced to feature 0 @ 0.0; left child forced to feature 1
+        assert ts["tree_structure"]["split_feature"] == 0
+        assert tb["tree_structure"]["split_feature"] == 0
+        assert abs(tb["tree_structure"]["threshold"]
+                   - ts["tree_structure"]["threshold"]) < 1e-9
+        # the second forced entry must have APPLIED in both learners
+        ls = ts["tree_structure"]["left_child"]
+        lb = tb["tree_structure"]["left_child"]
+        assert ls["split_feature"] == 1
+        assert lb["split_feature"] == 1
