@@ -212,4 +212,11 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as e:  # ALWAYS leave a JSON line for the driver
+        print(json.dumps({
+            "metric": f"bench_error_{type(e).__name__}"[:80],
+            "value": -1.0, "unit": "seconds", "vs_baseline": 0.0,
+            "error": str(e)[:300]}), flush=True)
+        raise
