@@ -1,0 +1,82 @@
+"""bench.py supervision: the driver-facing entry must never lose a round.
+
+Mirrors the reference's stance that benchmarks are artifacts with CI-level
+guarantees (docs/Experiments.rst reproduces exact configs); here the
+guarantee is: wedged tunnel => stale-but-real cached number, not rc=1.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def _run_copy(tmp_path, env_extra, cache=None, timeout=120):
+    """Run a copy of bench.py from tmp_path (so the real bench_cache.json
+    is untouched) with a scrubbed env (no axon sitecustomize)."""
+    with open(BENCH) as f:
+        (tmp_path / "bench.py").write_text(f.read())
+    if cache is not None:
+        (tmp_path / "bench_cache.json").write_text(json.dumps(cache))
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+    env.update(env_extra)
+    return subprocess.run([sys.executable, str(tmp_path / "bench.py")],
+                          capture_output=True, text=True, env=env,
+                          timeout=timeout)
+
+
+def test_stale_cache_fallback(tmp_path):
+    """All attempts fail -> cached measurement re-emitted with stale:true,
+    preferring the entry matching the requested bench mode."""
+    cache = {"kernel": {"metric": "higgs_synth_x", "value": 1.23,
+                        "unit": "seconds", "vs_baseline": 0.5,
+                        "platform": "axon"},
+             "e2e": {"metric": "higgs_e2e_x", "value": 9.9,
+                     "unit": "seconds", "vs_baseline": 0.4, "auc": 0.84,
+                     "platform": "axon"}}
+    # probe can't finish in 0.2 s on any machine -> every attempt fails
+    p = _run_copy(tmp_path, {"BENCH_ATTEMPTS": "0.2:0.2,0.2:0.2"}, cache)
+    assert p.returncode == 0, p.stderr
+    out = json.loads(p.stdout.strip().splitlines()[-1])
+    assert out["stale"] is True and "stale_reason" in out
+    assert out["vs_baseline"] == 0.5          # kernel entry for kernel mode
+    p = _run_copy(tmp_path, {"BENCH_ATTEMPTS": "0.2:0.2",
+                             "BENCH_E2E": "1"}, cache)
+    out = json.loads(p.stdout.strip().splitlines()[-1])
+    assert out["vs_baseline"] == 0.4          # e2e entry for e2e mode
+
+
+def test_legacy_single_payload_cache_still_works(tmp_path):
+    cache = {"metric": "higgs_synth_x", "value": 1.23, "unit": "seconds",
+             "vs_baseline": 0.5, "platform": "axon"}
+    p = _run_copy(tmp_path, {"BENCH_ATTEMPTS": "0.2:0.2"}, cache)
+    assert p.returncode == 0, p.stderr
+    out = json.loads(p.stdout.strip().splitlines()[-1])
+    assert out["stale"] is True and out["vs_baseline"] == 0.5
+
+
+def test_total_failure_without_cache_is_rc1(tmp_path):
+    p = _run_copy(tmp_path, {"BENCH_ATTEMPTS": "0.2:0.2"})
+    assert p.returncode == 1
+    out = json.loads(p.stdout.strip().splitlines()[-1])
+    assert out["metric"].startswith("backend_unreachable")
+    assert out["vs_baseline"] == 0.0
+
+
+@pytest.mark.slow
+def test_supervised_cpu_run_succeeds(tmp_path):
+    """Healthy backend -> child measurement relayed, rc=0, CPU not cached."""
+    p = _run_copy(tmp_path,
+                  {"JAX_PLATFORMS": "cpu", "BENCH_ROWS": "5000",
+                   "BENCH_ITERS": "2", "BENCH_LEAVES": "15",
+                   "BENCH_SPLIT_BATCH": "4", "BENCH_ATTEMPTS": "120:400",
+                   "PYTHONPATH": REPO}, timeout=500)
+    assert p.returncode == 0, (p.stdout, p.stderr)
+    out = json.loads(p.stdout.strip().splitlines()[-1])
+    assert out["value"] > 0 and out["platform"] == "cpu"
+    # CPU numbers must NOT seed the stale-fallback cache
+    assert not (tmp_path / "bench_cache.json").exists()
