@@ -68,7 +68,6 @@ def _hp_from_config(cfg: Config, n_bins: int) -> SplitHyper:
         hist_dtype=("float32" if cfg.deterministic
                     else str(cfg.tpu_hist_dtype)),
         leaf_hist=str(cfg.tpu_leaf_hist),
-        grouped_hist=bool(cfg.tpu_grouped_hist),
         extra_trees=bool(cfg.extra_trees),
         feature_fraction_bynode=float(cfg.feature_fraction_bynode),
     )
@@ -168,31 +167,6 @@ class GBDT:
         self.best_iteration = -1
 
         # device operands
-        self.hp = _hp_from_config(config, train_set.device_n_bins())
-        if bool(train_set.categorical_array().any()):
-            self.hp = dataclasses.replace(self.hp, has_categorical=True)
-        # bounded histogram pool (reference histogram_pool_size MB,
-        # serial_tree_learner.cpp:36-47): translate the MB budget into
-        # batched-grower pool slots; evicted parents re-histogram both
-        # children directly (learner/batch_grower.py)
-        pool_mb = float(config.histogram_pool_size)
-        if pool_mb > 0:
-            n_cols = train_set.bins.shape[1]
-            bytes_per_leaf = n_cols * self.hp.n_bins * 4 * 4
-            slots = int(pool_mb * (1 << 20) // max(bytes_per_leaf, 1))
-            kbatch = max(1, int(config.tpu_split_batch))
-            slots = max(slots, 3 * kbatch + 2)
-            if slots < self.hp.num_leaves:
-                if self.hp.has_categorical:
-                    log.warning("histogram_pool_size ignored: the bounded "
-                                "pool does not compose with categorical "
-                                "features yet")
-                elif kbatch <= 1:
-                    log.warning("histogram_pool_size requires the batched "
-                                "grower (tpu_split_batch > 1); ignored")
-                else:
-                    self.hp = dataclasses.replace(
-                        self.hp, hist_pool_slots=slots)
         self.bins = jnp.asarray(train_set.bins)
         self.num_bins_arr = jnp.asarray(train_set.num_bins_array())
         self.nan_bin_arr = jnp.asarray(train_set.nan_bin_array())
@@ -267,6 +241,85 @@ class GBDT:
             log.warning(f"tree_learner={tl} requested but only {n_dev} "
                         "device(s) visible; using serial")
 
+        # linear leaves (linear_tree=true): raw feature values on device
+        # (reference LinearTreeLearner keeps Dataset raw_data_)
+        self.linear = bool(config.linear_tree) and train_set.raw is not None
+        self.raw_dev = jnp.asarray(train_set.raw) if self.linear else None
+        self._valid_raw: List[Optional[jnp.ndarray]] = []
+
+        # hp + constraint arrays, shared with reset_config (ADVICE r3: the
+        # reference's GBDT::ResetConfig re-derives these too)
+        self._derive_learner_state(config)
+
+        n = train_set.num_data
+        k = self.num_tree_per_iteration
+        self.scores = jnp.zeros((n, k), jnp.float32)
+        self.init_scores = np.zeros(k)
+        self._init_base_score()
+
+        self.sample_strategy = create_sample_strategy(config, n)
+        self._rng = np.random.default_rng(
+            config.seed if config.seed is not None else config.data_random_seed)
+
+        # validation sets
+        self.valid_sets: List[Dataset] = []
+        self.valid_names: List[str] = []
+        self.valid_scores: List[jnp.ndarray] = []
+        self.valid_metrics: List[List[Metric]] = []
+        self._valid_bins: List[jnp.ndarray] = []
+
+    # ------------------------------------------------------------- helpers
+    def _resolve_auto_params(self, config: Config) -> None:
+        """Fast-by-default policy (VERDICT r3 #3): at scale, a plain
+        ``train()`` gets the batched grower and the exact quantized-grad
+        bf16 kernel path without opting in — the same configuration the
+        bench runs.  Decision-identity of that path vs the f32 kernel is
+        proven (ops/quantize.py, tests/test_quantized.py); leaf values are
+        renewed from true gradients.  Small runs keep the exact-f32 strict
+        path: there the extra kernel compilations dominate and exactness
+        is free.  Any explicit user setting, ``deterministic=true``,
+        feature-parallel (no level-scale plumbing) and linear trees
+        (true-gradient ridge fits) win over the policy."""
+        at_scale = self.train_set.num_data >= 100_000
+        # only auto-batch configurations the batched grower supports —
+        # an auto K on e.g. linear_tree would just warn-and-fall-back
+        batchable = (not bool(config.linear_tree)
+                     and str(config.monotone_constraints_method) != "advanced"
+                     and float(config.cegb_penalty_split) == 0.0
+                     and not list(config.cegb_penalty_feature_lazy or [])
+                     and not list(config.cegb_penalty_feature_coupled or [])
+                     and self.parallel_mode in (None, "data"))
+        if not config.is_explicit("tpu_split_batch"):
+            if at_scale and batchable and int(config.num_leaves) >= 8:
+                config.tpu_split_batch = min(28, int(config.num_leaves) - 1)
+        if (at_scale and not config.deterministic
+                and self.parallel_mode != "feature"
+                and not bool(config.linear_tree)
+                and not config.is_explicit("tpu_hist_dtype")
+                and not config.is_explicit("use_quantized_grad")):
+            config.tpu_hist_dtype = "bfloat16"
+            config.use_quantized_grad = True
+            if not config.is_explicit("quant_train_renew_leaf"):
+                config.quant_train_renew_leaf = True
+            log.info("auto speed mode: tpu_split_batch=%d, exact "
+                     "quantized-grad bfloat16 kernels (set "
+                     "tpu_hist_dtype=float32 or deterministic=true to "
+                     "opt out)" % int(config.tpu_split_batch))
+
+    def _derive_learner_state(self, config: Config) -> None:
+        """Derive ``hp`` and the constraint/penalty device arrays from a
+        config.  Called from ``__init__`` AND ``reset_config`` so a
+        parameter reset re-applies the histogram-pool translation and
+        refreshes monotone/interaction/forced/CEGB arrays exactly like the
+        reference's ``GBDT::ResetConfig`` -> ``TreeLearner::ResetConfig``
+        (gbdt.cpp, serial_tree_learner.cpp).  Requires ``parallel_mode``
+        and the device bins to be set already."""
+        train_set = self.train_set
+        self._resolve_auto_params(config)
+        self.hp = _hp_from_config(config, train_set.device_n_bins())
+        if bool(train_set.categorical_array().any()):
+            self.hp = dataclasses.replace(self.hp, has_categorical=True)
+
         # monotone constraints: per-ORIGINAL-feature directions from config,
         # remapped to packed (used) features; categorical features forced 0
         self.monotone_arr = None
@@ -305,14 +358,9 @@ class GBDT:
             self.forced_splits = _parse_forced_splits(
                 config.forcedsplits_filename, train_set, self.hp.num_leaves)
 
-        # linear leaves (linear_tree=true): raw feature values on device
-        # (reference LinearTreeLearner keeps Dataset raw_data_)
-        self.linear = bool(config.linear_tree) and train_set.raw is not None
-        self.raw_dev = jnp.asarray(train_set.raw) if self.linear else None
-        self._valid_raw: List[Optional[jnp.ndarray]] = []
-
         # CEGB penalties (cost_effective_gradient_boosting.hpp): acquisition
-        # state persists across ALL trees like the reference learner's
+        # state persists across ALL trees like the reference learner's (and
+        # resets on reset_config, like its ResetConfig recreating CEGB)
         self.cegb: Optional[CegbInput] = None
         if (float(config.cegb_penalty_split) > 0.0
                 or list(config.cegb_penalty_feature_lazy or [])
@@ -338,24 +386,40 @@ class GBDT:
                 used_rows=jnp.zeros((train_set.num_data, self.num_features),
                                     bool) if (lazy != 0).any() else None)
 
-        n = train_set.num_data
-        k = self.num_tree_per_iteration
-        self.scores = jnp.zeros((n, k), jnp.float32)
-        self.init_scores = np.zeros(k)
-        self._init_base_score()
+        # bounded histogram pool (reference histogram_pool_size MB,
+        # serial_tree_learner.cpp:36-47): translate the MB budget into
+        # batched-grower pool slots; evicted parents re-histogram both
+        # children directly (learner/batch_grower.py).  Composes with
+        # categorical splits (cached winner bitsets) and with the strict
+        # order via a batch=1 batched-grower route (_use_batched_grower);
+        # derived LAST so the strict-only feature checks see final state.
+        pool_mb = float(config.histogram_pool_size)
+        if pool_mb > 0:
+            n_cols = train_set.bins.shape[1]
+            bytes_per_leaf = n_cols * self.hp.n_bins * 4 * 4
+            slots = int(pool_mb * (1 << 20) // max(bytes_per_leaf, 1))
+            kbatch = max(1, int(config.tpu_split_batch))
+            slots = max(slots, 3 * kbatch + 2)
+            if slots < self.hp.num_leaves:
+                if self.parallel_mode is not None:
+                    # the pooled layout needs per-shard counts; under
+                    # shard_map it would trip the batch_grower assert
+                    # (ADVICE r3 medium) — full per-leaf histograms instead
+                    log.warning("histogram_pool_size ignored under "
+                                "tree_learner=%s (the bounded pool is "
+                                "serial-only)" % self.parallel_mode)
+                elif (self.cegb is not None or self.linear
+                      or self.forced_splits is not None
+                      or (self.hp.use_monotone
+                          and self.hp.monotone_method == "advanced")):
+                    log.warning("histogram_pool_size ignored: cegb, "
+                                "linear_tree, forced splits and advanced "
+                                "monotone constraints require the strict "
+                                "full-histogram learner")
+                else:
+                    self.hp = dataclasses.replace(
+                        self.hp, hist_pool_slots=slots)
 
-        self.sample_strategy = create_sample_strategy(config, n)
-        self._rng = np.random.default_rng(
-            config.seed if config.seed is not None else config.data_random_seed)
-
-        # validation sets
-        self.valid_sets: List[Dataset] = []
-        self.valid_names: List[str] = []
-        self.valid_scores: List[jnp.ndarray] = []
-        self.valid_metrics: List[List[Metric]] = []
-        self._valid_bins: List[jnp.ndarray] = []
-
-    # ------------------------------------------------------------- helpers
     def _init_base_score(self) -> None:
         has_init_score = self.train_set.metadata.init_score is not None
         if self.objective is None or has_init_score:
@@ -412,10 +476,40 @@ class GBDT:
     def invalidate_score_cache(self) -> None:
         """Rebuild cached train/valid scores from the current model list
         (after leaf edits, merges or shuffles — the reference's
-        ScoreUpdater is re-driven the same way on BoosterSetLeafValue)."""
+        ScoreUpdater is re-driven the same way on BoosterSetLeafValue).
+        Linear-leaf trees contribute const + coeff·raw, not the plain leaf
+        constant (ADVICE r3: the reference replays Tree::Predict, which
+        takes the is_linear_ branch, tree.h:587)."""
         k = self.num_tree_per_iteration
+        any_linear = any(t.is_linear for t in self.models)
+        o2p = {int(o): p
+               for p, o in enumerate(self.train_set.used_feature_idx)}
 
-        def rebuild(n, bins_d, init_score):
+        def linear_adjust(t, arrs, bins_d, n, raw, base):
+            """Replace the plain leaf constants with the linear-leaf
+            output (host mirror of models/tree.py Tree.predict linear
+            branch, on packed raw columns)."""
+            leaf = np.asarray(predict_bins_leaf(
+                arrs, bins_d, self.nan_bin_arr, self.bundle,
+                self.hp.has_categorical))[:n]
+            out = (t.leaf_const[leaf] - t.bias).astype(np.float32)
+            nan_bad = np.zeros(n, bool)
+            for l in range(t.num_leaves):
+                feats = t.leaf_features[l]
+                if not feats:
+                    continue
+                rows = leaf == l
+                if not rows.any():
+                    continue
+                cols = [o2p[f] for f in feats]
+                vals = raw[np.ix_(rows, cols)]
+                nan_bad[rows] = np.isnan(vals).any(axis=1)
+                out[rows] += (np.nan_to_num(vals)
+                              @ np.asarray(t.leaf_coeff[l])).astype(
+                                  np.float32)
+            return np.where(nan_bad, base, out)
+
+        def rebuild(n, bins_d, init_score, raw):
             sc = np.zeros((n, k), np.float32) + self.init_scores[None, :]
             if init_score is not None:
                 sc += init_score.reshape(sc.shape, order="F") \
@@ -427,27 +521,37 @@ class GBDT:
                 contrib = np.asarray(predict_bins_tree(
                     arrs, bins_d, self.nan_bin_arr, self.bundle,
                     self.hp.has_categorical), np.float32)[:n]
+                if t.is_linear:
+                    if raw is None:
+                        log.fatal("score-cache rebuild for a linear_tree "
+                                  "model needs the dataset's raw feature "
+                                  "matrix (construct with linear_tree "
+                                  "enabled)")
+                    contrib = linear_adjust(t, arrs, bins_d, n, raw, contrib)
                 sc[:, i % k] += contrib
             return jnp.asarray(sc)
 
+        train_raw = self.train_set.raw if any_linear else None
         self.scores = rebuild(self.train_set.num_data, self.bins,
-                              self.train_set.metadata.init_score)
+                              self.train_set.metadata.init_score, train_raw)
         for vi in range(len(self.valid_sets)):
             vs = self.valid_sets[vi]
             self.valid_scores[vi] = rebuild(
-                vs.num_data, self._valid_bins[vi], vs.metadata.init_score)
+                vs.num_data, self._valid_bins[vi], vs.metadata.init_score,
+                vs.raw if any_linear else None)
 
     def reset_config(self, config: Config) -> None:
         """Swap learning-control parameters on the live booster
         (reference GBDT::ResetConfig gbdt.cpp): learner hyperparameters,
-        shrinkage and the sampling strategy follow the new config;
-        objective/metrics/dataset stay."""
+        pool translation, constraint arrays, shrinkage and the sampling
+        strategy follow the new config; objective/metrics/dataset stay."""
+        if bool(config.linear_tree) != bool(self.config.linear_tree):
+            log.warning("linear_tree cannot be changed on a live booster; "
+                        "keeping linear_tree=%s" % self.config.linear_tree)
+            config.linear_tree = self.config.linear_tree
         self.config = config
         self.shrinkage_rate = float(config.learning_rate)
-        hp = _hp_from_config(config, self.train_set.device_n_bins())
-        if bool(self.train_set.categorical_array().any()):
-            hp = dataclasses.replace(hp, has_categorical=True)
-        self.hp = hp
+        self._derive_learner_state(config)
         self.sample_strategy = create_sample_strategy(
             config, self.train_set.num_data)
 
@@ -743,8 +847,12 @@ class GBDT:
 
     def _use_batched_grower(self) -> bool:
         """Batched split rounds (learner/batch_grower.py) when requested and
-        the tree uses only its supported feature set."""
-        if int(self.config.tpu_split_batch) <= 1:
+        the tree uses only its supported feature set.  An active bounded
+        pool routes through the batched grower even at tpu_split_batch=1
+        (batch=1 rounds produce trees IDENTICAL to the strict learner, so
+        histogram_pool_size composes with strict leaf-wise order)."""
+        pool_active = 0 < self.hp.hist_pool_slots < self.hp.num_leaves
+        if int(self.config.tpu_split_batch) <= 1 and not pool_active:
             return False
         # categorical splits, basic/intermediate monotone, interaction
         # constraints and path smoothing are batched-capable

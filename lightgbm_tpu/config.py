@@ -242,13 +242,12 @@ _PARAMS: List[Tuple[str, Any, Tuple[str, ...], Tuple[Tuple[str, float], ...]]] =
     ("gpu_device_id", -1, (), ()),
     ("gpu_use_dp", False, (), ()),
     ("num_gpu", 1, (), ((">", 0),)),
-    ("tpu_hist_dtype", "float32", (), ()),       # hist product dtype; float32 (default) = exact CPU/reference parity, bfloat16 = ~3x faster kernels with ~2^-9 grad/hess input rounding; deterministic=true always forces float32
+    ("tpu_hist_dtype", "float32", (), ()),       # hist product dtype; float32 = exact CPU/reference parity, bfloat16 = ~3x faster kernels; AUTO POLICY: at >=100k rows and deterministic=false, an unset value engages bfloat16 with exact quantized-grad levels (decision-identical; boosting/gbdt.py _resolve_auto_params); deterministic=true always forces float32
     ("tpu_debug_checks", False, (), ()),         # per-tree invariant checks (reference DEBUG CheckSplitValid)
     ("tpu_device_eval", True, (), ()),           # jitted device metric eval (l2/l1/rmse/logloss/error/auc/ndcg); host f64 when false or deterministic=true
     ("tpu_rows_per_block", 16384, (), ()),        # histogram kernel row tile
     ("tpu_leaf_hist", "masked", (), ()),          # per-leaf hist: masked|bucketed
-    ("tpu_split_batch", 1, (), ((">", 0),)),      # splits per histogram pass
-    ("tpu_grouped_hist", False, (), ()),          # leaf-grouped compacted histogram kernel (experimental)
+    ("tpu_split_batch", 1, (), ((">", 0),)),      # splits per histogram pass; AUTO POLICY: unset at >=100k rows resolves to min(28, num_leaves-1)
     ("tpu_donate_scores", True, (), ()),
 ]
 

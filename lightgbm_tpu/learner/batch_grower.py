@@ -37,7 +37,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..ops.histogram import histogram_for_leaves_auto, root_histogram
+from ..ops.histogram import (bins_to_words, histogram_for_leaves_auto,
+                             root_histogram)
+from ..ops.round_fuse import partition_select_pallas, use_fused_partition
 from ..ops.split import (NEG_INF, VAR_CAT_BWD, VAR_CAT_FWD, SplitHyper,
                          categorical_left_bitset, find_best_split,
                          leaf_output)
@@ -91,16 +93,16 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     mask_f = jnp.ones_like(grad) if row_mask is None \
         else row_mask.astype(grad.dtype)
     bins_t = lax.optimization_barrier(bins.T)
+    # tree-invariant i32 word view of the row-major bins, hoisted out of
+    # the round loop: every compacted round's payload concat reuses it
+    bins_words = lax.optimization_barrier(bins_to_words(bins))
+    # fused partition+key kernel (ops/round_fuse.py): numeric non-bundled
+    # splits only — categorical bitsets / EFB inverse tables are per-row
+    # gathers, kept on the XLA path
+    fuse_partition = (use_fused_partition() and not hp.has_categorical
+                      and bundle is None)
+    from ..ops.histogram import use_pallas as _use_pallas
     INF = jnp.float32(_INF_BOUND)
-    # one [n, F+8] u8 payload (bins row + grad + hess) for the grouped
-    # compaction path's single-gather — tree-invariant, built once
-    packed_rows = None
-    if hp.grouped_hist:
-        packed_rows = lax.optimization_barrier(jnp.concatenate([
-            bins,
-            lax.bitcast_convert_type(grad, jnp.uint8),
-            lax.bitcast_convert_type(hess, jnp.uint8),
-        ], axis=1))
 
     def node_mask(path_f, key=None):
         """Per-leaf allowed features: interaction constraints (reference
@@ -128,6 +130,20 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                               parent_output=pout, rng_key=key)
         depth_ok = (hp.max_depth <= 0) | (depth < hp.max_depth)
         return res._replace(gain=jnp.where(depth_ok, res.gain, NEG_INF))
+
+    def winner_bitset(h_phys, g_, h_, c_, feat, var, thr):
+        """Left-category bitset of a CACHED best split, computed from the
+        leaf's own histogram at best-split time (same inputs as the
+        strict learner's split-time computation, so identical output).
+        Caching it in state removes the record phase's parent-histogram
+        read — the step that kept the bounded pool and categorical
+        splits apart (an evicted parent has no histogram to read)."""
+        col_of = feat if bundle is None else bundle.feat_col[feat]
+        pf_col = h_phys[col_of]
+        hist_col = pf_col if bundle is None else \
+            _expand_hist_col(pf_col, bundle, feat, g_, h_, c_)
+        return categorical_left_bitset(
+            hist_col, num_bins[feat], var, thr, hp) & is_cat[feat]
 
     # quantized-levels mode (ops/quantize.py): grad/hess hold integer
     # levels; one deterministic multiply restores real units right after
@@ -174,8 +190,6 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     pooled = 0 < hp.hist_pool_slots < L
     P = hp.hist_pool_slots
     if pooled:
-        assert not hp.has_categorical, \
-            "hist_pool_slots does not compose with categorical splits yet"
         assert P >= 3 * K + 2, \
             "hist_pool_slots must be >= 3*batch+2 for worst-case rounds"
         assert axis_name is None, \
@@ -206,6 +220,10 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         n_splits=jnp.int32(0),
         progress=jnp.bool_(True),
     )
+    if hp.has_categorical:
+        state["best_bitset"] = jnp.zeros((L, hp.n_bins), bool).at[0].set(
+            winner_bitset(hist0_b, g0, h0, c0, best0.feature,
+                          best0.variant, best0.threshold))
     if use_paths:
         state["path_f"] = jnp.zeros((L, num_f), bool)
     if use_boxes:
@@ -264,6 +282,13 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
               sset("best_lg", lgf)
               sset("best_lh", lhf)
               sset("best_lc", lcf)
+              if hp.has_categorical:
+                  var_f = jnp.where(is_cat[ff], VAR_CAT_ONEHOT,
+                                    VAR_NUM_RIGHT)
+                  bs_f = winner_bitset(st["hist"][fl], pgf, phf, pcf,
+                                       ff, var_f, ft)
+                  st["best_bitset"] = st["best_bitset"].at[fl].set(
+                      jnp.where(use_f, bs_f, st["best_bitset"][fl]))
               forced_sel = (fl, use_f)
           else:
               forced_sel = None
@@ -301,16 +326,12 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                   st["best_lc"][bl]
               rg, rh, rcn = pg - lg, ph - lh, pc - lcn
 
-              # left-category bitset from the PARENT histogram (st["hist"][bl]
-              # still holds the parent at record time; the strict learner does
-              # the same, grower.py split())
+              # left-category bitset CACHED at best-split time (state
+              # best_bitset, winner_bitset) — identical to computing it
+              # from the parent histogram here, but works when the pool
+              # evicted that histogram
               if hp.has_categorical:
-                  col_of = feat if bundle is None else bundle.feat_col[feat]
-                  pf_col = st["hist"][bl, col_of]
-                  hist_pf = pf_col if bundle is None else \
-                      _expand_hist_col(pf_col, bundle, feat, pg, ph, pc)
-                  bitset = categorical_left_bitset(
-                      hist_pf, num_bins[feat], var, thr, hp) & catl
+                  bitset = st["best_bitset"][bl]
               else:
                   bitset = jnp.zeros((hp.n_bins,), bool)
               bitsets.append(bitset)
@@ -431,26 +452,49 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                   st["leaf_min"] = jnp.where(ok, lower, st["leaf_min"])
                   st["leaf_max"] = jnp.where(ok, upper, st["leaf_max"])
 
-          # ---- all K partitions in ONE widened pass (each row belongs to at
-          # most one split parent, so the K moves compose by summation)
+          # smaller-child bookkeeping first: the fused partition kernel
+          # emits the NEXT histogram pass's compaction keys, so it needs
+          # the smaller-leaf set up front (state counts are already
+          # updated by the record loop above)
+          safe_nl = jnp.where(valid, new_leaves, L - 1)
+          l_cnt = st["count"][parents]
+          r_cnt = st["count"][safe_nl]
+          smaller = jnp.where(l_cnt <= r_cnt, parents, safe_nl)
+
+          # ---- all K partitions in ONE widened pass (each row belongs to
+          # at most one split parent, so the K moves compose by summation)
+          sort_key = None
           with jax.named_scope("partition"):
               feats_k = st["best_feat"][parents]                      # [K]
-              cols_k = jax.vmap(
-                  lambda f: _feature_bin_of_rows(bins_t, bundle, f))(feats_k)
-              thr_k = st["best_thr"][parents][:, None]
-              dl_k = st["best_dl"][parents][:, None]
-              nanb_k = nan_bin[feats_k][:, None]
-              go_left_k = jnp.where(cols_k == nanb_k, dl_k, cols_k <= thr_k)
-              if hp.has_categorical:
-                  bitsets_k = jnp.stack(bitsets)                      # [K, B]
-                  cat_k = is_cat[feats_k][:, None]                    # [K, 1]
-                  go_cat_k = jnp.take_along_axis(bitsets_k, cols_k, axis=1)
-                  go_left_k = jnp.where(cat_k, go_cat_k, go_left_k)
-              in_parent = (lor[None, :] == parents[:, None]) \
-                  & valid[:, None]                                    # [K, n]
-              move = in_parent & ~go_left_k                           # [K, n]
-              target = jnp.sum(move * new_leaves[:, None], axis=0)    # [n]
-              lor = jnp.where(jnp.any(move, axis=0), target, lor)
+              if fuse_partition:
+                  lor, sort_key = partition_select_pallas(
+                      bins_t, lor, mask_f.astype(jnp.int32),
+                      feats_k, st["best_thr"][parents],
+                      st["best_dl"][parents].astype(jnp.int32),
+                      nan_bin[feats_k].astype(jnp.int32),
+                      parents, new_leaves, valid.astype(jnp.int32),
+                      smaller, rows_per_block=min(hp.rows_per_block, 2048),
+                      interpret=not _use_pallas())
+              else:
+                  cols_k = jax.vmap(
+                      lambda f: _feature_bin_of_rows(bins_t, bundle, f))(
+                          feats_k)
+                  thr_k = st["best_thr"][parents][:, None]
+                  dl_k = st["best_dl"][parents][:, None]
+                  nanb_k = nan_bin[feats_k][:, None]
+                  go_left_k = jnp.where(cols_k == nanb_k, dl_k,
+                                        cols_k <= thr_k)
+                  if hp.has_categorical:
+                      bitsets_k = jnp.stack(bitsets)                  # [K, B]
+                      cat_k = is_cat[feats_k][:, None]                # [K, 1]
+                      go_cat_k = jnp.take_along_axis(bitsets_k, cols_k,
+                                                     axis=1)
+                      go_left_k = jnp.where(cat_k, go_cat_k, go_left_k)
+                  in_parent = (lor[None, :] == parents[:, None]) \
+                      & valid[:, None]                                # [K, n]
+                  move = in_parent & ~go_left_k                       # [K, n]
+                  target = jnp.sum(move * new_leaves[:, None], axis=0)  # [n]
+                  lor = jnp.where(jnp.any(move, axis=0), target, lor)
 
           st["tree"] = t
           st["leaf_of_row"] = lor
@@ -459,30 +503,27 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
 
           # ---- ONE widened pass: histograms of the K smaller children
           with jax.named_scope("round_hist"):
-              safe_nl = jnp.where(valid, new_leaves, L - 1)
-              l_cnt = st["count"][parents]
-              r_cnt = st["count"][safe_nl]
-              smaller = jnp.where(l_cnt <= r_cnt, parents, safe_nl)
               # masked row count of each smaller child (0 for invalid
-              # slots) — lets the grouped path skip its O(K*n) rank and
-              # count reductions (histogram_for_leaves_auto fast path).
-              # Under shard_map the state counts are GLOBAL (psum-ed) while
-              # compaction is per-shard, so the fast path must recompute
-              # locally there: pass no counts.
+              # slots) saves the membership reduction in the compaction
+              # path.  Under shard_map the state counts are GLOBAL
+              # (psum-ed) while compaction is per-shard, so pass no counts
+              # there (recomputed locally).
               small_cnt = (jnp.where(valid, jnp.minimum(l_cnt, r_cnt), 0.0)
                            if axis_name is None else None)
 
-              def hist_call(lv, cnts):
+              def hist_call(lv, cnts, skey=None):
                   return _scaled(histogram_for_leaves_auto(
                       bins, bins_t, grad, hess, lor, lv, row_mask,
                       n_bins=hp.n_bins, rows_per_block=hp.rows_per_block,
                       hist_dtype=hp.hist_dtype, axis_name=axis_name,
-                      grouped=hp.grouped_hist, counts=cnts,
-                      packed_rows=packed_rows))
+                      counts=cnts, bins_words=bins_words, sort_key=skey))
 
               left_small = (l_cnt <= r_cnt)[:, None, None, None]
               if not pooled:
-                  h_small = hist_call(smaller, small_cnt)      # [K,Fb,B,C]
+                  # the fused kernel's keys target exactly the `smaller`
+                  # set; the pooled path's extended leaf set rebuilds its
+                  # own keys
+                  h_small = hist_call(smaller, small_cnt, sort_key)
                   h_parent = st["hist"][parents]
                   h_large = h_parent - h_small
                   h_left = jnp.where(left_small, h_small, h_large)
@@ -604,6 +645,13 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                       jnp.where(ok2, field, st[name][kids]))
               st["best_dl"] = st["best_dl"].at[kids].set(
                   jnp.where(ok2, res.default_left, st["best_dl"][kids]))
+              if hp.has_categorical:
+                  kb = jax.vmap(winner_bitset)(
+                      kid_hist, st["sum_g"][kids], st["sum_h"][kids],
+                      st["count"][kids], res.feature, res.variant,
+                      res.threshold)
+                  st["best_bitset"] = st["best_bitset"].at[kids].set(
+                      jnp.where(ok2[:, None], kb, st["best_bitset"][kids]))
           return st
 
       return round_body

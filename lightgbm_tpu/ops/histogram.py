@@ -33,10 +33,6 @@ from jax import lax
 
 NUM_CHANNELS = 4  # grad, hess, count, pad
 
-# test hook: lets the CPU suite exercise the grouped compaction path via the
-# pallas interpreter (use_pallas() is False off-TPU)
-_GROUPED_TEST_INTERPRET = False
-
 
 def _round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
@@ -248,29 +244,29 @@ def _rows_leaves_hist(bins_rows: jax.Array, grad: jax.Array,
         n_bins=n_bins, rows_per_block=rows_per_block, hist_dtype=hist_dtype)
 
 
-def _grouped_layout(cnt: jax.Array, n: int, s_pad: int, blk: int, K: int):
-    """Destination-side layout for the leaf-grouped kernel: where each
-    padded destination slot reads from in the (rank, row)-sorted order,
-    whether it is a real row, and each block's group id.
+# test hook: lets the CPU suite exercise the payload Pallas kernel via the
+# interpreter (use_pallas() is False off-TPU)
+_PAYLOAD_TEST_INTERPRET = False
 
-    Every group owns >= 1 block (its output tile must be written at least
-    once) and a whole number of blocks, so consecutive-block accumulation
-    in the kernel is exact."""
-    pad_cnt = jnp.maximum((cnt + blk - 1) // blk, 1) * blk          # [K]
-    P = jnp.concatenate([jnp.zeros(1, jnp.int32),
-                         jnp.cumsum(pad_cnt)])[:K].astype(jnp.int32)
-    cumc = jnp.concatenate([jnp.zeros(1, jnp.int32),
-                            jnp.cumsum(cnt)])[:K].astype(jnp.int32)
-    d = jnp.arange(s_pad, dtype=jnp.int32)
-    k_of = jnp.sum((d[:, None] >= P[None, :]).astype(jnp.int32),
-                   axis=1) - 1                                       # [s_pad]
-    k_of = jnp.clip(k_of, 0, K - 1)
-    off = d - P[k_of]
-    valid = off < cnt[k_of]
-    src_pos = jnp.clip(cumc[k_of] + jnp.minimum(
-        off, jnp.maximum(cnt[k_of] - 1, 0)), 0, n - 1)
-    bg = k_of[::blk]
-    return src_pos, valid, bg
+
+def _use_payload_kernel() -> bool:
+    import os
+    if os.environ.get("LGBMTPU_NO_PAYLOAD_KERNEL"):  # perf A/B escape hatch
+        return False
+    return use_pallas() or _PAYLOAD_TEST_INTERPRET
+
+
+def bins_to_words(bins_rows: jax.Array) -> jax.Array:
+    """u8 [n, F] row-major bins -> i32 [n, ceil(F/4)] word view (each word
+    packs 4 bin bytes little-endian).  Tree-invariant: built once and
+    reused by every compacted round's payload concat."""
+    n, num_f = bins_rows.shape
+    pad = (-num_f) % 4
+    if pad:
+        bins_rows = jnp.pad(bins_rows, ((0, 0), (0, pad)))
+    w = (num_f + pad) // 4
+    return lax.bitcast_convert_type(
+        bins_rows.reshape(n, w, 4), jnp.int32)
 
 
 def histogram_for_leaves_auto(bins_rows: jax.Array, bins_t: jax.Array,
@@ -281,55 +277,67 @@ def histogram_for_leaves_auto(bins_rows: jax.Array, bins_t: jax.Array,
                               hist_dtype: str = "float32",
                               axis_name: Optional[str] = None,
                               buckets=(4, 8, 16, 64),
-                              grouped: bool = False,
                               counts: Optional[jax.Array] = None,
-                              packed_rows: Optional[jax.Array] = None
+                              bins_words: Optional[jax.Array] = None,
+                              sort_key: Optional[jax.Array] = None
                               ) -> jax.Array:
     """K-leaf histograms with frontier compaction -> f32 [K, F, B, C].
 
     The TPU reformulation of the reference's O(smaller-child) histogram cost
     (serial_tree_learner.cpp:364-378 iterates only the leaf's data indices):
     when the rows belonging to ``leaves`` fit a power-of-two bucket, they are
-    compacted with a sized ``nonzero`` + contiguous row gather from the
-    ROW-major bin matrix and the kernel runs on the bucket; otherwise one
-    full masked pass (``histogram_for_leaves_masked``).  Total histogram work
-    per tree drops from O(n x rounds) to ~O(n log L), which the flat masked
-    pass cannot do.  Exact: the same rows contribute either way.
+    compacted with a packed single sort + contiguous row gather of an i32
+    WORD payload (4 bin bytes per word + grad/hess/leaf words — same 40
+    bytes/row as the old u8 layout) and the payload kernel runs on the
+    bucket; otherwise one full masked pass (``histogram_for_leaves_masked``).
+    Total histogram work per tree drops from O(n x rounds) to ~O(n log L),
+    which the flat masked pass cannot do.  Exact: the same rows contribute
+    either way.
+
+    A leaf-GROUPED compaction variant (rows sorted by leaf, block->leaf
+    scalar-prefetch steering) was built and measured slower end-to-end in
+    round 3 — the K-channel MXU multiplier it removes does not exist below
+    128 output channels, while its layout glue is real — and was deleted
+    (docs/PERF_NOTES.md round 3).
 
     ``bins_rows``: u8 [n, F] row-major; ``bins_t``: u8 [F, n] transposed.
 
     ``counts`` (f32 [K], optional): the caller's known masked row count per
-    leaf slot (0 for dummy slots).  It enables the efficient grouped path:
-    leaf ranks come from one fused compare-sum over the K slot ids and the
-    per-slot count reductions disappear from every round.
+    leaf slot (0 for dummy slots); saves the [K, n] membership reduction.
+    ``bins_words`` (i32 [n, ceil(F/4)], optional): ``bins_to_words`` result
+    hoisted out of the round loop by the caller.
+    ``sort_key`` (i32 [n], optional): precomputed (selected ? row :
+    row | 2^30) keys from the fused partition kernel (ops/round_fuse.py);
+    built here from the membership mask otherwise.
     """
     n = grad.shape[0]
     leaves = jnp.asarray(leaves, jnp.int32)
-    K = leaves.shape[0]
     lor = jnp.asarray(leaf_of_row, jnp.int32)
     if row_mask is not None:
         lor = jnp.where(row_mask, lor, -1)
     assert n < (1 << 30), "compaction packing needs n < 2^30 rows per shard"
     num_f = bins_rows.shape[1]
 
-    rank_bits = max((K + 1).bit_length(), 1)
-    # fall back to the masked/sorted paths (not an error) when the
-    # (rank, row) key cannot pack into the i32 sort
-    use_grouped = grouped and (use_pallas() or _GROUPED_TEST_INTERPRET) \
-        and n < (1 << (30 - rank_bits))
-    use_fast_grouped = use_grouped and counts is not None
-    if use_fast_grouped:
+    if counts is not None:
         cnt = jnp.sum(counts).astype(jnp.int32)
-        # fast-path branches never read sel; cheap stand-in keeps the
-        # switch operand structure uniform
-        sel = lor >= 0
     else:
-        eq = lor[None, :] == leaves[:, None]                  # [K, n]
-        sel = jnp.any(eq, axis=0)                             # [n]
+        sel = jnp.any(lor[None, :] == leaves[:, None], axis=0)    # [n]
         cnt = jnp.sum(sel.astype(jnp.int32))
+    if sort_key is None:
+        if counts is not None:
+            sel = jnp.any(lor[None, :] == leaves[:, None], axis=0)
+        # pack (selected?, row) into ONE i32 and single-sort in the
+        # branch — the first ``cnt`` sorted entries are exactly the
+        # selected rows in order.  A non-stable single-operand sort costs
+        # ~0.4 ms/1M on TPU vs ~1.4 ms for stable argsort and ~9 ms for
+        # sized ``nonzero`` (docs/PERF_NOTES.md).
+        iota_n = lax.iota(jnp.int32, n)
+        sort_key = jnp.where(sel, iota_n, iota_n | (1 << 30))
+    if bins_words is None:
+        bins_words = bins_to_words(bins_rows)
+    W = bins_words.shape[1]
 
     blk = min(rows_per_block, 2048)
-    kblk = min(1024, blk)
     sizes = []
     for d in buckets:
         s = _round_up(max(n // d, 1), blk)
@@ -341,157 +349,38 @@ def histogram_for_leaves_auto(bins_rows: jax.Array, bins_t: jax.Array,
             bins_t, grad, hess, lor, leaves, None, n_bins=n_bins,
             rows_per_block=rows_per_block, hist_dtype=hist_dtype)
 
-    def _grouped_hist_call(rows_c, g_c, h_c, vf, bg, kblk_b):
-        """Backend-dispatched grouped kernel (radix when bins allow)."""
-        if _radix_ok(n_bins):
-            from .hist_pallas import histogram_radix_grouped_pallas
-            return histogram_radix_grouped_pallas(
-                rows_c, g_c, h_c, vf, bg, K, n_bins=n_bins,
-                rows_per_block=kblk_b,
-                compute_dtype=jnp.dtype(hist_dtype).type,
-                interpret=not use_pallas())
-        from .hist_pallas import histogram_grouped_pallas
-        return histogram_grouped_pallas(
-            rows_c, g_c, h_c, vf, bg, K, n_bins=n_bins,
-            rows_per_block=kblk_b,
-            compute_dtype=jnp.dtype(hist_dtype).type,
-            interpret=not use_pallas())
-
-    if use_fast_grouped:
-        # Rank of each row among the K leaf slots.  Valid slots hold
-        # DISTINCT leaves (the batch grower's children are distinct), so
-        # first-match == sum-of-matches; dummy slots (count 0) are remapped
-        # to an id no row carries.  XLA fuses the [K, n] compare-multiply
-        # into one pass over lor — measured ~6x cheaper than a one-hot
-        # table lookup per round (docs/PERF_NOTES.md round 3).
-        counts_i = counts.astype(jnp.int32)
-        slot = jnp.arange(K, dtype=jnp.int32)
-        leaves_eff = jnp.where(counts_i > 0, leaves, -2)
-        match = lor[None, :] == leaves_eff[:, None]           # [K, n]
-        rank = jnp.sum(jnp.where(match, slot[:, None], 0), axis=0)
-        rank = jnp.where(jnp.any(match, axis=0), rank, K)
-        row_bits = 30 - rank_bits
-        iota_n = lax.iota(jnp.int32, n)
-        key = (rank << row_bits) | iota_n
-        order_full = jnp.sort(key, stable=False)
-
-    def make_fast_branch(S: int):
-        def branch(operands):
-            _, grad_, hess_, _ = operands
-            if packed_rows is not None:
-                # payload built ONCE per tree by the caller (bins/grad/hess
-                # never change across rounds)
-                packed_ = packed_rows
-            else:
-                packed_ = jnp.concatenate([
-                    bins_rows,
-                    lax.bitcast_convert_type(grad_, jnp.uint8),
-                    lax.bitcast_convert_type(hess_, jnp.uint8),
-                ], axis=1)                                   # [n, F+8]
-            order = order_full[:S] & ((1 << row_bits) - 1)   # [S]
-            # block size balancing per-group padding (<= S/4 total) against
-            # kernel block overhead
-            kblk_b = max(128, min(2048, S // max(4 * K, 1) // 128 * 128))
-            s_pad = _round_up(S, kblk_b) + K * kblk_b
-            src_pos, valid_d, bg = _grouped_layout(
-                counts_i, n, s_pad, kblk_b, K)
-            src_row = order[jnp.minimum(src_pos, S - 1)]
-            pc = packed_[src_row]                            # [s_pad, F+8]
-            rows_c = pc[:, :num_f]
-            g_c = lax.bitcast_convert_type(
-                pc[:, num_f:num_f + 4], jnp.float32)
-            h_c = lax.bitcast_convert_type(
-                pc[:, num_f + 4:num_f + 8], jnp.float32)
-            vf = valid_d.astype(jnp.float32)
-            # where(), not multiply: a NaN gradient on a pad-clipped row
-            # must not poison sums
-            g_c = jnp.where(valid_d, g_c, 0.0)
-            h_c = jnp.where(valid_d, h_c, 0.0)
-            return _grouped_hist_call(rows_c, g_c, h_c, vf, bg, kblk_b)
-        return branch
-
     def make_branch(S: int):
-        if use_fast_grouped:
-            return make_fast_branch(S)
-        if use_grouped:
-            def branch(operands):
-                # leaf-GROUPED compaction: sort by (leaf rank, row) so
-                # each leaf's rows are contiguous, pad groups to whole
-                # kernel blocks, and contract C=3 channels per block into
-                # a scalar-prefetch-steered output tile.
-                sel_, grad_, hess_, lor_ = operands
-                # rank/count work lives INSIDE the branch so full-pass
-                # rounds never pay the O(K*n) reductions
-                eq_ = lor_[None, :] == leaves[:, None]
-                sel_b = jnp.any(eq_, axis=0)
-                # first-match rank (duplicate dummy leaves collapse onto
-                # the first slot; their unused hist tiles come back zero)
-                rank_of_row = jnp.where(
-                    sel_b, jnp.argmax(eq_, axis=0).astype(jnp.int32), K)
-                cnt_k = jax.vmap(lambda k: jnp.sum(
-                    (rank_of_row == k).astype(jnp.int32)))(jnp.arange(K))
-                row_bits = 30 - rank_bits
-                iota_n = lax.iota(jnp.int32, n)
-                key = (rank_of_row << row_bits) | iota_n
-                order = jnp.sort(key, stable=False)[:S] \
-                    & ((1 << row_bits) - 1)                  # [S]
-                packed_ = jnp.concatenate([
-                    bins_rows,
-                    lax.bitcast_convert_type(grad_, jnp.uint8),
-                    lax.bitcast_convert_type(hess_, jnp.uint8),
-                ], axis=1)                                   # [n, F+8]
-                # whole kernel blocks regardless of the bucket's blk
-                # rounding (rows_per_block need not be a kblk multiple)
-                s_pad = _round_up(S, kblk) + K * kblk
-                src_pos, valid_d, bg = _grouped_layout(
-                    cnt_k, n, s_pad, kblk, K)
-                src_row = order[jnp.minimum(src_pos, S - 1)]
-                pc = packed_[src_row]                        # [s_pad, F+8]
-                rows_c = pc[:, :num_f]
-                g_c = lax.bitcast_convert_type(
-                    pc[:, num_f:num_f + 4], jnp.float32)
-                h_c = lax.bitcast_convert_type(
-                    pc[:, num_f + 4:num_f + 8], jnp.float32)
-                vf = valid_d.astype(jnp.float32)
-                # where(), not multiply: a NaN gradient on a pad-clipped
-                # row must not poison sums
-                g_c = jnp.where(valid_d, g_c, 0.0)
-                h_c = jnp.where(valid_d, h_c, 0.0)
-                return _grouped_hist_call(rows_c, g_c, h_c, vf, bg, kblk)
-            return branch
-
         def branch(operands):
-            sel_, grad_, hess_, lor_ = operands
-            # One u8 payload matrix holding (bins row, grad, hess, leaf) so
+            key_, grad_, hess_, lor_ = operands
+            # One payload matrix holding (bin words, grad, hess, leaf) so
             # the branch does a SINGLE contiguous row gather — separate
-            # gathers are DMA-descriptor bound (~9 ns/row each) and XLA lays
-            # an f32 [n, 4] stack out column-major, turning its row gather
-            # into lane gathers (docs/PERF_NOTES.md).  Built INSIDE the
-            # branch so full-pass rounds skip it and the sort entirely.
-            packed_ = jnp.concatenate([
-                bins_rows,
-                lax.bitcast_convert_type(grad_, jnp.uint8),   # [n, 4]
-                lax.bitcast_convert_type(hess_, jnp.uint8),
-                lax.bitcast_convert_type(lor_, jnp.uint8),
-            ], axis=1)                                        # [n, F+12]
-            # frontier indices: pack (selected?, row) into ONE i32 and
-            # single-sort — the first ``cnt`` entries are exactly the
-            # selected rows in order.  A non-stable single-operand sort
-            # costs ~0.4 ms/1M on TPU vs ~1.4 ms for stable argsort and
-            # ~9 ms for sized ``nonzero`` (docs/PERF_NOTES.md).
-            iota_n = lax.iota(jnp.int32, n)
-            idxc = jnp.sort(jnp.where(sel_, iota_n, iota_n | (1 << 30)),
-                            stable=False)[:S] & ((1 << 30) - 1)
+            # gathers are DMA-descriptor bound (~9 ns/row each).  The bin
+            # words are the hoisted tree-invariant view; only 12 bytes per
+            # row are fresh.  Built INSIDE the branch so full-pass rounds
+            # skip the concat and the sort entirely.
+            payload_ = jnp.concatenate([
+                bins_words,
+                lax.bitcast_convert_type(grad_, jnp.int32)[:, None],
+                lax.bitcast_convert_type(hess_, jnp.int32)[:, None],
+                lor_[:, None],
+            ], axis=1)                                        # [n, W+3] i32
+            idxc = jnp.sort(key_, stable=False)[:S] & ((1 << 30) - 1)
+            pc = payload_[idxc]                               # [S, W+3]
+            if _use_payload_kernel():
+                from .hist_pallas import histogram_payload_pallas
+                return histogram_payload_pallas(
+                    pc, leaves, cnt, num_f=num_f, n_bins=n_bins,
+                    rows_per_block=min(rows_per_block, 1024),
+                    compute_dtype=jnp.dtype(hist_dtype).type,
+                    interpret=not use_pallas())
+            # XLA fallback (CPU tests / non-TPU): unpack and run the
+            # generic rows path
             valid = lax.iota(jnp.int32, S) < cnt
-            pc = packed_[idxc]                                # [S, F+12] u8
-            rows_c = pc[:, :num_f]
-            grad_c = lax.bitcast_convert_type(
-                pc[:, num_f:num_f + 4], jnp.float32)
-            hess_c = lax.bitcast_convert_type(
-                pc[:, num_f + 4:num_f + 8], jnp.float32)
-            lor_g = lax.bitcast_convert_type(
-                pc[:, num_f + 8:num_f + 12], jnp.int32)
-            lor_c = jnp.where(valid, lor_g, -1)
+            rows_c = lax.bitcast_convert_type(
+                pc[:, :W], jnp.uint8).reshape(S, 4 * W)[:, :num_f]
+            grad_c = lax.bitcast_convert_type(pc[:, W], jnp.float32)
+            hess_c = lax.bitcast_convert_type(pc[:, W + 1], jnp.float32)
+            lor_c = jnp.where(valid, pc[:, W + 2], -1)
             return _rows_leaves_hist(rows_c, grad_c, hess_c, lor_c,
                                      leaves, n_bins=n_bins,
                                      rows_per_block=rows_per_block,
@@ -502,7 +391,7 @@ def histogram_for_leaves_auto(bins_rows: jax.Array, bins_t: jax.Array,
     j = jnp.int32(0)
     for k, s in enumerate(sizes):  # sizes descending: smallest fit wins
         j = jnp.where(cnt <= s, jnp.int32(k + 1), j)
-    hist = lax.switch(j, branches, (sel, grad, hess, lor))
+    hist = lax.switch(j, branches, (sort_key, grad, hess, lor))
     if axis_name is not None:
         hist = lax.psum(hist, axis_name)
     return hist

@@ -1,0 +1,141 @@
+"""Fused batched-round partition + frontier-key Pallas kernel.
+
+TPU-native equivalent of the reference's data partition step (reference:
+src/treelearner/cuda/cuda_data_partition.cu:288 ``GenDataToLeftBitVector``
++ ``SplitInnerKernel`` :907 — bitvector, prefix sums, stable scatter).
+This framework keeps rows in place and maintains a dense ``leaf_of_row``
+map instead (learner/grower.py); the batched grower moves rows of all K
+split parents in one pass.
+
+In XLA that pass materializes several [K, n] HBM intermediates (the
+per-slot feature columns, go-left masks and membership masks) plus a
+separate [n] frontier-membership reduction for the compaction sort key —
+profiled at ~8 ms/tree of small fusions (docs/PERF_NOTES.md round-2
+plan item 2).  This kernel fuses all of it into ONE elementwise pass over
+row blocks:
+
+  - per-slot feature columns come from ONE [K, F] x [F, blk] one-hot
+    contraction against the resident transposed bin matrix (bin values
+    <= 255 are exact in bfloat16, each sum has exactly one term — exact);
+  - the split decisions, the new ``leaf_of_row``, the bagging-masked
+    leaf id and the (selected ? row : row | 2^30) compaction sort key
+    (consumed by ops/histogram.py ``histogram_for_leaves_auto``) are all
+    computed in VMEM and written once.
+
+Numeric, non-bundled features only — categorical bitset lookups and EFB
+inverse tables are per-row gathers (the slowest TPU primitive); those
+configurations keep the XLA path in learner/batch_grower.py.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+try:  # pragma: no cover
+    from jax.experimental import pallas as pl
+    HAS_PALLAS = True
+except ImportError:  # pragma: no cover
+    HAS_PALLAS = False
+
+# test hook: CPU suite runs the kernel through the interpreter
+_FUSE_TEST_INTERPRET = False
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def use_fused_partition() -> bool:
+    import os
+    if os.environ.get("LGBMTPU_NO_FUSED_PARTITION"):  # perf A/B hatch
+        return False
+    if _FUSE_TEST_INTERPRET:
+        return True
+    from .histogram import use_pallas
+    return use_pallas()
+
+
+@functools.partial(jax.jit, static_argnames=("rows_per_block", "interpret"))
+def partition_select_pallas(bins_t: jax.Array, lor: jax.Array,
+                            mask: jax.Array, feats: jax.Array,
+                            thr: jax.Array, dl: jax.Array,
+                            nanb: jax.Array, parents: jax.Array,
+                            new_leaves: jax.Array, validk: jax.Array,
+                            smaller: jax.Array, *,
+                            rows_per_block: int = 2048,
+                            interpret: bool = False
+                            ) -> Tuple[jax.Array, jax.Array]:
+    """One fused pass: rows move to their split side and the next
+    histogram call's compaction keys come out with them.
+
+    bins_t: u8 [F, n] resident transposed bins; lor: i32 [n] current leaf
+    map (unmasked); mask: i32 [n] 1/0 bagging mask; per-slot descriptors
+    i32 [K]: feats/thr/nanb (split feature, bin threshold, NaN bin),
+    dl (default-left as 0/1), parents (parent leaf id, -1 disables the
+    slot), new_leaves (right-child leaf id), validk (0/1),
+    smaller (the leaf ids the NEXT histogram pass will compact, dummy
+    slots may repeat).
+
+    Returns (new_lor i32 [n], sort_key i32 [n]) where sort_key =
+    (row in smaller-frontier AND mask) ? row : row | 2^30.
+    """
+    num_f, n = bins_t.shape
+    K = feats.shape[0]
+    blk = min(rows_per_block, max(128, _round_up(n, 128)))
+    n_pad = _round_up(max(n, 1), blk)
+    if n_pad != n:
+        bins_t = jnp.pad(bins_t, ((0, 0), (0, n_pad - n)))
+        lor = jnp.pad(lor, (0, n_pad - n), constant_values=-1)
+        mask = jnp.pad(mask, (0, n_pad - n))
+    nb = n_pad // blk
+
+    def kernel(bins_ref, lor_ref, mask_ref, feats_ref, thr_ref, dl_ref,
+               nanb_ref, par_ref, nl_ref, vk_ref, sm_ref,
+               out_lor_ref, out_key_ref):
+        step = pl.program_id(0)
+        fk = feats_ref[0, :]                                  # [K]
+        iota_f = lax.iota(jnp.int32, num_f)
+        ohf = (fk[:, None] == iota_f[None, :]).astype(jnp.bfloat16)
+        b_blk = bins_ref[:].astype(jnp.bfloat16)              # [F, blk]
+        # per-slot feature column: exactly one one-hot term per sum and
+        # bin values <= 255 are exact in bf16 -> exact integers out
+        cols = lax.dot_general(
+            ohf, b_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(jnp.int32)  # [K, blk]
+        lor_b = lor_ref[0, :]                                 # [blk]
+        go_left = jnp.where(cols == nanb_ref[0, :][:, None],
+                            dl_ref[0, :][:, None] != 0,
+                            cols <= thr_ref[0, :][:, None])   # [K, blk]
+        in_par = (lor_b[None, :] == par_ref[0, :][:, None]) \
+            & (vk_ref[0, :][:, None] != 0)                    # [K, blk]
+        move = in_par & ~go_left
+        tgt = jnp.sum(jnp.where(move, nl_ref[0, :][:, None], 0), axis=0)
+        new_lor = jnp.where(jnp.any(move, axis=0), tgt, lor_b)
+        out_lor_ref[0, :] = new_lor
+        lor_m = jnp.where(mask_ref[0, :] != 0, new_lor, -1)
+        sel = jnp.any(lor_m[None, :] == sm_ref[0, :][:, None], axis=0)
+        row = step * blk + lax.iota(jnp.int32, blk)
+        out_key_ref[0, :] = jnp.where(sel, row, row | (1 << 30))
+
+    row_spec = pl.BlockSpec((1, blk), lambda i: (0, i))
+    k_spec = pl.BlockSpec((1, K), lambda i: (0, 0))
+    out_lor, out_key = pl.pallas_call(
+        kernel,
+        grid=(nb,),
+        in_specs=[pl.BlockSpec((num_f, blk), lambda i: (0, i)),
+                  row_spec, row_spec,
+                  k_spec, k_spec, k_spec, k_spec, k_spec, k_spec, k_spec,
+                  k_spec],
+        out_specs=[row_spec, row_spec],
+        out_shape=[jax.ShapeDtypeStruct((1, n_pad), jnp.int32),
+                   jax.ShapeDtypeStruct((1, n_pad), jnp.int32)],
+        interpret=interpret,
+    )(bins_t, lor[None, :], mask[None, :], feats[None, :], thr[None, :],
+      dl[None, :], nanb[None, :], parents[None, :], new_leaves[None, :],
+      validk[None, :], smaller[None, :])
+    return out_lor[0, :n], out_key[0, :n]

@@ -82,13 +82,6 @@ class SplitHyper:
     # nonzero+gather into power-of-two buckets (wins only when leaves are
     # tiny relative to n AND gathers are cheap)
     leaf_hist: str = "masked"
-    # leaf-GROUPED compacted histograms (ops/hist_pallas.py
-    # histogram_grouped_pallas): rows sorted by leaf + scalar-prefetch
-    # steered accumulation.  Measured SLOWER than the plain bucket path on
-    # hardware in round 3 (the assumed K-channel MXU multiplier does not
-    # exist below 128 channels, so the grouped glue is pure overhead —
-    # docs/PERF_NOTES.md); kept for re-evaluation.
-    grouped_hist: bool = False
     # bounded histogram pool (reference feature_histogram.hpp:1367
     # HistogramPool, serial_tree_learner.cpp:36-47 histogram_pool_size):
     # 0 = one resident histogram per leaf ([L, F, B, 4]); > 0 = that many

@@ -3,7 +3,7 @@ device-lane HLO durations per tree.
 
 Usage:  PK=28 PROWS=1000000 python tools/profile_bench.py
 
-Knobs (env): PK split batch, PGROUPED grouped path, PROWS rows, PLEAVES
+Knobs (env): PK split batch, PROWS rows, PLEAVES
 leaves.  Methodology notes in docs/PERF_NOTES.md — in particular, only
 scan-chained in-one-jit timing is trustworthy through the axon tunnel.
 """
@@ -17,7 +17,6 @@ from collections import defaultdict
 import numpy as np
 
 K = int(os.environ.get("PK", "20"))
-GROUPED = os.environ.get("PGROUPED", "0") == "1"
 N = int(os.environ.get("PROWS", "1000000"))
 LEAVES = int(os.environ.get("PLEAVES", "255"))
 
@@ -47,8 +46,7 @@ is_cat = jnp.zeros((f,), bool)
 
 hp = SplitHyper(num_leaves=LEAVES, min_data_in_leaf=0,
                 min_sum_hessian_in_leaf=100.0, n_bins=256,
-                rows_per_block=8192, hist_dtype="bfloat16",
-                grouped_hist=GROUPED)
+                rows_per_block=8192, hist_dtype="bfloat16")
 
 ITERS = 3
 
@@ -116,7 +114,7 @@ for e in events:
 
 print(f"# lanes: {set(pid_names.values())}")
 print(f"# total device time: {total:.1f} ms over {ITERS} iters "
-      f"=> {total/ITERS:.1f} ms/tree  (K={K} grouped={GROUPED})")
+      f"=> {total/ITERS:.1f} ms/tree  (K={K})")
 rows = sorted(agg.items(), key=lambda kv: -kv[1])[:45]
 for name, ms in rows:
     print(f"{ms/ITERS:9.2f} ms/tree  x{cnt[name]//ITERS:<5} {name[:110]}")

@@ -1,8 +1,8 @@
 """Hardware perf sweep over grower configurations.
 
-Usage:  python tools/sweep_perf.py k=28,grouped=0 k=28,dtype=float32
+Usage:  python tools/sweep_perf.py k=28 k=28,dtype=float32
 
-Each spec is comma-joined key=value pairs: k (split batch), grouped (0/1),
+Each spec is comma-joined key=value pairs: k (split batch),
 dtype (bfloat16/float32), warmup (0/1), iters, leaves.  Timing is
 scan-chained inside one jit (docs/PERF_NOTES.md methodology).
 """
@@ -43,12 +43,11 @@ nan_bin = jnp.full((f,), -1, jnp.int32)
 is_cat = jnp.zeros((f,), bool)
 
 
-def run_config(k, grouped, dtype="bfloat16", warmup=True, iters=ITERS,
+def run_config(k, dtype="bfloat16", warmup=True, iters=ITERS,
                leaves=255):
     hp = SplitHyper(num_leaves=leaves, min_data_in_leaf=0,
                     min_sum_hessian_in_leaf=100.0, n_bins=256,
-                    rows_per_block=8192, hist_dtype=dtype,
-                    grouped_hist=grouped)
+                    rows_per_block=8192, hist_dtype=dtype)
 
     @jax.jit
     def run(scores, bins_a, label_a):
@@ -75,7 +74,7 @@ def run_config(k, grouped, dtype="bfloat16", warmup=True, iters=ITERS,
     float(out[0])
     elapsed = time.time() - t0
     ms_per_tree = elapsed / iters * 1000
-    print(json.dumps({"k": k, "grouped": grouped, "dtype": dtype,
+    print(json.dumps({"k": k, "dtype": dtype,
                       "warmup": warmup, "ms_per_tree": round(ms_per_tree, 2),
                       "compile_s": round(compile_s, 1)}), flush=True)
     # A successful on-chip sweep is evidence worth keeping: persist it in
@@ -105,7 +104,6 @@ if __name__ == "__main__":
     for spec in sys.argv[1:]:
         parts = dict(p.split("=") for p in spec.split(","))
         run_config(int(parts.get("k", 20)),
-                   parts.get("grouped", "0") == "1",
                    parts.get("dtype", "bfloat16"),
                    parts.get("warmup", "1") == "1",
                    int(parts.get("iters", ITERS)),
