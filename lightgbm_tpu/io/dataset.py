@@ -292,34 +292,13 @@ class Dataset:
         ds.metadata.set_init_score(init_score)
 
         if reference is not None:
-            # the sparse builder assumes implicit entries decode to each
-            # feature's ZERO bin; a dense-trained reference may carry (a) a
-            # bundle plan whose default is the most-frequent (non-zero) bin
-            # or (b) categorical mappers (default_bin 0 = most frequent
-            # category) — both would silently mis-bin implicit zeros, so
-            # fall back to the dense path for correctness
-            compatible = not any(
-                reference.mappers[j].bin_type == BIN_CATEGORICAL
-                for j in reference.used_feature_idx)
+            # the builder decodes implicit entries through each mapper's
+            # bin-of-0.0 (values_to_bins, categorical included) and
+            # replicates apply_bundles' first-writer order, so a
+            # dense-trained reference — categorical mappers, nonzero
+            # default bins, dense-built bundle plans — binds without
+            # densification (the r3 fallback here is gone)
             plan = reference.bundle_plan
-            if compatible and plan is not None:
-                for members in plan.bundles:
-                    if len(members) == 1:
-                        continue
-                    for fv in members:
-                        j = reference.used_feature_idx[fv]
-                        if plan.default_bin[fv] != \
-                                reference.mappers[j].default_bin:
-                            compatible = False
-            if not compatible:
-                log.warning("sparse valid data against this reference "
-                            "needs densification (non-zero default bins "
-                            "or categorical features)")
-                return cls.from_data(
-                    np.asarray(csc.todense(), np.float64),
-                    label=label, config=cfg, weight=weight,
-                    group=group, init_score=init_score,
-                    feature_names=feature_names, reference=reference)
             ds.mappers = reference.mappers
             ds.used_feature_idx = list(reference.used_feature_idx)
             ds.num_total_features = reference.num_total_features
@@ -604,18 +583,28 @@ def _resolve_categorical(categorical_feature: Optional[Sequence[Union[int, str]]
 def _sparse_bundled_matrix(csc, mappers, used_idx, plan, n: int) -> np.ndarray:
     """Bundled uint8 [n, n_bundles] straight from CSC columns.
 
-    Implicit (absent) entries are zeros, so each column starts at its
-    feature's zero bin (BinMapper.default_bin — reference GetDefaultBin)
-    and only nonzero entries are binned and scattered.  With a bundle
-    plan, member encoding and first-writer conflict resolution match
-    ``apply_bundles`` on the equivalent dense matrix exactly.
+    Implicit (absent) entries are value 0.0, so each column starts at its
+    feature's bin-of-zero — ``values_to_bins(0.0)``, which handles both
+    numeric mappers (reference GetDefaultBin) and categorical mappers
+    (the bin of category 0) — and only nonzero entries are binned and
+    scattered.  With a bundle plan, member encoding and first-writer
+    conflict resolution match ``apply_bundles`` on the equivalent dense
+    matrix exactly, INCLUDING dense-built reference plans where a
+    member's zero bin is a stored (non-default) bin: that member claims
+    its implicit rows in member order too.
     """
+    _z = np.zeros(1, np.float64)
+
+    def zero_bin(m) -> int:
+        return int(m.values_to_bins(_z)[0])
+
     if plan is None:
         out = np.zeros((n, len(used_idx)), np.uint8)
         for col, j in enumerate(used_idx):
             m = mappers[j]
-            if m.default_bin:
-                out[:, col] = m.default_bin
+            zb = zero_bin(m)
+            if zb:
+                out[:, col] = zb
             rows = csc.indices[csc.indptr[j]:csc.indptr[j + 1]]
             vals = csc.data[csc.indptr[j]:csc.indptr[j + 1]]
             out[rows, col] = m.values_to_bins(vals).astype(np.uint8)
@@ -626,8 +615,9 @@ def _sparse_bundled_matrix(csc, mappers, used_idx, plan, n: int) -> np.ndarray:
             fv = members[0]
             j = used_idx[fv]
             m = mappers[j]
-            if m.default_bin:
-                out[:, col] = m.default_bin
+            zb = zero_bin(m)
+            if zb:
+                out[:, col] = zb
             rows = csc.indices[csc.indptr[j]:csc.indptr[j + 1]]
             vals = csc.data[csc.indptr[j]:csc.indptr[j + 1]]
             out[rows, col] = m.values_to_bins(vals).astype(np.uint8)
@@ -642,6 +632,15 @@ def _sparse_bundled_matrix(csc, mappers, used_idx, plan, n: int) -> np.ndarray:
             write = stored & (out[rows, col] == 0)
             out[rows[write], col] = \
                 plan.src_idx[fv][b[write]].astype(np.uint8)
+            # a dense-built plan can store the zero bin (its bundle
+            # default is the most-frequent bin, not necessarily the zero
+            # bin): the member's implicit rows carry it, first-writer
+            zb = zero_bin(m)
+            if 0 <= zb < len(plan.valid[fv]) and plan.valid[fv][zb]:
+                imp = np.ones(n, bool)
+                imp[rows] = False
+                imp &= out[:, col] == 0
+                out[imp, col] = np.uint8(plan.src_idx[fv][zb])
     return out
 
 

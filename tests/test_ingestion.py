@@ -223,10 +223,11 @@ def test_sparse_valid_set_alignment():
     assert auc > 0.7, auc
 
 
-def test_sparse_valid_against_dense_reference_falls_back():
+def test_sparse_valid_against_dense_reference_no_densify():
     """Sparse valid data against a DENSE-trained reference whose bundle
-    defaults are not zero bins must not silently mis-bin implicit zeros —
-    the densifying fallback keeps predictions/metrics correct."""
+    defaults are not zero bins binds WITHOUT densification (the r3
+    fallback is gone): implicit zeros decode through values_to_bins(0.0)
+    and first-writer bundle order, bit-equal to the dense-built valid."""
     from scipy import sparse
     rng = np.random.default_rng(2)
     n, f = 3000, 20
@@ -241,11 +242,41 @@ def test_sparse_valid_against_dense_reference_falls_back():
     dva_sparse = dtr.create_valid(sparse.csr_matrix(dense[2000:]),
                                   label=y[2000:])
     dva_dense = dtr.create_valid(dense[2000:], label=y[2000:])
+    dva_sparse.construct()
+    dva_dense.construct()
+    np.testing.assert_array_equal(dva_sparse._inner.bins,
+                                  dva_dense._inner.bins)
     bst = lgb.train(p, dtr, num_boost_round=6,
                     valid_sets=[dva_sparse, dva_dense],
                     valid_names=["sp", "dn"])
     vals = {name: v for name, _, v, _ in bst.eval_valid()}
     assert abs(vals["sp"] - vals["dn"]) < 1e-9, vals
+
+
+def test_sparse_valid_against_categorical_reference_no_densify():
+    """Categorical mappers map implicit zeros to the bin of CATEGORY 0
+    (not bin 0); the sparse valid bins must equal the dense-built ones."""
+    from scipy import sparse
+    rng = np.random.default_rng(7)
+    n, f = 2500, 8
+    dense = rng.normal(size=(n, f))
+    # integer category column where category 0 is NOT the most frequent
+    cats = rng.choice([0, 1, 2, 3, 4], size=n, p=[0.1, 0.4, 0.3, 0.1, 0.1])
+    dense[:, 3] = cats
+    dense[rng.random((n, f)) < 0.5] = 0.0
+    dense[:, 3] = cats  # keep the categorical column intact
+    y = ((dense[:, 0] + (cats == 1)) > 0.5).astype(np.float64)
+    p = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+         "min_data_in_leaf": 5}
+    dtr = lgb.Dataset(dense[:2000], label=y[:2000], params=p,
+                      categorical_feature=[3])
+    dva_sparse = dtr.create_valid(sparse.csr_matrix(dense[2000:]),
+                                  label=y[2000:])
+    dva_dense = dtr.create_valid(dense[2000:], label=y[2000:])
+    dva_sparse.construct()
+    dva_dense.construct()
+    np.testing.assert_array_equal(dva_sparse._inner.bins,
+                                  dva_dense._inner.bins)
 
 
 def test_arrow_direct_column_path():
@@ -277,3 +308,54 @@ def test_arrow_direct_column_path():
                    num_boost_round=5)
     # predictions come back float32; identical trees within f32 epsilon
     np.testing.assert_allclose(pred, b2.predict(dense), atol=1e-6)
+
+
+@pytest.mark.slow
+def test_allstate_shaped_wide_sparse_end_to_end():
+    """Allstate-class scale (BASELINE.md: 13.2M x 4228 one-hot sparse):
+    1M x 4000 mutually-exclusive sparse features must construct (EFB on),
+    train and predict WITHOUT ever materializing the dense [n, 4000]
+    matrix (32 GB f64 — the test could not finish if any path densified).
+    The bundled bin matrix must stay at a few uint8 columns."""
+    from scipy import sparse
+    rng = np.random.default_rng(11)
+    n, B, M = 1_000_000, 8, 500          # 8 bundles x 500 members = 4000
+    f = B * M
+    rows_idx = []
+    cols_idx = []
+    vals = []
+    member = rng.integers(0, M, size=(n, B))
+    for b in range(B):
+        rows_idx.append(np.arange(n))
+        cols_idx.append(b * M + member[:, b])
+        # one-hot indicators (2 bins/feature) — the real Allstate columns
+        # are one-hot-expanded categoricals, BASELINE.md
+        vals.append(np.ones(n))
+    rows_idx = np.concatenate(rows_idx)
+    cols_idx = np.concatenate(cols_idx)
+    vals = np.concatenate(vals)
+    X = sparse.csr_matrix((vals, (rows_idx, cols_idx)), shape=(n, f))
+    y = ((member[:, 0] % 7 < 3).astype(np.float64)
+         + 0.3 * rng.normal(size=n) > 0.5).astype(np.float64)
+    p = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+         "max_bin": 63, "min_data_in_leaf": 20, "tpu_split_batch": 4,
+         "tpu_hist_dtype": "float32", "metric": "auc"}
+    ds = lgb.Dataset(X, label=y, params=p)
+    ds.construct()
+    inner = ds._inner
+    # EFB collapsed the 4000 exclusive features into a handful of bundled
+    # uint8 columns: this IS the memory budget (1 MB per column at 1M rows)
+    assert inner.bins.shape[0] == n
+    assert inner.bins.shape[1] <= 8 * B, inner.bins.shape
+    assert inner.bins.dtype == np.uint8
+    bst = lgb.train(p, ds, num_boost_round=2)
+    pred = bst.predict(X[:50_000])
+    assert np.isfinite(pred).all()
+    order = np.argsort(pred)
+    ranks = np.empty(len(order))
+    ranks[order] = np.arange(1, len(order) + 1)
+    yb = y[:50_000]
+    npos = yb.sum()
+    auc = (ranks[yb > 0].sum() - npos * (npos + 1) / 2) / \
+        (npos * (len(yb) - npos))
+    assert auc > 0.6, auc
