@@ -124,12 +124,15 @@ def _arr_i32(ptr: int, n: int) -> np.ndarray:
 
 def dataset_from_csr(indptr_ptr: int, indices_ptr: int, data_ptr: int,
                      nrow: int, nnz: int, ncol: int, label_ptr: int,
-                     params_json: str) -> int:
+                     params_json: str, reference: int = 0) -> int:
     """LGBM_DatasetCreateFromCSR (c_api.h:340) equivalent.
 
     Routed through the sparse ingestion path (io/dataset.py _from_sparse)
     — the CSR payload is binned column-wise without densification, and
     duplicate (row, col) entries are summed (scipy.sparse semantics).
+    ``reference``: optional training-dataset handle; when set, the new
+    dataset aligns to its bin mappers (create_valid semantics, as the
+    reference's reference parameter does).
     """
     import lightgbm_tpu as lgb
     from scipy.sparse import csr_matrix
@@ -139,7 +142,10 @@ def dataset_from_csr(indptr_ptr: int, indices_ptr: int, data_ptr: int,
     mat = csr_matrix((vals, indices, indptr), shape=(nrow, ncol))
     label = _arr_f64(label_ptr, nrow).copy() if label_ptr else None
     params = json.loads(params_json) if params_json else {}
-    ds = lgb.Dataset(mat, label=label, params=params)
+    if reference:
+        ds = _handles[reference].create_valid(mat, label=label)
+    else:
+        ds = lgb.Dataset(mat, label=label, params=params)
     ds.construct()
     return _new_handle(ds)
 
@@ -1112,6 +1118,279 @@ def register_log_callback(fn_ptr: int) -> None:
         cb(msg.encode())
 
     _log.register_logger(logger)
+
+
+def network_init_with_functions(num_machines: int, rank: int,
+                                reduce_scatter_ptr: int,
+                                allgather_ptr: int) -> None:
+    """LGBM_NetworkInitWithFunctions (c_api.h:1593): externally provided
+    collectives — the reference's injection point for embedders (Dask,
+    .NET/SynapseML) that own their transport.
+
+    On this runtime, DEVICE-side reductions are XLA collectives over the
+    mesh and cannot be swapped; the injected functions serve the
+    HOST-side coordination path instead (:func:`ext_allgather` /
+    :func:`ext_reduce_scatter`, usable wherever the reference called
+    Network::Allgather on host buffers, e.g. bin-mapper agreement).
+    Function signatures follow the reference's ReduceScatterFunction /
+    AllgatherFunction typedefs."""
+    _network_conf.update(num_machines=int(num_machines), rank=int(rank),
+                         reduce_scatter_ptr=int(reduce_scatter_ptr),
+                         allgather_ptr=int(allgather_ptr))
+    if num_machines > 1:
+        from .utils import log
+        log.info("external collectives registered for %d machines (host-"
+                 "side coordination; device collectives remain XLA's)"
+                 % num_machines)
+
+
+def ext_allgather(local: np.ndarray, block_sizes) -> np.ndarray:
+    """Run the injected allgather over host bytes.
+
+    ``local``: this rank's uint8 buffer; ``block_sizes``: byte count per
+    rank.  Mirrors the reference AllgatherFunction contract
+    (input, input_size, block_start, block_len, num_block, output,
+    output_size)."""
+    ptr = _network_conf.get("allgather_ptr")
+    if not ptr:
+        raise RuntimeError("no external allgather registered "
+                           "(LGBM_NetworkInitWithFunctions)")
+    sizes = np.asarray(block_sizes, np.int32)
+    starts = np.concatenate([[0], np.cumsum(sizes)[:-1]]).astype(np.int32)
+    total = int(sizes.sum())
+    out = np.zeros(total, np.uint8)
+    local = np.ascontiguousarray(local, np.uint8)
+    fn = ctypes.CFUNCTYPE(
+        None, ctypes.c_char_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int, ctypes.c_char_p,
+        ctypes.c_int)(ptr)
+    fn(local.ctypes.data_as(ctypes.c_char_p), int(local.size),
+       starts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+       sizes.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+       int(len(sizes)), out.ctypes.data_as(ctypes.c_char_p), total)
+    return out
+
+
+# the reducer handed to external reduce-scatter transports (reference
+# ReduceFunction: dst[i] = reduce(dst[i], src[i]) over len bytes in
+# type_size chunks; here elementwise f64 sum).  Module-level so the
+# ctypes thunk outlives the call.
+_REDUCER_T = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_void_p,
+                              ctypes.c_int, ctypes.c_int64)
+
+
+def _sum_reducer(src_ptr, dst_ptr, type_size, nbytes):
+    n = int(nbytes) // 8
+    src = np.ctypeslib.as_array(
+        ctypes.cast(src_ptr, ctypes.POINTER(ctypes.c_double)), shape=(n,))
+    dst = np.ctypeslib.as_array(
+        ctypes.cast(dst_ptr, ctypes.POINTER(ctypes.c_double)), shape=(n,))
+    dst += src
+
+
+_sum_reducer_cb = _REDUCER_T(_sum_reducer)
+
+
+def ext_reduce_scatter(local: np.ndarray, block_sizes) -> np.ndarray:
+    """Run the injected reduce-scatter over host bytes (reference
+    ReduceScatterFunction contract; a real f64-sum reducer callback is
+    passed, since transport implementations invoke it to combine
+    blocks)."""
+    ptr = _network_conf.get("reduce_scatter_ptr")
+    if not ptr:
+        raise RuntimeError("no external reduce_scatter registered "
+                           "(LGBM_NetworkInitWithFunctions)")
+    sizes = np.asarray(block_sizes, np.int32)
+    starts = np.concatenate([[0], np.cumsum(sizes)[:-1]]).astype(np.int32)
+    rank = int(_network_conf.get("rank", 0))
+    out = np.zeros(int(sizes[rank]), np.uint8)
+    local = np.ascontiguousarray(local, np.uint8)
+    fn = ctypes.CFUNCTYPE(
+        None, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int, ctypes.c_char_p, ctypes.c_int, _REDUCER_T)(ptr)
+    fn(local.ctypes.data_as(ctypes.c_char_p), int(local.size), 8,
+       starts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+       sizes.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+       int(len(sizes)), out.ctypes.data_as(ctypes.c_char_p), int(out.size),
+       _sum_reducer_cb)
+    return out
+
+
+# sparse prediction outputs stay alive until BoosterFreePredictSparse
+# (keyed by the data buffer's address, like the reference's allocation)
+_sparse_out_keepalive: Dict[int, tuple] = {}
+
+
+def booster_predict_sparse_output(b_id: int, indptr_ptr: int,
+                                  indices_ptr: int, data_ptr: int,
+                                  nindptr: int, nelem: int,
+                                  num_col_or_row: int, predict_type: int,
+                                  start_iteration: int, num_iteration: int,
+                                  matrix_type: int):
+    """LGBM_BoosterPredictSparseOutput (c_api.h:1068): CSR-in, sparse-out
+    prediction — the wide-sparse SHAP-contribution path (predict_type 3 =
+    contrib, matching C_API_PREDICT_CONTRIB).  Returns
+    (indptr_ptr, nindptr, indices_ptr, data_ptr, nelem) of library-owned
+    buffers."""
+    from scipy.sparse import csr_matrix
+    if matrix_type != 0:
+        raise ValueError("only C_API_MATRIX_TYPE_CSR (0) output is "
+                         "supported")
+    b = _handles[b_id]
+    nrow = nindptr - 1
+    indptr = _arr_i32(indptr_ptr, nindptr).copy()
+    indices = _arr_i32(indices_ptr, nelem).copy()
+    vals = _arr_f64(data_ptr, nelem).copy()
+    X = csr_matrix((vals, indices, indptr),
+                   shape=(nrow, num_col_or_row))
+    dense = _predict_values(_handles[b_id], X, predict_type,
+                            start_iteration, num_iteration)
+    dense = np.asarray(dense, np.float64).reshape(nrow, -1)
+    out = csr_matrix(dense)
+    out_indptr = np.ascontiguousarray(out.indptr, np.int32)
+    out_indices = np.ascontiguousarray(out.indices, np.int32)
+    out_data = np.ascontiguousarray(out.data, np.float64)
+    key = int(out_data.ctypes.data)
+    _sparse_out_keepalive[key] = (out_indptr, out_indices, out_data)
+    return (int(out_indptr.ctypes.data), int(out_indptr.size),
+            int(out_indices.ctypes.data), key, int(out_data.size))
+
+
+def booster_free_predict_sparse(data_ptr: int) -> None:
+    """LGBM_BoosterFreePredictSparse (c_api.h:1088)."""
+    _sparse_out_keepalive.pop(int(data_ptr), None)
+
+
+_ARROW_ARRAY_STRUCT_SIZE = 80  # sizeof(ArrowArray), C Data Interface
+
+
+def _import_arrow_chunks(n_chunks: int, chunks_ptr: int, schema_ptr: int):
+    """ArrowArray struct array + ArrowSchema -> list of pyarrow
+    RecordBatches, zero-copy over the C Data Interface buffers (ownership
+    moves to pyarrow per the release-callback protocol).  The interface
+    releases the schema struct on first import, so later chunks import
+    through re-exports of the captured schema object."""
+    import pyarrow as pa
+    batches = []
+    schema_obj = None
+    for i in range(int(n_chunks)):
+        arr_addr = int(chunks_ptr) + i * _ARROW_ARRAY_STRUCT_SIZE
+        if i == 0:
+            b = pa.RecordBatch._import_from_c(arr_addr, int(schema_ptr))
+            schema_obj = b.schema
+        else:
+            tmp = (ctypes.c_byte * 72)()
+            schema_obj._export_to_c(ctypes.addressof(tmp))
+            b = pa.RecordBatch._import_from_c(arr_addr,
+                                              ctypes.addressof(tmp))
+        batches.append(b)
+    return batches
+
+
+def dataset_from_arrow(n_chunks: int, chunks_ptr: int, schema_ptr: int,
+                       params_json: str, reference: int) -> int:
+    """LGBM_DatasetCreateFromArrow (c_api.h:451): chunked Arrow record
+    batches bind zero-copy at the ABI (the column buffers are wrapped, not
+    copied; binning consumes them column-wise)."""
+    import lightgbm_tpu as lgb
+    import pyarrow as pa
+    batches = _import_arrow_chunks(n_chunks, chunks_ptr, schema_ptr)
+    table = pa.Table.from_batches(batches)
+    params = json.loads(params_json) if params_json else {}
+    ref = _handles[reference] if reference else None
+    if ref is not None:
+        ds = ref.create_valid(table)
+    else:
+        ds = lgb.Dataset(table, params=params)
+    ds.construct()
+    return _new_handle(ds)
+
+
+def dataset_set_field_from_arrow(ds_id: int, field: str, n_chunks: int,
+                                 chunks_ptr: int, schema_ptr: int) -> None:
+    """LGBM_DatasetSetFieldFromArrow (c_api.h:498)."""
+    import pyarrow as pa
+    chunks = []
+    typ = None
+    for i in range(int(n_chunks)):
+        addr = int(chunks_ptr) + i * _ARROW_ARRAY_STRUCT_SIZE
+        if i == 0:
+            a = pa.Array._import_from_c(addr, int(schema_ptr))
+            typ = a.type
+        else:
+            tmp = (ctypes.c_byte * 72)()
+            typ._export_to_c(ctypes.addressof(tmp))
+            a = pa.Array._import_from_c(addr, ctypes.addressof(tmp))
+        chunks.append(a)
+    vals = pa.chunked_array(chunks).to_numpy(zero_copy_only=False)
+    ds = _handles[ds_id]
+    vals = np.asarray(vals, np.float64)
+    if field == "weight":
+        ds.set_weight(vals)
+    elif field == "label":
+        ds.set_label(vals)
+    elif field == "init_score":
+        ds.set_init_score(vals)
+    elif field == "group":
+        ds.set_group(vals.astype(np.int64))
+    elif field == "position":
+        ds.position = vals.astype(np.int32)
+    else:
+        raise ValueError(f"unknown field {field}")
+
+
+def booster_predict_for_arrow(b_id: int, n_chunks: int, chunks_ptr: int,
+                              schema_ptr: int, predict_type: int,
+                              start_iteration: int, num_iteration: int,
+                              out_ptr: int, out_capacity: int) -> int:
+    """LGBM_BoosterPredictForArrow (c_api.h:1456)."""
+    import pyarrow as pa
+    batches = _import_arrow_chunks(n_chunks, chunks_ptr, schema_ptr)
+    table = pa.Table.from_batches(batches)
+    cols = [np.asarray(c.to_numpy(zero_copy_only=False), np.float64)
+            for c in table.columns]
+    X = np.column_stack(cols) if cols else np.zeros((0, 0))
+    return _predict_any(b_id, X, predict_type, start_iteration,
+                        num_iteration, out_ptr, out_capacity)
+
+
+def dataset_from_sampled_column(sample_data_ptr: int, sample_idx_ptr: int,
+                                ncol: int, num_per_col_ptr: int,
+                                num_sample_row: int, num_local_row: int,
+                                num_dist_row: int, params_json: str) -> int:
+    """LGBM_DatasetCreateFromSampledColumn (c_api.h:145): bin mappers are
+    fixed from the pre-sampled columns NOW (the reference's
+    ConstructFromSampleData); rows arrive afterwards via
+    LGBM_DatasetPushRows and bin through those mappers.  Realized by
+    reconstructing the sampled matrix (elided entries are zeros), binning
+    it into a throwaway reference dataset, and aligning the streaming
+    collector to it (create_valid semantics)."""
+    import lightgbm_tpu as lgb
+    ncol = int(ncol)
+    nsr = int(num_sample_row)
+    per_col = _arr_i32(num_per_col_ptr, ncol)
+    data_ptrs = np.ctypeslib.as_array(
+        ctypes.cast(sample_data_ptr, ctypes.POINTER(ctypes.c_uint64)),
+        shape=(ncol,))
+    idx_ptrs = np.ctypeslib.as_array(
+        ctypes.cast(sample_idx_ptr, ctypes.POINTER(ctypes.c_uint64)),
+        shape=(ncol,))
+    sample = np.zeros((nsr, ncol), np.float64)
+    for j in range(ncol):
+        cnt = int(per_col[j])
+        if cnt == 0:
+            continue
+        vals = _arr_f64(int(data_ptrs[j]), cnt)
+        rows = _arr_i32(int(idx_ptrs[j]), cnt)
+        sample[rows, j] = vals
+    params = json.loads(params_json) if params_json else {}
+    ref = lgb.Dataset(sample, params=params)
+    ref.construct()
+    collector = _StreamCollector(ncol, params)
+    collector.reference = ref
+    collector.expected_rows = int(num_local_row)
+    return _new_handle(collector)
 
 
 def fastpredict_init_csr(b_id: int, ncol: int, raw_score: int) -> int:

@@ -461,3 +461,317 @@ def test_merge_shuffle_reset(lib):
     assert rc != 0
     for h in (ds1, ds2, ds3, b1, b2, b3):
         _check(lib, lib.LGBMTPU_FreeHandle(h))
+
+
+def test_name_alias_entries(trained):
+    """Exact reference names: BoosterGetNumClasses / DatasetFree /
+    BoosterFree / SetLastError (c_api.h naming parity)."""
+    lib, ds, bst, X, y = trained
+    out = ctypes.c_int()
+    _check(lib, lib.LGBMTPU_BoosterGetNumClasses(bst, ctypes.byref(out)))
+    assert out.value == 1
+    lib.LGBMTPU_SetLastError(b"marker from test")
+    assert lib.LGBMTPU_GetLastError() == b"marker from test"
+
+
+def test_network_init_with_functions(lib):
+    """LGBM_NetworkInitWithFunctions (c_api.h:1593): externally provided
+    collectives register and are invocable through the host-coordination
+    helpers (single-machine identity transport here)."""
+    from lightgbm_tpu import capi_impl
+
+    # c_void_p params: c_char_p would hand the callback an immutable
+    # bytes COPY, so writes through `out` would be lost
+    AG = ctypes.CFUNCTYPE(
+        None, ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int, ctypes.c_void_p,
+        ctypes.c_int)
+    calls = []
+
+    def allgather(inp, in_size, starts, lens, nblock, out, out_size):
+        calls.append((in_size, nblock, out_size))
+        ctypes.memmove(out, inp, min(in_size, out_size))
+
+    RS = ctypes.CFUNCTYPE(
+        None, ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int, ctypes.c_void_p, ctypes.c_int, ctypes.c_void_p)
+
+    def reduce_scatter(inp, in_size, tsz, starts, lens, nblock, out,
+                       out_size, reducer):
+        # exercise the reducer contract: dst += src over f64 elements
+        src = np.arange(2, dtype=np.float64)
+        dst = np.ones(2, dtype=np.float64)
+        red = ctypes.cast(reducer, capi_impl._REDUCER_T)
+        red(src.ctypes.data, dst.ctypes.data, 8, 16)
+        np.testing.assert_allclose(dst, [1.0, 2.0])
+        ctypes.memmove(out, inp, min(out_size, in_size))
+
+    ag_cb = AG(allgather)
+    rs_cb = RS(reduce_scatter)
+    _check(lib, lib.LGBMTPU_NetworkInitWithFunctions(
+        1, 0, ctypes.cast(rs_cb, ctypes.c_void_p),
+        ctypes.cast(ag_cb, ctypes.c_void_p)))
+    local = np.arange(16, dtype=np.uint8)
+    got = capi_impl.ext_allgather(local, [16])
+    np.testing.assert_array_equal(got, local)
+    assert calls and calls[0] == (16, 1, 16)
+    got2 = capi_impl.ext_reduce_scatter(local, [16])
+    np.testing.assert_array_equal(got2, local)
+    _check(lib, lib.LGBMTPU_NetworkFree())
+
+
+def test_predict_sparse_output_contrib(trained):
+    """LGBM_BoosterPredictSparseOutput (c_api.h:1068): CSR SHAP-contrib
+    output matches the dense contrib path; FreePredictSparse releases."""
+    from scipy import sparse
+    lib, ds, bst, X, y = trained
+    n, f = 40, X.shape[1]
+    Xs = sparse.csr_matrix(np.where(np.abs(X[:n]) < 0.4, 0.0, X[:n]))
+    indptr = Xs.indptr.astype(np.int32)
+    indices = Xs.indices.astype(np.int32)
+    data = Xs.data.astype(np.float64)
+    out_len = (ctypes.c_int64 * 2)()
+    out_indptr = ctypes.POINTER(ctypes.c_int32)()
+    out_indices = ctypes.POINTER(ctypes.c_int32)()
+    out_data = ctypes.POINTER(ctypes.c_double)()
+    _check(lib, lib.LGBMTPU_BoosterPredictSparseOutput(
+        bst,
+        indptr.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        data.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.c_int64(len(indptr)), ctypes.c_int64(len(data)),
+        ctypes.c_int64(f), 3, 0, -1, 0,          # 3 = contrib, CSR out
+        out_len, ctypes.byref(out_indptr), ctypes.byref(out_indices),
+        ctypes.byref(out_data)))
+    nip, ne = out_len[0], out_len[1]
+    assert nip == n + 1
+    got_indptr = np.ctypeslib.as_array(out_indptr, shape=(nip,)).copy()
+    got_indices = np.ctypeslib.as_array(out_indices, shape=(ne,)).copy()
+    got_data = np.ctypeslib.as_array(out_data, shape=(ne,)).copy()
+    from scipy.sparse import csr_matrix
+    got = csr_matrix((got_data, got_indices, got_indptr),
+                     shape=(n, f + 1)).toarray()
+    # dense reference through the python surface
+    import lightgbm_tpu.capi_impl as capi_impl
+    b = capi_impl._handles[bst.value]
+    want = b.predict(Xs.toarray(), pred_contrib=True)
+    np.testing.assert_allclose(got, want, atol=1e-9)
+    _check(lib, lib.LGBMTPU_BoosterFreePredictSparse(
+        out_indptr, out_indices, out_data))
+    assert int(ctypes.cast(out_data, ctypes.c_void_p).value) not in \
+        capi_impl._sparse_out_keepalive
+
+
+def _export_batches(table):
+    """Export a pyarrow table as (chunks_buffer, schema_struct) through the
+    Arrow C Data Interface."""
+    import pyarrow as pa
+    batches = table.to_batches()
+    n = len(batches)
+    buf = (ctypes.c_byte * (80 * n))()
+    schema = (ctypes.c_byte * 72)()
+    base = ctypes.addressof(buf)
+    for i, b in enumerate(batches):
+        if i == 0:
+            b._export_to_c(base, ctypes.addressof(schema))
+        else:
+            b._export_to_c(base + 80 * i)
+    return buf, schema, n
+
+
+def test_arrow_abi_surface(lib):
+    """LGBM_DatasetCreateFromArrow / SetFieldFromArrow /
+    BoosterPredictForArrow (c_api.h:451 ff) through the real C Data
+    Interface structs."""
+    import pyarrow as pa
+    rng = np.random.default_rng(4)
+    n = 800
+    X = rng.normal(size=(n, 4))
+    y = ((X[:, 0] + X[:, 1]) > 0).astype(np.float64)
+    table = pa.table({f"f{j}": X[:, j] for j in range(4)})
+    params = json.dumps({"objective": "binary", "num_leaves": 15,
+                         "min_data_in_leaf": 5, "verbose": -1})
+    buf, schema, nchunks = _export_batches(table)
+    ds = ctypes.c_int64()
+    _check(lib, lib.LGBMTPU_DatasetCreateFromArrow(
+        ctypes.c_int64(nchunks), buf, schema, params.encode(),
+        ctypes.c_int64(0), ctypes.byref(ds)))
+    # label via SetFieldFromArrow (chunked primitive array)
+    label_arr = pa.chunked_array([y[:500], y[500:]])
+    nlc = len(label_arr.chunks)
+    lbuf = (ctypes.c_byte * (80 * nlc))()
+    lschema = (ctypes.c_byte * 72)()
+    for i, c in enumerate(label_arr.chunks):
+        if i == 0:
+            c._export_to_c(ctypes.addressof(lbuf),
+                           ctypes.addressof(lschema))
+        else:
+            c._export_to_c(ctypes.addressof(lbuf) + 80 * i)
+    _check(lib, lib.LGBMTPU_DatasetSetFieldFromArrow(
+        ds, b"label", ctypes.c_int64(nlc), lbuf, lschema))
+    bst = ctypes.c_int64()
+    _check(lib, lib.LGBMTPU_BoosterCreate(ds, params.encode(),
+                                          ctypes.byref(bst)))
+    fin = ctypes.c_int()
+    for _ in range(6):
+        _check(lib, lib.LGBMTPU_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+    # predict through Arrow input
+    buf2, schema2, nchunks2 = _export_batches(table)
+    out = np.zeros(n)
+    out_len = ctypes.c_int64(n)
+    _check(lib, lib.LGBMTPU_BoosterPredictForArrow(
+        bst, ctypes.c_int64(nchunks2), buf2, schema2, 0, 0, -1,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.byref(out_len)))
+    assert out_len.value == n
+    acc = float(((out > 0.5) == y).mean())  # type 0 = probabilities
+    assert acc > 0.85, acc
+
+
+def test_dataset_create_from_sampled_column(lib):
+    """LGBM_DatasetCreateFromSampledColumn (c_api.h:145): mappers fixed
+    from sampled columns, rows pushed afterwards, then training works."""
+    rng = np.random.default_rng(5)
+    n, f = 1000, 3
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] > 0).astype(np.float64)
+    ns = 400
+    sample_rows = rng.choice(n, size=ns, replace=False)
+    col_data = []
+    col_idx = []
+    ptrs_d = (ctypes.c_void_p * f)()
+    ptrs_i = (ctypes.c_void_p * f)()
+    per_col = np.zeros(f, np.int32)
+    for j in range(f):
+        vals = X[sample_rows, j].astype(np.float64)
+        idx = np.arange(ns, dtype=np.int32)
+        col_data.append(vals)
+        col_idx.append(idx)
+        ptrs_d[j] = vals.ctypes.data
+        ptrs_i[j] = idx.ctypes.data
+        per_col[j] = ns
+    params = json.dumps({"objective": "binary", "num_leaves": 15,
+                         "min_data_in_leaf": 5, "verbose": -1})
+    ds = ctypes.c_int64()
+    _check(lib, lib.LGBMTPU_DatasetCreateFromSampledColumn(
+        ptrs_d, ptrs_i, ctypes.c_int32(f),
+        per_col.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        ctypes.c_int32(ns), ctypes.c_int32(n), ctypes.c_int64(n),
+        params.encode(), ctypes.byref(ds)))
+    Xc = np.ascontiguousarray(X)
+    _check(lib, lib.LGBMTPU_DatasetPushRows(
+        ds, Xc.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.c_int64(n), ctypes.c_int64(f),
+        y.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+    _check(lib, lib.LGBMTPU_DatasetMarkFinished(ds))
+    bst = ctypes.c_int64()
+    _check(lib, lib.LGBMTPU_BoosterCreate(ds, params.encode(),
+                                          ctypes.byref(bst)))
+    fin = ctypes.c_int()
+    for _ in range(5):
+        _check(lib, lib.LGBMTPU_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+    nt = ctypes.c_int()
+    _check(lib, lib.LGBMTPU_BoosterNumTrees(bst, ctypes.byref(nt)))
+    assert nt.value == 5
+
+
+def test_predict_for_mats(trained):
+    """LGBM_BoosterPredictForMats (c_api.h:1408): array-of-row-pointers
+    input matches the contiguous-matrix prediction."""
+    lib, ds, bst, X, y = trained
+    n, f = 30, X.shape[1]
+    rows = [np.ascontiguousarray(X[i], np.float64) for i in range(n)]
+    ptrs = (ctypes.POINTER(ctypes.c_double) * n)(
+        *[r.ctypes.data_as(ctypes.POINTER(ctypes.c_double)) for r in rows])
+    out = np.zeros(n)
+    out_len = ctypes.c_int64(n)
+    _check(lib, lib.LGBMTPU_BoosterPredictForMats(
+        bst, ptrs, ctypes.c_int32(n), ctypes.c_int32(f), 0, 0, -1,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.byref(out_len)))
+    assert out_len.value == n
+    import lightgbm_tpu.capi_impl as capi_impl
+    b = capi_impl._handles[bst.value]
+    want = b.predict(X[:n])          # predict_type 0 = transformed
+    np.testing.assert_allclose(out, want, atol=1e-9)
+
+
+def test_csr_func_entry(lib, tmp_path):
+    """LGBM_DatasetCreateFromCSRFunc (c_api.h:363): the std::function
+    row-callback path, driven from a real C++ embedder program."""
+    import subprocess
+    import sysconfig
+    src = tmp_path / "csrfunc_main.cpp"
+    src.write_text(r'''
+#include <cstdint>
+#include <cstdio>
+#include <functional>
+#include <utility>
+#include <vector>
+extern "C" {
+int LGBMTPU_DatasetCreateFromCSRFunc(void*, int32_t, int64_t, const char*,
+                                     int64_t, int64_t*);
+int LGBMTPU_BoosterCreate(int64_t, const char*, int64_t*);
+int LGBMTPU_BoosterUpdateOneIter(int64_t, int*);
+int LGBMTPU_BoosterNumTrees(int64_t, int*);
+const char* LGBMTPU_GetLastError();
+}
+int main() {
+  using Row = std::vector<std::pair<int, double>>;
+  std::function<void(int, Row&)> get_row = [](int i, Row& out) {
+    out.push_back({0, i % 5 - 2.0});
+    out.push_back({1, (i * 7 % 11) / 11.0});
+  };
+  const char* params = "{\"objective\": \"regression\", \"num_leaves\": 7,"
+                       " \"min_data_in_leaf\": 5, \"verbose\": -1,"
+                       " \"label\": \"\"}";
+  int64_t ds = 0;
+  if (LGBMTPU_DatasetCreateFromCSRFunc(&get_row, 400, 2, params, 0, &ds)) {
+    std::printf("ERR %s\n", LGBMTPU_GetLastError());
+    return 1;
+  }
+  std::printf("OK ds=%lld\n", (long long)ds);
+  return 0;
+}
+''')
+    exe = tmp_path / "csrfunc_main"
+    libdir = os.path.dirname(CAPI)
+    cflags = sysconfig.get_config_var("LIBDIR") or ""
+    rc = subprocess.run(
+        ["g++", "-O1", str(src), "-o", str(exe),
+         f"-L{libdir}", "-llgbtpu_capi", f"-Wl,-rpath,{libdir}",
+         f"-L{cflags}", f"-Wl,-rpath,{cflags}"],
+        capture_output=True, text=True)
+    assert rc.returncode == 0, rc.stderr
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    r = subprocess.run([str(exe)], capture_output=True, text=True, env=env,
+                       timeout=300)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "OK ds=" in r.stdout
+
+
+def test_csr_with_reference_aligns_mappers(trained):
+    """dataset_from_csr with a reference handle aligns bin mappers
+    (the reference parameter of LGBM_DatasetCreateFromCSR/CSRFunc)."""
+    import lightgbm_tpu.capi_impl as capi_impl
+    from scipy import sparse
+    lib, ds, bst, X, y = trained
+    Xv = np.where(np.abs(X[:100]) < 0.3, 0.0, X[:100])
+    Xs = sparse.csr_matrix(Xv)
+    indptr = Xs.indptr.astype(np.int32)
+    indices = Xs.indices.astype(np.int32)
+    data = Xs.data.astype(np.float64)
+    h = capi_impl.dataset_from_csr(
+        int(indptr.ctypes.data), int(indices.ctypes.data),
+        int(data.ctypes.data), 100, int(Xs.nnz), X.shape[1], 0, "{}",
+        reference=ds.value)
+    valid = capi_impl._handles[h]
+    train = capi_impl._handles[ds.value]
+    valid.construct()
+    for mv, mt in zip(valid._inner.mappers, train._inner.mappers):
+        np.testing.assert_array_equal(np.asarray(mv.bin_upper_bound),
+                                      np.asarray(mt.bin_upper_bound))
