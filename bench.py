@@ -130,7 +130,11 @@ def supervise():
                 line = ln
                 break
         if p.returncode == 0 and line is not None:
-            payload = json.loads(line)
+            try:
+                payload = json.loads(line)
+            except ValueError:
+                last_fail = "measure_badjson_%s" % line[-120:]
+                continue
             # only real-accelerator measurements are worth keeping as
             # stale-fallback evidence; a CPU smoke run is not.
             if (payload.get("vs_baseline", 0) > 0
