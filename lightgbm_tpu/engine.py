@@ -15,7 +15,8 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 import numpy as np
 
 from .basic import Booster, Dataset
-from .callback import CallbackEnv, EarlyStopException
+from .callback import (CallbackEnv, EarlyStopException,
+                       record_evaluation)
 from .config import normalize_params
 from .utils import log
 from .utils.timer import global_timer
@@ -208,6 +209,7 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
                      for p in parts]
 
     cvb = CVBooster()
+    histories = []
     for fi, (train_idx, test_idx) in enumerate(folds):
         # fold datasets are SUBSETS of the binned data — bin mappers (and
         # the EFB plan) are shared, nothing is re-binned (reference cv
@@ -220,18 +222,53 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
             gtr, gte = fold_groups[fi]
             dtrain.inner.metadata.set_group(gtr)
             dtest.inner.metadata.set_group(gte)
+        rec: Dict[str, Dict[str, List[float]]] = {}
+        vs, vn = [dtest], ["valid"]
+        if eval_train_metric:
+            vs.append(dtrain)
+            vn.append("train")
         bst = train(params, dtrain, num_boost_round,
-                    valid_sets=[dtest], valid_names=["valid"],
-                    feval=feval, callbacks=list(callbacks or []))
+                    valid_sets=vs, valid_names=vn,
+                    feval=feval, callbacks=list(callbacks or [])
+                    + [record_evaluation(rec)])
         cvb.append(bst)
+        histories.append(rec)
 
-    final: Dict[str, List[float]] = {}
-    for bst in cvb.boosters:
-        for name, metric, val, _ in bst.eval_valid():
-            final.setdefault(f"{name} {metric}-mean", []).append(val)
-    out = {k: [float(np.mean(v))] for k, v in final.items()}
-    out.update({k.replace("-mean", "-stdv"): [float(np.std(final[k]))]
-                for k in final})
+    # per-iteration mean/stdv across folds, the reference cv's return
+    # shape (engine.py:611 _agg_cv_result); folds stopped early by a
+    # callback truncate to the shortest history
+    out: Dict[str, List[float]] = {}
+    first_valid_key = None
+    for set_name in histories[0]:
+        # train() labels the training set "training"; cv's public keys use
+        # "train" (reference cv key naming)
+        public = "train" if set_name == "training" else set_name
+        for metric in histories[0][set_name]:
+            rows = [h[set_name][metric] for h in histories]
+            it = min(len(r) for r in rows)
+            arr = np.asarray([r[:it] for r in rows])
+            out[f"{public} {metric}-mean"] = [float(v)
+                                             for v in arr.mean(axis=0)]
+            out[f"{public} {metric}-stdv"] = [float(v)
+                                             for v in arr.std(axis=0)]
+            if public == "valid" and first_valid_key is None:
+                first_valid_key = f"valid {metric}-mean"
+    # early stopping in any fold: truncate to the aggregate best
+    # iteration over the mean curve and record it, like the reference's
+    # cv (its folds run in lockstep and stop once)
+    stopped = any(
+        min((len(r) for r in h.get("valid", {}).values()),
+            default=num_boost_round) < num_boost_round
+        for h in histories)
+    if first_valid_key and stopped:
+        ev0 = cvb.boosters[0].eval_valid()
+        higher_better = bool(ev0[0][3]) if ev0 else False
+        curve = np.asarray(out[first_valid_key])
+        best_idx = int(np.argmax(curve) if higher_better
+                       else np.argmin(curve))
+        for k in list(out):
+            out[k] = out[k][:best_idx + 1]
+        cvb.best_iteration = best_idx + 1
     if return_cvbooster:
         out["cvbooster"] = cvb
     return out

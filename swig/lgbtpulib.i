@@ -1,0 +1,70 @@
+/* SWIG interface for the lightgbm_tpu C ABI — the JVM consumer path
+ * (counterpart of the reference's swig/lightgbmlib.i, which SynapseML-style
+ * JVM embedders build against).  Generates a Java (or other target)
+ * binding over native/capi.h; link the result against liblgbtpu_capi.so.
+ *
+ *   swig -java -package io.lgbtpu -outdir java/ lgbtpulib.i
+ *
+ * The handle model is simpler than the reference's: every handle is an
+ * opaque int64, so no pointer-manipulation helpers are needed — Java longs
+ * carry handles directly, and carrays.i covers the numeric buffers.
+ */
+%module lgbtpulib
+
+%{
+#include "../lightgbm_tpu/native/capi.h"
+%}
+
+%include "carrays.i"
+%include "cpointer.i"
+%include "stdint.i"
+
+/* primitive buffer helpers for JVM callers (reference .i uses the same
+ * carrays pattern for its double/int arrays) */
+%array_functions(double, doubleArray)
+%array_functions(float, floatArray)
+%array_functions(int, intArray)
+%array_functions(int32_t, int32Array)
+%array_functions(int64_t, int64Array)
+%pointer_functions(int, intp)
+%pointer_functions(int64_t, int64p)
+%pointer_functions(double, doublep)
+
+/* function-pointer-taking entries are driven from native embedders, not
+ * the JVM; exclude them from the generated binding like the reference
+ * ignores its non-JVM-safe entries */
+%ignore LGBMTPU_RegisterLogCallback;
+%ignore LGBMTPU_NetworkInitWithFunctions;
+%ignore LGBMTPU_DatasetCreateFromCSRFunc;
+%ignore LGBMTPU_DatasetCreateFromSampledColumn;
+%ignore LGBMTPU_BoosterPredictForMats;
+%ignore LGBMTPU_BoosterPredictSparseOutput;
+%ignore LGBMTPU_BoosterFreePredictSparse;
+%ignore LGBMTPU_DatasetCreateFromArrow;
+%ignore LGBMTPU_DatasetSetFieldFromArrow;
+%ignore LGBMTPU_BoosterPredictForArrow;
+
+%include "../lightgbm_tpu/native/capi.h"
+
+/* %newobject: SWIG's wrapper copies the returned string into the target
+ * language and then free()s it — so the allocation below must be malloc. */
+%newobject LGBMTPU_BoosterSaveModelToStringSWIG;
+
+%inline %{
+#include <stdlib.h>
+/* buffer-sizing convenience mirroring the reference's
+ * LGBM_BoosterSaveModelToStringSWIG.  (*out_len is in/out: capacity in,
+ * required size incl. NUL out — capi_impl.booster_save_model_to_string.) */
+char* LGBMTPU_BoosterSaveModelToStringSWIG(int64_t handle) {
+  int64_t len = 0;
+  if (LGBMTPU_BoosterSaveModelToString(handle, NULL, &len)) return NULL;
+  int64_t cap = len;
+  char* dst = (char*)malloc((size_t)cap);
+  if (!dst) return NULL;
+  if (LGBMTPU_BoosterSaveModelToString(handle, dst, &cap)) {
+    free(dst);
+    return NULL;
+  }
+  return dst;
+}
+%}

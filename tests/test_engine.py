@@ -582,3 +582,464 @@ def test_auto_speed_mode_at_scale():
     g = make({"num_leaves": 255, "linear_tree": True})
     assert g.config.use_quantized_grad is False
     assert int(g.config.tpu_split_batch) == 1
+
+
+# ---------------------------------------------------------------------------
+# Objective x boosting-mode x feature matrix (round 4; reference analogue:
+# tests/python_package_test/test_engine.py's per-objective/mode coverage).
+# Every cell asserts BOTH a learning-quality metric threshold and exact
+# save/load prediction equivalence, the two invariants the reference's
+# engine tests lean on throughout.
+
+def _matrix_data(objective, seed=0):
+    """Learnable synthetic task + (metric fn, base threshold) per
+    objective family.  Metric convention: smaller is better, and the
+    threshold is a fraction of the trivial predictor's score — so a cell
+    only passes when the model genuinely learned."""
+    rng = np.random.default_rng(seed)
+    n, f = 900, 6
+    X = rng.normal(size=(n, f))
+    extra = {}
+    if objective in ("binary", "cross_entropy"):
+        margin = X @ rng.normal(size=f) + 0.4 * X[:, 0] * X[:, 1]
+        y = (margin + 0.4 * rng.normal(size=n) > 0).astype(np.float64)
+        if objective == "cross_entropy":
+            y = 1.0 / (1.0 + np.exp(-2.0 * margin))     # soft labels
+
+        def metric(y_, p_):
+            return float(np.mean((p_ - y_) ** 2))       # Brier
+
+        base = metric(y, np.full(n, y.mean()))
+    elif objective in ("regression", "regression_l1", "huber", "fair",
+                       "quantile", "mape"):
+        y = X @ rng.normal(size=f) + np.sin(2 * X[:, 0]) \
+            + 0.1 * rng.normal(size=n)
+        if objective == "mape":
+            y = np.abs(y) + 1.0                          # mape needs y != 0
+
+        def metric(y_, p_):
+            return float(np.mean(np.abs(p_ - y_)))
+
+        base = metric(y, np.full(n, np.median(y)))
+    elif objective in ("poisson", "gamma", "tweedie"):
+        lam = np.exp(0.6 * X[:, 0] - 0.4 * X[:, 1])
+        y = rng.poisson(lam).astype(np.float64)
+        if objective in ("gamma", "tweedie"):
+            y = y + rng.gamma(1.0, 0.3, size=n) + 0.05   # positive
+
+        def metric(y_, p_):
+            return float(np.mean((p_ - y_) ** 2))
+
+        base = metric(y, np.full(n, y.mean()))
+    elif objective in ("multiclass", "multiclassova"):
+        centers = rng.normal(size=(3, f)) * 1.6
+        cls = rng.integers(0, 3, size=n)
+        X = centers[cls] + rng.normal(size=(n, f))
+        y = cls.astype(np.float64)
+        extra["num_class"] = 3
+
+        def metric(y_, p_):
+            return float(np.mean(np.argmax(p_, axis=1) != y_))  # error rate
+
+        base = 2.0 / 3.0
+    elif objective in ("lambdarank", "rank_xendcg"):
+        nq, per_q = 40, 15
+        X = rng.normal(size=(nq * per_q, f))
+        rel = X @ rng.normal(size=f) + 0.3 * rng.normal(size=nq * per_q)
+        y = np.zeros(nq * per_q)
+        for q in range(nq):
+            s = slice(q * per_q, (q + 1) * per_q)
+            y[s] = np.digitize(rel[s], np.quantile(rel[s], [0.6, 0.85, 0.97]))
+        extra["group"] = np.full(nq, per_q)
+
+        def metric(y_, p_):
+            # mean within-query fraction of top-3 predictions that are
+            # relevant (>=1): HIGHER is better, so return 1 - frac
+            ok = []
+            for q in range(nq):
+                s = slice(q * per_q, (q + 1) * per_q)
+                top = np.argsort(-p_[s])[:3]
+                ok.append(float((y_[s][top] >= 1).mean()))
+            return 1.0 - float(np.mean(ok))
+
+        base = metric(y, rng.normal(size=nq * per_q))
+    else:
+        raise AssertionError(objective)
+    return X, y, extra, metric, base
+
+
+MATRIX_MODES = {
+    "plain": {},
+    "bagging": {"bagging_fraction": 0.7, "bagging_freq": 1},
+    "goss": {"data_sample_strategy": "goss"},
+    "dart": {"boosting": "dart", "drop_rate": 0.2},
+    "rf": {"boosting": "rf", "bagging_fraction": 0.8, "bagging_freq": 1},
+}
+# rf averages unshrunk trees and dart drops trees: both learn less in 12
+# rounds, so their cells pass at a looser fraction of the base score
+_MODE_FRAC = {"plain": 0.75, "bagging": 0.8, "goss": 0.8, "dart": 0.9,
+              "rf": 0.95}
+
+MATRIX_OBJECTIVES = ["binary", "regression", "regression_l1", "poisson",
+                     "multiclass", "lambdarank"]
+
+
+def _train_cell(objective, mode_params, feature_params=None, seed=0,
+                rounds=12):
+    X, y, extra, metric, base = _matrix_data(objective, seed)
+    p = {**FAST, "objective": objective, **mode_params,
+         **(feature_params or {})}
+    p.update({k: v for k, v in extra.items() if k == "num_class"})
+    dkw = {}
+    if "group" in extra:
+        dkw["group"] = extra["group"]
+    weights = None
+    if feature_params and feature_params.get("_weights"):
+        p = {k: v for k, v in p.items() if k != "_weights"}
+        weights = np.linspace(0.5, 1.5, len(y))
+        dkw["weight"] = weights
+    cat = None
+    if feature_params and feature_params.get("_categorical"):
+        p = {k: v for k, v in p.items() if k != "_categorical"}
+        rng = np.random.default_rng(seed + 1)
+        X = X.copy()
+        catcol = rng.integers(0, 8, size=len(y)).astype(np.float64)
+        if objective in ("binary",):
+            y = ((y > 0.5) ^ (catcol < 2)).astype(np.float64)
+        X[:, -1] = catcol
+        cat = [X.shape[1] - 1]
+    if feature_params and feature_params.get("_efb"):
+        p = {k: v for k, v in p.items() if k != "_efb"}
+        rng = np.random.default_rng(seed + 2)
+        onehot = np.zeros((len(y), 6))
+        sel = rng.integers(0, 6, size=len(y))
+        onehot[np.arange(len(y)), sel] = 1.0
+        X = np.concatenate([X, onehot], axis=1)  # exclusive -> bundles
+    ds = lgb.Dataset(X, label=y, params=p, categorical_feature=cat, **dkw)
+    bst = lgb.train(p, ds, num_boost_round=rounds)
+    pred = bst.predict(X)
+    return bst, X, y, metric, base, pred
+
+
+@pytest.mark.parametrize("mode", list(MATRIX_MODES))
+@pytest.mark.parametrize("objective", MATRIX_OBJECTIVES)
+def test_objective_mode_matrix(objective, mode):
+    """Every (objective, boosting-mode) cell learns past a fraction of the
+    trivial predictor AND survives a model text round-trip bit-for-bit in
+    prediction."""
+    if objective == "lambdarank" and mode == "goss":
+        pytest.skip("goss resampling breaks query blocks (reference "
+                    "requires bagging_by_query for ranking subsamples)")
+    bst, X, y, metric, base, pred = _train_cell(objective,
+                                                MATRIX_MODES[mode])
+    score = metric(y, pred)
+    frac = _MODE_FRAC[mode]
+    assert score < frac * base, (objective, mode, score, base)
+    # save -> load -> identical predictions (model text is the contract)
+    s = bst.model_to_string()
+    bst2 = lgb.Booster(model_str=s)
+    pred2 = bst2.predict(X)
+    np.testing.assert_allclose(pred2, pred, rtol=1e-5, atol=1e-7,
+                               err_msg=f"{objective}/{mode}")
+
+
+MATRIX_FEATURES = {
+    "quantized": {"use_quantized_grad": True,
+                  "quant_train_renew_leaf": True},
+    "weights": {"_weights": True},
+    "categorical": {"_categorical": True},
+    "efb": {"_efb": True},
+    "bf16": {"tpu_hist_dtype": "bfloat16"},
+}
+
+
+@pytest.mark.parametrize("feature", list(MATRIX_FEATURES))
+@pytest.mark.parametrize("objective", ["binary", "regression",
+                                       "multiclass", "lambdarank"])
+def test_objective_feature_matrix(objective, feature):
+    """Every (objective, feature) cell: quantized gradients, sample
+    weights, categorical splits, EFB bundling and the bf16 kernel path
+    each keep the model learnable and round-trippable."""
+    if objective == "lambdarank" and feature == "quantized":
+        pytest.skip("ranking gradients are pair-normalized; the reference "
+                    "quantizes them too but at 30x our test rounds")
+    bst, X, y, metric, base, pred = _train_cell(
+        objective, {}, MATRIX_FEATURES[feature])
+    score = metric(y, pred)
+    assert score < 0.85 * base, (objective, feature, score, base)
+    s = bst.model_to_string()
+    bst2 = lgb.Booster(model_str=s)
+    np.testing.assert_allclose(bst2.predict(X), pred, rtol=1e-5,
+                               atol=1e-7, err_msg=f"{objective}/{feature}")
+
+
+@pytest.mark.parametrize("objective", ["binary", "regression", "multiclass"])
+def test_init_score_paths(objective):
+    """init_score seeds training (reference boost-from-init-score): a
+    booster continued from another model's scores must beat one trained
+    from scratch with the same SMALL round budget."""
+    X, y, extra, metric, base = _matrix_data(objective, seed=3)
+    k = int(extra.get("num_class", 1))
+    p = {**FAST, "objective": objective}
+    p.update({kk: v for kk, v in extra.items() if kk == "num_class"})
+    ds0 = lgb.Dataset(X, label=y, params=p)
+    warm = lgb.train(p, ds0, num_boost_round=10)
+    init = warm.predict(X, raw_score=True)
+    ds1 = lgb.Dataset(X, label=y, params=p,
+                      init_score=init.reshape(-1, order="F")
+                      if k > 1 else init)
+    cold = lgb.train(p, lgb.Dataset(X, label=y, params=p),
+                     num_boost_round=3)
+    hot = lgb.train(p, ds1, num_boost_round=3)
+    hot_pred = hot.predict(X, raw_score=True)
+    # continued predictions = init + new trees: add init back for scoring
+    full = hot_pred + init
+    if k > 1:
+        e = np.exp(full.reshape(-1, k) - full.reshape(-1, k).max(
+            axis=1, keepdims=True))
+        full_prob = e / e.sum(axis=1, keepdims=True)
+        score_hot = metric(y, full_prob)
+        score_cold = metric(y, cold.predict(X))
+    elif objective == "binary":
+        score_hot = metric(y, 1.0 / (1.0 + np.exp(-full)))
+        score_cold = metric(y, cold.predict(X))
+    else:
+        score_hot = metric(y, full)
+        score_cold = metric(y, cold.predict(X))
+    assert score_hot < score_cold, (objective, score_hot, score_cold)
+
+
+# ---- three-way mode x feature crosses (the combinations users actually
+# run together; each cell still carries threshold + round-trip)
+
+@pytest.mark.parametrize("objective,mode,feature", [
+    ("binary", "dart", "categorical"),
+    ("binary", "bagging", "quantized"),
+    ("binary", "goss", "bf16"),
+    ("regression", "dart", "weights"),
+    ("regression", "bagging", "efb"),
+    ("multiclass", "bagging", "weights"),
+    ("regression", "rf", "categorical"),
+    ("binary", "rf", "efb"),
+])
+def test_mode_feature_cross_matrix(objective, mode, feature):
+    bst, X, y, metric, base, pred = _train_cell(
+        objective, MATRIX_MODES[mode], MATRIX_FEATURES[feature])
+    score = metric(y, pred)
+    frac = min(_MODE_FRAC[mode] + 0.05, 0.97)
+    assert score < frac * base, (objective, mode, feature, score, base)
+    bst2 = lgb.Booster(model_str=bst.model_to_string())
+    np.testing.assert_allclose(bst2.predict(X), pred, rtol=1e-5, atol=1e-7)
+
+
+@pytest.mark.parametrize("objective", ["binary", "regression", "multiclass"])
+def test_predict_variants_per_objective(objective):
+    """pred_leaf shapes/index-validity, SHAP additivity, and
+    start/num_iteration slicing across objective families (reference
+    test_engine.py predict-variant blocks)."""
+    X, y, extra, metric, base = _matrix_data(objective, seed=5)
+    k = int(extra.get("num_class", 1))
+    p = {**FAST, "objective": objective}
+    p.update({kk: v for kk, v in extra.items() if kk == "num_class"})
+    bst = lgb.train(p, lgb.Dataset(X, label=y, params=p),
+                    num_boost_round=10)
+    n = 80
+    leaves = bst.predict(X[:n], pred_leaf=True)
+    assert leaves.shape == (n, 10 * k)
+    assert leaves.dtype.kind in "iu"
+    assert (leaves >= 0).all() and (leaves < FAST["num_leaves"]).all()
+    contrib = bst.predict(X[:n], pred_contrib=True)
+    raw = bst.predict(X[:n], raw_score=True)
+    f = X.shape[1]
+    assert contrib.shape == (n, (f + 1) * k)
+    # SHAP additivity: per-class contributions + bias == raw margin
+    csum = contrib.reshape(n, k, f + 1).sum(axis=2)
+    np.testing.assert_allclose(csum, raw.reshape(n, k, order="F")
+                               if k > 1 else csum * 0 + raw[:, None],
+                               rtol=1e-5, atol=1e-5)
+    # iteration slicing: first 4 + remaining 6 raw contributions compose
+    raw4 = bst.predict(X[:n], raw_score=True, num_iteration=4)
+    raw_rest = bst.predict(X[:n], raw_score=True, start_iteration=4,
+                           num_iteration=6)
+    np.testing.assert_allclose(np.asarray(raw4) + np.asarray(raw_rest),
+                               raw, rtol=1e-4, atol=1e-5)
+
+
+def test_class_imbalance_params(synthetic_binary):
+    """is_unbalance / scale_pos_weight upweight the positive class
+    (reference binary objective label weights)."""
+    X, y = synthetic_binary
+    # make it imbalanced: drop 80% of positives
+    rng = np.random.default_rng(0)
+    keep = (y < 0.5) | (rng.random(len(y)) < 0.2)
+    Xi, yi = X[keep], y[keep]
+    preds = {}
+    for name, extra in (("plain", {}), ("unbal", {"is_unbalance": True}),
+                        ("spw", {"scale_pos_weight": 4.0})):
+        p = {**FAST, "objective": "binary", **extra}
+        bst = lgb.train(p, lgb.Dataset(Xi, label=yi, params=p),
+                        num_boost_round=15)
+        preds[name] = bst.predict(Xi)
+    # upweighting positives raises mean predicted probability
+    assert preds["unbal"].mean() > preds["plain"].mean() * 1.05
+    assert preds["spw"].mean() > preds["plain"].mean() * 1.05
+
+
+def test_boost_from_average_toggle(synthetic_binary):
+    """boost_from_average=false starts from 0 margin; true from log-odds —
+    single-tree raw predictions must differ by roughly the prior's
+    log-odds (reference gbdt.cpp BoostFromAverage)."""
+    X, y = synthetic_binary
+    p1 = {**FAST, "objective": "binary", "boost_from_average": True}
+    p0 = {**FAST, "objective": "binary", "boost_from_average": False}
+    b1 = lgb.train(p1, lgb.Dataset(X, label=y, params=p1), num_boost_round=1)
+    b0 = lgb.train(p0, lgb.Dataset(X, label=y, params=p0), num_boost_round=1)
+    prior = float(y.mean())
+    logodds = np.log(prior / (1 - prior))
+    d = np.mean(b1.predict(X, raw_score=True) - b0.predict(X, raw_score=True))
+    assert abs(d - logodds) < 0.25 * abs(logodds) + 0.05
+
+
+def test_sigmoid_parameter(synthetic_binary):
+    """The sigmoid slope enters gradients AND the output transform
+    (reference binary_objective.hpp sigmoid_): raw margins scale ~1/s so
+    the predicted probabilities are near-invariant — the same geometry
+    the reference exhibits."""
+    X, y = synthetic_binary
+    p1 = {**FAST, "objective": "binary", "sigmoid": 1.0}
+    p2 = {**FAST, "objective": "binary", "sigmoid": 3.0}
+    b1 = lgb.train(p1, lgb.Dataset(X, label=y, params=p1), num_boost_round=8)
+    b2 = lgb.train(p2, lgb.Dataset(X, label=y, params=p2), num_boost_round=8)
+    r1 = b1.predict(X, raw_score=True)
+    r2 = b2.predict(X, raw_score=True)
+    assert not np.allclose(r1, r2)                  # raw margins differ
+    np.testing.assert_allclose(3.0 * np.median(np.abs(r2)),
+                               np.median(np.abs(r1)), rtol=0.25)
+    pr1, pr2 = b1.predict(X), b2.predict(X)
+    assert ((pr1 > 0) & (pr1 < 1)).all() and ((pr2 > 0) & (pr2 < 1)).all()
+    assert _auc(y, pr1) > 0.85 and _auc(y, pr2) > 0.85
+
+
+@pytest.mark.parametrize("objective", ["binary", "regression"])
+def test_cv_objectives(objective):
+    """lgb.cv returns per-iteration mean/stdv arrays that improve
+    (reference engine.py cv)."""
+    X, y, extra, metric, base = _matrix_data(objective, seed=7)
+    p = {**FAST, "objective": objective,
+         "metric": "binary_logloss" if objective == "binary" else "l2"}
+    res = lgb.cv(p, lgb.Dataset(X, label=y, params=p), num_boost_round=12,
+                 nfold=3, stratified=objective == "binary", seed=3)
+    mkey = [k for k in res if k.endswith("-mean")][0]
+    skey = [k for k in res if k.endswith("-stdv")][0]
+    assert len(res[mkey]) == 12 and len(res[skey]) == 12
+    assert res[mkey][-1] < res[mkey][0]
+    assert all(s >= 0 for s in res[skey])
+
+
+@pytest.mark.parametrize("objective", ["binary", "regression"])
+def test_early_stopping_min_delta_matrix(objective):
+    """early_stopping(min_delta) stops sooner than plain early stopping
+    (reference callback.py min_delta support, test_callback.py)."""
+    X, y, extra, metric, base = _matrix_data(objective, seed=9)
+    half = len(y) // 2
+    p = {**FAST, "objective": objective,
+         "metric": "binary_logloss" if objective == "binary" else "l2"}
+    ds = lgb.Dataset(X[:half], label=y[:half], params=p)
+    dv = ds.create_valid(X[half:], label=y[half:])
+
+    def run(cb):
+        return lgb.train(p, ds, num_boost_round=200, valid_sets=[dv],
+                         callbacks=[cb])
+
+    b_plain = run(lgb.early_stopping(5, verbose=False))
+    b_delta = run(lgb.early_stopping(5, min_delta=0.05, verbose=False))
+    assert b_delta.best_iteration <= b_plain.best_iteration
+    assert b_plain.best_iteration < 200
+
+
+def test_max_depth_respected_in_model():
+    """max_depth caps every tree's leaf depth (reference config check +
+    serial_tree_learner depth gating), verified from the dumped model."""
+    X, y, *_ = _matrix_data("binary", seed=11)
+    p = {**FAST, "objective": "binary", "max_depth": 3, "num_leaves": 31}
+    bst = lgb.train(p, lgb.Dataset(X, label=y, params=p), num_boost_round=5)
+    dump = bst.dump_model()
+
+    def depth(node, d=0):
+        if "split_feature" not in node:
+            return d
+        return max(depth(node["left_child"], d + 1),
+                   depth(node["right_child"], d + 1))
+
+    for t in dump["tree_info"]:
+        assert depth(t["tree_structure"]) <= 3
+
+
+def test_min_gain_to_split_prunes():
+    X, y, *_ = _matrix_data("regression", seed=12)
+    p0 = {**FAST, "objective": "regression", "min_gain_to_split": 0.0}
+    p1 = {**FAST, "objective": "regression", "min_gain_to_split": 1e3}
+    b0 = lgb.train(p0, lgb.Dataset(X, label=y, params=p0), num_boost_round=3)
+    b1 = lgb.train(p1, lgb.Dataset(X, label=y, params=p1), num_boost_round=3)
+    n0 = sum(t["num_leaves"] for t in b0.dump_model()["tree_info"])
+    n1 = sum(t["num_leaves"] for t in b1.dump_model()["tree_info"])
+    assert n1 < n0
+
+
+def test_feature_importance_split_vs_gain():
+    """split/gain importances agree on the dominant feature and match the
+    dumped model's split counts (reference Booster.feature_importance)."""
+    rng = np.random.default_rng(13)
+    n = 900
+    X = rng.normal(size=(n, 5))
+    y = (X[:, 2] > 0).astype(np.float64)      # single informative feature
+    p = {**FAST, "objective": "binary"}
+    bst = lgb.train(p, lgb.Dataset(X, label=y, params=p), num_boost_round=5)
+    imp_split = bst.feature_importance(importance_type="split")
+    imp_gain = bst.feature_importance(importance_type="gain")
+    # the informative feature dominates GAIN (split counts include the
+    # tiny noise splits under the pure root partition)
+    assert int(np.argmax(imp_gain)) == 2
+    root = bst.dump_model()["tree_info"][0]["tree_structure"]
+    assert root["split_feature"] == 2
+    assert imp_split.sum() == sum(
+        t["num_leaves"] - 1 for t in bst.dump_model()["tree_info"])
+
+
+def test_monotone_constraint_with_bagging():
+    """Monotone constraints hold under bagging (matrix cross; reference
+    monotone_constraints.hpp under any sampling): predictions must be
+    nondecreasing along the constrained feature."""
+    rng = np.random.default_rng(14)
+    n = 1200
+    X = rng.uniform(-2, 2, size=(n, 4))
+    y = 1.5 * X[:, 0] + np.sin(X[:, 1] * 3) + 0.2 * rng.normal(size=n)
+    p = {**FAST, "objective": "regression",
+         "monotone_constraints": [1, 0, 0, 0],
+         "bagging_fraction": 0.7, "bagging_freq": 1}
+    bst = lgb.train(p, lgb.Dataset(X, label=y, params=p),
+                    num_boost_round=20)
+    grid = np.linspace(-2, 2, 60)
+    base_rows = X[:20].copy()
+    for r in base_rows:
+        probe = np.tile(r, (60, 1))
+        probe[:, 0] = grid
+        pr = bst.predict(probe)
+        assert (np.diff(pr) >= -1e-10).all()
+
+
+def test_cv_early_stopping_truncates_to_best():
+    """cv + early_stopping truncates histories at the aggregate best
+    iteration and sets CVBooster.best_iteration (reference cv contract:
+    len(res[...]) is the round count to retrain with)."""
+    X, y, extra, metric, base = _matrix_data("regression", seed=21)
+    p = {**FAST, "objective": "regression", "metric": "l2"}
+    res = lgb.cv(p, lgb.Dataset(X, label=y, params=p), num_boost_round=400,
+                 nfold=3, callbacks=[lgb.early_stopping(5, verbose=False)],
+                 seed=2, return_cvbooster=True)
+    curve = res["valid l2-mean"]
+    assert len(curve) < 400
+    assert res["cvbooster"].best_iteration == len(curve)
+    # the last entry is the minimum of the truncated curve
+    assert curve[-1] == min(curve)
+    assert len(res["valid l2-stdv"]) == len(curve)

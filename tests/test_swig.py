@@ -1,0 +1,119 @@
+"""SWIG binding surface (swig/lgbtpulib.i — the JVM consumer path, the
+counterpart of the reference's swig/lightgbmlib.i).
+
+No JDK ships in this image, so the Java target is validated at the
+generation level (the .i produces a JNI wrapper + Java classes covering
+the ABI) and the END-TO-END proof — generate, compile, link against
+liblgbtpu_capi.so, call through the generated binding — runs with SWIG's
+Python target as the stand-in host language: the same interface file,
+typemaps and library produce a working binding either way."""
+
+import os
+import shutil
+import subprocess
+import sys
+import sysconfig
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+try:
+    from lightgbm_tpu.native import build_capi
+    CAPI = build_capi()
+except Exception:
+    CAPI = None
+
+pytestmark = pytest.mark.skipif(
+    CAPI is None or shutil.which("swig") is None,
+    reason="swig or the C ABI library unavailable")
+
+
+def test_java_binding_generates(tmp_path):
+    out = tmp_path / "java"
+    out.mkdir()
+    rc = subprocess.run(
+        ["swig", "-c++", "-java", "-package", "io.lgbtpu",
+         "-outdir", str(out), "-o", str(tmp_path / "wrap.cxx"),
+         os.path.join(REPO, "swig", "lgbtpulib.i")],
+        capture_output=True, text=True)
+    assert rc.returncode == 0, rc.stderr
+    jni = (out / "lgbtpulibJNI.java").read_text()
+    for fn in ("LGBMTPU_DatasetCreateFromMat", "LGBMTPU_BoosterCreate",
+               "LGBMTPU_BoosterUpdateOneIter",
+               "LGBMTPU_BoosterPredictForMat",
+               "LGBMTPU_BoosterSaveModelToStringSWIG",
+               "LGBMTPU_DatasetCreateFromCSR",
+               "LGBMTPU_NetworkInit"):
+        assert fn in jni, fn
+    assert "jni.h" in (tmp_path / "wrap.cxx").read_text()
+
+
+@pytest.mark.slow
+def test_swig_binding_end_to_end_python_target(tmp_path):
+    """Generate -> compile -> link -> import -> train through the SWIG
+    binding (Python as the stand-in target language)."""
+    wrap = tmp_path / "wrap.cxx"
+    rc = subprocess.run(
+        ["swig", "-c++", "-python", "-outdir", str(tmp_path),
+         "-o", str(wrap), os.path.join(REPO, "swig", "lgbtpulib.i")],
+        capture_output=True, text=True)
+    assert rc.returncode == 0, rc.stderr
+    inc = sysconfig.get_paths()["include"]
+    libdir = os.path.dirname(CAPI)
+    so = tmp_path / "_lgbtpulib.so"
+    rc = subprocess.run(
+        ["g++", "-O1", "-shared", "-fPIC", str(wrap), f"-I{inc}",
+         f"-I{REPO}", f"-I{os.path.join(REPO, 'swig')}",
+         f"-L{libdir}", "-llgbtpu_capi",
+         f"-Wl,-rpath,{libdir}", "-o", str(so)],
+        capture_output=True, text=True)
+    assert rc.returncode == 0, rc.stderr
+    driver = tmp_path / "drive.py"
+    driver.write_text("""
+import sys
+sys.path.insert(0, sys.argv[1])
+import numpy as np
+import lgbtpulib as L
+
+rng = np.random.default_rng(0)
+n, f = 400, 4
+X = rng.normal(size=(n, f))
+y = (X[:, 0] > 0).astype(np.float64)
+buf = L.new_doubleArray(n * f)
+for i, v in enumerate(X.ravel()):
+    L.doubleArray_setitem(buf, i, float(v))
+lab = L.new_doubleArray(n)
+for i, v in enumerate(y):
+    L.doubleArray_setitem(lab, i, float(v))
+params = '{"objective": "binary", "num_leaves": 7, "min_data_in_leaf": 5,'\
+         ' "verbose": -1}'
+dsp = L.new_int64p()
+assert L.LGBMTPU_DatasetCreateFromMat(buf, n, f, lab, params, dsp) == 0, \
+    L.LGBMTPU_GetLastError()
+ds = L.int64p_value(dsp)
+bp = L.new_int64p()
+assert L.LGBMTPU_BoosterCreate(ds, params, bp) == 0, L.LGBMTPU_GetLastError()
+bst = L.int64p_value(bp)
+fin = L.new_intp()
+for _ in range(4):
+    assert L.LGBMTPU_BoosterUpdateOneIter(bst, fin) == 0
+s = L.LGBMTPU_BoosterSaveModelToStringSWIG(bst)
+assert s and "tree" in s, s[:80]
+out = L.new_doubleArray(n)
+olp = L.new_int64p()
+L.int64p_assign(olp, n)
+assert L.LGBMTPU_BoosterPredictForMat(bst, buf, n, f, 0, out, olp) == 0
+preds = np.array([L.doubleArray_getitem(out, i) for i in range(n)])
+acc = float(((preds > 0.5) == y).mean())
+assert acc > 0.8, acc
+print("SWIG_E2E_OK", acc)
+""")
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    r = subprocess.run([sys.executable, str(driver), str(tmp_path)],
+                       capture_output=True, text=True, env=env, timeout=420)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "SWIG_E2E_OK" in r.stdout

@@ -1,0 +1,63 @@
+"""Regenerate lightgbm_tpu/native/capi.h from capi.cpp's definitions.
+
+The header is the SWIG/JVM + C-consumer surface (the counterpart of the
+reference's include/LightGBM/c_api.h); run after adding ABI entries."""
+import os
+import re
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SRC = os.path.join(ROOT, "lightgbm_tpu", "native", "capi.cpp")
+DST = os.path.join(ROOT, "lightgbm_tpu", "native", "capi.h")
+
+HEADER = '''/* C ABI header for lightgbm_tpu (native/capi.cpp) — the counterpart of
+ * the reference's include/LightGBM/c_api.h.  Conventions: every function
+ * returns 0 on success / -1 on failure, with LGBMTPU_GetLastError()
+ * holding the message (thread-local).  Handles are opaque int64 ids.
+ *
+ * Generated from capi.cpp's definitions; regenerate with
+ * tools/gen_capi_header.py after adding entries. */
+#ifndef LIGHTGBM_TPU_CAPI_H_
+#define LIGHTGBM_TPU_CAPI_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+'''
+
+FOOTER = '''
+
+#ifdef __cplusplus
+}  /* extern "C" */
+#endif
+
+#endif  /* LIGHTGBM_TPU_CAPI_H_ */
+'''
+
+
+def generate() -> str:
+    src = open(SRC).read()
+    pat = re.compile(r'^([A-Za-z_][A-Za-z0-9_ ]*?\**)\s+(LGBMTPU_\w+)'
+                     r'\(([^{]*?)\)\s*\{', re.M | re.S)
+    decls = []
+    emitted = set()
+    for m in pat.finditer(src):
+        ret, name, args = m.group(1), m.group(2), " ".join(m.group(3).split())
+        decls.append(f"{ret} {name}({args});")
+        emitted.add(name)
+    # completeness gate: every LGBMTPU_ symbol mentioned in capi.cpp must
+    # be declared — a silently dropped definition would surface as an
+    # implicit-declaration error at some consumer instead of here
+    mentioned = set(re.findall(r"\b(LGBMTPU_\w+)\s*\(", src))
+    missing = mentioned - emitted
+    if missing:
+        raise SystemExit(f"capi.h generation missed definitions: "
+                         f"{sorted(missing)}")
+    return HEADER + "\n".join(decls) + FOOTER
+
+
+if __name__ == "__main__":
+    open(DST, "w").write(generate())
+    print(f"wrote {DST}")
