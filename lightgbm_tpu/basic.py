@@ -378,6 +378,115 @@ class Dataset:
             self._inner.metadata.set_init_score(init_score)
         return self
 
+    def get_data(self):
+        """Raw data this Dataset was built from (reference
+        Dataset.get_data; raises after free_raw_data-style release)."""
+        if self.data is None:
+            log.fatal("Cannot get data: the raw data was freed or this "
+                      "Dataset was created from a binary/subset source")
+        return self.data
+
+    def get_params(self) -> Dict[str, Any]:
+        """reference Dataset.get_params."""
+        return dict(self.params)
+
+    def get_feature_name(self) -> List[str]:
+        """reference Dataset.get_feature_name."""
+        return list(self.feature_names)
+
+    def set_feature_name(self, feature_name: List[str]) -> "Dataset":
+        """reference Dataset.set_feature_name (alias of
+        set_feature_names)."""
+        return self.set_feature_names(list(feature_name))
+
+    def get_field(self, field_name: str) -> Optional[np.ndarray]:
+        """reference Dataset.get_field: label/weight/init_score as float
+        arrays, 'group' as cumulative query BOUNDARIES (the reference's
+        storage form), 'position' as int."""
+        self.construct()
+        md = self._inner.metadata
+        if field_name == "label":
+            return None if md.label is None else np.asarray(md.label)
+        if field_name == "weight":
+            return None if md.weight is None else np.asarray(md.weight)
+        if field_name == "init_score":
+            return None if md.init_score is None else \
+                np.asarray(md.init_score)
+        if field_name == "group":
+            qb = md.query_boundaries
+            return None if qb is None else np.asarray(qb, np.int32)
+        if field_name == "position":
+            pos = getattr(md, "position", None)
+            return None if pos is None else np.asarray(pos, np.int32)
+        log.fatal(f"Unknown field name: {field_name}")
+
+    def set_field(self, field_name: str, data) -> "Dataset":
+        """reference Dataset.set_field."""
+        if field_name == "label":
+            return self.set_label(data)
+        if field_name == "weight":
+            return self.set_weight(data)
+        if field_name == "init_score":
+            return self.set_init_score(data)
+        if field_name == "group":
+            return self.set_group(np.asarray(data))
+        if field_name == "position":
+            return self.set_position(data)
+        log.fatal(f"Unknown field name: {field_name}")
+
+    def get_position(self) -> Optional[np.ndarray]:
+        """reference Dataset.get_position (position-debiased ranking)."""
+        return self.get_field("position")
+
+    def set_position(self, position) -> "Dataset":
+        """reference Dataset.set_position."""
+        pos = np.asarray(position, np.int32)
+        self.position = pos
+        if self._inner is not None:
+            self._inner.metadata.set_position(pos)
+        return self
+
+    def set_categorical_feature(self, categorical_feature) -> "Dataset":
+        """reference Dataset.set_categorical_feature: effective before
+        construction; afterwards the binning is fixed."""
+        if self._inner is not None and \
+                list(categorical_feature or []) != \
+                list(self.categorical_feature or []):
+            log.warning("set_categorical_feature ignored: the Dataset is "
+                        "already constructed with its own binning")
+            return self
+        self.categorical_feature = categorical_feature
+        return self
+
+    def set_reference(self, reference: "Dataset") -> "Dataset":
+        """reference Dataset.set_reference: align bins to another
+        dataset's mappers (before construction)."""
+        if self._inner is not None and reference is not self.reference:
+            log.warning("set_reference ignored: the Dataset is already "
+                        "constructed")
+            return self
+        self.reference = reference
+        return self
+
+    def get_ref_chain(self, ref_limit: int = 100):
+        """reference Dataset.get_ref_chain: the set of datasets reachable
+        through .reference links."""
+        head: Optional["Dataset"] = self
+        chain = set()
+        while head is not None and len(chain) < ref_limit:
+            if head in chain:
+                break
+            chain.add(head)
+            head = head.reference
+        return chain
+
+    def feature_num_bin(self, feature: Union[int, str]) -> int:
+        """reference Dataset.feature_num_bin: bin count of one feature."""
+        self.construct()
+        if isinstance(feature, str):
+            feature = self.feature_names.index(feature)
+        return int(self._inner.mappers[int(feature)].num_bin)
+
     @property
     def feature_names(self) -> List[str]:
         return self.inner.feature_names
@@ -489,6 +598,9 @@ class Booster:
     # ------------------------------------------------------------ training
     def add_valid(self, data: Dataset, name: str) -> "Booster":
         data.construct()
+        if not hasattr(self, "_valid_lookup"):
+            self._valid_lookup = {}
+        self._valid_lookup[data] = len(self._gbdt.valid_sets)
         self._gbdt.add_valid(data.inner, name)
         return self
 
@@ -524,7 +636,11 @@ class Booster:
 
     # ---------------------------------------------------------- evaluation
     def eval_train(self):
-        return self._gbdt.eval_train()
+        out = self._gbdt.eval_train()
+        name = getattr(self, "_train_data_name", "training")
+        if name != "training":
+            out = [(name,) + r[1:] for r in out]
+        return out
 
     def eval_valid(self):
         return self._gbdt.eval_valid()
@@ -952,3 +1068,192 @@ class Booster:
         if self._gbdt is not None:
             return self._gbdt.train_set.feature_names
         return self._loaded["feature_names"]
+
+    # ------------------------------------------------- parity accessors
+    def model_from_string(self, model_str: str) -> "Booster":
+        """Load a model INTO this booster (reference
+        Booster.model_from_string): replaces the current model state."""
+        other = Booster(model_str=model_str)
+        self._gbdt = None
+        self._loaded = other._loaded
+        self.params = other.params
+        self.pandas_categorical = other.pandas_categorical
+        self.best_iteration = -1
+        return self
+
+    def set_train_data_name(self, name: str) -> "Booster":
+        """reference Booster.set_train_data_name: the label used for the
+        training set in eval output (see eval_train)."""
+        self._train_data_name = name
+        return self
+
+    def free_dataset(self) -> "Booster":
+        """reference Booster.free_dataset: drop the Python references to
+        the raw training/validation data (the binned device state the
+        booster trains on is retained)."""
+        self.train_set = None
+        return self
+
+    def set_network(self, machines, local_listen_port: int = 12400,
+                    listen_time_out: int = 120,
+                    num_machines: int = 1) -> "Booster":
+        """reference Booster.set_network -> LGBM_NetworkInit: records the
+        machine list and brings up the distributed runtime
+        (parallel/launcher.py; device collectives are XLA's)."""
+        from .capi_impl import network_init
+        if isinstance(machines, (list, set)):
+            machines = ",".join(str(m) for m in machines)
+        network_init(str(machines), int(local_listen_port),
+                     int(listen_time_out), int(num_machines))
+        self._network = True
+        return self
+
+    def free_network(self) -> "Booster":
+        """reference Booster.free_network."""
+        from .capi_impl import network_free
+        network_free()
+        self._network = False
+        return self
+
+    def get_leaf_output(self, tree_id: int, leaf_id: int) -> float:
+        """reference Booster.get_leaf_output / LGBM_BoosterGetLeafValue."""
+        return float(self._get_trees()[tree_id].leaf_value[leaf_id])
+
+    def set_leaf_output(self, tree_id: int, leaf_id: int,
+                        value: float) -> "Booster":
+        """reference Booster.set_leaf_output / LGBM_BoosterSetLeafValue;
+        cached training scores are rebuilt like the reference's
+        ScoreUpdater re-drive."""
+        t = self._get_trees()[tree_id]
+        t.leaf_value[leaf_id] = float(value)
+        if t.is_linear:
+            t.leaf_const[leaf_id] = float(value)
+            t.leaf_coeff[leaf_id] = []
+            t.leaf_features[leaf_id] = []
+        if self._gbdt is not None:
+            self._gbdt.invalidate_score_cache()
+        return self
+
+    def upper_bound(self) -> float:
+        """reference Booster.upper_bound: sum over trees of the maximum
+        leaf output (GBDT::GetUpperBoundValue)."""
+        return float(sum(float(np.max(t.leaf_value)) if t.num_leaves else 0.0
+                         for t in self._get_trees()))
+
+    def lower_bound(self) -> float:
+        """reference Booster.lower_bound (GBDT::GetLowerBoundValue)."""
+        return float(sum(float(np.min(t.leaf_value)) if t.num_leaves else 0.0
+                         for t in self._get_trees()))
+
+    def _check_valid_alignment(self, data: Dataset) -> None:
+        """The reference refuses validation data with different bin
+        mappers (Dataset::CheckAlign); a dataset binned independently
+        would evaluate trees against foreign bin indices."""
+        if self.train_set is not None and \
+                self.train_set in data.get_ref_chain():
+            return
+        data.construct()
+        tm = self._gbdt.train_set.mappers
+        vm = data.inner.mappers
+        if len(tm) != len(vm) or any(
+                not np.array_equal(np.asarray(a.bin_upper_bound),
+                                   np.asarray(b.bin_upper_bound))
+                for a, b in zip(tm, vm)):
+            log.fatal("cannot evaluate data with different bin mappers; "
+                      "build it with create_valid / reference=")
+
+    def eval(self, data: Dataset, name: str, feval=None) -> List[tuple]:
+        """Evaluate the current model on ``data`` (reference
+        Booster.eval): registered train/valid sets reuse their cached
+        scores; any other ALIGNED Dataset is registered like the
+        reference does (and stays registered)."""
+        if self._gbdt is not None and data is self.train_set:
+            out = [(name,) + r[1:] for r in self.eval_train()]
+            scores_for_feval = self._gbdt.scores
+        elif self._gbdt is not None:
+            if data not in getattr(self, "_valid_lookup", {}):
+                # the reference's eval registers unseen data as a valid
+                # set; rebuilding the score caches folds the existing
+                # trees into its scores
+                self._check_valid_alignment(data)
+                self.add_valid(data, name)
+                self._gbdt.invalidate_score_cache()
+            vi = self._valid_lookup[data]
+            out = [(name,) + r[1:] for r in self._gbdt._eval_metric_list(
+                self._gbdt.valid_names[vi], self._gbdt.valid_metrics[vi],
+                self._gbdt.valid_scores[vi])]
+            scores_for_feval = self._gbdt.valid_scores[vi]
+        else:
+            # loaded booster: score through prediction (needs the raw
+            # data, i.e. free_raw_data=False on `data`), with metrics and
+            # output conversion from the MODEL's stored params/objective
+            from .config import Config
+            from .metrics import create_metrics
+            from .objectives import create_objective
+            data.construct()
+            # model files store the objective with inline args
+            # ("binary sigmoid:1", "lambdarank lambdarank_truncation..."):
+            # split into the name plus parameter tokens
+            obj_toks = str(self._loaded.get("objective", "none")).split()
+            obj_extra = {t.split(":", 1)[0]: t.split(":", 1)[1]
+                         for t in obj_toks[1:] if ":" in t}
+            cfg = Config({**(self._loaded.get("params") or {}), **obj_extra,
+                          "objective": obj_toks[0] if obj_toks else "none",
+                          **self.params})
+            ms = create_metrics(cfg)
+            md = data.inner.metadata
+            for m in ms:
+                m.init(md, data.inner.num_data)
+            obj = None
+            try:
+                obj = create_objective(cfg)
+                if obj is not None:
+                    obj.init(md, data.inner.num_data)
+            except Exception:
+                obj = None
+            raw = np.asarray(self.predict(data.get_data(), raw_score=True),
+                             np.float64)
+            k = self.num_model_per_iteration()
+            score = raw if k == 1 else raw.reshape(-1, k, order="F")
+            isc = md.init_score
+            if isc is not None:
+                score = score + (isc.reshape(score.shape, order="F")
+                                 if np.size(isc) == score.size
+                                 else np.asarray(isc).reshape(-1, 1 if k == 1
+                                                              else k))
+            out = []
+            for m in ms:
+                for mname, val in m.eval(score, obj):
+                    out.append((name, mname, val, m.bigger_is_better))
+            scores_for_feval = score
+        if feval is not None:
+            fevals = feval if isinstance(feval, (list, tuple)) else [feval]
+            sc = np.asarray(scores_for_feval, np.float64)
+            sc = sc[:, 0] if sc.ndim == 2 and sc.shape[1] == 1 else sc
+            for f in fevals:
+                res = f(sc, data)
+                out.append((name,) + tuple(res))
+        return out
+
+    def get_split_value_histogram(self, feature, bins=None,
+                                  xgboost_style: bool = False):
+        """reference Booster.get_split_value_histogram: histogram over
+        the model's split thresholds of one feature (numerical splits;
+        the reference excludes categorical too)."""
+        fnames = self.feature_name()
+        fidx = fnames.index(feature) if isinstance(feature, str) \
+            else int(feature)
+        values = []
+        for t in self._get_trees():
+            for i in range(max(t.num_leaves - 1, 0)):
+                if int(t.split_feature[i]) == fidx and \
+                        not (int(t.decision_type[i]) & 1):
+                    values.append(float(t.threshold[i]))
+        values = np.asarray(values, np.float64)
+        if bins is None:
+            bins = max(len(np.unique(values)), 1)
+        hist, edges = np.histogram(values, bins=bins)
+        if not xgboost_style:
+            return hist, edges
+        nz = hist > 0
+        return np.column_stack([edges[1:][nz], hist[nz]])

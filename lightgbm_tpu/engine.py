@@ -256,9 +256,13 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
     # early stopping in any fold: truncate to the aggregate best
     # iteration over the mean curve and record it, like the reference's
     # cv (its folds run in lockstep and stop once)
+    # params may override the round count (train() honors
+    # params['num_iterations']); compare against the EFFECTIVE count or a
+    # params-supplied limit would read as early stopping
+    nbr_eff = int(params.get("num_iterations", num_boost_round))
     stopped = any(
         min((len(r) for r in h.get("valid", {}).values()),
-            default=num_boost_round) < num_boost_round
+            default=nbr_eff) < nbr_eff
         for h in histories)
     if first_valid_key and stopped:
         ev0 = cvb.boosters[0].eval_valid()

@@ -131,6 +131,10 @@ class LGBMModel:
         callbacks = list(callbacks or [])
         if early_stopping_rounds:
             callbacks.append(early_stopping_cb(early_stopping_rounds))
+        self._evals_result: Dict[str, Dict[str, List[float]]] = {}
+        if valid_sets:
+            from .callback import record_evaluation
+            callbacks.append(record_evaluation(self._evals_result))
         self._Booster = _train(params, train_ds,
                                num_boost_round=self.n_estimators,
                                valid_sets=valid_sets, valid_names=eval_names,
@@ -177,6 +181,51 @@ class LGBMModel:
     @property
     def n_features_(self) -> int:
         return self._n_features
+
+    @property
+    def n_features_in_(self) -> int:
+        """sklearn-convention alias (reference LGBMModel.n_features_in_)."""
+        return self._n_features
+
+    @property
+    def best_score_(self):
+        """reference LGBMModel.best_score_."""
+        return dict(self.booster_.best_score)
+
+    @property
+    def evals_result_(self):
+        """Per-iteration eval history recorded during fit (reference
+        LGBMModel.evals_result_; empty when fit ran without eval_set)."""
+        if getattr(self, "_evals_result", None) is None:
+            raise RuntimeError("Estimator not fitted")
+        return self._evals_result
+
+    @property
+    def feature_name_(self) -> List[str]:
+        return self.booster_.feature_name()
+
+    @property
+    def feature_names_in_(self) -> np.ndarray:
+        """sklearn-convention array form (reference
+        LGBMModel.feature_names_in_)."""
+        return np.asarray(self.booster_.feature_name())
+
+    @property
+    def n_estimators_(self) -> int:
+        """Actual fitted tree rounds (reference LGBMModel.n_estimators_:
+        best_iteration when early stopping fired, else all rounds)."""
+        return int(self.booster_.best_iteration
+                   if self.booster_.best_iteration > 0
+                   else self.booster_.current_iteration())
+    n_iter_ = n_estimators_
+
+    @property
+    def objective_(self) -> str:
+        """Resolved objective of the fitted model (reference
+        LGBMModel.objective_)."""
+        from .config import resolve_objective_alias
+        return resolve_objective_alias(
+            self.objective or self._default_objective)
 
 
 class LGBMRegressor(LGBMModel):

@@ -63,3 +63,127 @@ def test_get_set_params():
     clf.set_params(num_leaves=9, some_extra=1)
     assert clf.num_leaves == 9
     assert clf.get_params()["some_extra"] == 1
+
+
+def test_fitted_attribute_surface(synthetic_binary):
+    """Reference LGBMModel fitted-attribute parity: best_score_,
+    evals_result_, feature_name_/feature_names_in_, n_features_in_,
+    n_estimators_/n_iter_, objective_."""
+    import lightgbm_tpu as lgb
+    X, y = synthetic_binary
+    clf = lgb.LGBMClassifier(n_estimators=20, num_leaves=15,
+                             min_child_samples=5, verbose=-1)
+    clf.fit(X, y, eval_set=[(X[:300], y[:300])],
+            eval_metric=["binary_logloss"], early_stopping_rounds=5)
+    assert clf.n_features_in_ == X.shape[1]
+    assert list(clf.feature_names_in_) == clf.feature_name_
+    assert len(clf.feature_name_) == X.shape[1]
+    er = clf.evals_result_
+    (set_name, metrics), = er.items()
+    assert "binary_logloss" in metrics
+    assert len(metrics["binary_logloss"]) >= clf.best_iteration_
+    assert clf.best_score_  # populated dict
+    assert 0 < clf.n_estimators_ <= 20
+    assert clf.n_iter_ == clf.n_estimators_
+    assert clf.objective_ == "binary"
+
+
+def test_booster_parity_accessors(synthetic_binary, tmp_path):
+    """Reference Booster method parity: eval/get_leaf_output/
+    set_leaf_output/bounds/split-value histogram/model_from_string/
+    set_train_data_name/free_dataset."""
+    import lightgbm_tpu as lgb
+    X, y = synthetic_binary
+    p = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 5,
+         "verbose": -1, "metric": "binary_logloss"}
+    ds = lgb.Dataset(X, label=y, params=p)
+    dv = ds.create_valid(X[:400], label=y[:400])
+    bst = lgb.train(p, ds, num_boost_round=6, valid_sets=[dv],
+                    keep_training_booster=True)
+    # eval on the registered valid set and on a fresh aligned set
+    r1 = bst.eval(dv, "again")
+    assert r1 and r1[0][0] == "again" and np.isfinite(r1[0][2])
+    dfresh = ds.create_valid(X[400:800], label=y[400:800])
+    r2 = bst.eval(dfresh, "fresh")
+    assert r2 and np.isfinite(r2[0][2])
+    # bounds bracket every raw prediction
+    raw = bst.predict(X, raw_score=True)
+    assert raw.max() <= bst.upper_bound() + 1e-9
+    assert raw.min() >= bst.lower_bound() - 1e-9
+    # leaf edit round-trip invalidates caches
+    v = bst.get_leaf_output(0, 0)
+    bst.set_leaf_output(0, 0, v + 0.25)
+    assert abs(bst.get_leaf_output(0, 0) - (v + 0.25)) < 1e-12
+    # split value histogram of the most used feature
+    imp = bst.feature_importance()
+    f = int(np.argmax(imp))
+    hist, edges = bst.get_split_value_histogram(f)
+    assert hist.sum() > 0 and len(edges) == len(hist) + 1
+    xgb = bst.get_split_value_histogram(f, xgboost_style=True)
+    assert xgb.shape[1] == 2
+    # model_from_string replaces the model in place
+    s = bst.model_to_string()
+    other = lgb.Booster(model_str=s)
+    other.model_from_string(s)
+    np.testing.assert_allclose(other.predict(X[:50]), bst.predict(X[:50]),
+                               rtol=1e-5, atol=1e-7)
+    bst.set_train_data_name("train0")
+    bst.free_dataset()
+    assert bst.train_set is None
+
+
+def test_dataset_parity_accessors(synthetic_binary):
+    """Reference Dataset method parity: fields, params, names, positions,
+    ref chains and per-feature bin counts."""
+    import lightgbm_tpu as lgb
+    X, y = synthetic_binary
+    p = {"objective": "binary", "max_bin": 31, "verbose": -1}
+    w = np.linspace(0.5, 1.5, len(y))
+    ds = lgb.Dataset(X, label=y, weight=w, params=p, free_raw_data=False)
+    ds.construct()
+    np.testing.assert_array_equal(ds.get_field("label"), y)
+    np.testing.assert_allclose(ds.get_field("weight"), w)
+    assert ds.get_params()["max_bin"] == 31
+    assert ds.get_feature_name() == ds.feature_names
+    assert np.shape(ds.get_data()) == X.shape
+    assert 1 < ds.feature_num_bin(0) <= 31
+    dv = ds.create_valid(X[:100], label=y[:100])
+    dv.construct()
+    chain = dv.get_ref_chain()
+    assert ds in chain and dv in chain
+    # set_field routes to the typed setters
+    ds.set_field("weight", np.ones(len(y)))
+    np.testing.assert_allclose(ds.get_field("weight"), 1.0)
+    # group field round-trips as boundaries
+    n = len(y)
+    dq = lgb.Dataset(X, label=y, group=[n // 2, n - n // 2], params=p)
+    dq.construct()
+    qb = dq.get_field("group")
+    assert qb[0] == 0 and qb[-1] == n and len(qb) == 3
+
+
+def test_booster_eval_guard_and_loaded_eval(synthetic_binary):
+    """eval() on a misaligned dataset fails loudly (reference CheckAlign);
+    a LOADED booster evaluates with the model file's objective (sigmoid
+    applied, binary metrics) given raw data."""
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.utils.log import LightGBMError
+    X, y = synthetic_binary
+    p = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 5,
+         "verbose": -1, "metric": "binary_logloss"}
+    bst = lgb.train(p, lgb.Dataset(X, label=y, params=p),
+                    num_boost_round=6, keep_training_booster=True)
+    rogue = lgb.Dataset(X[:200] * 3.0 + 1.0, label=y[:200],
+                        params={"max_bin": 7})
+    with pytest.raises(LightGBMError):
+        bst.eval(rogue, "rogue")
+    # loaded booster eval via prediction path
+    loaded = lgb.Booster(model_str=bst.model_to_string())
+    dv = lgb.Dataset(X[:400], label=y[:400], params=p, free_raw_data=False)
+    res = loaded.eval(dv, "v")
+    (nm, metric, val, hb), = res
+    assert nm == "v" and metric == "binary_logloss"
+    assert 0.0 < val < 0.7, val          # sigmoid applied -> sane logloss
+    # train-data relabeling
+    bst.set_train_data_name("train0")
+    assert bst.eval_train()[0][0] == "train0"
