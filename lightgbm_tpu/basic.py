@@ -1072,13 +1072,17 @@ class Booster:
     # ------------------------------------------------- parity accessors
     def model_from_string(self, model_str: str) -> "Booster":
         """Load a model INTO this booster (reference
-        Booster.model_from_string): replaces the current model state."""
+        Booster.model_from_string): replaces the model state; the
+        booster's own params are kept (the reference does not touch
+        them) and training-only state is cleared."""
         other = Booster(model_str=model_str)
         self._gbdt = None
         self._loaded = other._loaded
-        self.params = other.params
         self.pandas_categorical = other.pandas_categorical
         self.best_iteration = -1
+        self.train_set = None
+        self._valid_lookup = {}
+        self._train_data_name = "training"
         return self
 
     def set_train_data_name(self, name: str) -> "Booster":
@@ -1155,10 +1159,19 @@ class Booster:
         data.construct()
         tm = self._gbdt.train_set.mappers
         vm = data.inner.mappers
-        if len(tm) != len(vm) or any(
-                not np.array_equal(np.asarray(a.bin_upper_bound),
-                                   np.asarray(b.bin_upper_bound))
-                for a, b in zip(tm, vm)):
+
+        def same(a, b):
+            # categorical mappers carry their mapping in bin_2_categorical
+            # (bin_upper_bound stays the default), so compare both forms
+            return (a.bin_type == b.bin_type
+                    and a.num_bin == b.num_bin
+                    and np.array_equal(np.asarray(a.bin_upper_bound),
+                                       np.asarray(b.bin_upper_bound))
+                    and list(getattr(a, "bin_2_categorical", []) or []) ==
+                    list(getattr(b, "bin_2_categorical", []) or []))
+
+        if len(tm) != len(vm) or any(not same(a, b)
+                                     for a, b in zip(tm, vm)):
             log.fatal("cannot evaluate data with different bin mappers; "
                       "build it with create_valid / reference=")
 
@@ -1173,11 +1186,12 @@ class Booster:
         elif self._gbdt is not None:
             if data not in getattr(self, "_valid_lookup", {}):
                 # the reference's eval registers unseen data as a valid
-                # set; rebuilding the score caches folds the existing
-                # trees into its scores
+                # set; rebuilding ONLY the new entry's scores folds the
+                # existing trees in without replaying every other cache
                 self._check_valid_alignment(data)
                 self.add_valid(data, name)
-                self._gbdt.invalidate_score_cache()
+                self._gbdt.invalidate_score_cache(
+                    only_valid_index=self._valid_lookup[data])
             vi = self._valid_lookup[data]
             out = [(name,) + r[1:] for r in self._gbdt._eval_metric_list(
                 self._gbdt.valid_names[vi], self._gbdt.valid_metrics[vi],
@@ -1217,10 +1231,12 @@ class Booster:
             score = raw if k == 1 else raw.reshape(-1, k, order="F")
             isc = md.init_score
             if isc is not None:
-                score = score + (isc.reshape(score.shape, order="F")
+                # per-row init scores broadcast over classes; full-size
+                # ones reshape column-major (same as GBDT.add_valid)
+                score = score + (np.asarray(isc).reshape(score.shape,
+                                                         order="F")
                                  if np.size(isc) == score.size
-                                 else np.asarray(isc).reshape(-1, 1 if k == 1
-                                                              else k))
+                                 else np.asarray(isc).reshape(-1, 1))
             out = []
             for m in ms:
                 for mname, val in m.eval(score, obj):

@@ -394,9 +394,22 @@ class GBDT:
         # order via a batch=1 batched-grower route (_use_batched_grower);
         # derived LAST so the strict-only feature checks see final state.
         pool_mb = float(config.histogram_pool_size)
+        n_cols = train_set.bins.shape[1]
+        bytes_per_leaf = n_cols * self.hp.n_bins * 4 * 4
+        full_state = bytes_per_leaf * self.hp.num_leaves
+        if pool_mb <= 0 and not config.is_explicit("histogram_pool_size") \
+                and full_state > (4 << 30):
+            # wide-data guard: the reference's default (-1) keeps every
+            # leaf's histogram resident, but [L, F, B, 4] f32 on an
+            # Allstate-wide bundled matrix can exceed HBM before the
+            # first tree finishes; cap the resident state at ~1 GB unless
+            # the user explicitly asked for unlimited
+            pool_mb = 1024.0
+            log.info("histogram state would be %.1f GB; engaging the "
+                     "bounded pool at 1 GB (set histogram_pool_size=-1 "
+                     "to keep all leaves resident)"
+                     % (full_state / (1 << 30)))
         if pool_mb > 0:
-            n_cols = train_set.bins.shape[1]
-            bytes_per_leaf = n_cols * self.hp.n_bins * 4 * 4
             slots = int(pool_mb * (1 << 20) // max(bytes_per_leaf, 1))
             kbatch = max(1, int(config.tpu_split_batch))
             slots = max(slots, 3 * kbatch + 2)
@@ -473,13 +486,17 @@ class GBDT:
         self.iter_ = len(self.models) // k
         self.invalidate_score_cache()
 
-    def invalidate_score_cache(self) -> None:
+    def invalidate_score_cache(self,
+                               only_valid_index: Optional[int] = None
+                               ) -> None:
         """Rebuild cached train/valid scores from the current model list
         (after leaf edits, merges or shuffles — the reference's
         ScoreUpdater is re-driven the same way on BoosterSetLeafValue).
         Linear-leaf trees contribute const + coeff·raw, not the plain leaf
         constant (ADVICE r3: the reference replays Tree::Predict, which
-        takes the is_linear_ branch, tree.h:587)."""
+        takes the is_linear_ branch, tree.h:587).  ``only_valid_index``
+        rebuilds a single valid set's scores (a late-added eval set),
+        leaving the train/other caches untouched."""
         k = self.num_tree_per_iteration
         any_linear = any(t.is_linear for t in self.models)
         o2p = {int(o): p
@@ -531,10 +548,15 @@ class GBDT:
                 sc[:, i % k] += contrib
             return jnp.asarray(sc)
 
-        train_raw = self.train_set.raw if any_linear else None
-        self.scores = rebuild(self.train_set.num_data, self.bins,
-                              self.train_set.metadata.init_score, train_raw)
-        for vi in range(len(self.valid_sets)):
+        if only_valid_index is None:
+            train_raw = self.train_set.raw if any_linear else None
+            self.scores = rebuild(self.train_set.num_data, self.bins,
+                                  self.train_set.metadata.init_score,
+                                  train_raw)
+            targets = range(len(self.valid_sets))
+        else:
+            targets = [only_valid_index]
+        for vi in targets:
             vs = self.valid_sets[vi]
             self.valid_scores[vi] = rebuild(
                 vs.num_data, self._valid_bins[vi], vs.metadata.init_score,
