@@ -288,7 +288,10 @@ class GBDT:
                      and float(config.cegb_penalty_split) == 0.0
                      and not list(config.cegb_penalty_feature_lazy or [])
                      and not list(config.cegb_penalty_feature_coupled or [])
-                     and self.parallel_mode in (None, "data"))
+                     and self.parallel_mode in (None, "data", "voting")
+                     and not (self.parallel_mode == "voting"
+                              and bool(self.train_set.categorical_array()
+                                       .any())))
         if not config.is_explicit("tpu_split_batch"):
             if at_scale and batchable and int(config.num_leaves) >= 8:
                 config.tpu_split_batch = min(28, int(config.num_leaves) - 1)
@@ -398,7 +401,7 @@ class GBDT:
         bytes_per_leaf = n_cols * self.hp.n_bins * 4 * 4
         full_state = bytes_per_leaf * self.hp.num_leaves
         if pool_mb <= 0 and not config.is_explicit("histogram_pool_size") \
-                and full_state > (4 << 30):
+                and full_state > (4 << 30) and self.parallel_mode is None:
             # wide-data guard: the reference's default (-1) keeps every
             # leaf's histogram resident, but [L, F, B, 4] f32 on an
             # Allstate-wide bundled matrix can exceed HBM before the
@@ -850,13 +853,16 @@ class GBDT:
             h = jnp.pad(h, (0, p))
             row_mask = jnp.pad(jnp.ones(g.shape[0] - p, bool)
                                if row_mask is None else row_mask, (0, p))
-        if self.parallel_mode == "data" and self._use_batched_grower():
+        if self.parallel_mode in ("data", "voting") \
+                and self._use_batched_grower():
             arrays, lor = grow_tree_batched_sharded(
                 self.mesh, self.bins, g, h, row_mask, self.num_bins_arr,
                 self.nan_bin_arr, self.is_cat_arr, feature_mask, self.hp,
                 batch=int(self.config.tpu_split_batch), bundle=self.bundle,
                 monotone=self.monotone_arr, hist_scale=hist_scale,
-                interaction_sets=self.interaction_sets)
+                interaction_sets=self.interaction_sets,
+                parallel_mode=self.parallel_mode,
+                top_k=int(self.config.top_k))
             return arrays, (lor[:-p] if p else lor)
         arrays, lor = grow_tree_sharded(
             self.mesh, self.bins, g, h, row_mask, self.num_bins_arr,
@@ -883,11 +889,16 @@ class GBDT:
             and self.hp.monotone_method == "advanced"
         forced_pooled = self.forced_splits is not None \
             and 0 < self.hp.hist_pool_slots < self.hp.num_leaves
+        # batched voting (round 4) carries the PV-Tree protocol but not
+        # categorical splits or forced splits (batch_grower asserts)
+        voting_unsupported = self.parallel_mode == "voting" and (
+            self.hp.has_categorical or self.forced_splits is not None)
         unsupported = (mono_strict
                        or forced_pooled
+                       or voting_unsupported
                        or self.cegb is not None
                        or self.linear
-                       or self.parallel_mode not in (None, "data"))
+                       or self.parallel_mode not in (None, "data", "voting"))
         # extra_trees / by-node sampling need per-node rng keys, which the
         # sharded batched wrapper does not plumb yet — serial only
         rng_parallel = self.parallel_mode is not None and (
@@ -899,9 +910,9 @@ class GBDT:
                 log.warning("tpu_split_batch > 1 ignored: advanced "
                             "monotone, forced splits, cegb, linear_tree, "
                             "extra_trees/bynode-sampling under distributed "
-                            "modes, and "
-                            "voting/feature parallel modes require the "
-                            "strict leaf-wise learner")
+                            "modes, categorical-under-voting and the "
+                            "feature-parallel mode require the strict "
+                            "leaf-wise learner")
                 self._warned_batch = True
             return False
         return True

@@ -23,7 +23,8 @@ splits (one-hot + sorted-subset, applied via per-split bitsets),
 basic/intermediate monotone constraints, interaction constraints, path
 smoothing, forced splits (K=1 prefix phase), extra_trees + per-node
 feature sampling, EFB bundles, bagging row masks, per-tree feature
-sampling, depth limits, data-parallel ``shard_map`` (axis psum).
+sampling, depth limits, data-parallel ``shard_map`` (axis psum) and
+voting-parallel (PV-Tree two-phase vote with local histogram state).
 Advanced monotone, CEGB and linear trees route through the strict
 learner (boosting/gbdt.py dispatch).
 """
@@ -45,11 +46,12 @@ from ..ops.split import (NEG_INF, VAR_CAT_BWD, VAR_CAT_FWD, SplitHyper,
                          leaf_output)
 from .grower import (DeviceBundle, TreeArrays, _INF_BOUND, _empty_tree,
                      _expand_hist, _expand_hist_col, _feature_bin_of_rows,
-                     sample_features_bynode)
+                     pv_vote_best_split, sample_features_bynode)
 
 
 @functools.partial(jax.jit, static_argnames=("hp", "batch", "axis_name",
-                                             "warmup"))
+                                             "warmup", "parallel_mode",
+                                             "top_k", "num_shards"))
 def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                       row_mask: Optional[jax.Array], num_bins: jax.Array,
                       nan_bin: jax.Array, is_cat: jax.Array,
@@ -63,7 +65,9 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                       interaction_sets: Optional[jax.Array] = None,
                       rng_key: Optional[jax.Array] = None,
                       forced: Optional[Tuple[jax.Array, jax.Array,
-                                             jax.Array]] = None
+                                             jax.Array]] = None,
+                      parallel_mode: str = "data", top_k: int = 20,
+                      num_shards: int = 1
                       ) -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree with ``batch`` splits per histogram pass.
 
@@ -75,12 +79,33 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     outputs; cached candidate GAINS of unsplit leaves may lag a round,
     the same class of lag the strict learner documents), and path
     smoothing.
+
+    Under ``axis_name`` with ``parallel_mode="voting"`` the rounds run
+    the PV-Tree protocol (reference voting_parallel_tree_learner.cpp,
+    round-4 lift of the batched-grower cliff): histogram state stays
+    LOCAL per shard, each child's best split does a two-phase vote —
+    local per-feature gains at 1/num_shards-relaxed thresholds, a
+    ``psum`` vote over each shard's top-``top_k`` features, then a
+    ``psum`` of ONLY the 2·top_k voted histogram slices — so per-round
+    communication is O(K · top_k · bins), independent of feature count,
+    while K splits still share one local histogram pass.
     """
+    voting = parallel_mode == "voting" and axis_name is not None
+    # collectives the histogram ops should use: none under voting (the
+    # vote psums slices itself)
+    hist_axis = None if voting else axis_name
     if hp.use_monotone:
         assert monotone is not None and hp.monotone_method in (
             "basic", "intermediate"), \
             "batched grower supports monotone basic/intermediate " \
             "(advanced needs the strict learner)"
+    if voting:
+        assert not hp.has_categorical, \
+            "batched voting does not support categorical splits (the " \
+            "sorted-subset bitset needs the GLOBAL histogram; route " \
+            "through the strict learner)"
+        assert forced is None, "forced splits need the strict learner " \
+            "under voting"
     use_boxes = hp.use_monotone and hp.monotone_method == "intermediate"
     use_paths = interaction_sets is not None
     use_smooth = hp.path_smooth > 0.0
@@ -120,8 +145,26 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                                        num_f)
         return m
 
+    if voting:
+        import dataclasses as _dc
+        # locally relaxed validity thresholds
+        # (voting_parallel_tree_learner.cpp:62-64)
+        hp_vote = _dc.replace(
+            hp, min_data_in_leaf=max(1, hp.min_data_in_leaf // num_shards),
+            min_sum_hessian_in_leaf=hp.min_sum_hessian_in_leaf / num_shards)
+
     def child_best(h_phys, g_, h_, c_, depth, lmin, lmax, fm, pout,
                    key=None):
+        if voting:
+            # PV-Tree two-phase vote per child — ONE protocol definition
+            # shared with the strict grower (learner/grower.py
+            # pv_vote_best_split)
+            return pv_vote_best_split(
+                h_phys, g_, h_, c_, depth, fm, pout, lmin, lmax, key,
+                hp=hp, hp_vote=hp_vote, num_bins=num_bins,
+                nan_bin=nan_bin, is_cat=is_cat, monotone=monotone,
+                bundle=bundle, num_f=num_f, top_k=top_k,
+                axis_name=axis_name)
         hv = h_phys if bundle is None else \
             _expand_hist(h_phys, bundle, g_, h_, c_)
         res = find_best_split(hv, g_, h_, c_, num_bins, nan_bin, is_cat,
@@ -159,7 +202,7 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     hist0_b = _scaled(root_histogram(
         bins_t, grad, hess, row_mask, n_bins=hp.n_bins,
         rows_per_block=hp.rows_per_block,
-        hist_dtype=hp.hist_dtype, axis_name=axis_name))
+        hist_dtype=hp.hist_dtype, axis_name=hist_axis))
     g0 = jnp.sum(grad * mask_f)
     h0 = jnp.sum(hess * mask_f)
     c0 = jnp.sum(mask_f)
@@ -515,7 +558,7 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                   return _scaled(histogram_for_leaves_auto(
                       bins, bins_t, grad, hess, lor, lv, row_mask,
                       n_bins=hp.n_bins, rows_per_block=hp.rows_per_block,
-                      hist_dtype=hp.hist_dtype, axis_name=axis_name,
+                      hist_dtype=hp.hist_dtype, axis_name=hist_axis,
                       counts=cnts, bins_words=bins_words, sort_key=skey))
 
               left_small = (l_cnt <= r_cnt)[:, None, None, None]

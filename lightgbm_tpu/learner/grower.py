@@ -221,6 +221,53 @@ def sample_features_bynode(mask: Optional[jax.Array], key: jax.Array,
     return base & (u >= kth) & (u >= 0)
 
 
+def pv_vote_best_split(h_phys, g_, h_, c_, depth, fm, parent_output, lmin,
+                       lmax, key, *, hp, hp_vote, num_bins, nan_bin, is_cat,
+                       monotone, bundle, num_f, top_k, axis_name
+                       ) -> "SplitResult":
+    """PV-Tree two-phase vote for ONE leaf (reference
+    voting_parallel_tree_learner.cpp:151 GlobalVoting + :184
+    CopyLocalHistogram), shared by the strict grower's voting branch and
+    the batched grower's rounds so the protocol has one definition.
+
+    ``h_phys`` is the leaf's LOCAL shard histogram; ``g_/h_/c_`` are the
+    GLOBAL leaf totals.  Phase 1 scores every feature on the local
+    histogram at the 1/num_shards-relaxed thresholds in ``hp_vote``;
+    phase 2 psums each shard's top-``top_k`` proposals into a vote,
+    reduces ONLY the 2·top_k winners' histogram slices globally, and
+    finds the split there.  Returned ``feature`` is the global index and
+    the gain carries the depth gate."""
+    from ..ops.split import find_best_split as _fbs
+    lg_ = jnp.sum(h_phys[0, :, 0])
+    lh_ = jnp.sum(h_phys[0, :, 1])
+    lc_ = jnp.sum(h_phys[0, :, 2])
+    hv_local = h_phys if bundle is None else \
+        _expand_hist(h_phys, bundle, lg_, lh_, lc_)
+    pf: list = []
+    _fbs(hv_local, lg_, lh_, lc_, num_bins, nan_bin, is_cat, fm, hp_vote,
+         monotone=monotone, parent_output=parent_output, leaf_min=lmin,
+         leaf_max=lmax, depth=depth, rng_key=key, per_feature_out=pf)
+    gains_local = pf[0]                                        # [F]
+    k = min(top_k, num_f)
+    _, local_top = lax.top_k(gains_local, k)
+    votes = lax.psum(jnp.zeros((num_f,), jnp.float32)
+                     .at[local_top].set(1.0), axis_name)
+    gain_sum = lax.psum(jnp.clip(gains_local, -1e9, 1e9), axis_name)
+    score = votes * 1e12 + gain_sum
+    sel_k = min(2 * top_k, num_f)
+    _, sel = lax.top_k(score, sel_k)                           # [2k]
+    h_sel = lax.psum(hv_local[sel], axis_name)                 # [2k, B, C]
+    res = _fbs(h_sel, g_, h_, c_, num_bins[sel], nan_bin[sel], is_cat[sel],
+               None if fm is None else fm[sel], hp,
+               monotone=None if monotone is None else monotone[sel],
+               parent_output=parent_output, leaf_min=lmin, leaf_max=lmax,
+               depth=depth, rng_key=key)
+    res = res._replace(feature=sel[res.feature])
+    depth_ok = (hp.max_depth <= 0) | (depth < hp.max_depth)
+    from ..ops.split import NEG_INF as _NEG_INF
+    return res._replace(gain=jnp.where(depth_ok, res.gain, _NEG_INF))
+
+
 def _child_best(hist: jax.Array, g: jax.Array, h: jax.Array, c: jax.Array,
                 depth: jax.Array, num_bins, nan_bin, is_cat, feature_mask,
                 hp: SplitHyper, monotone=None, parent_output=0.0,
@@ -381,39 +428,12 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         otherwise.  Returns a SplitResult whose ``feature`` is the virtual
         (voting) / global (feature-parallel) index."""
         if mode == "voting" and axis_name is not None:
-            # phase 1: local per-feature gains on the LOCAL histogram (any
-            # physical column's bins sum to the local leaf totals)
-            lg_ = jnp.sum(h_phys[0, :, 0])
-            lh_ = jnp.sum(h_phys[0, :, 1])
-            lc_ = jnp.sum(h_phys[0, :, 2])
-            hv_local = h_phys if bundle is None else \
-                _expand_hist(h_phys, bundle, lg_, lh_, lc_)
-            pf: list = []
-            find_best_split(hv_local, lg_, lh_, lc_, num_bins, nan_bin,
-                            is_cat, fm, hp_vote, monotone=monotone,
-                            parent_output=parent_output, leaf_min=lmin,
-                            leaf_max=lmax, depth=depth, rng_key=key,
-                            per_feature_out=pf)
-            gains_local = pf[0]                                # [F]
-            k = min(top_k, num_f)
-            _, local_top = lax.top_k(gains_local, k)
-            votes = lax.psum(jnp.zeros((num_f,), jnp.float32)
-                             .at[local_top].set(1.0), axis_name)
-            gain_sum = lax.psum(jnp.clip(gains_local, -1e9, 1e9), axis_name)
-            # phase 2: psum ONLY the globally voted candidates' histograms
-            score = votes * 1e12 + gain_sum
-            sel_k = min(2 * top_k, num_f)
-            _, sel = lax.top_k(score, sel_k)                   # [2k]
-            h_sel = lax.psum(hv_local[sel], axis_name)         # [2k, B, C]
-            res = find_best_split(
-                h_sel, g_, h_, c_, num_bins[sel], nan_bin[sel], is_cat[sel],
-                None if fm is None else fm[sel], hp,
-                monotone=None if monotone is None else monotone[sel],
-                parent_output=parent_output, leaf_min=lmin, leaf_max=lmax,
-                depth=depth, rng_key=key)
-            res = res._replace(feature=sel[res.feature])
-            depth_ok = (hp.max_depth <= 0) | (depth < hp.max_depth)
-            return res._replace(gain=jnp.where(depth_ok, res.gain, NEG_INF))
+            return pv_vote_best_split(
+                h_phys, g_, h_, c_, depth, fm, parent_output, lmin, lmax,
+                key, hp=hp, hp_vote=hp_vote, num_bins=num_bins,
+                nan_bin=nan_bin, is_cat=is_cat, monotone=monotone,
+                bundle=bundle, num_f=num_f, top_k=top_k,
+                axis_name=axis_name)
         if mode == "feature" and axis_name is not None:
             res = _child_best(h_phys, g_, h_, c_, depth, num_bins, nan_bin,
                               is_cat, fm, hp, parent_output=parent_output,

@@ -147,10 +147,13 @@ def grow_tree_batched_sharded(mesh: Mesh, bins: jax.Array, grad: jax.Array,
                               bundle=None,
                               monotone: Optional[jax.Array] = None,
                               hist_scale: Optional[jax.Array] = None,
-                              interaction_sets: Optional[jax.Array] = None
+                              interaction_sets: Optional[jax.Array] = None,
+                              parallel_mode: str = "data",
+                              top_k: int = 20
                               ) -> Tuple[TreeArrays, jax.Array]:
     """Batched-round grower (learner/batch_grower.py) under the data mesh:
-    K splits per psum-ed widened histogram pass."""
+    K splits per psum-ed widened histogram pass ("data"), or per LOCAL
+    pass with PV-Tree voted slice reduction ("voting")."""
     from ..learner.batch_grower import grow_tree_batched
 
     def rep(x):
@@ -175,7 +178,9 @@ def grow_tree_batched_sharded(mesh: Mesh, bins: jax.Array, grad: jax.Array,
         return grow_tree_batched(b, g, h, m, nb, nanb, cat, fm, hp,
                                  batch=batch, bundle=bd, monotone=mono,
                                  axis_name=DATA_AXIS, hist_scale=hs,
-                                 interaction_sets=isets)
+                                 interaction_sets=isets,
+                                 parallel_mode=parallel_mode, top_k=top_k,
+                                 num_shards=mesh.devices.size)
 
     fn = shard_map(local, mesh=mesh, in_specs=in_specs,
                    out_specs=out_specs, check_vma=False)

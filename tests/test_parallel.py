@@ -182,3 +182,51 @@ def test_data_parallel_large_mesh_matches_serial():
     np.testing.assert_array_equal(np.asarray(tree_d.split_bin),
                                   np.asarray(tree_s.split_bin))
     np.testing.assert_array_equal(np.asarray(lor_d), np.asarray(lor_s))
+
+
+def test_batched_voting_matches_strict_voting(problem):
+    """Round-4 batched voting: the PV-Tree protocol inside the batched
+    grower.  batch=1 reproduces the STRICT voting learner's tree exactly
+    (same vote, same psum-ed slices, same order); larger batches keep
+    the dominant features and quality."""
+    from lightgbm_tpu.parallel.data_parallel import grow_tree_batched_sharded
+    bins, g, h, nb, nanb, cat = map(jnp.asarray, problem)
+    mesh = _mesh(DATA_AXIS)
+    tree_sv, lor_sv = grow_tree_sharded(mesh, bins, g, h, None, nb, nanb,
+                                        cat, None, HP,
+                                        parallel_mode="voting", top_k=4)
+    tree_b1, lor_b1 = grow_tree_batched_sharded(
+        mesh, bins, g, h, None, nb, nanb, cat, None, HP, batch=1,
+        parallel_mode="voting", top_k=4)
+    np.testing.assert_array_equal(np.asarray(tree_sv.split_feature),
+                                  np.asarray(tree_b1.split_feature))
+    np.testing.assert_array_equal(np.asarray(tree_sv.split_bin),
+                                  np.asarray(tree_b1.split_bin))
+    np.testing.assert_array_equal(np.asarray(lor_sv), np.asarray(lor_b1))
+
+    tree_b4, _ = grow_tree_batched_sharded(
+        mesh, bins, g, h, None, nb, nanb, cat, None, HP, batch=4,
+        parallel_mode="voting", top_k=4)
+    assert int(tree_b4.num_leaves) >= 8
+    used = set(np.asarray(tree_b4.split_feature)[
+        np.asarray(tree_b4.split_feature) >= 0].tolist())
+    assert 0 in used
+    assert int(tree_b4.split_feature[0]) == int(tree_sv.split_feature[0])
+
+
+def test_batched_voting_end_to_end_train():
+    """Public API: tree_learner=voting + tpu_split_batch>1 uses the
+    batched voting grower (no strict fallback) and learns."""
+    import lightgbm_tpu as lgb
+    rng = np.random.default_rng(4)
+    n, f = 2000, 10
+    X = rng.normal(size=(n, f))
+    y = ((X @ rng.normal(size=f)) > 0).astype(np.float64)
+    p = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 5,
+         "verbose": -1, "tree_learner": "voting", "tpu_split_batch": 4,
+         "top_k": 4}
+    bst = lgb.train(p, lgb.Dataset(X, label=y, params=p),
+                    num_boost_round=10, keep_training_booster=True)
+    assert bst._gbdt._use_batched_grower()
+    acc = float(((bst.predict(X) > 0.5) == y).mean())
+    assert acc > 0.85, acc

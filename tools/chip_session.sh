@@ -1,0 +1,45 @@
+#!/bin/bash
+# One-shot on-chip measurement battery for when the axon tunnel answers.
+# Captures, in order of evidence value:
+#   1. headline kernel bench (seeds bench_cache.json for the driver)
+#   2. fused-kernel A/B (round-4 payload + partition kernels vs XLA paths)
+#   3. K sweep spot checks
+#   4. auto-speed-mode e2e train() bench
+# Every section appends to docs/CHIP_SESSION.log; safe to re-run.
+set -u
+cd "$(dirname "$0")/.."
+LOG=docs/CHIP_SESSION.log
+stamp() { date -u +"%Y-%m-%dT%H:%M:%SZ"; }
+
+echo "=== chip session $(stamp) ===" >> "$LOG"
+
+echo "[probe]" | tee -a "$LOG"
+if ! timeout 120 python -c "
+import jax.numpy as jnp
+y=(jnp.ones((256,256))@jnp.ones((256,256))); y.block_until_ready()
+print('TUNNEL_ALIVE')" >> "$LOG" 2>&1; then
+  echo "tunnel dead, aborting $(stamp)" | tee -a "$LOG"
+  exit 1
+fi
+
+echo "[1/4 headline bench $(stamp)]" | tee -a "$LOG"
+timeout 2400 python bench.py 2>&1 | tail -1 | tee -a "$LOG"
+
+echo "[2/4 fuse A/B $(stamp)]" | tee -a "$LOG"
+for mode in "" "LGBMTPU_NO_PAYLOAD_KERNEL=1" \
+            "LGBMTPU_NO_FUSED_PARTITION=1" \
+            "LGBMTPU_NO_PAYLOAD_KERNEL=1 LGBMTPU_NO_FUSED_PARTITION=1"; do
+  echo "-- env: [$mode]" | tee -a "$LOG"
+  env $mode timeout 1800 python tools/sweep_perf.py k=28 2>&1 | tail -1 \
+    | tee -a "$LOG"
+done
+
+echo "[3/4 K sweep $(stamp)]" | tee -a "$LOG"
+timeout 2400 python tools/sweep_perf.py k=16 k=20 k=32 2>&1 | tail -3 \
+  | tee -a "$LOG"
+
+echo "[4/4 e2e auto-mode $(stamp)]" | tee -a "$LOG"
+BENCH_E2E=1 BENCH_ROWS=1000000 BENCH_ITERS=20 timeout 3600 \
+  python bench.py 2>&1 | tail -1 | tee -a "$LOG"
+
+echo "=== done $(stamp) ===" | tee -a "$LOG"
