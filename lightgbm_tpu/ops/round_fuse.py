@@ -101,26 +101,33 @@ def partition_select_pallas(bins_t: jax.Array, lor: jax.Array,
         fk = feats_ref[0, :]                                  # [K]
         iota_f = lax.iota(jnp.int32, num_f)
         ohf = (fk[:, None] == iota_f[None, :]).astype(jnp.bfloat16)
-        b_blk = bins_ref[:].astype(jnp.bfloat16)              # [F, blk]
+        # via i32: Mosaic has no u8->bf16 cast (docs/PERF_NOTES.md round 3)
+        b_blk = bins_ref[:].astype(jnp.int32).astype(jnp.bfloat16)  # [F, blk]
         # per-slot feature column: exactly one one-hot term per sum and
         # bin values <= 255 are exact in bf16 -> exact integers out
         cols = lax.dot_general(
             ohf, b_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32).astype(jnp.int32)  # [K, blk]
         lor_b = lor_ref[0, :]                                 # [blk]
-        go_left = jnp.where(cols == nanb_ref[0, :][:, None],
-                            dl_ref[0, :][:, None] != 0,
-                            cols <= thr_ref[0, :][:, None])   # [K, blk]
-        in_par = (lor_b[None, :] == par_ref[0, :][:, None]) \
-            & (vk_ref[0, :][:, None] != 0)                    # [K, blk]
-        move = in_par & ~go_left
-        tgt = jnp.sum(jnp.where(move, nl_ref[0, :][:, None], 0), axis=0)
-        new_lor = jnp.where(jnp.any(move, axis=0), tgt, lor_b)
+        # boolean logic as 0/1 i32 arithmetic: Mosaic legalizes only
+        # 32-bit cmp/select here — a select_n over i1 payloads fails to
+        # compile (arith.trunci i8->i1), so where() is reserved for
+        # 32-bit payloads only
+        isnan = (cols == nanb_ref[0, :][:, None]).astype(jnp.int32)
+        le = (cols <= thr_ref[0, :][:, None]).astype(jnp.int32)
+        go_left = isnan * dl_ref[0, :][:, None] \
+            + (1 - isnan) * le                                # [K, blk] 0/1
+        in_par = (lor_b[None, :] == par_ref[0, :][:, None]
+                  ).astype(jnp.int32) * vk_ref[0, :][:, None]
+        move = in_par * (1 - go_left)     # one-hot across K: parents are
+        tgt = jnp.sum(move * nl_ref[0, :][:, None], axis=0)   # distinct
+        new_lor = jnp.where(jnp.sum(move, axis=0) > 0, tgt, lor_b)
         out_lor_ref[0, :] = new_lor
         lor_m = jnp.where(mask_ref[0, :] != 0, new_lor, -1)
-        sel = jnp.any(lor_m[None, :] == sm_ref[0, :][:, None], axis=0)
+        selv = jnp.sum((lor_m[None, :] == sm_ref[0, :][:, None]
+                        ).astype(jnp.int32), axis=0)          # [blk]
         row = step * blk + lax.iota(jnp.int32, blk)
-        out_key_ref[0, :] = jnp.where(sel, row, row | (1 << 30))
+        out_key_ref[0, :] = jnp.where(selv > 0, row, row | (1 << 30))
 
     row_spec = pl.BlockSpec((1, blk), lambda i: (0, i))
     k_spec = pl.BlockSpec((1, K), lambda i: (0, 0))

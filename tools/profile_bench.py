@@ -16,6 +16,9 @@ from collections import defaultdict
 
 import numpy as np
 
+# run as `python tools/profile_bench.py`: sys.path[0] is tools/, not the repo
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 K = int(os.environ.get("PK", "20"))
 N = int(os.environ.get("PROWS", "1000000"))
 LEAVES = int(os.environ.get("PLEAVES", "255"))

@@ -13,6 +13,9 @@ import time
 
 import numpy as np
 
+# run as `python tools/sweep_perf.py`: sys.path[0] is tools/, not the repo
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 os.environ.setdefault("BENCH_ROWS", "1000000")
 
 import jax
@@ -84,8 +87,6 @@ def run_config(k, dtype="bfloat16", warmup=True, iters=ITERS,
     try:
         if (jax.devices()[0].platform != "cpu" and leaves == 255
                 and N >= 1_000_000 and warmup):
-            sys.path.insert(0, os.path.dirname(
-                os.path.dirname(os.path.abspath(__file__))))
             import bench as _bench
             _bench.record_cache({
                 "metric": f"higgs_synth_{N}rows_{iters}iters_leaves{leaves}"
