@@ -83,6 +83,13 @@ class Tree:
     @classmethod
     def from_arrays(cls, arrays, dataset) -> "Tree":
         """Finalize a device ``TreeArrays`` against its training Dataset."""
+        import jax
+        # ONE pytree transfer: device_get issues copy_to_host_async on
+        # every leaf before blocking, so the 13 member arrays ride a
+        # single round trip.  Reading them one np.asarray at a time costs
+        # ~100 ms of tunnel latency EACH (~1.3 s/tree measured on-chip,
+        # 6x the whole device-side grow step).
+        arrays = jax.device_get(arrays)
         num_leaves = int(arrays.num_leaves)
         t = cls(num_leaves)
         ni = num_leaves - 1

@@ -77,6 +77,34 @@ def _prec(compute_dtype):
             else lax.Precision.DEFAULT)
 
 
+def _oh_contract(vals, oh_b, compute_dtype):
+    """vals [C, blk] (compute-dtype for float modes, int8 for int mode)
+    x bool one-hot [M, blk] -> f32 [C, M].  The shared int8/float dot
+    used by the flat masked, payload and plain kernels."""
+    if _is_int8(compute_dtype):
+        oh = oh_b.astype(jnp.int8)
+        return lax.dot_general(
+            vals, oh, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32).astype(jnp.float32)
+    oh = oh_b.astype(compute_dtype)
+    return lax.dot_general(vals, oh, (((1,), (1,)), ((), ())),
+                           preferred_element_type=jnp.float32,
+                           precision=_prec(compute_dtype))
+
+
+def _is_int8(compute_dtype) -> bool:
+    """int8 MXU mode: quantized-gradient levels ride the int8 systolic
+    path (~1.6x the bf16 rate measured on v5e, docs/PERF_NOTES.md round
+    4).  Valid ONLY when grad/hess carry small-integer values (the
+    ``use_quantized_grad`` contract, ops/quantize.py): products are
+    exact int32 and the f32 accumulation bound matches the bf16 mode's.
+    Mosaic legalizes bool->i8 and i32<->i8 casts and i8 dots on this
+    toolchain (the round-3 note claiming otherwise predates it); i8
+    elementwise multiplies still do NOT legalize, so masked values are
+    built in i32 and cast to i8 just before the dot."""
+    return jnp.dtype(compute_dtype) == jnp.int8
+
+
 @functools.partial(jax.jit,
                    static_argnames=("n_bins", "rows_per_block",
                                     "feats_per_chunk", "compute_dtype",
@@ -111,17 +139,16 @@ def histogram_pallas(bins_t: jax.Array, vals_t: jax.Array, *, n_bins: int,
             out_ref[:] = jnp.zeros_like(out_ref)
 
         b_blk = bins_ref[:].astype(jnp.int32)          # [f_pad, blk]
-        v_blk = vals_ref[:].astype(compute_dtype)      # [c, blk]
+        if _is_int8(compute_dtype):
+            v_blk = vals_ref[:].astype(jnp.int32).astype(jnp.int8)
+        else:
+            v_blk = vals_ref[:].astype(compute_dtype)  # [c, blk]
         iota = lax.iota(jnp.int32, n_bins)
         for f0 in range(0, f_pad, fc):
             chunk = b_blk[f0:f0 + fc]                  # [fc, blk]
-            onehot = (chunk[:, None, :] == iota[None, :, None]
-                      ).astype(compute_dtype)          # [fc, B, blk]
-            oh = onehot.reshape(fc * n_bins, blk)
-            acc = lax.dot_general(
-                v_blk, oh, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-                precision=_prec(compute_dtype))        # [c, fc*B]
+            oh_b = (chunk[:, None, :] == iota[None, :, None]
+                    ).reshape(fc * n_bins, blk)
+            acc = _oh_contract(v_blk, oh_b, compute_dtype)     # [c, fc*B]
             out_ref[:, f0 * n_bins:(f0 + fc) * n_bins] += acc
 
     out = pl.pallas_call(
@@ -203,12 +230,21 @@ def _histogram_leaves_impl(bins: jax.Array, grad: jax.Array,
 
         lor_b = lor_ref[0, :]                               # [blk] i32
         sel = lor_b[None, :] == leaves_ref[0, :][:, None]   # [K, blk]
-        m = sel.astype(jnp.float32)
-        # where(), not multiply: 0 * NaN = NaN would let one bad row (e.g.
-        # a custom objective emitting NaN on an excluded row) poison sums
-        gm = jnp.where(sel, g_ref[0, :][None, :], 0.0)      # [K, blk]
-        hm = jnp.where(sel, h_ref[0, :][None, :], 0.0)
-        vals = jnp.concatenate([gm, hm, m], axis=0).astype(compute_dtype)
+        if _is_int8(compute_dtype):
+            # integer masking by multiply is NaN-safe (0 * anything = 0
+            # in int); levels are small ints so f32->i32 is exact
+            seli = sel.astype(jnp.int32)
+            gm = seli * g_ref[0, :][None, :].astype(jnp.int32)
+            hm = seli * h_ref[0, :][None, :].astype(jnp.int32)
+            vals = jnp.concatenate([gm, hm, seli], axis=0).astype(jnp.int8)
+        else:
+            m = sel.astype(jnp.float32)
+            # where(), not multiply: 0 * NaN = NaN would let one bad row
+            # (e.g. a custom objective emitting NaN on an excluded row)
+            # poison sums
+            gm = jnp.where(sel, g_ref[0, :][None, :], 0.0)  # [K, blk]
+            hm = jnp.where(sel, h_ref[0, :][None, :], 0.0)
+            vals = jnp.concatenate([gm, hm, m], axis=0).astype(compute_dtype)
         b_blk = bins_ref[:].astype(jnp.int32)
         iota = lax.iota(jnp.int32, n_bins)
         for f0 in range(0, f_pad, fc):
@@ -220,13 +256,9 @@ def _histogram_leaves_impl(bins: jax.Array, grad: jax.Array,
                 chunk = b_blk[:, f0:f0 + fc].T              # [fc, blk]
             else:
                 chunk = b_blk[f0:f0 + fc]                   # [fc, blk]
-            onehot = (chunk[:, None, :] == iota[None, :, None]
-                      ).astype(compute_dtype)               # [fc, B, blk]
-            oh = onehot.reshape(fc * n_bins, blk)
-            acc = lax.dot_general(
-                vals, oh, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-                precision=_prec(compute_dtype))             # [3K, fc*B]
+            oh_b = (chunk[:, None, :] == iota[None, :, None]
+                    ).reshape(fc * n_bins, blk)
+            acc = _oh_contract(vals, oh_b, compute_dtype)      # [3K, fc*B]
             out_ref[:, f0 * n_bins:(f0 + fc) * n_bins] += acc
 
     bins_spec = pl.BlockSpec((blk, f_pad), lambda i: (i, 0)) if rows_major \
@@ -316,23 +348,26 @@ def histogram_payload_pallas(payload: jax.Array, leaves: jax.Array,
         pos_ok = step * blk + iota_r < cnt_ref[0]           # [blk]
         sel = (lor_b[None, :] == leaves_ref[0, :][:, None]) \
             & pos_ok[None, :]                               # [K, blk]
-        m = sel.astype(jnp.float32)
-        # where(), not multiply: clipped-duplicate rows can carry NaN grads
-        gm = jnp.where(sel, g[None, :], 0.0)
-        hm = jnp.where(sel, h[None, :], 0.0)
-        vals = jnp.concatenate([gm, hm, m], axis=0).astype(compute_dtype)
+        if _is_int8(compute_dtype):
+            # int multiply masking is NaN-safe; levels fit int8
+            seli = sel.astype(jnp.int32)
+            gm = seli * g[None, :].astype(jnp.int32)
+            hm = seli * h[None, :].astype(jnp.int32)
+            vals = jnp.concatenate([gm, hm, seli], axis=0).astype(jnp.int8)
+        else:
+            m = sel.astype(jnp.float32)
+            # where(), not multiply: clipped-duplicate rows can carry NaN
+            gm = jnp.where(sel, g[None, :], 0.0)
+            hm = jnp.where(sel, h[None, :], 0.0)
+            vals = jnp.concatenate([gm, hm, m], axis=0).astype(compute_dtype)
         iota = lax.iota(jnp.int32, n_bins)
         for j in range(W):
             w = pt[j]                                       # [blk] i32
             chunk = jnp.stack([w & 255, (w >> 8) & 255,
                                (w >> 16) & 255, (w >> 24) & 255])  # [4, blk]
-            onehot = (chunk[:, None, :] == iota[None, :, None]
-                      ).astype(compute_dtype)               # [4, B, blk]
-            oh = onehot.reshape(4 * n_bins, blk)
-            acc = lax.dot_general(
-                vals, oh, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-                precision=prec)                             # [3K, 4B]
+            oh_b = (chunk[:, None, :] == iota[None, :, None]
+                    ).reshape(4 * n_bins, blk)
+            acc = _oh_contract(vals, oh_b, compute_dtype)      # [3K, 4B]
             out_ref[:, j * 4 * n_bins:(j + 1) * 4 * n_bins] += acc
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -387,6 +422,21 @@ def _radix_chunk_accum(chunk_i32, vals3, *, nhi, nlo, p, blk, compute_dtype,
     lo = chunk_i32 & 15
     iota_h = lax.iota(jnp.int32, nhi)
     iota_l = lax.iota(jnp.int32, nlo)
+    if _is_int8(compute_dtype):
+        # i8 elementwise multiply doesn't legalize in Mosaic: build the
+        # masked lo-side channels in i32 and cast both dot operands to i8
+        # (values <= 127 by the quantized-levels contract)
+        hi_oh = (hi[:, None, :] == iota_h[None, :, None]
+                 ).astype(jnp.int8).reshape(p * nhi, blk)
+        lo_ohi = (lo[:, None, :] == iota_l[None, :, None]
+                  ).astype(jnp.int32).reshape(p * nlo, blk)
+        vlo = jnp.concatenate([lo_ohi * vals3[0][None, :],
+                               lo_ohi * vals3[1][None, :],
+                               lo_ohi * vals3[2][None, :]],
+                              axis=0).astype(jnp.int8)
+        return lax.dot_general(hi_oh, vlo, (((1,), (1,)), ((), ())),
+                               preferred_element_type=jnp.int32
+                               ).astype(jnp.float32)        # [p*nhi, 3*p*nlo]
     hi_oh = (hi[:, None, :] == iota_h[None, :, None]
              ).astype(compute_dtype).reshape(p * nhi, blk)
     lo_oh = (lo[:, None, :] == iota_l[None, :, None]
@@ -451,9 +501,15 @@ def histogram_radix_single_pallas(bins_t: jax.Array, grad: jax.Array,
             out_ref[:] = jnp.zeros_like(out_ref)
 
         valid = lor_ref[0, :] >= 0
-        gm = jnp.where(valid, g_ref[0, :], 0.0).astype(compute_dtype)
-        hm = jnp.where(valid, h_ref[0, :], 0.0).astype(compute_dtype)
-        mm = jnp.where(valid, 1.0, 0.0).astype(compute_dtype)
+        if _is_int8(compute_dtype):
+            vi = valid.astype(jnp.int32)
+            gm = vi * g_ref[0, :].astype(jnp.int32)
+            hm = vi * h_ref[0, :].astype(jnp.int32)
+            mm = vi
+        else:
+            gm = jnp.where(valid, g_ref[0, :], 0.0).astype(compute_dtype)
+            hm = jnp.where(valid, h_ref[0, :], 0.0).astype(compute_dtype)
+            mm = jnp.where(valid, 1.0, 0.0).astype(compute_dtype)
         b_blk = bins_ref[:].astype(jnp.int32)
         for c0 in range(nch):
             acc = _radix_chunk_accum(
@@ -524,28 +580,51 @@ def histogram_radix_joint_pallas(bins_t: jax.Array, grad: jax.Array,
         lor_b = lor_ref[0, :]
         lv = leaves_ref[0, :]
         eq = lor_b[None, :] == lv[:, None]                  # [G, blk]
-        goh = eq.astype(compute_dtype)                      # [G, blk]
-        sel = jnp.any(eq, axis=0)
-        gm = jnp.where(sel, g_ref[0, :], 0.0).astype(compute_dtype)
-        hm = jnp.where(sel, h_ref[0, :], 0.0).astype(compute_dtype)
-        mm = jnp.where(sel, 1.0, 0.0).astype(compute_dtype)
+        int8_mode = _is_int8(compute_dtype)
+        if int8_mode:
+            gohi = eq.astype(jnp.int32)                     # [G, blk]
+            seli = jnp.sign(jnp.sum(gohi, axis=0))          # 0/1 [blk]
+            gm = seli * g_ref[0, :].astype(jnp.int32)
+            hm = seli * h_ref[0, :].astype(jnp.int32)
+            mm = seli
+        else:
+            goh = eq.astype(compute_dtype)                  # [G, blk]
+            sel = jnp.any(eq, axis=0)
+            gm = jnp.where(sel, g_ref[0, :], 0.0).astype(compute_dtype)
+            hm = jnp.where(sel, h_ref[0, :], 0.0).astype(compute_dtype)
+            mm = jnp.where(sel, 1.0, 0.0).astype(compute_dtype)
         b_blk = bins_ref[:].astype(jnp.int32)
         iota_h = lax.iota(jnp.int32, nhi)
         iota_l = lax.iota(jnp.int32, nlo)
         for c0 in range(nch):
             chunk = b_blk[c0 * p:(c0 + 1) * p]
-            hi_oh = ((chunk >> 4)[:, None, :] == iota_h[None, :, None]
-                     ).astype(compute_dtype)                # [p, nhi, blk]
-            lo_oh = ((chunk & 15)[:, None, :] == iota_l[None, :, None]
-                     ).astype(compute_dtype).reshape(p * nlo, blk)
-            joint = (goh[:, None, None, :] * hi_oh[None, :, :, :]
-                     ).reshape(M, blk)                      # [(G,p,hi), blk]
-            vlo = jnp.concatenate([lo_oh * gm[None, :],
-                                   lo_oh * hm[None, :],
-                                   lo_oh * mm[None, :]], axis=0)
-            acc = lax.dot_general(joint, vlo, (((1,), (1,)), ((), ())),
-                                  preferred_element_type=jnp.float32,
-                                  precision=prec)           # [M, NW]
+            if int8_mode:
+                hi_ohi = ((chunk >> 4)[:, None, :] == iota_h[None, :, None]
+                          ).astype(jnp.int32)               # [p, nhi, blk]
+                lo_ohi = ((chunk & 15)[:, None, :] == iota_l[None, :, None]
+                          ).astype(jnp.int32).reshape(p * nlo, blk)
+                joint = (gohi[:, None, None, :] * hi_ohi[None, :, :, :]
+                         ).reshape(M, blk).astype(jnp.int8)
+                vlo = jnp.concatenate([lo_ohi * gm[None, :],
+                                       lo_ohi * hm[None, :],
+                                       lo_ohi * mm[None, :]],
+                                      axis=0).astype(jnp.int8)
+                acc = lax.dot_general(joint, vlo, (((1,), (1,)), ((), ())),
+                                      preferred_element_type=jnp.int32
+                                      ).astype(jnp.float32)  # [M, NW]
+            else:
+                hi_oh = ((chunk >> 4)[:, None, :] == iota_h[None, :, None]
+                         ).astype(compute_dtype)            # [p, nhi, blk]
+                lo_oh = ((chunk & 15)[:, None, :] == iota_l[None, :, None]
+                         ).astype(compute_dtype).reshape(p * nlo, blk)
+                joint = (goh[:, None, None, :] * hi_oh[None, :, :, :]
+                         ).reshape(M, blk)                  # [(G,p,hi), blk]
+                vlo = jnp.concatenate([lo_oh * gm[None, :],
+                                       lo_oh * hm[None, :],
+                                       lo_oh * mm[None, :]], axis=0)
+                acc = lax.dot_general(joint, vlo, (((1,), (1,)), ((), ())),
+                                      preferred_element_type=jnp.float32,
+                                      precision=prec)       # [M, NW]
             out_ref[:, c0 * NW:(c0 + 1) * NW] += acc
 
     out = pl.pallas_call(

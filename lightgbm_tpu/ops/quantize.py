@@ -35,6 +35,11 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def _pow2_ceil(x: jax.Array) -> jax.Array:
+    """Smallest power of two >= x (positive finite x)."""
+    return jnp.exp2(jnp.ceil(jnp.log2(x)))
+
+
 @functools.partial(jax.jit, static_argnames=("n_levels", "stochastic",
                                              "constant_hessian", "axis_name"))
 def discretize_gradients(grad: jax.Array, hess: jax.Array,
@@ -55,9 +60,15 @@ def discretize_gradients(grad: jax.Array, hess: jax.Array,
     if axis_name is not None:
         max_g = lax.pmax(max_g, axis_name)
         max_h = lax.pmax(max_h, axis_name)
-    g_scale = jnp.maximum(max_g / (n_levels // 2), 1e-20)
-    h_scale = jnp.maximum(max_h if constant_hessian
-                          else max_h / n_levels, 1e-20)
+    # scales round UP to a power of two: scale * level is then EXACT in
+    # f32 (the scale only shifts the exponent), so histogram bin values
+    # stay order-independent under summation and the matmul-cumsum split
+    # scan (ops/split.py _cumsum_bins), histogram subtraction and the
+    # bf16==f32 decision-parity contract are all exact.  Grid at most 2x
+    # coarser than max/levels; stochastic rounding keeps it unbiased.
+    g_scale = _pow2_ceil(jnp.maximum(max_g / (n_levels // 2), 1e-20))
+    h_scale = _pow2_ceil(jnp.maximum(max_h if constant_hessian
+                                     else max_h / n_levels, 1e-20))
     kg, kh = jax.random.split(key)
     if stochastic:
         ug = jax.random.uniform(kg, grad.shape)
@@ -89,9 +100,15 @@ def discretize_gradients_levels(grad: jax.Array, hess: jax.Array,
     if axis_name is not None:
         max_g = lax.pmax(max_g, axis_name)
         max_h = lax.pmax(max_h, axis_name)
-    g_scale = jnp.maximum(max_g / (n_levels // 2), 1e-20)
-    h_scale = jnp.maximum(max_h if constant_hessian
-                          else max_h / n_levels, 1e-20)
+    # scales round UP to a power of two: scale * level is then EXACT in
+    # f32 (the scale only shifts the exponent), so histogram bin values
+    # stay order-independent under summation and the matmul-cumsum split
+    # scan (ops/split.py _cumsum_bins), histogram subtraction and the
+    # bf16==f32 decision-parity contract are all exact.  Grid at most 2x
+    # coarser than max/levels; stochastic rounding keeps it unbiased.
+    g_scale = _pow2_ceil(jnp.maximum(max_g / (n_levels // 2), 1e-20))
+    h_scale = _pow2_ceil(jnp.maximum(max_h if constant_hessian
+                                     else max_h / n_levels, 1e-20))
     kg, kh = jax.random.split(key)
     if stochastic:
         ug = jax.random.uniform(kg, grad.shape)

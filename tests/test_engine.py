@@ -750,6 +750,12 @@ MATRIX_FEATURES = {
     "categorical": {"_categorical": True},
     "efb": {"_efb": True},
     "bf16": {"tpu_hist_dtype": "bfloat16"},
+    # int8 MXU histograms: requires quantized levels (gbdt.py
+    # _resolve_hist_dtype); CPU runs the exact XLA fallback so the cell
+    # checks config plumbing + learning, the kernel parity lives in
+    # tests/test_int8_kernels.py
+    "int8": {"tpu_hist_dtype": "int8", "use_quantized_grad": True,
+             "quant_train_renew_leaf": True},
 }
 
 
@@ -1043,3 +1049,200 @@ def test_cv_early_stopping_truncates_to_best():
     # the last entry is the minimum of the truncated curve
     assert curve[-1] == min(curve)
     assert len(res["valid l2-stdv"]) == len(curve)
+
+
+# ---------------------------------------------------------------- round 4
+# breadth additions (VERDICT r3 weak #4): objective variants the matrix
+# missed, metric-ordering contracts, and edge geometries.
+
+
+@pytest.mark.parametrize("objective", ["gamma", "tweedie"])
+def test_regression_positive_objectives(objective):
+    """gamma/tweedie on strictly-positive targets: deviance improves on
+    the mean predictor and predictions stay positive (log-link,
+    reference regression_objective.hpp Gamma/Tweedie)."""
+    rng = np.random.default_rng(5)
+    n = 1200
+    X = rng.normal(size=(n, 6))
+    mu = np.exp(0.8 * X[:, 0] - 0.5 * X[:, 1])
+    y = rng.gamma(shape=2.0, scale=mu / 2.0) + 1e-3
+    p = {**FAST, "objective": objective}
+    if objective == "tweedie":
+        p["tweedie_variance_power"] = 1.3
+    bst = lgb.train(p, lgb.Dataset(X, label=y, params=p),
+                    num_boost_round=40)
+    pred = bst.predict(X)
+    assert (pred > 0).all()
+    # squared error in log space beats the constant-mean predictor
+    err = np.mean((np.log(pred) - np.log(mu)) ** 2)
+    base = np.mean((np.log(np.full(n, y.mean())) - np.log(mu)) ** 2)
+    assert err < 0.5 * base, (err, base)
+    s = bst.model_to_string()
+    np.testing.assert_allclose(lgb.Booster(model_str=s).predict(X), pred,
+                               rtol=1e-5, atol=1e-7)
+
+
+def test_quantile_alpha_ordering():
+    """alpha=0.1 predictions sit below alpha=0.9 on heteroscedastic data
+    and roughly bracket the right coverage fraction."""
+    rng = np.random.default_rng(11)
+    n = 3000
+    X = rng.uniform(-1, 1, size=(n, 4))
+    y = X[:, 0] + (0.5 + 0.5 * np.abs(X[:, 1])) * rng.normal(size=n)
+    preds = {}
+    for alpha in (0.1, 0.9):
+        p = {**FAST, "objective": "quantile", "alpha": alpha}
+        bst = lgb.train(p, lgb.Dataset(X, label=y, params=p),
+                        num_boost_round=60)
+        preds[alpha] = bst.predict(X)
+    assert (preds[0.9] >= preds[0.1] - 1e-6).mean() > 0.97
+    cov_lo = (y <= preds[0.1]).mean()
+    cov_hi = (y <= preds[0.9]).mean()
+    assert 0.03 < cov_lo < 0.25, cov_lo
+    assert 0.75 < cov_hi < 0.97, cov_hi
+
+
+def test_first_metric_only_early_stopping(synthetic_binary):
+    """first_metric_only: stopping follows the FIRST metric even when a
+    second keeps improving (reference callback.py first_metric_only)."""
+    X, y = synthetic_binary
+    Xt, yt = X[:600], y[:600]
+    Xv, yv = X[600:], y[600:]
+    p = {**FAST, "objective": "binary", "metric": ["auc", "binary_logloss"],
+         "first_metric_only": True}
+    ds = lgb.Dataset(Xt, label=yt, params=p)
+    dv = ds.create_valid(Xv, label=yv)
+    ev = {}
+    bst = lgb.train(p, ds, num_boost_round=200, valid_sets=[dv],
+                    callbacks=[lgb.early_stopping(8, verbose=False,
+                                                  first_metric_only=True),
+                               lgb.record_evaluation(ev)])
+    assert bst.best_iteration > 0
+    aucs = ev["valid_0"]["auc"]
+    # stopped 8 rounds after the auc peak, not the logloss one
+    assert len(aucs) <= int(np.argmax(aucs)) + 1 + 8 + 1
+
+
+def test_shap_additivity_regression(synthetic_regression):
+    X, y = synthetic_regression
+    p = {**FAST, "objective": "regression"}
+    bst = lgb.train(p, lgb.Dataset(X, label=y, params=p),
+                    num_boost_round=25)
+    contrib = bst.predict(X[:100], pred_contrib=True)
+    assert contrib.shape == (100, X.shape[1] + 1)
+    np.testing.assert_allclose(contrib.sum(axis=1), bst.predict(X[:100]),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_stump_and_tiny_geometries():
+    """num_leaves=2 stumps and max_depth=1 both produce single-split
+    trees that still learn; predictions reload exactly."""
+    rng = np.random.default_rng(3)
+    n = 800
+    X = rng.normal(size=(n, 5))
+    y = (X[:, 2] > 0.3).astype(np.float64)
+    for geom in ({"num_leaves": 2}, {"max_depth": 1, "num_leaves": 15}):
+        p = {**FAST, **geom, "objective": "binary"}
+        bst = lgb.train(p, lgb.Dataset(X, label=y, params=p),
+                        num_boost_round=20)
+        assert _auc(y, bst.predict(X)) > 0.9
+        d = bst.dump_model()
+        for t in d["tree_info"]:
+            assert t["num_leaves"] <= 2
+        s = bst.model_to_string()
+        np.testing.assert_allclose(lgb.Booster(model_str=s).predict(X),
+                                   bst.predict(X), rtol=1e-6)
+
+
+def test_constant_label_stops_cleanly():
+    """All-identical labels: no splittable gain anywhere; training still
+    returns a usable model predicting the constant."""
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(500, 4))
+    y = np.full(500, 3.25)
+    p = {**FAST, "objective": "regression"}
+    bst = lgb.train(p, lgb.Dataset(X, label=y, params=p),
+                    num_boost_round=5)
+    np.testing.assert_allclose(bst.predict(X), 3.25, atol=1e-6)
+
+
+def test_constant_feature_never_split():
+    """A zero-variance column must never be chosen as a split feature
+    (the reference drops it at bin-mapping time)."""
+    rng = np.random.default_rng(6)
+    n = 1500
+    X = rng.normal(size=(n, 5))
+    X[:, 3] = 7.0
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    p = {**FAST, "objective": "binary"}
+    bst = lgb.train(p, lgb.Dataset(X, label=y, params=p),
+                    num_boost_round=15)
+    assert bst.feature_importance()[3] == 0
+    assert _auc(y, bst.predict(X)) > 0.85
+
+
+def test_multi_valid_sets_independent_eval(synthetic_binary):
+    """Two validation sets are evaluated independently each round and
+    recorded under their own names."""
+    X, y = synthetic_binary
+    p = {**FAST, "objective": "binary", "metric": "binary_logloss"}
+    ds = lgb.Dataset(X[:500], label=y[:500], params=p)
+    v1 = ds.create_valid(X[500:750], label=y[500:750])
+    v2 = ds.create_valid(X[750:], label=y[750:])
+    ev = {}
+    lgb.train(p, ds, num_boost_round=10, valid_sets=[v1, v2],
+              valid_names=["a", "b"],
+              callbacks=[lgb.record_evaluation(ev)])
+    assert set(ev) == {"a", "b"}
+    assert len(ev["a"]["binary_logloss"]) == 10
+    assert ev["a"]["binary_logloss"] != ev["b"]["binary_logloss"]
+
+
+def test_min_data_in_leaf_bounds_leaf_counts():
+    """Every trained leaf respects min_data_in_leaf (reference
+    CheckSplit min_data_in_leaf contract)."""
+    rng = np.random.default_rng(8)
+    n = 2000
+    X = rng.normal(size=(n, 6))
+    y = (X @ rng.normal(size=6) > 0).astype(np.float64)
+    p = {**FAST, "objective": "binary", "min_data_in_leaf": 120}
+    bst = lgb.train(p, lgb.Dataset(X, label=y, params=p),
+                    num_boost_round=10)
+    d = bst.dump_model()
+
+    def leaf_counts(node, out):
+        if "leaf_count" in node:
+            out.append(node["leaf_count"])
+        for k in ("left_child", "right_child"):
+            if isinstance(node.get(k), dict):
+                leaf_counts(node[k], out)
+    for t in d["tree_info"]:
+        out = []
+        leaf_counts(t["tree_structure"], out)
+        assert all(c >= 120 for c in out if c is not None), out
+
+
+def test_bagging_fraction_counts_rows():
+    """bagging_fraction=0.5: per-tree training row count is about half
+    of n (visible through leaf_count sums at the root)."""
+    rng = np.random.default_rng(9)
+    n = 4000
+    X = rng.normal(size=(n, 5))
+    y = (X[:, 0] > 0).astype(np.float64)
+    p = {**FAST, "objective": "binary", "bagging_fraction": 0.5,
+         "bagging_freq": 1, "bagging_seed": 3}
+    bst = lgb.train(p, lgb.Dataset(X, label=y, params=p),
+                    num_boost_round=6)
+    d = bst.dump_model()
+    for t in d["tree_info"][1:]:   # tree 0 may boost from score
+        out = []
+
+        def walk(node):
+            if "leaf_count" in node:
+                out.append(node["leaf_count"])
+            for k in ("left_child", "right_child"):
+                if isinstance(node.get(k), dict):
+                    walk(node[k])
+        walk(t["tree_structure"])
+        total = sum(out)
+        assert 0.4 * n < total < 0.6 * n, total

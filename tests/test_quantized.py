@@ -20,8 +20,10 @@ def test_discretize_levels():
                                   jax.random.PRNGKey(0), n_levels=4,
                                   stochastic=False)
     gq, hq = np.asarray(gq), np.asarray(hq)
-    # fake-quant: only (levels+1) distinct grad values, scaled integers
-    g_scale = np.abs(g).max() / 2
+    # fake-quant: only (levels+1) distinct grad values, scaled integers.
+    # The scale rounds UP to a power of two (ops/quantize.py: makes
+    # scale*level exact in f32 so histogram sums are order-independent)
+    g_scale = float(2.0 ** np.ceil(np.log2(np.abs(g).max() / 2)))
     levels = np.unique(np.round(gq / g_scale))
     assert len(levels) <= 5
     np.testing.assert_allclose(gq, np.round(g / g_scale) * g_scale, rtol=1e-5)

@@ -52,19 +52,34 @@ def run_config(k, dtype="bfloat16", warmup=True, iters=ITERS,
                     min_sum_hessian_in_leaf=100.0, n_bins=256,
                     rows_per_block=8192, hist_dtype=dtype)
 
+    # int8 kernels consume INTEGER gradient levels (the use_quantized_grad
+    # contract) — raw logistic grads in (-1, 1) would truncate to zero,
+    # collapse every tree and report a fantasy ms/tree.  Mirror the
+    # production path: discretize to levels inside the step.
+    quantize = dtype == "int8"
+    if quantize:
+        from lightgbm_tpu.ops.quantize import discretize_gradients_levels
+
     @jax.jit
     def run(scores, bins_a, label_a):
-        def step(scores, _):
+        def step(carry, i):
+            scores = carry
             sign = jnp.where(label_a > 0, 1.0, -1.0)
             resp = -sign / (1.0 + jnp.exp(sign * scores))
             grad = resp
             hess = jnp.abs(resp) * (1.0 - jnp.abs(resp))
+            hist_scale = None
+            if quantize:
+                key = jax.random.fold_in(jax.random.PRNGKey(7), i)
+                grad, hess, gs, hs = discretize_gradients_levels(
+                    grad, hess, key, n_levels=4, stochastic=True)
+                hist_scale = jnp.stack([gs, hs])
             tree, leaf_of_row = grow_tree_batched(
                 bins_a, grad, hess, None, num_bins, nan_bin, is_cat,
-                None, hp, batch=k, warmup=warmup)
+                None, hp, batch=k, warmup=warmup, hist_scale=hist_scale)
             return scores + 0.1 * take_small_table(tree.leaf_value,
                                                    leaf_of_row), None
-        scores, _ = jax.lax.scan(step, scores, None, length=iters)
+        scores, _ = jax.lax.scan(step, scores, jnp.arange(iters))
         return scores
 
     scores = jnp.zeros(N, jnp.float32)
