@@ -205,7 +205,8 @@ def main_e2e():
         "max_bin": MAX_BIN, "min_data_in_leaf": 0,
         "min_sum_hessian_in_leaf": 100.0,
     }
-    params["tpu_hist_dtype"] = os.environ.get("BENCH_HIST_DTYPE", "bfloat16")
+    params["tpu_hist_dtype"] = os.environ.get("BENCH_HIST_DTYPE", "int8")
+    params["use_quantized_grad"] = True
     params["tpu_split_batch"] = SPLIT_BATCH
     ds = lgb.Dataset(feat, label=label, params=params)
     ds.construct()
@@ -259,14 +260,15 @@ def main():
     for j in range(f):
         bins[:, j] = np.searchsorted(qs[:, j], feat[:, j]).astype(np.uint8)
 
-    # bfloat16 histogram products: the documented speed mode (the default is
-    # float32 exact parity; the reference's own GPU guidance likewise trades
+    # int8 histogram products over quantized-gradient levels: the shipped
+    # auto-speed-mode configuration (gbdt.py _resolve_auto_params; exact —
+    # see ops/quantize.py; the reference's own GPU guidance likewise trades
     # precision for speed, docs/GPU-Performance.rst single-precision + 63-bin
-    # recommendation).  AUC drift vs float32 measured 1.1e-4 (dual_parity).
+    # recommendation).  BENCH_HIST_DTYPE=bfloat16/float32 to A/B.
+    hist_dtype = os.environ.get("BENCH_HIST_DTYPE", "int8")
     hp = SplitHyper(num_leaves=NUM_LEAVES, min_data_in_leaf=0,
                     min_sum_hessian_in_leaf=100.0, n_bins=256,
-                    rows_per_block=8192,
-                    hist_dtype=os.environ.get("BENCH_HIST_DTYPE", "bfloat16"))
+                    rows_per_block=8192, hist_dtype=hist_dtype)
     bins_d = jnp.asarray(bins)
     label_d = jnp.asarray(label)
     num_bins = jnp.full((f,), MAX_BIN, jnp.int32)
@@ -280,17 +282,30 @@ def main():
     # arrays are ARGUMENTS, not closure constants — closure constants get
     # embedded in the HLO and shipped through the tunnel's remote-compile
     # on every compilation (294 MB of bins at Higgs scale).
+    quantize = hist_dtype == "int8"
+    if quantize:
+        from lightgbm_tpu.ops.quantize import discretize_gradients_levels
+
     @jax.jit
     def run(scores, bins_a, label_a):
-        def step(scores, _):
+        def step(scores, i):
             sign = jnp.where(label_a > 0, 1.0, -1.0)
             resp = -sign / (1.0 + jnp.exp(sign * scores))
             grad = resp
             hess = jnp.abs(resp) * (1.0 - jnp.abs(resp))
+            hist_scale = None
+            if quantize:
+                # int8 kernels consume INTEGER levels (the production
+                # use_quantized_grad path) — raw logistic grads would
+                # truncate to zero and fantasy-collapse the trees
+                key = jax.random.fold_in(jax.random.PRNGKey(7), i)
+                grad, hess, gs, hs = discretize_gradients_levels(
+                    grad, hess, key, n_levels=4, stochastic=True)
+                hist_scale = jnp.stack([gs, hs])
             if SPLIT_BATCH > 1:
                 tree, leaf_of_row = grow_tree_batched(
                     bins_a, grad, hess, None, num_bins, nan_bin, is_cat,
-                    None, hp, batch=SPLIT_BATCH)
+                    None, hp, batch=SPLIT_BATCH, hist_scale=hist_scale)
             else:
                 tree, leaf_of_row = grow_tree(bins_a, grad, hess, None,
                                               num_bins, nan_bin, is_cat,
@@ -299,7 +314,7 @@ def main():
             return scores + 0.1 * take_small_table(tree.leaf_value,
                                                    leaf_of_row), None
 
-        scores, _ = jax.lax.scan(step, scores, None, length=BENCH_ITERS)
+        scores, _ = jax.lax.scan(step, scores, jnp.arange(BENCH_ITERS))
         return scores
 
     scores = jnp.zeros(n, jnp.float32)

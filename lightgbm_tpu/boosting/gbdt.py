@@ -322,12 +322,16 @@ class GBDT:
                 and not bool(config.linear_tree)
                 and not config.is_explicit("tpu_hist_dtype")
                 and not config.is_explicit("use_quantized_grad")):
-            config.tpu_hist_dtype = "bfloat16"
+            # int8: quantized levels on the int8 MXU path — exact like
+            # the bf16-levels mode and ~12% faster end-to-end (round-4
+            # sweep: 82 vs 93 ms/tree); off-TPU both fall back to the
+            # exact f32 XLA contraction, so the choice is TPU-only
+            config.tpu_hist_dtype = "int8"
             config.use_quantized_grad = True
             if not config.is_explicit("quant_train_renew_leaf"):
                 config.quant_train_renew_leaf = True
             log.info("auto speed mode: tpu_split_batch=%d, exact "
-                     "quantized-grad bfloat16 kernels (set "
+                     "quantized-grad int8 kernels (set "
                      "tpu_hist_dtype=float32 or deterministic=true to "
                      "opt out)" % int(config.tpu_split_batch))
 

@@ -242,7 +242,7 @@ _PARAMS: List[Tuple[str, Any, Tuple[str, ...], Tuple[Tuple[str, float], ...]]] =
     ("gpu_device_id", -1, (), ()),
     ("gpu_use_dp", False, (), ()),
     ("num_gpu", 1, (), ((">", 0),)),
-    ("tpu_hist_dtype", "float32", (), ()),       # hist product dtype; float32 = exact CPU/reference parity, bfloat16 = ~3x faster kernels; AUTO POLICY: at >=100k rows and deterministic=false, an unset value engages bfloat16 with exact quantized-grad levels (decision-identical; boosting/gbdt.py _resolve_auto_params); deterministic=true always forces float32
+    ("tpu_hist_dtype", "float32", (), ()),       # hist product dtype; float32 = exact CPU/reference parity, bfloat16 = ~3x faster kernels, int8 = int8-MXU path (requires use_quantized_grad, ~1.6x bfloat16 kernel rate); AUTO POLICY: at >=100k rows and deterministic=false, an unset value engages int8 with exact quantized-grad levels (decision-identical; boosting/gbdt.py _resolve_auto_params); deterministic=true always forces float32
     ("tpu_debug_checks", False, (), ()),         # per-tree invariant checks (reference DEBUG CheckSplitValid)
     ("tpu_device_eval", True, (), ()),           # jitted device metric eval (l2/l1/rmse/logloss/error/auc/ndcg); host f64 when false or deterministic=true
     ("tpu_rows_per_block", 16384, (), ()),        # histogram kernel row tile

@@ -14,9 +14,10 @@ accumulation of integer sums is exact below 2^24 — and one deterministic
 scale multiply on the [K, F, B, 4] histogram restores real units.  This
 is the reference's int-accumulation design mapped to the MXU: the speed
 of the bf16 mode with bit-deterministic split sums across devices and
-meshes (the Mosaic ISA here legalizes no int8/int16 vector ops, so an
-integer-MXU path is not available; exact-bf16 achieves the same
-contract).  Exactness bound: n_rows * (num_grad_quant_bins/2) < 2^24,
+meshes.  ``tpu_hist_dtype=int8`` additionally rides the v5e int8
+systolic path (~1.6x the bf16 rate; int32 product accumulation —
+round-4 toolchains legalize i8 casts and dots, unlike round 3's).
+Exactness bound: n_rows * (num_grad_quant_bins/2) < 2^24,
 i.e. ~8.3M rows at the default 4 levels — beyond that, sums round at
 1 ulp f32 (the reference's int32 histograms overflow-guard similarly by
 bit-width selection, gradient_discretizer.hpp).
