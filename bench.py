@@ -37,10 +37,10 @@ BENCH_ITERS = int(os.environ.get("BENCH_ITERS", 20))
 NUM_LEAVES = int(os.environ.get("BENCH_LEAVES", 255))
 MAX_BIN = int(os.environ.get("BENCH_BIN", 255))
 # splits per histogram pass (learner/batch_grower.py); 1 = strict leaf-wise.
-# K sweep on the live chip (docs/PERF_NOTES.md round 3): 20 -> 99.5, 28 ->
-# 92.7, 32 -> 91.9, 40 -> 95.0 ms/tree; 28 matches 32 within noise at half
-# the compile time.
-SPLIT_BATCH = int(os.environ.get("BENCH_SPLIT_BATCH", 28))
+# Round-4 int8 K sweep on the live chip: 28 -> 83.2, 36 -> 89.0(noisy),
+# 42 -> 76.9 ms/tree — with K-independent kernel cost, fewer rounds win;
+# 3K = 126 <= 128 keeps the flat kernel inside one MXU channel tile.
+SPLIT_BATCH = int(os.environ.get("BENCH_SPLIT_BATCH", 42))
 BASELINE_S_PER_ROW_ITER = 130.094 / (10_500_000 * 500)
 
 CACHE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),

@@ -361,6 +361,9 @@ def histogram_payload_pallas(payload: jax.Array, leaves: jax.Array,
             hm = jnp.where(sel, h[None, :], 0.0)
             vals = jnp.concatenate([gm, hm, m], axis=0).astype(compute_dtype)
         iota = lax.iota(jnp.int32, n_bins)
+        # (a 4-words-per-dot widening was tried in round 4 and measured
+        # neutral: this kernel is bound by the [blk, W+3] VMEM transpose
+        # + byte unpack, not dot width)
         for j in range(W):
             w = pt[j]                                       # [blk] i32
             chunk = jnp.stack([w & 255, (w >> 8) & 255,

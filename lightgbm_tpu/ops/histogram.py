@@ -48,6 +48,20 @@ def use_pallas() -> bool:
         return False
 
 
+def _pallas_blk(hist_dtype: str, float_cap: int = 1024) -> int:
+    """Row-block cap for the flat/payload Pallas kernels.
+
+    Round-4 tuning: ISOLATED int8 kernels run ~1.7x faster at blk=2048
+    (flat 11.7/6.8/12.3 ms per 1M-row pass at 1024/2048/4096; payload
+    13.4 -> 8.2 at a 250k bucket, K=28) — but IN CONTEXT at K=42 the
+    2048 clamp regressed the tree loop 76.9 -> 84.9 ms/tree: the
+    [3K, F*B] f32 accumulator plus the wider one-hot crowd VMEM and
+    stall the grid's double buffering.  Standalone wins do not survive
+    composition here; stay at 1024 until a K-aware model is measured.
+    """
+    return float_cap
+
+
 def histogram_rows(bins: jax.Array, vals: jax.Array, *, n_bins: int,
                    rows_per_block: int = 4096,
                    hist_dtype: str = "float32") -> jax.Array:
@@ -73,7 +87,8 @@ def histogram_rows_t(bins_t: jax.Array, vals_t: jax.Array, *, n_bins: int,
     if use_pallas():
         from .hist_pallas import histogram_pallas
         return histogram_pallas(bins_t, vals_t, n_bins=n_bins,
-                                rows_per_block=min(rows_per_block, 1024),
+                                rows_per_block=min(rows_per_block,
+                                                   _pallas_blk(hist_dtype)),
                                 compute_dtype=jnp.dtype(hist_dtype).type)
     return build_histogram(bins_t.T, vals_t.T, n_bins=n_bins,
                            rows_per_block=rows_per_block)
@@ -206,7 +221,7 @@ def histogram_for_leaves_masked(bins_t: jax.Array, grad: jax.Array,
         from .hist_pallas import histogram_leaves_pallas
         hist = histogram_leaves_pallas(
             bins_t, grad, hess, lor, leaves, n_bins=n_bins,
-            rows_per_block=min(rows_per_block, 1024),
+            rows_per_block=min(rows_per_block, _pallas_blk(hist_dtype)),
             compute_dtype=jnp.dtype(hist_dtype).type)         # [K, F, B, C]
     else:
         sel = lor[None, :] == leaves[:, None]                 # [K, n]
@@ -237,7 +252,7 @@ def _rows_leaves_hist(bins_rows: jax.Array, grad: jax.Array,
         from .hist_pallas import histogram_leaves_rows_pallas
         return histogram_leaves_rows_pallas(
             bins_rows, grad, hess, lor, leaves, n_bins=n_bins,
-            rows_per_block=min(rows_per_block, 1024),
+            rows_per_block=min(rows_per_block, _pallas_blk(hist_dtype)),
             compute_dtype=jnp.dtype(hist_dtype).type)
     return histogram_for_leaves_masked(
         jnp.asarray(bins_rows).T, grad, hess, lor, leaves, None,
@@ -370,7 +385,8 @@ def histogram_for_leaves_auto(bins_rows: jax.Array, bins_t: jax.Array,
                 from .hist_pallas import histogram_payload_pallas
                 return histogram_payload_pallas(
                     pc, leaves, cnt, num_f=num_f, n_bins=n_bins,
-                    rows_per_block=min(rows_per_block, 1024),
+                    rows_per_block=min(rows_per_block,
+                                       _pallas_blk(hist_dtype)),
                     compute_dtype=jnp.dtype(hist_dtype).type,
                     interpret=not use_pallas())
             # XLA fallback (CPU tests / non-TPU): unpack and run the

@@ -547,7 +547,7 @@ def test_auto_speed_mode_at_scale():
         return GBDT(Config(p), ds.inner)
 
     g = make({"num_leaves": 255})
-    assert int(g.config.tpu_split_batch) == 28
+    assert int(g.config.tpu_split_batch) == 42
     assert g.config.use_quantized_grad is True
     assert g.config.tpu_hist_dtype == "int8"
     assert g.hp.hist_dtype == "int8"
@@ -1246,3 +1246,115 @@ def test_bagging_fraction_counts_rows():
         walk(t["tree_structure"])
         total = sum(out)
         assert 0.4 * n < total < 0.6 * n, total
+
+
+def test_prediction_iteration_slicing_additive(synthetic_binary):
+    """raw predictions over [0, a) + [a, b) slices equal the full [0, b)
+    raw prediction (tree contributions are additive in raw space)."""
+    X, y = synthetic_binary
+    p = {**FAST, "objective": "binary"}
+    bst = lgb.train(p, lgb.Dataset(X, label=y, params=p),
+                    num_boost_round=12)
+    full = bst.predict(X[:200], raw_score=True, num_iteration=12)
+    head = bst.predict(X[:200], raw_score=True, num_iteration=5)
+    tail = bst.predict(X[:200], raw_score=True, start_iteration=5,
+                       num_iteration=7)
+    np.testing.assert_allclose(head + tail, full, rtol=1e-5, atol=1e-6)
+
+
+def test_learning_rate_schedule_callback(synthetic_binary):
+    """reset_parameter with a per-round learning-rate list: later trees
+    shrink, visible through the leaf values of the dumped model."""
+    X, y = synthetic_binary
+    p = {**FAST, "objective": "binary"}
+    rates = [0.3] * 5 + [0.003] * 5
+    bst = lgb.train(p, lgb.Dataset(X, label=y, params=p),
+                    num_boost_round=10,
+                    callbacks=[lgb.reset_parameter(learning_rate=rates)])
+    d = bst.dump_model()
+
+    def max_abs_leaf(t):
+        out = []
+
+        def walk(node):
+            if "leaf_value" in node and "left_child" not in node:
+                out.append(abs(node["leaf_value"]))
+            for k in ("left_child", "right_child"):
+                if isinstance(node.get(k), dict):
+                    walk(node[k])
+        walk(t["tree_structure"])
+        return max(out)
+    early = max(max_abs_leaf(t) for t in d["tree_info"][1:5])
+    late = max(max_abs_leaf(t) for t in d["tree_info"][6:])
+    assert late < early * 0.2, (early, late)
+
+
+def test_pandas_categorical_roundtrip_prediction():
+    """DataFrame categoricals: training categories are stored in the
+    model, predict on a frame with the SAME categories in a different
+    order maps through the stored list (reference pandas_categorical)."""
+    pd = pytest.importorskip("pandas")
+    rng = np.random.default_rng(7)
+    n = 1200
+    cat = rng.choice(["red", "green", "blue", "violet"], size=n)
+    num = rng.normal(size=n)
+    y = ((cat == "red") * 1.0 + 0.3 * num +
+         0.1 * rng.normal(size=n) > 0.5).astype(np.float64)
+    df = pd.DataFrame({"c": pd.Categorical(cat), "x": num})
+    p = {**FAST, "objective": "binary"}
+    bst = lgb.train(p, lgb.Dataset(df, label=y, params=p,
+                                   categorical_feature=["c"]),
+                    num_boost_round=15)
+    pred = bst.predict(df)
+    assert _auc(y, pred) > 0.85
+    # same data, categories declared in a different order
+    df2 = df.copy()
+    df2["c"] = pd.Categorical(cat, categories=["violet", "blue", "green",
+                                               "red"])
+    np.testing.assert_allclose(bst.predict(df2), pred, rtol=1e-6)
+    # save/load keeps the category mapping
+    s = bst.model_to_string()
+    np.testing.assert_allclose(lgb.Booster(model_str=s).predict(df2), pred,
+                               rtol=1e-6)
+
+
+def test_cv_custom_folds(synthetic_binary):
+    """cv accepts explicit (train_idx, test_idx) folds and reports one
+    curve over them."""
+    X, y = synthetic_binary
+    n = len(y)
+    idx = np.arange(n)
+    folds = [(idx[: n // 2], idx[n // 2:]), (idx[n // 2:], idx[: n // 2])]
+    p = {**FAST, "objective": "binary", "metric": "binary_logloss"}
+    res = lgb.cv(p, lgb.Dataset(X, label=y, params=p), num_boost_round=8,
+                 folds=folds)
+    assert len(res["valid binary_logloss-mean"]) == 8
+    assert res["valid binary_logloss-mean"][-1] < \
+        res["valid binary_logloss-mean"][0]
+
+
+def test_dart_drop_rate_extremes(synthetic_binary):
+    """drop_rate=0 behaves like gbdt (no drops); skip_drop=1 likewise."""
+    X, y = synthetic_binary
+    base = {**FAST, "objective": "binary", "learning_rate": 0.1}
+    p_gbdt = {**base}
+    p_skip = {**base, "boosting": "dart", "skip_drop": 1.0}
+    ds = lambda pp: lgb.Dataset(X, label=y, params=pp)
+    b1 = lgb.train(p_gbdt, ds(p_gbdt), num_boost_round=10)
+    b2 = lgb.train(p_skip, ds(p_skip), num_boost_round=10)
+    np.testing.assert_allclose(b2.predict(X[:50]), b1.predict(X[:50]),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_feature_name_plumbing(synthetic_binary):
+    X, y = synthetic_binary
+    names = [f"col_{i}" for i in range(X.shape[1])]
+    p = {**FAST, "objective": "binary"}
+    bst = lgb.train(p, lgb.Dataset(X, label=y, params=p,
+                                   feature_name=names),
+                    num_boost_round=5)
+    assert bst.feature_name() == names
+    d = bst.dump_model()
+    assert d["feature_names"] == names
+    s = bst.model_to_string()
+    assert lgb.Booster(model_str=s).feature_name() == names

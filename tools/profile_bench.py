@@ -49,24 +49,34 @@ is_cat = jnp.zeros((f,), bool)
 
 hp = SplitHyper(num_leaves=LEAVES, min_data_in_leaf=0,
                 min_sum_hessian_in_leaf=100.0, n_bins=256,
-                rows_per_block=8192, hist_dtype="bfloat16")
+                rows_per_block=8192,
+                hist_dtype=os.environ.get("PDTYPE", "int8"))
 
 ITERS = 3
+QUANTIZE = hp.hist_dtype == "int8"
+if QUANTIZE:
+    from lightgbm_tpu.ops.quantize import discretize_gradients_levels
 
 
 @jax.jit
 def run(scores, bins_a, label_a):
-    def step(scores, _):
+    def step(scores, i):
         sign = jnp.where(label_a > 0, 1.0, -1.0)
         resp = -sign / (1.0 + jnp.exp(sign * scores))
         grad = resp
         hess = jnp.abs(resp) * (1.0 - jnp.abs(resp))
+        hist_scale = None
+        if QUANTIZE:
+            key = jax.random.fold_in(jax.random.PRNGKey(7), i)
+            grad, hess, gs, hs = discretize_gradients_levels(
+                grad, hess, key, n_levels=4, stochastic=True)
+            hist_scale = jnp.stack([gs, hs])
         tree, leaf_of_row = grow_tree_batched(
             bins_a, grad, hess, None, num_bins, nan_bin, is_cat,
-            None, hp, batch=K)
+            None, hp, batch=K, hist_scale=hist_scale)
         return scores + 0.1 * take_small_table(tree.leaf_value,
                                                leaf_of_row), None
-    scores, _ = jax.lax.scan(step, scores, None, length=ITERS)
+    scores, _ = jax.lax.scan(step, scores, jnp.arange(ITERS))
     return scores
 
 
