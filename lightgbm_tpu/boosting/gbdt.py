@@ -347,6 +347,7 @@ class GBDT:
         (gbdt.cpp, serial_tree_learner.cpp).  Requires ``parallel_mode``
         and the device bins to be set already."""
         train_set = self.train_set
+        self._fused_cache = {}   # compiled fused-round runners (train_fused)
         self._resolve_auto_params(config)
         self.hp = _hp_from_config(config, train_set.device_n_bins())
         if bool(train_set.categorical_array().any()):
@@ -846,6 +847,177 @@ class GBDT:
         self.iter_ += 1
         return finished
 
+    # ------------------------------------------------- fused iterations
+    def supports_fused(self) -> bool:
+        """True when whole boosting ROUNDS can run inside one jit
+        (``train_fused``).  The fused path must be a pure device program:
+        anything that reads or writes host state per iteration — custom
+        objectives, l1/quantile leaf renewal, position-debias bias
+        vectors, bagging/GOSS host RNG, CEGB acquisition state, linear
+        fits, DART drops, registered valid sets (their scores update per
+        tree), per-iter eval — keeps the classic loop."""
+        c = self.config
+        return (type(self) is GBDT
+                and self.objective is not None
+                and not self.objective.need_renew_tree_output
+                and getattr(self.objective, "_positions", None) is None
+                and self.num_tree_per_iteration == 1
+                and self.parallel_mode is None
+                and not self.linear
+                and self.cegb is None
+                and not bool(c.tpu_debug_checks)
+                and not self.valid_sets
+                and self._sampling_is_noop()
+                and self._use_batched_grower())
+
+    def _sampling_is_noop(self) -> bool:
+        """No per-iteration host RNG: the default BaggingSampleStrategy
+        no-ops unless bagging is actually configured (bagging.hpp's own
+        is_use_subset gate)."""
+        c = self.config
+        if str(c.data_sample_strategy) == "goss":
+            return False
+        return (float(c.bagging_fraction) >= 1.0
+                and float(c.pos_bagging_fraction) >= 1.0
+                and float(c.neg_bagging_fraction) >= 1.0) \
+            or int(c.bagging_freq) <= 0
+
+    @staticmethod
+    def fused_chunk_for(num_rounds: int) -> int:
+        """Chunk length for ``train_fused``: the largest c <= 40 that
+        divides ``num_rounds`` (>= 8), so the whole run reuses ONE
+        compiled scan; 32 + a ragged tail otherwise."""
+        for c in range(40, 7, -1):
+            if num_rounds % c == 0:
+                return c
+        return 32
+
+    def train_fused(self, num_rounds: int, chunk: int = 0) -> bool:
+        """Run ``num_rounds`` boosting iterations with the gradient step,
+        tree growth and score update of every round inside ONE compiled
+        scan (chunked so two compilations cover any round count).
+
+        The per-iteration dispatch of the classic loop costs ~0.2 s
+        through a tunneled dev chip and ~1 ms even on a co-located host —
+        at Higgs scale that is 100 s of pure overhead over 500 rounds.
+        The reference amortizes per-iteration launch overhead the same
+        way on CUDA by keeping the whole iteration on-device
+        (gbdt.cpp boosting_on_gpu / cuda gbdt path); here the rounds
+        themselves fuse.  Trees materialize on the host from ONE stacked
+        transfer per chunk.  Returns True if growth finished early (a
+        stump round)."""
+        from ..learner.batch_grower import grow_tree_batched
+
+        if chunk <= 0:
+            chunk = self.fused_chunk_for(num_rounds)
+        quant = bool(self.config.use_quantized_grad)
+        renew = quant and bool(self.config.quant_train_renew_leaf)
+        n_levels = int(self.config.num_grad_quant_bins)
+        stoch = bool(self.config.stochastic_rounding)
+        const_hess = bool(self.objective is not None
+                          and self.objective.is_constant_hessian)
+        seed_q = (self.config.seed or 0) * 7919
+        seed_node = int(self.config.extra_seed) * 1000003
+        shrink = self.shrinkage_rate
+        frac = float(self.config.feature_fraction)
+        if not hasattr(self, "_fused_cache"):
+            self._fused_cache = {}
+
+        def make_runner(T: int, has_fm: bool):
+            def run(scores, bins, it0, fmasks):
+                def body(sc, it, fm):
+                    g, h = self.objective.get_gradients(sc)
+                    g_t, h_t = g, h
+                    hist_scale = None
+                    if quant:
+                        from ..ops.quantize import (
+                            discretize_gradients_levels)
+                        # fold_in(·, 0): the class fold the loop applies
+                        # at k=1 — anything else lands on a different
+                        # stochastic-rounding draw and a different model
+                        qkey = jax.random.fold_in(
+                            jax.random.PRNGKey(seed_q + it), 0)
+                        g, h, gs, hs = discretize_gradients_levels(
+                            g, h, qkey, n_levels=n_levels,
+                            stochastic=stoch,
+                            constant_hessian=const_hess)
+                        hist_scale = jnp.stack([gs, hs])
+                    node_key = jax.random.PRNGKey(seed_node + it)
+                    arrays, lor = grow_tree_batched(
+                        bins, g, h, None, self.num_bins_arr,
+                        self.nan_bin_arr, self.is_cat_arr, fm, self.hp,
+                        batch=int(self.config.tpu_split_batch),
+                        bundle=self.bundle, monotone=self.monotone_arr,
+                        hist_scale=hist_scale,
+                        interaction_sets=self.interaction_sets,
+                        rng_key=node_key, forced=self.forced_splits)
+                    if renew:
+                        renewed = renew_leaf_values(
+                            lor, g_t, h_t, None,
+                            num_leaves=self.hp.num_leaves,
+                            lambda_l1=self.hp.lambda_l1,
+                            lambda_l2=self.hp.lambda_l2)
+                        arrays = arrays._replace(leaf_value=jnp.where(
+                            arrays.num_leaves > 1, renewed,
+                            arrays.leaf_value))
+                    # shrink BEFORE the gather, exactly like the classic
+                    # loop (train_one_iter: shrunk = leaf_value * rate,
+                    # then take_small_table) — the other order differs by
+                    # an ulp and cascades through the quantization grid
+                    sc = sc + take_small_table(arrays.leaf_value * shrink,
+                                               lor)
+                    return sc, arrays
+
+                its = it0 + jnp.arange(T)
+                if has_fm:
+                    return jax.lax.scan(
+                        lambda sc, xs: body(sc, xs[0], xs[1]),
+                        scores, (its, fmasks))
+                return jax.lax.scan(lambda sc, it: body(sc, it, None),
+                                    scores, its)
+            return jax.jit(run)
+
+        finished = False
+        done = 0
+        has_fm = frac < 1.0
+        while done < num_rounds and not finished:
+            T = min(chunk, num_rounds - done)
+            key = (T, has_fm)
+            if key not in self._fused_cache:
+                self._fused_cache[key] = make_runner(T, has_fm)
+            fmasks = None
+            if has_fm:
+                # per-ROUND masks: the seed is feature_fraction_seed +
+                # iteration (matching the classic loop, where iter_
+                # advances between draws) — drawing T masks at the same
+                # iter_ would freeze the subset for the whole chunk
+                fmasks = jnp.stack([
+                    self._feature_mask_for_tree(self.iter_ + t)
+                    for t in range(T)])
+            scores, stacked = self._fused_cache[key](
+                self.scores[:, 0], self.bins, jnp.int32(self.iter_),
+                fmasks)
+            self.scores = scores[:, None]
+            host = jax.device_get(stacked)     # ONE transfer per chunk
+            for t in range(T):
+                arrays_t = jax.tree.map(lambda a: a[t], host)
+                with global_timer.timer("tree_finalize"):
+                    tree = Tree.from_arrays(arrays_t, self.train_set)
+                tree.apply_shrinkage(self.shrinkage_rate)
+                if self.iter_ == 0 and abs(self.init_scores[0]) > 1e-10:
+                    tree.add_bias(self.init_scores[0])
+                self.models.append(tree)
+                self.iter_ += 1
+                done += 1
+                if tree.num_leaves <= 1:
+                    # the classic loop would have stopped here; drop any
+                    # overrun rounds and rebuild scores without them
+                    finished = True
+                    if t + 1 < T:
+                        self.invalidate_score_cache()
+                    break
+        return finished
+
     def _grow(self, g: jax.Array, h: jax.Array, row_mask, feature_mask,
               node_key, hist_scale=None) -> Tuple[TreeArrays, jax.Array]:
         """One tree via the configured tree learner (serial or a
@@ -969,14 +1141,16 @@ class GBDT:
                 arrays = arrays._replace(leaf_value=jnp.asarray(lv, jnp.float32))
         return arrays
 
-    def _feature_mask_for_tree(self) -> Optional[jax.Array]:
+    def _feature_mask_for_tree(self, iter_: Optional[int] = None
+                               ) -> Optional[jax.Array]:
         frac = float(self.config.feature_fraction)
         if frac >= 1.0:
             return None
         f = self.num_features
         kf = max(1, int(np.ceil(frac * f)))
-        rng = np.random.default_rng(self.config.feature_fraction_seed +
-                                    self.iter_)
+        rng = np.random.default_rng(
+            self.config.feature_fraction_seed
+            + (self.iter_ if iter_ is None else iter_))
         chosen = rng.choice(f, size=kf, replace=False)
         mask = np.zeros(f, bool)
         mask[chosen] = True

@@ -66,6 +66,24 @@ def train(params: Dict[str, Any], train_set: Dataset,
     cbs_after = [cb for cb in callbacks if not getattr(cb, "before_iteration",
                                                        False)]
 
+    # fused-rounds fast path: with nothing to observe per iteration (no
+    # callbacks, valid sets, custom eval/objective or train metric) the
+    # whole boosting run executes as chunked on-device scans
+    # (GBDT.train_fused) — one dispatch per ~32 rounds instead of one per
+    # round, which removes ~0.2 s/round of host/device round trips on
+    # tunneled chips and ~1 ms/round on co-located hosts.
+    if (not callbacks and not valid_pairs and not train_in_valid
+            and feval is None and fobj is None and num_boost_round > 0
+            and not booster._gbdt.config.is_provide_training_metric
+            and booster._gbdt.supports_fused()):
+        with global_timer.timer("train_fused"):
+            finished = booster._gbdt.train_fused(num_boost_round)
+        if finished:
+            log.warning("Stopped training because there are no more "
+                        "leaves that meet the split requirements")
+        booster.best_iteration = booster._gbdt.current_iteration()
+        return booster
+
     evals: List = []
     for it in range(num_boost_round):
         for cb in cbs_before:
