@@ -221,24 +221,16 @@ def main_e2e():
     # spends all its time in.
     from lightgbm_tpu.boosting.gbdt import GBDT as _G
 
-    def _chunk_lengths(total):
-        c = _G.fused_chunk_for(total)
-        out, done = set(), 0
-        while done < total:
-            t = min(c, total - done)
-            out.add(t)
-            done += t
-        return out
-
     bst = lgb.train(params, ds,
                     num_boost_round=_G.fused_chunk_for(BENCH_ITERS))
     gb = bst._gbdt
+    has_fm = float(params.get("feature_fraction", 1.0)) < 1.0
     if gb.supports_fused():
         # compile every scan length the timed run will use (the first
         # warmup train covers fused_chunk_for(BENCH_ITERS) only when
         # BENCH_ITERS is divisible; ragged tails need their own runner)
-        for L in sorted(_chunk_lengths(BENCH_ITERS)):
-            if (L, False) not in gb._fused_cache:
+        for L in sorted(set(_G.fused_chunks(BENCH_ITERS))):
+            if (L, has_fm) not in gb._fused_cache:
                 gb.train_fused(L)
     t0 = time.time()
     if gb.supports_fused():
@@ -247,7 +239,11 @@ def main_e2e():
         for _ in range(BENCH_ITERS):
             gb.train_one_iter()
     elapsed = time.time() - t0
-    pred = bst.predict(feat_te)
+    # warmup + precompile rounds left extra trees on the booster; score
+    # the FIRST BENCH_ITERS trees so the reported AUC is exactly the
+    # named iteration count's model (trees 0..N-1 train identically
+    # whatever follows them)
+    pred = bst.predict(feat_te, num_iteration=BENCH_ITERS)
     order = np.argsort(pred)
     ranks = np.empty(len(order))
     ranks[order] = np.arange(1, len(order) + 1)

@@ -892,6 +892,19 @@ class GBDT:
                 return c
         return 32
 
+    @classmethod
+    def fused_chunks(cls, num_rounds: int):
+        """The exact scan-length sequence ``train_fused`` will run —
+        the single source of truth shared with warmup code (bench.py)
+        that precompiles each length."""
+        c = cls.fused_chunk_for(num_rounds)
+        out, done = [], 0
+        while done < num_rounds:
+            t = min(c, num_rounds - done)
+            out.append(t)
+            done += t
+        return out
+
     def train_fused(self, num_rounds: int, chunk: int = 0) -> bool:
         """Run ``num_rounds`` boosting iterations with the gradient step,
         tree growth and score update of every round inside ONE compiled
@@ -924,25 +937,19 @@ class GBDT:
             self._fused_cache = {}
 
         def make_runner(T: int, has_fm: bool):
-            def run(scores, bins, it0, fmasks):
-                def body(sc, it, fm):
+            def run(scores, bins, qkeys, nkeys, fmasks):
+                def body(sc, qkey, node_key, fm):
                     g, h = self.objective.get_gradients(sc)
                     g_t, h_t = g, h
                     hist_scale = None
                     if quant:
                         from ..ops.quantize import (
                             discretize_gradients_levels)
-                        # fold_in(·, 0): the class fold the loop applies
-                        # at k=1 — anything else lands on a different
-                        # stochastic-rounding draw and a different model
-                        qkey = jax.random.fold_in(
-                            jax.random.PRNGKey(seed_q + it), 0)
                         g, h, gs, hs = discretize_gradients_levels(
                             g, h, qkey, n_levels=n_levels,
                             stochastic=stoch,
                             constant_hessian=const_hess)
                         hist_scale = jnp.stack([gs, hs])
-                    node_key = jax.random.PRNGKey(seed_node + it)
                     arrays, lor = grow_tree_batched(
                         bins, g, h, None, self.num_bins_arr,
                         self.nan_bin_arr, self.is_cat_arr, fm, self.hp,
@@ -968,13 +975,13 @@ class GBDT:
                                                lor)
                     return sc, arrays
 
-                its = it0 + jnp.arange(T)
                 if has_fm:
                     return jax.lax.scan(
-                        lambda sc, xs: body(sc, xs[0], xs[1]),
-                        scores, (its, fmasks))
-                return jax.lax.scan(lambda sc, it: body(sc, it, None),
-                                    scores, its)
+                        lambda sc, xs: body(sc, *xs),
+                        scores, (qkeys, nkeys, fmasks))
+                return jax.lax.scan(
+                    lambda sc, xs: body(sc, xs[0], xs[1], None),
+                    scores, (qkeys, nkeys))
             return jax.jit(run)
 
         finished = False
@@ -994,9 +1001,20 @@ class GBDT:
                 fmasks = jnp.stack([
                     self._feature_mask_for_tree(self.iter_ + t)
                     for t in range(T)])
+            # per-round PRNG keys computed HOST-SIDE with the classic
+            # loop's exact formulas (python ints: no traced-int32
+            # overflow for large seeds; fold_in(., 0) is the class fold
+            # the loop applies at k=1 — anything else lands on a
+            # different stochastic-rounding draw and a different model)
+            qkeys = jnp.stack([
+                jax.random.fold_in(
+                    jax.random.PRNGKey(seed_q + self.iter_ + t), 0)
+                for t in range(T)])
+            nkeys = jnp.stack([
+                jax.random.PRNGKey(seed_node + self.iter_ + t)
+                for t in range(T)])
             scores, stacked = self._fused_cache[key](
-                self.scores[:, 0], self.bins, jnp.int32(self.iter_),
-                fmasks)
+                self.scores[:, 0], self.bins, qkeys, nkeys, fmasks)
             self.scores = scores[:, None]
             host = jax.device_get(stacked)     # ONE transfer per chunk
             for t in range(T):

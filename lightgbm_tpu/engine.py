@@ -81,7 +81,6 @@ def train(params: Dict[str, Any], train_set: Dataset,
         if finished:
             log.warning("Stopped training because there are no more "
                         "leaves that meet the split requirements")
-        booster.best_iteration = booster._gbdt.current_iteration()
         return booster
 
     evals: List = []
@@ -110,7 +109,12 @@ def train(params: Dict[str, Any], train_set: Dataset,
                         "that meet the split requirements")
             break
     if booster.best_iteration <= 0:
-        booster.best_iteration = booster._gbdt.current_iteration()
+        # best_iteration stays UNSET without early stopping (reference
+        # basic.py contract: predict()/save_model() then use ALL trees).
+        # Setting it to the final round here looks harmless but silently
+        # truncates predictions after CONTINUED training on the returned
+        # booster — new trees beyond the recorded round were ignored
+        # (caught in round 4: a 525-tree flagship predicting with 25).
         _set_best_score(booster, evals)
     return booster
 

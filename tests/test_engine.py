@@ -1437,3 +1437,24 @@ def _iter_splits(node):
         for k in ("left_child", "right_child"):
             if isinstance(node.get(k), dict):
                 yield from _iter_splits(node[k])
+
+
+def test_fused_large_seed_no_overflow():
+    """seed big enough that seed*7919 exceeds int32: the fused path must
+    neither crash nor diverge from the loop (round-4 review catch —
+    per-round PRNG keys are computed host-side as python ints)."""
+    rng = np.random.default_rng(3)
+    n, f = 110_000, 5
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = (X @ rng.normal(size=f) > 0).astype(np.float32)
+    p = {"objective": "binary", "verbose": -1, "num_leaves": 15,
+         "seed": 400_000, "extra_seed": 5_000}
+    b_fused = lgb.train(p, lgb.Dataset(X, label=y, params=p),
+                        num_boost_round=4)
+    assert b_fused._gbdt.supports_fused()
+
+    def noop(env):
+        pass
+    b_loop = lgb.train(p, lgb.Dataset(X, label=y, params=p),
+                       num_boost_round=4, callbacks=[noop])
+    assert b_fused.model_to_string() == b_loop.model_to_string()
