@@ -224,7 +224,9 @@ def main_e2e():
     bst = lgb.train(params, ds,
                     num_boost_round=_G.fused_chunk_for(BENCH_ITERS))
     gb = bst._gbdt
-    has_fm = float(params.get("feature_fraction", 1.0)) < 1.0
+    # the exact expression train_fused keys its cache with (aliases and
+    # defaults resolved by the config, not the raw params dict)
+    has_fm = float(gb.config.feature_fraction) < 1.0
     if gb.supports_fused():
         # compile every scan length the timed run will use (the first
         # warmup train covers fused_chunk_for(BENCH_ITERS) only when

@@ -938,13 +938,16 @@ class GBDT:
 
         def make_runner(T: int, has_fm: bool):
             def run(scores, bins, qkeys, nkeys, fmasks):
-                def body(sc, qkey, node_key, fm):
+                def body(sc, qkey_raw, node_key, fm):
                     g, h = self.objective.get_gradients(sc)
                     g_t, h_t = g, h
                     hist_scale = None
                     if quant:
                         from ..ops.quantize import (
                             discretize_gradients_levels)
+                        # fold_in(., 0) — the class fold the loop applies
+                        # at k=1 — runs IN-JIT on the raw key words
+                        qkey = jax.random.fold_in(qkey_raw, 0)
                         g, h, gs, hs = discretize_gradients_levels(
                             g, h, qkey, n_levels=n_levels,
                             stochastic=stoch,
@@ -1001,18 +1004,19 @@ class GBDT:
                 fmasks = jnp.stack([
                     self._feature_mask_for_tree(self.iter_ + t)
                     for t in range(T)])
-            # per-round PRNG keys computed HOST-SIDE with the classic
-            # loop's exact formulas (python ints: no traced-int32
-            # overflow for large seeds; fold_in(., 0) is the class fold
-            # the loop applies at k=1 — anything else lands on a
-            # different stochastic-rounding draw and a different model)
-            qkeys = jnp.stack([
-                jax.random.fold_in(
-                    jax.random.PRNGKey(seed_q + self.iter_ + t), 0)
-                for t in range(T)])
-            nkeys = jnp.stack([
-                jax.random.PRNGKey(seed_node + self.iter_ + t)
-                for t in range(T)])
+            # per-round PRNG keys: python-int seed arithmetic (no
+            # traced-int32 overflow for large seeds) rendered straight
+            # to threefry key words in numpy — PRNGKey(s) is exactly
+            # [s >> 32, s & 0xffffffff] — so a chunk ships ONE [T, 2]
+            # array instead of ~3T tiny per-round device dispatches;
+            # the class fold_in(., 0) runs inside the jitted body
+            def _key_words(base):
+                return np.array(
+                    [[(base + t) >> 32 & 0xffffffff,
+                      (base + t) & 0xffffffff] for t in range(T)],
+                    np.uint32)
+            qkeys = jnp.asarray(_key_words(seed_q + self.iter_))
+            nkeys = jnp.asarray(_key_words(seed_node + self.iter_))
             scores, stacked = self._fused_cache[key](
                 self.scores[:, 0], self.bins, qkeys, nkeys, fmasks)
             self.scores = scores[:, None]
