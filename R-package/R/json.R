@@ -1,0 +1,113 @@
+# Minimal recursive-descent JSON reader in base R — enough for the
+# booster's DumpModel output (objects, arrays, numbers, strings, bools,
+# null).  Exists so the package needs no jsonlite dependency, the same
+# trade the reference makes by parsing model JSON with data.table tools.
+#
+# Objects -> named lists, arrays -> unnamed lists, null -> NULL.
+
+.lgb_json_parse <- function(txt) {
+  chars <- strsplit(txt, "", fixed = TRUE)[[1L]]
+  pos <- 1L
+  n <- length(chars)
+
+  peek <- function() if (pos <= n) chars[[pos]] else ""
+  advance <- function() pos <<- pos + 1L
+  skip_ws <- function() {
+    while (pos <= n && chars[[pos]] %in% c(" ", "\t", "\n", "\r")) {
+      advance()
+    }
+  }
+  expect <- function(ch) {
+    if (peek() != ch) {
+      stop(sprintf("JSON parse error at %d: expected '%s', got '%s'",
+                   pos, ch, peek()))
+    }
+    advance()
+  }
+
+  parse_string <- function() {
+    expect("\"")
+    out <- character(0L)
+    while (pos <= n && chars[[pos]] != "\"") {
+      ch <- chars[[pos]]
+      if (ch == "\\") {
+        advance()
+        esc <- chars[[pos]]
+        ch <- switch(esc, n = "\n", t = "\t", r = "\r", b = "\b",
+                     f = "\f", "/" = "/", "\\" = "\\", "\"" = "\"",
+                     u = {
+                       code <- paste(chars[(pos + 1L):(pos + 4L)],
+                                     collapse = "")
+                       pos <<- pos + 4L
+                       intToUtf8(strtoi(code, 16L))
+                     },
+                     esc)
+      }
+      out[[length(out) + 1L]] <- ch
+      advance()
+    }
+    expect("\"")
+    paste(out, collapse = "")
+  }
+
+  parse_number <- function() {
+    start <- pos
+    while (pos <= n &&
+           (chars[[pos]] %in% c("-", "+", ".", "e", "E") ||
+            grepl("[0-9]", chars[[pos]]))) {
+      advance()
+    }
+    as.numeric(paste(chars[start:(pos - 1L)], collapse = ""))
+  }
+
+  parse_value <- function() {
+    skip_ws()
+    ch <- peek()
+    if (ch == "{") return(parse_object())
+    if (ch == "[") return(parse_array())
+    if (ch == "\"") return(parse_string())
+    if (ch == "t") { pos <<- pos + 4L; return(TRUE) }
+    if (ch == "f") { pos <<- pos + 5L; return(FALSE) }
+    if (ch == "n") { pos <<- pos + 4L; return(NULL) }
+    parse_number()
+  }
+
+  parse_object <- function() {
+    expect("{")
+    out <- list()
+    skip_ws()
+    if (peek() == "}") { advance(); return(out) }
+    repeat {
+      skip_ws()
+      key <- parse_string()
+      skip_ws()
+      expect(":")
+      val <- parse_value()
+      out[[key]] <- val
+      skip_ws()
+      if (peek() == ",") { advance() } else break
+    }
+    skip_ws()
+    expect("}")
+    out
+  }
+
+  parse_array <- function() {
+    expect("[")
+    out <- list()
+    skip_ws()
+    if (peek() == "]") { advance(); return(out) }
+    repeat {
+      out[[length(out) + 1L]] <- parse_value()
+      skip_ws()
+      if (peek() == ",") { advance() } else break
+    }
+    skip_ws()
+    expect("]")
+    out
+  }
+
+  val <- parse_value()
+  skip_ws()
+  val
+}

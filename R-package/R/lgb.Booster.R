@@ -1,0 +1,218 @@
+# lgb.Booster — R front end of the framework's Booster (basic.py),
+# a thin client of the LGBMTPU_Booster* ABI.  Environment-backed with
+# S3 methods, covering the reference surface: predict, save/load/dump,
+# eval tracking, serialization keep-alive.
+
+.lgb_booster_new <- function(handle, train_set = NULL, params = list()) {
+  env <- new.env(parent = emptyenv())
+  env$handle <- handle
+  env$train_set <- train_set
+  env$params <- params
+  env$valid_sets <- list()
+  env$valid_names <- character(0L)
+  env$record_evals <- list()
+  env$best_iter <- -1L
+  env$best_score <- NA_real_
+  env$raw <- NULL            # serialized model kept by lgb.make_serializable
+  class(env) <- "lgb.Booster"
+  env
+}
+
+#' Create a Booster on a training Dataset
+#' @param train_set an lgb.Dataset
+#' @param params named list of training parameters
+#' @export
+lgb.Booster <- function(train_set, params = list()) {
+  lgb.Dataset.construct(train_set)
+  h <- .Call(LGBTPU_R_BoosterCreate, train_set$handle,
+             .lgb_params_json(params))
+  .lgb_booster_new(h, train_set, params)
+}
+
+# a handle read back by readRDS is an external pointer whose native
+# address is NULL — R-level is.null() cannot detect that, the glue can
+.lgb_handle_live <- function(h) {
+  !is.null(h) && .Call(LGBTPU_R_HandleIsLive, h)
+}
+
+.lgb_booster_handle <- function(booster) {
+  if (!.lgb_handle_live(booster$handle)) {
+    lgb.restore_handle(booster)
+  }
+  booster$handle
+}
+
+#' Predict with a Booster
+#'
+#' @param object an lgb.Booster
+#' @param newdata matrix, dgCMatrix or file path
+#' @param type "response" (transformed scores), "raw" (margins),
+#'   "leaf" (leaf indices) or "contrib" (per-feature SHAP contributions
+#'   plus bias column)
+#' @param start_iteration,num_iteration iteration window (0 / -1 = all;
+#'   when the booster has a best_iter from early stopping and
+#'   num_iteration is NULL, the best iteration is used, matching the
+#'   reference predict semantics)
+#' @param header whether a file newdata has a header line
+#' @param ... unused
+#' @export
+predict.lgb.Booster <- function(object, newdata,
+                                type = c("response", "raw", "leaf",
+                                         "contrib"),
+                                start_iteration = 0L,
+                                num_iteration = NULL, header = FALSE,
+                                ...) {
+  type <- match.arg(type)
+  ptype <- switch(type, response = 0L, raw = 1L, leaf = 2L,
+                  contrib = 3L)
+  if (is.null(num_iteration)) {
+    num_iteration <- if (object$best_iter > 0L) object$best_iter else -1L
+  }
+  h <- .lgb_booster_handle(object)
+  if (is.character(newdata) && length(newdata) == 1L) {
+    out_path <- tempfile(fileext = ".pred")
+    .Call(LGBTPU_R_BoosterPredictForFile, h, newdata, header, ptype,
+          as.integer(start_iteration), as.integer(num_iteration),
+          out_path)
+    preds <- as.numeric(readLines(out_path))
+    unlink(out_path)
+    return(preds)
+  }
+  if (inherits(newdata, "dgCMatrix")) {
+    preds <- .Call(LGBTPU_R_BoosterPredictForCSC, h, newdata@p,
+                   newdata@i, newdata@x, as.numeric(nrow(newdata)),
+                   ptype, as.integer(start_iteration),
+                   as.integer(num_iteration))
+    nrow_ <- nrow(newdata)
+  } else {
+    m <- newdata
+    if (is.data.frame(m)) m <- as.matrix(m)
+    if (is.null(dim(m))) m <- matrix(m, nrow = 1L)
+    storage.mode(m) <- "double"
+    preds <- .Call(LGBTPU_R_BoosterPredictForMat, h, t(m),
+                   as.numeric(nrow(m)), as.numeric(ncol(m)), ptype,
+                   as.integer(start_iteration),
+                   as.integer(num_iteration))
+    nrow_ <- nrow(m)
+  }
+  # multi-output shapes come back row-major; fold into a matrix like the
+  # reference's R predictor does
+  per_row <- length(preds) / nrow_
+  if (per_row > 1L) {
+    return(matrix(preds, nrow = nrow_, byrow = TRUE))
+  }
+  preds
+}
+
+#' Save a Booster to the interoperable text format
+#' @param booster an lgb.Booster
+#' @param filename output path
+#' @param num_iteration unused (full model is saved)
+#' @export
+lgb.save <- function(booster, filename, num_iteration = NULL) {
+  stopifnot(inherits(booster, "lgb.Booster"))
+  .Call(LGBTPU_R_BoosterSaveModel, .lgb_booster_handle(booster), filename)
+  invisible(booster)
+}
+
+#' Load a Booster from a text model file or model string
+#' @param filename path to a saved model
+#' @param model_str a model string (alternative to filename)
+#' @export
+lgb.load <- function(filename = NULL, model_str = NULL) {
+  if (!is.null(filename)) {
+    h <- .Call(LGBTPU_R_BoosterCreateFromModelfile, filename)
+  } else if (!is.null(model_str)) {
+    h <- .Call(LGBTPU_R_BoosterLoadModelFromString, model_str)
+  } else {
+    stop("lgb.load: give filename or model_str")
+  }
+  .lgb_booster_new(h)
+}
+
+#' Dump a Booster to JSON
+#' @param booster an lgb.Booster
+#' @param num_iteration how many iterations to include (-1 = all)
+#' @export
+lgb.dump <- function(booster, num_iteration = -1L) {
+  .Call(LGBTPU_R_BoosterDumpModel, .lgb_booster_handle(booster),
+        as.integer(num_iteration))
+}
+
+#' Fetch a recorded evaluation history
+#' @param booster an lgb.Booster trained with record = TRUE
+#' @param data_name validation set name
+#' @param eval_name metric name
+#' @param iters specific iterations (default all)
+#' @export
+lgb.get.eval.result <- function(booster, data_name, eval_name,
+                                iters = NULL) {
+  rec <- booster$record_evals[[data_name]][[eval_name]]
+  if (is.null(rec)) {
+    stop("no recorded evaluations for ", data_name, "/", eval_name,
+         " (train with valids and record = TRUE)")
+  }
+  if (is.null(iters)) rec else rec[iters]
+}
+
+#' Store the serialized model inside the R object so it survives
+#' saveRDS/readRDS (the native handle does not)
+#' @param booster an lgb.Booster
+#' @export
+lgb.make_serializable <- function(booster) {
+  stopifnot(inherits(booster, "lgb.Booster"))
+  booster$raw <- .Call(LGBTPU_R_BoosterSaveModelToString,
+                       .lgb_booster_handle(booster))
+  invisible(booster)
+}
+
+#' Drop the serialized copy stored by lgb.make_serializable
+#' @param booster an lgb.Booster
+#' @export
+lgb.drop_serialized <- function(booster) {
+  stopifnot(inherits(booster, "lgb.Booster"))
+  booster$raw <- NULL
+  invisible(booster)
+}
+
+#' Rebuild the native handle from the serialized copy (after readRDS)
+#' @param booster an lgb.Booster with a stored raw model
+#' @export
+lgb.restore_handle <- function(booster) {
+  stopifnot(inherits(booster, "lgb.Booster"))
+  if (.lgb_handle_live(booster$handle)) {
+    return(invisible(booster))
+  }
+  if (is.null(booster$raw)) {
+    stop("booster has no native handle and no serialized copy; call ",
+         "lgb.make_serializable before saveRDS")
+  }
+  booster$handle <- .Call(LGBTPU_R_BoosterLoadModelFromString,
+                          booster$raw)
+  invisible(booster)
+}
+
+#' @export
+print.lgb.Booster <- function(x, ...) {
+  h <- tryCatch(.lgb_booster_handle(x), error = function(e) NULL)
+  if (is.null(h)) {
+    cat("<lgb.Booster (lightgbm.tpu), handle-less>\n")
+    return(invisible(x))
+  }
+  nt <- .Call(LGBTPU_R_BoosterNumTrees, h)
+  nc <- .Call(LGBTPU_R_BoosterGetNumClasses, h)
+  it <- .Call(LGBTPU_R_BoosterGetCurrentIteration, h)
+  cat(sprintf(
+    "<lgb.Booster (lightgbm.tpu): %d trees, %d classes, iteration %d>\n",
+    nt, nc, it))
+  if (x$best_iter > 0L) {
+    cat(sprintf("  best_iter: %d\n", x$best_iter))
+  }
+  invisible(x)
+}
+
+#' @export
+summary.lgb.Booster <- function(object, ...) {
+  print(object)
+  invisible(object)
+}

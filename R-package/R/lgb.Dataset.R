@@ -1,0 +1,223 @@
+# lgb.Dataset — R front end of the framework's Dataset (io/dataset.py),
+# a thin client of the LGBMTPU_Dataset* ABI like the reference's
+# R-package/R/lgb.Dataset.R is of LGBM_Dataset*.
+#
+# The object is an environment with class "lgb.Dataset", constructed
+# LAZILY: data and parameters are recorded at creation, the native
+# handle is built on first use (construct), matching the reference's
+# two-phase design so set_field / categorical settings made before
+# training are folded into construction.
+
+#' Create a lightgbm.tpu Dataset
+#'
+#' @param data matrix, dgCMatrix (Matrix package) or path to a text file
+#' @param params named list of dataset parameters (max_bin, ...)
+#' @param reference another lgb.Dataset whose bin mappers to reuse
+#'   (validation sets must be binned like their training set)
+#' @param colnames feature names
+#' @param categorical_feature names or 1-based indices of categorical
+#'   features
+#' @param label,weight,group,init_score metadata vectors
+#' @param free_raw_data drop the R-side copy after construction
+#' @export
+lgb.Dataset <- function(data, params = list(), reference = NULL,
+                        colnames = NULL, categorical_feature = NULL,
+                        label = NULL, weight = NULL, group = NULL,
+                        init_score = NULL, free_raw_data = TRUE) {
+  if (!is.null(reference) && !inherits(reference, "lgb.Dataset")) {
+    stop("lgb.Dataset: reference must be an lgb.Dataset")
+  }
+  env <- new.env(parent = emptyenv())
+  env$raw_data <- data
+  env$params <- params
+  env$reference <- reference
+  env$colnames <- colnames %||% (if (is.matrix(data)) colnames(data))
+  env$categorical_feature <- categorical_feature
+  env$fields <- list()
+  if (!is.null(label)) env$fields[["label"]] <- as.numeric(label)
+  if (!is.null(weight)) env$fields[["weight"]] <- as.numeric(weight)
+  if (!is.null(group)) env$fields[["group"]] <- as.numeric(group)
+  if (!is.null(init_score)) {
+    env$fields[["init_score"]] <- as.numeric(init_score)
+  }
+  env$free_raw_data <- isTRUE(free_raw_data)
+  env$handle <- NULL
+  class(env) <- "lgb.Dataset"
+  env
+}
+
+`%||%` <- function(a, b) if (is.null(a)) b else a
+
+#' Construct the native dataset (no-op when already constructed)
+#' @param dataset an lgb.Dataset
+#' @export
+lgb.Dataset.construct <- function(dataset) {
+  stopifnot(inherits(dataset, "lgb.Dataset"))
+  if (!is.null(dataset$handle)) {
+    return(invisible(dataset))
+  }
+  params <- .lgb_resolve_categorical(dataset$params,
+                                     dataset$categorical_feature,
+                                     dataset$colnames)
+  pj <- .lgb_params_json(params)
+  data <- dataset$raw_data
+  label <- dataset$fields[["label"]]
+  if (is.character(data) && length(data) == 1L) {
+    h <- .Call(LGBTPU_R_DatasetCreateFromFile, data, pj)
+  } else if (inherits(data, "dgCMatrix")) {
+    h <- .Call(LGBTPU_R_DatasetCreateFromCSC,
+               data@p, data@i, data@x,
+               as.numeric(ncol(data)), as.numeric(length(data@x)),
+               as.numeric(nrow(data)),
+               as.numeric(label %||% numeric(0L)), pj)
+  } else {
+    m <- data
+    if (is.data.frame(m)) m <- as.matrix(m)
+    storage.mode(m) <- "double"
+    # ABI expects row-major [nrow, ncol]; R matrices are column-major
+    h <- .Call(LGBTPU_R_DatasetCreateFromMat, t(m),
+               as.numeric(nrow(m)), as.numeric(ncol(m)),
+               as.numeric(label %||% numeric(0L)), pj)
+  }
+  dataset$handle <- h
+  if (!is.null(dataset$colnames)) {
+    .Call(LGBTPU_R_DatasetSetFeatureNames, h,
+          .lgb_strings_json(dataset$colnames))
+  }
+  for (field in setdiff(names(dataset$fields), "label")) {
+    .Call(LGBTPU_R_DatasetSetField, h, field,
+          dataset$fields[[field]])
+  }
+  if (isTRUE(dataset$free_raw_data)) {
+    dataset$raw_data <- NULL
+  }
+  invisible(dataset)
+}
+
+#' Create a validation Dataset binned like its training set
+#' @param dataset the training lgb.Dataset (becomes the reference)
+#' @param data validation data (matrix / dgCMatrix / file path)
+#' @param ... passed to lgb.Dataset
+#' @export
+lgb.Dataset.create.valid <- function(dataset, data, ...) {
+  stopifnot(inherits(dataset, "lgb.Dataset"))
+  lgb.Dataset(data, params = dataset$params, reference = dataset, ...)
+}
+
+#' Save a Dataset to the framework's binary format
+#' @param dataset an lgb.Dataset
+#' @param fname output path
+#' @export
+lgb.Dataset.save <- function(dataset, fname) {
+  lgb.Dataset.construct(dataset)
+  .Call(LGBTPU_R_DatasetSaveBinary, dataset$handle, fname)
+  invisible(dataset)
+}
+
+#' Declare categorical features (before construction)
+#' @param dataset an lgb.Dataset
+#' @param categorical_feature names or 1-based indices
+#' @export
+lgb.Dataset.set.categorical <- function(dataset, categorical_feature) {
+  stopifnot(inherits(dataset, "lgb.Dataset"))
+  if (!is.null(dataset$handle)) {
+    stop("set.categorical must be called before the dataset is constructed")
+  }
+  dataset$categorical_feature <- categorical_feature
+  invisible(dataset)
+}
+
+#' Set the bin-mapper reference of a validation Dataset
+#' @param dataset the validation lgb.Dataset
+#' @param reference the training lgb.Dataset
+#' @export
+lgb.Dataset.set.reference <- function(dataset, reference) {
+  stopifnot(inherits(dataset, "lgb.Dataset"),
+            inherits(reference, "lgb.Dataset"))
+  if (!is.null(dataset$handle)) {
+    stop("set.reference must be called before the dataset is constructed")
+  }
+  dataset$reference <- reference
+  invisible(dataset)
+}
+
+#' Subset a Dataset by row indices (shares bin mappers, like cv folds)
+#' @param dataset an lgb.Dataset
+#' @param idxset 1-based row indices
+#' @param ... unused
+#' @export
+lgb.slice.Dataset <- function(dataset, idxset, ...) {
+  lgb.Dataset.construct(dataset)
+  sub <- new.env(parent = emptyenv())
+  sub$handle <- .Call(LGBTPU_R_DatasetGetSubset, dataset$handle,
+                      as.integer(idxset - 1L),
+                      .lgb_params_json(dataset$params))
+  sub$params <- dataset$params
+  sub$reference <- dataset
+  sub$colnames <- dataset$colnames
+  sub$fields <- list()
+  sub$free_raw_data <- TRUE
+  class(sub) <- "lgb.Dataset"
+  sub
+}
+
+#' Read a metadata field from a Dataset
+#' @param dataset an lgb.Dataset
+#' @param field_name "label", "weight", "group" or "init_score"
+#' @export
+get_field <- function(dataset, field_name) {
+  UseMethod("get_field")
+}
+
+#' @export
+get_field.lgb.Dataset <- function(dataset, field_name) {
+  if (is.null(dataset$handle)) {
+    return(dataset$fields[[field_name]])
+  }
+  .Call(LGBTPU_R_DatasetGetField, dataset$handle, field_name)
+}
+
+#' Set a metadata field on a Dataset
+#' @param dataset an lgb.Dataset
+#' @param field_name "label", "weight", "group" or "init_score"
+#' @param data numeric vector
+#' @export
+set_field <- function(dataset, field_name, data) {
+  UseMethod("set_field")
+}
+
+#' @export
+set_field.lgb.Dataset <- function(dataset, field_name, data) {
+  dataset$fields[[field_name]] <- as.numeric(data)
+  if (!is.null(dataset$handle)) {
+    .Call(LGBTPU_R_DatasetSetField, dataset$handle, field_name,
+          as.numeric(data))
+  }
+  invisible(dataset)
+}
+
+#' @export
+dim.lgb.Dataset <- function(x) {
+  if (is.null(x$handle)) {
+    if (is.matrix(x$raw_data) || inherits(x$raw_data, "dgCMatrix")) {
+      return(dim(x$raw_data))
+    }
+    lgb.Dataset.construct(x)
+  }
+  c(.Call(LGBTPU_R_DatasetGetNumData, x$handle),
+    .Call(LGBTPU_R_DatasetGetNumFeature, x$handle))
+}
+
+#' @export
+dimnames.lgb.Dataset <- function(x) {
+  list(NULL, x$colnames)
+}
+
+#' @export
+print.lgb.Dataset <- function(x, ...) {
+  constructed <- if (is.null(x$handle)) "not constructed" else "constructed"
+  d <- tryCatch(dim(x), error = function(e) c(NA, NA))
+  cat(sprintf("<lgb.Dataset (lightgbm.tpu), %s, %s x %s>\n", constructed,
+              d[1L], d[2L]))
+  invisible(x)
+}

@@ -1,0 +1,156 @@
+# lgb.train — the R training entry point, mirroring the reference's
+# R-package/R/lgb.train.R surface over this framework's engine
+# (engine.py train()): iteration loop via BoosterUpdateOneIter, eval
+# recording, early stopping on validation metrics.
+
+#' Train a GBDT model
+#'
+#' @param params named list of parameters (objective, num_leaves,
+#'   learning_rate, ...; aliases resolve ABI-side exactly as in
+#'   config.py)
+#' @param data training lgb.Dataset
+#' @param nrounds number of boosting iterations
+#' @param valids named list of validation lgb.Datasets
+#' @param obj optional custom objective: function(preds, dtrain) ->
+#'   list(grad =, hess =)
+#' @param record keep per-iteration eval results in
+#'   booster$record_evals
+#' @param verbose <= 0 silences per-iteration eval printing
+#' @param eval_freq print/record every k-th iteration
+#' @param early_stopping_rounds stop when no validation metric improves
+#'   for this many rounds; sets best_iter on the booster
+#' @param init_model a Booster or model file to continue training from
+#' @param callbacks list of functions(env) called after each iteration;
+#'   env carries booster/iteration/nrounds/eval_list
+#' @param reset_data unused compatibility argument
+#' @param ... additional parameters merged into params
+#' @export
+lgb.train <- function(params = list(), data, nrounds = 100L,
+                      valids = list(), obj = NULL, record = TRUE,
+                      verbose = 1L, eval_freq = 1L,
+                      early_stopping_rounds = NULL, init_model = NULL,
+                      callbacks = list(), reset_data = FALSE, ...) {
+  stopifnot(inherits(data, "lgb.Dataset"))
+  params <- c(params, list(...))
+  if (!is.null(obj)) {
+    params[["objective"]] <- "none"
+  }
+  booster <- lgb.Booster(data, params)
+  if (!is.null(init_model)) {
+    base <- if (inherits(init_model, "lgb.Booster")) {
+      lgb.make_serializable(init_model)$raw
+    } else {
+      paste(readLines(init_model), collapse = "\n")
+    }
+    other <- .Call(LGBTPU_R_BoosterLoadModelFromString, base)
+    # merge the previous model's trees in front, the ABI-side
+    # continuation path (BoosterMerge is the reference's model-merge)
+    .Call(LGBTPU_R_BoosterMerge, booster$handle, other)
+  }
+  if (length(valids) > 0L) {
+    if (is.null(names(valids)) || any(!nzchar(names(valids)))) {
+      stop("lgb.train: valids must be a NAMED list of lgb.Dataset")
+    }
+    for (vn in names(valids)) {
+      v <- valids[[vn]]
+      stopifnot(inherits(v, "lgb.Dataset"))
+      if (is.null(v$reference)) v$reference <- data
+      lgb.Dataset.construct(v)
+      .Call(LGBTPU_R_BoosterAddValidData, booster$handle, v$handle)
+    }
+    booster$valid_sets <- valids
+    booster$valid_names <- names(valids)
+  }
+
+  eval_names <- NULL
+  best_score <- Inf   # orientation-normalized (lower is better)
+  best_raw <- NA_real_  # the metric's own value at the best iteration
+  best_iter <- -1L
+  stale <- 0L
+
+  for (i in seq_len(nrounds)) {
+    if (is.null(obj)) {
+      .Call(LGBTPU_R_BoosterUpdateOneIter, booster$handle)
+    } else {
+      preds <- predict(booster, .lgb_train_matrix(data), type = "raw")
+      gh <- obj(preds, data)
+      .Call(LGBTPU_R_BoosterUpdateOneIterCustom, booster$handle,
+            as.numeric(gh$grad), as.numeric(gh$hess))
+    }
+
+    eval_list <- list()
+    if (length(booster$valid_names) > 0L &&
+        (i %% max(eval_freq, 1L) == 0L || i == nrounds)) {
+      if (is.null(eval_names)) {
+        eval_names <- .lgb_split_names(
+          .Call(LGBTPU_R_BoosterGetEvalNames, booster$handle))
+      }
+      for (vi in seq_along(booster$valid_names)) {
+        vals <- .Call(LGBTPU_R_BoosterGetEval, booster$handle,
+                      as.integer(vi))
+        vn <- booster$valid_names[[vi]]
+        for (mi in seq_along(vals)) {
+          mn <- if (mi <= length(eval_names)) eval_names[[mi]] else
+            paste0("metric", mi)
+          eval_list[[paste(vn, mn, sep = "-")]] <- vals[[mi]]
+          if (record) {
+            booster$record_evals[[vn]][[mn]] <-
+              c(booster$record_evals[[vn]][[mn]], vals[[mi]])
+          }
+        }
+      }
+      if (verbose > 0L && length(eval_list) > 0L) {
+        cat(sprintf("[%d]\t%s\n", i,
+                    paste(sprintf("%s: %.6g", names(eval_list),
+                                  unlist(eval_list)),
+                          collapse = "\t")))
+      }
+      if (!is.null(early_stopping_rounds) && length(eval_list) > 0L) {
+        # first validation metric drives the stop, reference default;
+        # ABI metrics are uniformly reported lower-is-better except the
+        # known higher-better family
+        m1 <- names(eval_list)[[1L]]
+        v1 <- eval_list[[1L]]
+        higher <- grepl("auc|ndcg|map|average_precision", m1)
+        score <- if (higher) -v1 else v1
+        if (score < best_score) {
+          best_score <- score
+          best_raw <- v1
+          best_iter <- i
+          stale <- 0L
+        } else {
+          stale <- stale + 1L
+          if (stale >= early_stopping_rounds) {
+            if (verbose > 0L) {
+              cat(sprintf(
+                "early stopping at iteration %d (best %d)\n", i,
+                best_iter))
+            }
+            booster$best_iter <- best_iter
+            booster$best_score <- best_raw
+            break
+          }
+        }
+      }
+    }
+    for (cb in callbacks) {
+      cb(list(booster = booster, iteration = i, nrounds = nrounds,
+              eval_list = eval_list))
+    }
+  }
+  if (booster$best_iter < 0L && best_iter > 0L) {
+    booster$best_iter <- best_iter
+    booster$best_score <- best_raw
+  }
+  booster
+}
+
+# custom objectives need raw predictions on the training matrix; keep a
+# handle to it (only for obj != NULL, where free_raw_data must be FALSE)
+.lgb_train_matrix <- function(dataset) {
+  if (is.null(dataset$raw_data)) {
+    stop("custom objectives need the raw training data: create the ",
+         "Dataset with free_raw_data = FALSE")
+  }
+  dataset$raw_data
+}

@@ -1,0 +1,102 @@
+# lightgbm() — the one-call fitting interface (reference
+# R-package/R/lightgbm.R): wraps matrix + label into an lgb.Dataset,
+# picks a default objective from the label, trains via lgb.train.
+
+#' Train a model in one call
+#'
+#' @param data matrix / dgCMatrix of features, or an lgb.Dataset
+#' @param label response vector (ignored when data is an lgb.Dataset)
+#' @param weights optional observation weights
+#' @param params named list of parameters; objective defaults to
+#'   "regression", or "binary" for a 0/1 label
+#' @param nrounds boosting iterations
+#' @param verbose <= 0 silences output
+#' @param objective convenience override of params$objective
+#' @param init_score optional initial scores
+#' @param ... passed to lgb.train
+#' @export
+lightgbm <- function(data, label = NULL, weights = NULL,
+                     params = list(), nrounds = 100L, verbose = 1L,
+                     objective = NULL, init_score = NULL, ...) {
+  if (inherits(data, "lgb.Dataset")) {
+    dtrain <- data
+  } else {
+    if (is.null(label)) {
+      stop("lightgbm: label is required when data is not an lgb.Dataset")
+    }
+    if (is.null(objective) && is.null(params[["objective"]])) {
+      two_level <- length(unique(label)) == 2L &&
+        all(label %in% c(0, 1))
+      objective <- if (two_level) "binary" else "regression"
+    }
+    dtrain <- lgb.Dataset(data, params = list(), label = label,
+                          weight = weights, init_score = init_score)
+  }
+  if (!is.null(objective)) {
+    params[["objective"]] <- objective
+  }
+  bst <- lgb.train(params = params, data = dtrain, nrounds = nrounds,
+                   verbose = verbose, ...)
+  bst
+}
+
+#' Map factor/character columns to numeric codes with reusable rules
+#'
+#' @param data a data.frame
+#' @param rules optional rules list from a previous call (applied to new
+#'   data so train and test share the same coding)
+#' @return list(data = converted data.frame, rules = rules)
+#' @export
+lgb.convert_with_rules <- function(data, rules = NULL) {
+  stopifnot(is.data.frame(data))
+  out <- data
+  new_rules <- rules %||% list()
+  for (col in names(out)) {
+    v <- out[[col]]
+    if (is.factor(v) || is.character(v)) {
+      v <- as.character(v)
+      if (is.null(new_rules[[col]])) {
+        lv <- sort(unique(v[!is.na(v)]))
+        new_rules[[col]] <- stats::setNames(seq_along(lv), lv)
+      }
+      codes <- unname(new_rules[[col]][v])
+      out[[col]] <- as.numeric(codes)
+    } else if (is.logical(v)) {
+      out[[col]] <- as.numeric(v)
+    }
+  }
+  list(data = out, rules = new_rules)
+}
+
+# The XLA runtime schedules its own parallelism; these exist for drop-in
+# compatibility with scripts that tune the reference's OpenMP threads.
+
+#' Set the native thread budget (advisory under XLA)
+#' @param num_threads requested thread count
+#' @export
+setLGBMthreads <- function(num_threads) {
+  Sys.setenv(LIGHTGBM_TPU_NUM_THREADS = as.character(num_threads))
+  invisible(NULL)
+}
+
+#' Read the native thread budget
+#' @export
+getLGBMthreads <- function() {
+  v <- Sys.getenv("LIGHTGBM_TPU_NUM_THREADS", unset = "")
+  if (nzchar(v)) as.integer(v) else -1L
+}
+
+#' Pre-bind a fast single-row predict configuration
+#'
+#' A compatibility shim over the ABI's fast predict path
+#' (LGBMTPU_BoosterPredictForMatSingleRowFastInit); ordinary predict()
+#' on this framework already reuses its compiled predictor, so this
+#' simply validates arguments and returns the booster.
+#' @param model an lgb.Booster
+#' @param csr unused
+#' @param ... unused
+#' @export
+lgb.configure_fast_predict <- function(model, csr = FALSE, ...) {
+  stopifnot(inherits(model, "lgb.Booster"))
+  invisible(model)
+}

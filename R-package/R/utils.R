@@ -1,0 +1,94 @@
+# Internal helpers for the lightgbm.tpu R package.
+#
+# The LGBMTPU_* ABI takes parameters as a JSON object (native/capi.h),
+# not the reference's "key=value key2=value2" strings, so the package
+# carries its own tiny JSON writer instead of depending on jsonlite.
+
+.lgb_json_escape <- function(x) {
+  x <- gsub("\\\\", "\\\\\\\\", x)
+  x <- gsub("\"", "\\\\\"", x)
+  x <- gsub("\n", "\\\\n", x)
+  x
+}
+
+.lgb_json_scalar <- function(v) {
+  if (is.logical(v)) {
+    return(ifelse(v, "true", "false"))
+  }
+  if (is.numeric(v)) {
+    if (is.finite(v) && v == floor(v) && abs(v) < 2^53) {
+      return(sprintf("%.0f", v))
+    }
+    return(format(v, digits = 17, scientific = TRUE))
+  }
+  paste0("\"", .lgb_json_escape(as.character(v)), "\"")
+}
+
+.lgb_json_value <- function(v) {
+  if (length(v) == 1L && is.null(names(v))) {
+    return(.lgb_json_scalar(v))
+  }
+  paste0("[", paste(vapply(v, .lgb_json_scalar, character(1L)),
+                    collapse = ","), "]")
+}
+
+# named list -> one-line JSON object understood by the ABI
+.lgb_params_json <- function(params) {
+  if (is.null(params) || length(params) == 0L) {
+    return("{}")
+  }
+  stopifnot(!is.null(names(params)), all(nzchar(names(params))))
+  fields <- vapply(seq_along(params), function(i) {
+    paste0("\"", .lgb_json_escape(names(params)[[i]]), "\":",
+           .lgb_json_value(params[[i]]))
+  }, character(1L))
+  paste0("{", paste(fields, collapse = ","), "}")
+}
+
+# JSON array of strings (feature names etc.)
+.lgb_strings_json <- function(x) {
+  paste0("[", paste(vapply(x, function(s) {
+    paste0("\"", .lgb_json_escape(s), "\"")
+  }, character(1L)), collapse = ","), "]")
+}
+
+# Merge categorical_feature / colnames information into a params list the
+# way the reference resolves them before hitting the C API.
+.lgb_resolve_categorical <- function(params, categorical_feature,
+                                     colnames_) {
+  if (is.null(categorical_feature) || length(categorical_feature) == 0L) {
+    return(params)
+  }
+  if (is.character(categorical_feature)) {
+    if (is.null(colnames_)) {
+      stop("categorical_feature given by name but the data has no colnames")
+    }
+    idx <- match(categorical_feature, colnames_)
+    if (anyNA(idx)) {
+      stop("categorical_feature not found in colnames: ",
+           paste(categorical_feature[is.na(idx)], collapse = ", "))
+    }
+  } else {
+    idx <- as.integer(categorical_feature)
+  }
+  # ABI side is 0-based like the reference C API
+  params[["categorical_feature"]] <- as.integer(idx - 1L)
+  params
+}
+
+.lgb_check_handle <- function(x, what) {
+  if (!inherits(x, "externalptr")) {
+    stop(what, ": handle is not constructed (call lgb.Dataset.construct ",
+         "or train first)")
+  }
+  x
+}
+
+# split the newline-joined name buffers the ABI's string getters produce
+# (GetFeatureNames / GetEvalNames, mirroring c_api.h:826,845 semantics)
+.lgb_split_names <- function(s) {
+  if (is.null(s) || !nzchar(s)) {
+    return(character(0L))
+  }
+  strsplit(s, "\n", fixed = TRUE)[[1L]]
+}

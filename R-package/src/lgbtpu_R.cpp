@@ -1,0 +1,525 @@
+/* R glue for lightgbm_tpu — .Call wrappers over the LGBMTPU_* C ABI
+ * (native/capi.h).  The counterpart of the reference's
+ * R-package/src/lightgbm_R.cpp (which wraps LGBM_* the same way), but
+ * written against this repo's ABI conventions: opaque int64 handles,
+ * params as a JSON string, 0/-1 returns with LGBMTPU_GetLastError().
+ *
+ * Handle lifetime: every constructor wraps the int64 id in an R
+ * external pointer whose finalizer calls LGBMTPU_FreeHandle, so R's GC
+ * owns native resources (the reference reaches the same goal with
+ * R_RegisterCFinalizerEx on booster/dataset handles).
+ *
+ * String outputs use the ABI's two-call protocol: call with a guess
+ * buffer, re-call with the reported length when it didn't fit.
+ */
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include <R.h>
+#include <Rinternals.h>
+
+#include "../../lightgbm_tpu/native/capi.h"
+
+namespace {
+
+void check(int rc) {
+  if (rc != 0) {
+    Rf_error("lightgbm.tpu: %s", LGBMTPU_GetLastError());
+  }
+}
+
+int64_t handle_of(SEXP ptr) {
+  if (TYPEOF(ptr) != EXTPTRSXP) {
+    Rf_error("lightgbm.tpu: expected a handle (external pointer)");
+  }
+  void* p = R_ExternalPtrAddr(ptr);
+  if (p == nullptr) {
+    Rf_error("lightgbm.tpu: handle already freed");
+  }
+  // the id is stored in the pointer value itself (ids are small
+  // sequential integers, never 0 for a live handle)
+  return static_cast<int64_t>(reinterpret_cast<intptr_t>(p)) - 1;
+}
+
+void finalize_handle(SEXP ptr) {
+  void* p = R_ExternalPtrAddr(ptr);
+  if (p != nullptr) {
+    LGBMTPU_FreeHandle(static_cast<int64_t>(reinterpret_cast<intptr_t>(p)) - 1);
+    R_ClearExternalPtr(ptr);
+  }
+}
+
+SEXP wrap_handle(int64_t id) {
+  SEXP ptr = PROTECT(R_MakeExternalPtr(
+      reinterpret_cast<void*>(static_cast<intptr_t>(id + 1)),
+      R_NilValue, R_NilValue));
+  R_RegisterCFinalizerEx(ptr, finalize_handle, TRUE);
+  UNPROTECT(1);
+  return ptr;
+}
+
+const double* real_or_null(SEXP x) {
+  return (Rf_isNull(x) || XLENGTH(x) == 0) ? nullptr : REAL(x);
+}
+
+// two-call string fetch: fn(buffer, buffer_len, &out_len)
+template <typename F>
+SEXP fetch_string(F fn) {
+  int64_t need = 0;
+  std::vector<char> buf(1 << 16);
+  check(fn(buf.data(), static_cast<int64_t>(buf.size()), &need));
+  if (need > static_cast<int64_t>(buf.size())) {
+    buf.resize(static_cast<size_t>(need) + 1);
+    check(fn(buf.data(), static_cast<int64_t>(buf.size()), &need));
+  }
+  return Rf_mkString(buf.data());
+}
+
+}  // namespace
+
+extern "C" {
+
+SEXP LGBTPU_R_GetLastError() {
+  return Rf_mkString(LGBMTPU_GetLastError());
+}
+
+SEXP LGBTPU_R_HandleIsLive(SEXP ptr) {
+  // readRDS deserializes external pointers as live-looking EXTPTRSXPs
+  // with a NULL address; R-level is.null() cannot see that, so the R
+  // side asks here before trusting a stored handle
+  return Rf_ScalarLogical(TYPEOF(ptr) == EXTPTRSXP &&
+                          R_ExternalPtrAddr(ptr) != nullptr);
+}
+
+/* ---------------- Dataset ---------------- */
+
+SEXP LGBTPU_R_DatasetCreateFromMat(SEXP mat, SEXP nrow, SEXP ncol,
+                                   SEXP label, SEXP params_json) {
+  int64_t out = 0;
+  check(LGBMTPU_DatasetCreateFromMat(
+      REAL(mat), static_cast<int64_t>(Rf_asReal(nrow)),
+      static_cast<int64_t>(Rf_asReal(ncol)), real_or_null(label),
+      CHAR(STRING_ELT(params_json, 0)), &out));
+  return wrap_handle(out);
+}
+
+SEXP LGBTPU_R_DatasetCreateFromFile(SEXP path, SEXP params_json) {
+  int64_t out = 0;
+  check(LGBMTPU_DatasetCreateFromFile(CHAR(STRING_ELT(path, 0)),
+                                      CHAR(STRING_ELT(params_json, 0)),
+                                      &out));
+  return wrap_handle(out);
+}
+
+SEXP LGBTPU_R_DatasetCreateFromCSC(SEXP colptr, SEXP indices, SEXP data,
+                                   SEXP ncol, SEXP nnz, SEXP nrow,
+                                   SEXP label, SEXP params_json) {
+  int64_t out = 0;
+  check(LGBMTPU_DatasetCreateFromCSC(
+      INTEGER(colptr), INTEGER(indices), REAL(data),
+      static_cast<int64_t>(Rf_asReal(ncol)),
+      static_cast<int64_t>(Rf_asReal(nnz)),
+      static_cast<int64_t>(Rf_asReal(nrow)), real_or_null(label),
+      CHAR(STRING_ELT(params_json, 0)), &out));
+  return wrap_handle(out);
+}
+
+SEXP LGBTPU_R_DatasetCreateByReference(SEXP ref, SEXP num_total_row) {
+  int64_t out = 0;
+  check(LGBMTPU_DatasetCreateByReference(
+      handle_of(ref), static_cast<int64_t>(Rf_asReal(num_total_row)),
+      &out));
+  return wrap_handle(out);
+}
+
+SEXP LGBTPU_R_DatasetGetSubset(SEXP ds, SEXP idx, SEXP params_json) {
+  int64_t out = 0;
+  check(LGBMTPU_DatasetGetSubset(handle_of(ds), INTEGER(idx),
+                                 static_cast<int64_t>(XLENGTH(idx)),
+                                 CHAR(STRING_ELT(params_json, 0)), &out));
+  return wrap_handle(out);
+}
+
+SEXP LGBTPU_R_DatasetSetField(SEXP ds, SEXP field, SEXP vals) {
+  check(LGBMTPU_DatasetSetField(handle_of(ds), CHAR(STRING_ELT(field, 0)),
+                                real_or_null(vals),
+                                static_cast<int64_t>(XLENGTH(vals))));
+  return R_NilValue;
+}
+
+SEXP LGBTPU_R_DatasetGetField(SEXP ds, SEXP field) {
+  int64_t n = 0;
+  check(LGBMTPU_DatasetGetField(handle_of(ds), CHAR(STRING_ELT(field, 0)),
+                                nullptr, &n));
+  SEXP out = PROTECT(Rf_allocVector(REALSXP, static_cast<R_xlen_t>(n)));
+  if (n > 0) {
+    check(LGBMTPU_DatasetGetField(handle_of(ds),
+                                  CHAR(STRING_ELT(field, 0)), REAL(out),
+                                  &n));
+  }
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP LGBTPU_R_DatasetGetNumData(SEXP ds) {
+  int64_t out = 0;
+  check(LGBMTPU_DatasetGetNumData(handle_of(ds), &out));
+  return Rf_ScalarReal(static_cast<double>(out));
+}
+
+SEXP LGBTPU_R_DatasetGetNumFeature(SEXP ds) {
+  int64_t out = 0;
+  check(LGBMTPU_DatasetGetNumFeature(handle_of(ds), &out));
+  return Rf_ScalarReal(static_cast<double>(out));
+}
+
+SEXP LGBTPU_R_DatasetSaveBinary(SEXP ds, SEXP path) {
+  check(LGBMTPU_DatasetSaveBinary(handle_of(ds), CHAR(STRING_ELT(path, 0))));
+  return R_NilValue;
+}
+
+SEXP LGBTPU_R_DatasetDumpText(SEXP ds, SEXP path) {
+  check(LGBMTPU_DatasetDumpText(handle_of(ds), CHAR(STRING_ELT(path, 0))));
+  return R_NilValue;
+}
+
+SEXP LGBTPU_R_DatasetSetFeatureNames(SEXP ds, SEXP names_json) {
+  check(LGBMTPU_DatasetSetFeatureNames(handle_of(ds),
+                                       CHAR(STRING_ELT(names_json, 0))));
+  return R_NilValue;
+}
+
+SEXP LGBTPU_R_DatasetGetFeatureNames(SEXP ds) {
+  int64_t h = handle_of(ds);
+  return fetch_string([h](char* buf, int64_t len, int64_t* need) {
+    return LGBMTPU_DatasetGetFeatureNames(h, buf, len, need);
+  });
+}
+
+SEXP LGBTPU_R_DatasetUpdateParamChecking(SEXP old_json, SEXP new_json) {
+  check(LGBMTPU_DatasetUpdateParamChecking(CHAR(STRING_ELT(old_json, 0)),
+                                           CHAR(STRING_ELT(new_json, 0))));
+  return R_NilValue;
+}
+
+/* ---------------- Booster ---------------- */
+
+SEXP LGBTPU_R_BoosterCreate(SEXP train_ds, SEXP params_json) {
+  int64_t out = 0;
+  check(LGBMTPU_BoosterCreate(handle_of(train_ds),
+                              CHAR(STRING_ELT(params_json, 0)), &out));
+  return wrap_handle(out);
+}
+
+SEXP LGBTPU_R_BoosterCreateFromModelfile(SEXP path) {
+  int64_t out = 0;
+  check(LGBMTPU_BoosterCreateFromModelfile(CHAR(STRING_ELT(path, 0)),
+                                           &out));
+  return wrap_handle(out);
+}
+
+SEXP LGBTPU_R_BoosterLoadModelFromString(SEXP model_str) {
+  int64_t out = 0;
+  check(LGBMTPU_BoosterLoadModelFromString(CHAR(STRING_ELT(model_str, 0)),
+                                           &out));
+  return wrap_handle(out);
+}
+
+SEXP LGBTPU_R_BoosterAddValidData(SEXP bst, SEXP valid_ds) {
+  check(LGBMTPU_BoosterAddValidData(handle_of(bst), handle_of(valid_ds)));
+  return R_NilValue;
+}
+
+SEXP LGBTPU_R_BoosterResetTrainingData(SEXP bst, SEXP train_ds) {
+  check(LGBMTPU_BoosterResetTrainingData(handle_of(bst),
+                                         handle_of(train_ds)));
+  return R_NilValue;
+}
+
+SEXP LGBTPU_R_BoosterResetParameter(SEXP bst, SEXP params_json) {
+  check(LGBMTPU_BoosterResetParameter(handle_of(bst),
+                                      CHAR(STRING_ELT(params_json, 0))));
+  return R_NilValue;
+}
+
+SEXP LGBTPU_R_BoosterUpdateOneIter(SEXP bst) {
+  int is_finished = 0;
+  check(LGBMTPU_BoosterUpdateOneIter(handle_of(bst), &is_finished));
+  return Rf_ScalarLogical(is_finished);
+}
+
+SEXP LGBTPU_R_BoosterUpdateOneIterCustom(SEXP bst, SEXP grad, SEXP hess) {
+  int is_finished = 0;
+  R_xlen_t n = XLENGTH(grad);
+  std::vector<float> g(static_cast<size_t>(n)), h(static_cast<size_t>(n));
+  const double* gd = REAL(grad);
+  const double* hd = REAL(hess);
+  for (R_xlen_t i = 0; i < n; ++i) {
+    g[static_cast<size_t>(i)] = static_cast<float>(gd[i]);
+    h[static_cast<size_t>(i)] = static_cast<float>(hd[i]);
+  }
+  check(LGBMTPU_BoosterUpdateOneIterCustom(handle_of(bst), g.data(),
+                                           h.data(),
+                                           static_cast<int64_t>(n),
+                                           &is_finished));
+  return Rf_ScalarLogical(is_finished);
+}
+
+SEXP LGBTPU_R_BoosterMerge(SEXP bst, SEXP other) {
+  check(LGBMTPU_BoosterMerge(handle_of(bst), handle_of(other)));
+  return R_NilValue;
+}
+
+SEXP LGBTPU_R_BoosterRollbackOneIter(SEXP bst) {
+  check(LGBMTPU_BoosterRollbackOneIter(handle_of(bst)));
+  return R_NilValue;
+}
+
+SEXP LGBTPU_R_BoosterGetCurrentIteration(SEXP bst) {
+  int out = 0;
+  check(LGBMTPU_BoosterGetCurrentIteration(handle_of(bst), &out));
+  return Rf_ScalarInteger(out);
+}
+
+SEXP LGBTPU_R_BoosterGetNumClasses(SEXP bst) {
+  int out = 0;
+  check(LGBMTPU_BoosterNumClasses(handle_of(bst), &out));
+  return Rf_ScalarInteger(out);
+}
+
+SEXP LGBTPU_R_BoosterGetNumFeature(SEXP bst) {
+  int out = 0;
+  check(LGBMTPU_BoosterGetNumFeature(handle_of(bst), &out));
+  return Rf_ScalarInteger(out);
+}
+
+SEXP LGBTPU_R_BoosterNumTrees(SEXP bst) {
+  int out = 0;
+  check(LGBMTPU_BoosterNumTrees(handle_of(bst), &out));
+  return Rf_ScalarInteger(out);
+}
+
+SEXP LGBTPU_R_BoosterNumModelPerIteration(SEXP bst) {
+  int out = 0;
+  check(LGBMTPU_BoosterNumModelPerIteration(handle_of(bst), &out));
+  return Rf_ScalarInteger(out);
+}
+
+SEXP LGBTPU_R_BoosterGetFeatureNames(SEXP bst) {
+  int64_t h = handle_of(bst);
+  return fetch_string([h](char* buf, int64_t len, int64_t* need) {
+    return LGBMTPU_BoosterGetFeatureNames(h, buf, len, need);
+  });
+}
+
+SEXP LGBTPU_R_BoosterGetEvalNames(SEXP bst) {
+  int64_t h = handle_of(bst);
+  return fetch_string([h](char* buf, int64_t len, int64_t* need) {
+    return LGBMTPU_BoosterGetEvalNames(h, buf, len, need);
+  });
+}
+
+SEXP LGBTPU_R_BoosterGetEval(SEXP bst, SEXP data_idx) {
+  int n_metrics = 0;
+  check(LGBMTPU_BoosterGetEvalCounts(handle_of(bst), &n_metrics));
+  SEXP out = PROTECT(Rf_allocVector(REALSXP, n_metrics));
+  int64_t n = n_metrics;
+  if (n_metrics > 0) {
+    check(LGBMTPU_BoosterGetEval(handle_of(bst), Rf_asInteger(data_idx),
+                                 REAL(out), &n));
+  }
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP LGBTPU_R_BoosterPredictForMat(SEXP bst, SEXP mat, SEXP nrow,
+                                   SEXP ncol, SEXP predict_type,
+                                   SEXP start_iteration,
+                                   SEXP num_iteration) {
+  int64_t h = handle_of(bst);
+  int64_t nr = static_cast<int64_t>(Rf_asReal(nrow));
+  int64_t len = 0;
+  check(LGBMTPU_BoosterCalcNumPredict(h, nr, Rf_asInteger(predict_type),
+                                      Rf_asInteger(start_iteration),
+                                      Rf_asInteger(num_iteration), &len));
+  SEXP out = PROTECT(Rf_allocVector(REALSXP, static_cast<R_xlen_t>(len)));
+  check(LGBMTPU_BoosterPredictForMat2(
+      h, REAL(mat), nr, static_cast<int64_t>(Rf_asReal(ncol)),
+      Rf_asInteger(predict_type), Rf_asInteger(start_iteration),
+      Rf_asInteger(num_iteration), REAL(out), &len));
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP LGBTPU_R_BoosterPredictForCSC(SEXP bst, SEXP colptr, SEXP indices,
+                                   SEXP data, SEXP nrow,
+                                   SEXP predict_type,
+                                   SEXP start_iteration,
+                                   SEXP num_iteration) {
+  int64_t h = handle_of(bst);
+  int64_t nr = static_cast<int64_t>(Rf_asReal(nrow));
+  int64_t len = 0;
+  check(LGBMTPU_BoosterCalcNumPredict(h, nr, Rf_asInteger(predict_type),
+                                      Rf_asInteger(start_iteration),
+                                      Rf_asInteger(num_iteration), &len));
+  SEXP out = PROTECT(Rf_allocVector(REALSXP, static_cast<R_xlen_t>(len)));
+  check(LGBMTPU_BoosterPredictForCSC(
+      h, INTEGER(colptr), INTEGER(indices), REAL(data),
+      static_cast<int64_t>(XLENGTH(colptr)),
+      static_cast<int64_t>(XLENGTH(data)), nr,
+      Rf_asInteger(predict_type), Rf_asInteger(start_iteration),
+      Rf_asInteger(num_iteration), REAL(out), &len));
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP LGBTPU_R_BoosterPredictForFile(SEXP bst, SEXP data_path,
+                                    SEXP has_header, SEXP predict_type,
+                                    SEXP start_iteration,
+                                    SEXP num_iteration, SEXP result_path) {
+  check(LGBMTPU_BoosterPredictForFile(
+      handle_of(bst), CHAR(STRING_ELT(data_path, 0)),
+      Rf_asLogical(has_header), Rf_asInteger(predict_type),
+      Rf_asInteger(start_iteration), Rf_asInteger(num_iteration),
+      CHAR(STRING_ELT(result_path, 0))));
+  return R_NilValue;
+}
+
+SEXP LGBTPU_R_BoosterSaveModel(SEXP bst, SEXP path) {
+  check(LGBMTPU_BoosterSaveModel(handle_of(bst),
+                                 CHAR(STRING_ELT(path, 0))));
+  return R_NilValue;
+}
+
+SEXP LGBTPU_R_BoosterSaveModelToString(SEXP bst) {
+  int64_t h = handle_of(bst);
+  // out_len is IN/OUT here (capacity in, required length out —
+  // capi.cpp:368), unlike the (buffer, buffer_len, out_len) getters
+  // fetch_string serves
+  std::vector<char> buf(1 << 20);
+  int64_t need = static_cast<int64_t>(buf.size());
+  check(LGBMTPU_BoosterSaveModelToString(h, buf.data(), &need));
+  if (need > static_cast<int64_t>(buf.size())) {
+    buf.resize(static_cast<size_t>(need) + 1);
+    need = static_cast<int64_t>(buf.size());
+    check(LGBMTPU_BoosterSaveModelToString(h, buf.data(), &need));
+  }
+  return Rf_mkString(buf.data());
+}
+
+SEXP LGBTPU_R_BoosterDumpModel(SEXP bst, SEXP num_iteration) {
+  int64_t h = handle_of(bst);
+  int ni = Rf_asInteger(num_iteration);
+  return fetch_string([h, ni](char* buf, int64_t len, int64_t* need) {
+    return LGBMTPU_BoosterDumpModel(h, ni, buf, len, need);
+  });
+}
+
+SEXP LGBTPU_R_BoosterFeatureImportance(SEXP bst, SEXP importance_type) {
+  int64_t h = handle_of(bst);
+  int nf = 0;
+  check(LGBMTPU_BoosterGetNumFeature(h, &nf));
+  SEXP out = PROTECT(Rf_allocVector(REALSXP, nf));
+  int64_t n = nf;
+  check(LGBMTPU_BoosterFeatureImportance(h, Rf_asInteger(importance_type),
+                                         REAL(out), &n));
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP LGBTPU_R_BoosterGetLeafValue(SEXP bst, SEXP tree_idx, SEXP leaf_idx) {
+  double out = 0.0;
+  check(LGBMTPU_BoosterGetLeafValue(handle_of(bst), Rf_asInteger(tree_idx),
+                                    Rf_asInteger(leaf_idx), &out));
+  return Rf_ScalarReal(out);
+}
+
+SEXP LGBTPU_R_BoosterSetLeafValue(SEXP bst, SEXP tree_idx, SEXP leaf_idx,
+                                  SEXP value) {
+  check(LGBMTPU_BoosterSetLeafValue(handle_of(bst), Rf_asInteger(tree_idx),
+                                    Rf_asInteger(leaf_idx),
+                                    Rf_asReal(value)));
+  return R_NilValue;
+}
+
+SEXP LGBTPU_R_BoosterGetLowerBoundValue(SEXP bst) {
+  double out = 0.0;
+  check(LGBMTPU_BoosterGetLowerBoundValue(handle_of(bst), &out));
+  return Rf_ScalarReal(out);
+}
+
+SEXP LGBTPU_R_BoosterGetUpperBoundValue(SEXP bst) {
+  double out = 0.0;
+  check(LGBMTPU_BoosterGetUpperBoundValue(handle_of(bst), &out));
+  return Rf_ScalarReal(out);
+}
+
+SEXP LGBTPU_R_BoosterGetLoadedParam(SEXP bst) {
+  int64_t h = handle_of(bst);
+  return fetch_string([h](char* buf, int64_t len, int64_t* need) {
+    return LGBMTPU_BoosterGetLoadedParam(h, buf, len, need);
+  });
+}
+
+/* ---------------- registration ---------------- */
+
+#define CALLDEF(name, n) {#name, (DL_FUNC)&name, n}
+
+static const R_CallMethodDef kCallMethods[] = {
+    CALLDEF(LGBTPU_R_GetLastError, 0),
+    CALLDEF(LGBTPU_R_HandleIsLive, 1),
+    CALLDEF(LGBTPU_R_DatasetCreateFromMat, 5),
+    CALLDEF(LGBTPU_R_DatasetCreateFromFile, 2),
+    CALLDEF(LGBTPU_R_DatasetCreateFromCSC, 8),
+    CALLDEF(LGBTPU_R_DatasetCreateByReference, 2),
+    CALLDEF(LGBTPU_R_DatasetGetSubset, 3),
+    CALLDEF(LGBTPU_R_DatasetSetField, 3),
+    CALLDEF(LGBTPU_R_DatasetGetField, 2),
+    CALLDEF(LGBTPU_R_DatasetGetNumData, 1),
+    CALLDEF(LGBTPU_R_DatasetGetNumFeature, 1),
+    CALLDEF(LGBTPU_R_DatasetSaveBinary, 2),
+    CALLDEF(LGBTPU_R_DatasetDumpText, 2),
+    CALLDEF(LGBTPU_R_DatasetSetFeatureNames, 2),
+    CALLDEF(LGBTPU_R_DatasetGetFeatureNames, 1),
+    CALLDEF(LGBTPU_R_DatasetUpdateParamChecking, 2),
+    CALLDEF(LGBTPU_R_BoosterCreate, 2),
+    CALLDEF(LGBTPU_R_BoosterCreateFromModelfile, 1),
+    CALLDEF(LGBTPU_R_BoosterLoadModelFromString, 1),
+    CALLDEF(LGBTPU_R_BoosterAddValidData, 2),
+    CALLDEF(LGBTPU_R_BoosterResetTrainingData, 2),
+    CALLDEF(LGBTPU_R_BoosterResetParameter, 2),
+    CALLDEF(LGBTPU_R_BoosterUpdateOneIter, 1),
+    CALLDEF(LGBTPU_R_BoosterUpdateOneIterCustom, 3),
+    CALLDEF(LGBTPU_R_BoosterMerge, 2),
+    CALLDEF(LGBTPU_R_BoosterRollbackOneIter, 1),
+    CALLDEF(LGBTPU_R_BoosterGetCurrentIteration, 1),
+    CALLDEF(LGBTPU_R_BoosterGetNumClasses, 1),
+    CALLDEF(LGBTPU_R_BoosterGetNumFeature, 1),
+    CALLDEF(LGBTPU_R_BoosterNumTrees, 1),
+    CALLDEF(LGBTPU_R_BoosterNumModelPerIteration, 1),
+    CALLDEF(LGBTPU_R_BoosterGetFeatureNames, 1),
+    CALLDEF(LGBTPU_R_BoosterGetEvalNames, 1),
+    CALLDEF(LGBTPU_R_BoosterGetEval, 2),
+    CALLDEF(LGBTPU_R_BoosterPredictForMat, 7),
+    CALLDEF(LGBTPU_R_BoosterPredictForCSC, 8),
+    CALLDEF(LGBTPU_R_BoosterPredictForFile, 7),
+    CALLDEF(LGBTPU_R_BoosterSaveModel, 2),
+    CALLDEF(LGBTPU_R_BoosterSaveModelToString, 1),
+    CALLDEF(LGBTPU_R_BoosterDumpModel, 2),
+    CALLDEF(LGBTPU_R_BoosterFeatureImportance, 2),
+    CALLDEF(LGBTPU_R_BoosterGetLeafValue, 3),
+    CALLDEF(LGBTPU_R_BoosterSetLeafValue, 4),
+    CALLDEF(LGBTPU_R_BoosterGetLowerBoundValue, 1),
+    CALLDEF(LGBTPU_R_BoosterGetUpperBoundValue, 1),
+    CALLDEF(LGBTPU_R_BoosterGetLoadedParam, 1),
+    {NULL, NULL, 0}};
+
+void R_init_lightgbm_tpu(DllInfo* dll) {
+  R_registerRoutines(dll, NULL, kCallMethods, NULL, NULL);
+  R_useDynamicSymbols(dll, FALSE);
+}
+
+}  // extern "C"

@@ -1,0 +1,107 @@
+# testthat suite for lightgbm.tpu — runnable wherever R + the built
+# package exist (the repo CI image has no R; tests/test_r_package.py is
+# the in-repo gate).
+
+library(testthat)
+library(lightgbm.tpu)
+
+make_data <- function(n = 500L, f = 6L, seed = 7L) {
+  set.seed(seed)
+  X <- matrix(rnorm(n * f), ncol = f)
+  colnames(X) <- paste0("feat", seq_len(f))
+  y <- as.numeric(X[, 1L] + 0.5 * X[, 2L] + rnorm(n, sd = 0.1) > 0)
+  list(X = X, y = y)
+}
+
+test_that("Dataset constructs from a matrix and reports dims", {
+  d <- make_data()
+  ds <- lgb.Dataset(d$X, label = d$y)
+  lgb.Dataset.construct(ds)
+  expect_equal(dim(ds), c(500L, 6L))
+  expect_equal(length(get_field(ds, "label")), 500L)
+})
+
+test_that("train -> predict improves over chance and respects types", {
+  d <- make_data()
+  ds <- lgb.Dataset(d$X, label = d$y)
+  bst <- lgb.train(list(objective = "binary", num_leaves = 15L),
+                   ds, nrounds = 30L, verbose = 0L)
+  p <- predict(bst, d$X)
+  expect_true(all(p >= 0 & p <= 1))
+  acc <- mean((p > 0.5) == (d$y > 0.5))
+  expect_gt(acc, 0.9)
+  raw <- predict(bst, d$X, type = "raw")
+  expect_equal(1 / (1 + exp(-raw)), p, tolerance = 1e-5)
+  leaves <- predict(bst, d$X, type = "leaf")
+  expect_true(all(leaves == floor(leaves)))
+  contrib <- predict(bst, d$X, type = "contrib")
+  expect_equal(ncol(contrib), ncol(d$X) + 1L)
+  expect_equal(rowSums(contrib), raw, tolerance = 1e-4)
+})
+
+test_that("save/load round-trips predictions", {
+  d <- make_data()
+  ds <- lgb.Dataset(d$X, label = d$y)
+  bst <- lgb.train(list(objective = "regression"), ds, nrounds = 10L,
+                   verbose = 0L)
+  f <- tempfile(fileext = ".txt")
+  lgb.save(bst, f)
+  bst2 <- lgb.load(f)
+  expect_equal(predict(bst2, d$X), predict(bst, d$X), tolerance = 1e-9)
+  unlink(f)
+})
+
+test_that("early stopping sets best_iter", {
+  d <- make_data(1000L)
+  tr <- seq_len(700L)
+  ds <- lgb.Dataset(d$X[tr, ], label = d$y[tr])
+  dv <- lgb.Dataset.create.valid(ds, d$X[-tr, ], label = d$y[-tr])
+  bst <- lgb.train(list(objective = "binary", learning_rate = 0.3),
+                   ds, nrounds = 200L,
+                   valids = list(va = dv),
+                   early_stopping_rounds = 5L, verbose = 0L)
+  expect_gt(bst$best_iter, 0L)
+  expect_true(length(lgb.get.eval.result(bst, "va",
+    names(bst$record_evals$va)[[1L]])) > 0L)
+})
+
+test_that("cv aggregates fold metrics", {
+  d <- make_data()
+  ds <- lgb.Dataset(d$X, label = d$y)
+  cv <- lgb.cv(list(objective = "binary", metric = "binary_logloss"),
+               ds, nrounds = 20L, nfold = 3L, verbose = 0L)
+  expect_equal(length(cv$boosters), 3L)
+  expect_gt(cv$best_iter, 0L)
+  m1 <- names(cv$record_evals)[[1L]]
+  expect_equal(length(cv$record_evals[[m1]]$mean), 20L)
+})
+
+test_that("importance and tree table are well-formed", {
+  d <- make_data()
+  ds <- lgb.Dataset(d$X, label = d$y)
+  bst <- lgb.train(list(objective = "binary"), ds, nrounds = 5L,
+                   verbose = 0L)
+  imp <- lgb.importance(bst)
+  expect_true(all(c("Feature", "Gain", "Cover", "Frequency")
+                  %in% names(imp)))
+  expect_equal(sum(imp$Gain), 1, tolerance = 1e-6)
+  tt <- lgb.model.dt.tree(bst)
+  expect_true(all(c("tree_index", "split_feature", "leaf_value")
+                  %in% names(tt)))
+  expect_true(any(!is.na(tt$leaf_value)))
+})
+
+test_that("serialization keep-alive survives saveRDS", {
+  d <- make_data()
+  ds <- lgb.Dataset(d$X, label = d$y)
+  bst <- lgb.train(list(objective = "regression"), ds, nrounds = 5L,
+                   verbose = 0L)
+  lgb.make_serializable(bst)
+  f <- tempfile(fileext = ".rds")
+  saveRDS(bst, f)
+  bst2 <- readRDS(f)
+  bst2$handle <- NULL   # simulate a fresh session
+  lgb.restore_handle(bst2)
+  expect_equal(predict(bst2, d$X), predict(bst, d$X), tolerance = 1e-9)
+  unlink(f)
+})

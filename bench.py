@@ -70,7 +70,17 @@ def record_cache(payload, mode="kernel", path=CACHE_PATH):
     on-chip number (this bench, tools/sweep_perf.py) so a later wedged
     tunnel can still report the round's best evidence.  The cache is keyed
     by bench mode ("kernel" / "e2e" / "sweep") so an e2e fallback prefers
-    an e2e number over a kernel-sweep one."""
+    an e2e number over a kernel-sweep one.
+
+    Experiment runs with non-default knobs (BENCH_SPLIT_BATCH etc.) are
+    NOT persisted: the fallback must reflect the configuration the driver
+    will actually run, not whatever A/B sweep happened last (a K=84
+    sweep once overwrote the cache with a 25%-slower number)."""
+    overrides = [k for k in os.environ
+                 if k.startswith("BENCH_") and k not in
+                 ("BENCH_CHILD", "BENCH_E2E", "BENCH_ATTEMPTS")]
+    if overrides and mode != "sweep":
+        return
     try:
         with open(path) as f:
             cache = json.load(f)

@@ -89,6 +89,12 @@ def build_capi() -> str:
     return _CAPI_LIB
 
 
+def lib_dir() -> str:
+    """Directory holding the built C ABI library — the link target for
+    non-Python consumers (R-package/src/Makevars, SWIG builds)."""
+    return os.path.dirname(build_capi())
+
+
 def parse_text(path: str, sep: str = ",", skip_header: int = 0) -> np.ndarray:
     """Parse a delimited numeric file natively -> f64 [rows, cols]."""
     lib = _load()

@@ -1,0 +1,57 @@
+/* Stub of R's C API — TEST SCAFFOLDING ONLY (tests/test_r_package.py).
+ *
+ * The CI image has no R installation, so the R glue
+ * (R-package/src/lgbtpu_R.cpp) cannot be really compiled or run here.
+ * This header declares just enough of the R API, with correct-shaped
+ * types, for `g++ -fsyntax-only` to type-check the glue: wrong argument
+ * counts, bad casts and misspelled R entry points fail the gate.  A real
+ * installation compiles against R's own headers via src/Makevars.
+ */
+#ifndef R_STUB_R_H_
+#define R_STUB_R_H_
+
+#include <cstddef>
+#include <cstdarg>
+
+extern "C" {
+
+typedef struct SEXPREC* SEXP;
+typedef ptrdiff_t R_xlen_t;
+typedef enum { FALSE = 0, TRUE } Rboolean;
+
+#define EXTPTRSXP 22
+#define REALSXP 14
+
+extern SEXP R_NilValue;
+
+int TYPEOF(SEXP x);
+void* R_ExternalPtrAddr(SEXP x);
+void R_ClearExternalPtr(SEXP x);
+SEXP R_MakeExternalPtr(void* p, SEXP tag, SEXP prot);
+typedef void (*R_CFinalizer_t)(SEXP);
+void R_RegisterCFinalizerEx(SEXP x, R_CFinalizer_t fn, Rboolean onexit);
+
+SEXP Rf_protect(SEXP x);
+void Rf_unprotect(int n);
+#define PROTECT(x) Rf_protect(x)
+#define UNPROTECT(n) Rf_unprotect(n)
+
+[[noreturn]] void Rf_error(const char* fmt, ...);
+SEXP Rf_mkString(const char* s);
+SEXP Rf_ScalarReal(double v);
+SEXP Rf_ScalarInteger(int v);
+SEXP Rf_ScalarLogical(int v);
+SEXP Rf_allocVector(unsigned int type, R_xlen_t n);
+double* REAL(SEXP x);
+int* INTEGER(SEXP x);
+R_xlen_t XLENGTH(SEXP x);
+double Rf_asReal(SEXP x);
+int Rf_asInteger(SEXP x);
+int Rf_asLogical(SEXP x);
+int Rf_isNull(SEXP x);
+SEXP STRING_ELT(SEXP x, R_xlen_t i);
+const char* CHAR(SEXP x);
+
+}  // extern "C"
+
+#endif  // R_STUB_R_H_

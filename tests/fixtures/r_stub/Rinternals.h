@@ -1,0 +1,35 @@
+/* Stub of Rinternals.h — TEST SCAFFOLDING ONLY; see R.h in this
+ * directory.  Adds the dynamic-registration types the glue's
+ * R_init_lightgbm_tpu uses. */
+#ifndef R_STUB_RINTERNALS_H_
+#define R_STUB_RINTERNALS_H_
+
+#include "R.h"
+
+extern "C" {
+
+typedef void* (*DL_FUNC)();
+
+typedef struct {
+  const char* name;
+  DL_FUNC fun;
+  int numArgs;
+} R_CallMethodDef;
+
+typedef struct _DllInfo DllInfo;
+
+typedef struct {
+  const char* name;
+  DL_FUNC fun;
+  int numArgs;
+} R_CMethodDef;
+
+int R_registerRoutines(DllInfo* info, const R_CMethodDef* croutines,
+                       const R_CallMethodDef* callRoutines,
+                       const void* fortranRoutines,
+                       const void* externalRoutines);
+int R_useDynamicSymbols(DllInfo* info, Rboolean value);
+
+}  // extern "C"
+
+#endif  // R_STUB_RINTERNALS_H_

@@ -1,0 +1,155 @@
+"""Gates for the R package (R-package/) in an R-less CI image.
+
+The reference validates its R package with a real R testthat suite
+(R-package/tests/); this image ships no R toolchain, so these tests pin
+everything checkable without one:
+
+1. the C glue type-checks against stub R headers
+   (tests/fixtures/r_stub/) — wrong arities, bad casts and misspelled R
+   API entry points fail;
+2. the glue's .Call registration table is consistent (every definition
+   registered, with the right argument count);
+3. every native `LGBMTPU_*` symbol the glue links is a real ABI entry
+   in native/capi.h;
+4. every `.Call(LGBTPU_R_*)` target in the R sources exists in the glue;
+5. the R sources are structurally sound (balanced delimiters outside
+   strings/comments) and every NAMESPACE export has a definition.
+
+The real behavioural suite is R-package/tests/testthat/, runnable
+wherever R + the built package exist.
+"""
+
+import os
+import re
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RPKG = os.path.join(REPO, "R-package")
+GLUE = os.path.join(RPKG, "src", "lgbtpu_R.cpp")
+STUB = os.path.join(REPO, "tests", "fixtures", "r_stub")
+
+
+def _read(path):
+    with open(path) as f:
+        return f.read()
+
+
+def test_glue_compiles_against_stub_headers():
+    res = subprocess.run(
+        ["g++", "-fsyntax-only", "-std=c++14", "-Wall", "-Werror",
+         f"-I{STUB}", GLUE],
+        capture_output=True, text=True, timeout=120)
+    assert res.returncode == 0, res.stderr
+
+
+def _glue_definitions():
+    """(name -> n_args) for every SEXP LGBTPU_R_*(...) definition."""
+    src = _read(GLUE)
+    defs = {}
+    for m in re.finditer(r"SEXP\s+(LGBTPU_R_\w+)\s*\(([^)]*)\)\s*\{",
+                         src):
+        args = [a for a in m.group(2).split(",") if a.strip()]
+        assert all("SEXP" in a for a in args), \
+            f"{m.group(1)}: .Call entry points take only SEXP args"
+        defs[m.group(1)] = len(args)
+    return defs
+
+
+def _glue_registrations():
+    src = _read(GLUE)
+    return {m.group(1): int(m.group(2))
+            for m in re.finditer(r"CALLDEF\((LGBTPU_R_\w+),\s*(\d+)\)",
+                                 src)}
+
+
+def test_registration_table_matches_definitions():
+    defs = _glue_definitions()
+    regs = _glue_registrations()
+    assert set(defs) == set(regs), (
+        f"unregistered: {set(defs) - set(regs)}; "
+        f"registered-but-undefined: {set(regs) - set(defs)}")
+    for name, n in defs.items():
+        assert regs[name] == n, \
+            f"{name}: defined with {n} args, registered with {regs[name]}"
+
+
+def test_native_calls_exist_in_abi_header():
+    header = _read(os.path.join(REPO, "lightgbm_tpu", "native",
+                                "capi.h"))
+    abi = set(re.findall(r"(LGBMTPU_\w+)\s*\(", header))
+    used = set(re.findall(r"(LGBMTPU_\w+)\s*\(", _read(GLUE)))
+    missing = used - abi
+    assert not missing, f"glue calls unknown ABI entries: {missing}"
+
+
+def _r_sources():
+    rdir = os.path.join(RPKG, "R")
+    return sorted(os.path.join(rdir, f) for f in os.listdir(rdir)
+                  if f.endswith(".R"))
+
+
+def _strip_r(code):
+    """Remove strings and comments so delimiter counting is honest."""
+    out = []
+    i, n = 0, len(code)
+    while i < n:
+        c = code[i]
+        if c in "\"'":
+            q = c
+            i += 1
+            while i < n and code[i] != q:
+                i += 2 if code[i] == "\\" else 1
+            i += 1
+        elif c == "#":
+            while i < n and code[i] != "\n":
+                i += 1
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+@pytest.mark.parametrize("path", _r_sources(),
+                         ids=lambda p: os.path.basename(p))
+def test_r_source_is_balanced(path):
+    code = _strip_r(_read(path))
+    for open_c, close_c in ("()", "[]", "{}"):
+        assert code.count(open_c) == code.count(close_c), (
+            f"{os.path.basename(path)}: unbalanced "
+            f"{open_c}{close_c}: {code.count(open_c)} vs "
+            f"{code.count(close_c)}")
+
+
+def test_r_dotcall_targets_exist():
+    regs = set(_glue_registrations())
+    for path in _r_sources():
+        code = _strip_r(_read(path))
+        for target in re.findall(r"\.Call\(\s*(\w+)", code):
+            assert target in regs, (
+                f"{os.path.basename(path)} calls {target}, not in the "
+                f"glue registration table")
+
+
+def test_namespace_exports_are_defined():
+    ns = _read(os.path.join(RPKG, "NAMESPACE"))
+    exports = re.findall(r"^export\(([^)]+)\)", ns, re.M)
+    all_code = "\n".join(_read(p) for p in _r_sources())
+    for name in exports:
+        pat = re.escape(name) + r"\s*<-\s*function"
+        assert re.search(pat, all_code), f"export {name} has no definition"
+    # S3 methods declared in NAMESPACE exist too
+    for generic, cls in re.findall(r"^S3method\((\w+),\s*([\w.]+)\)", ns,
+                                   re.M):
+        pat = re.escape(f"{generic}.{cls}") + r"\s*<-\s*function"
+        assert re.search(pat, all_code), \
+            f"S3method {generic}.{cls} has no definition"
+
+
+def test_description_and_makevars_present():
+    desc = _read(os.path.join(RPKG, "DESCRIPTION"))
+    assert "Package: lightgbm.tpu" in desc
+    assert "NeedsCompilation: yes" in desc
+    mk = _read(os.path.join(RPKG, "src", "Makevars"))
+    assert "-llgbtpu_capi" in mk
