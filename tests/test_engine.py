@@ -1458,3 +1458,119 @@ def test_fused_large_seed_no_overflow():
     b_loop = lgb.train(p, lgb.Dataset(X, label=y, params=p),
                        num_boost_round=4, callbacks=[noop])
     assert b_fused.model_to_string() == b_loop.model_to_string()
+
+
+# ---------------------------------------------------------------------------
+# combined-mode stress cells: features that each work alone must also
+# compose (reference test_engine.py exercises these pairings across its
+# grid; the failure mode is silent interaction bugs, e.g. a sampling
+# mask not reaching the quantized-histogram path)
+
+@pytest.mark.parametrize("boosting", ["gbdt", "dart"])
+def test_weights_categorical_quantized_compose(boosting):
+    """weights x categorical x quantized-gradients x {gbdt, dart} in one
+    run, with metric floor + save/load equivalence (the widest single
+    cell in the composition grid)."""
+    rng = np.random.default_rng(11)
+    n = 2000
+    Xn = rng.normal(size=(n, 4)).astype(np.float32)
+    Xc = rng.integers(0, 12, size=(n, 2)).astype(np.float32)
+    X = np.concatenate([Xn, Xc], axis=1)
+    logits = Xn[:, 0] + 0.8 * (Xc[:, 0] % 3 == 1) - 0.6 * (Xc[:, 1] > 7)
+    y = (logits + rng.normal(scale=0.4, size=n) > 0).astype(np.float32)
+    w = np.where(y > 0, 2.0, 1.0)
+    params = {**FAST, "objective": "binary", "boosting": boosting,
+              "categorical_feature": [4, 5],
+              "use_quantized_grad": True, "num_grad_quant_bins": 16}
+    if boosting == "dart":
+        params["drop_rate"] = 0.2
+    ds = lgb.Dataset(X, label=y, weight=w, params=params)
+    bst = lgb.train(params, ds, num_boost_round=40)
+    p = bst.predict(X)
+    assert _auc(y, p) > 0.85
+    s = bst.model_to_string()
+    p2 = lgb.Booster(model_str=s).predict(X)
+    np.testing.assert_allclose(p2, p, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("objective", ["multiclass", "regression"])
+def test_init_score_nonbinary(objective):
+    """init_score offsets the boosting start for multiclass (per-class
+    column layout, reference Metadata::Init init_score n*k) and
+    regression, not just binary (test_init_score_training above)."""
+    rng = np.random.default_rng(5)
+    n = 1200
+    X = rng.normal(size=(n, 5)).astype(np.float32)
+    if objective == "multiclass":
+        y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(int) + \
+            (X[:, 2] > 0.5).astype(int)
+        params = {**FAST, "objective": "multiclass", "num_class": 3,
+                  "metric": ["multi_logloss"]}
+        # a deliberately WRONG init pushes everything toward class 0;
+        # training must still recover (gradients see the offset).
+        # Flatten CLASS-MAJOR (order="F"): the engine un-flattens n*k
+        # init_score as reshape(-1, k, order="F"), the reference's
+        # init_score[class * num_data + row] layout — a C-order flatten
+        # here would stripe the bias across classes and cancel under
+        # softmax
+        init = np.zeros((n, 3), np.float64)
+        init[:, 0] = 2.0
+        ds = lgb.Dataset(X, label=y,
+                         init_score=init.reshape(-1, order="F"),
+                         params=params)
+        bst = lgb.train(params, ds, num_boost_round=40)
+        p = bst.predict(X)
+        acc = float(np.mean(np.argmax(p, axis=1) == y))
+        assert acc > 0.8
+    else:
+        y = (X[:, 0] * 2.0 + X[:, 1]).astype(np.float32) + 10.0
+        params = {**FAST, "objective": "regression"}
+        init = np.full(n, 10.0)
+        ds = lgb.Dataset(X, label=y, init_score=init, params=params)
+        bst = lgb.train(params, ds, num_boost_round=25)
+        # like the reference, predict() does NOT include the
+        # user-supplied init_score — the model learned the RESIDUAL
+        # (y - 10); the caller re-adds the offset
+        mse = float(np.mean((bst.predict(X) + 10.0 - y) ** 2))
+        assert mse < 0.3 * float(np.var(y))
+
+
+def test_goss_weights_saveload_equivalence():
+    """GOSS's amplified small-gradient rows compose with user weights,
+    and the trained model round-trips (reference GOSS strategy applies
+    on TOP of metadata weights, sample_strategy.cpp)."""
+    rng = np.random.default_rng(17)
+    n = 3000
+    X = rng.normal(size=(n, 6)).astype(np.float32)
+    y = (X[:, 0] - X[:, 1] + rng.normal(scale=0.3, size=n) > 0
+         ).astype(np.float32)
+    w = rng.uniform(0.5, 2.0, size=n)
+    params = {**FAST, "objective": "binary", "boosting": "goss",
+              "top_rate": 0.3, "other_rate": 0.2}
+    ds = lgb.Dataset(X, label=y, weight=w, params=params)
+    bst = lgb.train(params, ds, num_boost_round=30)
+    p = bst.predict(X)
+    assert _auc(y, p) > 0.9
+    p2 = lgb.Booster(model_str=bst.model_to_string()).predict(X)
+    np.testing.assert_allclose(p2, p, rtol=1e-5, atol=1e-6)
+
+
+def test_efb_quantized_compose():
+    """EFB-bundled sparse exclusives train under quantized gradients:
+    the bundle expansion tables and the integer histogram path must
+    agree on bin offsets (dataset.cpp:246 bundling x quantized
+    histograms — distinct subsystems in the reference too)."""
+    rng = np.random.default_rng(23)
+    n = 2500
+    dense = rng.normal(size=(n, 3)).astype(np.float32)
+    # 9 mutually-exclusive indicator columns -> EFB bundles them
+    which = rng.integers(0, 9, size=n)
+    sparse = np.zeros((n, 9), np.float32)
+    sparse[np.arange(n), which] = 1.0
+    X = np.concatenate([dense, sparse], axis=1)
+    y = (dense[:, 0] + 0.7 * (which % 3 == 0) > 0.3).astype(np.float32)
+    params = {**FAST, "objective": "binary", "enable_bundle": True,
+              "use_quantized_grad": True}
+    ds = lgb.Dataset(X, label=y, params=params)
+    bst = lgb.train(params, ds, num_boost_round=30)
+    assert _auc(y, bst.predict(X)) > 0.9
