@@ -1051,12 +1051,17 @@ class GBDT:
                     self.nan_bin_arr, self.is_cat_arr, feature_mask, self.hp)
             if self._use_batched_grower():
                 from ..learner.batch_grower import grow_tree_batched
-                return grow_tree_batched(
+                out = grow_tree_batched(
                     *args, batch=int(self.config.tpu_split_batch),
                     bundle=self.bundle, monotone=self.monotone_arr,
                     hist_scale=hist_scale,
                     interaction_sets=self.interaction_sets,
-                    rng_key=node_key, forced=self.forced_splits)
+                    rng_key=node_key, forced=self.forced_splits,
+                    cegb=self.cegb)
+                if self.cegb is not None:
+                    arrays, lor, self.cegb = out
+                    return arrays, lor
+                return out
             kwargs = dict(monotone=self.monotone_arr, rng_key=node_key,
                           interaction_sets=self.interaction_sets,
                           forced=self.forced_splits, bundle=self.bundle,
@@ -1113,21 +1118,24 @@ class GBDT:
         pool_active = 0 < self.hp.hist_pool_slots < self.hp.num_leaves
         if int(self.config.tpu_split_batch) <= 1 and not pool_active:
             return False
-        # categorical splits, basic/intermediate monotone, interaction
-        # constraints and path smoothing are batched-capable
-        # (learner/batch_grower.py); the rest still needs the strict learner
-        mono_strict = self.hp.use_monotone \
-            and self.hp.monotone_method == "advanced"
+        # categorical splits, all three monotone methods, interaction
+        # constraints, path smoothing and CEGB are batched-capable
+        # (learner/batch_grower.py); linear trees still need the strict
+        # learner
         forced_pooled = self.forced_splits is not None \
             and 0 < self.hp.hist_pool_slots < self.hp.num_leaves
         # batched voting (round 4) carries the PV-Tree protocol but not
-        # categorical splits or forced splits (batch_grower asserts)
+        # categorical splits, forced splits, or advanced monotone
+        # (batch_grower asserts)
         voting_unsupported = self.parallel_mode == "voting" and (
-            self.hp.has_categorical or self.forced_splits is not None)
-        unsupported = (mono_strict
-                       or forced_pooled
+            self.hp.has_categorical or self.forced_splits is not None
+            or (self.hp.use_monotone
+                and self.hp.monotone_method == "advanced"))
+        # CEGB is batched-capable (batch_grower round-4 lift); it only
+        # ever reaches this dispatch in serial mode — __init__ fatals on
+        # cegb_* with any non-serial tree_learner (gbdt.py:401)
+        unsupported = (forced_pooled
                        or voting_unsupported
-                       or self.cegb is not None
                        or self.linear
                        or self.parallel_mode not in (None, "data", "voting"))
         # extra_trees / by-node sampling need per-node rng keys, which the
@@ -1138,12 +1146,12 @@ class GBDT:
         unsupported = unsupported or rng_parallel
         if unsupported:
             if not getattr(self, "_warned_batch", False):
-                log.warning("tpu_split_batch > 1 ignored: advanced "
-                            "monotone, forced splits, cegb, linear_tree, "
-                            "extra_trees/bynode-sampling under distributed "
-                            "modes, categorical-under-voting and the "
-                            "feature-parallel mode require the strict "
-                            "leaf-wise learner")
+                log.warning("tpu_split_batch > 1 ignored: linear_tree, "
+                            "forced-splits-with-pool, extra_trees/bynode-"
+                            "sampling under distributed modes, "
+                            "categorical/forced/advanced-monotone under "
+                            "voting and the feature-parallel mode require "
+                            "the strict leaf-wise learner")
                 self._warned_batch = True
             return False
         return True

@@ -23,9 +23,12 @@ splits (one-hot + sorted-subset, applied via per-split bitsets),
 basic/intermediate monotone constraints, interaction constraints, path
 smoothing, forced splits (K=1 prefix phase), extra_trees + per-node
 feature sampling, EFB bundles, bagging row masks, per-tree feature
-sampling, depth limits, data-parallel ``shard_map`` (axis psum) and
-voting-parallel (PV-Tree two-phase vote with local histogram state).
-Advanced monotone, CEGB and linear trees route through the strict
+sampling, depth limits, data-parallel ``shard_map`` (axis psum),
+voting-parallel (PV-Tree two-phase vote with local histogram state),
+CEGB penalties (serial mode; split/coupled/lazy with round-batched
+acquisition updates), and all three monotone methods (advanced computes
+per-(feature, threshold) child bounds for the whole round's kids from
+the round-refreshed boxes).  Linear trees route through the strict
 learner (boosting/gbdt.py dispatch).
 """
 
@@ -44,9 +47,10 @@ from ..ops.round_fuse import partition_select_pallas, use_fused_partition
 from ..ops.split import (NEG_INF, VAR_CAT_BWD, VAR_CAT_FWD, SplitHyper,
                          categorical_left_bitset, find_best_split,
                          leaf_output)
-from .grower import (DeviceBundle, TreeArrays, _INF_BOUND, _empty_tree,
-                     _expand_hist, _expand_hist_col, _feature_bin_of_rows,
-                     pv_vote_best_split, sample_features_bynode)
+from .grower import (CegbInput, DeviceBundle, TreeArrays, _INF_BOUND,
+                     _empty_tree, _expand_hist, _expand_hist_col,
+                     _feature_bin_of_rows, pv_vote_best_split,
+                     sample_features_bynode)
 
 
 @functools.partial(jax.jit, static_argnames=("hp", "batch", "axis_name",
@@ -67,8 +71,8 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                       forced: Optional[Tuple[jax.Array, jax.Array,
                                              jax.Array]] = None,
                       parallel_mode: str = "data", top_k: int = 20,
-                      num_shards: int = 1
-                      ) -> Tuple[TreeArrays, jax.Array]:
+                      num_shards: int = 1,
+                      cegb: Optional[CegbInput] = None):
     """Grow one tree with ``batch`` splits per histogram pass.
 
     Same operands and return contract as ``grow_tree``.  Supports
@@ -96,9 +100,8 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     hist_axis = None if voting else axis_name
     if hp.use_monotone:
         assert monotone is not None and hp.monotone_method in (
-            "basic", "intermediate"), \
-            "batched grower supports monotone basic/intermediate " \
-            "(advanced needs the strict learner)"
+            "basic", "intermediate", "advanced"), \
+            f"unknown monotone method {hp.monotone_method!r}"
     if voting:
         assert not hp.has_categorical, \
             "batched voting does not support categorical splits (the " \
@@ -106,7 +109,18 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             "through the strict learner)"
         assert forced is None, "forced splits need the strict learner " \
             "under voting"
-    use_boxes = hp.use_monotone and hp.monotone_method == "intermediate"
+        assert not (hp.use_monotone
+                    and hp.monotone_method == "advanced"), \
+            "advanced monotone under voting needs the strict learner " \
+            "(the vote path does not thread per-threshold bounds)"
+    if cegb is not None:
+        assert axis_name is None, \
+            "batched CEGB runs the serial learner only (the distributed " \
+            "modes route through the strict grower)"
+    use_lazy = cegb is not None and cegb.used_rows is not None
+    use_boxes = hp.use_monotone and hp.monotone_method in (
+        "intermediate", "advanced")
+    use_adv = hp.use_monotone and hp.monotone_method == "advanced"
     use_paths = interaction_sets is not None
     use_smooth = hp.path_smooth > 0.0
     use_bynode = hp.feature_fraction_bynode < 1.0 and rng_key is not None
@@ -153,8 +167,19 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             hp, min_data_in_leaf=max(1, hp.min_data_in_leaf // num_shards),
             min_sum_hessian_in_leaf=hp.min_sum_hessian_in_leaf / num_shards)
 
+    def cegb_penalty(used_f, used_rows_cnt, leaf_count):
+        """Per-feature gain penalty for one leaf (CEGB DeltaGain,
+        cost_effective_gradient_boosting.hpp — same math as the strict
+        grower's cegb_penalty, with the lazy row count precomputed by
+        the caller's batched matmul)."""
+        pen = cegb.split_pen * leaf_count \
+            + jnp.where(used_f, 0.0, cegb.coupled_pen)
+        if use_lazy:
+            pen = pen + cegb.lazy_pen * used_rows_cnt
+        return pen
+
     def child_best(h_phys, g_, h_, c_, depth, lmin, lmax, fm, pout,
-                   key=None):
+                   key=None, pen=None, adv=None):
         if voting:
             # PV-Tree two-phase vote per child — ONE protocol definition
             # shared with the strict grower (learner/grower.py
@@ -170,7 +195,8 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         res = find_best_split(hv, g_, h_, c_, num_bins, nan_bin, is_cat,
                               fm, hp, monotone=monotone,
                               leaf_min=lmin, leaf_max=lmax, depth=depth,
-                              parent_output=pout, rng_key=key)
+                              parent_output=pout, rng_key=key,
+                              gain_penalty=pen, adv_bounds=adv)
         depth_ok = (hp.max_depth <= 0) | (depth < hp.max_depth)
         return res._replace(gain=jnp.where(depth_ok, res.gain, NEG_INF))
 
@@ -217,8 +243,16 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                            hp.max_delta_step)
     empty_path = jnp.zeros((num_f,), bool)
     key_root = jax.random.fold_in(rng_key, 0) if use_rng else None
+    if cegb is not None:
+        cnt0 = (jnp.einsum("n,nf->f", mask_f.astype(jnp.float32),
+                           (~cegb.used_rows).astype(jnp.float32))
+                if use_lazy else None)
+        pen0 = cegb_penalty(cegb.feature_used, cnt0, c0)
+    else:
+        pen0 = None
     best0 = child_best(hist0_b, g0, h0, c0, jnp.int32(0), -INF, INF,
-                       node_mask(empty_path, key_root), root_out, key_root)
+                       node_mask(empty_path, key_root), root_out, key_root,
+                       pen=pen0)
 
     tree = _empty_tree(L, hp.n_bins, num_f)
     tree = tree._replace(
@@ -267,6 +301,10 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         state["best_bitset"] = jnp.zeros((L, hp.n_bins), bool).at[0].set(
             winner_bitset(hist0_b, g0, h0, c0, best0.feature,
                           best0.variant, best0.threshold))
+    if cegb is not None:
+        state["cegb_used"] = cegb.feature_used
+        if use_lazy:
+            state["cegb_rows"] = cegb.used_rows
     if use_paths:
         state["path_f"] = jnp.zeros((L, num_f), bool)
     if use_boxes:
@@ -411,13 +449,25 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                   ro = leaf_output(rg, rh, hp.lambda_l1, l2_eff,
                                    hp.max_delta_step)
               if hp.use_monotone:
-                  # both methods clip children into the parent's box
+                  # all methods clip children into the parent's box
                   # (monotone_constraints.hpp); basic additionally tightens
                   # each child's box at the midpoint along the split
-                  # direction, intermediate refreshes boxes after the round
+                  # direction, intermediate/advanced refresh boxes per split
                   lmin_p, lmax_p = st["leaf_min"][bl], st["leaf_max"][bl]
                   lo = jnp.clip(lo, lmin_p, lmax_p)
                   ro = jnp.clip(ro, lmin_p, lmax_p)
+                  if use_boxes:
+                      # sibling-ordering repair (one source of truth with
+                      # the strict learner, grower.py: clipping both
+                      # children to the parent's range can inverse their
+                      # order under the split feature's constraint;
+                      # collapse inverted pairs to the midpoint)
+                      mono_sf = monotone[feat]
+                      inv = (~catl) & (((mono_sf > 0) & (lo > ro))
+                                       | ((mono_sf < 0) & (lo < ro)))
+                      mid_sib = jnp.clip((lo + ro) * 0.5, lmin_p, lmax_p)
+                      lo = jnp.where(inv, mid_sib, lo)
+                      ro = jnp.where(inv, mid_sib, ro)
                   if not use_boxes:
                       mono_f = monotone[feat]
                       is_num = ~catl
@@ -503,6 +553,31 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
           l_cnt = st["count"][parents]
           r_cnt = st["count"][safe_nl]
           smaller = jnp.where(l_cnt <= r_cnt, parents, safe_nl)
+
+          if cegb is not None:
+              # the round's K splits acquire their features for their
+              # whole parent leaves (strict grower: cegb_used.at[feat],
+              # cegb_rows |= in_parent & feat — here as one scatter-or +
+              # one [n, K] x [K, F] matmul while ``lor`` still maps rows
+              # to the split parents).  Splits later in this round see
+              # earlier splits' acquisitions only at the NEXT round's
+              # penalty refresh — the same one-round lag the batched
+              # monotone/interaction paths document.
+              feats_c = st["best_feat"][parents]                   # [K]
+              st["cegb_used"] = st["cegb_used"].at[
+                  jnp.where(valid, feats_c, 0)].max(valid)
+              if use_lazy:
+                  in_par = ((lor[None, :] == parents[:, None])
+                            & valid[:, None]
+                            & (mask_f > 0)[None, :])               # [K, n]
+                  feat_oh = ((feats_c[:, None]
+                              == lax.iota(jnp.int32, num_f)[None, :])
+                             & valid[:, None])                     # [K, F]
+                  upd = lax.dot_general(
+                      in_par.astype(jnp.float32).T,
+                      feat_oh.astype(jnp.float32),
+                      (((1,), (0,)), ((), ()))) > 0.0              # [n, F]
+                  st["cegb_rows"] = st["cegb_rows"] | upd
 
           # ---- all K partitions in ONE widened pass (each row belongs to
           # at most one split parent, so the K moves compose by summation)
@@ -666,15 +741,49 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                                           + feature_mask.shape)
                          if feature_mask is not None else None)
               pouts = st["tree"].leaf_value[kids]
+              if cegb is not None:
+                  # per-child penalty vectors from the round-updated
+                  # acquisition state; the lazy not-yet-computed row
+                  # counts for all 2K children come from one
+                  # [2K, n] x [n, F] contraction over the POST-partition
+                  # row map
+                  kid_sel = ((st["leaf_of_row"][None, :] == kids[:, None])
+                             & (mask_f > 0)[None, :])              # [2K, n]
+                  cnt_k = (lax.dot_general(
+                      kid_sel.astype(jnp.float32),
+                      (~st["cegb_rows"]).astype(jnp.float32),
+                      (((1,), (0,)), ((), ()))) if use_lazy else None)
+                  pens = jax.vmap(cegb_penalty, in_axes=(None, 0, 0))(
+                      st["cegb_used"],
+                      cnt_k if use_lazy else jnp.zeros((2 * Kr, 1)),
+                      st["count"][kids])
+              else:
+                  pens = None
+              if use_adv:
+                  # advanced monotone: per-(feature, threshold) child
+                  # bounds for each kid's upcoming split evaluation,
+                  # from the round-refreshed boxes (strict learner
+                  # computes the same right after each split; here the
+                  # kids see ALL of this round's box updates)
+                  from .monotone import advanced_split_bounds
+                  advs = jax.vmap(
+                      lambda lf: advanced_split_bounds(
+                          st["leaf_lo"], st["leaf_hi"],
+                          st["tree"].leaf_value, monotone,
+                          st["tree"].num_leaves, lf, hp.n_bins))(kids)
+              else:
+                  advs = None
               res = jax.vmap(
                   child_best,
                   in_axes=(0, 0, 0, 0, 0, 0, 0,
                            None if fms is None else 0, 0,
-                           None if keys is None else 0))(
+                           None if keys is None else 0,
+                           None if pens is None else 0,
+                           None if advs is None else 0))(
                   kid_hist, st["sum_g"][kids],
                   st["sum_h"][kids], st["count"][kids],
                   depths, st["leaf_min"][kids],
-                  st["leaf_max"][kids], fms, pouts, keys)
+                  st["leaf_max"][kids], fms, pouts, keys, pens, advs)
               ok2 = jnp.concatenate([valid, valid])
               gains2 = jnp.where(ok2, res.gain, st["best_gain"][kids])
               st["best_gain"] = st["best_gain"].at[kids].set(gains2)
@@ -738,4 +847,9 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     state = lax.while_loop(
         lambda st: st["progress"] & (st["n_splits"] < L - 1),
         make_round_body(K), state)
+    if cegb is not None:
+        new_cegb = cegb._replace(
+            feature_used=state["cegb_used"],
+            used_rows=state["cegb_rows"] if use_lazy else None)
+        return state["tree"], state["leaf_of_row"], new_cegb
     return state["tree"], state["leaf_of_row"]

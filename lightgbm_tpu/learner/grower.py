@@ -780,10 +780,17 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             fm_l = node_feature_mask(child_path, k_l)
             fm_r = node_feature_mask(child_path, k_r)
             if cegb is not None:
-                # this split acquires `feat` for the whole parent leaf
+                # this split acquires `feat` for the whole parent leaf —
+                # the BAGGED-IN rows only: the reference's DataPartition
+                # holds just the bag subset, so bagged-out rows never
+                # traverse the split during training and their feature
+                # stays un-acquired (cost_effective_gradient_boosting.hpp
+                # iterates the partition's indices).  Masking here also
+                # keeps batch_grower's round-batched update (same mask)
+                # bit-identical at batch=1 under bagging.
                 cegb_used = st.cegb_used.at[feat].set(True)
                 if cegb.used_rows is not None:
-                    in_parent = active  # rows of the just-split leaf
+                    in_parent = active & (mask_f > 0)
                     cegb_rows = st.cegb_rows | (
                         in_parent[:, None]
                         & (lax.iota(jnp.int32, num_f)[None, :] == feat))

@@ -415,3 +415,55 @@ def test_batched_forced_splits_match_strict(tmp_path, synthetic_binary):
         lb = tb["tree_structure"]["left_child"]
         assert ls["split_feature"] == 1
         assert lb["split_feature"] == 1
+
+
+def test_batch1_monotone_advanced_identical_to_strict(problem):
+    """batch=1 + advanced monotone equals the strict learner exactly:
+    the per-(feature, threshold) bounds and box refreshes degenerate to
+    the strict per-split cadence at K=1."""
+    bins, g, h, nb, nanb, cat = problem
+    mono = jnp.asarray(np.array([1, -1, 0, 0, 0, 0, 0, 0, 0, 0], np.int32))
+    hp = SplitHyper(num_leaves=31, min_data_in_leaf=5, n_bins=64,
+                    rows_per_block=2048, use_monotone=True,
+                    monotone_method="advanced")
+    t0, lor0 = grow_tree(bins, g, h, None, nb, nanb, cat, None, hp,
+                         monotone=mono)
+    t1, lor1 = grow_tree_batched(bins, g, h, None, nb, nanb, cat, None, hp,
+                                 batch=1, monotone=mono)
+    assert int(t1.num_leaves) == int(t0.num_leaves)
+    np.testing.assert_array_equal(np.asarray(t1.split_feature),
+                                  np.asarray(t0.split_feature))
+    np.testing.assert_array_equal(np.asarray(t1.split_bin),
+                                  np.asarray(t0.split_bin))
+    np.testing.assert_allclose(np.asarray(t1.leaf_value),
+                               np.asarray(t0.leaf_value), atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(lor1), np.asarray(lor0))
+
+
+def test_batched_monotone_advanced_respected():
+    """batch=8 + advanced monotone: predictions stay monotone in both
+    constrained directions (the strict learner's own sweep gate), and
+    the fit is not worse than intermediate's (reference quality
+    ordering basic <= intermediate <= advanced)."""
+    rng = np.random.default_rng(12)
+    n = 4000
+    X = rng.normal(size=(n, 4))
+    y = (2.0 * X[:, 0] - 1.2 * X[:, 1] + np.sin(X[:, 2] * 2) +
+         rng.normal(scale=0.3, size=n))
+    fits = {}
+    for method in ("intermediate", "advanced"):
+        p = {"objective": "regression", "num_leaves": 31,
+             "min_data_in_leaf": 5, "verbose": -1,
+             "monotone_constraints": [1, -1, 0, 0],
+             "monotone_constraints_method": method, "tpu_split_batch": 8}
+        b = lgb.train(p, lgb.Dataset(X, label=y, params=p),
+                      num_boost_round=20)
+        base = np.zeros((64, 4))
+        base[:, 2:] = rng.normal(size=(1, 2))
+        for col, sign in ((0, +1), (1, -1)):
+            sweep = base.copy()
+            sweep[:, col] = np.linspace(-3, 3, 64)
+            pred = b.predict(sweep)
+            assert (sign * np.diff(pred) >= -1e-6).all(), (method, col)
+        fits[method] = float(np.mean((b.predict(X) - y) ** 2))
+    assert fits["advanced"] <= fits["intermediate"] * 1.05, fits

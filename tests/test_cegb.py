@@ -3,6 +3,7 @@ cost_effective_gradient_boosting.hpp; reference test strategy:
 test_engine.py test_cegb)."""
 
 import numpy as np
+import pytest
 
 import lightgbm_tpu as lgb
 
@@ -65,3 +66,79 @@ def test_cegb_lazy_penalty_trains():
     assert acc > 0.9
     assert b._gbdt.cegb.used_rows is not None
     assert bool(np.asarray(b._gbdt.cegb.feature_used).any())
+
+
+@pytest.mark.parametrize("lazy", [False, True])
+def test_cegb_batched_batch1_identical_to_strict(lazy):
+    """tpu_split_batch=1 batched rounds + CEGB produce the SAME model
+    as the strict learner (the batched grower's round-batched
+    acquisition updates degenerate to the strict per-split cadence at
+    K=1)."""
+    X, y = _data()
+    p = {**FAST, "objective": "binary", "cegb_tradeoff": 1.0,
+         "cegb_penalty_split": 1e-4,
+         "cegb_penalty_feature_coupled": [50.0, 0, 0, 10.0, 0, 0]}
+    if lazy:
+        p["cegb_penalty_feature_lazy"] = [1e-3, 0, 0, 1e-3, 0, 0]
+    b_strict = lgb.train({**p, "tpu_split_batch": 1},
+                         lgb.Dataset(X, label=y, params=p),
+                         num_boost_round=6)
+    # batch=1 through the batched grower: force it via the pool knob
+    # (histogram_pool_size engages the batched route at batch=1) is
+    # refused for cegb, so compare against batch=2 only for QUALITY and
+    # use the direct grower call for exactness below
+    import jax.numpy as jnp
+    import numpy as np_
+    from lightgbm_tpu.learner.batch_grower import grow_tree_batched
+    from lightgbm_tpu.learner.grower import grow_tree
+    gb = b_strict._gbdt
+    assert gb.cegb is not None
+    rng = np.random.default_rng(3)
+    g = jnp.asarray(rng.normal(size=X.shape[0]).astype(np.float32))
+    h = jnp.asarray(rng.uniform(0.5, 1.5, size=X.shape[0])
+                    .astype(np.float32))
+    cegb0 = gb.cegb._replace(
+        feature_used=jnp.zeros_like(gb.cegb.feature_used),
+        used_rows=None if gb.cegb.used_rows is None else
+        jnp.zeros_like(gb.cegb.used_rows))
+    # a bagging row_mask exercises the masked lazy acquisition: only
+    # bagged-in rows acquire the split feature (reference DataPartition
+    # holds the bag subset; both growers share the same mask)
+    row_mask = jnp.asarray(rng.uniform(size=X.shape[0]) < 0.7)
+    t_s, lor_s, cegb_s = grow_tree(
+        gb.bins, g, h, row_mask, gb.num_bins_arr, gb.nan_bin_arr,
+        gb.is_cat_arr, None, gb.hp, cegb=cegb0)
+    t_b, lor_b, cegb_b = grow_tree_batched(
+        gb.bins, g, h, row_mask, gb.num_bins_arr, gb.nan_bin_arr,
+        gb.is_cat_arr, None, gb.hp, batch=1, cegb=cegb0)
+    np_.testing.assert_array_equal(np_.asarray(lor_s),
+                                   np_.asarray(lor_b))
+    np_.testing.assert_array_equal(np_.asarray(t_s.split_feature),
+                                   np_.asarray(t_b.split_feature))
+    np_.testing.assert_allclose(np_.asarray(t_s.leaf_value),
+                                np_.asarray(t_b.leaf_value), rtol=1e-6)
+    np_.testing.assert_array_equal(np_.asarray(cegb_s.feature_used),
+                                   np_.asarray(cegb_b.feature_used))
+    if lazy:
+        np_.testing.assert_array_equal(np_.asarray(cegb_s.used_rows),
+                                       np_.asarray(cegb_b.used_rows))
+
+
+def test_cegb_batched_multi_split_rounds_price_out_features():
+    """K>1 batched rounds keep the CEGB effect: a big coupled penalty
+    still prices feature 0 out of the model, and training through
+    train() persists acquisition state across iterations."""
+    X, y = _data()
+    p = {**FAST, "objective": "binary", "tpu_split_batch": 4,
+         "cegb_tradeoff": 1.0,
+         "cegb_penalty_feature_coupled": [1e6, 0, 0, 0, 0, 0]}
+    bst = lgb.train(p, lgb.Dataset(X, label=y, params=p),
+                    num_boost_round=8)
+    imp = bst.feature_importance()
+    assert imp[0] == 0           # feature 0 priced out
+    assert imp[1] > 0            # duplicate takes over
+    acc = float(((bst.predict(X) > 0.5) == y).mean())
+    assert acc > 0.9
+    # acquisition state persisted: the model's used features are marked
+    used = np.asarray(bst._gbdt.cegb.feature_used)
+    assert used[1] and not used[0]

@@ -177,8 +177,8 @@ def test_max_delta_step_limits_outputs():
 @pytest.mark.parametrize("method", ["intermediate", "advanced"])
 def test_monotone_intermediate_enforced(method):
     """Intermediate method (dense box-adjacency bounds, learner/monotone.py;
-    reference monotone_constraints.hpp:516) keeps predictions monotone;
-    'advanced' falls back to intermediate with a warning."""
+    reference monotone_constraints.hpp:516) and advanced (per-threshold
+    child bounds on top of the boxes) keep predictions monotone."""
     X, y = _monotone_data()
     ds = lgb.Dataset(X, label=y, params=FAST)
     bst = lgb.train({**FAST, "objective": "regression",
