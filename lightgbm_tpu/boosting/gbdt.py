@@ -300,20 +300,19 @@ class GBDT:
         renewed from true gradients.  Small runs keep the exact-f32 strict
         path: there the extra kernel compilations dominate and exactness
         is free.  Any explicit user setting, ``deterministic=true``,
-        feature-parallel (no level-scale plumbing) and linear trees
-        (true-gradient ridge fits) win over the policy."""
+        feature-parallel (no level-scale plumbing) win over the whole
+        policy; linear trees opt out of the int8 half only (ridge fits
+        need true gradients) and DO get the auto split batch."""
         at_scale = self.train_set.num_data >= 100_000
-        # only auto-batch configurations the batched grower supports —
-        # an auto K on e.g. linear_tree would just warn-and-fall-back
-        batchable = (not bool(config.linear_tree)
-                     and str(config.monotone_constraints_method) != "advanced"
-                     and float(config.cegb_penalty_split) == 0.0
-                     and not list(config.cegb_penalty_feature_lazy or [])
-                     and not list(config.cegb_penalty_feature_coupled or [])
-                     and self.parallel_mode in (None, "data", "voting")
+        # only auto-batch configurations the batched grower supports
+        # (linear trees, CEGB and advanced monotone joined in round 4;
+        # advanced-monotone-under-voting would warn-and-fall-back)
+        batchable = (self.parallel_mode in (None, "data", "voting")
                      and not (self.parallel_mode == "voting"
-                              and bool(self.train_set.categorical_array()
-                                       .any())))
+                              and (bool(self.train_set.categorical_array()
+                                        .any())
+                                   or str(config.monotone_constraints_method)
+                                   == "advanced")))
         if not config.is_explicit("tpu_split_batch"):
             if at_scale and batchable and int(config.num_leaves) >= 8:
                 # 42: the flat kernel's 3K=126 channels still fit one MXU
@@ -454,14 +453,14 @@ class GBDT:
                     log.warning("histogram_pool_size ignored under "
                                 "tree_learner=%s (the bounded pool is "
                                 "serial-only)" % self.parallel_mode)
-                elif (self.cegb is not None or self.linear
-                      or self.forced_splits is not None
-                      or (self.hp.use_monotone
-                          and self.hp.monotone_method == "advanced")):
-                    log.warning("histogram_pool_size ignored: cegb, "
-                                "linear_tree, forced splits and advanced "
-                                "monotone constraints require the strict "
-                                "full-histogram learner")
+                elif self.forced_splits is not None:
+                    # cegb / linear_tree / advanced monotone all compose
+                    # with the pooled batched grower since the round-4
+                    # lifts; forced splits still assert against pooling
+                    # (batch_grower.py forced-phase state)
+                    log.warning("histogram_pool_size ignored: forced "
+                                "splits require the strict full-histogram "
+                                "learner")
                 else:
                     self.hp = dataclasses.replace(
                         self.hp, hist_pool_slots=slots)
@@ -1119,9 +1118,8 @@ class GBDT:
         if int(self.config.tpu_split_batch) <= 1 and not pool_active:
             return False
         # categorical splits, all three monotone methods, interaction
-        # constraints, path smoothing and CEGB are batched-capable
-        # (learner/batch_grower.py); linear trees still need the strict
-        # learner
+        # constraints, path smoothing, CEGB and linear trees are
+        # batched-capable (learner/batch_grower.py)
         forced_pooled = self.forced_splits is not None \
             and 0 < self.hp.hist_pool_slots < self.hp.num_leaves
         # batched voting (round 4) carries the PV-Tree protocol but not
@@ -1136,7 +1134,6 @@ class GBDT:
         # cegb_* with any non-serial tree_learner (gbdt.py:401)
         unsupported = (forced_pooled
                        or voting_unsupported
-                       or self.linear
                        or self.parallel_mode not in (None, "data", "voting"))
         # extra_trees / by-node sampling need per-node rng keys, which the
         # sharded batched wrapper does not plumb yet — serial only
@@ -1146,7 +1143,7 @@ class GBDT:
         unsupported = unsupported or rng_parallel
         if unsupported:
             if not getattr(self, "_warned_batch", False):
-                log.warning("tpu_split_batch > 1 ignored: linear_tree, "
+                log.warning("tpu_split_batch > 1 ignored: "
                             "forced-splits-with-pool, extra_trees/bynode-"
                             "sampling under distributed modes, "
                             "categorical/forced/advanced-monotone under "

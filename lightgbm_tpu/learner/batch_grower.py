@@ -26,10 +26,10 @@ feature sampling, EFB bundles, bagging row masks, per-tree feature
 sampling, depth limits, data-parallel ``shard_map`` (axis psum),
 voting-parallel (PV-Tree two-phase vote with local histogram state),
 CEGB penalties (serial mode; split/coupled/lazy with round-batched
-acquisition updates), and all three monotone methods (advanced computes
+acquisition updates), all three monotone methods (advanced computes
 per-(feature, threshold) child bounds for the whole round's kids from
-the round-refreshed boxes).  Linear trees route through the strict
-learner (boosting/gbdt.py dispatch).
+the round-refreshed boxes), and linear trees (returned trees carry
+leaf_path, so the post-growth ridge fit composes unchanged).
 """
 
 from __future__ import annotations
@@ -75,14 +75,17 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                       cegb: Optional[CegbInput] = None):
     """Grow one tree with ``batch`` splits per histogram pass.
 
-    Same operands and return contract as ``grow_tree``.  Supports
-    interaction constraints (per-leaf path-feature masks), basic AND
-    intermediate monotone methods (intermediate refreshes every leaf's
-    bounds from dense box adjacency after EACH split, the strict
-    learner's cadence, so splits later in a round see earlier splits'
-    outputs; cached candidate GAINS of unsplit leaves may lag a round,
-    the same class of lag the strict learner documents), and path
-    smoothing.
+    Same operands and return contract as ``grow_tree`` (a 3-tuple with
+    the updated ``CegbInput`` when ``cegb`` is passed).  Supports
+    interaction constraints (per-leaf path-feature masks), ALL monotone
+    methods (intermediate/advanced refresh every leaf's bounds from
+    dense box adjacency after EACH split, the strict learner's cadence,
+    so splits later in a round see earlier splits' outputs; advanced
+    additionally threads per-(feature, threshold) child bounds into the
+    round's split evaluations; cached candidate GAINS of unsplit leaves
+    may lag a round, the same class of lag the strict learner
+    documents), path smoothing, CEGB penalties (acquisitions batch per
+    round), and linear trees (returned trees carry ``leaf_path``).
 
     Under ``axis_name`` with ``parallel_mode="voting"`` the rounds run
     the PV-Tree protocol (reference voting_parallel_tree_learner.cpp,
@@ -129,6 +132,12 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     num_f = bins.shape[1] if bundle is None else bundle.feat_col.shape[0]
     L = hp.num_leaves
     K = min(batch, L - 1)
+    if use_lazy:
+        # row-block geometry for the lazy-acquisition scans (bounds the
+        # per-round f32 transients to [K, blk] instead of [K, n])
+        cegb_blk = min(1 << 18, n)
+        cegb_pad = (-n) % cegb_blk
+        cegb_nb = (n + cegb_pad) // cegb_blk
     mask_f = jnp.ones_like(grad) if row_mask is None \
         else row_mask.astype(grad.dtype)
     bins_t = lax.optimization_barrier(bins.T)
@@ -305,8 +314,11 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         state["cegb_used"] = cegb.feature_used
         if use_lazy:
             state["cegb_rows"] = cegb.used_rows
-    if use_paths:
-        state["path_f"] = jnp.zeros((L, num_f), bool)
+    # leaf path features: tracked unconditionally ([L, F] bool is tiny)
+    # so returned trees carry leaf_path like the strict learner's — the
+    # linear-tree ridge fit (learner/linear.py fit_linear_leaves) selects
+    # each leaf's numeric path features from it
+    state["path_f"] = jnp.zeros((L, num_f), bool)
     if use_boxes:
         # bin-space boxes: root spans every bin (hi exclusive); dead slots
         # hold empty boxes so box_bounds ignores them
@@ -483,13 +495,12 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                   else:
                       lmin_l = lmin_r = lmin_p
                       lmax_l = lmax_r = lmax_p
-              if use_paths:
-                  # children inherit the path plus the split feature
-                  new_path = st["path_f"][bl].at[feat].set(True)
-                  st["path_f"] = st["path_f"].at[bl].set(
-                      jnp.where(ok, new_path, st["path_f"][bl]))
-                  st["path_f"] = st["path_f"].at[nl].set(
-                      jnp.where(ok, new_path, st["path_f"][nl]))
+              # children inherit the path plus the split feature
+              new_path = st["path_f"][bl].at[feat].set(True)
+              st["path_f"] = st["path_f"].at[bl].set(
+                  jnp.where(ok, new_path, st["path_f"][bl]))
+              st["path_f"] = st["path_f"].at[nl].set(
+                  jnp.where(ok, new_path, st["path_f"][nl]))
               if use_boxes:
                   from .monotone import box_bounds, split_boxes
                   n_lo, n_hi = split_boxes(
@@ -567,17 +578,31 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
               st["cegb_used"] = st["cegb_used"].at[
                   jnp.where(valid, feats_c, 0)].max(valid)
               if use_lazy:
-                  in_par = ((lor[None, :] == parents[:, None])
-                            & valid[:, None]
-                            & (mask_f > 0)[None, :])               # [K, n]
                   feat_oh = ((feats_c[:, None]
                               == lax.iota(jnp.int32, num_f)[None, :])
-                             & valid[:, None])                     # [K, F]
-                  upd = lax.dot_general(
-                      in_par.astype(jnp.float32).T,
-                      feat_oh.astype(jnp.float32),
-                      (((1,), (0,)), ((), ()))) > 0.0              # [n, F]
-                  st["cegb_rows"] = st["cegb_rows"] | upd
+                             & valid[:, None]).astype(jnp.float32)  # [K, F]
+
+                  # block-scanned [blk, K] x [K, F] matmuls: a single
+                  # dense [K, n] f32 operand would be ~1.7 GB at 1e7
+                  # rows x K=42 — the scan keeps the transient at
+                  # [K, blk] while computing the identical result
+                  def mark_block(_, xs):
+                      lor_b, m_b = xs
+                      ip = ((lor_b[None, :] == parents[:, None])
+                            & valid[:, None]
+                            & (m_b > 0)[None, :])                  # [K, blk]
+                      return None, lax.dot_general(
+                          ip.astype(jnp.float32).T, feat_oh,
+                          (((1,), (0,)), ((), ()))) > 0.0          # [blk, F]
+
+                  _, upd = lax.scan(
+                      mark_block, None,
+                      (jnp.pad(lor, (0, cegb_pad), constant_values=-1)
+                       .reshape(cegb_nb, cegb_blk),
+                       jnp.pad(mask_f, (0, cegb_pad))
+                       .reshape(cegb_nb, cegb_blk)))
+                  st["cegb_rows"] = st["cegb_rows"] | \
+                      upd.reshape(-1, num_f)[:n]
 
           # ---- all K partitions in ONE widened pass (each row belongs to
           # at most one split parent, so the K moves compose by summation)
@@ -744,15 +769,34 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
               if cegb is not None:
                   # per-child penalty vectors from the round-updated
                   # acquisition state; the lazy not-yet-computed row
-                  # counts for all 2K children come from one
-                  # [2K, n] x [n, F] contraction over the POST-partition
-                  # row map
-                  kid_sel = ((st["leaf_of_row"][None, :] == kids[:, None])
-                             & (mask_f > 0)[None, :])              # [2K, n]
-                  cnt_k = (lax.dot_general(
-                      kid_sel.astype(jnp.float32),
-                      (~st["cegb_rows"]).astype(jnp.float32),
-                      (((1,), (0,)), ((), ()))) if use_lazy else None)
+                  # counts for all 2K children come from block-scanned
+                  # [2K, blk] x [blk, F] contractions over the
+                  # POST-partition row map (bounded transients, same
+                  # result as one [2K, n] x [n, F] matmul)
+                  if use_lazy:
+                      def count_block(acc, xs):
+                          lor_b, m_b, rows_b = xs
+                          ks = ((lor_b[None, :] == kids[:, None])
+                                & (m_b > 0)[None, :])       # [2K, blk]
+                          return acc + lax.dot_general(
+                              ks.astype(jnp.float32),
+                              (~rows_b).astype(jnp.float32),
+                              (((1,), (0,)), ((), ()))), None
+
+                      cnt_k, _ = lax.scan(
+                          count_block,
+                          jnp.zeros((2 * Kr, num_f), jnp.float32),
+                          (jnp.pad(st["leaf_of_row"], (0, cegb_pad),
+                                   constant_values=-1)
+                           .reshape(cegb_nb, cegb_blk),
+                           jnp.pad(mask_f, (0, cegb_pad))
+                           .reshape(cegb_nb, cegb_blk),
+                           jnp.pad(st["cegb_rows"],
+                                   ((0, cegb_pad), (0, 0)),
+                                   constant_values=True)
+                           .reshape(cegb_nb, cegb_blk, num_f)))
+                  else:
+                      cnt_k = None
                   pens = jax.vmap(cegb_penalty, in_axes=(None, 0, 0))(
                       st["cegb_used"],
                       cnt_k if use_lazy else jnp.zeros((2 * Kr, 1)),
@@ -847,9 +891,10 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     state = lax.while_loop(
         lambda st: st["progress"] & (st["n_splits"] < L - 1),
         make_round_body(K), state)
+    tree_out = state["tree"]._replace(leaf_path=state["path_f"])
     if cegb is not None:
         new_cegb = cegb._replace(
             feature_used=state["cegb_used"],
             used_rows=state["cegb_rows"] if use_lazy else None)
-        return state["tree"], state["leaf_of_row"], new_cegb
-    return state["tree"], state["leaf_of_row"]
+        return tree_out, state["leaf_of_row"], new_cegb
+    return tree_out, state["leaf_of_row"]

@@ -467,3 +467,42 @@ def test_batched_monotone_advanced_respected():
             assert (sign * np.diff(pred) >= -1e-6).all(), (method, col)
         fits[method] = float(np.mean((b.predict(X) - y) ** 2))
     assert fits["advanced"] <= fits["intermediate"] * 1.05, fits
+
+
+def test_batched_linear_tree_trains_and_matches_strict_at_batch1():
+    """linear_tree + tpu_split_batch: the batched grower's trees carry
+    leaf_path, so the post-growth ridge fit composes.  batch=1 must
+    reproduce the strict learner's model exactly (growth identical =>
+    identical per-leaf fits); batch=4 keeps linear-fit quality."""
+    rng = np.random.default_rng(9)
+    n = 3000
+    X = rng.normal(size=(n, 5))
+    y = 1.5 * X[:, 0] + np.where(X[:, 1] > 0, 2.0 * X[:, 2], -X[:, 2]) \
+        + rng.normal(scale=0.2, size=n)
+    base = {"objective": "regression", "num_leaves": 15, "verbose": -1,
+            "min_data_in_leaf": 20, "linear_tree": True,
+            "linear_lambda": 0.01}
+    models = {}
+    for k in (1, 4):
+        p = {**base, "tpu_split_batch": k,
+             # batch=1 alone routes strict; a pool with fewer slots than
+             # num_leaves forces the batched grower at batch=1 for the
+             # equivalence check (5 feats x 256 bins x 4ch x 4B = 20 KB
+             # per slot; 0.15 MB => ~7 slots < 15 leaves)
+             **({"histogram_pool_size": 0.15} if k == 1 else {})}
+        b = lgb.train(p, lgb.Dataset(X, label=y, params=p),
+                      num_boost_round=10)
+        models[k] = b
+    p_strict = {**base, "tpu_split_batch": 1}
+    b_strict = lgb.train(p_strict, lgb.Dataset(X, label=y, params=p_strict),
+                         num_boost_round=10)
+    assert any(t.is_linear for t in b_strict._gbdt.models)
+    # batch=1 (batched route, pooled) == strict, linear fits included
+    np.testing.assert_allclose(models[1].predict(X), b_strict.predict(X),
+                               rtol=1e-6, atol=1e-7)
+    # batch=4 relaxes split order only: linear-fit quality stays within
+    # a whisker of the strict learner's at the same budget
+    mse4 = float(np.mean((models[4].predict(X) - y) ** 2))
+    mse_s = float(np.mean((b_strict.predict(X) - y) ** 2))
+    assert any(t.is_linear for t in models[4]._gbdt.models)
+    assert mse4 < mse_s * 1.10, (mse4, mse_s)

@@ -83,10 +83,9 @@ def test_cegb_batched_batch1_identical_to_strict(lazy):
     b_strict = lgb.train({**p, "tpu_split_batch": 1},
                          lgb.Dataset(X, label=y, params=p),
                          num_boost_round=6)
-    # batch=1 through the batched grower: force it via the pool knob
-    # (histogram_pool_size engages the batched route at batch=1) is
-    # refused for cegb, so compare against batch=2 only for QUALITY and
-    # use the direct grower call for exactness below
+    # exactness is checked at the grower level (direct calls below);
+    # pool composition with cegb is covered by
+    # tests/test_hist_pool.py::test_pooled_cegb_equals_unpooled
     import jax.numpy as jnp
     import numpy as np_
     from lightgbm_tpu.learner.batch_grower import grow_tree_batched

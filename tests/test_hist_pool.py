@@ -206,3 +206,62 @@ def test_auto_pool_engages_for_wide_histogram_state():
     assert 0 < g.hp.hist_pool_slots < g.hp.num_leaves
     g = make({"histogram_pool_size": -1})
     assert g.hp.hist_pool_slots == 0
+
+
+def test_pooled_cegb_equals_unpooled():
+    """The bounded pool composes with CEGB (round-4 lift): identical
+    trees and identical acquisition state with and without pooling —
+    the cached-winner design means penalties never read an evicted
+    parent histogram."""
+    import jax.numpy as jnp
+    from lightgbm_tpu.learner.grower import CegbInput
+    bins, grad, hess, num_bins, nan_bin, is_cat = _mk()
+    f = bins.shape[1]
+    hp = SplitHyper(num_leaves=31, min_data_in_leaf=5, n_bins=64,
+                    hist_dtype="float32")
+    hp_pool = dataclasses.replace(hp, hist_pool_slots=14)
+    cegb0 = CegbInput(
+        split_pen=jnp.float32(1e-4),
+        coupled_pen=jnp.full((f,), 0.05, jnp.float32),
+        lazy_pen=jnp.full((f,), 1e-4, jnp.float32),
+        feature_used=jnp.zeros((f,), bool),
+        used_rows=jnp.zeros(bins.shape, bool))
+    t0, lor0, c0 = grow_tree_batched(bins, grad, hess, None, num_bins,
+                                     nan_bin, is_cat, None, hp, batch=4,
+                                     cegb=cegb0)
+    t1, lor1, c1 = grow_tree_batched(bins, grad, hess, None, num_bins,
+                                     nan_bin, is_cat, None, hp_pool,
+                                     batch=4, cegb=cegb0)
+    np.testing.assert_array_equal(np.asarray(t0.split_feature),
+                                  np.asarray(t1.split_feature))
+    np.testing.assert_array_equal(np.asarray(lor0), np.asarray(lor1))
+    np.testing.assert_array_equal(np.asarray(c0.feature_used),
+                                  np.asarray(c1.feature_used))
+    np.testing.assert_array_equal(np.asarray(c0.used_rows),
+                                  np.asarray(c1.used_rows))
+
+
+def test_pooled_advanced_monotone_equals_unpooled():
+    """The bounded pool composes with advanced monotone: the
+    per-threshold bounds read boxes and outputs, never histograms, so
+    pooling cannot change them."""
+    import jax.numpy as jnp
+    bins, grad, hess, num_bins, nan_bin, is_cat = _mk()
+    f = bins.shape[1]
+    mono = jnp.asarray(
+        np.array([1, -1] + [0] * (f - 2), np.int32))
+    hp = SplitHyper(num_leaves=31, min_data_in_leaf=5, n_bins=64,
+                    hist_dtype="float32", use_monotone=True,
+                    monotone_method="advanced")
+    hp_pool = dataclasses.replace(hp, hist_pool_slots=14)
+    t0, lor0 = grow_tree_batched(bins, grad, hess, None, num_bins,
+                                 nan_bin, is_cat, None, hp, batch=4,
+                                 monotone=mono)
+    t1, lor1 = grow_tree_batched(bins, grad, hess, None, num_bins,
+                                 nan_bin, is_cat, None, hp_pool, batch=4,
+                                 monotone=mono)
+    np.testing.assert_array_equal(np.asarray(t0.split_feature),
+                                  np.asarray(t1.split_feature))
+    np.testing.assert_array_equal(np.asarray(t0.leaf_value),
+                                  np.asarray(t1.leaf_value))
+    np.testing.assert_array_equal(np.asarray(lor0), np.asarray(lor1))
