@@ -306,13 +306,12 @@ class GBDT:
         at_scale = self.train_set.num_data >= 100_000
         # only auto-batch configurations the batched grower supports
         # (linear trees, CEGB and advanced monotone joined in round 4;
-        # advanced-monotone-under-voting would warn-and-fall-back)
+        # advanced-under-voting is downgraded to intermediate before
+        # growth, so no monotone config blocks batching)
         batchable = (self.parallel_mode in (None, "data", "voting")
                      and not (self.parallel_mode == "voting"
-                              and (bool(self.train_set.categorical_array()
-                                        .any())
-                                   or str(config.monotone_constraints_method)
-                                   == "advanced")))
+                              and bool(self.train_set.categorical_array()
+                                       .any())))
         if not config.is_explicit("tpu_split_batch"):
             if at_scale and batchable and int(config.num_leaves) >= 8:
                 # 42: the flat kernel's 3K=126 channels still fit one MXU
@@ -1123,12 +1122,11 @@ class GBDT:
         forced_pooled = self.forced_splits is not None \
             and 0 < self.hp.hist_pool_slots < self.hp.num_leaves
         # batched voting (round 4) carries the PV-Tree protocol but not
-        # categorical splits, forced splits, or advanced monotone
-        # (batch_grower asserts)
+        # categorical splits or forced splits (batch_grower asserts;
+        # advanced monotone is already downgraded to intermediate under
+        # voting at construction)
         voting_unsupported = self.parallel_mode == "voting" and (
-            self.hp.has_categorical or self.forced_splits is not None
-            or (self.hp.use_monotone
-                and self.hp.monotone_method == "advanced"))
+            self.hp.has_categorical or self.forced_splits is not None)
         # CEGB is batched-capable (batch_grower round-4 lift); it only
         # ever reaches this dispatch in serial mode — __init__ fatals on
         # cegb_* with any non-serial tree_learner (gbdt.py:401)

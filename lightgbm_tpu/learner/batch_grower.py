@@ -402,9 +402,140 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
 
           t = st["tree"]
           lor = st["leaf_of_row"]
-          # record + partition each slot (cheap [L]/[n] ops, no data passes)
+          if not use_boxes:
+              # ---- vectorized record: ONE batched scatter per array.
+              # The sequential per-slot loop below (kept for the
+              # box-based monotone methods, whose per-split bound
+              # refresh makes later slots depend on earlier outputs)
+              # cost ~17 ms/tree at K=42 in pure scatter-chain latency
+              # (round-4 e2e profile); all its reads/writes touch
+              # DISTINCT indices across slots — parents are distinct
+              # top-k leaves, new node/leaf ids are distinct, and a
+              # shared grandparent node is written on complementary
+              # child sides — so the loop folds into masked scatters
+              # (invalid slots aim out of bounds, mode="drop").
+              ok = valid                                          # [K]
+              bl = parents
+              feat = st["best_feat"][bl]
+              thr = st["best_thr"][bl]
+              dl = st["best_dl"][bl]
+              var = st["best_var"][bl]
+              catl = is_cat[feat]
+              pg, ph, pc = st["sum_g"][bl], st["sum_h"][bl], \
+                  st["count"][bl]
+              lg, lh, lcn = st["best_lg"][bl], st["best_lh"][bl], \
+                  st["best_lc"][bl]
+              rg, rh, rcn = pg - lg, ph - lh, pc - lcn
+              if hp.has_categorical:
+                  bitsets_arr = st["best_bitset"][bl]             # [K, B]
+              else:
+                  bitsets_arr = jnp.zeros((Kr, hp.n_bins), bool)
+
+              ni = L - 1
+              p, side = st["parent_node"][bl], st["parent_side"][bl]
+              nid_m = jnp.where(ok, node_ids, ni)                 # drop idx
+              lc = t.left_child.at[
+                  jnp.where(ok & (p >= 0) & (side == 0), p, ni)
+              ].set(node_ids, mode="drop")
+              lc = lc.at[nid_m].set(-(bl + 1), mode="drop")
+              rc = t.right_child.at[
+                  jnp.where(ok & (p >= 0) & (side == 1), p, ni)
+              ].set(node_ids, mode="drop")
+              rc = rc.at[nid_m].set(-(new_leaves + 1), mode="drop")
+
+              l2_eff = hp.lambda_l2 + jnp.where(
+                  (var == VAR_CAT_FWD) | (var == VAR_CAT_BWD),
+                  hp.cat_l2, 0.0)
+              if use_smooth:
+                  from ..ops.split import smoothed_output
+                  pout_k = t.leaf_value[bl]
+                  lo = smoothed_output(lg, lh, lcn, pout_k,
+                                       hp.lambda_l1, l2_eff, hp)
+                  ro = smoothed_output(rg, rh, rcn, pout_k,
+                                       hp.lambda_l1, l2_eff, hp)
+              else:
+                  lo = leaf_output(lg, lh, hp.lambda_l1, l2_eff,
+                                   hp.max_delta_step)
+                  ro = leaf_output(rg, rh, hp.lambda_l1, l2_eff,
+                                   hp.max_delta_step)
+              if hp.use_monotone:
+                  # basic method only here (box methods take the
+                  # sequential branch): clip into the parent's range,
+                  # tighten each child's box at the midpoint
+                  lmin_p = st["leaf_min"][bl]
+                  lmax_p = st["leaf_max"][bl]
+                  lo = jnp.clip(lo, lmin_p, lmax_p)
+                  ro = jnp.clip(ro, lmin_p, lmax_p)
+                  mono_f = monotone[feat]
+                  is_num = ~catl
+                  mid = (lo + ro) * 0.5
+                  lmax_l = jnp.where(is_num & (mono_f > 0),
+                                     jnp.minimum(lmax_p, mid), lmax_p)
+                  lmin_l = jnp.where(is_num & (mono_f < 0),
+                                     jnp.maximum(lmin_p, mid), lmin_p)
+                  lmin_r = jnp.where(is_num & (mono_f > 0),
+                                     jnp.maximum(lmin_p, mid), lmin_p)
+                  lmax_r = jnp.where(is_num & (mono_f < 0),
+                                     jnp.minimum(lmax_p, mid), lmax_p)
+              d = t.leaf_depth[bl] + 1
+
+              # leaf-indexed arrays: one [2K] scatter (bl existing ids,
+              # new_leaves fresh ids — provably disjoint)
+              idx2 = jnp.concatenate([jnp.where(ok, bl, L),
+                                      jnp.where(ok, new_leaves, L)])
+
+              def w2(arr, vb, vn):
+                  return arr.at[idx2].set(
+                      jnp.concatenate([vb, vn]), mode="drop")
+
+              new_path = st["path_f"][bl] | (
+                  feat[:, None] == lax.iota(jnp.int32, num_f)[None, :])
+              st["path_f"] = st["path_f"].at[idx2].set(
+                  jnp.concatenate([new_path, new_path]), mode="drop")
+
+              t = t._replace(
+                  split_feature=t.split_feature.at[nid_m].set(
+                      feat, mode="drop"),
+                  split_bin=t.split_bin.at[nid_m].set(thr, mode="drop"),
+                  default_left=t.default_left.at[nid_m].set(
+                      dl, mode="drop"),
+                  split_cat=t.split_cat.at[nid_m].set(catl, mode="drop"),
+                  cat_bitset=t.cat_bitset.at[nid_m].set(
+                      bitsets_arr, mode="drop"),
+                  left_child=lc, right_child=rc,
+                  split_gain=t.split_gain.at[nid_m].set(
+                      st["best_gain"][bl], mode="drop"),
+                  internal_value=t.internal_value.at[nid_m].set(
+                      leaf_output(pg, ph, hp.lambda_l1, hp.lambda_l2,
+                                  hp.max_delta_step), mode="drop"),
+                  internal_count=t.internal_count.at[nid_m].set(
+                      pc, mode="drop"),
+                  leaf_depth=w2(t.leaf_depth, d, d),
+                  leaf_value=w2(t.leaf_value, lo, ro),
+                  leaf_count=w2(t.leaf_count, lcn, rcn),
+                  leaf_weight=w2(t.leaf_weight, lh, rh),
+                  num_leaves=t.num_leaves
+                  + jnp.sum(valid.astype(jnp.int32)),
+              )
+              st["sum_g"] = w2(st["sum_g"], lg, rg)
+              st["sum_h"] = w2(st["sum_h"], lh, rh)
+              st["count"] = w2(st["count"], lcn, rcn)
+              st["parent_node"] = w2(st["parent_node"], node_ids,
+                                     node_ids)
+              st["parent_side"] = w2(st["parent_side"],
+                                     jnp.zeros((Kr,), jnp.int32),
+                                     jnp.ones((Kr,), jnp.int32))
+              if hp.use_monotone:
+                  st["leaf_min"] = w2(st["leaf_min"], lmin_l, lmin_r)
+                  st["leaf_max"] = w2(st["leaf_max"], lmax_l, lmax_r)
+              st["best_gain"] = st["best_gain"].at[
+                  jnp.where(ok, bl, L)].set(NEG_INF, mode="drop")
+
+          # record + partition each slot (cheap [L]/[n] ops, no data
+          # passes) — sequential branch for the box-based monotone
+          # methods; MUST mirror the vectorized branch above
           bitsets = []
-          for j in range(Kr):
+          for j in (range(Kr) if use_boxes else ()):
               ok = valid[j]
               bl = parents[j]
               nid = node_ids[j]
@@ -628,7 +759,8 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                   go_left_k = jnp.where(cols_k == nanb_k, dl_k,
                                         cols_k <= thr_k)
                   if hp.has_categorical:
-                      bitsets_k = jnp.stack(bitsets)                  # [K, B]
+                      bitsets_k = (jnp.stack(bitsets) if use_boxes
+                                   else bitsets_arr)              # [K, B]
                       cat_k = is_cat[feats_k][:, None]                # [K, 1]
                       go_cat_k = jnp.take_along_axis(bitsets_k, cols_k,
                                                      axis=1)
