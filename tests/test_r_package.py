@@ -153,3 +153,20 @@ def test_description_and_makevars_present():
     assert "NeedsCompilation: yes" in desc
     mk = _read(os.path.join(RPKG, "src", "Makevars"))
     assert "-llgbtpu_capi" in mk
+
+
+def test_native_symbols_exported_by_built_library():
+    """Beyond the header cross-check: every LGBMTPU_* symbol the glue
+    links must be EXPORTED by the built liblgbtpu_capi.so (a header
+    entry without a definition would only fail at the consumer's link
+    step, which no CI here runs)."""
+    import lightgbm_tpu.native as native
+    lib = native.build_capi()
+    res = subprocess.run(["nm", "-D", "--defined-only", lib],
+                         capture_output=True, text=True, timeout=60)
+    assert res.returncode == 0, res.stderr
+    exported = set(re.findall(r"\sT\s+(LGBMTPU_\w+)", res.stdout))
+    used = set(re.findall(r"(LGBMTPU_\w+)\s*\(", _read(GLUE)))
+    missing = used - exported
+    assert not missing, f"glue links symbols the library does not " \
+                        f"export: {sorted(missing)}"
