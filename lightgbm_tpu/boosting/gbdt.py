@@ -671,13 +671,17 @@ class GBDT:
 
     # ------------------------------------------------------------ training
     def boosting_gradients(self) -> Tuple[jax.Array, jax.Array]:
-        """reference GBDT::Boosting (gbdt.cpp:220)."""
+        """reference GBDT::Boosting (gbdt.cpp:220).  Gradients run under
+        one jit where the objective is pure (jitted_gradients) — through
+        a tunneled chip the eager per-op dispatch of a large gradient
+        graph (lambdarank's pairwise sort) otherwise dominates the
+        iteration."""
         if self.objective is None:
             log.fatal("No objective; pass grad/hess to train_one_iter")
         if self.num_tree_per_iteration == 1:
-            g, h = self.objective.get_gradients(self.scores[:, 0])
+            g, h = self.objective.jitted_gradients(self.scores[:, 0])
             return g[:, None], h[:, None]
-        return self.objective.get_gradients(self.scores)
+        return self.objective.jitted_gradients(self.scores)
 
     def _debug_check_tree(self, arrays, leaf_of_row, row_mask) -> None:
         """Per-tree invariant checks (reference cuda_single_gpu_tree_learner
@@ -858,7 +862,12 @@ class GBDT:
         return (type(self) is GBDT
                 and self.objective is not None
                 and not self.objective.need_renew_tree_output
-                and getattr(self.objective, "_positions", None) is None
+                # the fused chunk jit-traces get_gradients; objectives
+                # with per-call mutable state (rank_xendcg's RNG split,
+                # lambdarank position-bias Newton updates) must stay on
+                # the eager per-iteration loop — jit_safe is the single
+                # source of that contract
+                and self.objective.jit_safe
                 and self.num_tree_per_iteration == 1
                 and self.parallel_mode is None
                 and not self.linear

@@ -65,6 +65,12 @@ class ObjectiveFunction:
     need_renew_tree_output: bool = False
     is_constant_hessian: bool = False
     need_convert_output: bool = False
+    #: get_gradients is a PURE function of the score (no per-call mutable
+    #: Python state), so the trainer may wrap it in one jax.jit.  Set
+    #: False where a call mutates state (rank_xendcg's RNG split;
+    #: lambdarank under position debiasing, whose bias factors update
+    #: each iteration).
+    jit_safe: bool = True
 
     def __init__(self, config: Config):
         self.config = config
@@ -77,9 +83,29 @@ class ObjectiveFunction:
         self._label = jnp.asarray(metadata.label, jnp.float32)
         self._weight = None if metadata.weight is None else \
             jnp.asarray(metadata.weight, jnp.float32)
+        # a cached gradient jit traced against the PREVIOUS dataset's
+        # labels/weights must not survive re-init (reset_training_data
+        # re-runs init on the same objective instance)
+        if hasattr(self, "_grad_jit"):
+            del self._grad_jit
 
     def get_gradients(self, score: jax.Array) -> Tuple[jax.Array, jax.Array]:
         raise NotImplementedError
+
+    def jitted_gradients(self, score: jax.Array
+                         ) -> Tuple[jax.Array, jax.Array]:
+        """``get_gradients`` under ONE ``jax.jit`` (cached per instance)
+        when the objective declares itself pure — one device dispatch per
+        iteration instead of one per op.  Eager per-op dispatch is ~free
+        on a co-located host but costs ~100 ms EACH through a tunneled
+        dev chip; lambdarank's ~40-op pairwise graph measured 13 s/iter
+        eager vs sub-second jitted at 1M rows.  Falls back to the eager
+        call for objectives with per-call mutable state (jit_safe)."""
+        if not self.jit_safe:
+            return self.get_gradients(score)
+        if not hasattr(self, "_grad_jit"):
+            self._grad_jit = jax.jit(self.get_gradients)
+        return self._grad_jit(score)
 
     def boost_from_score(self, class_id: int = 0) -> float:
         return 0.0
@@ -542,6 +568,9 @@ class LambdarankNDCG(ObjectiveFunction):
             self._pos_biases = np.zeros(len(ids), np.float64)
             self._pos_reg = float(
                 self.config.lambdarank_position_bias_regularization)
+            # bias factors mutate every call (the Newton update below and
+            # the score adjustment both read them) — not jittable
+            self.jit_safe = False
 
     def _update_position_bias(self, g: np.ndarray, h: np.ndarray) -> None:
         """Newton step on per-position bias factors (rank_objective.hpp:295
@@ -652,6 +681,8 @@ class RankXENDCG(ObjectiveFunction):
     """reference rank_objective.hpp:378 RankXENDCG (XE-NDCG-MART, Bruch et
     al.) — listwise cross-entropy with Gumbel-perturbed relevance targets."""
     NAME = "rank_xendcg"
+    # each call splits self._rng — per-call mutable state, not jittable
+    jit_safe = False
 
     def init(self, metadata, num_data):
         super().init(metadata, num_data)

@@ -5,6 +5,11 @@ import numpy as np
 import pytest
 
 import lightgbm_tpu as lgb
+from lightgbm_tpu.metrics import _weighted_auc
+
+
+def _auc(y, p):
+    return _weighted_auc(np.asarray(y, float), np.asarray(p, float), None)
 
 FAST = {"num_leaves": 7, "learning_rate": 0.2, "min_data_in_leaf": 5,
         "max_bin": 63, "verbosity": 0}
@@ -71,3 +76,50 @@ def test_cv_ranking(synthetic_ranking):
                  ds, num_boost_round=8, nfold=3)
     key = [k for k in res if "ndcg@5-mean" in k]
     assert key and res[key[0]][0] > 0.5
+
+
+def test_reset_training_data_refreshes_jitted_gradients():
+    """reset_training_data re-inits the objective on the SAME instance;
+    the cached gradient jit (ObjectiveFunction.jitted_gradients) traced
+    the old dataset's labels as constants and must be dropped, or
+    continued boosting silently fits the previous labels."""
+    rng = np.random.default_rng(8)
+    n = 2000
+    X = rng.normal(size=(n, 5)).astype(np.float32)
+    y_a = (X[:, 0] > 0).astype(np.float32)
+    y_b = 1.0 - y_a                      # exactly inverted labels
+    p = {"objective": "binary", "num_leaves": 15, "verbose": -1}
+    ds_a = lgb.Dataset(X, label=y_a, params=p)
+    bst = lgb.train(p, ds_a, num_boost_round=5)
+    # force the jit cache to exist, then reset to inverted labels
+    g_a, _ = bst._gbdt.objective.jitted_gradients(bst._gbdt.scores[:, 0])
+    ds_b = lgb.Dataset(X, label=y_b, params=p)
+    bst.reset_training_data(ds_b)
+    g_b, _ = bst._gbdt.objective.jitted_gradients(bst._gbdt.scores[:, 0])
+    # inverted labels must flip the gradient signs, not replay A's
+    corr = float(np.mean(np.sign(np.asarray(g_a)) ==
+                         np.sign(np.asarray(g_b))))
+    assert corr < 0.2, f"gradients still reflect the OLD labels ({corr})"
+    for _ in range(5):
+        bst._gbdt.train_one_iter()
+    pred = bst.predict(X)
+    auc_b = _auc(y_b, pred)
+    assert auc_b > 0.9, auc_b            # the model now fits B
+
+
+def test_xendcg_never_takes_the_fused_path():
+    """rank_xendcg splits its RNG every gradient call (per-call mutable
+    state, jit_safe=False) — tracing it into the fused chunk would
+    freeze the Gumbel perturbation and leak a tracer; the fused gate
+    must route it to the classic loop."""
+    rng = np.random.default_rng(3)
+    n = 600
+    X = rng.normal(size=(n, 4)).astype(np.float32)
+    rel = rng.integers(0, 4, size=n).astype(np.float32)
+    p = {"objective": "rank_xendcg", "verbose": -1, "num_leaves": 7}
+    ds = lgb.Dataset(X, label=rel, group=np.full(30, 20), params=p)
+    bst = lgb.train(p, ds, num_boost_round=3)
+    gb = bst._gbdt
+    assert not gb.objective.jit_safe
+    assert not gb.supports_fused()
+    assert bst.num_trees() == 3

@@ -75,12 +75,17 @@ def test_registration_table_matches_definitions():
             f"{name}: defined with {n} args, registered with {regs[name]}"
 
 
+def _glue_native_symbols():
+    """Every LGBMTPU_* symbol the glue references (one extraction rule
+    shared by the header and the built-library gates)."""
+    return set(re.findall(r"(LGBMTPU_\w+)\s*\(", _read(GLUE)))
+
+
 def test_native_calls_exist_in_abi_header():
     header = _read(os.path.join(REPO, "lightgbm_tpu", "native",
                                 "capi.h"))
     abi = set(re.findall(r"(LGBMTPU_\w+)\s*\(", header))
-    used = set(re.findall(r"(LGBMTPU_\w+)\s*\(", _read(GLUE)))
-    missing = used - abi
+    missing = _glue_native_symbols() - abi
     assert not missing, f"glue calls unknown ABI entries: {missing}"
 
 
@@ -166,7 +171,6 @@ def test_native_symbols_exported_by_built_library():
                          capture_output=True, text=True, timeout=60)
     assert res.returncode == 0, res.stderr
     exported = set(re.findall(r"\sT\s+(LGBMTPU_\w+)", res.stdout))
-    used = set(re.findall(r"(LGBMTPU_\w+)\s*\(", _read(GLUE)))
-    missing = used - exported
+    missing = _glue_native_symbols() - exported
     assert not missing, f"glue links symbols the library does not " \
                         f"export: {sorted(missing)}"
