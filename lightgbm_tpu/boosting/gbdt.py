@@ -23,6 +23,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 import numpy as np
 
 from ..config import Config
@@ -868,7 +869,6 @@ class GBDT:
                 # the eager per-iteration loop — jit_safe is the single
                 # source of that contract
                 and self.objective.jit_safe
-                and self.num_tree_per_iteration == 1
                 and self.parallel_mode is None
                 and not self.linear
                 and self.cegb is None
@@ -943,47 +943,71 @@ class GBDT:
         if not hasattr(self, "_fused_cache"):
             self._fused_cache = {}
 
+        k = self.num_tree_per_iteration
+
         def make_runner(T: int, has_fm: bool):
             def run(scores, bins, qkeys, nkeys, fmasks):
-                def body(sc, qkey_raw, node_key, fm):
-                    g, h = self.objective.get_gradients(sc)
-                    g_t, h_t = g, h
-                    hist_scale = None
-                    if quant:
-                        from ..ops.quantize import (
-                            discretize_gradients_levels)
-                        # fold_in(., 0) — the class fold the loop applies
-                        # at k=1 — runs IN-JIT on the raw key words
-                        qkey = jax.random.fold_in(qkey_raw, 0)
-                        g, h, gs, hs = discretize_gradients_levels(
-                            g, h, qkey, n_levels=n_levels,
-                            stochastic=stoch,
-                            constant_hessian=const_hess)
-                        hist_scale = jnp.stack([gs, hs])
-                    arrays, lor = grow_tree_batched(
-                        bins, g, h, None, self.num_bins_arr,
-                        self.nan_bin_arr, self.is_cat_arr, fm, self.hp,
-                        batch=int(self.config.tpu_split_batch),
-                        bundle=self.bundle, monotone=self.monotone_arr,
-                        hist_scale=hist_scale,
-                        interaction_sets=self.interaction_sets,
-                        rng_key=node_key, forced=self.forced_splits)
-                    if renew:
-                        renewed = renew_leaf_values(
-                            lor, g_t, h_t, None,
-                            num_leaves=self.hp.num_leaves,
-                            lambda_l1=self.hp.lambda_l1,
-                            lambda_l2=self.hp.lambda_l2)
-                        arrays = arrays._replace(leaf_value=jnp.where(
-                            arrays.num_leaves > 1, renewed,
-                            arrays.leaf_value))
-                    # shrink BEFORE the gather, exactly like the classic
-                    # loop (train_one_iter: shrunk = leaf_value * rate,
-                    # then take_small_table) — the other order differs by
-                    # an ulp and cascades through the quantization grid
-                    sc = sc + take_small_table(arrays.leaf_value * shrink,
-                                               lor)
-                    return sc, arrays
+                def body(sc, qkey_raw, node_keys, fm):
+                    # sc: [n, k].  One gradient evaluation per round,
+                    # then k per-class trees (one-vs-all, exactly the
+                    # classic loop's class order) — all in this jit.
+                    if k == 1:
+                        g2, h2 = self.objective.get_gradients(sc[:, 0])
+                        g2, h2 = g2[:, None], h2[:, None]
+                    else:
+                        g2, h2 = self.objective.get_gradients(sc)
+
+                    def class_body(sc_c, xs):
+                        # one-vs-all tree for one class — a lax.scan
+                        # iteration, NOT a python unroll: the grower
+                        # program compiles ONCE however large num_class
+                        # is (an unrolled loop multiplied compile time
+                        # and executable size by k)
+                        g, h, nkey, cls = xs
+                        g_t, h_t = g, h
+                        hist_scale = None
+                        if quant:
+                            from ..ops.quantize import (
+                                discretize_gradients_levels)
+                            # per-class fold on the raw key words — the
+                            # classic loop's fold_in(qkey, cls), in-jit
+                            qkey = jax.random.fold_in(qkey_raw, cls)
+                            g, h, gs, hs = discretize_gradients_levels(
+                                g, h, qkey, n_levels=n_levels,
+                                stochastic=stoch,
+                                constant_hessian=const_hess)
+                            hist_scale = jnp.stack([gs, hs])
+                        arrays, lor = grow_tree_batched(
+                            bins, g, h, None, self.num_bins_arr,
+                            self.nan_bin_arr, self.is_cat_arr, fm, self.hp,
+                            batch=int(self.config.tpu_split_batch),
+                            bundle=self.bundle, monotone=self.monotone_arr,
+                            hist_scale=hist_scale,
+                            interaction_sets=self.interaction_sets,
+                            rng_key=nkey, forced=self.forced_splits)
+                        if renew:
+                            renewed = renew_leaf_values(
+                                lor, g_t, h_t, None,
+                                num_leaves=self.hp.num_leaves,
+                                lambda_l1=self.hp.lambda_l1,
+                                lambda_l2=self.hp.lambda_l2)
+                            arrays = arrays._replace(leaf_value=jnp.where(
+                                arrays.num_leaves > 1, renewed,
+                                arrays.leaf_value))
+                        # shrink BEFORE the gather, exactly like the
+                        # classic loop (train_one_iter: shrunk =
+                        # leaf_value * rate, then take_small_table) — the
+                        # other order differs by an ulp and cascades
+                        # through the quantization grid
+                        sc_c = sc_c.at[:, cls].add(take_small_table(
+                            arrays.leaf_value * shrink, lor))
+                        return sc_c, arrays
+
+                    sc, stacked_cls = jax.lax.scan(
+                        class_body, sc,
+                        (g2.T, h2.T, node_keys,
+                         lax.iota(jnp.int32, k)))        # [k, ...] ys
+                    return sc, stacked_cls
 
                 if has_fm:
                     return jax.lax.scan(
@@ -1017,28 +1041,38 @@ class GBDT:
             # [s >> 32, s & 0xffffffff] — so a chunk ships ONE [T, 2]
             # array instead of ~3T tiny per-round device dispatches;
             # the class fold_in(., 0) runs inside the jitted body
-            def _key_words(base):
+            def _key_words(vals):
                 return np.array(
-                    [[(base + t) >> 32 & 0xffffffff,
-                      (base + t) & 0xffffffff] for t in range(T)],
-                    np.uint32)
-            qkeys = jnp.asarray(_key_words(seed_q + self.iter_))
-            nkeys = jnp.asarray(_key_words(seed_node + self.iter_))
+                    [[v >> 32 & 0xffffffff, v & 0xffffffff]
+                     for v in vals], np.uint32)
+            qkeys = jnp.asarray(_key_words(
+                [seed_q + self.iter_ + t for t in range(T)]))
+            # node keys per (round, class): the classic loop's
+            # PRNGKey(extra_seed * 1000003 + iter * k + cls)
+            nkeys = jnp.asarray(_key_words(
+                [seed_node + (self.iter_ + t) * k + cls
+                 for t in range(T) for cls in range(k)])
+            ).reshape(T, k, 2)
             scores, stacked = self._fused_cache[key](
-                self.scores[:, 0], self.bins, qkeys, nkeys, fmasks)
-            self.scores = scores[:, None]
+                self.scores, self.bins, qkeys, nkeys, fmasks)
+            self.scores = scores
             host = jax.device_get(stacked)     # ONE transfer per chunk
             for t in range(T):
-                arrays_t = jax.tree.map(lambda a: a[t], host)
-                with global_timer.timer("tree_finalize"):
-                    tree = Tree.from_arrays(arrays_t, self.train_set)
-                tree.apply_shrinkage(self.shrinkage_rate)
-                if self.iter_ == 0 and abs(self.init_scores[0]) > 1e-10:
-                    tree.add_bias(self.init_scores[0])
-                self.models.append(tree)
+                stumps = 0
+                for cls in range(k):
+                    arrays_tc = jax.tree.map(lambda a: a[t, cls], host)
+                    with global_timer.timer("tree_finalize"):
+                        tree = Tree.from_arrays(arrays_tc, self.train_set)
+                    tree.apply_shrinkage(self.shrinkage_rate)
+                    if self.iter_ == 0 and \
+                            abs(self.init_scores[cls]) > 1e-10:
+                        tree.add_bias(self.init_scores[cls])
+                    self.models.append(tree)
+                    if tree.num_leaves <= 1:
+                        stumps += 1
                 self.iter_ += 1
                 done += 1
-                if tree.num_leaves <= 1:
+                if stumps == k:
                     # the classic loop would have stopped here; drop any
                     # overrun rounds and rebuild scores without them
                     finished = True

@@ -1400,8 +1400,12 @@ def test_fused_ineligible_paths_fall_back(synthetic_binary):
                      "bagging_freq": 1}).supports_fused()
     assert not make({"linear_tree": True}).supports_fused()
     assert not make({"objective": "quantile"}).supports_fused()
-    assert not make({"num_class": 3,
-                     "objective": "multiclass"}).supports_fused()
+    # multiclass is fused-capable since the k-trees-per-round lift
+    # (small fixtures need an explicit split batch: the fused path
+    # rides the batched grower, and auto-K stays 1 below 100k rows);
+    # impure objectives (per-call RNG) are the remaining objective gate
+    assert make({"num_class": 3, "objective": "multiclass",
+                 "tpu_split_batch": 4}).supports_fused()
 
 
 def test_fused_feature_fraction_matches_loop():
@@ -1574,3 +1578,26 @@ def test_efb_quantized_compose():
     ds = lgb.Dataset(X, label=y, params=params)
     bst = lgb.train(params, ds, num_boost_round=30)
     assert _auc(y, bst.predict(X)) > 0.9
+
+
+def test_fused_multiclass_identical_to_loop():
+    """Fused rounds now carry k trees per scan step (one-vs-all, class
+    order and per-class PRNG folds matching the classic loop), so
+    multiclass training through train_fused must be bit-identical to
+    the per-iteration loop."""
+    rng = np.random.default_rng(13)
+    n = 3000
+    X = rng.normal(size=(n, 6)).astype(np.float32)
+    y = ((X[:, 0] > 0).astype(int) + (X[:, 1] > 0.5).astype(int))
+    p = {**FAST, "objective": "multiclass", "num_class": 3,
+         "tpu_split_batch": 4}
+    b_fused = lgb.train(p, lgb.Dataset(X, label=y, params=p),
+                        num_boost_round=6)
+    assert b_fused._gbdt.supports_fused()
+    noop = lambda env: None   # any callback forces the classic loop
+    b_loop = lgb.train(p, lgb.Dataset(X, label=y, params=p),
+                       num_boost_round=6, callbacks=[noop])
+    assert b_fused.model_to_string() == b_loop.model_to_string()
+    pr = b_fused.predict(X)
+    acc = float(np.mean(np.argmax(pr, axis=1) == y))
+    assert acc > 0.85
