@@ -578,10 +578,11 @@ def test_auto_speed_mode_at_scale():
     assert g.config.use_quantized_grad is False
     assert g.hp.hist_dtype == "float32"
 
-    # linear trees need true gradients and the strict learner
+    # linear trees need true gradients (no int8/quantized auto) but ARE
+    # batched-capable since the round-4 lift, so they get the auto K
     g = make({"num_leaves": 255, "linear_tree": True})
     assert g.config.use_quantized_grad is False
-    assert int(g.config.tpu_split_batch) == 1
+    assert int(g.config.tpu_split_batch) == 42
 
 
 # ---------------------------------------------------------------------------
