@@ -264,6 +264,19 @@ class Dataset:
         fn = None if self.feature_name in ("auto", None) else list(self.feature_name)
         cat = None if self.categorical_feature in ("auto", None) else \
             list(self.categorical_feature)
+        if cat is None:
+            # categorical_feature may also arrive through params (the
+            # reference honors both the Dataset kwarg and the parameter
+            # route, config.h categorical_feature aliases)
+            pcat = (self.params or {}).get("categorical_feature")
+            for alias in ("cat_feature", "categorical_column",
+                          "cat_column", "categorical_features"):
+                if pcat in (None, ""):
+                    pcat = (self.params or {}).get(alias)
+            if pcat not in (None, "", "auto"):
+                if isinstance(pcat, str):
+                    pcat = [int(x) for x in pcat.split(",") if x != ""]
+                cat = list(pcat)
         predictor = self._predictor
         if predictor is None and self.reference is not None:
             predictor = self.reference._predictor

@@ -19,6 +19,7 @@ so saved models are self-contained.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -1261,6 +1262,13 @@ class GBDT:
         return s[:, 0] if s.shape[1] == 1 else s
 
     # ------------------------------------------------------------- predict
+    #: rows x trees above which predict_raw batches on the device; below
+    #: it the host f64 walk wins (no binning pass, no compile) and keeps
+    #: full-double accumulation for the tiny inputs tests compare
+    #: bit-tightly.  At 1M rows x 100 trees the host walk measured 136 s
+    #: vs ~1 s device (round 4).
+    DEVICE_PREDICT_MIN_WORK = 20_000_000
+
     def predict_raw(self, X: np.ndarray, start_iteration: int = 0,
                     num_iteration: int = -1, early=None) -> np.ndarray:
         X = np.asarray(X, dtype=np.float64)
@@ -1270,6 +1278,12 @@ class GBDT:
         total_iters = len(self.models) // k
         end = total_iters if num_iteration <= 0 else \
             min(total_iters, start_iteration + num_iteration)
+        n_trees = max(0, (end - start_iteration) * k)
+        if (early is None and X.shape[0] * n_trees
+                >= self.DEVICE_PREDICT_MIN_WORK):
+            dev = self._device_predict_raw(X, start_iteration, end)
+            if dev is not None:
+                return dev
         out = np.zeros((X.shape[0], k))
         active = np.ones(X.shape[0], bool) if early is not None else None
         for it in range(start_iteration, end):
@@ -1284,6 +1298,122 @@ class GBDT:
                 if not active.any():
                     break
         return out[:, 0] if k == 1 else out
+
+    def _device_predict_raw(self, X: np.ndarray, start_it: int,
+                            end_it: int) -> Optional[np.ndarray]:
+        """Batched on-device prediction: bin X once with the training
+        mappers (a raw split ``value <= threshold`` is exactly
+        ``bin <= threshold_bin`` under them), stack the requested trees
+        into one [T, ...] pytree, and scan ``predict_bins_tree`` over
+        it — one compiled program instead of a per-tree host walk.
+        Returns None when a model family needs the host path (linear
+        leaves add per-leaf raw-feature terms the bin traversal lacks).
+        """
+        k = self.num_tree_per_iteration
+        models = self.models[start_it * k:end_it * k]
+        # linear leaves add per-leaf raw-feature terms the bin traversal
+        # lacks; CATEGORICAL models differ in raw space for categories
+        # unseen at training time (the host walk sends them
+        # right-unless-in-set per the reference, while bin space maps
+        # them onto the most frequent training category) — both keep
+        # the host path so outputs never depend on batch size
+        if (not models or any(t.is_linear for t in models)
+                or bool(self.hp.has_categorical)):
+            return None
+        bins_np = self.train_set.bin_external(X)
+        # row blocks bound the [ni, n] decision-bit transients of the
+        # matmul predictor (~0.5 GB bf16 per 1M rows at 255 leaves);
+        # ragged tails pad UP to a 131072 multiple so at most 8 block
+        # shapes ever compile (a fresh shape per remainder would pay
+        # seconds of XLA compile per distinct predict size)
+        blk = 1_048_576
+        tail_q = 131_072
+        if self.bundle is None:
+            from ..models.predict import predict_numeric_forest
+            fa = self._forest_arrays(models, k)
+            outs = []
+            n_all = bins_np.shape[0]
+            for r0 in range(0, n_all, blk):
+                chunk = bins_np[r0:r0 + blk]
+                rows = chunk.shape[0]
+                pad = (-rows) % min(tail_q, blk)
+                if pad:
+                    chunk = np.concatenate(
+                        [chunk, np.zeros((pad, chunk.shape[1]),
+                                         chunk.dtype)])
+                bins_t = jnp.asarray(np.ascontiguousarray(chunk.T))
+                outs.append(np.asarray(
+                    predict_numeric_forest(fa, bins_t, k),
+                    np.float64)[:rows])
+            out = np.concatenate(outs, axis=0)
+            return out[:, 0] if k == 1 else out
+        L = max(max(t.num_leaves for t in models), 2)
+        per_tree = [_tree_to_arrays_stub(t, self.train_set,
+                                         num_leaves_out=L)
+                    for t in models]
+        stacked = jax.tree.map(lambda *a: jnp.stack(a), *per_tree)
+        cls_idx = jnp.asarray(
+            np.arange(len(models), dtype=np.int32) % k)
+        out = _predict_stacked_trees(
+            stacked, cls_idx, jnp.asarray(bins_np), self.nan_bin_arr,
+            self.bundle, k, bool(self.hp.has_categorical))
+        out = np.asarray(out, np.float64)
+        return out[:, 0] if k == 1 else out
+
+    def _forest_arrays(self, models, k: int):
+        """Host Tree list -> stacked ForestArrays for the matmul batch
+        predictor: per tree, the per-node split operands plus each
+        leaf's path-direction masks (which internal-node decisions, and
+        in which direction, place a row in that leaf)."""
+        from ..models.predict import ForestArrays
+        L = max(max(t.num_leaves for t in models), 2)
+        ni = L - 1
+        T = len(models)
+        orig_to_packed = {o: p for p, o in
+                          enumerate(self.train_set.used_feature_idx)}
+        nan_bin_np = np.asarray(self.nan_bin_arr)
+        feat = np.zeros((T, ni), np.int32)
+        thr = np.zeros((T, ni), np.int32)
+        dl = np.zeros((T, ni), bool)
+        nanb = np.full((T, ni), -2, np.int32)
+        mpos = np.zeros((T, L, ni), np.float32)
+        mneg = np.zeros((T, L, ni), np.float32)
+        depth = np.full((T, L), -1, np.int32)
+        value = np.zeros((T, L), np.float32)
+        for ti, t in enumerate(models):
+            nn = max(t.num_leaves - 1, 0)
+            pf = np.array([orig_to_packed.get(int(f), 0)
+                           for f in t.split_feature[:nn]], np.int32)
+            feat[ti, :nn] = pf
+            thr[ti, :nn] = t.threshold_bin[:nn]
+            dl[ti, :nn] = (t.decision_type[:nn] & 2) > 0
+            nanb[ti, :nn] = nan_bin_np[pf] if nn else 0
+            value[ti, :t.num_leaves] = t.leaf_value[:t.num_leaves]
+            if t.num_leaves <= 1:
+                depth[ti, 0] = 0
+                continue
+            # DFS from the root recording each leaf's (node, direction)
+            # path; children encode leaves as -(leaf_idx + 1)
+            stack = [(0, [])]
+            while stack:
+                node, path = stack.pop()
+                for child, left in ((t.left_child[node], True),
+                                    (t.right_child[node], False)):
+                    p2 = path + [(node, left)]
+                    if child < 0:
+                        leaf = -int(child) - 1
+                        depth[ti, leaf] = len(p2)
+                        for nd, lft in p2:
+                            (mpos if lft else mneg)[ti, leaf, nd] = 1.0
+                    else:
+                        stack.append((int(child), p2))
+        return ForestArrays(
+            feat=jnp.asarray(feat), thr=jnp.asarray(thr),
+            dl=jnp.asarray(dl), nanb=jnp.asarray(nanb),
+            mpos=jnp.asarray(mpos, jnp.bfloat16),
+            mneg=jnp.asarray(mneg, jnp.bfloat16),
+            depth=jnp.asarray(depth), value=jnp.asarray(value),
+            cls=jnp.asarray(np.arange(T, dtype=np.int32) % k))
 
     def predict(self, X: np.ndarray, raw_score: bool = False,
                 start_iteration: int = 0, num_iteration: int = -1,
@@ -1338,12 +1468,34 @@ class GBDT:
         self.iter_ -= 1
 
 
+@functools.partial(jax.jit, static_argnames=("k", "has_cat"))
+def _predict_stacked_trees(stacked: TreeArrays, cls_idx: jax.Array,
+                           bins_d: jax.Array, nan_bin: jax.Array,
+                           bundle, k: int, has_cat: bool) -> jax.Array:
+    """Sum per-tree contributions over a stacked [T, ...] tree pytree
+    into per-class score columns (GBDT._device_predict_raw)."""
+    n = bins_d.shape[0]
+
+    def body(out, xs):
+        tree, cls = xs
+        contrib = predict_bins_tree(tree, bins_d, nan_bin, bundle,
+                                    has_cat)
+        return out.at[:, cls].add(contrib), None
+
+    out0 = jnp.zeros((n, k), jnp.float32)
+    out, _ = lax.scan(body, out0, (stacked, cls_idx))
+    return out
+
+
 def _tree_to_arrays_stub(tree: Tree, dataset: Dataset,
-                         exclude_bias: bool = False) -> TreeArrays:
+                         exclude_bias: bool = False,
+                         num_leaves_out: Optional[int] = None) -> TreeArrays:
     """Host Tree -> device TreeArrays (packed feature idx, bin thresholds).
     ``exclude_bias`` subtracts the folded boost-from-average bias so the
-    result is the tree's own contribution to the score tensors."""
-    L = max(tree.num_leaves, 2)
+    result is the tree's own contribution to the score tensors.
+    ``num_leaves_out`` pads every array to a common leaf capacity so
+    trees of different sizes stack into one [T, ...] pytree."""
+    L = max(num_leaves_out or tree.num_leaves, 2)
     ni = L - 1
     orig_to_packed = {o: p for p, o in enumerate(dataset.used_feature_idx)}
     sf = np.array([orig_to_packed.get(int(f), 0)

@@ -434,6 +434,13 @@ class Dataset:
                       "(single bin). Check your data or binning parameters.")
 
     def _bin_all(self, arr: np.ndarray) -> None:
+        self.bins = self._bin_matrix(arr)
+
+    def _bin_matrix(self, arr: np.ndarray) -> np.ndarray:
+        """Apply this dataset's per-feature mappers to a raw matrix —
+        the one binning implementation shared by construction
+        (``_bin_all``) and external-matrix prediction
+        (``bin_external``)."""
         n = arr.shape[0]
         used = self.used_feature_idx
         bins = np.zeros((n, len(used)), dtype=np.uint8)
@@ -442,7 +449,22 @@ class Dataset:
                       f"match Dataset ({self.num_total_features})")
         for col, j in enumerate(used):
             bins[:, col] = self.mappers[j].values_to_bins(arr[:, j]).astype(np.uint8)
-        self.bins = np.ascontiguousarray(bins)
+        return np.ascontiguousarray(bins)
+
+    def bin_external(self, arr: np.ndarray) -> np.ndarray:
+        """Bin an EXTERNAL raw matrix with this dataset's mappers (and
+        its EFB bundle layout) — the transformation a validation set
+        goes through at construction, exposed for on-device batched
+        prediction (boosting/gbdt.py ``_device_predict_raw``): a split
+        on ``threshold`` is exactly ``bin <= threshold_bin`` under these
+        mappers, so bin-space traversal reproduces raw-space decisions
+        (NUMERIC features; categorical raw-space semantics for unseen
+        categories differ, which is why the caller excludes categorical
+        models)."""
+        bins = self._bin_matrix(arr)
+        if self.bundle_plan is not None:
+            bins = apply_bundles(bins, self.bundle_plan)
+        return np.ascontiguousarray(bins)
 
     # --------------------------------------------------------------- utility
     def bin_threshold_to_value(self, packed_feature: int, bin_thr: int) -> float:

@@ -12,6 +12,7 @@ tree costs depth × O(n) gathers instead of per-row branching.
 from __future__ import annotations
 
 import functools
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -70,3 +71,61 @@ def predict_bins_leaf(tree: TreeArrays, bins: jax.Array,
 
     node = lax.while_loop(cond, body, node0)
     return (-node - 1).astype(jnp.int32)
+
+
+class ForestArrays(NamedTuple):
+    """Stacked per-tree operands for the matmul batch predictor
+    (``predict_numeric_forest``).  Built host-side by
+    boosting/gbdt.py ``_forest_arrays`` from the trained model list."""
+    feat: jax.Array     # i32 [T, ni] packed split feature per node
+    thr: jax.Array      # i32 [T, ni] bin threshold per node
+    dl: jax.Array       # bool [T, ni] missing default-left
+    nanb: jax.Array     # i32 [T, ni] nan bin of the node's feature
+    mpos: jax.Array     # bf16 [T, L, ni] 1 where leaf's path expects LEFT
+    mneg: jax.Array     # bf16 [T, L, ni] 1 where leaf's path expects RIGHT
+    depth: jax.Array    # i32 [T, L] path length (-1 for dead leaf slots)
+    value: jax.Array    # f32 [T, L] leaf values (shrunk, bias included)
+    cls: jax.Array      # i32 [T] score column (tree index % num_class)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def predict_numeric_forest(fa: ForestArrays, bins_t: jax.Array,
+                           k: int) -> jax.Array:
+    """Batched prediction over a stacked all-numeric forest — the
+    matmul reformulation of tree traversal (TPU redesign of the
+    reference's per-row walk, tree.h:137 ``Predict``).
+
+    The frontier walk (``predict_bins_leaf``) pays depth x O(n) RANDOM
+    gathers per tree — measured 0.68 s/tree at 1M rows on a v5e, gather
+    being the slowest TPU primitive.  Here each tree instead computes
+    every node's decision bit at once (``bins_t[feat]`` is a CONTIGUOUS
+    row gather), then matches rows to leaves by counting satisfied
+    path conditions with two [L, ni] x [ni, n] matmuls: a row lands in
+    leaf l iff its count equals l's path length.  All operands are
+    small integers, exact in bf16 (<= 256), so the MXU result is exact;
+    the leaf one-hot contracts with the value vector for the output.
+    ~250 GFLOP per 100-tree x 1M-row call — milliseconds of MXU time
+    instead of seconds of gathers.
+    """
+    n = bins_t.shape[1]
+
+    def tree_body(out, xs):
+        feat, thr, dl, nanb, mpos, mneg, depth, value, cls = xs
+        cols = bins_t[feat].astype(jnp.int32)           # [ni, n]
+        go = jnp.where(cols == nanb[:, None], dl[:, None],
+                       cols <= thr[:, None])
+        bits = go.astype(jnp.bfloat16)
+        counts = lax.dot_general(
+            mpos, bits, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) + lax.dot_general(
+            mneg, 1.0 - bits, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)         # [L, n] exact ints
+        sel = (counts.astype(jnp.int32) == depth[:, None]) \
+            & (depth[:, None] >= 0)
+        contrib = jnp.sum(value[:, None] * sel.astype(jnp.float32),
+                          axis=0)                        # [n]
+        return out.at[:, cls].add(contrib), None
+
+    out0 = jnp.zeros((n, k), jnp.float32)
+    out, _ = lax.scan(tree_body, out0, fa)
+    return out

@@ -1602,3 +1602,72 @@ def test_fused_multiclass_identical_to_loop():
     pr = b_fused.predict(X)
     acc = float(np.mean(np.argmax(pr, axis=1) == y))
     assert acc > 0.85
+
+
+def test_device_predict_parity_paths(monkeypatch):
+    """Large predictions batch on the device (GBDT._device_predict_raw):
+    the matmul path-aggregation predictor (numeric models) and the
+    frontier-walk fallback (categorical models) must both reproduce the
+    host f64 walk within f32 rounding, NaN rows included."""
+    from lightgbm_tpu.boosting.gbdt import GBDT
+    monkeypatch.setattr(GBDT, "DEVICE_PREDICT_MIN_WORK", 0)
+    rng = np.random.default_rng(21)
+    n = 4000
+
+    # numeric (matmul predictor)
+    X = rng.normal(size=(n, 6)).astype(np.float64)
+    X[::41, 2] = np.nan
+    y = (np.nan_to_num(X[:, 0]) + 0.5 * np.nan_to_num(X[:, 2])
+         + rng.normal(scale=0.4, size=n) > 0).astype(np.float64)
+    p = {**FAST, "objective": "binary"}
+    bst = lgb.train(p, lgb.Dataset(X, label=y, params=p),
+                    num_boost_round=12)
+    gb = bst._gbdt
+    dev = gb.predict_raw(X)
+    monkeypatch.setattr(GBDT, "DEVICE_PREDICT_MIN_WORK", 1 << 62)
+    host = gb.predict_raw(X)
+    np.testing.assert_allclose(dev, host, rtol=2e-5, atol=2e-6)
+
+    # categorical models DECLINE the device path: raw-space unseen
+    # categories go right-unless-in-set (reference semantics), which
+    # bin space cannot represent — outputs must not depend on batch size
+    monkeypatch.setattr(GBDT, "DEVICE_PREDICT_MIN_WORK", 0)
+    Xc = np.concatenate(
+        [rng.normal(size=(n, 3)),
+         rng.integers(0, 9, size=(n, 1)).astype(float)], axis=1)
+    yc = (Xc[:, 0] + (Xc[:, 3] % 3 == 1)
+          + rng.normal(scale=0.4, size=n) > 0.5).astype(np.float64)
+    pc = {**FAST, "objective": "binary", "categorical_feature": [3]}
+    bc = lgb.train(pc, lgb.Dataset(Xc, label=yc, params=pc),
+                   num_boost_round=12)
+    assert bc._gbdt._device_predict_raw(Xc, 0, 12) is None
+
+    # EFB-bundled numeric model: the frontier-walk device path
+    which = rng.integers(0, 9, size=n)
+    Xb = np.zeros((n, 9 + 2))
+    Xb[:, :2] = rng.normal(size=(n, 2))
+    Xb[np.arange(n), 2 + which] = 1.0
+    yb = (Xb[:, 0] + 0.6 * (which % 3 == 0)
+          + rng.normal(scale=0.3, size=n) > 0.3).astype(np.float64)
+    pb = {**FAST, "objective": "binary", "enable_bundle": True}
+    bb = lgb.train(pb, lgb.Dataset(Xb, label=yb, params=pb),
+                   num_boost_round=12)
+    gbb = bb._gbdt
+    if gbb.bundle is not None:
+        devb = gbb.predict_raw(Xb)
+        monkeypatch.setattr(GBDT, "DEVICE_PREDICT_MIN_WORK", 1 << 62)
+        hostb = gbb.predict_raw(Xb)
+        np.testing.assert_allclose(devb, hostb, rtol=2e-5, atol=2e-6)
+
+    # multiclass columns route to the right classes
+    monkeypatch.setattr(GBDT, "DEVICE_PREDICT_MIN_WORK", 0)
+    ym = ((X[:, 0] > 0).astype(int) + (np.nan_to_num(X[:, 1]) > 0.5)
+          .astype(int))
+    pm = {**FAST, "objective": "multiclass", "num_class": 3}
+    bm = lgb.train(pm, lgb.Dataset(X, label=ym, params=pm),
+                   num_boost_round=8)
+    gbm = bm._gbdt
+    devm = gbm.predict_raw(X)
+    monkeypatch.setattr(GBDT, "DEVICE_PREDICT_MIN_WORK", 1 << 62)
+    hostm = gbm.predict_raw(X)
+    np.testing.assert_allclose(devm, hostm, rtol=2e-5, atol=2e-6)
