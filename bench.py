@@ -36,6 +36,17 @@ BENCH_ROWS = int(os.environ.get("BENCH_ROWS", 1_000_000))
 BENCH_ITERS = int(os.environ.get("BENCH_ITERS", 20))
 NUM_LEAVES = int(os.environ.get("BENCH_LEAVES", 255))
 MAX_BIN = int(os.environ.get("BENCH_BIN", 255))
+# device histogram width: max_bin rounded up to a power of two — THE
+# rounding rule lives in lightgbm_tpu.io.dataset.device_bins_pow2 (same
+# as Dataset.device_n_bins); BENCH_BIN=63 exercises the reference GPU
+# doc's speed configuration (docs/GPU-Performance.rst:100-123).
+# Imported lazily in the measuring child processes: the supervisor parent
+# stays jax-import-free so a wedged tunnel can never hang it.
+
+
+def _n_bins() -> int:
+    from lightgbm_tpu.io.dataset import device_bins_pow2
+    return device_bins_pow2(MAX_BIN)
 # splits per histogram pass (learner/batch_grower.py); 1 = strict leaf-wise.
 # Round-4 int8 K sweep on the live chip: 28 -> 83.2, 36 -> 89.0(noisy),
 # 42 -> 76.9 ms/tree — with K-independent kernel cost, fewer rounds win;
@@ -242,7 +253,7 @@ def main_e2e():
         # warmup train covers fused_chunk_for(BENCH_ITERS) only when
         # BENCH_ITERS is divisible; ragged tails need their own runner)
         for L in sorted(set(_G.fused_chunks(BENCH_ITERS))):
-            if (L, has_fm) not in gb._fused_cache:
+            if (L, has_fm, 0, False) not in gb._fused_cache:
                 gb.train_fused(L)
     t0 = time.time()
     if gb.supports_fused():
@@ -302,7 +313,7 @@ def main():
     # recommendation).  BENCH_HIST_DTYPE=bfloat16/float32 to A/B.
     hist_dtype = os.environ.get("BENCH_HIST_DTYPE", "int8")
     hp = SplitHyper(num_leaves=NUM_LEAVES, min_data_in_leaf=0,
-                    min_sum_hessian_in_leaf=100.0, n_bins=256,
+                    min_sum_hessian_in_leaf=100.0, n_bins=_n_bins(),
                     rows_per_block=8192, hist_dtype=hist_dtype)
     bins_d = jnp.asarray(bins)
     label_d = jnp.asarray(label)

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from jax import lax
 import numpy as np
 
+from ..callback import EarlyStopException
 from ..config import Config
 from ..io.dataset import Dataset
 from ..learner.grower import CegbInput, DeviceBundle, TreeArrays, grow_tree
@@ -857,9 +858,13 @@ class GBDT:
         (``train_fused``).  The fused path must be a pure device program:
         anything that reads or writes host state per iteration — custom
         objectives, l1/quantile leaf renewal, position-debias bias
-        vectors, bagging/GOSS host RNG, CEGB acquisition state, linear
-        fits, DART drops, registered valid sets (their scores update per
-        tree), per-iter eval — keeps the classic loop."""
+        vectors, by-query bagging's host expansion, CEGB acquisition
+        state, linear fits, DART drops — keeps the classic loop.  Since
+        round 5, plain/pos-neg bagging and GOSS run in-jit (their masks
+        derive from ``fold_in(PRNGKey(bagging_seed), iter)`` in BOTH
+        paths — sample_strategy.py ``device_sample_fn``), and registered
+        valid sets ride the scan when every valid metric has a device
+        evaluation (``fused_valid_ok``)."""
         c = self.config
         return (type(self) is GBDT
                 and self.objective is not None
@@ -874,14 +879,43 @@ class GBDT:
                 and not self.linear
                 and self.cegb is None
                 and not bool(c.tpu_debug_checks)
-                and not self.valid_sets
-                and self._sampling_is_noop()
+                and (not self.valid_sets or self.fused_valid_ok())
+                and (self._sampling_is_noop()
+                     or self._device_sample_fn() is not None)
                 and self._use_batched_grower())
 
+    def _device_sample_fn(self):
+        """The sampling strategy's pure in-jit twin, or None (see
+        sample_strategy.py ``device_sample_fn``)."""
+        return self.sample_strategy.device_sample_fn(
+            self.train_set.metadata)
+
+    def fused_valid_ok(self) -> bool:
+        """Valid sets can ride the fused scan when every registered valid
+        metric has a traceable device evaluation (metrics.py
+        ``eval_device_traced``) and scoring is single-output (the device
+        metric kernels evaluate [n]-score columns)."""
+        from ..metrics import Metric as _MetricBase
+        if self.num_tree_per_iteration != 1:
+            return False
+        if bool(self.config.deterministic) or \
+                not bool(self.config.tpu_device_eval):
+            return False
+        for ms in self.valid_metrics:
+            if not ms:
+                return False
+            for m in ms:
+                has_traced = (type(m).eval_device_traced
+                              is not _MetricBase.eval_device_traced
+                              or m._DEV_KIND is not None)
+                if not has_traced:
+                    return False
+        return True
+
     def _sampling_is_noop(self) -> bool:
-        """No per-iteration host RNG: the default BaggingSampleStrategy
-        no-ops unless bagging is actually configured (bagging.hpp's own
-        is_use_subset gate)."""
+        """No per-iteration row sampling: the default
+        BaggingSampleStrategy no-ops unless bagging is actually
+        configured (bagging.hpp's own is_use_subset gate)."""
         c = self.config
         if str(c.data_sample_strategy) == "goss":
             return False
@@ -913,10 +947,23 @@ class GBDT:
             done += t
         return out
 
-    def train_fused(self, num_rounds: int, chunk: int = 0) -> bool:
+    def _fused_metric_layout(self):
+        """Static (set_name, display_name, bigger) rows matching the
+        concatenation order of the in-scan metric eval."""
+        rows = []
+        for vi, ms in enumerate(self.valid_metrics):
+            for m in ms:
+                for disp in m.display_names():
+                    rows.append((self.valid_names[vi], disp,
+                                 bool(m.bigger_is_better)))
+        return rows
+
+    def train_fused(self, num_rounds: int, chunk: int = 0,
+                    cb_driver=None, es_params=None) -> bool:
         """Run ``num_rounds`` boosting iterations with the gradient step,
-        tree growth and score update of every round inside ONE compiled
-        scan (chunked so two compilations cover any round count).
+        row sampling, tree growth, score update, valid-set scoring and
+        metric eval of every round inside ONE compiled scan (chunked so
+        two compilations cover any round count).
 
         The per-iteration dispatch of the classic loop costs ~0.2 s
         through a tunneled dev chip and ~1 ms even on a co-located host —
@@ -926,7 +973,23 @@ class GBDT:
         (gbdt.cpp boosting_on_gpu / cuda gbdt path); here the rounds
         themselves fuse.  Trees materialize on the host from ONE stacked
         transfer per chunk.  Returns True if growth finished early (a
-        stump round)."""
+        stump round).
+
+        ``cb_driver(iteration, evals)`` — optional host hook run once per
+        round with the device-evaluated metric list (engine.py feeds the
+        REAL callbacks through it, so early_stopping/log_evaluation/
+        record_evaluation semantics are bit-for-bit the classic loop's).
+        An EarlyStopException from it truncates this booster to the
+        detection round (score caches rebuilt) and re-raises.
+
+        ``es_params`` — optional (stopping_rounds, first_metric_only,
+        min_delta) mirror of the early_stopping callback, enabling the
+        IN-JIT stop flag: once the flag trips, remaining rounds in the
+        chunk skip growth entirely (lax.cond), so a stopped run pays no
+        overshoot compute.  Enabled only at min_delta == 0, where the
+        in-jit f32 comparisons provably agree with the host callback's
+        f64 comparisons of the same f32 values (strict >/< of identical
+        floats); the host decision stays authoritative either way."""
         from ..learner.batch_grower import grow_tree_batched
 
         if chunk <= 0:
@@ -945,10 +1008,39 @@ class GBDT:
             self._fused_cache = {}
 
         k = self.num_tree_per_iteration
+        nvalid = len(self.valid_sets)
+        mrows = self._fused_metric_layout() if nvalid else []
+        use_es = (es_params is not None and cb_driver is not None
+                  and nvalid > 0 and float(es_params[2]) == 0.0)
+        if use_es:
+            es_rounds, es_first, _ = int(es_params[0]), bool(es_params[1]), 0
+            bigger_arr = jnp.asarray([r[2] for r in mrows])
+            if es_first:
+                fam0 = mrows[0][1].split("@")[0]
+                consider = jnp.asarray(
+                    [r[1].split("@")[0] == fam0 for r in mrows])
+            else:
+                consider = jnp.ones((len(mrows),), bool)
 
         def make_runner(T: int, has_fm: bool):
-            def run(scores, bins, qkeys, nkeys, fmasks):
-                def body(sc, qkey_raw, node_keys, fm):
+            dev_sample = self._device_sample_fn() \
+                if not self._sampling_is_noop() else None
+
+            def eval_valid_traced(vsc):
+                parts = []
+                for vi, ms in enumerate(self.valid_metrics):
+                    for m in ms:
+                        parts.append(jnp.asarray(
+                            m.eval_device_traced(vsc[vi][:, 0],
+                                                 self.objective),
+                            jnp.float32))
+                return jnp.concatenate(parts) if parts else \
+                    jnp.zeros((0,), jnp.float32)
+
+            def run(scores, bins, qkeys, nkeys, fmasks, iters, vscores,
+                    es0):
+                def round_real(carry, qkey_raw, node_keys, fm, it):
+                    sc, vsc, es = carry
                     # sc: [n, k].  One gradient evaluation per round,
                     # then k per-class trees (one-vs-all, exactly the
                     # classic loop's class order) — all in this jit.
@@ -957,13 +1049,20 @@ class GBDT:
                         g2, h2 = g2[:, None], h2[:, None]
                     else:
                         g2, h2 = self.objective.get_gradients(sc)
+                    if dev_sample is not None:
+                        # in-jit bagging/GOSS draw — same key derivation
+                        # as the classic loop (sample_strategy.py)
+                        rmask, g2, h2 = dev_sample(it, g2, h2)
+                    else:
+                        rmask = None
 
-                    def class_body(sc_c, xs):
+                    def class_body(cs, xs):
                         # one-vs-all tree for one class — a lax.scan
                         # iteration, NOT a python unroll: the grower
                         # program compiles ONCE however large num_class
                         # is (an unrolled loop multiplied compile time
                         # and executable size by k)
+                        sc_c, vsc_c = cs
                         g, h, nkey, cls = xs
                         g_t, h_t = g, h
                         hist_scale = None
@@ -979,7 +1078,7 @@ class GBDT:
                                 constant_hessian=const_hess)
                             hist_scale = jnp.stack([gs, hs])
                         arrays, lor = grow_tree_batched(
-                            bins, g, h, None, self.num_bins_arr,
+                            bins, g, h, rmask, self.num_bins_arr,
                             self.nan_bin_arr, self.is_cat_arr, fm, self.hp,
                             batch=int(self.config.tpu_split_batch),
                             bundle=self.bundle, monotone=self.monotone_arr,
@@ -988,7 +1087,7 @@ class GBDT:
                             rng_key=nkey, forced=self.forced_splits)
                         if renew:
                             renewed = renew_leaf_values(
-                                lor, g_t, h_t, None,
+                                lor, g_t, h_t, rmask,
                                 num_leaves=self.hp.num_leaves,
                                 lambda_l1=self.hp.lambda_l1,
                                 lambda_l2=self.hp.lambda_l2)
@@ -1000,31 +1099,90 @@ class GBDT:
                         # leaf_value * rate, then take_small_table) — the
                         # other order differs by an ulp and cascades
                         # through the quantization grid
+                        shrunk = arrays.leaf_value * shrink
                         sc_c = sc_c.at[:, cls].add(take_small_table(
-                            arrays.leaf_value * shrink, lor))
-                        return sc_c, arrays
+                            shrunk, lor))
+                        if nvalid:
+                            arrays_s = arrays._replace(leaf_value=shrunk)
+                            vsc_c = tuple(
+                                v.at[:, cls].add(predict_bins_tree(
+                                    arrays_s, self._valid_bins[vi],
+                                    self.nan_bin_arr, self.bundle,
+                                    self.hp.has_categorical))
+                                for vi, v in enumerate(vsc_c))
+                        return (sc_c, vsc_c), arrays
 
-                    sc, stacked_cls = jax.lax.scan(
-                        class_body, sc,
+                    (sc, vsc), stacked_cls = jax.lax.scan(
+                        class_body, (sc, vsc),
                         (g2.T, h2.T, node_keys,
                          lax.iota(jnp.int32, k)))        # [k, ...] ys
-                    return sc, stacked_cls
+                    mvals = eval_valid_traced(vsc) if nvalid else \
+                        jnp.zeros((0,), jnp.float32)
+                    if use_es:
+                        best, best_it, seen, stopped = es
+                        # a first evaluation ALWAYS improves (the host
+                        # callback's `best is None` bootstrap — also the
+                        # NaN case, where a float compare would say no)
+                        improved = (jnp.where(bigger_arr, mvals > best,
+                                              mvals < best) | ~seen) \
+                            & consider
+                        best = jnp.where(improved, mvals, best)
+                        # best_it carries ABSOLUTE iteration indices and
+                        # is always set from a real round before the
+                        # stall test can trip (seen gate), so continued
+                        # training (iter_ > 0 at entry) counts correctly
+                        best_it = jnp.where(improved, it, best_it)
+                        seen = seen | consider
+                        trip = consider & seen & ~improved & \
+                            (it - best_it >= es_rounds)
+                        es = (best, best_it, seen, stopped | jnp.any(trip))
+                    return (sc, vsc, es), (stacked_cls, mvals)
 
-                if has_fm:
-                    return jax.lax.scan(
-                        lambda sc, xs: body(sc, *xs),
-                        scores, (qkeys, nkeys, fmasks))
-                return jax.lax.scan(
-                    lambda sc, xs: body(sc, xs[0], xs[1], None),
-                    scores, (qkeys, nkeys))
+                def body(carry, xs):
+                    if has_fm:
+                        qkey_raw, node_keys, fm, it = xs
+                    else:
+                        (qkey_raw, node_keys, it), fm = xs, None
+
+                    def real(c):
+                        return round_real(c, qkey_raw, node_keys, fm, it)
+
+                    if not use_es:
+                        return real(carry)
+                    # stop flag tripped: skip growth, emit zero ys (the
+                    # host truncates at the detection round and never
+                    # reads them)
+                    ys_shape = jax.eval_shape(real, carry)[1]
+                    zeros = jax.tree.map(
+                        lambda s: jnp.zeros(s.shape, s.dtype), ys_shape)
+                    return lax.cond(carry[2][3],
+                                    lambda c: (c, zeros), real, carry)
+
+                xs = (qkeys, nkeys, fmasks, iters) if has_fm else \
+                    (qkeys, nkeys, iters)
+                return jax.lax.scan(body, (scores, vscores, es0), xs)
             return jax.jit(run)
 
         finished = False
         done = 0
         has_fm = frac < 1.0
+        # callbacks see RELATIVE round indices (the classic loop passes
+        # `it` from range(num_boost_round)); continued training starts
+        # iter_ at num_init_iteration, so the offset matters
+        begin_iter = self.iter_
+        # in-jit early-stop state persists ACROSS chunks (one callback
+        # state machine per train() run, like the classic loop's)
+        if use_es:
+            M = len(mrows)
+            es_host = (jnp.where(bigger_arr, -jnp.inf, jnp.inf),
+                       jnp.zeros((M,), jnp.int32),
+                       jnp.zeros((M,), bool), jnp.bool_(False))
+        else:
+            es_host = ()
+        self._last_fused_evals = []
         while done < num_rounds and not finished:
             T = min(chunk, num_rounds - done)
-            key = (T, has_fm)
+            key = (T, has_fm, nvalid, use_es)
             if key not in self._fused_cache:
                 self._fused_cache[key] = make_runner(T, has_fm)
             fmasks = None
@@ -1054,10 +1212,16 @@ class GBDT:
                 [seed_node + (self.iter_ + t) * k + cls
                  for t in range(T) for cls in range(k)])
             ).reshape(T, k, 2)
-            scores, stacked = self._fused_cache[key](
-                self.scores, self.bins, qkeys, nkeys, fmasks)
+            iters = jnp.arange(self.iter_, self.iter_ + T, dtype=jnp.int32)
+            (scores, vscores, es_host), (stacked, mvals) = \
+                self._fused_cache[key](
+                    self.scores, self.bins, qkeys, nkeys, fmasks, iters,
+                    tuple(self.valid_scores), es_host)
             self.scores = scores
+            for vi in range(nvalid):
+                self.valid_scores[vi] = vscores[vi]
             host = jax.device_get(stacked)     # ONE transfer per chunk
+            mhost = np.asarray(jax.device_get(mvals)) if nvalid else None
             for t in range(T):
                 stumps = 0
                 for cls in range(k):
@@ -1073,6 +1237,28 @@ class GBDT:
                         stumps += 1
                 self.iter_ += 1
                 done += 1
+                if nvalid:
+                    self._last_fused_evals = [
+                        (mrows[j][0], mrows[j][1], float(mhost[t, j]),
+                         mrows[j][2]) for j in range(len(mrows))]
+                if cb_driver is not None:
+                    try:
+                        # feed the REAL callbacks this round's
+                        # device-evaluated metrics — identical state
+                        # machine to the classic loop's post-iteration
+                        # callback pass; iteration is RELATIVE to this
+                        # train() run, like the classic loop's range()
+                        cb_driver(self.iter_ - 1 - begin_iter,
+                                  self._last_fused_evals)
+                    except EarlyStopException:
+                        # models stop at the detection round (later
+                        # rounds were never materialized); the device
+                        # advanced the score caches by the whole chunk —
+                        # rebuild from the kept models unless the stop
+                        # landed exactly on the chunk's last round
+                        if t + 1 < T:
+                            self.invalidate_score_cache()
+                        raise
                 if stumps == k:
                     # the classic loop would have stopped here; drop any
                     # overrun rounds and rebuild scores without them

@@ -36,50 +36,97 @@ class SampleStrategy:
         reweighted (GOSS)."""
         return None, grad, hess
 
+    def device_sample_fn(self, metadata: Metadata):
+        """A pure jit-safe ``(iter_idx, grad, hess) -> (row_mask or None,
+        grad, hess)`` twin of ``sample`` for the fused training scan
+        (GBDT.train_fused), or None when the strategy needs host state
+        per iteration.  ``iter_idx`` may be a traced i32 scalar; grad and
+        hess are [n, k].  Strategies that CAN run on device derive their
+        per-iteration randomness from ``fold_in(PRNGKey(bagging_seed),
+        iteration)`` in BOTH paths, so fused and classic training grow
+        identical trees."""
+        return None
+
 
 class BaggingSampleStrategy(SampleStrategy):
     """bagging_fraction / bagging_freq / pos+neg bagging
-    (reference bagging.hpp)."""
+    (reference bagging.hpp).
+
+    The plain-fraction and pos/neg paths derive each resample from
+    ``fold_in(PRNGKey(bagging_seed), resample_index)`` — a pure function
+    of the iteration — so the fused scan (``device_sample_fn``) and the
+    classic loop draw IDENTICAL masks.  By-query bagging keeps the host
+    numpy draw (its query expansion is a host loop over boundaries)."""
 
     def __init__(self, config: Config, num_data: int):
         super().__init__(config, num_data)
         self._mask: Optional[jax.Array] = None
+        self._mask_iter = -1
         self._use_pos_neg = (config.pos_bagging_fraction < 1.0 or
                              config.neg_bagging_fraction < 1.0)
         self._rng = np.random.default_rng(config.bagging_seed)
 
-    def _need_resample(self, iter_: int) -> bool:
-        freq = self.config.bagging_freq
-        if freq <= 0:
-            return False
-        full = (self.config.bagging_fraction < 1.0) or self._use_pos_neg
-        if not full:
-            return False
-        return iter_ % freq == 0
+    def _active(self) -> bool:
+        return self.config.bagging_freq > 0 and (
+            self.config.bagging_fraction < 1.0 or self._use_pos_neg)
+
+    def _by_query(self, metadata) -> bool:
+        return (bool(self.config.bagging_by_query)
+                and not self._use_pos_neg
+                and metadata.query_boundaries is not None)
+
+    def _device_mask(self, iter_idx, metadata: Metadata) -> jax.Array:
+        """Pure per-iteration mask: freq-held resamples keyed on the
+        resample index (iter // freq), matching bagging.hpp's cadence of
+        resampling when ``iter % freq == 0`` and holding in between."""
+        cfg = self.config
+        n = self.num_data
+        freq = max(int(cfg.bagging_freq), 1)
+        key = jax.random.fold_in(jax.random.PRNGKey(cfg.bagging_seed),
+                                 iter_idx // freq)
+        u = jax.random.uniform(key, (n,))
+        if self._use_pos_neg:
+            if not hasattr(self, "_pos_dev"):
+                self._pos_dev = jnp.asarray(
+                    np.asarray(metadata.label) > 0)
+            m = jnp.where(self._pos_dev,
+                          u < cfg.pos_bagging_fraction,
+                          u < cfg.neg_bagging_fraction)
+        else:
+            m = u < cfg.bagging_fraction
+        # empty-mask rescue (bagging.hpp re-draws; here: deterministic)
+        return jnp.where(jnp.any(m), m, m.at[0].set(True))
+
+    def device_sample_fn(self, metadata):
+        if not self._active():
+            return None
+        if self._by_query(metadata):
+            return None
+
+        def fn(iter_idx, grad, hess):
+            return self._device_mask(iter_idx, metadata), grad, hess
+        return fn
 
     def sample(self, iter_, grad, hess, rng, metadata):
-        if self.config.bagging_freq <= 0 or (
-                self.config.bagging_fraction >= 1.0 and not self._use_pos_neg):
+        if not self._active():
             return None, grad, hess
-        if self._need_resample(iter_) or self._mask is None:
+        if not self._by_query(metadata):
+            # same derivation as the fused path; recompute only at the
+            # resample cadence
+            freq = max(int(self.config.bagging_freq), 1)
+            ridx = iter_ // freq
+            if self._mask is None or ridx != self._mask_iter:
+                self._mask = self._device_mask(jnp.int32(iter_), metadata)
+                self._mask_iter = ridx
+            return self._mask, grad, hess
+        if iter_ % self.config.bagging_freq == 0 or self._mask is None:
             n = self.num_data
-            if self._use_pos_neg:
-                lbl = np.asarray(metadata.label) > 0
-                m = np.zeros(n, bool)
-                m[lbl] = self._rng.random(int(lbl.sum())) < \
-                    self.config.pos_bagging_fraction
-                m[~lbl] = self._rng.random(int((~lbl).sum())) < \
-                    self.config.neg_bagging_fraction
-            elif self.config.bagging_by_query and \
-                    metadata.query_boundaries is not None:
-                qb = metadata.query_boundaries
-                nq = len(qb) - 1
-                qm = self._rng.random(nq) < self.config.bagging_fraction
-                m = np.zeros(n, bool)
-                for qi in np.nonzero(qm)[0]:
-                    m[qb[qi]:qb[qi + 1]] = True
-            else:
-                m = self._rng.random(n) < self.config.bagging_fraction
+            qb = metadata.query_boundaries
+            nq = len(qb) - 1
+            qm = self._rng.random(nq) < self.config.bagging_fraction
+            m = np.zeros(n, bool)
+            for qi in np.nonzero(qm)[0]:
+                m[qb[qi]:qb[qi + 1]] = True
             if not m.any():
                 m[self._rng.integers(0, n)] = True
             self._mask = jnp.asarray(m)
@@ -98,26 +145,30 @@ class GOSSStrategy(SampleStrategy):
         super().__init__(config, num_data)
         if config.top_rate + config.other_rate > 1.0:
             log.fatal("top_rate + other_rate cannot be larger than 1.0")
-        self._key = jax.random.PRNGKey(config.bagging_seed)
 
-    def sample(self, iter_, grad, hess, rng, metadata):
+    def _warmup_iters(self) -> int:
         # reference starts GOSS after 1/learning_rate warmup iterations
-        warmup = min(int(1.0 / max(self.config.learning_rate, 1e-6)),
-                     self.config.num_iterations // 2)
-        if iter_ < warmup:
-            return None, grad, hess
+        return min(int(1.0 / max(self.config.learning_rate, 1e-6)),
+                   self.config.num_iterations // 2)
+
+    def _goss_select(self, iter_idx, grad, hess):
+        """Pure GOSS draw for one iteration: the per-iteration randomness
+        is ``fold_in(PRNGKey(bagging_seed), iter)`` so the fused scan and
+        the classic loop select identical rows."""
         n = self.num_data
         a, b = self.config.top_rate, self.config.other_rate
         top_k = max(1, int(n * a))
-        score = jnp.sum(jnp.abs(grad) * jnp.sqrt(jnp.abs(hess) + 1e-12), axis=1)
-        # exact top-k membership (ties broken by index) — a >= threshold test
-        # floods the top set when gradients tie, e.g. constant-|grad| l1
+        score = jnp.sum(jnp.abs(grad) * jnp.sqrt(jnp.abs(hess) + 1e-12),
+                        axis=1)
+        # exact top-k membership (ties broken by index) — a >= threshold
+        # test floods the top set when gradients tie (constant-|grad| l1)
         order = jnp.argsort(-score, stable=True)
         is_top = jnp.zeros(n, bool).at[order[:top_k]].set(True)
         if b <= 0.0:
             return is_top, grad, hess
         other_k = max(1, int(n * b))
-        self._key, sub = jax.random.split(self._key)
+        sub = jax.random.fold_in(
+            jax.random.PRNGKey(self.config.bagging_seed), iter_idx)
         u = jax.random.uniform(sub, (n,))
         # sample from the non-top pool with probability other_k / pool_size
         pool = jnp.maximum(n - jnp.sum(is_top), 1)
@@ -127,6 +178,26 @@ class GOSSStrategy(SampleStrategy):
         amp = (1.0 - a) / b
         mult = jnp.where(is_other, amp, 1.0)[:, None]
         return mask, grad * mult, hess * mult
+
+    def device_sample_fn(self, metadata):
+        warmup = self._warmup_iters()
+
+        def fn(iter_idx, grad, hess):
+            mask, g2, h2 = self._goss_select(iter_idx, grad, hess)
+            # warmup rounds use the full data (all-ones mask, unscaled) —
+            # a traced-iteration-safe jnp.where of the classic loop's
+            # early-return
+            active = iter_idx >= warmup
+            mask = jnp.where(active, mask, True)
+            g2 = jnp.where(active, g2, grad)
+            h2 = jnp.where(active, h2, hess)
+            return mask, g2, h2
+        return fn
+
+    def sample(self, iter_, grad, hess, rng, metadata):
+        if iter_ < self._warmup_iters():
+            return None, grad, hess
+        return self._goss_select(jnp.int32(iter_), grad, hess)
 
 
 def create_sample_strategy(config: Config, num_data: int) -> SampleStrategy:

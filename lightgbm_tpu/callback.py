@@ -40,6 +40,10 @@ def log_evaluation(period: int = 1, show_stdv: bool = True) -> Callable:
                                  if show_stdv else f"{name}'s {metric}: {val:g}")
             log.info(f"[{env.iteration + 1}]\t" + "\t".join(parts))
     _callback.order = 10
+    # fused-training contract (engine.py / GBDT.train_fused): this callback
+    # only READS the per-iteration eval list, so it can be driven from the
+    # host replay of a fused chunk's device-evaluated metrics
+    _callback.fused_safe = True
     return _callback
 
 
@@ -62,6 +66,7 @@ def record_evaluation(eval_result: Dict[str, Dict[str, List[float]]]) -> Callabl
             eval_result.setdefault(name, collections.OrderedDict())
             eval_result[name].setdefault(metric, []).append(val)
     _callback.order = 20
+    _callback.fused_safe = True   # reads the eval list only (see above)
     return _callback
 
 
@@ -142,4 +147,8 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
                              f" [{best_iter[i] + 1}]")
                 raise EarlyStopException(best_iter[i], state["best_list"][i])
     _callback.order = 30
+    _callback.fused_safe = True   # reads the eval list only (see above)
+    # introspection for the fused path's optional IN-JIT compute gating
+    # (GBDT.train_fused skips growth in rounds past the would-be stop)
+    _callback.es_params = (stopping_rounds, first_metric_only, min_delta)
     return _callback

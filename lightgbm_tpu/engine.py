@@ -66,21 +66,48 @@ def train(params: Dict[str, Any], train_set: Dataset,
     cbs_after = [cb for cb in callbacks if not getattr(cb, "before_iteration",
                                                        False)]
 
-    # fused-rounds fast path: with nothing to observe per iteration (no
-    # callbacks, valid sets, custom eval/objective or train metric) the
-    # whole boosting run executes as chunked on-device scans
-    # (GBDT.train_fused) — one dispatch per ~32 rounds instead of one per
-    # round, which removes ~0.2 s/round of host/device round trips on
-    # tunneled chips and ~1 ms/round on co-located hosts.
-    if (not callbacks and not valid_pairs and not train_in_valid
+    # fused-rounds fast path: when every per-iteration observer can be
+    # driven from device-evaluated metrics — no callbacks at all, or only
+    # fused-safe ones (early_stopping / log_evaluation /
+    # record_evaluation, which READ the eval list) with device-evaluable
+    # valid metrics — the whole boosting run executes as chunked
+    # on-device scans (GBDT.train_fused): one dispatch per ~32 rounds
+    # instead of one per round, which removes ~0.2 s/round of host/device
+    # round trips on tunneled chips and ~1 ms/round on co-located hosts.
+    # Valid-set scoring, metric eval and the early-stop flag ride the
+    # scan; the REAL callbacks run on the host once per round with the
+    # device-computed values, so their semantics are exactly the classic
+    # loop's.
+    cbs_fused_safe = all(getattr(cb, "fused_safe", False)
+                         for cb in callbacks) and not cbs_before
+    if (cbs_fused_safe and not train_in_valid
             and feval is None and fobj is None and num_boost_round > 0
             and not booster._gbdt.config.is_provide_training_metric
+            and (not valid_pairs or callbacks)
             and booster._gbdt.supports_fused()):
-        with global_timer.timer("train_fused"):
-            finished = booster._gbdt.train_fused(num_boost_round)
+        es_params = next((cb.es_params for cb in callbacks
+                          if getattr(cb, "es_params", None)), None)
+
+        def cb_driver(it, evals):
+            for cb in cbs_after:
+                cb(CallbackEnv(booster, params, it, 0, num_boost_round,
+                               evals))
+        try:
+            with global_timer.timer("train_fused"):
+                finished = booster._gbdt.train_fused(
+                    num_boost_round,
+                    cb_driver=cb_driver if callbacks else None,
+                    es_params=es_params)
+        except EarlyStopException as e:
+            booster.best_iteration = e.best_iteration + 1
+            _set_best_score(booster, e.best_score)
+            return booster
         if finished:
             log.warning("Stopped training because there are no more "
                         "leaves that meet the split requirements")
+        if booster.best_iteration <= 0:
+            _set_best_score(booster,
+                            booster._gbdt._last_fused_evals or [])
         return booster
 
     evals: List = []

@@ -29,6 +29,15 @@ from .bundling import (BundlePlan, apply_bundles, plan_bundles,
 MAX_UINT8_BINS = 256
 
 
+def device_bins_pow2(widest: int) -> int:
+    """Device histogram bin-axis width for a widest-column bin count:
+    rounded up to a power of two (lane-friendly), floor 4.  THE rounding
+    rule — ``Dataset.device_n_bins`` and the bench scripts (bench.py,
+    tools/sweep_perf.py, tools/profile_bench.py) must agree on it or the
+    bench measures a bin width the real pipeline doesn't use."""
+    return max(1 << max(1, (int(widest) - 1).bit_length()), 4)
+
+
 def _as_2d_float(data: Any) -> np.ndarray:
     """Accept numpy / pandas / list-of-rows; return float64 [n, F] with NaN
     for missing (the reference accepts mat/CSR/CSC/pandas via c_api)."""
@@ -169,8 +178,7 @@ class Dataset:
                 total = 1 + sum(self.mappers[self.used_feature_idx[f]].num_bin
                                 - 1 for f in members)
                 widest = max(widest, total)
-        n_bins = 1 << max(1, (widest - 1).bit_length())
-        return max(n_bins, 4)
+        return device_bins_pow2(widest)
 
     def device_bundle_arrays(self):
         """EFB tables trimmed to ``device_n_bins`` width, or None

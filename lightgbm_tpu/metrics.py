@@ -142,6 +142,19 @@ class Metric:
         """Device-path evaluation over the resident score array; returns
         None when this metric/config has no device path (the caller then
         falls back to host ``eval``)."""
+        vals = self.eval_device_traced(score_dev, objective)
+        if vals is None:
+            return None
+        import numpy as np
+        host = np.asarray(vals)
+        return [(name, float(host[i]))
+                for i, name in enumerate(self.display_names())]
+
+    def eval_device_traced(self, score_dev, objective=None):
+        """Traceable device evaluation: a f32 [len(display_names())] array
+        of metric values, or None when no device path exists.  Safe to
+        call INSIDE jit (the fused training scan evaluates valid metrics
+        per round with this); ``eval_device`` is the host wrapper."""
         if self._DEV_KIND is None:
             return None
         import jax.numpy as jnp
@@ -149,7 +162,7 @@ class Metric:
         p = self._dev_convert(score_dev, objective)
         val = _dev_pointwise(self._DEV_KIND)(
             p, y, w, jnp.float32(self.sum_weight))
-        return [(self.NAME, float(val))]
+        return jnp.reshape(val, (1,))
 
     def display_names(self) -> List[str]:
         """Metric display names in eval() output order, computable WITHOUT
@@ -157,11 +170,20 @@ class Metric:
         return [self.NAME]
 
     def _dev_arrays(self):
+        import jax
         import jax.numpy as jnp
         if not hasattr(self, "_label_dev"):
-            self._label_dev = jnp.asarray(self.label, jnp.float32)
-            self._weight_dev = None if self.weight is None else \
+            label_dev = jnp.asarray(self.label, jnp.float32)
+            weight_dev = None if self.weight is None else \
                 jnp.asarray(self.weight, jnp.float32)
+            if isinstance(label_dev, jax.core.Tracer):
+                # called under an ABSTRACT trace (e.g. the fused scan's
+                # eval_shape): caching a tracer would leak it into later
+                # real evaluations — return uncached, cache on the first
+                # concrete call
+                return label_dev, weight_dev
+            self._label_dev = label_dev
+            self._weight_dev = weight_dev
         return self._label_dev, self._weight_dev
 
     def _dev_convert(self, score, objective):
@@ -326,9 +348,10 @@ class AUCMetric(Metric):
     def eval(self, score, objective=None):
         return [(self.NAME, _weighted_auc(self.label, score, self.weight))]
 
-    def eval_device(self, score_dev, objective=None):
+    def eval_device_traced(self, score_dev, objective=None):
+        import jax.numpy as jnp
         y, w = self._dev_arrays()
-        return [(self.NAME, float(_dev_auc()(score_dev, y, w)))]
+        return jnp.reshape(_dev_auc()(score_dev, y, w), (1,))
 
 
 class AveragePrecisionMetric(Metric):
@@ -458,15 +481,16 @@ class NDCGMetric(Metric):
     def display_names(self):
         return [f"ndcg@{k}" for k in self.ks]
 
-    def eval_device(self, score_dev, objective=None):
+    def eval_device_traced(self, score_dev, objective=None):
+        import jax
         import jax.numpy as jnp
         if not hasattr(self, "_qidx_dev"):
             from .objectives import _pad_queries
             qidx, _, qmax = _pad_queries(self.bounds)
-            self._qidx_dev = jnp.asarray(qidx)
-            self._gain_dev = jnp.asarray(
+            qidx_dev = jnp.asarray(qidx)
+            gain_dev = jnp.asarray(
                 self.label_gain[self.label.astype(int)], jnp.float32)
-            self._disc_dev = jnp.asarray(
+            disc_dev = jnp.asarray(
                 1.0 / np.log2(np.arange(max(qmax, 1)) + 2.0), jnp.float32)
             idcgs = np.zeros((len(self.ks), len(self.bounds) - 1), np.float32)
             for qi in range(len(self.bounds) - 1):
@@ -475,11 +499,18 @@ class NDCGMetric(Metric):
                 ideal = np.argsort(-lbl, kind="mergesort")
                 for i, k in enumerate(self.ks):
                     idcgs[i, qi] = _dcg_at_k(lbl, ideal, k, self.label_gain)
-            self._idcg_dev = jnp.asarray(idcgs)
-        vals = np.asarray(_dev_ndcg(tuple(self.ks))(
+            idcg_dev = jnp.asarray(idcgs)
+            if isinstance(qidx_dev, jax.core.Tracer):
+                # abstract trace (see Metric._dev_arrays): use uncached
+                return _dev_ndcg(tuple(self.ks))(
+                    score_dev, qidx_dev, gain_dev, idcg_dev, disc_dev)
+            self._qidx_dev = qidx_dev
+            self._gain_dev = gain_dev
+            self._disc_dev = disc_dev
+            self._idcg_dev = idcg_dev
+        return _dev_ndcg(tuple(self.ks))(
             score_dev, self._qidx_dev, self._gain_dev, self._idcg_dev,
-            self._disc_dev))
-        return [(f"ndcg@{k}", float(vals[i])) for i, k in enumerate(self.ks)]
+            self._disc_dev)
 
 
 class MapMetric(Metric):

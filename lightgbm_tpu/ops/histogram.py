@@ -48,7 +48,8 @@ def use_pallas() -> bool:
         return False
 
 
-def _pallas_blk(hist_dtype: str, float_cap: int = 1024) -> int:
+def _pallas_blk(hist_dtype: str, n_bins: int = 256,
+                float_cap: int = 1024) -> int:
     """Row-block cap for the flat/payload Pallas kernels.
 
     Round-4 tuning: ISOLATED int8 kernels run ~1.7x faster at blk=2048
@@ -58,7 +59,13 @@ def _pallas_blk(hist_dtype: str, float_cap: int = 1024) -> int:
     [3K, F*B] f32 accumulator plus the wider one-hot crowd VMEM and
     stall the grid's double buffering.  Standalone wins do not survive
     composition here; stay at 1024 until a K-aware model is measured.
+
+    At <= 64 bins (the reference GPU docs' speed configuration) the
+    accumulator and one-hot are 4x smaller, VMEM pressure disappears and
+    the wider block wins in context too (round-5 measurement).
     """
+    if n_bins <= 64:
+        return 2048
     return float_cap
 
 
@@ -88,7 +95,7 @@ def histogram_rows_t(bins_t: jax.Array, vals_t: jax.Array, *, n_bins: int,
         from .hist_pallas import histogram_pallas
         return histogram_pallas(bins_t, vals_t, n_bins=n_bins,
                                 rows_per_block=min(rows_per_block,
-                                                   _pallas_blk(hist_dtype)),
+                                                   _pallas_blk(hist_dtype, n_bins)),
                                 compute_dtype=jnp.dtype(hist_dtype).type)
     return build_histogram(bins_t.T, vals_t.T, n_bins=n_bins,
                            rows_per_block=rows_per_block)
@@ -141,11 +148,17 @@ def build_histogram(bins: jax.Array, vals: jax.Array, *, n_bins: int = 256,
 def _radix_ok(n_bins: int) -> bool:
     """The radix kernels decompose bin = 16*hi + lo (ops/hist_pallas.py
     ``_radix_shapes``); any other bin width falls back to the flat kernel.
-    ``LGBMTPU_NO_RADIX=1`` disables them (perf A/B escape hatch)."""
+    ``LGBMTPU_NO_RADIX=1`` disables them (perf A/B escape hatch).
+
+    Below 128 bins the flat kernel wins outright: the radix build cost is
+    nibble-bound (nhi + nlo one-hot elements — 20 at 64 bins vs the flat
+    kernel's 64) but its small [p*nhi, 3*p*nlo] matmul tiles waste the
+    MXU, measured 2.4 ms (radix joint) vs 1.7 ms (flat, full 63-bin K=42
+    masked pass) on the live chip in round 5."""
     import os
     if os.environ.get("LGBMTPU_NO_RADIX"):
         return False
-    return n_bins % 16 == 0 and n_bins >= 32
+    return n_bins % 16 == 0 and n_bins >= 128
 
 
 def histogram_for_leaf_masked(bins_t: jax.Array, grad: jax.Array,
@@ -221,7 +234,7 @@ def histogram_for_leaves_masked(bins_t: jax.Array, grad: jax.Array,
         from .hist_pallas import histogram_leaves_pallas
         hist = histogram_leaves_pallas(
             bins_t, grad, hess, lor, leaves, n_bins=n_bins,
-            rows_per_block=min(rows_per_block, _pallas_blk(hist_dtype)),
+            rows_per_block=min(rows_per_block, _pallas_blk(hist_dtype, n_bins)),
             compute_dtype=jnp.dtype(hist_dtype).type)         # [K, F, B, C]
     else:
         sel = lor[None, :] == leaves[:, None]                 # [K, n]
@@ -252,7 +265,7 @@ def _rows_leaves_hist(bins_rows: jax.Array, grad: jax.Array,
         from .hist_pallas import histogram_leaves_rows_pallas
         return histogram_leaves_rows_pallas(
             bins_rows, grad, hess, lor, leaves, n_bins=n_bins,
-            rows_per_block=min(rows_per_block, _pallas_blk(hist_dtype)),
+            rows_per_block=min(rows_per_block, _pallas_blk(hist_dtype, n_bins)),
             compute_dtype=jnp.dtype(hist_dtype).type)
     return histogram_for_leaves_masked(
         jnp.asarray(bins_rows).T, grad, hess, lor, leaves, None,
@@ -386,7 +399,7 @@ def histogram_for_leaves_auto(bins_rows: jax.Array, bins_t: jax.Array,
                 return histogram_payload_pallas(
                     pc, leaves, cnt, num_f=num_f, n_bins=n_bins,
                     rows_per_block=min(rows_per_block,
-                                       _pallas_blk(hist_dtype)),
+                                       _pallas_blk(hist_dtype, n_bins)),
                     compute_dtype=jnp.dtype(hist_dtype).type,
                     interpret=not use_pallas())
             # XLA fallback (CPU tests / non-TPU): unpack and run the

@@ -22,6 +22,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 K = int(os.environ.get("PK", "20"))
 N = int(os.environ.get("PROWS", "1000000"))
 LEAVES = int(os.environ.get("PLEAVES", "255"))
+PBIN = int(os.environ.get("PBIN", "255"))
 
 import jax
 import jax.numpy as jnp
@@ -31,7 +32,9 @@ from lightgbm_tpu.ops.table import take_small_table
 
 rng = np.random.default_rng(0)
 f = 28
-MAX_BIN = 255
+MAX_BIN = PBIN
+from lightgbm_tpu.io.dataset import device_bins_pow2
+N_BINS = device_bins_pow2(MAX_BIN)
 w = rng.normal(size=f)
 feat = rng.normal(size=(N, f)).astype(np.float32)
 logits = feat @ w * 0.5
@@ -48,7 +51,7 @@ nan_bin = jnp.full((f,), -1, jnp.int32)
 is_cat = jnp.zeros((f,), bool)
 
 hp = SplitHyper(num_leaves=LEAVES, min_data_in_leaf=0,
-                min_sum_hessian_in_leaf=100.0, n_bins=256,
+                min_sum_hessian_in_leaf=100.0, n_bins=N_BINS,
                 rows_per_block=8192,
                 hist_dtype=os.environ.get("PDTYPE", "int8"))
 

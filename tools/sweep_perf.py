@@ -26,7 +26,11 @@ from lightgbm_tpu.ops.table import take_small_table
 
 N = int(os.environ["BENCH_ROWS"])
 ITERS = int(os.environ.get("BENCH_ITERS", "5"))
-MAX_BIN = 255
+# BENCH_BIN=63 exercises the reference GPU doc's speed configuration
+# (docs/GPU-Performance.rst:100-123); bin width rounds up to a power of two
+MAX_BIN = int(os.environ.get("BENCH_BIN", "255"))
+from lightgbm_tpu.io.dataset import device_bins_pow2
+N_BINS = device_bins_pow2(MAX_BIN)
 
 rng = np.random.default_rng(0)
 f = 28
@@ -49,7 +53,7 @@ is_cat = jnp.zeros((f,), bool)
 def run_config(k, dtype="bfloat16", warmup=True, iters=ITERS,
                leaves=255):
     hp = SplitHyper(num_leaves=leaves, min_data_in_leaf=0,
-                    min_sum_hessian_in_leaf=100.0, n_bins=256,
+                    min_sum_hessian_in_leaf=100.0, n_bins=N_BINS,
                     rows_per_block=8192, hist_dtype=dtype)
 
     # int8 kernels consume INTEGER gradient levels (the use_quantized_grad
@@ -101,7 +105,7 @@ def run_config(k, dtype="bfloat16", warmup=True, iters=ITERS,
     # a small-tree sweep would inflate vs_baseline.
     try:
         if (jax.devices()[0].platform != "cpu" and leaves == 255
-                and N >= 1_000_000 and warmup):
+                and N >= 1_000_000 and warmup and MAX_BIN == 255):
             import bench as _bench
             _bench.record_cache({
                 "metric": f"higgs_synth_{N}rows_{iters}iters_leaves{leaves}"
