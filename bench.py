@@ -253,7 +253,7 @@ def main_e2e():
         # warmup train covers fused_chunk_for(BENCH_ITERS) only when
         # BENCH_ITERS is divisible; ragged tails need their own runner)
         for L in sorted(set(_G.fused_chunks(BENCH_ITERS))):
-            if (L, has_fm, 0, False) not in gb._fused_cache:
+            if (L, has_fm, 0, None) not in gb._fused_cache:
                 gb.train_fused(L)
     t0 = time.time()
     if gb.supports_fused():

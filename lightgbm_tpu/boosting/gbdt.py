@@ -1182,7 +1182,11 @@ class GBDT:
         self._last_fused_evals = []
         while done < num_rounds and not finished:
             T = min(chunk, num_rounds - done)
-            key = (T, has_fm, nvalid, use_es)
+            # es window parameters are baked into the runner's closure —
+            # they must key the cache or a later train_fused call with a
+            # different stopping window would reuse a stale in-jit flag
+            key = (T, has_fm, nvalid,
+                   (es_rounds, es_first) if use_es else None)
             if key not in self._fused_cache:
                 self._fused_cache[key] = make_runner(T, has_fm)
             fmasks = None

@@ -44,7 +44,11 @@ def _train_loop(params, X, y, rounds):
     {"bagging_fraction": 0.6, "bagging_freq": 1,
      "pos_bagging_fraction": 0.9, "neg_bagging_fraction": 0.4,
      "bagging_seed": 3},
-    {"data_sample_strategy": "goss", "top_rate": 0.3, "other_rate": 0.2},
+    # learning_rate=0.5 shrinks the GOSS warmup window to 2 rounds
+    # (min(int(1/lr), num_iterations//2)) so rounds 2..7 exercise the
+    # ACTUAL selection/amplification path, not just the warmup branch
+    {"data_sample_strategy": "goss", "top_rate": 0.3, "other_rate": 0.2,
+     "learning_rate": 0.5},
 ])
 def test_fused_sampling_identical_to_loop(extra):
     """Device-derived sampling masks (sample_strategy.py

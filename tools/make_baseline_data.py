@@ -1,0 +1,38 @@
+"""Export the e2e bench's synthetic Higgs-shaped dataset as CSV for the
+reference CLI (same-host baseline capture, VERDICT r4 next-round #2).
+
+Reproduces bench.py ``_synth_higgs`` draws EXACTLY (same seed, same rng
+call order): train = _synth_higgs(N, 28, rng), test =
+_synth_higgs(200_000, 28, rng, w=w).  Label is column 0, no header —
+the reference CLI's default CSV layout (docs/Parameters label_column).
+
+Usage:  BENCH_ROWS=10500000 python tools/make_baseline_data.py OUTDIR
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from bench import BENCH_ROWS, _synth_higgs  # noqa: E402
+
+outdir = sys.argv[1] if len(sys.argv) > 1 else ".refbuild"
+os.makedirs(outdir, exist_ok=True)
+rng = np.random.default_rng(0)
+n, f = BENCH_ROWS, 28
+feat, label, w = _synth_higgs(n, f, rng)
+feat_te, label_te, _ = _synth_higgs(200_000, f, rng, w=w)
+
+
+def write_csv(path, X, y, chunk=200_000):
+    with open(path, "w") as fh:
+        for s in range(0, len(y), chunk):
+            e = min(s + chunk, len(y))
+            block = np.column_stack([y[s:e], X[s:e]])
+            np.savetxt(fh, block, fmt="%.7g", delimiter=",")
+            print(f"{path}: {e}/{len(y)}", flush=True)
+
+
+write_csv(os.path.join(outdir, "higgs_synth.train"), feat, label)
+write_csv(os.path.join(outdir, "higgs_synth.test"), feat_te, label_te)
+print("done")
