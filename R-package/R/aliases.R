@@ -1,0 +1,46 @@
+# aliases — reference R-package/R/aliases.R counterpart: parameter
+# alias resolution for the handful of parameters the R layer itself
+# reads (early stopping, verbosity, metric).  The full 314-alias table
+# lives ABI-side (config.py; LGBMTPU_DumpParamAliases mirrors
+# c_api.h:100) and resolves every parameter passed through params; this
+# file only normalizes the R-visible ones, querying the ABI's table so
+# the two layers can never drift.
+
+# cached alias map: canonical name -> character vector of aliases
+.lgb_alias_env <- new.env(parent = emptyenv())
+
+.lgb_param_aliases <- function() {
+  if (is.null(.lgb_alias_env$map)) {
+    txt <- .Call(LGBTPU_R_DumpParamAliases)
+    .lgb_alias_env$map <- .lgb_json_parse(txt)
+  }
+  .lgb_alias_env$map
+}
+
+# first-wins alias resolution for one canonical parameter: returns the
+# value found under the canonical name or any of its aliases, or NULL
+.lgb_param_get <- function(params, canonical) {
+  if (!is.null(params[[canonical]])) {
+    return(params[[canonical]])
+  }
+  aliases <- .lgb_param_aliases()[[canonical]]
+  for (a in aliases) {
+    if (!is.null(params[[a]])) {
+      return(params[[a]])
+    }
+  }
+  NULL
+}
+
+# normalize the R-read parameters onto canonical keys (params passed to
+# the ABI keep their original spelling; the ABI resolves them again)
+.lgb_standardize_params <- function(params) {
+  for (canonical in c("early_stopping_round", "metric", "verbosity",
+                      "num_iterations")) {
+    v <- .lgb_param_get(params, canonical)
+    if (!is.null(v)) {
+      params[[canonical]] <- v
+    }
+  }
+  params
+}

@@ -1,0 +1,137 @@
+# callback — reference R-package/R/callback.R counterpart: formal
+# per-iteration callback constructors driven by lgb.train / lgb.cv.
+# Each callback is a function(env) where env is a list carrying
+# booster, iteration, begin_iteration, end_iteration and eval_list
+# (named metric values of the round).  User callbacks passed via
+# lgb.train(callbacks =) receive the same env, after the built-ins.
+
+#' @noRd
+cb_print_evaluation <- function(period = 1L) {
+  function(env) {
+    if (period > 0L && length(env$eval_list) > 0L &&
+        (env$iteration %% period == 0L ||
+         env$iteration == env$end_iteration)) {
+      cat(sprintf("[%d]\t%s\n", env$iteration,
+                  paste(sprintf("%s: %.6g", names(env$eval_list),
+                                unlist(env$eval_list)),
+                        collapse = "\t")))
+    }
+  }
+}
+
+#' @noRd
+cb_record_evaluation <- function() {
+  function(env) {
+    # env$eval_parts carries (valid_name, metric_name) pairs aligned
+    # with eval_list — re-splitting the display key would mis-key any
+    # valid-set name containing "-"
+    for (i in seq_along(env$eval_list)) {
+      vn <- env$eval_parts[[i]][[1L]]
+      mn <- env$eval_parts[[i]][[2L]]
+      env$booster$record_evals[[vn]][[mn]] <-
+        c(env$booster$record_evals[[vn]][[mn]], env$eval_list[[i]])
+    }
+  }
+}
+
+#' @noRd
+cb_early_stop <- function(stopping_rounds, first_metric_only = FALSE,
+                          verbose = TRUE) {
+  # PER-METRIC best tracking (the reference/python callback semantics):
+  # each (valid, metric) entry keeps its own best and stall counter;
+  # training stops when ANY considered entry stalls for
+  # ``stopping_rounds`` — a single shared best would let the metric with
+  # the smallest normalized value mask every other metric's improvement
+  state <- new.env(parent = emptyenv())
+  state$best_score <- list()    # per entry key, orientation-normalized
+  state$best_raw <- list()
+  state$best_iter <- list()
+  state$stale <- list()
+  function(env) {
+    if (length(env$eval_list) == 0L) {
+      return(invisible(NULL))
+    }
+    consider <- if (first_metric_only) 1L else seq_along(env$eval_list)
+    for (i in consider) {
+      nm <- names(env$eval_list)[[i]]
+      v <- env$eval_list[[i]]
+      score <- if (.lgb_metric_higher_better(nm)) -v else v
+      if (is.null(state$best_score[[nm]]) ||
+          score < state$best_score[[nm]]) {
+        state$best_score[[nm]] <- score
+        state$best_raw[[nm]] <- v
+        state$best_iter[[nm]] <- env$iteration
+        state$stale[[nm]] <- 0L
+      } else {
+        state$stale[[nm]] <- state$stale[[nm]] + 1L
+        if (state$stale[[nm]] >= stopping_rounds) {
+          if (verbose) {
+            cat(sprintf("early stopping at iteration %d (best %d)\n",
+                        env$iteration, state$best_iter[[nm]]))
+          }
+          env$booster$best_iter <- state$best_iter[[nm]]
+          env$booster$best_score <- state$best_raw[[nm]]
+          env$booster$stop_training <- TRUE
+          return(invisible(NULL))
+        }
+      }
+    }
+    if (env$iteration == env$end_iteration &&
+        env$booster$best_iter < 0L && length(state$best_iter) > 0L) {
+      first <- names(env$eval_list)[[consider[[1L]]]]
+      env$booster$best_iter <- state$best_iter[[first]]
+      env$booster$best_score <- state$best_raw[[first]]
+    }
+    invisible(NULL)
+  }
+}
+
+#' @noRd
+cb_reset_parameter <- function(new_params) {
+  # new_params: named list; each entry is a vector (one value per
+  # iteration) or function(iteration, total) -> value — the reference
+  # reset_parameter callback's contract
+  function(env) {
+    upd <- list()
+    for (nm in names(new_params)) {
+      spec <- new_params[[nm]]
+      v <- if (is.function(spec)) {
+        spec(env$iteration, env$end_iteration)
+      } else {
+        spec[[min(env$iteration, length(spec))]]
+      }
+      upd[[nm]] <- v
+    }
+    if (length(upd) > 0L) {
+      .Call(LGBTPU_R_BoosterResetParameter,
+            .lgb_booster_handle(env$booster), .lgb_params_json(upd))
+    }
+    invisible(NULL)
+  }
+}
+
+# assemble the built-in callback pipeline the way engine.py orders its
+# callbacks: reset_parameter (before-effects) first, then printing,
+# recording and early stopping
+.lgb_build_callbacks <- function(verbose, eval_freq, record,
+                                 early_stopping_rounds,
+                                 first_metric_only = FALSE,
+                                 reset_parameter = NULL,
+                                 user_callbacks = list()) {
+  cbs <- list()
+  if (!is.null(reset_parameter)) {
+    cbs[[length(cbs) + 1L]] <- cb_reset_parameter(reset_parameter)
+  }
+  if (verbose > 0L) {
+    cbs[[length(cbs) + 1L]] <- cb_print_evaluation(max(eval_freq, 1L))
+  }
+  if (record) {
+    cbs[[length(cbs) + 1L]] <- cb_record_evaluation()
+  }
+  if (!is.null(early_stopping_rounds) && early_stopping_rounds > 0L) {
+    cbs[[length(cbs) + 1L]] <- cb_early_stop(
+      as.integer(early_stopping_rounds), first_metric_only,
+      verbose > 0L)
+  }
+  c(cbs, user_callbacks)
+}

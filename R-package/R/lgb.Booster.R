@@ -42,68 +42,6 @@ lgb.Booster <- function(train_set, params = list()) {
   booster$handle
 }
 
-#' Predict with a Booster
-#'
-#' @param object an lgb.Booster
-#' @param newdata matrix, dgCMatrix or file path
-#' @param type "response" (transformed scores), "raw" (margins),
-#'   "leaf" (leaf indices) or "contrib" (per-feature SHAP contributions
-#'   plus bias column)
-#' @param start_iteration,num_iteration iteration window (0 / -1 = all;
-#'   when the booster has a best_iter from early stopping and
-#'   num_iteration is NULL, the best iteration is used, matching the
-#'   reference predict semantics)
-#' @param header whether a file newdata has a header line
-#' @param ... unused
-#' @export
-predict.lgb.Booster <- function(object, newdata,
-                                type = c("response", "raw", "leaf",
-                                         "contrib"),
-                                start_iteration = 0L,
-                                num_iteration = NULL, header = FALSE,
-                                ...) {
-  type <- match.arg(type)
-  ptype <- switch(type, response = 0L, raw = 1L, leaf = 2L,
-                  contrib = 3L)
-  if (is.null(num_iteration)) {
-    num_iteration <- if (object$best_iter > 0L) object$best_iter else -1L
-  }
-  h <- .lgb_booster_handle(object)
-  if (is.character(newdata) && length(newdata) == 1L) {
-    out_path <- tempfile(fileext = ".pred")
-    .Call(LGBTPU_R_BoosterPredictForFile, h, newdata, header, ptype,
-          as.integer(start_iteration), as.integer(num_iteration),
-          out_path)
-    preds <- as.numeric(readLines(out_path))
-    unlink(out_path)
-    return(preds)
-  }
-  if (inherits(newdata, "dgCMatrix")) {
-    preds <- .Call(LGBTPU_R_BoosterPredictForCSC, h, newdata@p,
-                   newdata@i, newdata@x, as.numeric(nrow(newdata)),
-                   ptype, as.integer(start_iteration),
-                   as.integer(num_iteration))
-    nrow_ <- nrow(newdata)
-  } else {
-    m <- newdata
-    if (is.data.frame(m)) m <- as.matrix(m)
-    if (is.null(dim(m))) m <- matrix(m, nrow = 1L)
-    storage.mode(m) <- "double"
-    preds <- .Call(LGBTPU_R_BoosterPredictForMat, h, t(m),
-                   as.numeric(nrow(m)), as.numeric(ncol(m)), ptype,
-                   as.integer(start_iteration),
-                   as.integer(num_iteration))
-    nrow_ <- nrow(m)
-  }
-  # multi-output shapes come back row-major; fold into a matrix like the
-  # reference's R predictor does
-  per_row <- length(preds) / nrow_
-  if (per_row > 1L) {
-    return(matrix(preds, nrow = nrow_, byrow = TRUE))
-  }
-  preds
-}
-
 #' Save a Booster to the interoperable text format
 #' @param booster an lgb.Booster
 #' @param filename output path
@@ -153,43 +91,6 @@ lgb.get.eval.result <- function(booster, data_name, eval_name,
          " (train with valids and record = TRUE)")
   }
   if (is.null(iters)) rec else rec[iters]
-}
-
-#' Store the serialized model inside the R object so it survives
-#' saveRDS/readRDS (the native handle does not)
-#' @param booster an lgb.Booster
-#' @export
-lgb.make_serializable <- function(booster) {
-  stopifnot(inherits(booster, "lgb.Booster"))
-  booster$raw <- .Call(LGBTPU_R_BoosterSaveModelToString,
-                       .lgb_booster_handle(booster))
-  invisible(booster)
-}
-
-#' Drop the serialized copy stored by lgb.make_serializable
-#' @param booster an lgb.Booster
-#' @export
-lgb.drop_serialized <- function(booster) {
-  stopifnot(inherits(booster, "lgb.Booster"))
-  booster$raw <- NULL
-  invisible(booster)
-}
-
-#' Rebuild the native handle from the serialized copy (after readRDS)
-#' @param booster an lgb.Booster with a stored raw model
-#' @export
-lgb.restore_handle <- function(booster) {
-  stopifnot(inherits(booster, "lgb.Booster"))
-  if (.lgb_handle_live(booster$handle)) {
-    return(invisible(booster))
-  }
-  if (is.null(booster$raw)) {
-    stop("booster has no native handle and no serialized copy; call ",
-         "lgb.make_serializable before saveRDS")
-  }
-  booster$handle <- .Call(LGBTPU_R_BoosterLoadModelFromString,
-                          booster$raw)
-  invisible(booster)
 }
 
 #' @export

@@ -1,0 +1,45 @@
+# lgb.DataProcessor — reference R-package/R/lgb.DataProcessor.R
+# counterpart: data.frame preprocessing for the high-level lightgbm()
+# interface.  Factor/character columns are coded to numeric with
+# deterministic, reusable rules (lgb.convert_with_rules) and flagged as
+# categorical_feature; the rules ride on the returned booster so
+# predict() on a data.frame codes new data identically — including
+# levels unseen at training time (NA -> the reference's
+# not-in-any-set branch).
+
+# prepare a data.frame/matrix for training: returns
+# list(data = numeric matrix, categorical_feature = 0-based ABI indices
+#      or NULL, rules = coding rules or NULL)
+.lgb_data_processor_prepare <- function(data) {
+  if (!is.data.frame(data)) {
+    return(list(data = data, categorical_feature = NULL, rules = NULL))
+  }
+  cat_cols <- names(data)[vapply(data, function(v) {
+    is.factor(v) || is.character(v)
+  }, logical(1L))]
+  conv <- lgb.convert_with_rules(data)
+  m <- as.matrix(conv$data)
+  storage.mode(m) <- "double"
+  cats <- match(cat_cols, names(data))
+  list(data = m,
+       categorical_feature = if (length(cats)) as.integer(cats) else NULL,
+       rules = if (length(cat_cols)) conv$rules else NULL)
+}
+
+# apply stored rules to new prediction data (data.frame in, matrix out);
+# unseen levels become NA, which the predictor routes like the
+# reference's unseen-category branch
+.lgb_data_processor_apply <- function(newdata, rules) {
+  if (!is.data.frame(newdata)) {
+    return(newdata)
+  }
+  if (is.null(rules) || length(rules) == 0L) {
+    m <- as.matrix(newdata)
+    storage.mode(m) <- "double"
+    return(m)
+  }
+  conv <- lgb.convert_with_rules(newdata, rules = rules)
+  m <- as.matrix(conv$data)
+  storage.mode(m) <- "double"
+  m
+}

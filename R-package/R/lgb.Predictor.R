@@ -1,0 +1,71 @@
+# lgb.Predictor — the prediction path of the R binding (reference
+# R-package/R/lgb.Predictor.R): routes matrix / dgCMatrix / file inputs
+# to the matching LGBMTPU_BoosterPredictFor* ABI entry, folds
+# multi-output row-major buffers into matrices, and applies the
+# data.frame conversion rules stored by the DataProcessor so factor
+# columns code identically at train and predict time.
+
+#' Predict with a Booster
+#'
+#' @param object an lgb.Booster
+#' @param newdata matrix, dgCMatrix or file path
+#' @param type "response" (transformed scores), "raw" (margins),
+#'   "leaf" (leaf indices) or "contrib" (per-feature SHAP contributions
+#'   plus bias column)
+#' @param start_iteration,num_iteration iteration window (0 / -1 = all;
+#'   when the booster has a best_iter from early stopping and
+#'   num_iteration is NULL, the best iteration is used, matching the
+#'   reference predict semantics)
+#' @param header whether a file newdata has a header line
+#' @param ... unused
+#' @export
+predict.lgb.Booster <- function(object, newdata,
+                                type = c("response", "raw", "leaf",
+                                         "contrib"),
+                                start_iteration = 0L,
+                                num_iteration = NULL, header = FALSE,
+                                ...) {
+  type <- match.arg(type)
+  ptype <- switch(type, response = 0L, raw = 1L, leaf = 2L,
+                  contrib = 3L)
+  if (is.null(num_iteration)) {
+    num_iteration <- if (object$best_iter > 0L) object$best_iter else -1L
+  }
+  h <- .lgb_booster_handle(object)
+  if (is.character(newdata) && length(newdata) == 1L) {
+    out_path <- tempfile(fileext = ".pred")
+    .Call(LGBTPU_R_BoosterPredictForFile, h, newdata, header, ptype,
+          as.integer(start_iteration), as.integer(num_iteration),
+          out_path)
+    preds <- as.numeric(readLines(out_path))
+    unlink(out_path)
+    return(preds)
+  }
+  if (inherits(newdata, "dgCMatrix")) {
+    preds <- .Call(LGBTPU_R_BoosterPredictForCSC, h, newdata@p,
+                   newdata@i, newdata@x, as.numeric(nrow(newdata)),
+                   ptype, as.integer(start_iteration),
+                   as.integer(num_iteration))
+    nrow_ <- nrow(newdata)
+  } else {
+    m <- newdata
+    if (is.data.frame(m)) {
+      m <- .lgb_data_processor_apply(m, object$data_rules)
+    }
+    if (is.null(dim(m))) m <- matrix(m, nrow = 1L)
+    storage.mode(m) <- "double"
+    preds <- .Call(LGBTPU_R_BoosterPredictForMat, h, t(m),
+                   as.numeric(nrow(m)), as.numeric(ncol(m)), ptype,
+                   as.integer(start_iteration),
+                   as.integer(num_iteration))
+    nrow_ <- nrow(m)
+  }
+  # multi-output shapes come back row-major; fold into a matrix like the
+  # reference's R predictor does
+  per_row <- length(preds) / nrow_
+  if (per_row > 1L) {
+    return(matrix(preds, nrow = nrow_, byrow = TRUE))
+  }
+  preds
+}
+

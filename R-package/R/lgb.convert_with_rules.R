@@ -1,0 +1,33 @@
+# lgb.convert_with_rules — reference
+# R-package/R/lgb.convert_with_rules.R counterpart: deterministic
+# factor/character -> numeric coding with reusable rules so train
+# and test share one coding.
+
+#' Map factor/character columns to numeric codes with reusable rules
+#'
+#' @param data a data.frame
+#' @param rules optional rules list from a previous call (applied to new
+#'   data so train and test share the same coding)
+#' @return list(data = converted data.frame, rules = rules)
+#' @export
+lgb.convert_with_rules <- function(data, rules = NULL) {
+  stopifnot(is.data.frame(data))
+  out <- data
+  new_rules <- rules %||% list()
+  for (col in names(out)) {
+    v <- out[[col]]
+    if (is.factor(v) || is.character(v)) {
+      v <- as.character(v)
+      if (is.null(new_rules[[col]])) {
+        lv <- sort(unique(v[!is.na(v)]))
+        new_rules[[col]] <- stats::setNames(seq_along(lv), lv)
+      }
+      codes <- unname(new_rules[[col]][v])
+      out[[col]] <- as.numeric(codes)
+    } else if (is.logical(v)) {
+      out[[col]] <- as.numeric(v)
+    }
+  }
+  list(data = out, rules = new_rules)
+}
+

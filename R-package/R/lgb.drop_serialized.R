@@ -1,0 +1,13 @@
+# lgb.drop_serialized — reference R-package/R/lgb.drop_serialized.R counterpart (model
+# serialization keep-alive; the native handle does not survive
+# saveRDS/readRDS, the stored text model does).
+
+#' Drop the serialized copy stored by lgb.make_serializable
+#' @param booster an lgb.Booster
+#' @export
+lgb.drop_serialized <- function(booster) {
+  stopifnot(inherits(booster, "lgb.Booster"))
+  booster$raw <- NULL
+  invisible(booster)
+}
+

@@ -37,105 +37,3 @@ lgb.importance <- function(model, percentage = TRUE) {
              stringsAsFactors = FALSE)
 }
 
-# parse the booster's JSON dump once (base-R JSON reader below; the
-# package avoids a jsonlite dependency the same way the ABI avoided it)
-.lgb_model_dump <- function(model) {
-  txt <- lgb.dump(model)
-  .lgb_json_parse(txt)
-}
-
-#' Flat per-node table of every tree in the model
-#'
-#' @param model an lgb.Booster
-#' @return data.frame with one row per node/leaf: tree_index,
-#'   split_feature, split_gain, threshold, internal_value,
-#'   internal_count, leaf_index, leaf_value, leaf_count, depth
-#' @export
-lgb.model.dt.tree <- function(model) {
-  dump <- .lgb_model_dump(model)
-  feat_names <- vapply(dump$feature_names, as.character, character(1L))
-  rows <- list()
-  walk <- function(node, tree_idx, depth) {
-    if (!is.null(node$leaf_index)) {
-      rows[[length(rows) + 1L]] <<- data.frame(
-        tree_index = tree_idx, depth = depth,
-        split_feature = NA_character_, split_gain = NA_real_,
-        threshold = NA_real_, internal_value = NA_real_,
-        internal_count = NA_real_,
-        leaf_index = as.integer(node$leaf_index),
-        leaf_value = as.numeric(node$leaf_value),
-        leaf_count = as.numeric(node$leaf_count %||% NA_real_),
-        stringsAsFactors = FALSE)
-      return(invisible(NULL))
-    }
-    fi <- as.integer(node$split_feature) + 1L
-    rows[[length(rows) + 1L]] <<- data.frame(
-      tree_index = tree_idx, depth = depth,
-      split_feature = if (fi >= 1L && fi <= length(feat_names))
-        feat_names[[fi]] else as.character(fi - 1L),
-      split_gain = as.numeric(node$split_gain %||% NA_real_),
-      threshold = as.numeric(node$threshold %||% NA_real_),
-      internal_value = as.numeric(node$internal_value %||% NA_real_),
-      internal_count = as.numeric(node$internal_count %||% NA_real_),
-      leaf_index = NA_integer_, leaf_value = NA_real_,
-      leaf_count = NA_real_, stringsAsFactors = FALSE)
-    walk(node$left_child, tree_idx, depth + 1L)
-    walk(node$right_child, tree_idx, depth + 1L)
-  }
-  for (ti in seq_along(dump$tree_info)) {
-    walk(dump$tree_info[[ti]]$tree_structure, ti - 1L, 0L)
-  }
-  do.call(rbind, rows)
-}
-
-#' Per-prediction feature contributions for selected rows
-#'
-#' @param model an lgb.Booster
-#' @param data matrix of rows to explain
-#' @param idxset 1-based row indices to explain
-#' @return list of data.frames (Feature, Contribution), one per row,
-#'   sorted by absolute contribution
-#' @export
-lgb.interprete <- function(model, data, idxset) {
-  stopifnot(inherits(model, "lgb.Booster"))
-  m <- data[idxset, , drop = FALSE]
-  contrib <- predict(model, m, type = "contrib")
-  if (is.null(dim(contrib))) {
-    contrib <- matrix(contrib, nrow = length(idxset), byrow = TRUE)
-  }
-  nf <- ncol(contrib) - 1L  # last column is the bias
-  feat_names <- colnames(data) %||% paste0("Column_", seq_len(nf) - 1L)
-  lapply(seq_along(idxset), function(i) {
-    v <- contrib[i, seq_len(nf)]
-    ord <- order(-abs(v))
-    data.frame(Feature = feat_names[ord], Contribution = v[ord],
-               stringsAsFactors = FALSE)
-  })
-}
-
-#' Barplot of feature importance
-#' @param tree_imp output of lgb.importance
-#' @param top_n how many features to show
-#' @param measure "Gain", "Cover" or "Frequency"
-#' @param ... passed to graphics::barplot
-#' @export
-lgb.plot.importance <- function(tree_imp, top_n = 10L,
-                                measure = "Gain", ...) {
-  top <- utils::head(tree_imp[order(-tree_imp[[measure]]), ], top_n)
-  graphics::barplot(rev(top[[measure]]), names.arg = rev(top$Feature),
-                    horiz = TRUE, las = 1L, main = measure, ...)
-  invisible(top)
-}
-
-#' Barplot of one row's feature contributions
-#' @param tree_interpretation one element of lgb.interprete's output
-#' @param top_n how many features to show
-#' @param ... passed to graphics::barplot
-#' @export
-lgb.plot.interpretation <- function(tree_interpretation, top_n = 10L,
-                                    ...) {
-  top <- utils::head(tree_interpretation, top_n)
-  graphics::barplot(rev(top$Contribution), names.arg = rev(top$Feature),
-                    horiz = TRUE, las = 1L, main = "Contribution", ...)
-  invisible(top)
-}

@@ -1,0 +1,29 @@
+# lgb.interprete — per-prediction feature contributions (reference
+# R-package/R/lgb.interprete.R) served by the ABI's SHAP predict
+# (pred_contrib) instead of an R-side tree walk.
+
+#' Per-prediction feature contributions for selected rows
+#'
+#' @param model an lgb.Booster
+#' @param data matrix of rows to explain
+#' @param idxset 1-based row indices to explain
+#' @return list of data.frames (Feature, Contribution), one per row,
+#'   sorted by absolute contribution
+#' @export
+lgb.interprete <- function(model, data, idxset) {
+  stopifnot(inherits(model, "lgb.Booster"))
+  m <- data[idxset, , drop = FALSE]
+  contrib <- predict(model, m, type = "contrib")
+  if (is.null(dim(contrib))) {
+    contrib <- matrix(contrib, nrow = length(idxset), byrow = TRUE)
+  }
+  nf <- ncol(contrib) - 1L  # last column is the bias
+  feat_names <- colnames(data) %||% paste0("Column_", seq_len(nf) - 1L)
+  lapply(seq_along(idxset), function(i) {
+    v <- contrib[i, seq_len(nf)]
+    ord <- order(-abs(v))
+    data.frame(Feature = feat_names[ord], Contribution = v[ord],
+               stringsAsFactors = FALSE)
+  })
+}
+

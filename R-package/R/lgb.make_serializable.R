@@ -1,0 +1,15 @@
+# lgb.make_serializable — reference R-package/R/lgb.make_serializable.R counterpart (model
+# serialization keep-alive; the native handle does not survive
+# saveRDS/readRDS, the stored text model does).
+
+#' Store the serialized model inside the R object so it survives
+#' saveRDS/readRDS (the native handle does not)
+#' @param booster an lgb.Booster
+#' @export
+lgb.make_serializable <- function(booster) {
+  stopifnot(inherits(booster, "lgb.Booster"))
+  booster$raw <- .Call(LGBTPU_R_BoosterSaveModelToString,
+                       .lgb_booster_handle(booster))
+  invisible(booster)
+}
+

@@ -1,0 +1,55 @@
+# lgb.model.dt.tree — flat per-node table of the model (reference
+# R-package/R/lgb.model.dt.tree.R), built from the booster's JSON
+# dump with the package's base-R JSON reader (json.R).
+
+# parse the booster's JSON dump once (base-R JSON reader below; the
+# package avoids a jsonlite dependency the same way the ABI avoided it)
+.lgb_model_dump <- function(model) {
+  txt <- lgb.dump(model)
+  .lgb_json_parse(txt)
+}
+
+#' Flat per-node table of every tree in the model
+#'
+#' @param model an lgb.Booster
+#' @return data.frame with one row per node/leaf: tree_index,
+#'   split_feature, split_gain, threshold, internal_value,
+#'   internal_count, leaf_index, leaf_value, leaf_count, depth
+#' @export
+lgb.model.dt.tree <- function(model) {
+  dump <- .lgb_model_dump(model)
+  feat_names <- vapply(dump$feature_names, as.character, character(1L))
+  rows <- list()
+  walk <- function(node, tree_idx, depth) {
+    if (!is.null(node$leaf_index)) {
+      rows[[length(rows) + 1L]] <<- data.frame(
+        tree_index = tree_idx, depth = depth,
+        split_feature = NA_character_, split_gain = NA_real_,
+        threshold = NA_real_, internal_value = NA_real_,
+        internal_count = NA_real_,
+        leaf_index = as.integer(node$leaf_index),
+        leaf_value = as.numeric(node$leaf_value),
+        leaf_count = as.numeric(node$leaf_count %||% NA_real_),
+        stringsAsFactors = FALSE)
+      return(invisible(NULL))
+    }
+    fi <- as.integer(node$split_feature) + 1L
+    rows[[length(rows) + 1L]] <<- data.frame(
+      tree_index = tree_idx, depth = depth,
+      split_feature = if (fi >= 1L && fi <= length(feat_names))
+        feat_names[[fi]] else as.character(fi - 1L),
+      split_gain = as.numeric(node$split_gain %||% NA_real_),
+      threshold = as.numeric(node$threshold %||% NA_real_),
+      internal_value = as.numeric(node$internal_value %||% NA_real_),
+      internal_count = as.numeric(node$internal_count %||% NA_real_),
+      leaf_index = NA_integer_, leaf_value = NA_real_,
+      leaf_count = NA_real_, stringsAsFactors = FALSE)
+    walk(node$left_child, tree_idx, depth + 1L)
+    walk(node$right_child, tree_idx, depth + 1L)
+  }
+  for (ti in seq_along(dump$tree_info)) {
+    walk(dump$tree_info[[ti]]$tree_structure, ti - 1L, 0L)
+  }
+  do.call(rbind, rows)
+}
+

@@ -1,0 +1,17 @@
+# lgb.plot.importance — reference R-package/R/lgb.plot.importance.R
+# counterpart over base graphics (no ggplot dependency).
+
+#' Barplot of feature importance
+#' @param tree_imp output of lgb.importance
+#' @param top_n how many features to show
+#' @param measure "Gain", "Cover" or "Frequency"
+#' @param ... passed to graphics::barplot
+#' @export
+lgb.plot.importance <- function(tree_imp, top_n = 10L,
+                                measure = "Gain", ...) {
+  top <- utils::head(tree_imp[order(-tree_imp[[measure]]), ], top_n)
+  graphics::barplot(rev(top[[measure]]), names.arg = rev(top$Feature),
+                    horiz = TRUE, las = 1L, main = measure, ...)
+  invisible(top)
+}
+

@@ -1,0 +1,15 @@
+# lgb.plot.interpretation — reference
+# R-package/R/lgb.plot.interpretation.R counterpart.
+
+#' Barplot of one row's feature contributions
+#' @param tree_interpretation one element of lgb.interprete's output
+#' @param top_n how many features to show
+#' @param ... passed to graphics::barplot
+#' @export
+lgb.plot.interpretation <- function(tree_interpretation, top_n = 10L,
+                                    ...) {
+  top <- utils::head(tree_interpretation, top_n)
+  graphics::barplot(rev(top$Contribution), names.arg = rev(top$Feature),
+                    horiz = TRUE, las = 1L, main = "Contribution", ...)
+  invisible(top)
+}

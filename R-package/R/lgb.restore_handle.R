@@ -1,0 +1,21 @@
+# lgb.restore_handle — reference R-package/R/lgb.restore_handle.R counterpart (model
+# serialization keep-alive; the native handle does not survive
+# saveRDS/readRDS, the stored text model does).
+
+#' Rebuild the native handle from the serialized copy (after readRDS)
+#' @param booster an lgb.Booster with a stored raw model
+#' @export
+lgb.restore_handle <- function(booster) {
+  stopifnot(inherits(booster, "lgb.Booster"))
+  if (.lgb_handle_live(booster$handle)) {
+    return(invisible(booster))
+  }
+  if (is.null(booster$raw)) {
+    stop("booster has no native handle and no serialized copy; call ",
+         "lgb.make_serializable before saveRDS")
+  }
+  booster$handle <- .Call(LGBTPU_R_BoosterLoadModelFromString,
+                          booster$raw)
+  invisible(booster)
+}
+

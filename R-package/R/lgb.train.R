@@ -62,11 +62,17 @@ lgb.train <- function(params = list(), data, nrounds = 100L,
     booster$valid_names <- names(valids)
   }
 
+  params <- .lgb_standardize_params(params)
+  if (is.null(early_stopping_rounds) &&
+      !is.null(params[["early_stopping_round"]])) {
+    early_stopping_rounds <- as.integer(params[["early_stopping_round"]])
+  }
+  cbs <- .lgb_build_callbacks(
+    verbose = verbose, eval_freq = eval_freq, record = record,
+    early_stopping_rounds = early_stopping_rounds,
+    user_callbacks = callbacks)
   eval_names <- NULL
-  best_score <- Inf   # orientation-normalized (lower is better)
-  best_raw <- NA_real_  # the metric's own value at the best iteration
-  best_iter <- -1L
-  stale <- 0L
+  booster$stop_training <- FALSE
 
   for (i in seq_len(nrounds)) {
     if (is.null(obj)) {
@@ -79,6 +85,7 @@ lgb.train <- function(params = list(), data, nrounds = 100L,
     }
 
     eval_list <- list()
+    eval_parts <- list()     # (valid_name, metric_name) per entry
     if (length(booster$valid_names) > 0L &&
         (i %% max(eval_freq, 1L) == 0L || i == nrounds)) {
       if (is.null(eval_names)) {
@@ -93,54 +100,19 @@ lgb.train <- function(params = list(), data, nrounds = 100L,
           mn <- if (mi <= length(eval_names)) eval_names[[mi]] else
             paste0("metric", mi)
           eval_list[[paste(vn, mn, sep = "-")]] <- vals[[mi]]
-          if (record) {
-            booster$record_evals[[vn]][[mn]] <-
-              c(booster$record_evals[[vn]][[mn]], vals[[mi]])
-          }
-        }
-      }
-      if (verbose > 0L && length(eval_list) > 0L) {
-        cat(sprintf("[%d]\t%s\n", i,
-                    paste(sprintf("%s: %.6g", names(eval_list),
-                                  unlist(eval_list)),
-                          collapse = "\t")))
-      }
-      if (!is.null(early_stopping_rounds) && length(eval_list) > 0L) {
-        # first validation metric drives the stop, reference default;
-        # ABI metrics are uniformly reported lower-is-better except the
-        # known higher-better family
-        m1 <- names(eval_list)[[1L]]
-        v1 <- eval_list[[1L]]
-        higher <- grepl("auc|ndcg|map|average_precision", m1)
-        score <- if (higher) -v1 else v1
-        if (score < best_score) {
-          best_score <- score
-          best_raw <- v1
-          best_iter <- i
-          stale <- 0L
-        } else {
-          stale <- stale + 1L
-          if (stale >= early_stopping_rounds) {
-            if (verbose > 0L) {
-              cat(sprintf(
-                "early stopping at iteration %d (best %d)\n", i,
-                best_iter))
-            }
-            booster$best_iter <- best_iter
-            booster$best_score <- best_raw
-            break
-          }
+          eval_parts[[length(eval_parts) + 1L]] <- list(vn, mn)
         }
       }
     }
-    for (cb in callbacks) {
-      cb(list(booster = booster, iteration = i, nrounds = nrounds,
-              eval_list = eval_list))
+    env <- list(booster = booster, iteration = i, begin_iteration = 1L,
+                end_iteration = nrounds, eval_list = eval_list,
+                eval_parts = eval_parts, nrounds = nrounds)
+    for (cb in cbs) {
+      cb(env)
     }
-  }
-  if (booster$best_iter < 0L && best_iter > 0L) {
-    booster$best_iter <- best_iter
-    booster$best_score <- best_raw
+    if (isTRUE(booster$stop_training)) {
+      break
+    }
   }
   booster
 }

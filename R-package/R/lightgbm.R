@@ -18,72 +18,35 @@
 lightgbm <- function(data, label = NULL, weights = NULL,
                      params = list(), nrounds = 100L, verbose = 1L,
                      objective = NULL, init_score = NULL, ...) {
+  rules <- NULL
   if (inherits(data, "lgb.Dataset")) {
     dtrain <- data
   } else {
     if (is.null(label)) {
       stop("lightgbm: label is required when data is not an lgb.Dataset")
     }
+    # data.frames route through the DataProcessor: factor/character
+    # columns become categorical features with reusable coding rules
+    # (lgb.DataProcessor.R), so predict() on a data.frame codes new
+    # data identically
+    proc <- .lgb_data_processor_prepare(data)
     if (is.null(objective) && is.null(params[["objective"]])) {
       two_level <- length(unique(label)) == 2L &&
         all(label %in% c(0, 1))
       objective <- if (two_level) "binary" else "regression"
     }
-    dtrain <- lgb.Dataset(data, params = list(), label = label,
-                          weight = weights, init_score = init_score)
+    dtrain <- lgb.Dataset(proc$data, params = list(), label = label,
+                          weight = weights, init_score = init_score,
+                          categorical_feature = proc$categorical_feature)
+    rules <- proc$rules
   }
   if (!is.null(objective)) {
     params[["objective"]] <- objective
   }
   bst <- lgb.train(params = params, data = dtrain, nrounds = nrounds,
                    verbose = verbose, ...)
+  bst$data_rules <- rules
   bst
-}
-
-#' Map factor/character columns to numeric codes with reusable rules
-#'
-#' @param data a data.frame
-#' @param rules optional rules list from a previous call (applied to new
-#'   data so train and test share the same coding)
-#' @return list(data = converted data.frame, rules = rules)
-#' @export
-lgb.convert_with_rules <- function(data, rules = NULL) {
-  stopifnot(is.data.frame(data))
-  out <- data
-  new_rules <- rules %||% list()
-  for (col in names(out)) {
-    v <- out[[col]]
-    if (is.factor(v) || is.character(v)) {
-      v <- as.character(v)
-      if (is.null(new_rules[[col]])) {
-        lv <- sort(unique(v[!is.na(v)]))
-        new_rules[[col]] <- stats::setNames(seq_along(lv), lv)
-      }
-      codes <- unname(new_rules[[col]][v])
-      out[[col]] <- as.numeric(codes)
-    } else if (is.logical(v)) {
-      out[[col]] <- as.numeric(v)
-    }
-  }
-  list(data = out, rules = new_rules)
-}
-
-# The XLA runtime schedules its own parallelism; these exist for drop-in
-# compatibility with scripts that tune the reference's OpenMP threads.
-
-#' Set the native thread budget (advisory under XLA)
-#' @param num_threads requested thread count
-#' @export
-setLGBMthreads <- function(num_threads) {
-  Sys.setenv(LIGHTGBM_TPU_NUM_THREADS = as.character(num_threads))
-  invisible(NULL)
-}
-
-#' Read the native thread budget
-#' @export
-getLGBMthreads <- function() {
-  v <- Sys.getenv("LIGHTGBM_TPU_NUM_THREADS", unset = "")
-  if (nzchar(v)) as.integer(v) else -1L
 }
 
 #' Pre-bind a fast single-row predict configuration

@@ -1,0 +1,21 @@
+# metrics — reference R-package/R/metrics.R counterpart: the explicit
+# table of metrics where LARGER values mean better models, driving the
+# early-stopping orientation in lgb.train / lgb.cv (the reference keeps
+# the same list; metric.h factor_to_bigger_better is the C side).
+
+.METRICS_HIGHER_BETTER <- c(
+  "auc" = TRUE,
+  "auc_mu" = TRUE,
+  "average_precision" = TRUE,
+  "ndcg" = TRUE,
+  "map" = TRUE
+)
+
+# TRUE when a reported metric name (possibly "ndcg@5"-style) is
+# higher-is-better
+.lgb_metric_higher_better <- function(name) {
+  base <- sub("@.*$", "", name)
+  # eval names arrive as "<metric>" or "<valid>-<metric>"
+  base <- sub("^.*-", "", base)
+  isTRUE(.METRICS_HIGHER_BETTER[[base]])
+}

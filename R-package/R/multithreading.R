@@ -1,0 +1,21 @@
+# multithreading — reference R-package/R/multithreading.R counterpart
+# over the ABI's thread controls (LGBMTPU_SetMaxThreads /
+# LGBMTPU_GetMaxThreads, the c_api.h:1603-1610 pair).  Device compute is
+# scheduled by XLA; the budget governs the HOST side (parsers, binning).
+
+#' Set the maximum number of host threads the library may use
+#'
+#' @param num_threads requested thread count; <= 0 resets to the default
+#' @export
+setLGBMthreads <- function(num_threads) {
+  .Call(LGBTPU_R_SetMaxThreads, as.integer(num_threads))
+  invisible(NULL)
+}
+
+#' Read the maximum number of host threads the library may use
+#'
+#' @return the configured budget, or -1 when unlimited/default
+#' @export
+getLGBMthreads <- function() {
+  .Call(LGBTPU_R_GetMaxThreads)
+}

@@ -464,6 +464,24 @@ SEXP LGBTPU_R_BoosterGetLoadedParam(SEXP bst) {
   });
 }
 
+
+SEXP LGBTPU_R_DumpParamAliases() {
+  return fetch_string([](char* buf, int64_t len, int64_t* need) {
+    return LGBMTPU_DumpParamAliases(buf, len, need);
+  });
+}
+
+SEXP LGBTPU_R_SetMaxThreads(SEXP n) {
+  check(LGBMTPU_SetMaxThreads(Rf_asInteger(n)));
+  return R_NilValue;
+}
+
+SEXP LGBTPU_R_GetMaxThreads() {
+  int out = -1;
+  check(LGBMTPU_GetMaxThreads(&out));
+  return Rf_ScalarInteger(out);
+}
+
 /* ---------------- registration ---------------- */
 
 #define CALLDEF(name, n) {#name, (DL_FUNC)&name, n}
@@ -515,6 +533,9 @@ static const R_CallMethodDef kCallMethods[] = {
     CALLDEF(LGBTPU_R_BoosterGetLowerBoundValue, 1),
     CALLDEF(LGBTPU_R_BoosterGetUpperBoundValue, 1),
     CALLDEF(LGBTPU_R_BoosterGetLoadedParam, 1),
+    CALLDEF(LGBTPU_R_DumpParamAliases, 0),
+    CALLDEF(LGBTPU_R_SetMaxThreads, 1),
+    CALLDEF(LGBTPU_R_GetMaxThreads, 0),
     {NULL, NULL, 0}};
 
 void R_init_lightgbm_tpu(DllInfo* dll) {

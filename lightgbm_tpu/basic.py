@@ -100,6 +100,19 @@ def _coerce_data(data: Any, categorical_feature, category_maps=None):
     elif isinstance(data, list) and data and \
             all(isinstance(s, Sequence) for s in data):
         data = _sequence_to_array(data)
+    if type(data).__module__.split(".")[0] == "datatable" and \
+            hasattr(data, "to_numpy"):
+        # datatable Frame (reference basic.py _data_from_datatable): the
+        # Frame's own to_numpy gives [n, F] with NaN for NA; column names
+        # carry over.  Gated on the module name so the check costs
+        # nothing when datatable isn't installed (it isn't in this
+        # image; the path is exercised by a duck-typed stub in tests).
+        feature_names = [str(c) for c in data.names] \
+            if hasattr(data, "names") else None
+        arr = np.asarray(data.to_numpy(), dtype=np.float64)
+        if arr.ndim == 1:
+            arr = arr.reshape(-1, 1)
+        return arr, feature_names, categorical_feature, None
     if hasattr(data, "column_names") and hasattr(data, "to_pandas"):
         # pyarrow Table: numeric-only tables convert column-by-column from
         # the arrow buffers into ONE [n, F] float64 matrix (no pandas

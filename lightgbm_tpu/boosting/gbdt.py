@@ -311,10 +311,9 @@ class GBDT:
         # (linear trees, CEGB and advanced monotone joined in round 4;
         # advanced-under-voting is downgraded to intermediate before
         # growth, so no monotone config blocks batching)
-        batchable = (self.parallel_mode in (None, "data", "voting")
-                     and not (self.parallel_mode == "voting"
-                              and bool(self.train_set.categorical_array()
-                                       .any())))
+        # voting x categorical joined the batched grower in round 5 (the
+        # winner's histogram column psums for the bitset)
+        batchable = self.parallel_mode in (None, "data", "voting")
         if not config.is_explicit("tpu_split_batch"):
             if at_scale and batchable and int(config.num_leaves) >= 8:
                 # 42: the flat kernel's 3K=126 channels still fit one MXU
@@ -448,13 +447,11 @@ class GBDT:
             kbatch = max(1, int(config.tpu_split_batch))
             slots = max(slots, 3 * kbatch + 2)
             if slots < self.hp.num_leaves:
-                if self.parallel_mode is not None:
-                    # the pooled layout needs per-shard counts; under
-                    # shard_map it would trip the batch_grower assert
-                    # (ADVICE r3 medium) — full per-leaf histograms instead
+                if self.parallel_mode == "feature":
+                    # feature-parallel shards columns, not rows; its
+                    # strict learner keeps full per-shard histograms
                     log.warning("histogram_pool_size ignored under "
-                                "tree_learner=%s (the bounded pool is "
-                                "serial-only)" % self.parallel_mode)
+                                "tree_learner=feature")
                 elif self.forced_splits is not None:
                     # cegb / linear_tree / advanced monotone all compose
                     # with the pooled batched grower since the round-4
@@ -1355,12 +1352,13 @@ class GBDT:
         # batched-capable (learner/batch_grower.py)
         forced_pooled = self.forced_splits is not None \
             and 0 < self.hp.hist_pool_slots < self.hp.num_leaves
-        # batched voting (round 4) carries the PV-Tree protocol but not
-        # categorical splits or forced splits (batch_grower asserts;
-        # advanced monotone is already downgraded to intermediate under
-        # voting at construction)
-        voting_unsupported = self.parallel_mode == "voting" and (
-            self.hp.has_categorical or self.forced_splits is not None)
+        # batched voting carries the PV-Tree protocol including
+        # categorical splits (round 5: the winner's column psums for the
+        # bitset, the strict learner's cadence) but not forced splits
+        # (batch_grower asserts; advanced monotone is already downgraded
+        # to intermediate under voting at construction)
+        voting_unsupported = self.parallel_mode == "voting" and \
+            self.forced_splits is not None
         # CEGB is batched-capable (batch_grower round-4 lift); it only
         # ever reaches this dispatch in serial mode — __init__ fatals on
         # cegb_* with any non-serial tree_learner (gbdt.py:401)
@@ -1377,10 +1375,9 @@ class GBDT:
             if not getattr(self, "_warned_batch", False):
                 log.warning("tpu_split_batch > 1 ignored: "
                             "forced-splits-with-pool, extra_trees/bynode-"
-                            "sampling under distributed modes, "
-                            "categorical/forced/advanced-monotone under "
-                            "voting and the feature-parallel mode require "
-                            "the strict leaf-wise learner")
+                            "sampling under distributed modes, forced "
+                            "splits under voting and the feature-parallel "
+                            "mode require the strict leaf-wise learner")
                 self._warned_batch = True
             return False
         return True
@@ -1493,62 +1490,164 @@ class GBDT:
                             end_it: int) -> Optional[np.ndarray]:
         """Batched on-device prediction: bin X once with the training
         mappers (a raw split ``value <= threshold`` is exactly
-        ``bin <= threshold_bin`` under them), stack the requested trees
-        into one [T, ...] pytree, and scan ``predict_bins_tree`` over
-        it — one compiled program instead of a per-tree host walk.
-        Returns None when a model family needs the host path (linear
-        leaves add per-leaf raw-feature terms the bin traversal lacks).
+        ``bin <= threshold_bin`` under them) and run the matmul batch
+        predictor — ``predict_numeric_forest`` for plain numeric
+        models, ``predict_bitset_forest`` for categorical / EFB-bundled
+        / linear models (round 5; these previously kept 15-30x-slower
+        walks).  One compiled program instead of a per-tree host walk.
         """
         k = self.num_tree_per_iteration
         models = self.models[start_it * k:end_it * k]
-        # linear leaves add per-leaf raw-feature terms the bin traversal
-        # lacks; CATEGORICAL models differ in raw space for categories
-        # unseen at training time (the host walk sends them
-        # right-unless-in-set per the reference, while bin space maps
-        # them onto the most frequent training category) — both keep
-        # the host path so outputs never depend on batch size
-        if (not models or any(t.is_linear for t in models)
-                or bool(self.hp.has_categorical)):
+        if not models:
             return None
-        bins_np = self.train_set.bin_external(X)
         # row blocks bound the [ni, n] decision-bit transients of the
-        # matmul predictor (~0.5 GB bf16 per 1M rows at 255 leaves);
+        # matmul predictors (~0.5 GB bf16 per 1M rows at 255 leaves);
         # ragged tails pad UP to a 131072 multiple so at most 8 block
         # shapes ever compile (a fresh shape per remainder would pay
         # seconds of XLA compile per distinct predict size)
         blk = 1_048_576
         tail_q = 131_072
-        if self.bundle is None:
+        general = (any(t.is_linear for t in models)
+                   or bool(self.hp.has_categorical)
+                   or self.bundle is not None)
+        if general:
+            # categorical / EFB-bundled / linear models: the BITSET
+            # forest predictor (per-node decision bitsets over logical
+            # bins; sentinel bins make unseen-category and NaN rows
+            # match the host raw-space walk, so outputs never depend on
+            # batch size)
+            from ..models.predict import predict_bitset_forest
+            fb, lin, cat_feats = self._forest_bitset_arrays(models, k)
+            bins_np = self.train_set.bin_external_pred(X)
+            raw_np = np.asarray(X, np.float32) if lin is not None else None
+        else:
             from ..models.predict import predict_numeric_forest
             fa = self._forest_arrays(models, k)
-            outs = []
-            n_all = bins_np.shape[0]
-            for r0 in range(0, n_all, blk):
-                chunk = bins_np[r0:r0 + blk]
-                rows = chunk.shape[0]
-                pad = (-rows) % min(tail_q, blk)
-                if pad:
-                    chunk = np.concatenate(
-                        [chunk, np.zeros((pad, chunk.shape[1]),
-                                         chunk.dtype)])
-                bins_t = jnp.asarray(np.ascontiguousarray(chunk.T))
-                outs.append(np.asarray(
-                    predict_numeric_forest(fa, bins_t, k),
-                    np.float64)[:rows])
-            out = np.concatenate(outs, axis=0)
-            return out[:, 0] if k == 1 else out
-        L = max(max(t.num_leaves for t in models), 2)
-        per_tree = [_tree_to_arrays_stub(t, self.train_set,
-                                         num_leaves_out=L)
-                    for t in models]
-        stacked = jax.tree.map(lambda *a: jnp.stack(a), *per_tree)
-        cls_idx = jnp.asarray(
-            np.arange(len(models), dtype=np.int32) % k)
-        out = _predict_stacked_trees(
-            stacked, cls_idx, jnp.asarray(bins_np), self.nan_bin_arr,
-            self.bundle, k, bool(self.hp.has_categorical))
-        out = np.asarray(out, np.float64)
+            bins_np = self.train_set.bin_external(X)
+        outs = []
+        n_all = bins_np.shape[0]
+        for r0 in range(0, n_all, blk):
+            chunk = bins_np[r0:r0 + blk]
+            rows = chunk.shape[0]
+            pad = (-rows) % min(tail_q, blk)
+            if pad:
+                chunk = np.concatenate(
+                    [chunk, np.zeros((pad, chunk.shape[1]), chunk.dtype)])
+            bins_t = jnp.asarray(np.ascontiguousarray(chunk.T))
+            if general:
+                raw_d = nan_d = None
+                if lin is not None:
+                    rchunk = raw_np[r0:r0 + blk]
+                    if pad:
+                        rchunk = np.concatenate(
+                            [rchunk, np.zeros((pad, rchunk.shape[1]),
+                                              rchunk.dtype)])
+                    raw_d = jnp.asarray(np.nan_to_num(rchunk))
+                    nan_d = jnp.asarray(
+                        np.ascontiguousarray(np.isnan(rchunk).T),
+                        jnp.bfloat16)
+                res = predict_bitset_forest(fb, bins_t, k,
+                                            cat_feats=cat_feats,
+                                            lin=lin, raw=raw_d,
+                                            raw_nan=nan_d)
+            else:
+                res = predict_numeric_forest(fa, bins_t, k)
+            outs.append(np.asarray(res, np.float64)[:rows])
+        out = np.concatenate(outs, axis=0)
         return out[:, 0] if k == 1 else out
+
+    def _forest_bitset_arrays(self, models, k: int):
+        """Host Tree list -> stacked BitsetForest (+ LinearLeaves when
+        any tree is linear) for the GENERAL matmul predictor.  Numeric
+        nodes (bundled or not) stay threshold compares in LOGICAL bin
+        space; only true categorical nodes get bitsets, over the narrow
+        categorical bin range plus the unseen/NaN sentinel bins of
+        ``bin_external_pred``.  Returns (fb, lin, cat_feats)."""
+        from ..models.predict import BitsetForest, LinearLeaves
+        ds = self.train_set
+        L = max(max(t.num_leaves for t in models), 2)
+        ni = L - 1
+        T = len(models)
+        orig_to_packed = {o: p for p, o in enumerate(ds.used_feature_idx)}
+        nan_bin_np = np.asarray(self.nan_bin_arr)
+        is_cat_np = np.asarray(ds.categorical_array())
+        cat_feats = tuple(int(p) for p in np.nonzero(is_cat_np)[0])
+        # categorical one-hot width: widest cat feature + 2 sentinels
+        Bc = max((ds.mappers[ds.used_feature_idx[p]].num_bin
+                  for p in cat_feats), default=1) + 2
+        # cat nodes per tree, padded to a shared width (>= 1)
+        C = 1
+        cat_nodes = []
+        for t in models:
+            nn = max(t.num_leaves - 1, 0)
+            nodes = [nd for nd in range(nn)
+                     if int(t.decision_type[nd]) & 1]
+            cat_nodes.append(nodes)
+            C = max(C, len(nodes))
+        feat = np.zeros((T, ni), np.int32)
+        thr = np.zeros((T, ni), np.int32)
+        dl = np.zeros((T, ni), bool)
+        nanb = np.full((T, ni), -2, np.int32)
+        catn = np.full((T, C), ni, np.int32)   # ni = dead pad slot
+        catf = np.zeros((T, C), np.int32)
+        catb = np.zeros((T, C, Bc), np.float32)
+        mpos = np.zeros((T, L, ni), np.float32)
+        mneg = np.zeros((T, L, ni), np.float32)
+        depth = np.full((T, L), -1, np.int32)
+        value = np.zeros((T, L), np.float32)
+        any_linear = any(t.is_linear for t in models)
+        if any_linear:
+            Fr = ds.num_total_features
+            lconst = np.zeros((T, L), np.float32)
+            lcoeff = np.zeros((T, L, Fr), np.float32)
+            lmask = np.zeros((T, L, Fr), np.float32)
+        for ti, t in enumerate(models):
+            nn = max(t.num_leaves - 1, 0)
+            value[ti, :t.num_leaves] = t.leaf_value[:t.num_leaves]
+            _leaf_path_masks(t, mpos[ti], mneg[ti], depth[ti])
+            if any_linear and t.is_linear:
+                for l in range(t.num_leaves):
+                    lconst[ti, l] = t.leaf_const[l]
+                    for fi, f in enumerate(t.leaf_features[l]):
+                        lcoeff[ti, l, f] = t.leaf_coeff[l][fi]
+                        lmask[ti, l, f] = 1.0
+            if nn:
+                pf = np.array([orig_to_packed.get(int(f), 0)
+                               for f in t.split_feature[:nn]], np.int32)
+                feat[ti, :nn] = pf
+                thr[ti, :nn] = t.threshold_bin[:nn]
+                dl[ti, :nn] = (np.asarray(t.decision_type[:nn]) & 2) > 0
+                nanb[ti, :nn] = nan_bin_np[pf]
+            for ci, nd in enumerate(cat_nodes[ti]):
+                p = int(feat[ti, nd])
+                catn[ti, ci] = nd
+                catf[ti, ci] = p
+                csi = int(t.cat_split_index[nd])
+                sets = set(t.cat_threshold[csi])
+                mapper = ds.mappers[ds.used_feature_idx[p]]
+                for b, c in enumerate(mapper.bin_2_categorical):
+                    if c in sets:
+                        catb[ti, ci, b] = 1.0
+                # sentinels ride at this FEATURE's (num_bin, num_bin+1):
+                # unseen -> right (stays 0); NaN -> cat_nan_left
+                # (tree.cpp CategoricalDecision)
+                if csi < len(t.cat_nan_left) and t.cat_nan_left[csi]:
+                    catb[ti, ci, mapper.num_bin + 1] = 1.0
+        fb = BitsetForest(
+            feat=jnp.asarray(feat), thr=jnp.asarray(thr),
+            dl=jnp.asarray(dl), nanb=jnp.asarray(nanb),
+            catn=jnp.asarray(catn), catf=jnp.asarray(catf),
+            catb=jnp.asarray(catb, jnp.bfloat16),
+            mpos=jnp.asarray(mpos, jnp.bfloat16),
+            mneg=jnp.asarray(mneg, jnp.bfloat16),
+            depth=jnp.asarray(depth), value=jnp.asarray(value),
+            cls=jnp.asarray(np.arange(T, dtype=np.int32) % k))
+        lin = None
+        if any_linear:
+            lin = LinearLeaves(const=jnp.asarray(lconst),
+                               coeff=jnp.asarray(lcoeff),
+                               featmask=jnp.asarray(lmask, jnp.bfloat16))
+        return fb, lin, cat_feats
 
     def _forest_arrays(self, models, k: int):
         """Host Tree list -> stacked ForestArrays for the matmul batch
@@ -1579,24 +1678,7 @@ class GBDT:
             dl[ti, :nn] = (t.decision_type[:nn] & 2) > 0
             nanb[ti, :nn] = nan_bin_np[pf] if nn else 0
             value[ti, :t.num_leaves] = t.leaf_value[:t.num_leaves]
-            if t.num_leaves <= 1:
-                depth[ti, 0] = 0
-                continue
-            # DFS from the root recording each leaf's (node, direction)
-            # path; children encode leaves as -(leaf_idx + 1)
-            stack = [(0, [])]
-            while stack:
-                node, path = stack.pop()
-                for child, left in ((t.left_child[node], True),
-                                    (t.right_child[node], False)):
-                    p2 = path + [(node, left)]
-                    if child < 0:
-                        leaf = -int(child) - 1
-                        depth[ti, leaf] = len(p2)
-                        for nd, lft in p2:
-                            (mpos if lft else mneg)[ti, leaf, nd] = 1.0
-                    else:
-                        stack.append((int(child), p2))
+            _leaf_path_masks(t, mpos[ti], mneg[ti], depth[ti])
         return ForestArrays(
             feat=jnp.asarray(feat), thr=jnp.asarray(thr),
             dl=jnp.asarray(dl), nanb=jnp.asarray(nanb),
@@ -1658,23 +1740,28 @@ class GBDT:
         self.iter_ -= 1
 
 
-@functools.partial(jax.jit, static_argnames=("k", "has_cat"))
-def _predict_stacked_trees(stacked: TreeArrays, cls_idx: jax.Array,
-                           bins_d: jax.Array, nan_bin: jax.Array,
-                           bundle, k: int, has_cat: bool) -> jax.Array:
-    """Sum per-tree contributions over a stacked [T, ...] tree pytree
-    into per-class score columns (GBDT._device_predict_raw)."""
-    n = bins_d.shape[0]
-
-    def body(out, xs):
-        tree, cls = xs
-        contrib = predict_bins_tree(tree, bins_d, nan_bin, bundle,
-                                    has_cat)
-        return out.at[:, cls].add(contrib), None
-
-    out0 = jnp.zeros((n, k), jnp.float32)
-    out, _ = lax.scan(body, out0, (stacked, cls_idx))
-    return out
+def _leaf_path_masks(t: Tree, mpos: np.ndarray, mneg: np.ndarray,
+                     depth: np.ndarray) -> None:
+    """Fill one tree's leaf path-direction masks in place (shared by the
+    matmul batch predictors): DFS from the root recording each leaf's
+    (node, direction) path; children encode leaves as -(leaf_idx + 1).
+    mpos/mneg: [L, ni]; depth: [L] (-1 stays for dead slots)."""
+    if t.num_leaves <= 1:
+        depth[0] = 0
+        return
+    stack = [(0, [])]
+    while stack:
+        node, path = stack.pop()
+        for child, left in ((t.left_child[node], True),
+                            (t.right_child[node], False)):
+            p2 = path + [(node, left)]
+            if child < 0:
+                leaf = -int(child) - 1
+                depth[leaf] = len(p2)
+                for nd, lft in p2:
+                    (mpos if lft else mneg)[leaf, nd] = 1.0
+            else:
+                stack.append((int(child), p2))
 
 
 def _tree_to_arrays_stub(tree: Tree, dataset: Dataset,

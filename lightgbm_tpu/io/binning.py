@@ -294,21 +294,7 @@ class BinMapper:
             except ImportError:
                 pass
         if self.bin_type == BIN_CATEGORICAL:
-            out = np.zeros(len(values), dtype=np.int32)
-            isnan = np.isnan(values)
-            ivals = np.where(isnan, -1, values).astype(np.int64)
-            table = self._cat_2_bin or {}
-            # vectorized dict lookup via searchsorted over sorted cats
-            cats = np.array(sorted(table), dtype=np.int64)
-            bins_for = np.array([table[c] for c in cats], dtype=np.int32) \
-                if len(cats) else np.zeros(0, np.int32)
-            if len(cats):
-                pos = np.searchsorted(cats, ivals)
-                pos = np.clip(pos, 0, len(cats) - 1)
-                hit = cats[pos] == ivals
-                out = np.where(hit, bins_for[pos], 0).astype(np.int32)
-            out[isnan] = 0
-            return out
+            return self._cat_values_to_bins(values, 0, 0)
         isnan = np.isnan(values)
         if self.missing_type == MISSING_ZERO:
             values = np.where(isnan, 0.0, values)
@@ -320,6 +306,42 @@ class BinMapper:
         else:
             out[isnan] = self.default_bin
         return out
+
+    def _cat_values_to_bins(self, values: np.ndarray, unseen_bin: int,
+                            nan_bin_out: int) -> np.ndarray:
+        """THE categorical raw->bin lookup, shared by training binning
+        (``values_to_bins``: unseen/NaN fold to bin 0) and the bitset
+        predictor (``values_to_bins_pred``: dedicated sentinel bins).
+        int64 truncation matches the host walk's ``int(v)`` coercion;
+        negative codes never match a category and take the unseen fill."""
+        values = np.asarray(values, dtype=np.float64)
+        isnan = np.isnan(values)
+        ivals = np.where(isnan, -1, values).astype(np.int64)
+        table = self._cat_2_bin or {}
+        # vectorized dict lookup via searchsorted over sorted cats
+        cats = np.array(sorted(table), dtype=np.int64)
+        out = np.full(len(values), unseen_bin, dtype=np.int32)
+        if len(cats):
+            bins_for = np.array([table[c] for c in cats], dtype=np.int32)
+            pos = np.clip(np.searchsorted(cats, ivals), 0, len(cats) - 1)
+            hit = cats[pos] == ivals
+            out = np.where(hit, bins_for[pos], unseen_bin).astype(np.int32)
+        out[isnan] = nan_bin_out
+        return out
+
+    def values_to_bins_pred(self, values: np.ndarray, unseen_bin: int,
+                            nan_bin_out: int) -> np.ndarray:
+        """``values_to_bins`` variant for the device BITSET predictor
+        (models/predict.py predict_bitset_forest): categorical columns
+        map categories unseen at training time to ``unseen_bin`` and NaN
+        to ``nan_bin_out`` instead of folding both into bin 0 — the
+        sentinels let bin-space traversal reproduce the raw-space walk's
+        'not in set -> right' / cat_nan_left branches exactly
+        (reference tree.cpp CategoricalDecision).  Numerical columns are
+        unchanged (their bin space is decision-exact already)."""
+        if self.bin_type != BIN_CATEGORICAL:
+            return self.values_to_bins(values)
+        return self._cat_values_to_bins(values, unseen_bin, nan_bin_out)
 
     def bin_to_value(self, bin_idx: int) -> float:
         """Representative split threshold for a bin boundary: the upper bound

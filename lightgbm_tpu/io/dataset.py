@@ -474,6 +474,29 @@ class Dataset:
             bins = apply_bundles(bins, self.bundle_plan)
         return np.ascontiguousarray(bins)
 
+    def bin_external_pred(self, arr: np.ndarray) -> np.ndarray:
+        """i32 LOGICAL (un-bundled) bins for the device BITSET predictor
+        (models/predict.py ``predict_bitset_forest``): numeric columns
+        bin exactly like ``bin_external``; CATEGORICAL columns map
+        unseen categories to the PER-FEATURE sentinel bin ``num_bin``
+        and NaN to ``num_bin + 1`` so the bitset walk reproduces the
+        host raw-space semantics (unseen/NaN never inherit the
+        most-frequent category's side) while the categorical one-hot
+        stays as narrow as the feature itself.  Un-bundled on purpose —
+        prediction needs no EFB layout, so bundled models route through
+        the same path."""
+        n = arr.shape[0]
+        used = self.used_feature_idx
+        if arr.shape[1] != self.num_total_features:
+            log.fatal(f"The number of features in data ({arr.shape[1]}) "
+                      f"does not match Dataset ({self.num_total_features})")
+        bins = np.zeros((n, len(used)), dtype=np.int32)
+        for col, j in enumerate(used):
+            m = self.mappers[j]
+            bins[:, col] = m.values_to_bins_pred(
+                arr[:, j], m.num_bin, m.num_bin + 1)
+        return np.ascontiguousarray(bins)
+
     # --------------------------------------------------------------- utility
     def bin_threshold_to_value(self, packed_feature: int, bin_thr: int) -> float:
         """Convert a learner bin threshold to the real-valued model threshold."""

@@ -106,10 +106,6 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             "basic", "intermediate", "advanced"), \
             f"unknown monotone method {hp.monotone_method!r}"
     if voting:
-        assert not hp.has_categorical, \
-            "batched voting does not support categorical splits (the " \
-            "sorted-subset bitset needs the GLOBAL histogram; route " \
-            "through the strict learner)"
         assert forced is None, "forced splits need the strict learner " \
             "under voting"
         assert not (hp.use_monotone
@@ -215,9 +211,14 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         strict learner's split-time computation, so identical output).
         Caching it in state removes the record phase's parent-histogram
         read — the step that kept the bounded pool and categorical
-        splits apart (an evicted parent has no histogram to read)."""
+        splits apart (an evicted parent has no histogram to read).
+        Under voting the state holds LOCAL histograms, so the winning
+        feature's column is psum-ed first — one [B, C] column per split,
+        the strict learner's cadence (grower.py split())."""
         col_of = feat if bundle is None else bundle.feat_col[feat]
         pf_col = h_phys[col_of]
+        if voting:
+            pf_col = lax.psum(pf_col, axis_name)
         hist_col = pf_col if bundle is None else \
             _expand_hist_col(pf_col, bundle, feat, g_, h_, c_)
         return categorical_left_bitset(
@@ -278,9 +279,6 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     if pooled:
         assert P >= 3 * K + 2, \
             "hist_pool_slots must be >= 3*batch+2 for worst-case rounds"
-        assert axis_name is None, \
-            "hist_pool_slots does not compose with shard_map yet (its " \
-            "layout needs per-shard counts)"
     state = dict(
         tree=tree,
         leaf_of_row=jnp.zeros((n,), jnp.int32),
@@ -823,8 +821,12 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                                         jnp.maximum(l_cnt, r_cnt), 0.0)
                   leaves_ext = jnp.concatenate(
                       [smaller, jnp.where(need_direct, larger, L - 1)])
-                  h_ext = hist_call(leaves_ext,
-                                    jnp.concatenate([small_cnt, large_cnt]))
+                  # counts are GLOBAL under shard_map while compaction is
+                  # per-shard — same gate as the non-pooled path: let the
+                  # histogram op recompute local counts there
+                  ext_cnt = (jnp.concatenate([small_cnt, large_cnt])
+                             if axis_name is None else None)
+                  h_ext = hist_call(leaves_ext, ext_cnt)
                   h_small = h_ext[:Kr]
                   h_parent = st["hist"][jnp.maximum(p_slot, 0)]
                   h_large = jnp.where(present[:, None, None, None],

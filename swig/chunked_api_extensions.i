@@ -1,0 +1,84 @@
+/* ChunkedBuffer SWIG surface — the streaming-ingestion helpers JVM
+ * consumers build on (counterpart of the reference's
+ * swig/ChunkedArray_API_extensions.i).  Rows accumulate in fixed-size
+ * chunks without a known final count; LGBMTPU_DatasetCreateFromChunks
+ * hands the chunk table to the ABI's multi-matrix constructor. */
+
+%{
+#include "chunked_buffer.hpp"
+%}
+
+%include "chunked_buffer.hpp"
+
+%template(doubleChunkedBuffer) ChunkedBuffer<double>;
+%template(floatChunkedBuffer) ChunkedBuffer<float>;
+%template(int32ChunkedBuffer) ChunkedBuffer<int32_t>;
+
+%inline %{
+#include <stdint.h>
+#include <vector>
+
+/* Create a Dataset straight from chunked staging buffers: the features
+ * buffer must have been filled row-major with a chunk_size that is a
+ * multiple of ncol (each chunk holds whole rows — the same contract the
+ * reference documents for LGBM_DatasetCreateFromMats over ChunkedArray).
+ * The label buffer is coalesced (labels are 8 bytes/row; the copy is
+ * noise next to binning). */
+int LGBMTPU_DatasetCreateFromChunks(ChunkedBuffer<double>* features,
+                                    ChunkedBuffer<double>* labels,
+                                    int64_t ncol, const char* params_json,
+                                    int64_t* out) {
+  if (!features || !labels || ncol <= 0 ||
+      features->get_chunk_size() % ncol != 0 ||
+      features->get_add_count() % ncol != 0 ||
+      features->get_add_count() / ncol != labels->get_add_count()) {
+    return -1;
+  }
+  const int nmat = (int)features->get_chunks_count();
+  std::vector<int32_t> nrows((size_t)(nmat > 0 ? nmat : 1));
+  const int64_t rows_per_chunk = features->get_chunk_size() / ncol;
+  for (int c = 0; c < nmat; ++c) {
+    nrows[(size_t)c] = (int32_t)rows_per_chunk;
+  }
+  if (nmat > 0) {
+    nrows[(size_t)(nmat - 1)] =
+        (int32_t)((features->get_add_count() / ncol) -
+                  rows_per_chunk * (nmat - 1));
+  }
+  std::vector<double> label_flat((size_t)labels->get_add_count());
+  labels->coalesce_to(label_flat.data());
+  return LGBMTPU_DatasetCreateFromMats(
+      nmat, features->chunk_table(), nrows.data(), ncol,
+      label_flat.data(), params_json, out);
+}
+
+/* Streaming push of one staged chunk table into a pre-initialized
+ * Dataset (LGBMTPU_DatasetInitStreaming + PushRows consumers): pushes
+ * each chunk as a row block. */
+int LGBMTPU_DatasetPushChunks(int64_t dataset,
+                              ChunkedBuffer<double>* features,
+                              ChunkedBuffer<double>* labels,
+                              int64_t ncol) {
+  if (!features || !labels || ncol <= 0 ||
+      features->get_chunk_size() % ncol != 0 ||
+      features->get_add_count() % ncol != 0) {
+    return -1;
+  }
+  std::vector<double> label_flat((size_t)labels->get_add_count());
+  labels->coalesce_to(label_flat.data());
+  const int64_t rows_per_chunk = features->get_chunk_size() / ncol;
+  int64_t row0 = 0;
+  const int64_t total_rows = features->get_add_count() / ncol;
+  for (int64_t c = 0; c < features->get_chunks_count(); ++c) {
+    int64_t rows = rows_per_chunk;
+    if (row0 + rows > total_rows) rows = total_rows - row0;
+    if (rows <= 0) break;
+    const int rc = LGBMTPU_DatasetPushRows(
+        dataset, features->chunk_ptr(c), rows, ncol,
+        label_flat.data() + row0);
+    if (rc != 0) return rc;
+    row0 += rows;
+  }
+  return 0;
+}
+%}

@@ -1,0 +1,104 @@
+/* ChunkedBuffer — streaming input staging for JVM consumers of the
+ * lightgbm_tpu C ABI (counterpart of the reference's
+ * swig/ChunkedArray_API_extensions.i over utils/chunked_array.hpp:
+ * SynapseML-style embedders accumulate rows chunk by chunk without
+ * knowing the final count, then hand the chunk table to
+ * LGBMTPU_DatasetCreateFromMats / PushRows).
+ *
+ * Fresh TPU-side design, not a port: chunks are RAII-owned
+ * (std::vector of std::unique_ptr<T[]>), the high-level add() API keeps
+ * the insert cursor, and release is automatic — the reference's manual
+ * release()/new_chunk() low-level surface collapses into clear().
+ */
+#ifndef LGBTPU_SWIG_CHUNKED_BUFFER_HPP_
+#define LGBTPU_SWIG_CHUNKED_BUFFER_HPP_
+
+#include <stdint.h>
+
+#include <memory>
+#include <vector>
+
+template <typename T>
+class ChunkedBuffer {
+ public:
+  explicit ChunkedBuffer(int64_t chunk_size)
+      : chunk_size_(chunk_size > 0 ? chunk_size : 1), added_(0) {}
+
+  /* append one value, growing by a chunk when the last one is full */
+  void add(T value) {
+    const int64_t pos = added_ % chunk_size_;
+    if (pos == 0 && added_ / chunk_size_ >=
+        static_cast<int64_t>(chunks_.size())) {
+      chunks_.emplace_back(new T[chunk_size_]());
+    }
+    chunks_[added_ / chunk_size_][pos] = value;
+    ++added_;
+  }
+
+  int64_t get_add_count() const { return added_; }
+  int64_t get_chunk_size() const { return chunk_size_; }
+  int64_t get_chunks_count() const {
+    return static_cast<int64_t>(chunks_.size());
+  }
+  /* elements in the LAST chunk (it may be partially filled) */
+  int64_t get_last_chunk_add_count() const {
+    if (added_ == 0) return 0;
+    const int64_t r = added_ % chunk_size_;
+    return r == 0 ? chunk_size_ : r;
+  }
+
+  /* random access across chunk boundaries (bounds-unchecked hot path;
+   * getitem() below is the checked SWIG-facing one) */
+  T at(int64_t i) const {
+    return chunks_[i / chunk_size_][i % chunk_size_];
+  }
+  int getitem(int64_t i, T* out) const {
+    if (i < 0 || i >= added_ || out == nullptr) return -1;
+    *out = at(i);
+    return 0;
+  }
+  int setitem(int64_t i, T value) {
+    if (i < 0 || i >= added_) return -1;
+    chunks_[i / chunk_size_][i % chunk_size_] = value;
+    return 0;
+  }
+
+  /* chunk table for the *FromMats-style ABI entries */
+  T* chunk_ptr(int64_t c) const {
+    if (c < 0 || c >= get_chunks_count()) return nullptr;
+    return chunks_[c].get();
+  }
+  const T** chunk_table() {
+    table_.clear();
+    for (const auto& ch : chunks_) {
+      table_.push_back(ch.get());
+    }
+    return table_.data();
+  }
+
+  /* copy everything into one contiguous destination */
+  void coalesce_to(T* dst) const {
+    int64_t left = added_;
+    for (const auto& ch : chunks_) {
+      const int64_t take = left < chunk_size_ ? left : chunk_size_;
+      for (int64_t i = 0; i < take; ++i) dst[i] = ch[i];
+      dst += take;
+      left -= take;
+      if (left <= 0) break;
+    }
+  }
+
+  void clear() {
+    chunks_.clear();
+    table_.clear();
+    added_ = 0;
+  }
+
+ private:
+  int64_t chunk_size_;
+  int64_t added_;
+  std::vector<std::unique_ptr<T[]>> chunks_;
+  std::vector<const T*> table_;
+};
+
+#endif  // LGBTPU_SWIG_CHUNKED_BUFFER_HPP_

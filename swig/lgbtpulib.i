@@ -46,6 +46,11 @@
 
 %include "../lightgbm_tpu/native/capi.h"
 
+/* streaming-ingestion + string helpers for JVM consumers (counterparts
+ * of the reference's ChunkedArray_API_extensions.i / StringArray.i) */
+%include "chunked_api_extensions.i"
+%include "string_api_extensions.i"
+
 /* %newobject: SWIG's wrapper copies the returned string into the target
  * language and then free()s it — so the allocation below must be malloc. */
 %newobject LGBMTPU_BoosterSaveModelToStringSWIG;

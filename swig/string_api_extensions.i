@@ -1,0 +1,88 @@
+/* String-returning conveniences for JVM consumers — counterpart of the
+ * reference's swig/StringArray.i + StringArray_API_extensions.i.  The
+ * reference needed a managed char** helper class because its C API
+ * fills caller-allocated string arrays; this ABI's name getters return
+ * ONE newline-joined buffer (capi.h GetFeatureNames/GetEvalNames), so
+ * the JVM side needs only sized-fetch wrappers — String.split("\n")
+ * replaces the whole StringArray class. */
+
+%newobject LGBMTPU_BoosterGetEvalNamesSWIG;
+%newobject LGBMTPU_BoosterGetFeatureNamesSWIG;
+%newobject LGBMTPU_DatasetGetFeatureNamesSWIG;
+%newobject LGBMTPU_BoosterGetLoadedParamSWIG;
+%newobject LGBMTPU_BoosterDumpModelSWIG;
+
+%inline %{
+#include <stdlib.h>
+
+/* shared sized-fetch: call once for the length, once for the bytes */
+typedef int (*lgbtpu_strfetch_t)(int64_t, char*, int64_t, int64_t*);
+
+static char* lgbtpu_fetch_string_(int64_t handle, lgbtpu_strfetch_t fn) {
+  int64_t need = 0;
+  if (fn(handle, NULL, 0, &need) != 0 || need <= 0) return NULL;
+  char* dst = (char*)malloc((size_t)need);
+  if (!dst) return NULL;
+  int64_t cap = need;
+  if (fn(handle, dst, cap, &need) != 0) {
+    free(dst);
+    return NULL;
+  }
+  return dst;
+}
+
+static int lgbtpu_eval_names_(int64_t h, char* buf, int64_t len,
+                              int64_t* need) {
+  return LGBMTPU_BoosterGetEvalNames(h, buf, len, need);
+}
+static int lgbtpu_feat_names_(int64_t h, char* buf, int64_t len,
+                              int64_t* need) {
+  return LGBMTPU_BoosterGetFeatureNames(h, buf, len, need);
+}
+static int lgbtpu_ds_feat_names_(int64_t h, char* buf, int64_t len,
+                                 int64_t* need) {
+  return LGBMTPU_DatasetGetFeatureNames(h, buf, len, need);
+}
+static int lgbtpu_loaded_param_(int64_t h, char* buf, int64_t len,
+                                int64_t* need) {
+  return LGBMTPU_BoosterGetLoadedParam(h, buf, len, need);
+}
+
+/* newline-joined eval metric names (split on "\n" JVM-side) */
+char* LGBMTPU_BoosterGetEvalNamesSWIG(int64_t booster) {
+  return lgbtpu_fetch_string_(booster, lgbtpu_eval_names_);
+}
+
+/* newline-joined feature names of a trained booster */
+char* LGBMTPU_BoosterGetFeatureNamesSWIG(int64_t booster) {
+  return lgbtpu_fetch_string_(booster, lgbtpu_feat_names_);
+}
+
+/* newline-joined feature names of a dataset */
+char* LGBMTPU_DatasetGetFeatureNamesSWIG(int64_t dataset) {
+  return lgbtpu_fetch_string_(dataset, lgbtpu_ds_feat_names_);
+}
+
+/* JSON of the parameters a loaded model carries */
+char* LGBMTPU_BoosterGetLoadedParamSWIG(int64_t booster) {
+  return lgbtpu_fetch_string_(booster, lgbtpu_loaded_param_);
+}
+
+/* JSON dump of the model (num_iteration <= 0 = all) */
+char* LGBMTPU_BoosterDumpModelSWIG(int64_t booster, int num_iteration) {
+  int64_t need = 0;
+  if (LGBMTPU_BoosterDumpModel(booster, num_iteration, NULL, 0,
+                               &need) != 0 || need <= 0) {
+    return NULL;
+  }
+  char* dst = (char*)malloc((size_t)need);
+  if (!dst) return NULL;
+  int64_t cap = need;
+  if (LGBMTPU_BoosterDumpModel(booster, num_iteration, dst, cap,
+                               &need) != 0) {
+    free(dst);
+    return NULL;
+  }
+  return dst;
+}
+%}

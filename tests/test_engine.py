@@ -1628,9 +1628,9 @@ def test_device_predict_parity_paths(monkeypatch):
     host = gb.predict_raw(X)
     np.testing.assert_allclose(dev, host, rtol=2e-5, atol=2e-6)
 
-    # categorical models DECLINE the device path: raw-space unseen
-    # categories go right-unless-in-set (reference semantics), which
-    # bin space cannot represent — outputs must not depend on batch size
+    # categorical models take the BITSET device path (round 5): unseen
+    # categories, negative codes and NaN rows must match the host
+    # raw-space walk (sentinel bins in bin_external_pred)
     monkeypatch.setattr(GBDT, "DEVICE_PREDICT_MIN_WORK", 0)
     Xc = np.concatenate(
         [rng.normal(size=(n, 3)),
@@ -1640,9 +1640,18 @@ def test_device_predict_parity_paths(monkeypatch):
     pc = {**FAST, "objective": "binary", "categorical_feature": [3]}
     bc = lgb.train(pc, lgb.Dataset(Xc, label=yc, params=pc),
                    num_boost_round=12)
-    assert bc._gbdt._device_predict_raw(Xc, 0, 12) is None
+    Xc_test = Xc.copy()
+    Xc_test[::7, 3] = 50.0          # category unseen at training time
+    Xc_test[::11, 3] = np.nan
+    Xc_test[::13, 3] = -3.0         # negative code -> NaN-like (right)
+    gbc = bc._gbdt
+    devc = gbc.predict_raw(Xc_test)
+    monkeypatch.setattr(GBDT, "DEVICE_PREDICT_MIN_WORK", 1 << 62)
+    hostc = gbc.predict_raw(Xc_test)
+    np.testing.assert_allclose(devc, hostc, rtol=2e-5, atol=2e-6)
 
-    # EFB-bundled numeric model: the frontier-walk device path
+    # EFB-bundled numeric model: the bitset device path over LOGICAL bins
+    monkeypatch.setattr(GBDT, "DEVICE_PREDICT_MIN_WORK", 0)
     which = rng.integers(0, 9, size=n)
     Xb = np.zeros((n, 9 + 2))
     Xb[:, :2] = rng.normal(size=(n, 2))
@@ -1658,6 +1667,19 @@ def test_device_predict_parity_paths(monkeypatch):
         monkeypatch.setattr(GBDT, "DEVICE_PREDICT_MIN_WORK", 1 << 62)
         hostb = gbb.predict_raw(Xb)
         np.testing.assert_allclose(devb, hostb, rtol=2e-5, atol=2e-6)
+
+    # linear-leaf model: const + coeff·x with per-leaf NaN fallback
+    monkeypatch.setattr(GBDT, "DEVICE_PREDICT_MIN_WORK", 0)
+    pl = {**FAST, "objective": "regression", "linear_tree": True}
+    yl = X[:, 0] * 1.5 + np.nan_to_num(X[:, 1]) * 0.5 \
+        + rng.normal(scale=0.2, size=n)
+    bl = lgb.train(pl, lgb.Dataset(X, label=yl, params=pl),
+                   num_boost_round=8)
+    gbl = bl._gbdt
+    devl = gbl.predict_raw(X)
+    monkeypatch.setattr(GBDT, "DEVICE_PREDICT_MIN_WORK", 1 << 62)
+    hostl = gbl.predict_raw(X)
+    np.testing.assert_allclose(devl, hostl, rtol=2e-4, atol=2e-4)
 
     # multiclass columns route to the right classes
     monkeypatch.setattr(GBDT, "DEVICE_PREDICT_MIN_WORK", 0)

@@ -8,12 +8,27 @@ IDENTICAL models for every newly-fused configuration, and the engine's
 callback semantics (best_iteration, truncation) are unchanged.
 """
 
+import jax
 import numpy as np
 import numpy.testing as npt
 import pytest
 
 import lightgbm_tpu as lgb
 from lightgbm_tpu.boosting.gbdt import GBDT
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _no_persistent_compilation_cache():
+    """jaxlib's executable serializer segfaults (SIGSEGV in
+    put_executable_and_time) on the in-jit early-stop runner's program
+    under full-suite conditions — and a crashed write corrupts the cache
+    for every later run (SIGSEGV at get_executable_and_time).  The
+    persistent cache is a test-speed optimization only; skip it for this
+    module."""
+    old = jax.config.jax_enable_compilation_cache
+    jax.config.update("jax_enable_compilation_cache", False)
+    yield
+    jax.config.update("jax_enable_compilation_cache", old)
 
 
 def _task(n=6000, f=8, seed=0, noise=1.0):

@@ -304,3 +304,70 @@ def test_gspmd_entry_style_matches_shard_map(problem):
     np.testing.assert_array_equal(np.asarray(tree_g.split_bin),
                                   np.asarray(tree_s.split_bin))
     np.testing.assert_array_equal(np.asarray(lor_g), np.asarray(lor_s))
+
+
+def test_batched_voting_categorical_matches_strict():
+    """Round 5: voting x categorical joined the batched grower (the
+    winner's histogram column psums for the sorted-subset bitset).
+    batch=1 batched voting must reproduce the strict voting learner
+    bit-for-bit on a categorical problem."""
+    import dataclasses
+    from lightgbm_tpu.parallel.data_parallel import (
+        grow_tree_batched_sharded)
+
+    rng = np.random.default_rng(11)
+    n, f = 4096, 6
+    bins = rng.integers(0, 16, size=(n, f)).astype(np.uint8)
+    cat_col = rng.integers(0, 12, size=n).astype(np.uint8)
+    bins[:, 3] = cat_col
+    y = ((bins[:, 0] > 8) | np.isin(cat_col, [2, 5, 7])).astype(np.float32)
+    g = (0.5 - y).astype(np.float32)
+    h = np.full(n, 0.25, np.float32)
+    nb = np.full(f, 16, np.int32)
+    nanb = np.full(f, -1, np.int32)
+    cat = np.zeros(f, bool)
+    cat[3] = True
+    hp = dataclasses.replace(HP, has_categorical=True,
+                             max_cat_to_onehot=4)
+    args = tuple(map(jnp.asarray, (bins, g, h, nb, nanb, cat)))
+    mesh = _mesh(DATA_AXIS)
+
+    tree_s, lor_s = grow_tree_sharded(
+        mesh, args[0], args[1], args[2], None, args[3], args[4], args[5],
+        None, hp, parallel_mode="voting", top_k=4)
+    tree_b, lor_b = grow_tree_batched_sharded(
+        mesh, args[0], args[1], args[2], None, args[3], args[4], args[5],
+        None, hp, batch=1, parallel_mode="voting", top_k=4)
+    assert int(tree_s.num_leaves) >= 2
+    assert bool(np.asarray(tree_s.split_cat).any()), \
+        "problem must actually produce a categorical split"
+    np.testing.assert_array_equal(np.asarray(tree_b.split_feature),
+                                  np.asarray(tree_s.split_feature))
+    np.testing.assert_array_equal(np.asarray(tree_b.split_bin),
+                                  np.asarray(tree_s.split_bin))
+    np.testing.assert_array_equal(np.asarray(tree_b.cat_bitset),
+                                  np.asarray(tree_s.cat_bitset))
+    np.testing.assert_array_equal(np.asarray(lor_b), np.asarray(lor_s))
+
+
+def test_pooled_grower_composes_with_shard_map(problem):
+    """Round 5: the bounded histogram pool under shard_map (the
+    pool x shard_map assert is gone).  Pooling is exact — the sharded
+    pooled grower must reproduce the sharded full-histogram grower."""
+    import dataclasses
+    from lightgbm_tpu.parallel.data_parallel import (
+        grow_tree_batched_sharded)
+
+    bins, g, h, nb, nanb, cat = map(jnp.asarray, problem)
+    mesh = _mesh(DATA_AXIS)
+    hp_pool = dataclasses.replace(HP, hist_pool_slots=8)
+    tree_p, lor_p = grow_tree_batched_sharded(
+        mesh, bins, g, h, None, nb, nanb, cat, None, hp_pool, batch=2)
+    tree_f, lor_f = grow_tree_batched_sharded(
+        mesh, bins, g, h, None, nb, nanb, cat, None, HP, batch=2)
+    assert int(tree_p.num_leaves) == int(tree_f.num_leaves)
+    np.testing.assert_array_equal(np.asarray(tree_p.split_feature),
+                                  np.asarray(tree_f.split_feature))
+    np.testing.assert_array_equal(np.asarray(tree_p.split_bin),
+                                  np.asarray(tree_f.split_bin))
+    np.testing.assert_array_equal(np.asarray(lor_p), np.asarray(lor_f))

@@ -1,0 +1,74 @@
+"""Profile the device batch predictor on a live chip (device-lane HLO
+aggregation, same parsing as profile_bench).
+
+Usage: PCAT=1 PROWS=1000000 PTREES=100 python tools/profile_predict.py
+"""
+import glob
+import gzip
+import json
+import os
+import sys
+from collections import defaultdict
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+CAT = bool(int(os.environ.get("PCAT", "1")))
+N = int(os.environ.get("PROWS", "1000000"))
+TREES = int(os.environ.get("PTREES", "100"))
+
+import jax
+import lightgbm_tpu as lgb
+
+rng = np.random.default_rng(5)
+n_train = 200_000
+if CAT:
+    Xt = np.concatenate([rng.normal(size=(n_train, 24)),
+                         rng.integers(0, 30, size=(n_train, 4)).astype(float)],
+                        axis=1)
+    p = {"objective": "binary", "verbose": -1, "num_leaves": 255,
+         "categorical_feature": [24, 25, 26, 27], "min_data_in_leaf": 20}
+else:
+    Xt = rng.normal(size=(n_train, 28))
+    p = {"objective": "binary", "verbose": -1, "num_leaves": 255,
+         "min_data_in_leaf": 20}
+y = (Xt[:, 0] + rng.normal(scale=0.5, size=n_train) > 0.5).astype(np.float64)
+bst = lgb.train(p, lgb.Dataset(Xt, label=y, params=p),
+                num_boost_round=TREES)
+gb = bst._gbdt
+X = np.concatenate([rng.normal(size=(N, 24)),
+                    rng.integers(0, 32, size=(N, 4)).astype(float)],
+                   axis=1) if CAT else rng.normal(size=(N, 28))
+gb.predict_raw(X)          # warm
+
+tdir = "/tmp/jaxprof_pred"
+os.system(f"rm -rf {tdir}")
+with jax.profiler.trace(tdir):
+    gb.predict_raw(X)
+
+files = glob.glob(f"{tdir}/**/*.trace.json.gz", recursive=True)
+with gzip.open(files[0], "rt") as fh:
+    trace = json.load(fh)
+events = trace["traceEvents"]
+pid_names, tid_names = {}, {}
+for e in events:
+    if e.get("ph") == "M":
+        if e.get("name") == "process_name":
+            pid_names[e["pid"]] = e["args"].get("name", "")
+        if e.get("name") == "thread_name":
+            tid_names[(e["pid"], e["tid"])] = e["args"].get("name", "")
+agg, cnt, total = defaultdict(float), defaultdict(int), 0.0
+for e in events:
+    if e.get("ph") != "X":
+        continue
+    if "TPU" not in pid_names.get(e["pid"], ""):
+        continue
+    if "step" in tid_names.get((e["pid"], e["tid"]), "").lower():
+        continue
+    agg[e.get("name", "?")] += e.get("dur", 0) / 1e3
+    cnt[e.get("name", "?")] += 1
+    total += e.get("dur", 0) / 1e3
+print(f"# total device time: {total:.1f} ms ({TREES} trees)")
+for name, ms in sorted(agg.items(), key=lambda kv: -kv[1])[:25]:
+    print(f"{ms:9.1f} ms  x{cnt[name]:<6} {name[:100]}")
