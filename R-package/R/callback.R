@@ -51,7 +51,18 @@ cb_early_stop <- function(stopping_rounds, first_metric_only = FALSE,
     if (length(env$eval_list) == 0L) {
       return(invisible(NULL))
     }
-    consider <- if (first_metric_only) 1L else seq_along(env$eval_list)
+    consider <- seq_along(env$eval_list)
+    if (first_metric_only) {
+      # every valid set's entry for the FIRST metric family — the same
+      # family semantics as the python callback and the fused in-jit
+      # early stop (boosting/gbdt.py), so both frontends stop at the
+      # same iteration on multi-valid runs
+      fam <- function(m) sub("@.*$", "", m)
+      first_fam <- fam(env$eval_parts[[1L]][[2L]])
+      consider <- which(vapply(env$eval_parts, function(p) {
+        fam(p[[2L]]) == first_fam
+      }, logical(1L)))
+    }
     for (i in consider) {
       nm <- names(env$eval_list)[[i]]
       v <- env$eval_list[[i]]

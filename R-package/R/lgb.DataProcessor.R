@@ -3,13 +3,17 @@
 # interface.  Factor/character columns are coded to numeric with
 # deterministic, reusable rules (lgb.convert_with_rules) and flagged as
 # categorical_feature; the rules ride on the returned booster so
-# predict() on a data.frame codes new data identically — including
-# levels unseen at training time (NA -> the reference's
-# not-in-any-set branch).
+# predict() on a data.frame codes new data identically.  Levels unseen
+# at training time code to NA and route through the predictor's
+# missing-category branch — the same treatment the reference's
+# rules-based conversion gives unseen levels (its stored-rules apply
+# also yields NA; true go-right "not in set" semantics exist only for
+# numeric-coded categoricals, where the raw value survives to predict).
 
 # prepare a data.frame/matrix for training: returns
-# list(data = numeric matrix, categorical_feature = 0-based ABI indices
-#      or NULL, rules = coding rules or NULL)
+# list(data = numeric matrix, categorical_feature = 1-based column
+#      indices as lgb.Dataset consumes them (it converts to the ABI's
+#      0-based form itself) or NULL, rules = coding rules or NULL)
 .lgb_data_processor_prepare <- function(data) {
   if (!is.data.frame(data)) {
     return(list(data = data, categorical_feature = NULL, rules = NULL))

@@ -19,6 +19,11 @@
 #' @param eval_freq print/record every k-th iteration
 #' @param early_stopping_rounds stop when no validation metric improves
 #'   for this many rounds; sets best_iter on the booster
+#' @param first_metric_only early-stop on the first metric family only
+#' @param reset_parameter named list of per-iteration parameter
+#'   schedules (vector or function(iter, total)), applied through
+#'   BoosterResetParameter each round (reference reset_parameter
+#'   callback)
 #' @param init_model a Booster or model file to continue training from
 #' @param callbacks list of functions(env) called after each iteration;
 #'   env carries booster/iteration/nrounds/eval_list
@@ -28,8 +33,10 @@
 lgb.train <- function(params = list(), data, nrounds = 100L,
                       valids = list(), obj = NULL, record = TRUE,
                       verbose = 1L, eval_freq = 1L,
-                      early_stopping_rounds = NULL, init_model = NULL,
-                      callbacks = list(), reset_data = FALSE, ...) {
+                      early_stopping_rounds = NULL,
+                      first_metric_only = FALSE, init_model = NULL,
+                      callbacks = list(), reset_parameter = NULL,
+                      reset_data = FALSE, ...) {
   stopifnot(inherits(data, "lgb.Dataset"))
   params <- c(params, list(...))
   if (!is.null(obj)) {
@@ -70,6 +77,8 @@ lgb.train <- function(params = list(), data, nrounds = 100L,
   cbs <- .lgb_build_callbacks(
     verbose = verbose, eval_freq = eval_freq, record = record,
     early_stopping_rounds = early_stopping_rounds,
+    first_metric_only = first_metric_only,
+    reset_parameter = reset_parameter,
     user_callbacks = callbacks)
   eval_names <- NULL
   booster$stop_training <- FALSE

@@ -109,7 +109,13 @@ def _coerce_data(data: Any, categorical_feature, category_maps=None):
         # image; the path is exercised by a duck-typed stub in tests).
         feature_names = [str(c) for c in data.names] \
             if hasattr(data, "names") else None
-        arr = np.asarray(data.to_numpy(), dtype=np.float64)
+        arr = data.to_numpy()
+        if np.ma.isMaskedArray(arr):
+            # real datatable returns a MASKED array for non-float
+            # columns with NAs; np.asarray would silently expose the
+            # fill values — masked cells must become NaN (missing)
+            arr = np.ma.filled(arr.astype(np.float64), np.nan)
+        arr = np.asarray(arr, dtype=np.float64)
         if arr.ndim == 1:
             arr = arr.reshape(-1, 1)
         return arr, feature_names, categorical_feature, None

@@ -61,8 +61,9 @@ int LGBMTPU_DatasetPushChunks(int64_t dataset,
                               int64_t ncol) {
   if (!features || !labels || ncol <= 0 ||
       features->get_chunk_size() % ncol != 0 ||
-      features->get_add_count() % ncol != 0) {
-    return -1;
+      features->get_add_count() % ncol != 0 ||
+      features->get_add_count() / ncol != labels->get_add_count()) {
+    return -1;  // incl. rows/labels mismatch: never read past label_flat
   }
   std::vector<double> label_flat((size_t)labels->get_add_count());
   labels->coalesce_to(label_flat.data());

@@ -1,0 +1,30 @@
+#!/bin/bash
+# Build the reference LightGBM CLI from /root/reference source for the
+# same-host baseline capture (BASELINE.json reference_same_host_same_data).
+#
+# Why not cmake: the reference requires cmake >= 3.28; this image ships
+# 3.25.  Why shims: the vendored fmt / fast_double_parser submodules are
+# EMPTY in this checkout; the reference uses exactly one fmt API
+# (format_to_n with "{}"/"{:g}"/"{:.17g}", utils/common.h:1203) and one
+# fast_double_parser API (parse_number, utils/common.h:356), which
+# tools/ref_shims/ implements freshly (snprintf / strtod).  Eigen (for
+# linear_tree_learner.cpp) comes from TensorFlow's bundled copy.
+#
+# Usage: tools/build_reference_cli.sh [outdir=.refbuild]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+OUT=${1:-.refbuild}
+mkdir -p "$OUT"
+cp -r tools/ref_shims "$OUT/shim"
+EIGEN=/opt/venv/lib/python3.12/site-packages/tensorflow/include
+g++ -O3 -std=c++17 -fopenmp -DUSE_SOCKET -DMM_MALLOC=1 -DEIGEN_MPL2_ONLY \
+  -I"$OUT/shim" -I/root/reference/include -I"$EIGEN" \
+  /root/reference/src/boosting/*.cpp /root/reference/src/io/*.cpp \
+  /root/reference/src/metric/*.cpp /root/reference/src/network/*.cpp \
+  /root/reference/src/objective/objective_function.cpp \
+  /root/reference/src/treelearner/*.cpp \
+  /root/reference/src/utils/openmp_wrapper.cpp \
+  /root/reference/src/application/application.cpp \
+  /root/reference/src/main.cpp \
+  -o "$OUT/lightgbm-ref" -lpthread
+echo "built $OUT/lightgbm-ref"

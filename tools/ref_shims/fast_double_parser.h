@@ -1,0 +1,15 @@
+// Minimal fast_double_parser shim (vendored submodule empty in this
+// checkout).  API used: parse_number(p, out) -> end pointer or nullptr
+// (include/LightGBM/utils/common.h:356).  strtod is slower but exact.
+#pragma once
+#include <cstdlib>
+
+namespace fast_double_parser {
+
+inline const char* parse_number(const char* p, double* out) {
+  char* end = nullptr;
+  *out = std::strtod(p, &end);
+  return end == p ? nullptr : end;
+}
+
+}  // namespace fast_double_parser

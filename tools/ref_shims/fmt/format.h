@@ -1,0 +1,38 @@
+// Minimal fmt shim for building the reference CLI without its vendored
+// submodule (empty in this checkout).  The reference uses exactly one fmt
+// API: fmt::format_to_n(buf, n, fmt, value) with format strings "{}",
+// "{:g}" and "{:.17g}" (include/LightGBM/utils/common.h:1203).
+#pragma once
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <type_traits>
+
+namespace fmt {
+
+struct format_to_n_result_shim { size_t size; };
+
+template <typename T>
+inline format_to_n_result_shim format_to_n(char* buf, size_t n,
+                                           const char* f, T value) {
+  char out[512];
+  int len;
+  if (std::strcmp(f, "{:.17g}") == 0) {
+    len = snprintf(out, sizeof(out), "%.17g", static_cast<double>(value));
+  } else if (std::strcmp(f, "{:g}") == 0) {
+    len = snprintf(out, sizeof(out), "%g", static_cast<double>(value));
+  } else if (std::is_floating_point<T>::value) {
+    len = snprintf(out, sizeof(out), "%.17g", static_cast<double>(value));
+  } else if (std::is_signed<T>::value) {
+    len = snprintf(out, sizeof(out), "%lld",
+                   static_cast<long long>(value));
+  } else {
+    len = snprintf(out, sizeof(out), "%llu",
+                   static_cast<unsigned long long>(value));
+  }
+  size_t m = static_cast<size_t>(len) < n ? static_cast<size_t>(len) : n;
+  std::memcpy(buf, out, m);
+  return format_to_n_result_shim{static_cast<size_t>(len)};
+}
+
+}  // namespace fmt
