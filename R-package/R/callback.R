@@ -101,15 +101,21 @@ cb_early_stop <- function(stopping_rounds, first_metric_only = FALSE,
 cb_reset_parameter <- function(new_params) {
   # new_params: named list; each entry is a vector (one value per
   # iteration) or function(iteration, total) -> value — the reference
-  # reset_parameter callback's contract
-  function(env) {
+  # reset_parameter callback's contract.  Runs BEFORE the iteration
+  # (reference before_iteration = TRUE; python frontend callback.py),
+  # so iteration i trains with schedule value i.
+  cb <- function(env) {
     upd <- list()
     for (nm in names(new_params)) {
       spec <- new_params[[nm]]
-      v <- if (is.function(spec)) {
-        spec(env$iteration, env$end_iteration)
+      if (is.function(spec)) {
+        v <- spec(env$iteration, env$end_iteration)
       } else {
-        spec[[min(env$iteration, length(spec))]]
+        if (length(spec) < env$end_iteration) {
+          stop("reset_parameter: length of '", nm, "' (", length(spec),
+               ") must cover every iteration (", env$end_iteration, ")")
+        }
+        v <- spec[[env$iteration]]
       }
       upd[[nm]] <- v
     }
@@ -119,11 +125,14 @@ cb_reset_parameter <- function(new_params) {
     }
     invisible(NULL)
   }
+  attr(cb, "pre_iteration") <- TRUE
+  cb
 }
 
 # assemble the built-in callback pipeline the way engine.py orders its
-# callbacks: reset_parameter (before-effects) first, then printing,
-# recording and early stopping
+# callbacks: reset_parameter carries attr pre_iteration = TRUE and runs
+# BEFORE BoosterUpdateOneIter (lgb.train splits on the attribute), then
+# printing, recording and early stopping run after the iteration
 .lgb_build_callbacks <- function(verbose, eval_freq, record,
                                  early_stopping_rounds,
                                  first_metric_only = FALSE,

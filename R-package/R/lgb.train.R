@@ -80,10 +80,17 @@ lgb.train <- function(params = list(), data, nrounds = 100L,
     first_metric_only = first_metric_only,
     reset_parameter = reset_parameter,
     user_callbacks = callbacks)
+  pre <- Filter(function(cb) isTRUE(attr(cb, "pre_iteration")), cbs)
+  post <- Filter(function(cb) !isTRUE(attr(cb, "pre_iteration")), cbs)
   eval_names <- NULL
   booster$stop_training <- FALSE
 
   for (i in seq_len(nrounds)) {
+    for (cb in pre) {
+      cb(list(booster = booster, iteration = i, begin_iteration = 1L,
+              end_iteration = nrounds, eval_list = list(),
+              eval_parts = list(), nrounds = nrounds))
+    }
     if (is.null(obj)) {
       .Call(LGBTPU_R_BoosterUpdateOneIter, booster$handle)
     } else {
@@ -116,7 +123,7 @@ lgb.train <- function(params = list(), data, nrounds = 100L,
     env <- list(booster = booster, iteration = i, begin_iteration = 1L,
                 end_iteration = nrounds, eval_list = eval_list,
                 eval_parts = eval_parts, nrounds = nrounds)
-    for (cb in cbs) {
+    for (cb in post) {
       cb(env)
     }
     if (isTRUE(booster$stop_training)) {

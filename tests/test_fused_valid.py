@@ -19,16 +19,20 @@ from lightgbm_tpu.boosting.gbdt import GBDT
 
 @pytest.fixture(autouse=True, scope="module")
 def _no_persistent_compilation_cache():
-    """jaxlib's executable serializer segfaults (SIGSEGV in
-    put_executable_and_time) on the in-jit early-stop runner's program
+    """jaxlib's executable serializer dies (SIGSEGV/SIGABRT in
+    put_executable_and_time) on this module's fused-runner programs
     under full-suite conditions — and a crashed write corrupts the cache
     for every later run (SIGSEGV at get_executable_and_time).  The
     persistent cache is a test-speed optimization only; skip it for this
-    module."""
-    old = jax.config.jax_enable_compilation_cache
+    module.  BOTH knobs must clear: with jax_compilation_cache_dir still
+    set (conftest), the enable flag alone did not gate writes here."""
+    old_flag = jax.config.jax_enable_compilation_cache
+    old_dir = jax.config.jax_compilation_cache_dir
     jax.config.update("jax_enable_compilation_cache", False)
+    jax.config.update("jax_compilation_cache_dir", None)
     yield
-    jax.config.update("jax_enable_compilation_cache", old)
+    jax.config.update("jax_enable_compilation_cache", old_flag)
+    jax.config.update("jax_compilation_cache_dir", old_dir)
 
 
 def _task(n=6000, f=8, seed=0, noise=1.0):

@@ -15,7 +15,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 OUT=${1:-.refbuild}
 mkdir -p "$OUT"
-cp -r tools/ref_shims "$OUT/shim"
+rm -rf "$OUT/shim" && cp -r tools/ref_shims "$OUT/shim"
 EIGEN=/opt/venv/lib/python3.12/site-packages/tensorflow/include
 g++ -O3 -std=c++17 -fopenmp -DUSE_SOCKET -DMM_MALLOC=1 -DEIGEN_MPL2_ONLY \
   -I"$OUT/shim" -I/root/reference/include -I"$EIGEN" \
