@@ -184,6 +184,40 @@ def supervise():
            "value": -1.0, "unit": "seconds", "vs_baseline": 0.0}, 1)
 
 
+def _capture_quality(repeats=3):
+    """Capture-quality preamble for the emitted JSON (VERDICT Weak #2:
+    the flagship e2e number failed to reproduce — 467 s vs 924-1108 s
+    re-runs — with nothing in BENCH_*.json to tell a clean window from a
+    congested one).  Reports a 3-repeat timing of a fixed small device
+    computation (compile excluded) whose spread exposes a congested
+    tunnel/host, plus host RSS and device memory stats at capture time.
+    Child-process only — imports jax."""
+    import jax.numpy as jnp
+    from lightgbm_tpu.obs import memory as obs_memory
+
+    x = jnp.ones((2048, 2048))
+    (x @ x).block_until_ready()          # compile outside the probe
+    probes = []
+    for _ in range(max(1, repeats)):
+        t0 = time.perf_counter()
+        (x @ x).block_until_ready()
+        probes.append(round(time.perf_counter() - t0, 6))
+    out = {
+        "probe_matmul_s": probes,
+        "probe_spread": round(max(probes) / max(min(probes), 1e-9), 3),
+    }
+    out.update(obs_memory.memory_snapshot())
+    return out
+
+
+def _memory_result():
+    """Post-measurement memory stats for the payload (closes VERDICT
+    Missing #3: peak RAM is a headline result in the reference's
+    Experiments.rst but BENCH_*.json never carried it)."""
+    from lightgbm_tpu.obs import memory as obs_memory
+    return obs_memory.memory_snapshot()
+
+
 def _synth_higgs(n, f, rng, w=None):
     """Higgs-shaped synthetic binary data (separable-ish continuous
     features; BASELINE.md pairs its 130.094 s with AUC 0.845724 on the real
@@ -227,6 +261,7 @@ def main_e2e():
     # device AUC eval ride INSIDE the fused scan (round 5), the
     # reference HIGGS recipe's shape (train + eval each iteration)
     with_valid = bool(os.environ.get("BENCH_VALID"))
+    capture = _capture_quality()
     ds = lgb.Dataset(feat, label=label, params=params)
     ds.construct()
     # warm the jit caches OUTSIDE the timed region: through the tunnel's
@@ -285,6 +320,8 @@ def main_e2e():
         "vs_baseline": round(baseline_equiv / elapsed, 4),
         "auc": round(float(auc), 6),
         "platform": jax.devices()[0].platform,
+        "capture_quality": capture,
+        "memory": _memory_result(),
     }
     if with_valid and getattr(gb, "_last_fused_evals", None):
         # the in-scan device AUC of the final round (proof the valid set
@@ -389,6 +426,7 @@ def main():
     # precision for speed, docs/GPU-Performance.rst single-precision + 63-bin
     # recommendation).  BENCH_HIST_DTYPE=bfloat16/float32 to A/B.
     hist_dtype = os.environ.get("BENCH_HIST_DTYPE", "int8")
+    capture = _capture_quality()
     elapsed = _time_kernel_run(feat, label, MAX_BIN, hist_dtype)
     baseline_equiv = BASELINE_S_PER_ROW_ITER * n * BENCH_ITERS
     payload = {
@@ -397,6 +435,7 @@ def main():
         "unit": "seconds",
         "vs_baseline": round(baseline_equiv / elapsed, 4),
         "platform": jax.devices()[0].platform,
+        "capture_quality": capture,
     }
     if MAX_BIN == 255 and not os.environ.get("BENCH_NO_SPEED_MODE"):
         # the reference GPU docs' speed configuration (max_bin=63,
@@ -409,6 +448,8 @@ def main():
             "value": round(e63, 3),
             "vs_baseline": round(baseline_equiv / e63, 4),
         }
+    # sampled AFTER the timed runs so peak covers the measurement itself
+    payload["memory"] = _memory_result()
     print(json.dumps(payload))
 
 

@@ -14,7 +14,8 @@ __version__ = "0.1.0"
 from .basic import Booster, Dataset, Sequence  # noqa: E402
 from .engine import cv, train  # noqa: E402
 from .callback import (early_stopping, log_evaluation,  # noqa: E402
-                       record_evaluation, reset_parameter)
+                       log_telemetry, record_evaluation, reset_parameter)
+from .obs import global_metrics  # noqa: E402
 
 try:  # sklearn wrappers are optional (sklearn may be absent)
     from .sklearn import (LGBMClassifier, LGBMModel,  # noqa: E402
@@ -30,7 +31,8 @@ except ImportError:  # pragma: no cover
 
 __all__ = [
     "Config", "Dataset", "Booster", "train", "cv",
-    "early_stopping", "log_evaluation", "record_evaluation", "reset_parameter",
+    "early_stopping", "log_evaluation", "log_telemetry",
+    "record_evaluation", "reset_parameter", "global_metrics",
     "LGBMModel", "LGBMClassifier", "LGBMRegressor", "LGBMRanker",
     "LightGBMError", "register_logger", "Sequence",
     "plot_importance", "plot_split_value_histogram", "plot_metric",

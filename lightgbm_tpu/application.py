@@ -109,9 +109,24 @@ def _run_train(cfg: Config, params: Dict[str, Any]) -> None:
     booster.save_model(cfg.output_model)
     log.info(f"Finished training; model saved to {cfg.output_model}")
     if int(cfg.verbosity) >= 2:
-        # reference USE_TIMETAG aggregate table at exit
+        # reference USE_TIMETAG aggregate table at exit — the
+        # process-global timer is the CLI default (one booster per CLI
+        # run); concurrent in-process boosters use booster.telemetry()
         from .utils.timer import global_timer
         log.info("phase timings:\n" + global_timer.summary())
+        tel = booster.telemetry()
+        mem = tel.get("memory", {})
+        dev = mem.get("device_peak_bytes_in_use")
+        log.info("memory: host_rss=%.1f MB peak=%.1f MB device_peak=%s"
+                 % (mem.get("host_rss_mb") or -1,
+                    mem.get("host_peak_rss_mb") or -1,
+                    ("%.1f MB" % (dev / (1 << 20))) if dev else "n/a"))
+        if tel.get("counters"):
+            log.info("telemetry counters: " + ", ".join(
+                f"{k}={v:g}" for k, v in sorted(tel["counters"].items())))
+    if str(cfg.trace_output or "") and os.path.exists(str(cfg.trace_output)):
+        log.info(f"trace written to {cfg.trace_output} (load in Perfetto; "
+                 "summarize with tools/trace_report.py)")
 
 
 def _run_predict(cfg: Config, params: Dict[str, Any]) -> None:

@@ -666,6 +666,18 @@ class Booster:
         return self._gbdt.num_tree_per_iteration if self._gbdt else \
             self._loaded["num_tree_per_iteration"]
 
+    # --------------------------------------------------------- telemetry
+    def telemetry(self) -> Dict[str, Any]:
+        """Telemetry snapshot for this booster (obs/): counters/gauges
+        accumulated while training, the per-booster phase-timing table,
+        and a current host/device memory sample.  Loaded (predict-only)
+        boosters report memory only."""
+        if self._gbdt is not None:
+            return self._gbdt.telemetry()
+        from .obs import memory as obs_memory
+        return {"counters": {}, "gauges": {}, "phases": {},
+                "memory": obs_memory.memory_snapshot()}
+
     # ---------------------------------------------------------- evaluation
     def eval_train(self):
         out = self._gbdt.eval_train()

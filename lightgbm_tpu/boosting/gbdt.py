@@ -39,8 +39,10 @@ from ..objectives import ObjectiveFunction, create_objective
 from ..ops.quantize import (discretize_gradients_levels,
                             renew_leaf_values)
 from ..ops.split import SplitHyper
+from ..obs import count_event, trace as obs_trace
+from ..obs.metrics import MetricsRegistry
 from ..utils import log
-from ..utils.timer import global_timer
+from ..utils.timer import PhaseTimer, global_timer, phase
 from .sample_strategy import create_sample_strategy
 from ..ops.table import take_small_table
 
@@ -176,10 +178,24 @@ class GBDT:
         for m in self.train_metrics:
             m.init(train_set.metadata, train_set.num_data)
 
-        # reference USE_TIMETAG phase table (utils/common.h Timer); set
-        # unconditionally so a later non-verbose run disables it again,
-        # and reset so the table covers only THIS training run
-        global_timer.enabled = int(config.verbosity) >= 2
+        # reference USE_TIMETAG phase table (utils/common.h Timer).  Each
+        # booster owns its OWN accumulator so concurrently alive boosters
+        # never clobber each other's tables; the process-global timer
+        # remains the CLI default and is managed through the enable/
+        # disable API (set unconditionally so a later non-verbose run
+        # disables it again, and reset so its table covers only the most
+        # recent training run).
+        self.timer = PhaseTimer()
+        self.metrics = MetricsRegistry()
+        want_timing = (int(config.verbosity) >= 2
+                       or bool(str(config.trace_output or ""))
+                       or bool(str(config.telemetry_output or "")))
+        if want_timing:
+            self.timer.enable()
+        if int(config.verbosity) >= 2:
+            global_timer.enable()
+        else:
+            global_timer.disable()
         global_timer.reset()
         self.num_class = max(1, int(config.num_class))
         self.num_tree_per_iteration = (
@@ -294,6 +310,61 @@ class GBDT:
         self._valid_bins: List[jnp.ndarray] = []
 
     # ------------------------------------------------------------- helpers
+    def _phase(self, name: str):
+        """Time one phase into this booster's table, the process-global
+        table AND the active trace (utils/timer.py ``phase``)."""
+        return phase(name, self.timer, global_timer)
+
+    def _count(self, name: str, value: float = 1) -> None:
+        """Bump a telemetry counter in this booster's registry and the
+        process-global one (obs/metrics.py)."""
+        count_event(name, value, self.metrics)
+
+    def _hist_rounds_per_tree(self) -> int:
+        """Analytic histogram-pass count one grown tree costs: the strict
+        leaf-wise learner runs one build+split-find pass per split, the
+        batched grower one per K-split round.  A host-side tally — the
+        passes themselves run inside jit where counting would record
+        compilations, not executions."""
+        splits = max(1, self.hp.num_leaves - 1)
+        if self._use_batched_grower():
+            k = max(1, int(self.config.tpu_split_batch))
+            return -(-splits // k)
+        return splits
+
+    def _collective_bytes_per_tree(self) -> int:
+        """Analytic estimate of the bytes all-reduced growing ONE tree in
+        the active parallel mode (psums run inside jit; XLA's actual
+        schedule may reduce-scatter, so this is the logical payload, not
+        wire traffic).  Per histogram pass: data mode psums the full
+        [F, B, 3] f32 histogram; voting psums each shard's 2·top_k voted
+        [B, 3] slices per split; feature mode all-gathers a 12-float
+        SplitInfo per device plus one [n] partition psum per split."""
+        if self.parallel_mode is None or self.mesh is None:
+            return 0
+        splits = max(1, self.hp.num_leaves - 1)
+        rounds = self._hist_rounds_per_tree()
+        B = self.hp.n_bins
+        F = self.bins.shape[1]
+        if self.parallel_mode == "data":
+            return rounds * F * B * 3 * 4
+        if self.parallel_mode == "voting":
+            return splits * 2 * int(self.config.top_k) * B * 3 * 4
+        if self.parallel_mode == "feature":
+            n_dev = int(self.mesh.devices.size)
+            return splits * (n_dev * 12 * 4 + self.bins.shape[0] * 4)
+        return 0
+
+    def telemetry(self) -> Dict[str, Any]:
+        """This booster's telemetry snapshot: counters/gauges, the phase
+        table, and a current memory sample (surfaced publicly as
+        ``Booster.telemetry()``)."""
+        from ..obs import memory as obs_memory
+        snap = self.metrics.snapshot()
+        return {"counters": snap["counters"], "gauges": snap["gauges"],
+                "phases": self.timer.as_dict(),
+                "memory": obs_memory.memory_snapshot()}
+
     def _resolve_auto_params(self, config: Config) -> None:
         """Fast-by-default policy (VERDICT r3 #3): at scale, a plain
         ``train()`` gets the batched grower and the exact quantized-grad
@@ -348,6 +419,7 @@ class GBDT:
         and the device bins to be set already."""
         train_set = self.train_set
         self._fused_cache = {}   # compiled fused-round runners (train_fused)
+        self._batched_decision = None   # memoized _use_batched_grower
         self._resolve_auto_params(config)
         self.hp = _hp_from_config(config, train_set.device_n_bins())
         if bool(train_set.categorical_array().any()):
@@ -460,6 +532,7 @@ class GBDT:
                     log.warning("histogram_pool_size ignored: forced "
                                 "splits require the strict full-histogram "
                                 "learner")
+                    self._count("hist_pool_fallbacks")
                 else:
                     self.hp = dataclasses.replace(
                         self.hp, hist_pool_slots=slots)
@@ -724,7 +797,7 @@ class GBDT:
         n = self.train_set.num_data
         k = self.num_tree_per_iteration
         if grad is None or hess is None:
-            with global_timer.timer("boosting_gradients"):
+            with self._phase("boosting_gradients"):
                 g, h = self.boosting_gradients()
         else:
             g = jnp.asarray(np.asarray(grad, np.float32).reshape(n, k, order="F"))
@@ -744,21 +817,24 @@ class GBDT:
             # exact in the bf16 histogram kernel, so the fast kernel's
             # sums become bit-deterministic; the grower multiplies the
             # scales back in after each histogram pass
-            qkey = jax.random.PRNGKey(
-                (self.config.seed or 0) * 7919 + self.iter_)
-            gq, hq = [], []
-            for c in range(k):
-                gc, hc, gs, hs = discretize_gradients_levels(
-                    g[:, c], h[:, c], jax.random.fold_in(qkey, c),
-                    n_levels=int(self.config.num_grad_quant_bins),
-                    stochastic=bool(self.config.stochastic_rounding),
-                    constant_hessian=bool(self.objective is not None
-                                          and self.objective.is_constant_hessian))
-                gq.append(gc)
-                hq.append(hc)
-                hist_scales[c] = jnp.stack([gs, hs])
-            g = jnp.stack(gq, axis=1)
-            h = jnp.stack(hq, axis=1)
+            with self._phase("quantize"):
+                qkey = jax.random.PRNGKey(
+                    (self.config.seed or 0) * 7919 + self.iter_)
+                gq, hq = [], []
+                for c in range(k):
+                    gc, hc, gs, hs = discretize_gradients_levels(
+                        g[:, c], h[:, c], jax.random.fold_in(qkey, c),
+                        n_levels=int(self.config.num_grad_quant_bins),
+                        stochastic=bool(self.config.stochastic_rounding),
+                        constant_hessian=bool(
+                            self.objective is not None
+                            and self.objective.is_constant_hessian))
+                    gq.append(gc)
+                    hq.append(hc)
+                    hist_scales[c] = jnp.stack([gs, hs])
+                g = jnp.stack(gq, axis=1)
+                h = jnp.stack(hq, axis=1)
+            self._count("quantize_rounds")
 
         finished = True
         for cls_idx in range(k):
@@ -767,7 +843,7 @@ class GBDT:
                 node_key = jax.random.PRNGKey(
                     int(self.config.extra_seed) * 1000003
                     + self.iter_ * k + cls_idx)
-            with global_timer.timer("tree_growth"):
+            with self._phase("tree_growth"):
                 arrays, leaf_of_row = self._grow(g[:, cls_idx],
                                                  h[:, cls_idx], row_mask,
                                                  feature_mask, node_key,
@@ -833,7 +909,7 @@ class GBDT:
                                                 self.hp.has_categorical)
                     self.valid_scores[vi] = \
                         self.valid_scores[vi].at[:, cls_idx].add(contrib)
-            with global_timer.timer("tree_finalize"):
+            with self._phase("tree_finalize"):
                 tree = Tree.from_arrays(arrays, self.train_set)
             if tree.num_leaves > 1:
                 finished = False
@@ -847,6 +923,10 @@ class GBDT:
                 tree.add_bias(self.init_scores[cls_idx])
             self.models.append(tree)
         self.iter_ += 1
+        self._count("iterations")
+        self._count("strict_rounds")
+        self._count("trees_grown", k)
+        self._count("hist_build_rounds", self._hist_rounds_per_tree() * k)
         return finished
 
     # ------------------------------------------------- fused iterations
@@ -1185,7 +1265,10 @@ class GBDT:
             key = (T, has_fm, nvalid,
                    (es_rounds, es_first) if use_es else None)
             if key not in self._fused_cache:
+                self._count("fused_runner_cache_misses")
                 self._fused_cache[key] = make_runner(T, has_fm)
+            else:
+                self._count("fused_runner_cache_hits")
             fmasks = None
             if has_fm:
                 # per-ROUND masks: the seed is feature_fraction_seed +
@@ -1214,20 +1297,22 @@ class GBDT:
                  for t in range(T) for cls in range(k)])
             ).reshape(T, k, 2)
             iters = jnp.arange(self.iter_, self.iter_ + T, dtype=jnp.int32)
-            (scores, vscores, es_host), (stacked, mvals) = \
-                self._fused_cache[key](
-                    self.scores, self.bins, qkeys, nkeys, fmasks, iters,
-                    tuple(self.valid_scores), es_host)
+            with self._phase("fused_round_scan"):
+                (scores, vscores, es_host), (stacked, mvals) = \
+                    self._fused_cache[key](
+                        self.scores, self.bins, qkeys, nkeys, fmasks, iters,
+                        tuple(self.valid_scores), es_host)
             self.scores = scores
             for vi in range(nvalid):
                 self.valid_scores[vi] = vscores[vi]
-            host = jax.device_get(stacked)     # ONE transfer per chunk
+            with self._phase("fused_chunk_transfer"):
+                host = jax.device_get(stacked)  # ONE transfer per chunk
             mhost = np.asarray(jax.device_get(mvals)) if nvalid else None
             for t in range(T):
                 stumps = 0
                 for cls in range(k):
                     arrays_tc = jax.tree.map(lambda a: a[t, cls], host)
-                    with global_timer.timer("tree_finalize"):
+                    with self._phase("tree_finalize"):
                         tree = Tree.from_arrays(arrays_tc, self.train_set)
                     tree.apply_shrinkage(self.shrinkage_rate)
                     if self.iter_ == 0 and \
@@ -1238,6 +1323,11 @@ class GBDT:
                         stumps += 1
                 self.iter_ += 1
                 done += 1
+                self._count("iterations")
+                self._count("fused_rounds")
+                self._count("trees_grown", k)
+                self._count("hist_build_rounds",
+                            self._hist_rounds_per_tree() * k)
                 if nvalid:
                     self._last_fused_evals = [
                         (mrows[j][0], mrows[j][1], float(mhost[t, j]),
@@ -1300,15 +1390,19 @@ class GBDT:
                                                    **kwargs)
                 return arrays, lor
             return grow_tree(*args, **kwargs)
+        self._count("collective_allreduce_bytes_est",
+                    self._collective_bytes_per_tree())
         if self.parallel_mode == "feature":
             from ..parallel.feature_parallel import grow_tree_feature_parallel
             if feature_mask is not None and self._pad_cols:
                 feature_mask = jnp.pad(feature_mask, (0, self._pad_cols))
             # quantized levels rejected at construction (__init__ fatal);
             # hist_scale is always None on this path
-            arrays, lor = grow_tree_feature_parallel(
-                self.mesh, self.bins, g, h, row_mask, self.num_bins_arr,
-                self.nan_bin_arr, self.is_cat_arr, feature_mask, self.hp)
+            with obs_trace.span("collective_grow_dispatch",
+                                mode="feature"):
+                arrays, lor = grow_tree_feature_parallel(
+                    self.mesh, self.bins, g, h, row_mask, self.num_bins_arr,
+                    self.nan_bin_arr, self.is_cat_arr, feature_mask, self.hp)
             return arrays, lor
         from ..parallel.data_parallel import (grow_tree_batched_sharded,
                                               grow_tree_sharded)
@@ -1320,22 +1414,27 @@ class GBDT:
                                if row_mask is None else row_mask, (0, p))
         if self.parallel_mode in ("data", "voting") \
                 and self._use_batched_grower():
-            arrays, lor = grow_tree_batched_sharded(
+            with obs_trace.span("collective_grow_dispatch",
+                                mode=self.parallel_mode, batched=True):
+                arrays, lor = grow_tree_batched_sharded(
+                    self.mesh, self.bins, g, h, row_mask, self.num_bins_arr,
+                    self.nan_bin_arr, self.is_cat_arr, feature_mask, self.hp,
+                    batch=int(self.config.tpu_split_batch),
+                    bundle=self.bundle,
+                    monotone=self.monotone_arr, hist_scale=hist_scale,
+                    interaction_sets=self.interaction_sets,
+                    parallel_mode=self.parallel_mode,
+                    top_k=int(self.config.top_k))
+            return arrays, (lor[:-p] if p else lor)
+        with obs_trace.span("collective_grow_dispatch",
+                            mode=self.parallel_mode, batched=False):
+            arrays, lor = grow_tree_sharded(
                 self.mesh, self.bins, g, h, row_mask, self.num_bins_arr,
                 self.nan_bin_arr, self.is_cat_arr, feature_mask, self.hp,
-                batch=int(self.config.tpu_split_batch), bundle=self.bundle,
-                monotone=self.monotone_arr, hist_scale=hist_scale,
-                interaction_sets=self.interaction_sets,
-                parallel_mode=self.parallel_mode,
-                top_k=int(self.config.top_k))
-            return arrays, (lor[:-p] if p else lor)
-        arrays, lor = grow_tree_sharded(
-            self.mesh, self.bins, g, h, row_mask, self.num_bins_arr,
-            self.nan_bin_arr, self.is_cat_arr, feature_mask, self.hp,
-            bundle=self.bundle, parallel_mode=self.parallel_mode,
-            top_k=int(self.config.top_k), monotone=self.monotone_arr,
-            rng_key=node_key, interaction_sets=self.interaction_sets,
-            forced=self.forced_splits, hist_scale=hist_scale)
+                bundle=self.bundle, parallel_mode=self.parallel_mode,
+                top_k=int(self.config.top_k), monotone=self.monotone_arr,
+                rng_key=node_key, interaction_sets=self.interaction_sets,
+                forced=self.forced_splits, hist_scale=hist_scale)
         return arrays, (lor[:-p] if p else lor)
 
     def _use_batched_grower(self) -> bool:
@@ -1343,15 +1442,23 @@ class GBDT:
         the tree uses only its supported feature set.  An active bounded
         pool routes through the batched grower even at tpu_split_batch=1
         (batch=1 rounds produce trees IDENTICAL to the strict learner, so
-        histogram_pool_size composes with strict leaf-wise order)."""
+        histogram_pool_size composes with strict leaf-wise order).
+
+        The decision is pure config state, memoized per
+        ``_derive_learner_state`` so a fallback is warned about and
+        counted ONCE per configuration (``batched_path_fallbacks`` in the
+        telemetry registry — VERDICT Weak #5: silent slow-path training
+        must be visible)."""
+        if self._batched_decision is not None:
+            return self._batched_decision
         pool_active = 0 < self.hp.hist_pool_slots < self.hp.num_leaves
         if int(self.config.tpu_split_batch) <= 1 and not pool_active:
+            self._batched_decision = False
             return False
         # categorical splits, all three monotone methods, interaction
         # constraints, path smoothing, CEGB and linear trees are
         # batched-capable (learner/batch_grower.py)
-        forced_pooled = self.forced_splits is not None \
-            and 0 < self.hp.hist_pool_slots < self.hp.num_leaves
+        forced_pooled = self.forced_splits is not None and pool_active
         # batched voting carries the PV-Tree protocol including
         # categorical splits (round 5: the winner's column psums for the
         # bitset, the strict learner's cadence) but not forced splits
@@ -1359,27 +1466,30 @@ class GBDT:
         # to intermediate under voting at construction)
         voting_unsupported = self.parallel_mode == "voting" and \
             self.forced_splits is not None
-        # CEGB is batched-capable (batch_grower round-4 lift); it only
-        # ever reaches this dispatch in serial mode — __init__ fatals on
-        # cegb_* with any non-serial tree_learner (gbdt.py:401)
-        unsupported = (forced_pooled
-                       or voting_unsupported
-                       or self.parallel_mode not in (None, "data", "voting"))
         # extra_trees / by-node sampling need per-node rng keys, which the
         # sharded batched wrapper does not plumb yet — serial only
         rng_parallel = self.parallel_mode is not None and (
             self.hp.extra_trees or self.hp.feature_fraction_bynode < 1.0
             or self.forced_splits is not None)
-        unsupported = unsupported or rng_parallel
-        if unsupported:
-            if not getattr(self, "_warned_batch", False):
-                log.warning("tpu_split_batch > 1 ignored: "
-                            "forced-splits-with-pool, extra_trees/bynode-"
-                            "sampling under distributed modes, forced "
-                            "splits under voting and the feature-parallel "
-                            "mode require the strict leaf-wise learner")
-                self._warned_batch = True
+        # CEGB is batched-capable (batch_grower round-4 lift); it only
+        # ever reaches this dispatch in serial mode — __init__ fatals on
+        # cegb_* with any non-serial tree_learner (gbdt.py:401)
+        reasons = [name for name, hit in (
+            ("forced-splits-with-pool", forced_pooled),
+            ("forced-splits-under-voting", voting_unsupported),
+            ("extra_trees/bynode-sampling/forced-splits-under-"
+             "distributed", rng_parallel),
+            ("unsupported-parallel-mode",
+             self.parallel_mode not in (None, "data", "voting")),
+        ) if hit]
+        if reasons:
+            log.warning("tpu_split_batch > 1 ignored (%s): falling back "
+                        "to the strict leaf-wise learner"
+                        % ", ".join(reasons))
+            self._count("batched_path_fallbacks")
+            self._batched_decision = False
             return False
+        self._batched_decision = True
         return True
 
     def _renew_leaves(self, arrays: TreeArrays, leaf_of_row: jax.Array,

@@ -18,6 +18,11 @@ CallbackEnv = collections.namedtuple(
     ["model", "params", "iteration", "begin_iteration", "end_iteration",
      "evaluation_result_list"])
 
+#: distinguishes train() runs appending telemetry JSONL to one shared
+#: path (cv folds) — each log_telemetry instance draws one id
+import itertools as _itertools
+_TELEMETRY_RUN_SEQ = _itertools.count()
+
 
 class EarlyStopException(Exception):
     def __init__(self, best_iteration: int, best_score):
@@ -88,6 +93,83 @@ def reset_parameter(**kwargs: Any) -> Callable:
             env.params.update(new_params)
     _callback.before_iteration = True
     _callback.order = 10
+    return _callback
+
+
+def log_telemetry(path: str, period: int = 1) -> Callable:
+    """Append one JSONL telemetry record per boosting iteration to
+    ``path`` (the callback behind the ``telemetry_output=<path>`` config
+    key; also usable directly in a ``callbacks=[...]`` list).
+
+    Each record carries the iteration index, wall-clock seconds since the
+    previous record, the iteration's eval results, the booster's telemetry
+    counters (obs/metrics.py) and a host/device memory sample
+    (obs/memory.py) — so a BENCH_*.json-style memory regression or a
+    silent slow-path fallback is visible per iteration, not just at exit.
+    When a trace recorder is active the memory sample is also emitted as a
+    Chrome trace counter track.  Fused-safe: it only READS booster state
+    and the eval list, so it can be driven from the host replay of a fused
+    chunk's device-evaluated metrics — records from that replay carry
+    ``"fused_replay": true`` because there ``iter_time_s`` is the replay
+    cadence (~0 within a chunk, the whole chunk's wall time at its
+    boundary), NOT per-iteration device cost.
+
+    Each record carries a ``"run"`` id unique to this callback instance:
+    several train() runs appending to ONE file (``cv()`` folds share the
+    ``telemetry_output`` path) stay distinguishable even though their
+    iteration indices and per-booster counters each restart at 0."""
+    import json
+    import time as _time
+
+    state: Dict[str, Any] = {"t_last": None, "fused_seen": 0,
+                             "run": next(_TELEMETRY_RUN_SEQ)}
+
+    def _callback(env: CallbackEnv) -> None:
+        if period > 0 and (env.iteration + 1) % period != 0:
+            return
+        from .obs import memory as obs_memory, trace as obs_trace
+        now = _time.time()
+        dt = None if state["t_last"] is None else now - state["t_last"]
+        state["t_last"] = now
+        mem = obs_memory.memory_snapshot()
+        rec: Dict[str, Any] = {
+            "run": state["run"],
+            "iteration": env.iteration,
+            "unix_time": round(now, 3),
+            "iter_time_s": None if dt is None else round(dt, 6),
+            "evals": {f"{item[0]}.{item[1]}": float(item[2])
+                      for item in (env.evaluation_result_list or [])},
+        }
+        gb = getattr(env.model, "_gbdt", None)
+        if gb is not None:
+            counters = gb.metrics.snapshot()["counters"]
+            rec["counters"] = counters
+            fused_now = counters.get("fused_rounds", 0)
+            if fused_now > state["fused_seen"]:
+                rec["fused_replay"] = True
+            state["fused_seen"] = fused_now
+        rec.update(mem)
+        try:
+            with open(path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+        except OSError as e:
+            # telemetry must never take training down: degrade to a
+            # one-time warning (e.g. disk filled mid-run)
+            if not state.get("write_failed"):
+                state["write_failed"] = True
+                log.warning(f"telemetry write to {path!r} failed "
+                            f"({type(e).__name__}: {e}); further "
+                            "records dropped")
+            return
+        tr = obs_trace.active()
+        if tr is not None:
+            track = {k: mem[k] for k in ("host_rss_mb",
+                                         "device_bytes_in_use")
+                     if mem.get(k) is not None}
+            if track:
+                tr.add_counter("memory", track)
+    _callback.order = 25
+    _callback.fused_safe = True   # reads booster state + eval list only
     return _callback
 
 

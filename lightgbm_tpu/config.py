@@ -164,6 +164,10 @@ _PARAMS: List[Tuple[str, Any, Tuple[str, ...], Tuple[Tuple[str, float], ...]]] =
     ("interaction_constraints", "", (), ()),
     ("verbosity", 1, ("verbose",), ()),
     ("snapshot_freq", -1, ("save_period",), ()),
+    # --- observability (obs/; docs/OBSERVABILITY.md) ---
+    ("trace_output", "", ("trace_file", "trace_out"), ()),        # Chrome trace-event JSON path (Perfetto-loadable)
+    ("telemetry_output", "", ("telemetry_file",), ()),            # per-iteration telemetry JSONL path
+    ("profile_dir", "", ("profiler_dir",), ()),                   # jax.profiler trace directory (device timeline)
     ("use_quantized_grad", False, (), ()),
     ("num_grad_quant_bins", 4, (), ()),
     ("quant_train_renew_leaf", False, (), ()),

@@ -15,11 +15,12 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 import numpy as np
 
 from .basic import Booster, Dataset
-from .callback import (CallbackEnv, EarlyStopException,
+from .callback import (CallbackEnv, EarlyStopException, log_telemetry,
                        record_evaluation)
 from .config import normalize_params
+from .obs import observe_training, trace as obs_trace
 from .utils import log
-from .utils.timer import global_timer
+from .utils.timer import global_timer, phase
 
 
 def train(params: Dict[str, Any], train_set: Dataset,
@@ -60,24 +61,55 @@ def train(params: Dict[str, Any], train_set: Dataset,
         booster.add_valid(vs, name)
         valid_pairs.append((name, vs))
 
-    callbacks = sorted(callbacks or [], key=lambda cb: getattr(cb, "order", 0))
+    callbacks = list(callbacks or [])
+    cfg = booster._gbdt.config
+    if str(cfg.telemetry_output or ""):
+        # telemetry_output=<path>: one JSONL record per iteration
+        # (counters, phase deltas, host/device memory) — the config-key
+        # spelling of the log_telemetry callback.  Writability is probed
+        # up front so a path typo surfaces before round 1, not as a
+        # mid-training crash.
+        from .obs import _writable
+        if _writable(str(cfg.telemetry_output)):
+            callbacks.append(log_telemetry(str(cfg.telemetry_output)))
+        else:
+            log.warning(f"telemetry_output={cfg.telemetry_output!r} is "
+                        "not writable; telemetry JSONL disabled for "
+                        "this run")
+    callbacks = sorted(callbacks, key=lambda cb: getattr(cb, "order", 0))
     cbs_before = [cb for cb in callbacks if getattr(cb, "before_iteration",
                                                     False)]
     cbs_after = [cb for cb in callbacks if not getattr(cb, "before_iteration",
                                                        False)]
 
+    # observability session (obs/): trace_output starts the span recorder
+    # (exported on exit), profile_dir brackets the run with
+    # jax.profiler.trace; both no-ops when unset.  The "train" phase is
+    # the root span every other span nests under.
+    with observe_training(cfg), \
+            phase("train", booster._gbdt.timer, global_timer):
+        return _run_training(booster, params, train_set, num_boost_round,
+                             valid_pairs, train_in_valid, feval, fobj,
+                             callbacks, cbs_before, cbs_after)
+
+
+def _run_training(booster, params, train_set, num_boost_round, valid_pairs,
+                  train_in_valid, feval, fobj, callbacks, cbs_before,
+                  cbs_after) -> Booster:
+    """The boosting loop of ``train()`` (split out so the observability
+    session brackets every exit path)."""
     # fused-rounds fast path: when every per-iteration observer can be
     # driven from device-evaluated metrics — no callbacks at all, or only
     # fused-safe ones (early_stopping / log_evaluation /
-    # record_evaluation, which READ the eval list) with device-evaluable
-    # valid metrics — the whole boosting run executes as chunked
-    # on-device scans (GBDT.train_fused): one dispatch per ~32 rounds
-    # instead of one per round, which removes ~0.2 s/round of host/device
-    # round trips on tunneled chips and ~1 ms/round on co-located hosts.
-    # Valid-set scoring, metric eval and the early-stop flag ride the
-    # scan; the REAL callbacks run on the host once per round with the
-    # device-computed values, so their semantics are exactly the classic
-    # loop's.
+    # record_evaluation / log_telemetry, which READ the eval list) with
+    # device-evaluable valid metrics — the whole boosting run executes as
+    # chunked on-device scans (GBDT.train_fused): one dispatch per ~32
+    # rounds instead of one per round, which removes ~0.2 s/round of
+    # host/device round trips on tunneled chips and ~1 ms/round on
+    # co-located hosts.  Valid-set scoring, metric eval and the
+    # early-stop flag ride the scan; the REAL callbacks run on the host
+    # once per round with the device-computed values, so their semantics
+    # are exactly the classic loop's.
     cbs_fused_safe = all(getattr(cb, "fused_safe", False)
                          for cb in callbacks) and not cbs_before
     if (cbs_fused_safe and not train_in_valid
@@ -93,7 +125,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
                 cb(CallbackEnv(booster, params, it, 0, num_boost_round,
                                evals))
         try:
-            with global_timer.timer("train_fused"):
+            with phase("train_fused", booster._gbdt.timer, global_timer):
                 finished = booster._gbdt.train_fused(
                     num_boost_round,
                     cb_driver=cb_driver if callbacks else None,
@@ -112,29 +144,32 @@ def train(params: Dict[str, Any], train_set: Dataset,
 
     evals: List = []
     for it in range(num_boost_round):
-        for cb in cbs_before:
-            cb(CallbackEnv(booster, params, it, 0, num_boost_round, None))
-        finished = booster.update(fobj=fobj)
-        evals = []
-        with global_timer.timer("metric_eval"):
-            if train_in_valid or \
-                    booster._gbdt.config.is_provide_training_metric:
-                evals.extend(booster.eval_train())
-            evals.extend(booster.eval_valid())
-        if feval is not None:
-            evals.extend(_eval_custom(feval, booster, train_set, valid_pairs,
-                                      train_in_valid))
-        try:
-            for cb in cbs_after:
-                cb(CallbackEnv(booster, params, it, 0, num_boost_round, evals))
-        except EarlyStopException as e:
-            booster.best_iteration = e.best_iteration + 1
-            _set_best_score(booster, e.best_score)
-            break
-        if finished:
-            log.warning("Stopped training because there are no more leaves "
-                        "that meet the split requirements")
-            break
+        with obs_trace.span("iteration", iter=it):
+            for cb in cbs_before:
+                cb(CallbackEnv(booster, params, it, 0, num_boost_round,
+                               None))
+            finished = booster.update(fobj=fobj)
+            evals = []
+            with phase("metric_eval", booster._gbdt.timer, global_timer):
+                if train_in_valid or \
+                        booster._gbdt.config.is_provide_training_metric:
+                    evals.extend(booster.eval_train())
+                evals.extend(booster.eval_valid())
+            if feval is not None:
+                evals.extend(_eval_custom(feval, booster, train_set,
+                                          valid_pairs, train_in_valid))
+            try:
+                for cb in cbs_after:
+                    cb(CallbackEnv(booster, params, it, 0, num_boost_round,
+                                   evals))
+            except EarlyStopException as e:
+                booster.best_iteration = e.best_iteration + 1
+                _set_best_score(booster, e.best_score)
+                break
+            if finished:
+                log.warning("Stopped training because there are no more "
+                            "leaves that meet the split requirements")
+                break
     if booster.best_iteration <= 0:
         # best_iteration stays UNSET without early stopping (reference
         # basic.py contract: predict()/save_model() then use ALL trees).
@@ -259,29 +294,39 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
 
     cvb = CVBooster()
     histories = []
-    for fi, (train_idx, test_idx) in enumerate(folds):
-        # fold datasets are SUBSETS of the binned data — bin mappers (and
-        # the EFB plan) are shared, nothing is re-binned (reference cv
-        # builds folds with Dataset.subset, engine.py _make_n_folds)
-        dtrain = Dataset.from_inner(inner.subset(train_idx),
-                                    dict(train_set.params))
-        dtest = Dataset.from_inner(inner.subset(test_idx),
-                                   dict(train_set.params))
-        if fold_groups is not None:
-            gtr, gte = fold_groups[fi]
-            dtrain.inner.metadata.set_group(gtr)
-            dtest.inner.metadata.set_group(gte)
-        rec: Dict[str, Dict[str, List[float]]] = {}
-        vs, vn = [dtest], ["valid"]
-        if eval_train_metric:
-            vs.append(dtrain)
-            vn.append("train")
-        bst = train(params, dtrain, num_boost_round,
-                    valid_sets=vs, valid_names=vn,
-                    feval=feval, callbacks=list(callbacks or [])
-                    + [record_evaluation(rec)])
-        cvb.append(bst)
-        histories.append(rec)
+    # ONE observability session for the whole cv run: fold train() calls
+    # join it (obs.trace.start no-ops while a recorder is active), so
+    # trace_output gets a single trace covering every fold instead of
+    # each fold overwriting the file
+    import types
+    obs_cfg = types.SimpleNamespace(
+        trace_output=params.get("trace_output", ""),
+        profile_dir=params.get("profile_dir", ""))
+    with observe_training(obs_cfg):
+        for fi, (train_idx, test_idx) in enumerate(folds):
+            # fold datasets are SUBSETS of the binned data — bin mappers
+            # (and the EFB plan) are shared, nothing is re-binned
+            # (reference cv builds folds with Dataset.subset, engine.py
+            # _make_n_folds)
+            dtrain = Dataset.from_inner(inner.subset(train_idx),
+                                        dict(train_set.params))
+            dtest = Dataset.from_inner(inner.subset(test_idx),
+                                       dict(train_set.params))
+            if fold_groups is not None:
+                gtr, gte = fold_groups[fi]
+                dtrain.inner.metadata.set_group(gtr)
+                dtest.inner.metadata.set_group(gte)
+            rec: Dict[str, Dict[str, List[float]]] = {}
+            vs, vn = [dtest], ["valid"]
+            if eval_train_metric:
+                vs.append(dtrain)
+                vn.append("train")
+            bst = train(params, dtrain, num_boost_round,
+                        valid_sets=vs, valid_names=vn,
+                        feval=feval, callbacks=list(callbacks or [])
+                        + [record_evaluation(rec)])
+            cvb.append(bst)
+            histories.append(rec)
 
     # per-iteration mean/stdv across folds, the reference cv's return
     # shape (engine.py:611 _agg_cv_result); folds stopped early by a

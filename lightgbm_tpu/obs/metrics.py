@@ -1,0 +1,76 @@
+"""Process- and booster-scoped telemetry counters/gauges.
+
+The reference exposes no runtime counters at all — silent slow-path
+decisions (a batched-grower fallback, a congested capture window) leave no
+artifact.  This registry is the single place such events are tallied:
+counters are monotone within a registry's lifetime, gauges carry the last
+sampled value.  Two scopes exist:
+
+  * ``global_metrics`` — process-wide, survives across boosters (the
+    reference ``global_timer`` analogue for counts),
+  * per-booster registries (``GBDT.metrics``) queryable via
+    ``Booster.telemetry()``.
+
+Counter bumps are one dict ``get`` + add on coarse (per-iteration /
+per-decision) host paths only — never inside per-row or per-leaf loops, and
+never inside jitted code (a traced bump would count compilations, not
+executions).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+
+class MetricsRegistry:
+    __slots__ = ("_counters", "_gauges", "_lock")
+
+    def __init__(self) -> None:
+        self._counters: Dict[str, float] = {}
+        self._gauges: Dict[str, float] = {}
+        # the GLOBAL registry is shared by concurrently training
+        # boosters (the same scenario per-booster timers exist for), and
+        # an unlocked read-modify-write drops increments under threads
+        self._lock = threading.Lock()
+
+    def inc(self, name: str, value: float = 1) -> None:
+        """Bump a monotone counter."""
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + value
+
+    def set_gauge(self, name: str, value: float) -> None:
+        """Record a point-in-time sample (last write wins)."""
+        with self._lock:
+            self._gauges[name] = float(value)
+
+    def counter(self, name: str) -> float:
+        return self._counters.get(name, 0)
+
+    def gauge(self, name: str) -> Optional[float]:
+        return self._gauges.get(name)
+
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        """Copy of the current state (safe to serialize / mutate)."""
+        with self._lock:
+            return {"counters": dict(self._counters),
+                    "gauges": dict(self._gauges)}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+
+
+#: process-wide registry (the counting analogue of utils.timer.global_timer)
+global_metrics = MetricsRegistry()
+
+
+def count_event(name: str, value: float = 1,
+                booster_metrics: Optional[MetricsRegistry] = None) -> None:
+    """Bump ``name`` in the global registry and, when given, a booster's
+    own registry — the standard dual-scope tally used by instrumentation
+    points."""
+    global_metrics.inc(name, value)
+    if booster_metrics is not None:
+        booster_metrics.inc(name, value)

@@ -1,0 +1,22 @@
+"""shard_map compatibility shim.
+
+``shard_map`` moved from ``jax.experimental.shard_map`` (keyword
+``check_rep``) to ``jax.shard_map`` (keyword ``check_vma``) across jax
+releases; this repo runs on both.  All parallel call sites import
+``shard_map`` from here and always pass ``check_vma=`` — the shim maps it
+to whichever keyword the installed jax expects.
+"""
+
+from __future__ import annotations
+
+try:
+    from jax import shard_map as _shard_map          # jax >= 0.6
+    _KW = "check_vma"
+except ImportError:                                   # jax 0.4.x
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _KW = "check_rep"
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=False):
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **{_KW: check_vma})

@@ -2,47 +2,91 @@
 ``FunctionTimer`` — RAII accumulation per named phase, aggregate table
 printed at exit when built with USE_TIMETAG).
 
-Here timing is always available and cheap: a global accumulator with a
-context manager, enabled per-run via ``Config.verbosity >= 2`` (the CLI
-prints the table after training) or programmatically via
-``global_timer.enable()``.  Device work is asynchronous under jit, so
-phases that end with a host sync (eval, metric reads) absorb queued device
-time — same caveat as any wall-clock profile of an async runtime; use
-``jax.profiler`` traces for kernel-level attribution.
+Here timing is always available and cheap: per-phase accumulators with a
+context manager.  Two scopes exist since the telemetry round:
+
+  * ``global_timer`` — the process-wide accumulator (reference
+    ``global_timer``, gbdt.cpp:22), the CLI default: the CLI prints its
+    table after training at ``verbosity >= 2``.
+  * per-booster ``PhaseTimer`` instances (``GBDT.timer``) so concurrently
+    alive boosters never clobber each other's tables; exposed through
+    ``Booster.telemetry()``.
+
+``phase(name, *timers)`` times one region into every ENABLED timer with a
+single pair of clock reads, and — when a trace recorder is active
+(obs/trace.py, ``trace_output=...``) — emits the same interval as a span
+event.  Disabled timers with no active trace cost one tuple scan and an
+``is None`` check.
+
+Device work is asynchronous under jit, so phases that end with a host sync
+(eval, metric reads) absorb queued device time — same caveat as any
+wall-clock profile of an async runtime; use the ``profile_dir`` hook
+(``jax.profiler`` traces) for kernel-level attribution.
 """
 
 from __future__ import annotations
 
 import collections
 import contextlib
+import threading
 import time
 from typing import Dict, Iterator
+
+from ..obs import trace as _trace
+
+
+@contextlib.contextmanager
+def phase(name: str, *timers: "PhaseTimer") -> Iterator[None]:
+    """Time one phase into every enabled timer AND the active trace."""
+    on = [t for t in timers if t.enabled]
+    tracing = _trace.active() is not None
+    if not on and not tracing:
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        for t in on:
+            # the global timer is shared across concurrently training
+            # boosters; an unlocked += drops accumulations under threads
+            with t._lock:
+                t._acc[name] += dt
+                t._count[name] += 1
+        if tracing:
+            _trace.emit_complete(name, t0, dt)
 
 
 class PhaseTimer:
     def __init__(self) -> None:
         self._acc: Dict[str, float] = collections.defaultdict(float)
         self._count: Dict[str, int] = collections.defaultdict(int)
+        self._lock = threading.Lock()
         self.enabled = False
 
     def enable(self) -> None:
         self.enabled = True
 
+    def disable(self) -> None:
+        self.enabled = False
+
     def reset(self) -> None:
         self._acc.clear()
         self._count.clear()
 
-    @contextlib.contextmanager
-    def timer(self, name: str) -> Iterator[None]:
-        if not self.enabled:
-            yield
-            return
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            self._acc[name] += time.perf_counter() - t0
-            self._count[name] += 1
+    def timer(self, name: str):
+        """Context manager timing ``name`` into this accumulator (and the
+        active trace, if any)."""
+        return phase(name, self)
+
+    def as_dict(self) -> Dict[str, Dict[str, float]]:
+        """``{phase: {"total_s", "count", "avg_ms"}}`` — the telemetry
+        serialization of the aggregate table."""
+        return {name: {"total_s": round(total, 6),
+                       "count": self._count[name],
+                       "avg_ms": round(total / self._count[name] * 1e3, 4)}
+                for name, total in self._acc.items()}
 
     def summary(self) -> str:
         if not self._acc:

@@ -1,0 +1,307 @@
+"""Telemetry subsystem tests (obs/ — trace spans, metrics registry,
+memory observability; docs/OBSERVABILITY.md).
+
+Covers the ISSUE-2 acceptance surface: trace export is valid Chrome trace
+JSON with properly nested spans, counters are monotone across iterations,
+the telemetry JSONL carries one record per iteration with host/device
+memory fields, and disabled-mode training writes no files.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.obs import global_metrics, memory as obs_memory, trace
+from lightgbm_tpu.utils.timer import PhaseTimer, global_timer
+
+N_ROUNDS = 4
+
+
+@pytest.fixture(scope="module")
+def traced_run(tmp_path_factory):
+    """ONE observed training run shared by the trace/JSONL assertions
+    (keeps the suite's added wall-clock to a single small training)."""
+    d = tmp_path_factory.mktemp("telemetry")
+    trace_path = str(d / "trace.json")
+    tele_path = str(d / "tele.jsonl")
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(400, 6))
+    y = (X[:, 0] - X[:, 1] + rng.normal(scale=0.3, size=400) > 0
+         ).astype(np.float64)
+    p = {"objective": "binary", "num_leaves": 7, "min_data_in_leaf": 5,
+         "verbose": -1, "metric": ["binary_logloss"],
+         "trace_output": trace_path, "telemetry_output": tele_path}
+    ds = lgb.Dataset(X, label=y, params=p)
+    bst = lgb.train(p, ds, num_boost_round=N_ROUNDS,
+                    valid_sets=[ds.create_valid(X, label=y)],
+                    valid_names=["v0"])
+    return bst, trace_path, tele_path
+
+
+def test_trace_export_is_valid_chrome_trace(traced_run):
+    _, trace_path, _ = traced_run
+    with open(trace_path) as f:
+        doc = json.load(f)
+    events = doc["traceEvents"]
+    assert events, "trace has no events"
+    spans = [e for e in events if e["ph"] == "X"]
+    assert spans, "trace has no complete span events"
+    for e in spans:
+        # required Chrome trace-event fields on every span
+        for field in ("name", "ph", "ts", "dur", "pid", "tid"):
+            assert field in e, f"span missing {field}: {e}"
+        assert e["dur"] >= 0
+    names = {e["name"] for e in spans}
+    assert {"train", "iteration", "tree_growth",
+            "boosting_gradients"} <= names
+
+
+def test_trace_spans_properly_nested(traced_run):
+    """Container spans strictly contain their children on the same
+    thread: every iteration inside train, every tree_growth inside an
+    iteration (context-manager discipline must survive export)."""
+    _, trace_path, _ = traced_run
+    with open(trace_path) as f:
+        spans = [e for e in json.load(f)["traceEvents"] if e["ph"] == "X"]
+
+    def covers(outer, inner):
+        return (outer["ts"] <= inner["ts"] + 1e-3
+                and outer["ts"] + outer["dur"]
+                >= inner["ts"] + inner["dur"] - 1e-3)
+
+    train_spans = [e for e in spans if e["name"] == "train"]
+    iters = [e for e in spans if e["name"] == "iteration"]
+    grows = [e for e in spans if e["name"] == "tree_growth"]
+    assert len(train_spans) == 1
+    assert len(iters) == N_ROUNDS
+    assert len(grows) == N_ROUNDS
+    for it in iters:
+        assert covers(train_spans[0], it)
+    for g in grows:
+        assert any(covers(it, g) for it in iters), \
+            "tree_growth span not nested in any iteration span"
+
+
+def test_telemetry_jsonl_one_record_per_iteration(traced_run):
+    _, _, tele_path = traced_run
+    with open(tele_path) as f:
+        recs = [json.loads(ln) for ln in f.read().strip().splitlines()]
+    assert len(recs) == N_ROUNDS
+    assert [r["iteration"] for r in recs] == list(range(N_ROUNDS))
+    for r in recs:
+        # host/device memory fields present on every record
+        assert "host_rss_mb" in r and "host_peak_rss_mb" in r
+        assert "device_memory" in r
+        assert r["counters"]["iterations"] >= 1
+        assert any(k.startswith("v0.") for k in r["evals"])
+
+
+def test_counters_monotone_across_iterations(traced_run):
+    _, _, tele_path = traced_run
+    with open(tele_path) as f:
+        recs = [json.loads(ln) for ln in f.read().strip().splitlines()]
+    keys = set().union(*(r["counters"] for r in recs))
+    for key in keys:
+        series = [r["counters"].get(key, 0) for r in recs]
+        assert series == sorted(series), \
+            f"counter {key} not monotone: {series}"
+    # iterations advances by exactly one per record
+    its = [r["counters"]["iterations"] for r in recs]
+    assert its == list(range(1, N_ROUNDS + 1))
+
+
+def test_booster_telemetry_snapshot(traced_run):
+    bst, _, _ = traced_run
+    tel = bst.telemetry()
+    assert tel["counters"]["iterations"] == N_ROUNDS
+    assert tel["counters"]["trees_grown"] == N_ROUNDS
+    assert "tree_growth" in tel["phases"]
+    assert tel["phases"]["tree_growth"]["count"] == N_ROUNDS
+    assert tel["memory"]["host_rss_mb"] is None or \
+        tel["memory"]["host_rss_mb"] > 0
+
+
+def test_trace_report_tool(traced_run):
+    import importlib.util
+    path = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "trace_report.py")
+    spec = importlib.util.spec_from_file_location("trace_report", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    _, trace_path, _ = traced_run
+    out = mod.render(mod.load_trace(trace_path))
+    assert "tree_growth" in out
+    assert "total_s" in out
+
+
+def test_disabled_mode_emits_no_files(tmp_path, synthetic_binary):
+    """No trace/telemetry keys -> no recorder active and no files
+    written anywhere under the working dir."""
+    X, y = synthetic_binary
+    cwd = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        p = {"objective": "binary", "num_leaves": 7, "min_data_in_leaf": 5,
+             "verbose": -1}
+        lgb.train(p, lgb.Dataset(X[:300], label=y[:300], params=p),
+                  num_boost_round=2)
+        assert trace.active() is None
+        assert list(tmp_path.iterdir()) == []
+    finally:
+        os.chdir(cwd)
+
+
+def test_per_booster_timer_isolation(synthetic_binary):
+    """Satellite 1: each booster owns its PhaseTimer — training a second
+    (quiet) booster must not clear or disable the first's table."""
+    X, y = synthetic_binary
+    pv = {"objective": "binary", "num_leaves": 7, "min_data_in_leaf": 5,
+          "verbosity": 2}
+    b1 = lgb.train(pv, lgb.Dataset(X[:300], label=y[:300], params=pv),
+                   num_boost_round=2)
+    t1 = b1._gbdt.timer
+    assert t1.enabled and "tree_growth" in t1.summary()
+    pq = {"objective": "binary", "num_leaves": 7, "min_data_in_leaf": 5,
+          "verbose": -1}
+    lgb.train(pq, lgb.Dataset(X[:300], label=y[:300], params=pq),
+              num_boost_round=2)
+    # first booster's table survives the second training untouched
+    assert t1.enabled
+    assert t1.as_dict()["tree_growth"]["count"] == 2
+
+
+def test_phase_timer_disable():
+    t = PhaseTimer()
+    t.enable()
+    with t.timer("x"):
+        pass
+    t.disable()
+    with t.timer("x"):
+        pass
+    assert not t.enabled
+    assert t.as_dict()["x"]["count"] == 1
+
+
+def test_batched_fallback_warns_and_counts(synthetic_binary):
+    """Satellite 2: a config that requests the batched grower but must
+    fall back to the strict learner warns once and bumps the
+    batched_path_fallbacks counter (extra_trees under the data-parallel
+    mode — the sharded batched wrapper has no per-node rng plumbing)."""
+    X, y = synthetic_binary
+    p = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 5,
+         "verbose": -1, "tpu_split_batch": 4, "extra_trees": True,
+         "tree_learner": "data"}       # conftest mesh: 8 CPU devices
+    before = global_metrics.counter("batched_path_fallbacks")
+    ds = lgb.Dataset(X[:300], label=y[:300], params=p)
+    bst = lgb.Booster(params=p, train_set=ds)
+    assert bst._gbdt.parallel_mode == "data"
+    assert bst._gbdt._use_batched_grower() is False
+    assert bst._gbdt.metrics.counter("batched_path_fallbacks") == 1
+    assert global_metrics.counter("batched_path_fallbacks") == before + 1
+    # memoized: a second query must not double-count
+    bst._gbdt._use_batched_grower()
+    assert bst._gbdt.metrics.counter("batched_path_fallbacks") == 1
+
+
+def test_forced_splits_pool_fallback_counts(tmp_path, synthetic_binary):
+    """Forced splits force the bounded pool off — warned and tallied as
+    hist_pool_fallbacks so the silent slow path stays visible."""
+    X, y = synthetic_binary
+    forced = tmp_path / "forced.json"
+    forced.write_text(json.dumps({"feature": 0, "threshold": 0.0}))
+    p = {"objective": "binary", "num_leaves": 31, "min_data_in_leaf": 5,
+         "verbose": -1, "histogram_pool_size": 1e-4,
+         "forcedsplits_filename": str(forced)}
+    ds = lgb.Dataset(X[:300], label=y[:300], params=p)
+    bst = lgb.Booster(params=p, train_set=ds)
+    assert bst._gbdt.metrics.counter("hist_pool_fallbacks") == 1
+
+
+def test_memory_snapshot_shape():
+    snap = obs_memory.memory_snapshot()
+    assert "host_rss_mb" in snap and "device_memory" in snap
+    if snap["host_rss_mb"] is not None:        # Linux
+        assert snap["host_rss_mb"] > 0
+        assert snap["host_peak_rss_mb"] >= 0
+
+
+def test_config_registers_observability_keys(tmp_path):
+    cfg = lgb.Config({"trace_output": str(tmp_path / "t.json"),
+                      "telemetry_output": str(tmp_path / "t.jsonl"),
+                      "profile_dir": str(tmp_path / "prof")})
+    assert cfg.trace_output.endswith("t.json")
+    assert cfg.telemetry_output.endswith("t.jsonl")
+    assert cfg.profile_dir.endswith("prof")
+
+
+def test_cv_produces_one_trace_covering_all_folds(tmp_path,
+                                                  synthetic_binary):
+    """cv() opens ONE observability session the fold train() calls join:
+    the exported trace carries every fold's train span instead of each
+    fold overwriting the file."""
+    X, y = synthetic_binary
+    tp = str(tmp_path / "cv_trace.json")
+    p = {"objective": "binary", "num_leaves": 7, "min_data_in_leaf": 5,
+         "verbose": -1, "metric": ["binary_logloss"], "trace_output": tp}
+    lgb.cv(p, lgb.Dataset(X[:400], label=y[:400], params=p),
+           num_boost_round=2, nfold=2, stratified=False)
+    assert trace.active() is None
+    with open(tp) as f:
+        spans = [e for e in json.load(f)["traceEvents"] if e["ph"] == "X"]
+    assert sum(1 for e in spans if e["name"] == "train") == 2
+
+
+def test_fused_replay_records_are_marked(tmp_path):
+    """Telemetry records driven from a fused chunk's host replay carry
+    fused_replay=true (iter_time_s there is replay cadence, not
+    per-iteration device cost)."""
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(500, 5))
+    y = (X[:, 0] > 0).astype(np.float64)
+    jp = str(tmp_path / "fused_tele.jsonl")
+    p = {"objective": "binary", "num_leaves": 7, "min_data_in_leaf": 5,
+         "verbose": -1, "tpu_split_batch": 3, "telemetry_output": jp}
+    ds = lgb.Dataset(X, label=y, params=p)
+    bst = lgb.train(p, ds, num_boost_round=8)
+    assert bst._gbdt.metrics.counter("fused_rounds") == 8
+    with open(jp) as f:
+        recs = [json.loads(ln) for ln in f.read().strip().splitlines()]
+    assert len(recs) == 8
+    assert all(r.get("fused_replay") for r in recs)
+
+
+def test_unwritable_output_paths_never_take_training_down(synthetic_binary):
+    """A typo'd trace/telemetry path degrades to a warning before round
+    1 — it must not cost (or crash) the training run."""
+    X, y = synthetic_binary
+    p = {"objective": "binary", "num_leaves": 7, "min_data_in_leaf": 5,
+         "verbose": -1,
+         "trace_output": "/no/such/dir/trace.json",
+         "telemetry_output": "/no/such/dir/tele.jsonl"}
+    bst = lgb.train(p, lgb.Dataset(X[:300], label=y[:300], params=p),
+                    num_boost_round=2)
+    assert bst.num_trees() == 2
+    assert trace.active() is None
+
+
+def test_nested_trace_sessions_do_not_fight():
+    """cv() folds train() inside an outer observed run: the inner start()
+    must join (not steal or close) the outer recorder."""
+    outer = trace.start()
+    assert outer is not None
+    inner = trace.start()
+    assert inner is None
+    trace.stop(inner)                 # no-op
+    assert trace.active() is outer
+    trace.stop(outer)
+    assert trace.active() is None
+
+
+@pytest.fixture(autouse=True)
+def _restore_global_timer():
+    yield
+    global_timer.disable()
+    global_timer.reset()

@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Summarize a Chrome trace JSON produced by ``trace_output=<path>``.
+
+    python tools/trace_report.py TRACE.json [--top N]
+
+Prints the top phases by total time (total / count / avg / max), the
+span-tree depth, and — when the trace carries ``memory`` counter events
+(telemetry_output set alongside trace_output) — the memory high-water
+marks.  The numbers here are host wall-clock spans (dispatch + any host
+sync); use a ``profile_dir`` jax.profiler capture for device-side kernel
+attribution.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List
+
+
+def load_trace(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        try:
+            doc = json.load(f)
+        except json.JSONDecodeError as e:
+            raise ValueError(f"{path}: empty or not JSON ({e}) — was the "
+                             "trace session exported?") from e
+    if isinstance(doc, list):          # bare event-array form is also legal
+        doc = {"traceEvents": doc}
+    if "traceEvents" not in doc:
+        raise ValueError(f"{path}: not a Chrome trace (no traceEvents)")
+    return doc
+
+
+def phase_stats(doc: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Aggregate complete (``ph: X``) events by name."""
+    agg: Dict[str, Dict[str, float]] = {}
+    for ev in doc["traceEvents"]:
+        if ev.get("ph") != "X":
+            continue
+        dur = float(ev.get("dur", 0.0))
+        s = agg.setdefault(ev["name"], {"total_us": 0.0, "count": 0,
+                                        "max_us": 0.0})
+        s["total_us"] += dur
+        s["count"] += 1
+        s["max_us"] = max(s["max_us"], dur)
+    rows = []
+    for name, s in agg.items():
+        rows.append({
+            "name": name,
+            "total_s": s["total_us"] / 1e6,
+            "count": int(s["count"]),
+            "avg_ms": s["total_us"] / s["count"] / 1e3,
+            "max_ms": s["max_us"] / 1e3,
+        })
+    rows.sort(key=lambda r: -r["total_s"])
+    return rows
+
+
+def memory_high_water(doc: Dict[str, Any]) -> Dict[str, float]:
+    """Max of each ``memory`` counter-track series (``ph: C``)."""
+    high: Dict[str, float] = {}
+    for ev in doc["traceEvents"]:
+        if ev.get("ph") != "C" or ev.get("name") != "memory":
+            continue
+        for k, v in (ev.get("args") or {}).items():
+            if isinstance(v, (int, float)):
+                high[k] = max(high.get(k, float("-inf")), float(v))
+    return high
+
+
+def render(doc: Dict[str, Any], top: int = 15) -> str:
+    rows = phase_stats(doc)
+    lines = []
+    if not rows:
+        lines.append("no complete (ph=X) span events in trace")
+    else:
+        width = max(len(r["name"]) for r in rows[:top])
+        lines.append(f"{'phase'.ljust(width)}   total_s   count    avg_ms"
+                     f"    max_ms")
+        for r in rows[:top]:
+            lines.append(f"{r['name'].ljust(width)}  {r['total_s']:8.3f}"
+                         f"  {r['count']:6d}  {r['avg_ms']:8.2f}"
+                         f"  {r['max_ms']:8.2f}")
+        if len(rows) > top:
+            lines.append(f"... {len(rows) - top} more phases "
+                         f"(--top {len(rows)} for all)")
+    high = memory_high_water(doc)
+    if high:
+        lines.append("")
+        lines.append("memory high-water marks:")
+        for k in sorted(high):
+            v = high[k]
+            unit = " MB" if k.endswith("_mb") else \
+                (" bytes" if "bytes" in k else "")
+            lines.append(f"  {k}: {v:,.2f}{unit}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace", help="Chrome trace JSON (trace_output=...)")
+    ap.add_argument("--top", type=int, default=15,
+                    help="phases to show (default 15)")
+    args = ap.parse_args(argv)
+    print(render(load_trace(args.trace), top=args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
