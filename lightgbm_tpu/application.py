@@ -102,10 +102,15 @@ def _run_train(cfg: Config, params: Dict[str, Any]) -> None:
         callbacks.append(_snapshot)
 
     init_model = cfg.input_model or None
+    # checkpoint_dir= turns on both periodic checkpointing AND
+    # resume-from-newest (docs/ROBUSTNESS.md): a re-run of the same CLI
+    # command after a crash continues from the last valid checkpoint
+    resume = "auto" if str(cfg.checkpoint_dir or "") else None
     booster = train_api(params, train_set,
                         num_boost_round=int(cfg.num_iterations),
                         valid_sets=valid_sets, valid_names=valid_names,
-                        init_model=init_model, callbacks=callbacks)
+                        init_model=init_model, callbacks=callbacks,
+                        resume=resume)
     booster.save_model(cfg.output_model)
     log.info(f"Finished training; model saved to {cfg.output_model}")
     if int(cfg.verbosity) >= 2:

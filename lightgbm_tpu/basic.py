@@ -297,9 +297,13 @@ class Dataset:
                     pcat = [int(x) for x in pcat.split(",") if x != ""]
                 cat = list(pcat)
         predictor = self._predictor
+        skip_pred_init = getattr(self, "_skip_predictor_init_score", False)
         if predictor is None and self.reference is not None:
             predictor = self.reference._predictor
-        if predictor is not None and self.init_score is None:
+            skip_pred_init = skip_pred_init or getattr(
+                self.reference, "_skip_predictor_init_score", False)
+        if predictor is not None and self.init_score is None \
+                and not skip_pred_init:
             # ALL of the predictor's trees: they are merged wholesale into
             # the new booster (gbdt.h MergeFrom), so residuals must be
             # computed against the full model, not best_iteration
@@ -346,11 +350,25 @@ class Dataset:
         self._inner.save_binary(str(filename))
         return self
 
+    def _set_resume_predictor(self, predictor: "Booster") -> None:
+        """Continuation predictor whose score contribution is restored
+        EXTERNALLY (robustness/checkpoint.py resume): its trees are
+        merged into the new booster, but no init-score predict pass runs
+        — the resume path overwrites (or rebuilds) the f32 score caches
+        itself.  Unlike :meth:`_apply_predictor` this works on a
+        constructed Dataset whose raw data was freed (the CLI path)."""
+        self._predictor = predictor
+        self._skip_predictor_init_score = True
+
     def _apply_predictor(self, predictor: Optional["Booster"]) -> None:
         """Set the continuation predictor (reference basic.py:2576
         ``_set_predictor``).  For an already-constructed dataset the init
         score is injected immediately — requires the raw data."""
         self._predictor = predictor
+        # a leftover resume marker must not leak into a later plain
+        # init_model continuation (it would silently skip the init-score
+        # predict pass)
+        self._skip_predictor_init_score = False
         if predictor is None or self._inner is None:
             return
         if self.data is None:
@@ -606,10 +624,31 @@ class Booster:
         self.train_set = train_set
         self.pandas_categorical: Optional[list] = None
         if model_file is not None:
-            with open(model_file) as f:
-                model_str = f.read()
+            # a missing/unreadable model file is an operator-facing error:
+            # name the path in a LightGBMError instead of leaking the raw
+            # OSError traceback
+            try:
+                with open(model_file) as f:
+                    model_str = f.read()
+            except OSError as e:
+                raise log.LightGBMError(
+                    f"cannot read model file {str(model_file)!r}: "
+                    f"{type(e).__name__}: {e}") from e
         if model_str is not None:
-            self._loaded = parse_model_string(model_str)
+            src = (f"model file {str(model_file)!r}"
+                   if model_file is not None else "model string")
+            try:
+                self._loaded = parse_model_string(model_str)
+            except log.LightGBMError as e:
+                raise log.LightGBMError(f"failed to parse {src}: {e}") \
+                    from None
+            except Exception as e:
+                # truncated/garbled tree blocks surface as KeyError /
+                # ValueError deep in Tree.from_text; wrap them with the
+                # path so the operator knows WHICH artifact is bad
+                raise log.LightGBMError(
+                    f"failed to parse {src}: "
+                    f"{type(e).__name__}: {e}") from e
             self.pandas_categorical = self._loaded.get("pandas_categorical")
             return
         if train_set is None:

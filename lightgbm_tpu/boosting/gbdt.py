@@ -420,6 +420,9 @@ class GBDT:
         train_set = self.train_set
         self._fused_cache = {}   # compiled fused-round runners (train_fused)
         self._batched_decision = None   # memoized _use_batched_grower
+        # numeric guard policy (robustness/guards.py); validated by
+        # Config.check_param_conflict, re-derived on reset_config
+        self.nan_policy = str(config.nan_policy or "none")
         self._resolve_auto_params(config)
         self.hp = _hp_from_config(config, train_set.device_n_bins())
         if bool(train_set.categorical_array().any()):
@@ -803,6 +806,14 @@ class GBDT:
             g = jnp.asarray(np.asarray(grad, np.float32).reshape(n, k, order="F"))
             h = jnp.asarray(np.asarray(hess, np.float32).reshape(n, k, order="F"))
 
+        if self.nan_policy != "none":
+            # one fused isfinite-reduction over (g, h, scores); raises for
+            # nan_policy=raise/halt_and_keep_best, True = skip this round
+            from ..robustness.guards import enforce_nan_policy
+            if enforce_nan_policy(self, g, h):
+                self.iter_ += 1
+                return False
+
         row_mask, g, h = self.sample_strategy.sample(self.iter_, g, h, self._rng,
                                                      self.train_set.metadata)
         feature_mask = self._feature_mask_for_tree()
@@ -955,6 +966,9 @@ class GBDT:
                 and self.parallel_mode is None
                 and not self.linear
                 and self.cegb is None
+                # the per-round numeric guard is a host-side check; the
+                # fused scan cannot surface a mid-chunk trip
+                and self.nan_policy == "none"
                 and not bool(c.tpu_debug_checks)
                 and (not self.valid_sets or self.fused_valid_ok())
                 and (self._sampling_is_noop()

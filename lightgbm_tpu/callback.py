@@ -72,6 +72,10 @@ def record_evaluation(eval_result: Dict[str, Dict[str, List[float]]]) -> Callabl
             eval_result[name].setdefault(metric, []).append(val)
     _callback.order = 20
     _callback.fused_safe = True   # reads the eval list only (see above)
+    # resume hook (robustness/checkpoint.py): a checkpointed eval history
+    # is re-injected into this dict so a resumed run's recorded history
+    # is the uninterrupted run's
+    _callback.eval_result = eval_result
     return _callback
 
 
@@ -199,8 +203,11 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
 
     def _callback(env: CallbackEnv) -> None:
         # reset at the first iteration so one callback object can be reused
-        # across train() runs (cv() folds reuse the same instance)
-        if env.iteration == env.begin_iteration:
+        # across train() runs (cv() folds reuse the same instance) —
+        # UNLESS a checkpoint resume just re-seeded the state
+        # (robustness/checkpoint.py restore_into sets "resume_ready")
+        if env.iteration == env.begin_iteration and \
+                not state.pop("resume_ready", False):
             state.clear()
         if not state:
             _init(env)
@@ -233,4 +240,8 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
     # introspection for the fused path's optional IN-JIT compute gating
     # (GBDT.train_fused skips growth in rounds past the would-be stop)
     _callback.es_params = (stopping_rounds, first_metric_only, min_delta)
+    # checkpoint hook (robustness/checkpoint.py): the patience state is
+    # saved and re-seeded on resume, so a resumed early-stopping run
+    # stops at the same round as the uninterrupted one
+    _callback.stopping_state = state
     return _callback

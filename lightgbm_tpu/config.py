@@ -168,6 +168,12 @@ _PARAMS: List[Tuple[str, Any, Tuple[str, ...], Tuple[Tuple[str, float], ...]]] =
     ("trace_output", "", ("trace_file", "trace_out"), ()),        # Chrome trace-event JSON path (Perfetto-loadable)
     ("telemetry_output", "", ("telemetry_file",), ()),            # per-iteration telemetry JSONL path
     ("profile_dir", "", ("profiler_dir",), ()),                   # jax.profiler trace directory (device timeline)
+    # --- robustness (robustness/; docs/ROBUSTNESS.md) ---
+    ("checkpoint_dir", "", ("checkpoint_directory",), ()),        # periodic atomic training checkpoints under this directory; empty = off
+    ("checkpoint_interval", 10, (), ((">", 0),)),                 # boosting rounds between checkpoints
+    ("checkpoint_keep", 3, (), ((">", 0),)),                      # newest checkpoints retained (older ones pruned)
+    ("nan_policy", "none", (), ()),                               # per-round finite guard on grad/hess/scores: none|raise|skip_round|halt_and_keep_best
+    ("cluster_timeout_s", 3600.0, ("cluster_timeout",), ((">", 0.0),)),  # parallel.cluster.launch worker deadline
     ("use_quantized_grad", False, (), ()),
     ("num_grad_quant_bins", 4, (), ()),
     ("quant_train_renew_leaf", False, (), ()),
@@ -433,6 +439,11 @@ class Config:
         if self.objective in ("lambdarank", "rank_xendcg") and \
                 self.lambdarank_truncation_level <= 0:
             log.fatal("lambdarank_truncation_level must be positive")
+        self.nan_policy = str(self.nan_policy or "none").strip().lower()
+        if self.nan_policy not in ("none", "raise", "skip_round",
+                                   "halt_and_keep_best"):
+            log.fatal(f"unknown nan_policy={self.nan_policy!r} (expected "
+                      "none/raise/skip_round/halt_and_keep_best)")
         # max_depth implies a num_leaves cap when num_leaves not explicit
         if self.max_depth > 0 and not self.is_explicit("num_leaves"):
             full = 1 << min(self.max_depth, 30)

@@ -19,7 +19,9 @@ from .callback import (CallbackEnv, EarlyStopException, log_telemetry,
                        record_evaluation)
 from .config import normalize_params
 from .obs import observe_training, trace as obs_trace
+from .robustness.guards import NumericHalt
 from .utils import log
+from .utils.paths import check_output_path
 from .utils.timer import global_timer, phase
 
 
@@ -31,8 +33,19 @@ def train(params: Dict[str, Any], train_set: Dataset,
           init_model: Optional[Union[str, Booster]] = None,
           keep_training_booster: bool = False,
           callbacks: Optional[List[Callable]] = None,
-          fobj: Optional[Callable] = None) -> Booster:
-    """Train a booster (reference engine.py:109)."""
+          fobj: Optional[Callable] = None,
+          resume: Optional[str] = None) -> Booster:
+    """Train a booster (reference engine.py:109).
+
+    ``resume="auto"`` (requires ``checkpoint_dir`` in ``params``) loads
+    the newest VALID checkpoint, rebuilds the booster through the
+    ``init_model`` continuation path with the checkpointed score caches,
+    RNG states and eval history restored exactly, and trains the
+    REMAINING rounds — ``num_boost_round`` is the TOTAL target, so an
+    interrupted-and-resumed run finishes with the same round count (and,
+    for deterministic configs, the same trees) as an uninterrupted one.
+    With no valid checkpoint, training starts from scratch.
+    """
     params = normalize_params(params)
     if "num_iterations" in params:
         num_boost_round = params["num_iterations"]
@@ -40,13 +53,37 @@ def train(params: Dict[str, Any], train_set: Dataset,
     if fobj is not None:
         params["objective"] = "none"
 
+    ckpt_dir = str(params.get("checkpoint_dir", "") or "")
+    resume_state = None
+    if resume is not None:
+        if str(resume) != "auto":
+            log.fatal(f"resume={resume!r} is not supported (only 'auto')")
+        if not ckpt_dir:
+            log.fatal("resume='auto' requires checkpoint_dir= in params")
+        from .robustness.checkpoint import load_latest_checkpoint
+        resume_state = load_latest_checkpoint(ckpt_dir)
+        if resume_state is None:
+            log.info(f"resume='auto': no valid checkpoint under "
+                     f"{ckpt_dir!r}; training from scratch")
+        else:
+            if init_model is not None:
+                log.warning("resume='auto' found a checkpoint; the given "
+                            "init_model is ignored in favor of it")
+            init_model = Booster(model_str=resume_state.model_text)
+
     if init_model is not None:
         # continuation (reference engine.py:233-244): the init model's raw
         # predictions become the train/valid datasets' init_score, and its
         # trees are merged into the new booster (basic.py Booster.__init__)
         predictor = init_model if isinstance(init_model, Booster) \
             else Booster(model_file=str(init_model))
-        train_set._apply_predictor(predictor)
+        if resume_state is not None:
+            # checkpoint resume restores the exact f32 score caches below,
+            # so the init-score predict pass is skipped — this also works
+            # on a constructed Dataset whose raw data was freed (CLI)
+            train_set._set_resume_predictor(predictor)
+        else:
+            train_set._apply_predictor(predictor)
     booster = Booster(params=params, train_set=train_set)
 
     valid_sets = list(valid_sets or [])
@@ -67,20 +104,58 @@ def train(params: Dict[str, Any], train_set: Dataset,
         # telemetry_output=<path>: one JSONL record per iteration
         # (counters, phase deltas, host/device memory) — the config-key
         # spelling of the log_telemetry callback.  Writability is probed
-        # up front so a path typo surfaces before round 1, not as a
-        # mid-training crash.
-        from .obs import _writable
-        if _writable(str(cfg.telemetry_output)):
+        # up front (shared utils/paths contract) so a path typo surfaces
+        # before round 1, not as a mid-training crash.
+        if check_output_path(str(cfg.telemetry_output),
+                             key="telemetry_output"):
             callbacks.append(log_telemetry(str(cfg.telemetry_output)))
-        else:
-            log.warning(f"telemetry_output={cfg.telemetry_output!r} is "
-                        "not writable; telemetry JSONL disabled for "
-                        "this run")
+    mgr = None
+    if ckpt_dir:
+        # periodic atomic checkpoints (robustness/checkpoint.py).  Same
+        # failure contract as the other output keys: an unwritable dir
+        # degrades to a warning before round 1.  The callback is not
+        # fused-safe, so checkpointed runs keep the classic loop (a
+        # mid-chunk snapshot would pair end-of-chunk scores with
+        # mid-chunk trees).
+        if check_output_path(ckpt_dir, key="checkpoint_dir", kind="dir"):
+            from .robustness.checkpoint import CheckpointManager
+            mgr = CheckpointManager(
+                ckpt_dir, interval=int(cfg.checkpoint_interval),
+                keep=int(cfg.checkpoint_keep),
+                history=resume_state.history if resume_state else None,
+                # a from-scratch run owns the directory: stale checkpoints
+                # from a previous run are cleared (with a warning) so
+                # retention and a later resume='auto' see only THIS run
+                fresh=resume_state is None)
+            callbacks.append(mgr.callback())
     callbacks = sorted(callbacks, key=lambda cb: getattr(cb, "order", 0))
+    if mgr is not None:
+        # the manager snapshots peer-callback state (early-stopping
+        # patience) into each checkpoint
+        mgr.peer_callbacks = callbacks
     cbs_before = [cb for cb in callbacks if getattr(cb, "before_iteration",
                                                     False)]
     cbs_after = [cb for cb in callbacks if not getattr(cb, "before_iteration",
                                                        False)]
+
+    rounds_to_run = num_boost_round
+    start_round = 0
+    if resume_state is not None:
+        # exact-state restore (score caches / RNG / eval history) on top
+        # of the init_model continuation; num_boost_round is the TOTAL
+        # target, so only the remaining rounds run.  Callbacks see
+        # ABSOLUTE iteration indices (begin_iteration = the resume
+        # point), so early stopping / NumericHalt record a
+        # best_iteration that counts every tree in the model, not just
+        # the resumed segment's.
+        resume_state.restore_into(booster, callbacks)
+        rounds_to_run = num_boost_round - resume_state.iteration
+        start_round = resume_state.iteration
+        if rounds_to_run <= 0:
+            log.info(f"checkpoint is already at iteration "
+                     f"{resume_state.iteration} >= num_boost_round="
+                     f"{num_boost_round}; nothing to train")
+            return booster
 
     # observability session (obs/): trace_output starts the span recorder
     # (exported on exit), profile_dir brackets the run with
@@ -88,16 +163,21 @@ def train(params: Dict[str, Any], train_set: Dataset,
     # the root span every other span nests under.
     with observe_training(cfg), \
             phase("train", booster._gbdt.timer, global_timer):
-        return _run_training(booster, params, train_set, num_boost_round,
+        return _run_training(booster, params, train_set, rounds_to_run,
                              valid_pairs, train_in_valid, feval, fobj,
-                             callbacks, cbs_before, cbs_after)
+                             callbacks, cbs_before, cbs_after,
+                             start_round=start_round)
 
 
 def _run_training(booster, params, train_set, num_boost_round, valid_pairs,
                   train_in_valid, feval, fobj, callbacks, cbs_before,
-                  cbs_after) -> Booster:
+                  cbs_after, start_round: int = 0) -> Booster:
     """The boosting loop of ``train()`` (split out so the observability
-    session brackets every exit path)."""
+    session brackets every exit path).  ``start_round`` > 0 (checkpoint
+    resume) makes callback iteration indices ABSOLUTE: the loop runs
+    ``[start_round, start_round + num_boost_round)`` with
+    ``begin_iteration = start_round``, so best_iteration bookkeeping and
+    checkpoint cadence line up with the uninterrupted run's."""
     # fused-rounds fast path: when every per-iteration observer can be
     # driven from device-evaluated metrics — no callbacks at all, or only
     # fused-safe ones (early_stopping / log_evaluation /
@@ -112,7 +192,7 @@ def _run_training(booster, params, train_set, num_boost_round, valid_pairs,
     # are exactly the classic loop's.
     cbs_fused_safe = all(getattr(cb, "fused_safe", False)
                          for cb in callbacks) and not cbs_before
-    if (cbs_fused_safe and not train_in_valid
+    if (cbs_fused_safe and not train_in_valid and start_round == 0
             and feval is None and fobj is None and num_boost_round > 0
             and not booster._gbdt.config.is_provide_training_metric
             and (not valid_pairs or callbacks)
@@ -143,12 +223,20 @@ def _run_training(booster, params, train_set, num_boost_round, valid_pairs,
         return booster
 
     evals: List = []
-    for it in range(num_boost_round):
+    end_round = start_round + num_boost_round
+    for it in range(start_round, end_round):
         with obs_trace.span("iteration", iter=it):
             for cb in cbs_before:
-                cb(CallbackEnv(booster, params, it, 0, num_boost_round,
+                cb(CallbackEnv(booster, params, it, start_round, end_round,
                                None))
-            finished = booster.update(fobj=fobj)
+            try:
+                finished = booster.update(fobj=fobj)
+            except NumericHalt:
+                # nan_policy=halt_and_keep_best: keep every completed
+                # round; guards.py already warned with the round number
+                booster.best_iteration = it
+                _set_best_score(booster, evals)
+                break
             evals = []
             with phase("metric_eval", booster._gbdt.timer, global_timer):
                 if train_in_valid or \
@@ -160,8 +248,8 @@ def _run_training(booster, params, train_set, num_boost_round, valid_pairs,
                                           valid_pairs, train_in_valid))
             try:
                 for cb in cbs_after:
-                    cb(CallbackEnv(booster, params, it, 0, num_boost_round,
-                                   evals))
+                    cb(CallbackEnv(booster, params, it, start_round,
+                                   end_round, evals))
             except EarlyStopException as e:
                 booster.best_iteration = e.best_iteration + 1
                 _set_best_score(booster, e.best_score)
@@ -226,6 +314,13 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
        return_cvbooster: bool = False) -> Dict[str, List[float]]:
     """K-fold cross-validation (reference engine.py:611)."""
     params = normalize_params(params)
+    if params.get("checkpoint_dir"):
+        # per-fold trains would interleave checkpoints in one directory
+        # (and each fold's fresh start clears the previous fold's) —
+        # checkpointing is a single-run feature
+        log.warning("checkpoint_dir is not supported inside cv(); "
+                    "checkpointing disabled for the fold trainings")
+        params = {k: v for k, v in params.items() if k != "checkpoint_dir"}
     if metrics is not None:
         params["metric"] = metrics
     # construction-affecting params (max_bin, linear_tree, enable_bundle...)

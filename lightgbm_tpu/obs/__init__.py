@@ -42,14 +42,14 @@ def observe_training(config) -> Iterator[None]:
     degrades to a warning — the trained booster is never lost to
     telemetry."""
     from ..utils import log
+    from ..utils.paths import check_output_path
     trace_path = str(getattr(config, "trace_output", "") or "")
     profile_dir = str(getattr(config, "profile_dir", "") or "")
     # probe writability only when this session would own the export —
     # a joiner of an already-active session must not leave a zero-byte
     # stub at a path that will never be written
-    if trace_path and trace.active() is None and not _writable(trace_path):
-        log.warning(f"trace_output={trace_path!r} is not writable; "
-                    "tracing disabled for this run")
+    if trace_path and trace.active() is None and \
+            not check_output_path(trace_path, key="trace_output"):
         trace_path = ""
     recorder = trace.start(trace_path) if trace_path else None
     profiling = bool(profile_dir) and trace.start_profiler(profile_dir)
@@ -67,11 +67,8 @@ def observe_training(config) -> Iterator[None]:
 
 
 def _writable(path: str) -> bool:
-    """Can ``path`` be created/appended?  Probed up front so output-path
-    typos fail before training starts, not after it finishes."""
-    try:
-        with open(path, "a"):
-            pass
-        return True
-    except OSError:
-        return False
+    """Back-compat alias for the shared probe (utils/paths.py) — the
+    single implementation of the warn-before-round-1 output-path
+    contract shared by trace/telemetry/checkpoint keys."""
+    from ..utils.paths import writable_file
+    return writable_file(path)

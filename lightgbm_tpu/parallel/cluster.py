@@ -80,6 +80,40 @@ def _shard_rows(n: int, n_workers: int, group: Optional[np.ndarray]) -> list:
     return [(np.arange(r, n, n_workers), None) for r in range(n_workers)]
 
 
+#: default seconds the startup barrier (every rank through
+#: launcher.initialize) may take before the attempt is classified a
+#: startup failure and retried; bounded so a hung coordinator
+#: negotiation does not burn the whole job deadline per attempt.
+#: Large pods with slow multi-host initialize can raise it via the
+#: ``startup_window_s`` kwarg of :func:`launch`.
+STARTUP_WINDOW_S = 300.0
+
+
+def _resolve_timeout(params: Dict[str, Any], timeout_s: Optional[float]
+                     ) -> float:
+    """Worker deadline: explicit ``timeout_s`` kwarg wins, else the
+    ``cluster_timeout_s`` param (or its ``cluster_timeout`` alias),
+    else 3600 s."""
+    if timeout_s is not None:
+        return float(timeout_s)
+    raw = params.get("cluster_timeout_s",
+                     params.get("cluster_timeout", 0))
+    try:
+        v = float(raw or 0)
+    except (TypeError, ValueError):
+        v = 0.0
+    return v if v > 0 else 3600.0
+
+
+def _log_tail(path: str, limit: int = 2000) -> str:
+    try:
+        with open(path, "rb") as fh:
+            fh.seek(max(0, os.path.getsize(path) - limit))
+            return fh.read().decode(errors="replace")
+    except OSError as e:
+        return f"<log unreadable: {e}>"
+
+
 def launch(params: Dict[str, Any], data, label=None, *,
            weight: Optional[np.ndarray] = None,
            group: Optional[np.ndarray] = None,
@@ -88,7 +122,9 @@ def launch(params: Dict[str, Any], data, label=None, *,
            machines: Optional[str] = None,
            local_listen_port: int = 12400,
            devices_per_worker: int = 0,
-           timeout_s: float = 3600.0):
+           timeout_s: Optional[float] = None,
+           startup_retries: int = 2,
+           startup_window_s: Optional[float] = None):
     """Run data-parallel training across ``n_workers`` fresh processes and
     return the trained Booster (identical on every rank; rank 0's copy).
 
@@ -97,14 +133,30 @@ def launch(params: Dict[str, Any], data, label=None, *,
     stripe via ``load_rank_shard`` — nothing is shipped).
     ``devices_per_worker`` > 0 forces that many virtual CPU devices per
     worker (the CI configuration; leave 0 to inherit real accelerators).
+
+    Robustness (docs/ROBUSTNESS.md): each worker drops a ready marker
+    once it clears the distributed startup barrier.  A crash or hang
+    BEFORE every marker exists is a startup failure and is retried with
+    backoff up to ``startup_retries`` times (fresh processes, fresh
+    logs); a failure after the barrier is a training failure and fails
+    fast.  Either way the raised error names the dead/stuck worker ranks
+    and carries their log tails.  ``timeout_s=None`` resolves from the
+    ``cluster_timeout_s`` param (default 3600 s);
+    ``startup_window_s=None`` gives the barrier min(STARTUP_WINDOW_S,
+    timeout_s) seconds — raise it for pods with slow multi-host
+    initialization.
     """
+    import time as _time
+
     from ..basic import Booster
 
+    timeout_s = _resolve_timeout(params, timeout_s)
     worker_map = _machines_to_worker_map(machines, n_workers,
                                          local_listen_port)
     coordinator = worker_map[0]
     with tempfile.TemporaryDirectory(prefix="lgbtpu_cluster_") as tmp:
-        specs = []
+        specs = []        # per-rank spec file paths (worker argv)
+        spec_dicts = []   # the same specs, kept in memory for the parent
         shards = None
         if isinstance(data, (str, os.PathLike)):
             if label is not None or weight is not None or group is not None:
@@ -124,6 +176,7 @@ def launch(params: Dict[str, Any], data, label=None, *,
                 "num_boost_round": int(num_boost_round),
                 "devices_per_worker": int(devices_per_worker),
                 "out_path": os.path.join(tmp, "model.txt"),
+                "ready_path": os.path.join(tmp, f"ready_{rank}"),
             }
             if shards is None:
                 spec["data_path"] = str(data)
@@ -143,10 +196,60 @@ def launch(params: Dict[str, Any], data, label=None, *,
             with open(spec_path, "w") as fh:
                 json.dump(spec, fh)
             specs.append(spec_path)
+            spec_dicts.append(spec)
 
-        procs = []
-        logs = []
-        for rank, spec_path in enumerate(specs):
+        if startup_window_s is None:
+            startup_window_s = STARTUP_WINDOW_S
+        # the barrier window never exceeds the job deadline — otherwise a
+        # pre-barrier hang would hit the main deadline first and be
+        # classified 'runtime' (non-retryable)
+        startup_window_s = min(float(startup_window_s), timeout_s)
+        last_fail = None
+        for attempt in range(startup_retries + 1):
+            outcome, detail = _run_attempt(specs, spec_dicts, tmp,
+                                           timeout_s, startup_window_s,
+                                           attempt)
+            if outcome == "ok":
+                with open(spec_dicts[0]["out_path"]) as fh:
+                    return Booster(model_str=fh.read())
+            if outcome == "runtime":
+                # post-barrier death: retrying would redo a long train
+                # on the same inputs that just failed — fail fast with
+                # the named worker's diagnosis
+                log.fatal(f"cluster launch failed: {detail}")
+            last_fail = detail
+            if attempt < startup_retries:
+                delay = 2.0 * (attempt + 1)
+                log.warning(
+                    "cluster startup attempt %d/%d failed (%s); retrying "
+                    "in %.0f s" % (attempt + 1, startup_retries + 1,
+                                   detail.splitlines()[0], delay))
+                _time.sleep(delay)
+        log.fatal(f"cluster launch failed after {startup_retries + 1} "
+                  f"startup attempts: {last_fail}")
+
+
+def _run_attempt(spec_paths, specs, tmp: str, timeout_s: float,
+                 startup_window_s: float, attempt: int):
+    """One spawn-and-wait pass over all ranks (``specs`` are the parsed
+    dicts behind ``spec_paths``).  Returns ``("ok", None)``,
+    ``("startup", msg)`` (failure before every rank cleared the barrier —
+    retryable) or ``("runtime", msg)`` (failure after — fatal).  The
+    message names the failing worker(s) and carries their log tails."""
+    import time as _time
+
+    ready_paths = [s["ready_path"] for s in specs]
+    for rp in ready_paths:           # markers are per-attempt
+        try:
+            os.remove(rp)
+        except OSError:
+            pass
+    devices_per_worker = int(specs[0].get("devices_per_worker", 0))
+
+    procs = []
+    logs = []
+    try:
+        for rank, spec_path in enumerate(spec_paths):
             env = dict(os.environ)
             # drop only sitecustomize-injection entries (their premature
             # jax import breaks platform forcing); user PYTHONPATH entries
@@ -166,23 +269,36 @@ def launch(params: Dict[str, Any], data, label=None, *,
                     flags + " --xla_force_host_platform_device_count="
                     f"{devices_per_worker}").strip()
                 env["JAX_PLATFORMS"] = "cpu"
-            # per-rank log files, not pipes: a worker blocking on a full
-            # 64KB stdout pipe mid-collective would deadlock the job
-            lf = open(os.path.join(tmp, f"worker_{rank}.log"), "wb")
+            # per-rank per-attempt log files, not pipes: a worker blocking
+            # on a full 64KB stdout pipe mid-collective would deadlock
+            lf = open(os.path.join(tmp, f"worker_{rank}.a{attempt}.log"),
+                      "wb")
             logs.append(lf)
-            procs.append(subprocess.Popen(
-                [sys.executable, "-m", "lightgbm_tpu.parallel.cluster",
-                 spec_path],
-                env=env, stdout=lf, stderr=subprocess.STDOUT))
+            try:
+                procs.append(subprocess.Popen(
+                    [sys.executable, "-m", "lightgbm_tpu.parallel.cluster",
+                     spec_path],
+                    env=env, stdout=lf, stderr=subprocess.STDOUT))
+            except OSError as e:
+                return "startup", (f"spawning worker {rank} failed: "
+                                   f"{type(e).__name__}: {e}")
+
         # poll ALL workers against one shared deadline: the first crash
         # kills the survivors immediately (they would otherwise hang in
         # the distributed barrier until the full timeout) and ITS log is
-        # the one surfaced
-        import time as _time
+        # the one surfaced.  The startup barrier gets its own bounded
+        # window so a hung negotiation is retryable without burning the
+        # whole deadline.
         deadline = _time.monotonic() + timeout_s
+        barrier_deadline = _time.monotonic() + startup_window_s
+        barrier_passed = False
         fail = None
+        startup_failure = False
         live = dict(enumerate(procs))
         while live and fail is None:
+            if not barrier_passed:
+                barrier_passed = all(os.path.exists(rp)
+                                     for rp in ready_paths)
             for rank in list(live):
                 rc = live[rank].poll()
                 if rc is None:
@@ -190,25 +306,56 @@ def launch(params: Dict[str, Any], data, label=None, *,
                 del live[rank]
                 if rc != 0:
                     logs[rank].flush()
-                    with open(logs[rank].name, errors="replace") as fh:
-                        tail = fh.read()[-2000:]
-                    fail = f"worker {rank} exited {rc}:\n{tail}"
+                    ready = os.path.exists(ready_paths[rank])
+                    startup_failure = not ready
+                    fail = ("worker %d exited %d %s the startup barrier; "
+                            "log tail:\n%s"
+                            % (rank, rc,
+                               "after" if ready else "before",
+                               _log_tail(logs[rank].name)))
             if live and fail is None:
-                if _time.monotonic() > deadline:
-                    fail = f"workers {sorted(live)} timed out"
+                now = _time.monotonic()
+                if not barrier_passed and now > barrier_deadline:
+                    stuck = sorted(r for r in live
+                                   if not os.path.exists(ready_paths[r]))
+                    startup_failure = True
+                    for r in stuck[:2]:
+                        logs[r].flush()
+                    tails = "\n".join(
+                        f"--- worker {r} log tail ---\n"
+                        f"{_log_tail(logs[r].name)}" for r in stuck[:2])
+                    fail = ("workers %s never reached the startup barrier "
+                            "within %.0f s\n%s"
+                            % (stuck, startup_window_s, tails))
+                elif now > deadline:
+                    stuck = sorted(live)
+                    for r in stuck[:2]:
+                        logs[r].flush()
+                    tails = "\n".join(
+                        f"--- worker {r} log tail ---\n"
+                        f"{_log_tail(logs[r].name)}" for r in stuck[:2])
+                    fail = ("workers %s timed out after %.0f s "
+                            "(cluster_timeout_s)\n%s"
+                            % (stuck, timeout_s, tails))
                 else:
                     _time.sleep(0.2)
+    finally:
         for p in procs:
             if p.poll() is None:
                 p.kill()
                 p.wait()
         for lf in logs:
             lf.close()
-        if fail:
-            log.fatal(f"cluster launch failed: {fail}")
-        model_path = json.load(open(specs[0]))["out_path"]
-        with open(model_path) as fh:
-            return Booster(model_str=fh.read())
+    if fail is None:
+        if not os.path.exists(specs[0]["out_path"]):
+            # every worker exited 0 yet rank 0 never wrote the model —
+            # still a failure, diagnosed with rank 0's log instead of
+            # leaking a FileNotFoundError from the model read
+            return "runtime", ("all workers exited 0 but rank 0 never "
+                               "wrote the model; rank 0 log tail:\n"
+                               + _log_tail(logs[0].name))
+        return "ok", None
+    return ("startup" if startup_failure else "runtime"), fail
 
 
 def _worker_main(spec_path: str) -> None:
@@ -224,6 +371,12 @@ def _worker_main(spec_path: str) -> None:
     launcher.initialize(machines=spec["machines"],
                         num_machines=spec["num_machines"],
                         rank=spec["rank"])
+    rp = spec.get("ready_path")
+    if rp:
+        # startup-barrier marker: the parent's liveness monitor uses it to
+        # tell retryable startup failures from mid-training deaths
+        with open(rp, "w") as fh:
+            fh.write(str(os.getpid()))
     kwargs: Dict[str, Any] = {}
     if "shard_path" in spec:
         z = np.load(spec["shard_path"])

@@ -1,0 +1,28 @@
+"""Fault tolerance: checkpoint/resume, numeric guards, fault injection.
+
+Three pillars (docs/ROBUSTNESS.md):
+
+  * :mod:`.checkpoint` — periodic atomic training checkpoints
+    (``checkpoint_dir=`` / ``checkpoint_interval=`` / ``checkpoint_keep=``)
+    and exact resume (``train(..., resume="auto")``): manifest + model
+    text + score/RNG/eval-history state written via write-to-temp +
+    rename, newest-valid-wins discovery that skips corrupt checkpoints
+    with a warning,
+  * :mod:`.guards` — per-round finite checks on gradients/hessians/
+    scores with a ``nan_policy`` config
+    (``raise`` | ``skip_round`` | ``halt_and_keep_best``),
+  * :mod:`.faults` — the injection harness tests use to kill training
+    mid-run, corrupt/truncate checkpoints and poison gradients, so the
+    recovery paths above stay verifiable instead of theoretical.
+
+Everything is off by default: without ``checkpoint_dir`` no file is ever
+written, and ``nan_policy=none`` adds zero per-iteration work (the guard
+is gated before any device sync).
+"""
+
+from . import checkpoint, faults, guards
+from .checkpoint import CheckpointManager, load_latest_checkpoint
+from .guards import NumericHalt
+
+__all__ = ["checkpoint", "guards", "faults", "CheckpointManager",
+           "load_latest_checkpoint", "NumericHalt"]

@@ -1,0 +1,457 @@
+"""Periodic atomic training checkpoints and exact resume.
+
+The reference survives interruption via ``init_model`` continuation on a
+saved model file (gbdt_model_text.cpp); that replays the MODEL but loses
+the run: eval history, the f32 score caches (recomputed from f64
+predictions, which differ by ulps from the incrementally-accumulated
+caches), RNG state.  A checkpoint here captures the full training state,
+so a resumed run grows bit-for-bit the same trees the uninterrupted run
+would have:
+
+``checkpoint_dir/ckpt_<iteration>/``
+  * ``model.txt``   — the full model text (all trees, interop format),
+  * ``state.npz``   — the f32 train/valid score caches, exactly as they
+    sat on device,
+  * ``state.json``  — iteration counters, valid-set names, numpy RNG
+    states (booster + sampling strategy), the eval history,
+  * ``manifest.json`` — byte sizes + sha256 of the files above; written
+    last, so a manifest that parses and matches is the definition of a
+    valid checkpoint.
+
+Atomicity: everything is written into a dot-temp sibling directory and
+``os.replace``-renamed into place, so a crash mid-write leaves a temp
+dir (ignored and garbage-collected on the next save), never a
+half-valid checkpoint.  Discovery (:func:`load_latest_checkpoint`) walks
+checkpoints newest-first and SKIPS invalid ones with a warning instead
+of crashing — a truncated newest checkpoint falls back to the previous
+valid one.
+
+Retention: the newest ``checkpoint_keep`` checkpoints survive; older
+ones are pruned after each successful save.
+"""
+
+from __future__ import annotations
+
+import copy
+import hashlib
+import json
+import os
+import shutil
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils import log
+
+CKPT_PREFIX = "ckpt_"
+MANIFEST_NAME = "manifest.json"
+MODEL_NAME = "model.txt"
+STATE_NAME = "state.npz"
+META_NAME = "state.json"
+FORMAT_VERSION = 1
+
+
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+    except OSError:  # fsync on a dir is best-effort (not all filesystems)
+        pass
+
+
+def _write_file(path: str, data) -> None:
+    mode = "wb" if isinstance(data, bytes) else "w"
+    with open(path, mode) as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def checkpoint_dirs(directory: str) -> List[Tuple[int, str]]:
+    """All ``ckpt_*`` entries under ``directory`` as (iteration, path),
+    newest first.  Non-conforming names are ignored."""
+    out = []
+    try:
+        entries = os.listdir(directory)
+    except OSError:
+        return []
+    for name in entries:
+        if not name.startswith(CKPT_PREFIX):
+            continue
+        try:
+            it = int(name[len(CKPT_PREFIX):])
+        except ValueError:
+            continue
+        path = os.path.join(directory, name)
+        if os.path.isdir(path):
+            out.append((it, path))
+    out.sort(key=lambda t: t[0], reverse=True)
+    return out
+
+
+def validate_checkpoint(path: str) -> Tuple[bool, str]:
+    """Integrity check: the manifest parses and every file it names
+    exists with the recorded size and sha256.  Returns (ok, reason)."""
+    mpath = os.path.join(path, MANIFEST_NAME)
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except OSError as e:
+        return False, f"manifest unreadable ({e})"
+    except (json.JSONDecodeError, ValueError) as e:
+        return False, f"manifest corrupt ({e})"
+    if not isinstance(manifest, dict) or "files" not in manifest:
+        return False, "manifest missing 'files'"
+    try:
+        for name, rec in manifest["files"].items():
+            fpath = os.path.join(path, name)
+            if not os.path.exists(fpath):
+                return False, f"{name} missing"
+            size = os.path.getsize(fpath)
+            if size != int(rec.get("bytes", -1)):
+                return False, (f"{name} size mismatch ({size} vs manifest "
+                               f"{rec.get('bytes')})")
+            if _sha256(fpath) != rec.get("sha256"):
+                return False, f"{name} checksum mismatch"
+    except (AttributeError, TypeError, ValueError, OSError) as e:
+        # JSON-valid but structurally wrong manifest (files as a list,
+        # non-numeric sizes, ...) is corruption, not a crash
+        return False, f"manifest malformed ({type(e).__name__}: {e})"
+    return True, "ok"
+
+
+def read_manifest(path: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(os.path.join(path, MANIFEST_NAME)) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError, ValueError):
+        return None
+
+
+class CheckpointState:
+    """A loaded checkpoint, ready to be applied onto a freshly built
+    continuation booster (:meth:`restore_into`)."""
+
+    def __init__(self, path: str, iteration: int, model_text: str,
+                 scores: Optional[np.ndarray],
+                 valid_scores: Dict[str, np.ndarray],
+                 rng_state: Optional[dict], strategy_rng_state: Optional[dict],
+                 history: Dict[str, Dict[str, List[float]]],
+                 stopping_states: Optional[List[dict]] = None):
+        self.path = path
+        self.iteration = iteration
+        self.model_text = model_text
+        self.scores = scores
+        self.valid_scores = valid_scores
+        self.rng_state = rng_state
+        self.strategy_rng_state = strategy_rng_state
+        self.history = history
+        self.stopping_states = stopping_states or []
+
+    def restore_into(self, booster, callbacks) -> None:
+        """Overwrite the continuation booster's training state with the
+        checkpointed one: the f32 score caches exactly as saved (the
+        ``init_model`` path recomputes them from f64 predictions, which
+        differs by ulps from the incrementally-accumulated caches and
+        would break bit-for-bit resume), the RNG states, and the eval
+        history of every ``record_evaluation`` callback."""
+        import jax.numpy as jnp
+        g = booster._gbdt
+        k = g.num_tree_per_iteration
+        if len(g.models) != self.iteration * k:
+            # nan_policy=skip_round advances iter_ without growing trees,
+            # so a skipped round makes these differ legitimately
+            log.info(f"resume: model carries {len(g.models)} trees at "
+                     f"checkpoint iteration {self.iteration} (skipped "
+                     "rounds)")
+        # iter_ follows the CHECKPOINT, not the tree count: sampling,
+        # quantization and feature-mask draws are keyed on iter_, and the
+        # engine's remaining-round arithmetic subtracts the checkpoint
+        # iteration — a tree-count iter_ would shift every RNG stream
+        # one round behind the uninterrupted run after a skipped round
+        g.iter_ = self.iteration
+        g.num_init_iteration = len(g.models) // k
+        # the loaded trees already carry any boost-from-average bias
+        # (folded into tree 0 at the original round 0); zero init_scores
+        # so score-cache rebuilds never double-count it
+        g.init_scores = np.zeros(k)
+        train_match = (self.scores is not None
+                       and tuple(self.scores.shape)
+                       == tuple(g.scores.shape))
+        if not train_match:
+            # no exact cache (old/partial state, or a different dataset):
+            # rebuild every score cache from the merged model — correct
+            # (same raw predictions), just not ulp-identical to the
+            # incremental accumulation, so bit-for-bit resume is off
+            log.warning("resume: checkpointed train score cache is "
+                        "missing or shaped "
+                        f"{None if self.scores is None else self.scores.shape}"
+                        f" vs dataset {tuple(g.scores.shape)}; rebuilding "
+                        "score caches from the model")
+            g.invalidate_score_cache()
+        else:
+            g.scores = jnp.asarray(self.scores)
+        for vi, name in enumerate(g.valid_names):
+            vsc = self.valid_scores.get(name)
+            if vsc is not None and tuple(vsc.shape) \
+                    == tuple(g.valid_scores[vi].shape):
+                g.valid_scores[vi] = jnp.asarray(vsc)
+            elif train_match:
+                # full rebuild above already fixed the others
+                log.warning(f"resume: no usable checkpointed scores for "
+                            f"valid set {name!r}; rebuilding them from "
+                            "the model")
+                g.invalidate_score_cache(only_valid_index=vi)
+        if self.rng_state:
+            try:
+                rng = np.random.default_rng()
+                rng.bit_generator.state = self.rng_state
+                g._rng = rng
+            except (KeyError, ValueError, TypeError) as e:
+                log.warning(f"resume: could not restore booster RNG state "
+                            f"({e}); reseeding")
+        if self.strategy_rng_state and hasattr(g.sample_strategy, "_rng"):
+            try:
+                rng = np.random.default_rng()
+                rng.bit_generator.state = self.strategy_rng_state
+                g.sample_strategy._rng = rng
+            except (KeyError, ValueError, TypeError) as e:
+                log.warning(f"resume: could not restore sampling RNG state "
+                            f"({e}); reseeding")
+        for cb in callbacks or []:
+            er = getattr(cb, "eval_result", None)
+            if isinstance(er, dict):
+                er.clear()
+                er.update(copy.deepcopy(self.history))
+        es_cbs = [cb for cb in callbacks or []
+                  if getattr(cb, "stopping_state", None) is not None]
+        if len(es_cbs) != len(self.stopping_states) and \
+                (es_cbs or self.stopping_states):
+            log.warning(f"resume: {len(self.stopping_states)} checkpointed "
+                        f"early-stopping state(s) for {len(es_cbs)} "
+                        "registered callback(s); unmatched callbacks "
+                        "restart their patience at the resume point")
+        for cb, saved in zip(es_cbs, self.stopping_states):
+            cb.stopping_state.clear()
+            cb.stopping_state.update(copy.deepcopy(saved))
+            # survive the callback's begin-of-run reset (callback.py)
+            cb.stopping_state["resume_ready"] = True
+        g._count("checkpoint_resumes")
+        log.info(f"resumed from checkpoint {self.path} "
+                 f"(iteration {self.iteration})")
+
+
+def load_latest_checkpoint(directory: str) -> Optional[CheckpointState]:
+    """Newest VALID checkpoint under ``directory``, or None.  Invalid or
+    partial checkpoints are skipped with a warning, never an error — a
+    corrupt newest checkpoint falls back to the previous valid one."""
+    from ..obs import count_event
+    for it, path in checkpoint_dirs(directory):
+        ok, reason = validate_checkpoint(path)
+        if not ok:
+            count_event("checkpoints_skipped_invalid")
+            log.warning(f"skipping invalid checkpoint {path}: {reason}")
+            continue
+        try:
+            with open(os.path.join(path, MODEL_NAME)) as f:
+                model_text = f.read()
+            with open(os.path.join(path, META_NAME)) as f:
+                meta = json.load(f)
+            scores = None
+            valid_scores: Dict[str, np.ndarray] = {}
+            state_path = os.path.join(path, STATE_NAME)
+            if os.path.exists(state_path):
+                with np.load(state_path) as z:
+                    if "scores" in z:
+                        scores = np.asarray(z["scores"])
+                    for vi, name in enumerate(meta.get("valid_names", [])):
+                        key = f"valid_{vi}"
+                        if key in z:
+                            valid_scores[name] = np.asarray(z[key])
+        except (OSError, json.JSONDecodeError, ValueError, KeyError) as e:
+            count_event("checkpoints_skipped_invalid")
+            log.warning(f"skipping unreadable checkpoint {path}: {e}")
+            continue
+        return CheckpointState(
+            path=path, iteration=int(meta.get("iteration", it)),
+            model_text=model_text, scores=scores,
+            valid_scores=valid_scores,
+            rng_state=meta.get("rng_state"),
+            strategy_rng_state=meta.get("strategy_rng_state"),
+            history=meta.get("history") or {},
+            stopping_states=meta.get("stopping_states") or [])
+    return None
+
+
+class CheckpointManager:
+    """Writes periodic checkpoints from a training run.
+
+    ``callback()`` returns the engine-registered training callback: it
+    accumulates the per-iteration eval history and saves a checkpoint
+    every ``interval`` iterations.  The callback is deliberately NOT
+    ``fused_safe``: inside a fused chunk the score caches already sit at
+    the end-of-chunk state while trees materialize round by round, so a
+    mid-chunk snapshot would be inconsistent — checkpointing keeps the
+    classic per-round loop.
+
+    A failed save degrades to a warning (training is never taken down by
+    its own safety net); the failure is counted in telemetry.
+    """
+
+    def __init__(self, directory: str, interval: int = 10, keep: int = 3,
+                 history: Optional[Dict[str, Dict[str, List[float]]]] = None,
+                 fresh: bool = False):
+        self.directory = str(directory)
+        self.interval = max(1, int(interval))
+        self.keep = max(1, int(keep))
+        self.history: Dict[str, Dict[str, List[float]]] = \
+            copy.deepcopy(history) if history else {}
+        self._warned_save_failure = False
+        self.peer_callbacks: List[Callable] = []
+        if fresh:
+            # this run starts from scratch: leftover checkpoints belong
+            # to a PREVIOUS run and would poison both retention (higher
+            # iteration numbers outrank this run's) and a later
+            # resume='auto' (restoring the old run's model against this
+            # run's data) — clear them, loudly
+            stale = checkpoint_dirs(self.directory)
+            if stale:
+                log.warning(
+                    f"checkpoint_dir {self.directory!r} holds "
+                    f"{len(stale)} checkpoint(s) from a previous run "
+                    f"(up to iteration {stale[0][0]}); removing them — "
+                    "pass resume='auto' to continue that run, or use a "
+                    "fresh directory to keep it")
+                for _, path in stale:
+                    shutil.rmtree(path, ignore_errors=True)
+
+    # ------------------------------------------------------------ callback
+    def callback(self) -> Callable:
+        def _callback(env) -> None:
+            for item in (env.evaluation_result_list or []):
+                name, metric, val = item[0], item[1], item[2]
+                self.history.setdefault(name, {}).setdefault(
+                    metric, []).append(float(val))
+            if (env.iteration + 1) % self.interval == 0:
+                self.save(env.model)
+        _callback.order = 40
+        _callback.checkpoint_manager = self
+        return _callback
+
+    # ---------------------------------------------------------------- save
+    def save(self, booster) -> Optional[str]:
+        """Write one atomic checkpoint of ``booster``; returns its path
+        (None when the save failed and was degraded to a warning)."""
+        g = booster._gbdt
+        it = g.iter_
+        final = os.path.join(self.directory, f"{CKPT_PREFIX}{it:07d}")
+        tmp = os.path.join(self.directory,
+                           f".tmp_{CKPT_PREFIX}{it:07d}_{os.getpid()}")
+        try:
+            path = self._write(booster, g, it, tmp, final)
+        except OSError as e:
+            shutil.rmtree(tmp, ignore_errors=True)
+            g._count("checkpoint_write_failures")
+            if not self._warned_save_failure:
+                self._warned_save_failure = True
+                log.warning(f"checkpoint save to {final} failed "
+                            f"({type(e).__name__}: {e}); training "
+                            "continues without this checkpoint")
+            return None
+        g._count("checkpoints_written")
+        self._prune()
+        return path
+
+    def _write(self, booster, g, it: int, tmp: str, final: str) -> str:
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp, ignore_errors=True)
+        os.makedirs(tmp)
+        _write_file(os.path.join(tmp, MODEL_NAME),
+                    booster.model_to_string(num_iteration=-1))
+        arrays: Dict[str, np.ndarray] = {
+            "scores": np.asarray(g.scores, np.float32)}
+        for vi in range(len(g.valid_scores)):
+            arrays[f"valid_{vi}"] = np.asarray(g.valid_scores[vi],
+                                               np.float32)
+        state_path = os.path.join(tmp, STATE_NAME)
+        with open(state_path, "wb") as f:
+            np.savez(f, **arrays)
+            f.flush()
+            os.fsync(f.fileno())
+        meta = {
+            "format_version": FORMAT_VERSION,
+            "iteration": int(it),
+            "num_trees": len(g.models),
+            "num_tree_per_iteration": int(g.num_tree_per_iteration),
+            "valid_names": list(g.valid_names),
+            "rng_state": _rng_state(getattr(g, "_rng", None)),
+            "strategy_rng_state": _rng_state(
+                getattr(g.sample_strategy, "_rng", None)),
+            "history": self.history,
+            # early-stopping patience state (callback.py stopping_state),
+            # one entry per registered early_stopping callback in order,
+            # so a resumed run stops at the same round the uninterrupted
+            # one would
+            "stopping_states": [
+                dict(cb.stopping_state) for cb in self.peer_callbacks
+                if getattr(cb, "stopping_state", None) is not None],
+        }
+        _write_file(os.path.join(tmp, META_NAME), json.dumps(meta))
+        files = {}
+        for name in (MODEL_NAME, STATE_NAME, META_NAME):
+            p = os.path.join(tmp, name)
+            files[name] = {"bytes": os.path.getsize(p),
+                           "sha256": _sha256(p)}
+        manifest = {
+            "format_version": FORMAT_VERSION,
+            "iteration": int(it),
+            "unix_time": round(time.time(), 3),
+            "num_trees": len(g.models),
+            "files": files,
+        }
+        _write_file(os.path.join(tmp, MANIFEST_NAME), json.dumps(manifest))
+        if os.path.exists(final):
+            shutil.rmtree(final, ignore_errors=True)
+        os.replace(tmp, final)
+        _fsync_dir(self.directory)
+        return final
+
+    def _prune(self) -> None:
+        """Keep the newest ``keep`` checkpoints; drop the rest and any
+        orphaned temp dirs from interrupted saves."""
+        for it, path in checkpoint_dirs(self.directory)[self.keep:]:
+            shutil.rmtree(path, ignore_errors=True)
+        try:
+            for name in os.listdir(self.directory):
+                if name.startswith(f".tmp_{CKPT_PREFIX}"):
+                    full = os.path.join(self.directory, name)
+                    # another live writer may own a fresh temp dir; only
+                    # reap stale ones (>1h old)
+                    try:
+                        if time.time() - os.path.getmtime(full) > 3600:
+                            shutil.rmtree(full, ignore_errors=True)
+                    except OSError:
+                        pass
+        except OSError:
+            pass
+
+
+def _rng_state(rng) -> Optional[dict]:
+    if rng is None:
+        return None
+    try:
+        return rng.bit_generator.state
+    except AttributeError:
+        return None

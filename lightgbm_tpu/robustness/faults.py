@@ -1,0 +1,115 @@
+"""Fault-injection harness: make recovery paths testable.
+
+A robustness subsystem that is never exercised is theoretical.  This
+module provides the three injections the test suite (and any operator
+drill) uses against a REAL training run:
+
+  * :func:`kill_training` — a callback that raises :class:`KillTraining`
+    once a given iteration completes, before its checkpoint cadence
+    fires: the round's work is lost exactly like a preemption between
+    checkpoints,
+  * :func:`corrupt_checkpoint` — truncate / garbage / delete pieces of
+    the newest checkpoint on disk, driving the skip-and-fall-back path,
+  * :func:`poison_gradients` — a context manager that patches the
+    gradient step to emit NaN/inf at one chosen round, driving the
+    ``nan_policy`` guards.
+
+Only tests and drills import this module; nothing in the training stack
+depends on it.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Callable, Iterator, Optional
+
+from .checkpoint import (CKPT_PREFIX, MANIFEST_NAME, MODEL_NAME, STATE_NAME,
+                         checkpoint_dirs)
+
+
+class KillTraining(Exception):
+    """The injected mid-run crash (stands in for preemption/OOM)."""
+
+
+def kill_training(at_iteration: int) -> Callable:
+    """Callback raising :class:`KillTraining` after iteration
+    ``at_iteration`` (0-based; absolute, matching the engine's callback
+    numbering — resumed runs continue from the checkpoint round)
+    completes.  Ordered after the checkpoint callback, so a kill on a
+    checkpoint round still persists that round first — like a crash
+    landing between rounds."""
+    def _callback(env) -> None:
+        if env.iteration >= at_iteration:
+            raise KillTraining(
+                f"injected kill at iteration {env.iteration}")
+    _callback.order = 100
+    return _callback
+
+
+def newest_checkpoint_path(directory: str) -> Optional[str]:
+    dirs = checkpoint_dirs(directory)
+    return dirs[0][1] if dirs else None
+
+
+def corrupt_checkpoint(directory: str, mode: str = "truncate_model",
+                       path: Optional[str] = None) -> str:
+    """Damage the newest checkpoint under ``directory`` (or the given
+    ``path``).  Modes:
+
+      * ``truncate_model``   — cut ``model.txt`` to half its bytes,
+      * ``garbage_manifest`` — overwrite the manifest with non-JSON,
+      * ``missing_state``    — delete ``state.npz``,
+      * ``flip_byte``        — flip one byte inside ``model.txt``
+        (size-preserving; caught by the sha256 check).
+
+    Returns the damaged checkpoint's path."""
+    target = path or newest_checkpoint_path(directory)
+    if target is None:
+        raise FileNotFoundError(
+            f"no {CKPT_PREFIX}* checkpoint under {directory}")
+    if mode == "truncate_model":
+        mpath = os.path.join(target, MODEL_NAME)
+        size = os.path.getsize(mpath)
+        with open(mpath, "r+b") as f:
+            f.truncate(size // 2)
+    elif mode == "garbage_manifest":
+        with open(os.path.join(target, MANIFEST_NAME), "w") as f:
+            f.write("{not json")
+    elif mode == "missing_state":
+        os.remove(os.path.join(target, STATE_NAME))
+    elif mode == "flip_byte":
+        mpath = os.path.join(target, MODEL_NAME)
+        with open(mpath, "r+b") as f:
+            f.seek(os.path.getsize(mpath) // 2)
+            b = f.read(1)
+            f.seek(-1, os.SEEK_CUR)
+            f.write(bytes([b[0] ^ 0xFF]))
+    else:
+        raise ValueError(f"unknown corruption mode {mode!r}")
+    return target
+
+
+@contextlib.contextmanager
+def poison_gradients(at_iteration: int, mode: str = "nan") -> Iterator[None]:
+    """Patch ``GBDT.boosting_gradients`` so the round at absolute
+    iteration ``at_iteration`` emits a non-finite gradient (``mode`` is
+    ``nan`` or ``inf``), then restore the original.  The classic loop's
+    per-round guard (robustness/guards.py) sees the poisoned values
+    exactly as a diverging objective would produce them."""
+    import jax.numpy as jnp
+    from ..boosting.gbdt import GBDT
+    bad = jnp.nan if mode == "nan" else jnp.inf
+    orig = GBDT.boosting_gradients
+
+    def patched(self):
+        g, h = orig(self)
+        if self.iter_ == at_iteration:
+            g = g.at[0].set(bad)
+        return g, h
+
+    GBDT.boosting_gradients = patched
+    try:
+        yield
+    finally:
+        GBDT.boosting_gradients = orig

@@ -1,0 +1,81 @@
+"""Numeric guards: per-round finite checks with a configurable policy.
+
+The reference has no runtime NaN policy — a pathological round (custom
+``fobj`` returning inf, a diverging objective, bad label data) silently
+poisons the score cache and every later tree.  Here each boosting round
+can be checked before growth: one fused ``isfinite``-reduction over the
+round's gradients, hessians and the incoming score cache (a single
+device scalar, so the guard costs one sync per round — and nothing at
+all at the default ``nan_policy=none``).
+
+Policies (``nan_policy`` config key; docs/ROBUSTNESS.md):
+
+  * ``none``  — no checks (default; the fused fast path stays eligible),
+  * ``raise`` — fail fast with the offending round number in a
+    ``LightGBMError``,
+  * ``skip_round`` — log + count the round, grow no trees, continue,
+  * ``halt_and_keep_best`` — stop training, keeping every completed
+    round (the engine records the last good round as
+    ``best_iteration``).
+
+Every trip increments telemetry counters (obs/metrics.py) so a guarded
+run's history is visible in ``Booster.telemetry()`` and the JSONL feed.
+"""
+
+from __future__ import annotations
+
+from ..utils import log
+from ..utils.log import LightGBMError
+
+VALID_NAN_POLICIES = ("none", "raise", "skip_round", "halt_and_keep_best")
+
+
+class NumericHalt(Exception):
+    """Raised by ``nan_policy=halt_and_keep_best`` when a round fails the
+    finite check; the engine catches it, keeps every completed round and
+    stops training cleanly (never crossing the public API boundary)."""
+
+    def __init__(self, iteration: int):
+        super().__init__(f"numeric halt at boosting round {iteration}")
+        self.iteration = iteration
+
+
+def round_is_finite(*arrays) -> bool:
+    """True when every given array is all-finite.  One fused device
+    reduction — the arrays never cross to the host."""
+    import jax.numpy as jnp
+    ok = jnp.bool_(True)
+    for a in arrays:
+        if a is not None:
+            ok = ok & jnp.isfinite(a).all()
+    return bool(ok)
+
+
+def enforce_nan_policy(gb, grad, hess) -> bool:
+    """Check one round's (grad, hess, score-cache) triplet and apply the
+    booster's ``nan_policy``.  Returns True when the round must be
+    SKIPPED; raises for the ``raise`` / ``halt_and_keep_best`` policies;
+    False when the round is clean (or the policy is ``none``)."""
+    policy = getattr(gb, "nan_policy", "none")
+    if policy == "none":
+        return False
+    if round_is_finite(grad, hess, gb.scores):
+        return False
+    it = gb.iter_
+    gb._count("nan_guard_trips")
+    if policy == "raise":
+        gb._count("nan_guard_raises")
+        raise LightGBMError(
+            f"nan_policy=raise: non-finite gradients/hessians/scores at "
+            f"boosting round {it}")
+    if policy == "skip_round":
+        gb._count("nan_rounds_skipped")
+        log.warning(f"non-finite gradients/hessians/scores at boosting "
+                    f"round {it}; skipping the round "
+                    "(nan_policy=skip_round)")
+        return True
+    gb._count("nan_guard_halts")
+    log.warning(f"non-finite gradients/hessians/scores at boosting "
+                f"round {it}; halting training and keeping the "
+                f"{it} completed round(s) (nan_policy=halt_and_keep_best)")
+    raise NumericHalt(it)

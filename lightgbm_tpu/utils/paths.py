@@ -1,0 +1,50 @@
+"""Output-path validation shared by every path-producing config key.
+
+The failure-path contract (docs/OBSERVABILITY.md, docs/ROBUSTNESS.md):
+a mistyped or unwritable output path (``trace_output``,
+``telemetry_output``, ``checkpoint_dir``, ...) degrades the FEATURE to a
+warning emitted before boosting round 1 — it must never surface as a
+mid-training crash after hours of work, and it must never take the
+trained booster down with it.  This module is the single implementation
+of that probe; the per-feature call sites only differ in the key name
+they put in the warning.
+"""
+
+from __future__ import annotations
+
+import os
+
+from . import log
+
+
+def writable_file(path: str) -> bool:
+    """Can ``path`` be created/appended as a file?"""
+    try:
+        with open(path, "a"):
+            pass
+        return True
+    except OSError:
+        return False
+
+
+def writable_dir(path: str) -> bool:
+    """Can ``path`` be used as a writable directory (created if absent)?"""
+    try:
+        os.makedirs(path, exist_ok=True)
+        probe = os.path.join(path, f".probe_{os.getpid()}")
+        with open(probe, "w"):
+            pass
+        os.remove(probe)
+        return True
+    except OSError:
+        return False
+
+
+def check_output_path(path: str, *, key: str, kind: str = "file") -> bool:
+    """Probe ``path`` and warn (naming the config ``key``) when it is not
+    writable.  Returns True when the feature may proceed."""
+    ok = writable_dir(path) if kind == "dir" else writable_file(path)
+    if not ok:
+        log.warning(f"{key}={path!r} is not writable; {key} disabled "
+                    "for this run")
+    return ok

@@ -1,0 +1,442 @@
+"""Fault-tolerance tests (robustness/ — checkpoint/resume, numeric
+guards, cluster retry; docs/ROBUSTNESS.md).
+
+Covers the ISSUE-3 acceptance surface: kill-and-resume reproduces the
+uninterrupted run's model text bit-for-bit, ``nan_policy`` survives /
+fails-fast / halts as configured with telemetry counters, a corrupt
+newest checkpoint falls back to the previous valid one, and cluster
+startup failures retry with backoff while post-barrier failures fail
+fast with a named worker.  Fault-injection cases carry the ``fault``
+marker (filter with ``-m 'not fault'``).
+"""
+
+import contextlib
+import json
+import os
+import shutil
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.robustness import faults
+from lightgbm_tpu.robustness.checkpoint import (
+    CheckpointManager, checkpoint_dirs, load_latest_checkpoint,
+    validate_checkpoint)
+
+
+@contextlib.contextmanager
+def capture_logs():
+    from lightgbm_tpu.utils.log import get_verbosity, set_verbosity
+    msgs = []
+    prev = get_verbosity()
+    set_verbosity(0)  # a prior verbose=-1 Config must not mute warnings
+    lgb.register_logger(msgs.append)
+    try:
+        yield msgs
+    finally:
+        lgb.register_logger(None)
+        set_verbosity(prev)
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(150, 5))
+    y = (X[:, 0] - X[:, 1]
+         + rng.normal(scale=0.3, size=150) > 0).astype(np.float64)
+    Xv = rng.normal(size=(60, 5))
+    yv = (Xv[:, 0] - Xv[:, 1] > 0).astype(np.float64)
+    return X, y, Xv, yv
+
+
+def _params(**over):
+    p = {"objective": "binary", "num_leaves": 4, "min_data_in_leaf": 5,
+         "verbose": -1, "metric": ["binary_logloss"], "seed": 7}
+    p.update(over)
+    return p
+
+
+def _train(data, params, rounds, callbacks=None, resume=None):
+    X, y, Xv, yv = data
+    ds = lgb.Dataset(X, label=y)
+    rec = {}
+    bst = lgb.train(params, ds, num_boost_round=rounds,
+                    valid_sets=[ds.create_valid(Xv, label=yv)],
+                    valid_names=["v0"],
+                    callbacks=[lgb.record_evaluation(rec)]
+                    + list(callbacks or []), resume=resume)
+    return bst, rec
+
+
+# --------------------------------------------------------- checkpointing
+def test_checkpoint_layout_and_retention(data, tmp_path):
+    ck = str(tmp_path / "ck")
+    bst, _ = _train(data, _params(checkpoint_dir=ck, checkpoint_interval=2,
+                                  checkpoint_keep=2), 10)
+    names = sorted(os.listdir(ck))
+    assert names == ["ckpt_0000008", "ckpt_0000010"]  # keep=2 pruned 2..6
+    for it, path in checkpoint_dirs(ck):
+        ok, reason = validate_checkpoint(path)
+        assert ok, reason
+        manifest = json.load(open(os.path.join(path, "manifest.json")))
+        assert manifest["iteration"] == it
+        assert set(manifest["files"]) == {"model.txt", "state.npz",
+                                          "state.json"}
+    # the newest checkpoint round-trips as a standalone model
+    st = load_latest_checkpoint(ck)
+    assert st.iteration == 10
+    assert lgb.Booster(model_str=st.model_text).num_trees() == 10
+    assert len(st.history["v0"]["binary_logloss"]) == 10
+    assert bst.telemetry()["counters"]["checkpoints_written"] == 5
+
+
+def test_checkpoint_inspect_tool(data, tmp_path):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "checkpoint_inspect",
+        os.path.join(os.path.dirname(__file__), "..", "tools",
+                     "checkpoint_inspect.py"))
+    tool = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tool)
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert tool.main([str(empty)]) == 1
+    ck = str(tmp_path / "ck")
+    _train(data, _params(checkpoint_dir=ck, checkpoint_interval=3), 6)
+    assert tool.main([ck]) == 0
+    assert tool.main([ck, "--json"]) == 0
+    faults.corrupt_checkpoint(ck, "flip_byte")
+    assert tool.main([ck, "--verify"]) == 2
+
+
+def test_resume_with_empty_dir(data, tmp_path):
+    ck = str(tmp_path / "ck")
+    bst, _ = _train(data, _params(checkpoint_dir=ck), 4, resume="auto")
+    assert bst.num_trees() == 4
+
+
+def test_unwritable_checkpoint_dir_degrades(data):
+    with capture_logs() as msgs:
+        bst, _ = _train(data, _params(checkpoint_dir="/proc/nope/ck",
+                                      verbose=0), 3)
+    assert bst.num_trees() == 3
+    assert any("checkpoint_dir" in m and "not writable" in m for m in msgs)
+
+
+# ----------------------------------------------------- kill-and-resume
+@pytest.mark.fault
+def test_resume_equivalence(data, tmp_path):
+    """30 straight rounds vs 15-checkpointed + kill-at-17 + resume must
+    produce identical model text and eval history (ISSUE-3 acceptance:
+    bit-for-bit)."""
+    ck = str(tmp_path / "ck")
+    params = _params(checkpoint_dir=ck, checkpoint_interval=5)
+    with pytest.raises(faults.KillTraining):
+        _train(data, params, 30, callbacks=[faults.kill_training(17)])
+    # rounds 16-17 ran but were never checkpointed: newest survivor is 15
+    assert load_latest_checkpoint(ck).iteration == 15
+    resumed, rec_resumed = _train(data, params, 30, resume="auto")
+    text_resumed = resumed.model_to_string(num_iteration=-1)
+    # the straight run reuses the SAME checkpoint_dir value (it is
+    # recorded in the model's params trailer), wiped so it trains fresh
+    shutil.rmtree(ck)
+    straight, rec_straight = _train(data, params, 30)
+    assert straight.model_to_string(num_iteration=-1) == text_resumed
+    assert rec_straight == rec_resumed
+    assert resumed.num_trees() == 30
+    assert resumed.telemetry()["counters"]["checkpoint_resumes"] == 1
+
+
+@pytest.mark.fault
+def test_resume_callbacks_see_absolute_iterations(data, tmp_path):
+    """Resumed runs number callback iterations absolutely (begin = the
+    resume point), so early stopping / NumericHalt best_iteration counts
+    every tree in the model, not just the resumed segment's."""
+    ck = str(tmp_path / "ck")
+    params = _params(checkpoint_dir=ck, checkpoint_interval=5)
+    with pytest.raises(faults.KillTraining):
+        _train(data, params, 20, callbacks=[faults.kill_training(12)])
+    seen = []
+
+    def probe(env):
+        seen.append((env.iteration, env.begin_iteration,
+                     env.end_iteration))
+    bst, _ = _train(data, params, 20, callbacks=[probe], resume="auto")
+    assert seen[0] == (10, 10, 20)
+    assert seen[-1] == (19, 10, 20)
+    assert bst.num_trees() == 20
+
+
+@pytest.mark.fault
+def test_resume_preserves_early_stopping_state(data, tmp_path):
+    """The patience state is checkpointed: a resumed early-stopping run
+    stops at the same round with the same best_iteration as the
+    uninterrupted one (no re-bootstrap at the resume point)."""
+    ck = str(tmp_path / "ck")
+    params = _params(checkpoint_dir=ck, checkpoint_interval=2)
+    # min_delta=1.0 makes round 0 the permanent best: the straight run
+    # stops at round 3 (patience 3) with best_iteration=1
+    es = dict(stopping_rounds=3, verbose=False, min_delta=1.0)
+    straight, _ = _train(data, params, 10,
+                         callbacks=[lgb.early_stopping(**es)])
+    assert straight.best_iteration == 1
+    shutil.rmtree(ck)
+    with pytest.raises(faults.KillTraining):
+        _train(data, params, 10,
+               callbacks=[lgb.early_stopping(**es),
+                          faults.kill_training(1)])  # ckpt at round 2
+    resumed, _ = _train(data, params, 10,
+                        callbacks=[lgb.early_stopping(**es)],
+                        resume="auto")
+    # without the restored patience state the resumed callback would
+    # adopt round 2 as best and stop at round 5 with best_iteration=3
+    assert resumed.best_iteration == straight.best_iteration == 1
+    assert resumed.num_trees() == straight.num_trees()
+
+
+def test_cv_disables_checkpointing(data, tmp_path):
+    """cv()'s per-fold trains would interleave (and fresh-clear) one
+    directory — checkpoint_dir is dropped with a warning instead."""
+    X, y, _, _ = data
+    ck = str(tmp_path / "ck")
+    ds = lgb.Dataset(X, label=y)
+    with capture_logs() as msgs:
+        lgb.cv(_params(checkpoint_dir=ck, verbose=0), ds,
+               num_boost_round=2, nfold=2)
+    assert any("not supported inside cv" in m for m in msgs)
+    assert not os.path.exists(ck) or os.listdir(ck) == []
+
+
+def test_fresh_run_clears_stale_checkpoints(data, tmp_path):
+    """A from-scratch run into a dir holding a previous run's
+    checkpoints clears them (warned), so retention and a later resume
+    only ever see the active run."""
+    ck = str(tmp_path / "ck")
+    params = _params(checkpoint_dir=ck, checkpoint_interval=5, verbose=0)
+    _train(data, params, 10)                 # previous run: ckpts 5, 10
+    with capture_logs() as msgs:
+        _train(data, params, 5)              # new fresh run
+    assert sorted(os.listdir(ck)) == ["ckpt_0000005"]
+    assert any("previous run" in m for m in msgs)
+    assert load_latest_checkpoint(ck).iteration == 5
+
+
+@pytest.mark.fault
+def test_corrupt_newest_falls_back(data, tmp_path):
+    ck = str(tmp_path / "ck")
+    params = _params(checkpoint_dir=ck, checkpoint_interval=5, verbose=0)
+    _train(data, params, 10)
+    assert load_latest_checkpoint(ck).iteration == 10
+    faults.corrupt_checkpoint(ck, "truncate_model")
+    with capture_logs() as msgs:
+        st = load_latest_checkpoint(ck)
+    assert st.iteration == 5
+    assert any("skipping invalid checkpoint" in m and "ckpt_0000010" in m
+               for m in msgs)
+    # resume continues from the fallback checkpoint to the full target
+    bst, _ = _train(data, params, 12, resume="auto")
+    assert bst.num_trees() == 12
+    # every corruption mode is detected
+    for mode in ("garbage_manifest", "missing_state", "flip_byte"):
+        path = faults.corrupt_checkpoint(ck, mode)
+        ok, _ = validate_checkpoint(path)
+        assert not ok, mode
+    # JSON-valid but structurally wrong manifest: corruption, not a crash
+    with open(os.path.join(path, "manifest.json"), "w") as f:
+        json.dump({"iteration": 1, "files": ["model.txt"]}, f)
+    ok, reason = validate_checkpoint(path)
+    assert not ok and "malformed" in reason
+    assert load_latest_checkpoint(ck).iteration == 5  # still falls back
+
+
+@pytest.mark.fault
+def test_atomic_write_leaves_no_partial(data, tmp_path):
+    """A temp dir from an interrupted save is never mistaken for a
+    checkpoint."""
+    ck = tmp_path / "ck"
+    ck.mkdir()
+    (ck / ".tmp_ckpt_0000099_123").mkdir()  # simulated crash mid-write
+    assert checkpoint_dirs(str(ck)) == []
+    assert load_latest_checkpoint(str(ck)) is None
+
+
+# ------------------------------------------------------- numeric guards
+@pytest.mark.fault
+def test_nan_policy_skip_round(data):
+    with faults.poison_gradients(3):
+        bst, _ = _train(data, _params(nan_policy="skip_round", verbose=0), 8)
+    counters = bst.telemetry()["counters"]
+    assert counters["nan_rounds_skipped"] == 1
+    assert counters["nan_guard_trips"] == 1
+    assert bst.num_trees() == 7  # finished; the poisoned round grew nothing
+
+
+@pytest.mark.fault
+def test_nan_policy_raise_names_round(data):
+    with faults.poison_gradients(3):
+        with pytest.raises(lgb.LightGBMError, match="round 3"):
+            _train(data, _params(nan_policy="raise"), 8)
+
+
+@pytest.mark.fault
+def test_nan_policy_halt_keeps_best(data):
+    with faults.poison_gradients(3, mode="inf"):
+        bst, rec = _train(data, _params(nan_policy="halt_and_keep_best",
+                                        verbose=0), 8)
+    assert bst.num_trees() == 3          # rounds 0-2 kept
+    assert bst.best_iteration == 3
+    assert len(rec["v0"]["binary_logloss"]) == 3
+    assert bst.telemetry()["counters"]["nan_guard_halts"] == 1
+
+
+def test_nan_policy_validation():
+    with pytest.raises(lgb.LightGBMError, match="nan_policy"):
+        lgb.Config({"nan_policy": "explode"})
+
+
+def test_nan_policy_disables_fused(data):
+    """The guard is a host-side per-round check, so an active policy must
+    keep the classic loop (with nan_policy=none the same config fuses)."""
+    X, y, _, _ = data
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train(_params(tpu_split_batch=4), ds, num_boost_round=2)
+    assert bst._gbdt.supports_fused()
+    ds2 = lgb.Dataset(X, label=y)
+    bst2 = lgb.train(_params(tpu_split_batch=4, nan_policy="skip_round"),
+                     ds2, num_boost_round=2)
+    assert not bst2._gbdt.supports_fused()
+
+
+# -------------------------------------------------- model-file satellite
+def test_booster_missing_model_file_raises_clearly(tmp_path):
+    missing = str(tmp_path / "nope" / "model.txt")
+    with pytest.raises(lgb.LightGBMError) as ei:
+        lgb.Booster(model_file=missing)
+    assert missing in str(ei.value)
+    bad = tmp_path / "bad.txt"
+    bad.write_text("this is not a model\nat all\n")
+    with pytest.raises(lgb.LightGBMError) as ei:
+        lgb.Booster(model_file=str(bad))
+    assert str(bad) in str(ei.value)
+    # truncated tree block: wrapped, path named, no raw KeyError escape
+    trunc = tmp_path / "trunc.txt"
+    trunc.write_text("tree\nversion=v4\nnum_class=1\n\nTree=0\n")
+    with pytest.raises(lgb.LightGBMError) as ei:
+        lgb.Booster(model_file=str(trunc))
+    assert str(trunc) in str(ei.value)
+
+
+# ------------------------------------------------- shared path contract
+def test_shared_path_validation_helper(tmp_path):
+    from lightgbm_tpu.utils.paths import (check_output_path, writable_dir,
+                                          writable_file)
+    ok_file = str(tmp_path / "out.jsonl")
+    assert writable_file(ok_file)
+    assert not writable_file(str(tmp_path / "no" / "dir" / "out.jsonl"))
+    assert writable_dir(str(tmp_path / "fresh" / "nested"))
+    assert not writable_dir("/proc/nope/dir")
+    with capture_logs() as msgs:
+        assert not check_output_path("/proc/nope/x", key="trace_output")
+    assert any("trace_output" in m and "not writable" in m for m in msgs)
+
+
+# --------------------------------------------------------- cluster retry
+def test_cluster_timeout_resolution():
+    from lightgbm_tpu.parallel.cluster import _resolve_timeout
+    assert _resolve_timeout({}, None) == 3600.0
+    assert _resolve_timeout({"cluster_timeout_s": 42.5}, None) == 42.5
+    assert _resolve_timeout({"cluster_timeout_s": "120"}, None) == 120.0
+    assert _resolve_timeout({"cluster_timeout": 60}, None) == 60.0  # alias
+    assert _resolve_timeout({"cluster_timeout_s": 42.5}, 7.0) == 7.0
+    assert _resolve_timeout({"cluster_timeout_s": "bogus"}, None) == 3600.0
+
+
+@pytest.fixture(scope="module")
+def tiny_model_text(data):
+    X, y, _, _ = data
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train(_params(), ds, num_boost_round=2)
+    return bst.model_to_string(num_iteration=-1)
+
+
+def test_cluster_startup_failure_retries(data, tiny_model_text, monkeypatch):
+    from lightgbm_tpu.parallel import cluster
+    X, y, _, _ = data
+    attempts = []
+    sleeps = []
+
+    def fake_run_attempt(spec_paths, specs, tmp, timeout_s, window_s,
+                         attempt):
+        attempts.append(attempt)
+        if len(attempts) < 3:
+            return "startup", "worker 1 exited 1 before the startup barrier"
+        with open(specs[0]["out_path"], "w") as fh:
+            fh.write(tiny_model_text)
+        return "ok", None
+
+    monkeypatch.setattr(cluster, "_run_attempt", fake_run_attempt)
+    monkeypatch.setattr(time, "sleep", lambda s: sleeps.append(s))
+    with capture_logs() as msgs:
+        bst = cluster.launch(_params(verbose=0), X, y, num_boost_round=2,
+                             n_workers=2, startup_retries=2)
+    assert attempts == [0, 1, 2]
+    assert sleeps == [2.0, 4.0]            # bounded backoff
+    assert bst.num_trees() == 2
+    assert any("retrying" in m for m in msgs)
+
+
+def test_cluster_runtime_failure_fails_fast(data, monkeypatch):
+    from lightgbm_tpu.parallel import cluster
+    X, y, _, _ = data
+    attempts = []
+
+    def fake_run_attempt(spec_paths, specs, tmp, timeout_s, window_s,
+                         attempt):
+        attempts.append(attempt)
+        return "runtime", ("worker 1 exited 1 after the startup barrier; "
+                           "log tail:\nboom")
+
+    monkeypatch.setattr(cluster, "_run_attempt", fake_run_attempt)
+    with pytest.raises(lgb.LightGBMError, match="worker 1"):
+        cluster.launch(_params(), X, y, num_boost_round=2, n_workers=2,
+                       startup_retries=2)
+    assert attempts == [0]                 # no retry after the barrier
+
+
+def test_cluster_startup_exhaustion_names_worker(data, monkeypatch):
+    from lightgbm_tpu.parallel import cluster
+    X, y, _, _ = data
+
+    def fake_run_attempt(spec_paths, specs, tmp, timeout_s, window_s,
+                         attempt):
+        return "startup", ("workers [0, 1] never reached the startup "
+                           "barrier within 300 s\n--- worker 0 log tail "
+                           "---\nImportError: nope")
+
+    monkeypatch.setattr(cluster, "_run_attempt", fake_run_attempt)
+    monkeypatch.setattr(time, "sleep", lambda s: None)
+    with pytest.raises(lgb.LightGBMError) as ei:
+        cluster.launch(_params(), X, y, num_boost_round=2, n_workers=2,
+                       startup_retries=1)
+    msg = str(ei.value)
+    assert "2 startup attempts" in msg and "ImportError: nope" in msg
+
+
+# --------------------------------------------- manager unit behaviors
+def test_manager_save_failure_degrades(data, tmp_path, monkeypatch):
+    ck = str(tmp_path / "ck")
+    os.makedirs(ck)
+    X, y, _, _ = data
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train(_params(), ds, num_boost_round=2)
+    mgr = CheckpointManager(ck, interval=1, keep=2)
+    monkeypatch.setattr(CheckpointManager, "_write",
+                        lambda *a, **k: (_ for _ in ()).throw(
+                            OSError("disk full")))
+    with capture_logs() as msgs:
+        assert mgr.save(bst) is None
+        assert mgr.save(bst) is None       # warns once, never raises
+    assert sum("checkpoint save" in m for m in msgs) == 1
+    assert bst.telemetry()["counters"]["checkpoint_write_failures"] == 2

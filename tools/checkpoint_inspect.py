@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Inspect and verify lightgbm_tpu training checkpoints.
+
+    python tools/checkpoint_inspect.py <checkpoint_dir> [--verify]
+
+Prints one line per checkpoint under ``checkpoint_dir`` (newest first):
+iteration, wall-clock timestamp, model size, tree count, and an
+OK/INVALID verdict with the failure reason (manifest integrity: file
+presence, byte sizes, sha256 — robustness/checkpoint.py
+``validate_checkpoint``).
+
+Exit codes (CI-friendly):
+  0 — at least one checkpoint exists and the NEWEST one is valid,
+  1 — the directory holds no checkpoints at all,
+  2 — the newest checkpoint is invalid (resume would fall back to an
+      older one — or fail entirely when none validates).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from lightgbm_tpu.robustness.checkpoint import (  # noqa: E402
+    MODEL_NAME, checkpoint_dirs, read_manifest, validate_checkpoint)
+
+
+def inspect_dir(directory: str) -> int:
+    ckpts = checkpoint_dirs(directory)
+    if not ckpts:
+        print(f"no checkpoints under {directory}")
+        return 1
+    newest_ok = None
+    for it, path in ckpts:
+        ok, reason = validate_checkpoint(path)
+        if newest_ok is None:
+            newest_ok = ok
+        manifest = read_manifest(path) or {}
+        ts = manifest.get("unix_time")
+        when = time.strftime("%Y-%m-%d %H:%M:%S",
+                             time.localtime(ts)) if ts else "?"
+        mpath = os.path.join(path, MODEL_NAME)
+        msize = os.path.getsize(mpath) if os.path.exists(mpath) else 0
+        verdict = "OK" if ok else f"INVALID ({reason})"
+        print(f"iter={it:<8d} time={when}  model={msize:>9d}B  "
+              f"trees={manifest.get('num_trees', '?'):>5}  {verdict}  "
+              f"{os.path.basename(path)}")
+    return 0 if newest_ok else 2
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("checkpoint_dir")
+    ap.add_argument("--verify", action="store_true",
+                    help="exit nonzero unless the newest checkpoint "
+                         "validates (the default behavior; kept as an "
+                         "explicit flag for CI readability)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one JSON object per checkpoint instead of "
+                         "the human table")
+    args = ap.parse_args(argv)
+    if args.json:
+        ckpts = checkpoint_dirs(args.checkpoint_dir)
+        if not ckpts:
+            print(json.dumps({"checkpoints": 0}))
+            return 1
+        rc = 1
+        for i, (it, path) in enumerate(ckpts):
+            ok, reason = validate_checkpoint(path)
+            if i == 0:
+                rc = 0 if ok else 2
+            print(json.dumps({"iteration": it, "path": path, "valid": ok,
+                              "reason": reason,
+                              "manifest": read_manifest(path)}))
+        return rc
+    return inspect_dir(args.checkpoint_dir)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
