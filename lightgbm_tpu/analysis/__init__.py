@@ -1,0 +1,37 @@
+"""tpulint — JAX/TPU-aware static analysis for this codebase.
+
+Rule families (stable IDs; full catalog in docs/STATIC_ANALYSIS.md):
+
+  * ``TPU1xx`` — JAX/TPU hazards: host syncs inside jit, per-loop jit
+    closures, ``static_argnums`` misuse, float64 leakage into jitted
+    math, donated-buffer reuse, collectives inside rank branches.
+  * ``CFG2xx`` — config-registry contracts: every param read registered
+    in config.py, no dead registered keys, docs/Parameters.md in sync.
+  * ``OBS3xx`` — telemetry contracts: counter names declared once.
+  * ``LNT0xx`` — lint infrastructure (syntax errors, malformed/stale
+    suppressions).
+
+This package is deliberately **stdlib-only** and importable without the
+parent package: ``tools/tpulint.py`` loads it by file path so the tier-1
+lint gate never imports jax.  Keep it that way — no imports from
+``lightgbm_tpu`` proper, no numpy, no jax.
+
+Suppress a finding inline with ``# tpulint: disable=RULE[,RULE]`` on the
+offending line, or (intentional host syncs only) with a justified entry
+in ``tools/tpulint_suppressions.txt``.
+"""
+
+from . import contracts  # noqa: F401 — rule registration side effect
+from . import jaxrules   # noqa: F401 — rule registration side effect
+from .cli import build_rules, main
+from .core import (FileContext, LintRun, LintRunner, Rule, Violation,
+                   register_rule, registered_rules)
+from .reporters import (EXIT_ERROR, EXIT_FINDINGS, EXIT_OK, render_json,
+                        render_text)
+
+__all__ = [
+    "FileContext", "LintRun", "LintRunner", "Rule", "Violation",
+    "register_rule", "registered_rules", "build_rules", "main",
+    "render_json", "render_text", "EXIT_OK", "EXIT_FINDINGS",
+    "EXIT_ERROR",
+]

@@ -1,0 +1,14 @@
+"""``python -m lightgbm_tpu.analysis`` — tpulint entry point.
+
+Equivalent to ``python tools/tpulint.py`` (the tool script loads the
+same package by file path to avoid importing jax; this module-level
+entry point is for environments where the package import cost does not
+matter).
+"""
+
+import sys
+
+from .cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

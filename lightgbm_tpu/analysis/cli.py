@@ -1,0 +1,125 @@
+"""tpulint command line.
+
+    python tools/tpulint.py [paths...]          # jax-free file loader
+    python -m lightgbm_tpu.analysis [paths...]  # package entry point
+
+With no paths, lints ``lightgbm_tpu/`` under the repo root.  ``--format
+json`` emits the machine-readable report; exit codes follow the repo
+convention (0 clean, 1 findings, 2 usage/internal error).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from . import contracts  # noqa: F401 — registers CFG2xx/OBS3xx rules
+from . import jaxrules   # noqa: F401 — registers TPU1xx rules
+from .core import (LintRunner, SEVERITY_ERROR, SEVERITY_WARNING,
+                   registered_rules)
+from .reporters import (EXIT_ERROR, exit_code, render_json, render_text)
+
+#: diagnostics emitted by the runner/suppression machinery rather than a
+#: registered rule — still valid --select/--ignore targets
+_INFRA_IDS = {
+    "LNT002": "unparseable or unreadable source file",
+    "LNT003": "malformed suppression-file entry",
+    "LNT004": "stale suppression-file entry (matches nothing)",
+    "LNT005": "config.py _PARAMS is not a pure literal (registry "
+              "unloadable, CFG rules cannot run)",
+}
+
+
+def default_root() -> str:
+    # analysis/ lives at <root>/lightgbm_tpu/analysis
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def build_rules(select: Optional[List[str]] = None,
+                ignore: Optional[List[str]] = None):
+    rules = [cls() for cls in registered_rules()]
+    if select:
+        rules = [r for r in rules if r.id in select]
+    if ignore:
+        rules = [r for r in rules if r.id not in ignore]
+    return rules
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpulint", description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*",
+                    help="files/directories to lint (default: "
+                         "lightgbm_tpu/ under --root)")
+    ap.add_argument("--root", default=default_root(),
+                    help="repo root for relative paths, the config "
+                         "registry and docs (default: autodetected)")
+    ap.add_argument("--format", choices=("text", "json"), default="text",
+                    help="report format (default: text)")
+    ap.add_argument("--select", default="",
+                    help="comma-separated rule IDs to run exclusively")
+    ap.add_argument("--ignore", default="",
+                    help="comma-separated rule IDs to skip")
+    ap.add_argument("--suppressions", default=None,
+                    help="suppression file (default: "
+                         "tools/tpulint_suppressions.txt under --root)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for cls in registered_rules():
+            print(f"{cls.id}  {cls.severity:7s}  {cls.name}")
+            print(f"        {cls.description}")
+        for rid, desc in sorted(_INFRA_IDS.items()):
+            print(f"{rid}  infra    {desc}")
+        return 0
+
+    root = os.path.abspath(args.root)
+    paths = args.paths or [os.path.join(root, "lightgbm_tpu")]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"tpulint: no such path: {p}", file=sys.stderr)
+            return EXIT_ERROR
+    supp = args.suppressions
+    if supp is None:
+        supp = os.path.join(root, "tools", "tpulint_suppressions.txt")
+    select = [s.strip() for s in args.select.split(",") if s.strip()]
+    ignore = [s.strip() for s in args.ignore.split(",") if s.strip()]
+    # a typo here must not silently disable the gate (exit 0, 0 rules)
+    known_ids = {cls.id for cls in registered_rules()} | set(_INFRA_IDS)
+    unknown = [r for r in select + ignore if r not in known_ids]
+    if unknown:
+        print(f"tpulint: unknown rule id(s): {', '.join(unknown)} "
+              f"(see --list-rules)", file=sys.stderr)
+        return EXIT_ERROR
+    runner = LintRunner(build_rules(select or None, ignore or None),
+                        root=root, suppression_path=supp)
+    violations, stats = runner.run(paths)
+    # infra diagnostics (LNT0xx) bypass the rule registry, so --select/
+    # --ignore are honored here as a post-filter
+    if select or ignore:
+        violations = [v for v in violations
+                      if (not select or v.rule_id in select)
+                      and v.rule_id not in ignore]
+        stats["violations"] = len(violations)
+        stats["errors"] = sum(1 for v in violations
+                              if v.severity == SEVERITY_ERROR)
+        stats["warnings"] = sum(1 for v in violations
+                                if v.severity == SEVERITY_WARNING)
+        by_rule = {}
+        for v in violations:
+            by_rule[v.rule_id] = by_rule.get(v.rule_id, 0) + 1
+        stats["by_rule"] = dict(sorted(by_rule.items()))
+    if args.format == "json":
+        print(render_json(violations, stats))
+    else:
+        print(render_text(violations, stats))
+    return exit_code(violations)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,311 @@
+"""tpulint core: rule registry, file contexts, suppressions, runner.
+
+An AST-based lint framework purpose-built for this codebase.  Design
+constraints that shape everything here:
+
+  * **stdlib only** — the tier-1 gate must run in seconds on one CPU, so
+    no module in ``lightgbm_tpu/analysis/`` may import jax, numpy, or
+    anything from the parent package.  ``tools/tpulint.py`` loads this
+    package by file path precisely so that ``lightgbm_tpu/__init__``
+    (which imports jax) never runs.
+  * rules carry **stable IDs** (TPU1xx = JAX/TPU hazards, CFG2xx =
+    config-registry contracts, OBS3xx = telemetry contracts, LNT0xx =
+    lint-infrastructure diagnostics) so suppressions stay valid across
+    refactors.
+  * suppression is per-line (``# tpulint: disable=RULE[,RULE]``) or via a
+    checked-in suppression file whose every entry requires a
+    justification (see :class:`SuppressionFile`).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+SEVERITY_ERROR = "error"
+SEVERITY_WARNING = "warning"
+
+_SUPPRESS_RE = re.compile(r"#\s*tpulint:\s*disable=([A-Za-z0-9_,\s]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    rule_id: str
+    severity: str
+    path: str            # repo-relative path
+    line: int            # 1-based
+    col: int             # 0-based
+    message: str
+
+    def as_dict(self) -> Dict[str, object]:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"{self.rule_id} [{self.severity}] {self.message}")
+
+
+class FileContext:
+    """One parsed source file plus its per-line suppressions."""
+
+    def __init__(self, path: str, relpath: str, source: str,
+                 tree: ast.Module):
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self._suppressed: Dict[int, Set[str]] = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(line)
+            if m:
+                ids = {s.strip() for s in m.group(1).split(",") if s.strip()}
+                self._suppressed[i] = ids
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def is_suppressed(self, lineno: int, rule_id: str) -> bool:
+        ids = self._suppressed.get(lineno)
+        if ids is None:
+            return False
+        return rule_id in ids or "all" in ids
+
+
+class Rule:
+    """Base class for lint rules.
+
+    ``check(ctx)`` yields per-file violations; ``finalize(run)`` yields
+    cross-file violations once every file has been visited (used by the
+    registry/docs/counter cross-checks).
+    """
+
+    id: str = "LNT000"
+    name: str = "base"
+    severity: str = SEVERITY_ERROR
+    description: str = ""
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        return ()
+
+    def finalize(self, run: "LintRun") -> Iterable[Violation]:
+        return ()
+
+    def violation(self, ctx_or_path, line: int, col: int,
+                  message: str) -> Violation:
+        path = ctx_or_path.relpath if isinstance(ctx_or_path, FileContext) \
+            else str(ctx_or_path)
+        return Violation(self.id, self.severity, path, line, col, message)
+
+
+_RULE_CLASSES: List[type] = []
+
+
+def register_rule(cls: type) -> type:
+    """Class decorator adding a rule to the default registry."""
+    ids = [c.id for c in _RULE_CLASSES]
+    if cls.id in ids:
+        raise ValueError(f"duplicate rule id {cls.id}")
+    _RULE_CLASSES.append(cls)
+    return cls
+
+
+def registered_rules() -> List[type]:
+    return list(_RULE_CLASSES)
+
+
+@dataclasses.dataclass
+class SuppressionEntry:
+    rule_id: str
+    path_substr: str
+    line_substr: str
+    justification: str
+    lineno: int
+    used: bool = False
+
+    def matches(self, v: Violation, line_text: str) -> bool:
+        return (self.rule_id == v.rule_id
+                and self.path_substr in v.path
+                and self.line_substr in line_text)
+
+
+class SuppressionFile:
+    """Checked-in suppression list — intentional, justified exceptions.
+
+    Format (one entry per non-comment line, ``|``-separated)::
+
+        RULE_ID | path/substring | offending line substring | justification
+
+    Entries match by substring (not line number) so they survive
+    unrelated edits.  A missing justification or malformed entry is
+    itself reported (LNT003); entries that match nothing are reported as
+    stale (LNT004) so the file can only shrink, never rot.
+    """
+
+    def __init__(self, path: Optional[str]):
+        self.path = path
+        self.entries: List[SuppressionEntry] = []
+        self.errors: List[Violation] = []
+        if path and os.path.exists(path):
+            self._parse(path)
+
+    def _parse(self, path: str) -> None:
+        rel = os.path.basename(path)
+        with open(path) as fh:
+            for lineno, raw in enumerate(fh, start=1):
+                line = raw.strip()
+                if not line or line.startswith("#"):
+                    continue
+                parts = [p.strip() for p in line.split("|")]
+                if len(parts) != 4 or not all(parts):
+                    self.errors.append(Violation(
+                        "LNT003", SEVERITY_ERROR, rel, lineno, 0,
+                        "malformed suppression entry (need 'RULE | path | "
+                        "line substring | justification', all non-empty): "
+                        f"{line!r}"))
+                    continue
+                self.entries.append(SuppressionEntry(
+                    parts[0], parts[1], parts[2], parts[3], lineno))
+
+    def filter(self, violations: List[Violation],
+               line_text_for: Dict[Tuple[str, int], str]) -> List[Violation]:
+        kept = []
+        for v in violations:
+            text = line_text_for.get((v.path, v.line), "")
+            entry = next((e for e in self.entries if e.matches(v, text)),
+                         None)
+            if entry is not None:
+                entry.used = True
+            else:
+                kept.append(v)
+        return kept
+
+    def stale_entries(self) -> List[Violation]:
+        rel = os.path.basename(self.path) if self.path else "suppressions"
+        return [Violation("LNT004", SEVERITY_WARNING, rel, e.lineno, 0,
+                          f"stale suppression (matched nothing): "
+                          f"{e.rule_id} | {e.path_substr} | {e.line_substr}")
+                for e in self.entries if not e.used]
+
+
+class LintRun:
+    """State shared across files for one lint invocation — ``finalize``
+    rules read the per-file observations other rules recorded here."""
+
+    def __init__(self, root: str, input_paths: Sequence[str] = ()):
+        self.root = root
+        #: the lint invocation's path arguments (absolute) — whole-
+        #: package rules consult :meth:`covers` so a single-file lint
+        #: does not report package-wide "never used" false positives
+        self.input_paths: List[str] = [os.path.abspath(p)
+                                       for p in input_paths]
+        self.contexts: List[FileContext] = []
+        # free-form scratch space keyed by rule id (e.g. CFG202 collects
+        # every attribute/string-key read here during check())
+        self.scratch: Dict[str, object] = {}
+
+    def covers(self, path: str) -> bool:
+        """True when some input path contains (or is) ``path`` — i.e.
+        the run saw every file under it."""
+        target = os.path.abspath(path)
+        for p in self.input_paths:
+            if target == p or target.startswith(p + os.sep):
+                return True
+        return False
+
+
+def _iter_py_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    seen: Set[str] = set()
+
+    def add(path: str) -> None:
+        key = os.path.abspath(path)
+        if key not in seen:          # overlapping args lint a file once
+            seen.add(key)
+            out.append(path)
+
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                add(p)
+        else:
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d != "__pycache__"
+                                     and not d.startswith("."))
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        add(os.path.join(dirpath, fn))
+    return out
+
+
+class LintRunner:
+    def __init__(self, rules: Sequence[Rule], root: str,
+                 suppression_path: Optional[str] = None):
+        self.rules = list(rules)
+        self.root = os.path.abspath(root)
+        self.suppressions = SuppressionFile(suppression_path)
+
+    def run(self, paths: Sequence[str]) -> Tuple[List[Violation],
+                                                 Dict[str, object]]:
+        run = LintRun(self.root, input_paths=paths)
+        violations: List[Violation] = list(self.suppressions.errors)
+        files = _iter_py_files(paths)
+        for path in files:
+            rel = os.path.relpath(os.path.abspath(path), self.root)
+            try:
+                # tokenize.open honors PEP 263 coding cookies, so legal
+                # non-UTF-8 sources lint instead of crashing the gate
+                with tokenize.open(path) as fh:
+                    source = fh.read()
+                tree = ast.parse(source, filename=path)
+            except (OSError, UnicodeDecodeError) as e:
+                violations.append(Violation(
+                    "LNT002", SEVERITY_ERROR, rel, 1, 0,
+                    f"unreadable source: {e}"))
+                continue
+            except SyntaxError as e:
+                violations.append(Violation(
+                    "LNT002", SEVERITY_ERROR, rel, e.lineno or 1, 0,
+                    f"syntax error: {e.msg}"))
+                continue
+            ctx = FileContext(path, rel, source, tree)
+            run.contexts.append(ctx)
+            for rule in self.rules:
+                for v in rule.check(ctx):
+                    if not ctx.is_suppressed(v.line, v.rule_id):
+                        violations.append(v)
+        line_text: Dict[Tuple[str, int], str] = {}
+        for rule in self.rules:
+            for v in rule.finalize(run):
+                ctx = next((c for c in run.contexts if c.relpath == v.path),
+                           None)
+                if ctx is not None and ctx.is_suppressed(v.line, v.rule_id):
+                    continue
+                violations.append(v)
+        for ctx in run.contexts:
+            for i in range(1, len(ctx.lines) + 1):
+                line_text[(ctx.relpath, i)] = ctx.line_text(i)
+        violations = self.suppressions.filter(violations, line_text)
+        violations.extend(self.suppressions.stale_entries())
+        violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule_id))
+        stats: Dict[str, object] = {
+            "files_checked": len(files),
+            "violations": len(violations),
+            "errors": sum(1 for v in violations
+                          if v.severity == SEVERITY_ERROR),
+            "warnings": sum(1 for v in violations
+                            if v.severity == SEVERITY_WARNING),
+            "by_rule": {},
+        }
+        by_rule: Dict[str, int] = {}
+        for v in violations:
+            by_rule[v.rule_id] = by_rule.get(v.rule_id, 0) + 1
+        stats["by_rule"] = dict(sorted(by_rule.items()))
+        return violations, stats

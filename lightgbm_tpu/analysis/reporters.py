@@ -1,0 +1,49 @@
+"""tpulint reporters: text and JSON, with the shared CLI exit codes.
+
+Exit-code convention shared by every repo CLI (tools/_report.py mirrors
+these for trace_report / checkpoint_inspect):
+
+  * 0 — clean / healthy,
+  * 1 — findings (lint violations, invalid artifacts),
+  * 2 — usage or internal error (bad path, unparseable input).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Sequence
+
+from .core import Violation
+
+EXIT_OK = 0
+EXIT_FINDINGS = 1
+EXIT_ERROR = 2
+
+
+def render_text(violations: Sequence[Violation],
+                stats: Dict[str, object]) -> str:
+    lines = [v.render() for v in violations]
+    if violations:
+        lines.append("")
+    by_rule = stats.get("by_rule") or {}
+    summary = (f"tpulint: {stats['files_checked']} file(s), "
+               f"{stats['errors']} error(s), {stats['warnings']} "
+               f"warning(s)")
+    if by_rule:
+        summary += " [" + ", ".join(f"{k}:{v}" for k, v in
+                                    sorted(by_rule.items())) + "]"
+    lines.append(summary)
+    return "\n".join(lines)
+
+
+def render_json(violations: Sequence[Violation],
+                stats: Dict[str, object]) -> str:
+    return json.dumps({
+        "tool": "tpulint",
+        "violations": [v.as_dict() for v in violations],
+        "summary": stats,
+    }, indent=2, sort_keys=True)
+
+
+def exit_code(violations: Sequence[Violation]) -> int:
+    return EXIT_FINDINGS if violations else EXIT_OK

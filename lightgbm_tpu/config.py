@@ -258,8 +258,29 @@ _PARAMS: List[Tuple[str, Any, Tuple[str, ...], Tuple[Tuple[str, float], ...]]] =
     ("tpu_rows_per_block", 16384, (), ()),        # histogram kernel row tile
     ("tpu_leaf_hist", "masked", (), ()),          # per-leaf hist: masked|bucketed
     ("tpu_split_batch", 1, (), ((">", 0),)),      # splits per histogram pass; AUTO POLICY: unset at >=100k rows resolves to min(42, num_leaves-1)
-    ("tpu_donate_scores", True, (), ()),
 ]
+
+# Reference-LightGBM parameters this port ACCEPTS but never reads: they
+# exist so reference configs/sklearn kwargs parse cleanly, and their
+# values change nothing on the jax/TPU execution path (no row/col-wise
+# hist split, no CUDA device selection, no text-parser tuning; the
+# DATASET_BINDING_PARAMS members below are still consulted *as names*
+# for binding-change warnings, their values stay inert).  tpulint CFG202
+# reads this literal: a key listed here is exempt from dead-key
+# reporting, and gets re-flagged the moment code starts reading it (or
+# if it leaves _PARAMS) so the list cannot rot.
+_COMPAT_ONLY: Tuple[str, ...] = (
+    "device_type",
+    "num_threads",        # XLA owns threading; n_jobs accepted and dropped
+    "saved_feature_importance_type",  # model-file importance not ported
+    "force_col_wise", "force_row_wise",
+    "feature_contri",
+    "is_enable_sparse", "feature_pre_filter", "two_round", "ignore_column",
+    "precise_float_parser", "parser_config_file",
+    "predict_disable_shape_check",
+    "time_out",
+    "gpu_platform_id", "gpu_device_id", "gpu_use_dp", "num_gpu",
+)
 
 _CANONICAL: Dict[str, Any] = {name: default for name, default, _, _ in _PARAMS}
 _ALIASES: Dict[str, str] = {}

@@ -22,6 +22,36 @@ from __future__ import annotations
 import threading
 from typing import Dict, Optional
 
+#: Every telemetry counter name used anywhere in the package, declared
+#: once with a one-line meaning.  This is a lint contract (tpulint
+#: OBS301): bumping an undeclared name — or declaring one nothing bumps —
+#: fails `python tools/tpulint.py`.  Keys are parsed from this literal by
+#: AST, so keep it a plain ``str: str`` dict.  Gauges are not listed:
+#: their names are structural (memory sampling keys), not an API surface.
+COUNTERS: Dict[str, str] = {
+    "iterations": "boosting rounds executed (strict + fused paths)",
+    "strict_rounds": "rounds run on the strict per-tree update path",
+    "fused_rounds": "rounds run on the fused round-kernel fast path",
+    "trees_grown": "trees grown (k per round for multiclass)",
+    "hist_build_rounds": "histogram build passes dispatched",
+    "quantize_rounds": "rounds that quantized gradients before binning",
+    "hist_pool_fallbacks": "histogram-pool exhaustion -> rebuild fallbacks",
+    "batched_path_fallbacks": "batched-grower bailouts to the strict path",
+    "fused_runner_cache_hits": "fused round-runner compile-cache hits",
+    "fused_runner_cache_misses": "fused round-runner compile-cache misses",
+    "collective_allreduce_bytes_est":
+        "estimated bytes all-reduced across workers (data-parallel)",
+    "nan_guard_trips": "rounds where the numeric guard saw non-finite values",
+    "nan_guard_raises": "numeric-guard trips escalated to an exception",
+    "nan_rounds_skipped": "rounds dropped by nan_policy=skip_round",
+    "nan_guard_halts": "trainings halted by nan_policy=halt_and_keep_best",
+    "checkpoints_written": "checkpoints committed to checkpoint_dir",
+    "checkpoint_write_failures": "checkpoint writes that failed (warned)",
+    "checkpoint_resumes": "trainings resumed from a checkpoint",
+    "checkpoints_skipped_invalid":
+        "corrupt checkpoints skipped during resume scan",
+}
+
 
 class MetricsRegistry:
     __slots__ = ("_counters", "_gauges", "_lock")

@@ -1,0 +1,12 @@
+"""Toy registry with a dead key, a stale doc row, and a missing row."""
+
+_PARAMS = [
+    ("num_widgets", 8, ("widgets",), ((">", 0.0),)),
+    ("dead_knob", 1, (), ()),           # CFG202: nothing reads this
+    ("stale_doc_key", 2, (), ()),       # CFG203: docs row disagrees
+    ("undocumented_key", 3, (), ()),    # CFG203: no docs row at all
+]
+
+_COMPAT_ONLY = (
+    "ghost_compat",                     # CFG202: not registered above
+)

@@ -1,0 +1,11 @@
+"""Reads an unregistered key and bumps an undeclared counter."""
+from .obs.metrics import count_event
+
+
+def build(params, config):
+    n = params.get("num_widgets", 8)
+    mystery = params.get("unregistered_key")    # CFG201
+    lvl = config.stale_doc_key
+    depth = config.undocumented_key
+    count_event("undeclared_counter")           # OBS301
+    return n + mystery + lvl + depth

@@ -1,0 +1,3 @@
+COUNTERS = {
+    "never_bumped": "declared, but no instrumentation point bumps it",
+}

@@ -1,0 +1,11 @@
+"""Toy registry mirroring the real ``_PARAMS`` literal shape."""
+
+_PARAMS = [
+    ("num_widgets", 8, ("widgets",), ((">", 0.0),)),
+    ("gadget_rate", 0.5, (), ()),
+    ("legacy_knob", 1, (), ()),
+]
+
+_COMPAT_ONLY = (
+    "legacy_knob",
+)

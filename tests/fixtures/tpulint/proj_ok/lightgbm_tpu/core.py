@@ -1,0 +1,9 @@
+"""Reads every registered key; bumps the one declared counter."""
+from .obs.metrics import count_event
+
+
+def build(params, config):
+    n = params.get("num_widgets", 8)
+    rate = config.gadget_rate
+    count_event("widgets_built", n)
+    return n * rate

@@ -1,0 +1,3 @@
+COUNTERS = {
+    "widgets_built": "widgets assembled by core.build()",
+}

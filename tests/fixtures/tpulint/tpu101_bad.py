@@ -1,0 +1,10 @@
+"""TPU101 positive: host syncs on traced values inside a jitted region."""
+import jax
+import numpy as np
+
+
+@jax.jit
+def scale(x):
+    peak = x.max().item()        # device->host sync at trace time
+    host = np.asarray(x)         # materializes the traced array
+    return x * float(peak) / host.sum()

@@ -1,0 +1,14 @@
+"""TPU101 negative: statics may be concretized; host code may sync."""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def scale(x, n):
+    return x * float(n) + jnp.sum(x)     # n is static: a Python value
+
+
+def host_read(x):
+    return float(jnp.sum(x))             # outside jit: legitimate sync

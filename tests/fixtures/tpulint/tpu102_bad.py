@@ -1,0 +1,10 @@
+"""TPU102 positive: a fresh jit wrapper built every loop iteration."""
+import jax
+
+
+def train(xs):
+    out = []
+    for x in xs:
+        step = jax.jit(lambda v: v + 1)   # re-traces each pass
+        out.append(step(x))
+    return out

@@ -1,0 +1,11 @@
+"""TPU102 negative: the jitted callable is built once, outside loops."""
+import jax
+
+_step = jax.jit(lambda v: v + 1)
+
+
+def train(xs):
+    out = []
+    for x in xs:
+        out.append(_step(x))
+    return out

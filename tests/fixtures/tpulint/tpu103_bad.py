@@ -1,0 +1,10 @@
+"""TPU103 positive: statics that do not match the wrapped signature."""
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnums=(3,),
+                   static_argnames=("missing",))
+def kernel(x, y):
+    return x + y

@@ -1,0 +1,11 @@
+"""TPU103 negative: in-range static_argnums, real static_argnames
+(including a keyword-only parameter)."""
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnums=(1,),
+                   static_argnames=("flag",))
+def kernel(x, n, *, flag=False):
+    return x * n if flag else x

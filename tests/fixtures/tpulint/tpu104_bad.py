@@ -1,0 +1,10 @@
+"""TPU104 positive: float64 leakage inside jitted math."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def accumulate(x):
+    acc = jnp.zeros_like(x, dtype="float64")
+    return acc + x.astype(np.float64)

@@ -1,0 +1,13 @@
+"""TPU104 negative: f32 inside jit; f64 allowed on the host path."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def accumulate(x):
+    return jnp.zeros_like(x, dtype=jnp.float32) + x
+
+
+def host_sum(a):
+    return np.asarray(a, np.float64).sum()   # host eval precision

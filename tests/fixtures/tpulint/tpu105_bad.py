@@ -1,0 +1,9 @@
+"""TPU105 positive: a donated buffer read after the donating call."""
+import jax
+
+update = jax.jit(lambda buf, g: buf + g, donate_argnums=(0,))
+
+
+def apply(buf, g):
+    out = update(buf, g)
+    return out + buf        # buf's storage was donated to `update`

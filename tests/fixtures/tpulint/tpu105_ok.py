@@ -1,0 +1,9 @@
+"""TPU105 negative: rebinding the donated name retires the old buffer."""
+import jax
+
+update = jax.jit(lambda buf, g: buf + g, donate_argnums=(0,))
+
+
+def apply(buf, g):
+    buf = update(buf, g)    # rebind: the donated name is never re-read
+    return buf

@@ -107,6 +107,7 @@ def test_checkpoint_inspect_tool(data, tmp_path):
     _train(data, _params(checkpoint_dir=ck, checkpoint_interval=3), 6)
     assert tool.main([ck]) == 0
     assert tool.main([ck, "--json"]) == 0
+    assert tool.main([ck, "--format", "json"]) == 0
     faults.corrupt_checkpoint(ck, "flip_byte")
     assert tool.main([ck, "--verify"]) == 2
 

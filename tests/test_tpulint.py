@@ -1,0 +1,486 @@
+"""tpulint static-analysis gate (tier-1).
+
+Loads ``lightgbm_tpu/analysis`` through ``tools/tpulint.py``'s file-path
+loader — the same code path CI uses — so the lint gate itself never
+imports jax or the parent package.  Covers:
+
+  * every lint rule with one triggering and one non-triggering fixture
+    (``tests/fixtures/tpulint/``),
+  * the contract rules against toy registry projects (both directions
+    of the code <-> config.py <-> docs/Parameters.md cross-check),
+  * the end-to-end gate: the package tree lints clean,
+  * suppression machinery (inline, file, stale, malformed),
+  * CLI exit codes and the shared ``--format json`` report surface.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.lint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "tpulint")
+SUPPRESSIONS = os.path.join(REPO, "tools", "tpulint_suppressions.txt")
+
+
+def _load_tool():
+    path = os.path.join(REPO, "tools", "tpulint.py")
+    spec = importlib.util.spec_from_file_location("tpulint_tool", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+TOOL = _load_tool()
+ANALYSIS = TOOL.load_analysis()
+
+
+def lint(paths, root, suppressions=None, select=None):
+    runner = ANALYSIS.LintRunner(
+        ANALYSIS.build_rules(select=select), root=root,
+        suppression_path=suppressions)
+    return runner.run(paths if isinstance(paths, list) else [paths])
+
+
+def rule_ids(violations):
+    return {v.rule_id for v in violations}
+
+
+# ------------------------------------------------------------ rule fixtures
+RULE_FIXTURES = [
+    ("TPU101", "tpu101_bad.py", "tpu101_ok.py"),
+    ("TPU102", "tpu102_bad.py", "tpu102_ok.py"),
+    ("TPU103", "tpu103_bad.py", "tpu103_ok.py"),
+    ("TPU104", "tpu104_bad.py", "tpu104_ok.py"),
+    ("TPU105", "tpu105_bad.py", "tpu105_ok.py"),
+    ("TPU106", "parallel/tpu106_bad.py", "parallel/tpu106_ok.py"),
+]
+
+
+@pytest.mark.parametrize("rule_id,bad,ok", RULE_FIXTURES,
+                         ids=[r for r, _, _ in RULE_FIXTURES])
+def test_rule_fires_on_bad_fixture(rule_id, bad, ok):
+    violations, _ = lint(os.path.join(FIXTURES, bad), root=FIXTURES)
+    assert rule_id in rule_ids(violations), \
+        f"{rule_id} did not fire on {bad}: {violations}"
+    # no OTHER rule may fire either — fixtures are single-hazard
+    assert rule_ids(violations) == {rule_id}
+
+
+@pytest.mark.parametrize("rule_id,bad,ok", RULE_FIXTURES,
+                         ids=[r for r, _, _ in RULE_FIXTURES])
+def test_rule_quiet_on_ok_fixture(rule_id, bad, ok):
+    violations, _ = lint(os.path.join(FIXTURES, ok), root=FIXTURES)
+    assert violations == [], \
+        f"false positive(s) on {ok}: {violations}"
+
+
+def test_tpu105_single_report_per_read():
+    violations, _ = lint(os.path.join(FIXTURES, "tpu105_bad.py"),
+                         root=FIXTURES)
+    assert len([v for v in violations if v.rule_id == "TPU105"]) == 1
+
+
+def test_tpu105_plain_call_to_wrapped_fn_is_clean(tmp_path):
+    """Only the BOUND wrapper donates — calling the original un-jitted
+    function must not be flagged."""
+    f = tmp_path / "plain.py"
+    f.write_text(
+        "import jax\n\n"
+        "def g(buf, grad):\n    return buf + grad\n\n"
+        "step = jax.jit(g, donate_argnums=(0,))\n\n"
+        "def debug(buf, grad):\n"
+        "    out = g(buf, grad)   # plain call: nothing donated\n"
+        "    return out + buf\n")
+    violations, _ = lint(str(f), root=str(tmp_path))
+    assert violations == [], violations
+
+
+def test_tpu105_redonation_after_rebind_still_fires(tmp_path):
+    """A safe rebind must not mask a LATER donation of the same name."""
+    f = tmp_path / "redonate.py"
+    f.write_text(
+        "import jax\n\n"
+        "def g(buf, grad):\n    return buf + grad\n\n"
+        "step = jax.jit(g, donate_argnums=(0,))\n\n"
+        "def apply(x, g):\n"
+        "    x = step(x, g)     # donate + rebind: safe\n"
+        "    y = step(x, g)     # donates the NEW x\n"
+        "    return x + y       # reads the donated x\n")
+    violations, _ = lint(str(f), root=str(tmp_path))
+    tpu105 = [v for v in violations if v.rule_id == "TPU105"]
+    assert len(tpu105) == 1 and tpu105[0].line == 11, violations
+
+
+def test_tpu102_partial_jit_in_loop_fires(tmp_path):
+    f = tmp_path / "partial_loop.py"
+    f.write_text(
+        "from functools import partial\n"
+        "import jax\n\n"
+        "def train(xs, step):\n"
+        "    for x in xs:\n"
+        "        f = partial(jax.jit, static_argnums=(1,))(step)\n"
+        "        f(x, 2)\n")
+    violations, _ = lint(str(f), root=str(tmp_path))
+    assert any(v.rule_id == "TPU102" for v in violations), violations
+
+
+# -------------------------------------------------------- contract projects
+def test_contract_rules_fire_on_bad_project():
+    root = os.path.join(FIXTURES, "proj_bad")
+    violations, _ = lint([root], root=root)
+    by_rule = {}
+    for v in violations:
+        by_rule.setdefault(v.rule_id, []).append(v)
+    # CFG201: one unregistered read
+    assert len(by_rule["CFG201"]) == 1
+    assert "unregistered_key" in by_rule["CFG201"][0].message
+    # CFG202: dead_knob never read + ghost_compat marker unregistered
+    msgs = " / ".join(v.message for v in by_rule["CFG202"])
+    assert len(by_rule["CFG202"]) == 2
+    assert "dead_knob" in msgs and "ghost_compat" in msgs
+    # CFG203: stale row, missing row, documented-but-unregistered
+    msgs = " / ".join(v.message for v in by_rule["CFG203"])
+    assert len(by_rule["CFG203"]) == 3
+    assert "stale_doc_key" in msgs
+    assert "undocumented_key" in msgs
+    assert "ghost_param" in msgs
+    # OBS301: bumped-undeclared + declared-unbumped
+    msgs = " / ".join(v.message for v in by_rule["OBS301"])
+    assert len(by_rule["OBS301"]) == 2
+    assert "undeclared_counter" in msgs and "never_bumped" in msgs
+
+
+def test_contract_rules_quiet_on_ok_project():
+    root = os.path.join(FIXTURES, "proj_ok")
+    violations, _ = lint([root], root=root)
+    assert violations == [], violations
+
+
+def test_compat_only_entry_that_is_read_is_flagged(tmp_path):
+    proj = tmp_path / "lightgbm_tpu"
+    proj.mkdir()
+    (proj / "config.py").write_text(
+        '_PARAMS = [("knob", 1, (), ())]\n_COMPAT_ONLY = ("knob",)\n')
+    (proj / "user.py").write_text(
+        "def f(config):\n    return config.knob\n")
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "Parameters.md").write_text(
+        "| Parameter | Default | Aliases | Constraints |\n|---|---|\n"
+        "| `knob` | 1 | — | — |\n")
+    violations, _ = lint([str(tmp_path)], root=str(tmp_path))
+    assert any(v.rule_id == "CFG202" and "no longer inert" in v.message
+               for v in violations), violations
+
+
+def test_single_file_lint_has_no_package_scope_fps():
+    """Linting one package file must not fire the package-wide 'never
+    used anywhere' directions (CFG202 / OBS301-unbumped)."""
+    for rel in ("lightgbm_tpu/config.py", "lightgbm_tpu/boosting/gbdt.py"):
+        violations, _ = lint(os.path.join(REPO, rel), root=REPO,
+                             suppressions=SUPPRESSIONS)
+        assert violations == [], \
+            f"{rel}: " + "\n".join(v.render() for v in violations)
+
+
+def test_duplicate_path_args_lint_once():
+    f = os.path.join(FIXTURES, "tpu101_bad.py")
+    once, stats1 = lint([f], root=FIXTURES)
+    twice, stats2 = lint([f, FIXTURES + "/tpu101_bad.py", f],
+                         root=FIXTURES)
+    assert len(twice) == len(once)
+    assert stats2["files_checked"] == stats1["files_checked"] == 1
+
+
+def test_unloadable_registry_fails_loudly(tmp_path):
+    proj = tmp_path / "lightgbm_tpu"
+    proj.mkdir()
+    (proj / "config.py").write_text(
+        "_BASE = [('a', 1, (), ())]\n_PARAMS = _BASE + [('b', 2, (), ())]\n")
+    (proj / "user.py").write_text(
+        "def f(params):\n    return params.get('totally_unknown')\n")
+    violations, _ = lint([str(tmp_path)], root=str(tmp_path))
+    assert any(v.rule_id == "LNT005" for v in violations), violations
+
+
+def test_tpu104_complex128(tmp_path):
+    f = tmp_path / "c128.py"
+    f.write_text("import jax\nimport jax.numpy as jnp\n\n"
+                 "@jax.jit\ndef f(x):\n"
+                 "    return x.astype(jnp.complex128)\n")
+    violations, _ = lint(str(f), root=str(tmp_path))
+    assert any(v.rule_id == "TPU104" for v in violations), violations
+
+
+# ------------------------------------------------------------- e2e package
+def test_package_tree_lints_clean():
+    """The tier-1 gate: zero unsuppressed violations over the package."""
+    violations, stats = lint([os.path.join(REPO, "lightgbm_tpu")],
+                             root=REPO, suppressions=SUPPRESSIONS)
+    assert violations == [], "\n".join(v.render() for v in violations)
+    assert stats["files_checked"] > 50
+
+
+def test_every_registered_rule_has_a_fixture():
+    """Adding a rule without fixture coverage fails here."""
+    covered = {r for r, _, _ in RULE_FIXTURES} | {
+        "CFG201", "CFG202", "CFG203", "OBS301"}
+    for cls in ANALYSIS.registered_rules():
+        assert cls.id in covered, \
+            f"rule {cls.id} ({cls.name}) has no fixture test"
+
+
+# ------------------------------------------------------------- suppressions
+def test_inline_suppression(tmp_path):
+    src = (FIXTURES + "/tpu104_bad.py")
+    text = open(src).read().replace(
+        'dtype="float64")', 'dtype="float64")  # tpulint: disable=TPU104')
+    f = tmp_path / "suppressed.py"
+    f.write_text(text)
+    violations, _ = lint(str(f), root=str(tmp_path))
+    # the astype(np.float64) on the next line still fires
+    assert len([v for v in violations if v.rule_id == "TPU104"]) == 1
+
+
+def test_suppression_file_hides_justified_entry(tmp_path):
+    f = tmp_path / "code.py"
+    f.write_text("import jax\n\n@jax.jit\ndef f(x):\n"
+                 "    return float(x)\n")
+    supp = tmp_path / "supp.txt"
+    supp.write_text("TPU101 | code.py | float(x) | intentional: host "
+                    "debug probe\n")
+    violations, _ = lint(str(f), root=str(tmp_path),
+                         suppressions=str(supp))
+    assert violations == []
+
+
+def test_suppression_file_stale_and_malformed(tmp_path):
+    f = tmp_path / "clean.py"
+    f.write_text("x = 1\n")
+    supp = tmp_path / "supp.txt"
+    supp.write_text("TPU101 | nowhere.py | nothing | obsolete\n"
+                    "TPU101 | missing-fields\n")
+    violations, _ = lint(str(f), root=str(tmp_path),
+                         suppressions=str(supp))
+    ids = sorted(v.rule_id for v in violations)
+    assert ids == ["LNT003", "LNT004"]
+
+
+def test_syntax_error_reported(tmp_path):
+    f = tmp_path / "broken.py"
+    f.write_text("def f(:\n")
+    violations, _ = lint(str(f), root=str(tmp_path))
+    assert [v.rule_id for v in violations] == ["LNT002"]
+
+
+def test_non_utf8_source_lints_not_crashes(tmp_path):
+    """PEP 263 coding cookies are honored; garbage bytes become LNT002
+    instead of an uncaught UnicodeDecodeError."""
+    legal = tmp_path / "latin.py"
+    legal.write_bytes(b"# -*- coding: latin-1 -*-\n# caf\xe9\nx = 1\n")
+    violations, _ = lint(str(legal), root=str(tmp_path))
+    assert violations == [], violations
+    garbage = tmp_path / "garbage.py"
+    garbage.write_bytes(b"\xff\xfe\x00broken")
+    violations, _ = lint(str(garbage), root=str(tmp_path))
+    assert [v.rule_id for v in violations] == ["LNT002"]
+
+
+def test_tpu101_shape_derived_scalars_are_clean(tmp_path):
+    """float(x.shape[0]) and scalars derived from it are static under
+    trace — the standard JAX idiom must not be flagged."""
+    f = tmp_path / "shapes.py"
+    f.write_text(
+        "import jax\n\n"
+        "@jax.jit\n"
+        "def normalize(x):\n"
+        "    n = x.shape[0]\n"
+        "    return x * (1.0 / float(n)) + float(x.shape[1]) \\\n"
+        "        + int(x.ndim) + float(len(x))\n")
+    violations, _ = lint(str(f), root=str(tmp_path))
+    assert violations == [], violations
+
+
+def test_tpu105_same_statement_read_after_donation(tmp_path):
+    f = tmp_path / "samestmt.py"
+    f.write_text(
+        "import jax\n\n"
+        "def g(buf, grad):\n    return buf + grad\n\n"
+        "step = jax.jit(g, donate_argnums=(0,))\n\n"
+        "def apply(x, g):\n"
+        "    return step(x, g) + x   # reads x after donating it\n")
+    violations, _ = lint(str(f), root=str(tmp_path))
+    assert any(v.rule_id == "TPU105" for v in violations), violations
+
+
+# ------------------------------------------------------------ CLI surface
+def test_cli_exit_codes_and_json(capsys):
+    rc = TOOL.main([os.path.join(FIXTURES, "tpu101_bad.py"),
+                    "--root", FIXTURES, "--format", "json"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    doc = json.loads(out)
+    assert doc["tool"] == "tpulint"
+    assert doc["summary"]["errors"] >= 1
+    assert any(v["rule_id"] == "TPU101" for v in doc["violations"])
+
+    rc = TOOL.main([os.path.join(FIXTURES, "tpu101_ok.py"),
+                    "--root", FIXTURES])
+    capsys.readouterr()
+    assert rc == 0
+
+    rc = TOOL.main(["--list-rules"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "TPU101" in out and "CFG203" in out
+
+    rc = TOOL.main([os.path.join(FIXTURES, "no_such_file.py")])
+    capsys.readouterr()
+    assert rc == 2
+
+
+def test_cli_select_and_ignore(capsys):
+    bad = os.path.join(FIXTURES, "tpu104_bad.py")
+    rc = TOOL.main([bad, "--root", FIXTURES, "--select", "TPU101"])
+    capsys.readouterr()
+    assert rc == 0            # only TPU104 hazards in that file
+    rc = TOOL.main([bad, "--root", FIXTURES, "--ignore", "TPU104"])
+    capsys.readouterr()
+    assert rc == 0
+    # a typo must not silently disable the gate
+    rc = TOOL.main([bad, "--root", FIXTURES, "--select", "TPU1O4"])
+    capsys.readouterr()
+    assert rc == 2
+
+
+def test_cli_ignore_covers_infra_diagnostics(tmp_path, capsys):
+    """LNT0xx ids are emitted by the runner, not a registered rule —
+    --ignore/--select must still accept and honor them."""
+    f = tmp_path / "clean.py"
+    f.write_text("x = 1\n")
+    supp = tmp_path / "supp.txt"
+    supp.write_text("TPU101 | nowhere.py | nothing | obsolete\n")
+    args = [str(f), "--root", str(tmp_path),
+            "--suppressions", str(supp)]
+    rc = TOOL.main(args)
+    capsys.readouterr()
+    assert rc == 1                      # stale entry -> LNT004
+    rc = TOOL.main(args + ["--ignore", "LNT004"])
+    capsys.readouterr()
+    assert rc == 0
+    rc = TOOL.main(["--list-rules"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "LNT004" in out
+
+
+def test_runner_reuse_does_not_leak_state():
+    """A second run() on the same LintRunner must not inherit the first
+    run's counter uses (OBS301) or param reads."""
+    root = os.path.join(FIXTURES, "proj_bad")
+    runner = ANALYSIS.LintRunner(ANALYSIS.build_rules(), root=root)
+    first, _ = runner.run([root])
+    second, _ = runner.run([root])
+    assert [v.render() for v in first] == [v.render() for v in second]
+
+
+def test_gate_runs_without_jax(tmp_path):
+    """CI contract: the lint gate must work with jax unimportable."""
+    script = (
+        "import sys\n"
+        "sys.modules['jax'] = None  # poison: import jax would fail\n"
+        "sys.modules['numpy'] = None\n"
+        f"sys.path.insert(0, {os.path.join(REPO, 'tools')!r})\n"
+        "import tpulint\n"
+        f"rc = tpulint.main(['--root', {REPO!r}])\n"
+        "sys.exit(rc)\n"
+    )
+    p = subprocess.run([sys.executable, "-c", script],
+                       capture_output=True, text=True,
+                       env={**os.environ, "PYTHONPATH": ""})
+    assert p.returncode == 0, p.stdout + p.stderr
+
+
+# -------------------------------------------- shared report/exit contract
+def _load_by_path(name, relpath):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, relpath))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_exit_codes_consistent_across_clis():
+    report = _load_by_path("_report", "tools/_report.py")
+    assert (report.EXIT_OK, report.EXIT_FINDINGS, report.EXIT_ERROR) \
+        == (0, 1, 2)
+    assert ANALYSIS.EXIT_OK == report.EXIT_OK
+    assert ANALYSIS.EXIT_FINDINGS == report.EXIT_FINDINGS
+    assert ANALYSIS.EXIT_ERROR == report.EXIT_ERROR
+
+
+def test_doc_row_renderer_matches_generator():
+    """CFG203's row renderer must stay byte-identical to
+    config.generate_parameter_docs — drift would flag every row stale
+    with regeneration advice that fixes nothing."""
+    from lightgbm_tpu.config import generate_parameter_docs
+    contracts = ANALYSIS.contracts
+    reg = contracts.load_registry(
+        os.path.join(REPO, "lightgbm_tpu", "config.py"))
+    expected = contracts.render_param_rows(reg)
+    generated = {}
+    for line in generate_parameter_docs().splitlines():
+        if line.startswith("## Objective aliases"):
+            break
+        m = contracts._DOC_ROW_RE.match(line)
+        if m and m.group(1) != "Parameter":
+            generated[m.group(1)] = line
+    assert generated == expected
+
+
+def test_trace_report_json_and_exit_codes(tmp_path, capsys):
+    tr = _load_by_path("trace_report", "tools/trace_report.py")
+    good = tmp_path / "trace.json"
+    good.write_text(json.dumps({"traceEvents": [
+        {"ph": "X", "name": "tree_growth", "dur": 1000.0, "ts": 0},
+        {"ph": "C", "name": "memory", "args": {"host_rss_mb": 42.0}},
+    ]}))
+    rc = tr.main([str(good), "--format", "json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert doc["tool"] == "trace_report"
+    assert doc["phases"][0]["name"] == "tree_growth"
+    assert doc["memory_high_water"]["host_rss_mb"] == 42.0
+
+    empty = tmp_path / "empty.json"
+    empty.write_text(json.dumps({"traceEvents": []}))
+    rc = tr.main([str(empty)])
+    capsys.readouterr()
+    assert rc == 1
+
+    bad = tmp_path / "bad.json"
+    bad.write_text("not json")
+    rc = tr.main([str(bad)])
+    capsys.readouterr()
+    assert rc == 2
+
+    # valid JSON that is not a trace container must also be exit 2
+    for payload in ("null", "42", "true"):
+        f = tmp_path / "scalar.json"
+        f.write_text(payload)
+        rc = tr.main([str(f)])
+        capsys.readouterr()
+        assert rc == 2, payload
+
+
+def test_dunder_main_import_is_inert():
+    """Importing the module (plugin scans, autodoc) must not run the
+    lint or SystemExit; only `python -m` executes it."""
+    import importlib
+    mod = importlib.import_module("lightgbm_tpu.analysis.__main__")
+    assert hasattr(mod, "main")

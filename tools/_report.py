@@ -1,0 +1,41 @@
+"""Shared report emission for the repo CLIs.
+
+All three tools (tpulint, trace_report, checkpoint_inspect) speak the
+same ``--format {text,json}`` surface and the same exit-code
+convention so CI can drive any of them uniformly:
+
+  * ``EXIT_OK`` (0)       — clean / healthy,
+  * ``EXIT_FINDINGS`` (1) — the tool found something actionable (lint
+    violations, an empty checkpoint directory),
+  * ``EXIT_ERROR`` (2)    — unusable input or an invalid newest
+    artifact (unparseable trace, corrupt newest checkpoint).
+
+JSON output is a single object on stdout with a ``tool`` tag so piped
+consumers can dispatch on it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from typing import Any, Callable, Dict
+
+EXIT_OK = 0
+EXIT_FINDINGS = 1
+EXIT_ERROR = 2
+
+
+def add_format_arg(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text",
+                        help="report format (default: text)")
+
+
+def emit(payload: Dict[str, Any], fmt: str,
+         text_renderer: Callable[[Dict[str, Any]], str]) -> None:
+    """Print ``payload`` as JSON, or through ``text_renderer`` for the
+    human view.  The payload must already carry a ``tool`` tag."""
+    if fmt == "json":
+        print(json.dumps(payload, indent=2, sort_keys=True, default=str))
+    else:
+        print(text_renderer(payload))

@@ -2,6 +2,7 @@
 """Inspect and verify lightgbm_tpu training checkpoints.
 
     python tools/checkpoint_inspect.py <checkpoint_dir> [--verify]
+                                       [--format text|json]
 
 Prints one line per checkpoint under ``checkpoint_dir`` (newest first):
 iteration, wall-clock timestamp, model size, tree count, and an
@@ -9,7 +10,7 @@ OK/INVALID verdict with the failure reason (manifest integrity: file
 presence, byte sizes, sha256 — robustness/checkpoint.py
 ``validate_checkpoint``).
 
-Exit codes (CI-friendly):
+Exit codes (tools/_report.py convention):
   0 — at least one checkpoint exists and the NEWEST one is valid,
   1 — the directory holds no checkpoints at all,
   2 — the newest checkpoint is invalid (resume would fall back to an
@@ -19,39 +20,68 @@ Exit codes (CI-friendly):
 from __future__ import annotations
 
 import argparse
-import json
 import os
 import sys
 import time
+from typing import Any, Dict
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
+from _report import (EXIT_ERROR, EXIT_FINDINGS, EXIT_OK,  # noqa: E402
+                     add_format_arg, emit)
 from lightgbm_tpu.robustness.checkpoint import (  # noqa: E402
     MODEL_NAME, checkpoint_dirs, read_manifest, validate_checkpoint)
 
 
-def inspect_dir(directory: str) -> int:
-    ckpts = checkpoint_dirs(directory)
-    if not ckpts:
-        print(f"no checkpoints under {directory}")
-        return 1
-    newest_ok = None
-    for it, path in ckpts:
+def build_report(directory: str) -> Dict[str, Any]:
+    """Payload for one checkpoint directory (newest first)."""
+    entries = []
+    for it, path in checkpoint_dirs(directory):
         ok, reason = validate_checkpoint(path)
-        if newest_ok is None:
-            newest_ok = ok
         manifest = read_manifest(path) or {}
-        ts = manifest.get("unix_time")
+        mpath = os.path.join(path, MODEL_NAME)
+        entries.append({
+            "iteration": it,
+            "path": path,
+            "valid": ok,
+            "reason": reason,
+            "unix_time": manifest.get("unix_time"),
+            "model_bytes": os.path.getsize(mpath)
+            if os.path.exists(mpath) else 0,
+            "num_trees": manifest.get("num_trees"),
+            "manifest": manifest,
+        })
+    return {
+        "tool": "checkpoint_inspect",
+        "directory": directory,
+        "checkpoints": entries,
+        "newest_valid": entries[0]["valid"] if entries else None,
+    }
+
+
+def _render_report(payload: Dict[str, Any]) -> str:
+    entries = payload["checkpoints"]
+    if not entries:
+        return f"no checkpoints under {payload['directory']}"
+    lines = []
+    for e in entries:
+        ts = e["unix_time"]
         when = time.strftime("%Y-%m-%d %H:%M:%S",
                              time.localtime(ts)) if ts else "?"
-        mpath = os.path.join(path, MODEL_NAME)
-        msize = os.path.getsize(mpath) if os.path.exists(mpath) else 0
-        verdict = "OK" if ok else f"INVALID ({reason})"
-        print(f"iter={it:<8d} time={when}  model={msize:>9d}B  "
-              f"trees={manifest.get('num_trees', '?'):>5}  {verdict}  "
-              f"{os.path.basename(path)}")
-    return 0 if newest_ok else 2
+        verdict = "OK" if e["valid"] else f"INVALID ({e['reason']})"
+        trees = e["num_trees"] if e["num_trees"] is not None else "?"
+        lines.append(f"iter={e['iteration']:<8d} time={when}  "
+                     f"model={e['model_bytes']:>9d}B  trees={trees!s:>5}  "
+                     f"{verdict}  {os.path.basename(e['path'])}")
+    return "\n".join(lines)
+
+
+def exit_code(payload: Dict[str, Any]) -> int:
+    if not payload["checkpoints"]:
+        return EXIT_FINDINGS
+    return EXIT_OK if payload["newest_valid"] else EXIT_ERROR
 
 
 def main(argv=None) -> int:
@@ -61,25 +91,16 @@ def main(argv=None) -> int:
                     help="exit nonzero unless the newest checkpoint "
                          "validates (the default behavior; kept as an "
                          "explicit flag for CI readability)")
+    add_format_arg(ap)
     ap.add_argument("--json", action="store_true",
-                    help="emit one JSON object per checkpoint instead of "
-                         "the human table")
+                    help="deprecated spelling of --format json (NOTE: "
+                         "output is one report object now, no longer "
+                         "one JSON line per checkpoint)")
     args = ap.parse_args(argv)
-    if args.json:
-        ckpts = checkpoint_dirs(args.checkpoint_dir)
-        if not ckpts:
-            print(json.dumps({"checkpoints": 0}))
-            return 1
-        rc = 1
-        for i, (it, path) in enumerate(ckpts):
-            ok, reason = validate_checkpoint(path)
-            if i == 0:
-                rc = 0 if ok else 2
-            print(json.dumps({"iteration": it, "path": path, "valid": ok,
-                              "reason": reason,
-                              "manifest": read_manifest(path)}))
-        return rc
-    return inspect_dir(args.checkpoint_dir)
+    payload = build_report(args.checkpoint_dir)
+    fmt = "json" if args.json else args.format
+    emit(payload, fmt, _render_report)
+    return exit_code(payload)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,50 @@
+#!/usr/bin/env python
+"""tpulint — JAX/TPU-aware static analysis over this repo (jax-free).
+
+    python tools/tpulint.py [paths...] [--format text|json]
+    python tools/tpulint.py --list-rules
+
+Loads ``lightgbm_tpu/analysis`` by FILE PATH (never importing
+``lightgbm_tpu/__init__``, which pulls in jax), so the whole lint gate
+is pure-stdlib AST work and runs in seconds on one CPU.  ``python -m
+lightgbm_tpu.analysis`` is the equivalent package entry point.
+
+Exit codes (tools/_report.py convention): 0 clean, 1 findings,
+2 usage/internal error.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_PKG_NAME = "_tpulint_analysis"
+
+
+def load_analysis():
+    """Import lightgbm_tpu/analysis as a standalone package.
+
+    The synthetic package name keeps relative imports inside analysis/
+    working while bypassing ``lightgbm_tpu/__init__`` entirely.
+    """
+    if _PKG_NAME in sys.modules:
+        return sys.modules[_PKG_NAME]
+    pkg_dir = os.path.join(REPO_ROOT, "lightgbm_tpu", "analysis")
+    spec = importlib.util.spec_from_file_location(
+        _PKG_NAME, os.path.join(pkg_dir, "__init__.py"),
+        submodule_search_locations=[pkg_dir])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[_PKG_NAME] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None) -> int:
+    analysis = load_analysis()
+    return analysis.main(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

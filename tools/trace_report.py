@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Summarize a Chrome trace JSON produced by ``trace_output=<path>``.
 
-    python tools/trace_report.py TRACE.json [--top N]
+    python tools/trace_report.py TRACE.json [--top N] [--format text|json]
 
 Prints the top phases by total time (total / count / avg / max), the
 span-tree depth, and — when the trace carries ``memory`` counter events
@@ -9,14 +9,24 @@ span-tree depth, and — when the trace carries ``memory`` counter events
 marks.  The numbers here are host wall-clock spans (dispatch + any host
 sync); use a ``profile_dir`` jax.profiler capture for device-side kernel
 attribution.
+
+Exit codes (tools/_report.py convention): 0 — trace has span events,
+1 — parseable but empty trace (no ``ph: X`` events), 2 — unreadable or
+not a Chrome trace.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from typing import Any, Dict, List
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from _report import (EXIT_ERROR, EXIT_FINDINGS, EXIT_OK,  # noqa: E402
+                     add_format_arg, emit)
 
 
 def load_trace(path: str) -> Dict[str, Any]:
@@ -28,7 +38,7 @@ def load_trace(path: str) -> Dict[str, Any]:
                              "trace session exported?") from e
     if isinstance(doc, list):          # bare event-array form is also legal
         doc = {"traceEvents": doc}
-    if "traceEvents" not in doc:
+    if not isinstance(doc, dict) or "traceEvents" not in doc:
         raise ValueError(f"{path}: not a Chrome trace (no traceEvents)")
     return doc
 
@@ -70,8 +80,21 @@ def memory_high_water(doc: Dict[str, Any]) -> Dict[str, float]:
     return high
 
 
-def render(doc: Dict[str, Any], top: int = 15) -> str:
-    rows = phase_stats(doc)
+def build_report(doc: Dict[str, Any], trace: str = "",
+                 top: int = 15) -> Dict[str, Any]:
+    """The full report payload (all phases — ``top`` only trims text)."""
+    return {
+        "tool": "trace_report",
+        "trace": trace,
+        "phases": phase_stats(doc),
+        "memory_high_water": memory_high_water(doc),
+        "top": top,
+    }
+
+
+def _render_report(payload: Dict[str, Any]) -> str:
+    rows = payload["phases"]
+    top = payload.get("top", 15)
     lines = []
     if not rows:
         lines.append("no complete (ph=X) span events in trace")
@@ -86,7 +109,7 @@ def render(doc: Dict[str, Any], top: int = 15) -> str:
         if len(rows) > top:
             lines.append(f"... {len(rows) - top} more phases "
                          f"(--top {len(rows)} for all)")
-    high = memory_high_water(doc)
+    high = payload["memory_high_water"]
     if high:
         lines.append("")
         lines.append("memory high-water marks:")
@@ -98,14 +121,26 @@ def render(doc: Dict[str, Any], top: int = 15) -> str:
     return "\n".join(lines)
 
 
+def render(doc: Dict[str, Any], top: int = 15) -> str:
+    """Back-compat helper: text report straight from a loaded trace."""
+    return _render_report(build_report(doc, top=top))
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("trace", help="Chrome trace JSON (trace_output=...)")
     ap.add_argument("--top", type=int, default=15,
                     help="phases to show (default 15)")
+    add_format_arg(ap)
     args = ap.parse_args(argv)
-    print(render(load_trace(args.trace), top=args.top))
-    return 0
+    try:
+        doc = load_trace(args.trace)
+    except (OSError, ValueError) as e:
+        print(f"trace_report: {e}", file=sys.stderr)
+        return EXIT_ERROR
+    payload = build_report(doc, trace=args.trace, top=args.top)
+    emit(payload, args.format, _render_report)
+    return EXIT_OK if payload["phases"] else EXIT_FINDINGS
 
 
 if __name__ == "__main__":
