@@ -72,6 +72,15 @@ def _resolve_hist_dtype(cfg: Config) -> str:
     return dt
 
 
+def _resolve_hist_kernel_cfg(cfg: Config) -> str:
+    """Histogram-build formulation (ops/histogram.py HIST_KERNELS).  All
+    modes are bit-identical, so no validity gating beyond the name check
+    — the dispatcher itself falls back to the flat kernel where a
+    forced mode's shape constraints don't hold."""
+    from ..ops.histogram import resolve_hist_kernel
+    return resolve_hist_kernel(cfg.hist_kernel)
+
+
 def _hp_from_config(cfg: Config, n_bins: int) -> SplitHyper:
     return SplitHyper(
         num_leaves=max(2, int(cfg.num_leaves)),
@@ -94,6 +103,7 @@ def _hp_from_config(cfg: Config, n_bins: int) -> SplitHyper:
         # the user's tpu_hist_dtype (ADVICE r1: bfloat16 silently broke the
         # deterministic contract)
         hist_dtype=_resolve_hist_dtype(cfg),
+        hist_kernel=_resolve_hist_kernel_cfg(cfg),
         leaf_hist=str(cfg.tpu_leaf_hist),
         extra_trees=bool(cfg.extra_trees),
         feature_fraction_bynode=float(cfg.feature_fraction_bynode),
@@ -308,6 +318,7 @@ class GBDT:
         self.valid_scores: List[jnp.ndarray] = []
         self.valid_metrics: List[List[Metric]] = []
         self._valid_bins: List[jnp.ndarray] = []
+        self._valid_bins_t: List[Optional[jnp.ndarray]] = []
 
     # ------------------------------------------------------------- helpers
     def _phase(self, name: str):
@@ -527,18 +538,25 @@ class GBDT:
                     # strict learner keeps full per-shard histograms
                     log.warning("histogram_pool_size ignored under "
                                 "tree_learner=feature")
-                elif self.forced_splits is not None:
-                    # cegb / linear_tree / advanced monotone all compose
-                    # with the pooled batched grower since the round-4
-                    # lifts; forced splits still assert against pooling
-                    # (batch_grower.py forced-phase state)
-                    log.warning("histogram_pool_size ignored: forced "
-                                "splits require the strict full-histogram "
-                                "learner")
                     self._count("hist_pool_fallbacks")
                 else:
+                    # cegb / linear_tree / advanced monotone composed in
+                    # round 4; forced splits joined in round 6 (the
+                    # batched forced phase derives evicted leaves'
+                    # columns directly — batch_grower.forced_col_hist)
                     self.hp = dataclasses.replace(
                         self.hp, hist_pool_slots=slots)
+
+        # packed-word mirror (round-6 packed histogram mode): ship the
+        # dataset's construction-time mirror ONCE per booster instead of
+        # re-deriving the word view inside every traced tree; the
+        # distributed modes pad rows/columns after construction, so they
+        # keep the in-jit derivation
+        self.bins_words = None
+        if self.parallel_mode is None:
+            from ..ops.histogram import wants_packed_mirror
+            if wants_packed_mirror(self.hp.hist_kernel, self.hp.n_bins):
+                self.bins_words = jnp.asarray(train_set.packed_mirror())
 
     def _init_base_score(self) -> None:
         has_init_score = self.train_set.metadata.init_score is not None
@@ -717,6 +735,8 @@ class GBDT:
         for m in self.train_metrics:
             m.init(train_set.metadata, train_set.num_data)
         self.bins = jnp.asarray(train_set.bins)
+        if getattr(self, "bins_words", None) is not None:
+            self.bins_words = jnp.asarray(train_set.packed_mirror())
         self.sample_strategy = create_sample_strategy(
             self.config, train_set.num_data)
         n = train_set.num_data
@@ -741,9 +761,38 @@ class GBDT:
                 if isc.size == vsc.size else isc.reshape(-1, 1)
         self.valid_scores.append(jnp.asarray(vsc))
         self._valid_bins.append(jnp.asarray(valid_set.bins))
+        # transposed mirror for the matmul valid scorer (round 6): the
+        # per-tree path-aggregation wants rows on lanes; cached once per
+        # valid set, only for model classes the matmul path serves
+        self._valid_bins_t.append(
+            jnp.asarray(np.ascontiguousarray(valid_set.bins.T))
+            if self._matmul_valid_ok() else None)
         self._valid_raw.append(jnp.asarray(valid_set.raw)
                                if self.linear and valid_set.raw is not None
                                else None)
+
+    def _matmul_valid_ok(self) -> bool:
+        """True when per-tree valid scoring can take the matmul
+        path-aggregation (models/predict.py predict_bins_tree_matmul)
+        instead of the frontier walk: numeric un-bundled non-linear
+        models — categorical bitsets and EFB inverse tables are per-row
+        gathers the matmul formulation has no cheap equivalent for, and
+        linear leaves score through their own raw-feature path."""
+        return (not self.hp.has_categorical and self.bundle is None
+                and not self.linear)
+
+    def _valid_tree_scores(self, arrays: TreeArrays, vi: int) -> jax.Array:
+        """One tree's contribution to valid set ``vi``'s scores (leaf
+        values must already be shrunk).  Matmul path aggregation where
+        eligible (bit-identical to the walk — exactly one leaf matches
+        per row); frontier walk otherwise."""
+        if self._matmul_valid_ok() and self._valid_bins_t[vi] is not None:
+            from ..models.predict import predict_bins_tree_matmul
+            return predict_bins_tree_matmul(
+                arrays, self._valid_bins_t[vi], self.nan_bin_arr)
+        return predict_bins_tree(arrays, self._valid_bins[vi],
+                                 self.nan_bin_arr, self.bundle,
+                                 self.hp.has_categorical)
 
     # ------------------------------------------------------------ training
     def boosting_gradients(self) -> Tuple[jax.Array, jax.Array]:
@@ -911,13 +960,11 @@ class GBDT:
                 # gather ~25x on TPU (ops/table.py)
                 self.scores = self.scores.at[:, cls_idx].add(
                     take_small_table(shrunk, leaf_of_row))
-                # valid scores via frontier traversal (shrunk values)
+                # valid scores: matmul path aggregation where eligible,
+                # frontier traversal otherwise (shrunk values either way)
                 arrays_shrunk = arrays._replace(leaf_value=shrunk)
                 for vi in range(len(self.valid_sets)):
-                    contrib = predict_bins_tree(arrays_shrunk,
-                                                self._valid_bins[vi],
-                                                self.nan_bin_arr, self.bundle,
-                                                self.hp.has_categorical)
+                    contrib = self._valid_tree_scores(arrays_shrunk, vi)
                     self.valid_scores[vi] = \
                         self.valid_scores[vi].at[:, cls_idx].add(contrib)
             with self._phase("tree_finalize"):
@@ -984,11 +1031,10 @@ class GBDT:
     def fused_valid_ok(self) -> bool:
         """Valid sets can ride the fused scan when every registered valid
         metric has a traceable device evaluation (metrics.py
-        ``eval_device_traced``) and scoring is single-output (the device
-        metric kernels evaluate [n]-score columns)."""
+        ``eval_device_traced``).  Multiclass rides too (round 6 — the
+        in-scan eval hands multi-output metrics the full [n, k] score
+        matrix; multi_logloss / multi_error carry device kernels)."""
         from ..metrics import Metric as _MetricBase
-        if self.num_tree_per_iteration != 1:
-            return False
         if bool(self.config.deterministic) or \
                 not bool(self.config.tpu_device_eval):
             return False
@@ -1000,6 +1046,11 @@ class GBDT:
                               is not _MetricBase.eval_device_traced
                               or m._DEV_KIND is not None)
                 if not has_traced:
+                    return False
+                if self.num_tree_per_iteration != 1 and not m._DEV_MULTI:
+                    # the in-scan eval hands multiclass runs the full
+                    # [n, k] matrix; single-column device kernels (l2,
+                    # auc, ...) can't consume it
                     return False
         return True
 
@@ -1120,16 +1171,18 @@ class GBDT:
             def eval_valid_traced(vsc):
                 parts = []
                 for vi, ms in enumerate(self.valid_metrics):
+                    # single-output metrics see the [n] column, multi-
+                    # output metrics the full [n, k] matrix (round 6)
+                    sc = vsc[vi][:, 0] if k == 1 else vsc[vi]
                     for m in ms:
                         parts.append(jnp.asarray(
-                            m.eval_device_traced(vsc[vi][:, 0],
-                                                 self.objective),
+                            m.eval_device_traced(sc, self.objective),
                             jnp.float32))
                 return jnp.concatenate(parts) if parts else \
                     jnp.zeros((0,), jnp.float32)
 
-            def run(scores, bins, qkeys, nkeys, fmasks, iters, vscores,
-                    es0):
+            def run(scores, bins, bwords, qkeys, nkeys, fmasks, iters,
+                    vscores, es0):
                 def round_real(carry, qkey_raw, node_keys, fm, it):
                     sc, vsc, es = carry
                     # sc: [n, k].  One gradient evaluation per round,
@@ -1175,7 +1228,8 @@ class GBDT:
                             bundle=self.bundle, monotone=self.monotone_arr,
                             hist_scale=hist_scale,
                             interaction_sets=self.interaction_sets,
-                            rng_key=nkey, forced=self.forced_splits)
+                            rng_key=nkey, forced=self.forced_splits,
+                            bins_words=bwords)
                         if renew:
                             renewed = renew_leaf_values(
                                 lor, g_t, h_t, rmask,
@@ -1194,12 +1248,13 @@ class GBDT:
                         sc_c = sc_c.at[:, cls].add(take_small_table(
                             shrunk, lor))
                         if nvalid:
+                            # matmul path aggregation replaces the
+                            # per-round frontier walk (round 6 — the walk
+                            # cost ~107 ms/iter at 1M/200k, VERDICT r5 #4)
                             arrays_s = arrays._replace(leaf_value=shrunk)
                             vsc_c = tuple(
-                                v.at[:, cls].add(predict_bins_tree(
-                                    arrays_s, self._valid_bins[vi],
-                                    self.nan_bin_arr, self.bundle,
-                                    self.hp.has_categorical))
+                                v.at[:, cls].add(
+                                    self._valid_tree_scores(arrays_s, vi))
                                 for vi, v in enumerate(vsc_c))
                         return (sc_c, vsc_c), arrays
 
@@ -1314,7 +1369,8 @@ class GBDT:
             with self._phase("fused_round_scan"):
                 (scores, vscores, es_host), (stacked, mvals) = \
                     self._fused_cache[key](
-                        self.scores, self.bins, qkeys, nkeys, fmasks, iters,
+                        self.scores, self.bins, self.bins_words, qkeys,
+                        nkeys, fmasks, iters,
                         tuple(self.valid_scores), es_host)
             self.scores = scores
             for vi in range(nvalid):
@@ -1390,7 +1446,7 @@ class GBDT:
                     hist_scale=hist_scale,
                     interaction_sets=self.interaction_sets,
                     rng_key=node_key, forced=self.forced_splits,
-                    cegb=self.cegb)
+                    cegb=self.cegb, bins_words=self.bins_words)
                 if self.cegb is not None:
                     arrays, lor, self.cegb = out
                     return arrays, lor
@@ -1398,7 +1454,8 @@ class GBDT:
             kwargs = dict(monotone=self.monotone_arr, rng_key=node_key,
                           interaction_sets=self.interaction_sets,
                           forced=self.forced_splits, bundle=self.bundle,
-                          hist_scale=hist_scale)
+                          hist_scale=hist_scale,
+                          bins_words=self.bins_words)
             if self.cegb is not None:
                 arrays, lor, self.cegb = grow_tree(*args, cegb=self.cegb,
                                                    **kwargs)
@@ -1470,9 +1527,9 @@ class GBDT:
             self._batched_decision = False
             return False
         # categorical splits, all three monotone methods, interaction
-        # constraints, path smoothing, CEGB and linear trees are
-        # batched-capable (learner/batch_grower.py)
-        forced_pooled = self.forced_splits is not None and pool_active
+        # constraints, path smoothing, CEGB, linear trees and (since
+        # round 6) forced splits x hist pool are batched-capable
+        # (learner/batch_grower.py)
         # batched voting carries the PV-Tree protocol including
         # categorical splits (round 5: the winner's column psums for the
         # bitset, the strict learner's cadence) but not forced splits
@@ -1489,7 +1546,6 @@ class GBDT:
         # ever reaches this dispatch in serial mode — __init__ fatals on
         # cegb_* with any non-serial tree_learner (gbdt.py:401)
         reasons = [name for name, hit in (
-            ("forced-splits-with-pool", forced_pooled),
             ("forced-splits-under-voting", voting_unsupported),
             ("extra_trees/bynode-sampling/forced-splits-under-"
              "distributed", rng_parallel),
@@ -1501,6 +1557,16 @@ class GBDT:
                         "to the strict leaf-wise learner"
                         % ", ".join(reasons))
             self._count("batched_path_fallbacks")
+            if pool_active:
+                # the pool lives in the batched grower only; the strict
+                # learner keeps the full [L, F, B, 4] state resident, so
+                # the user's memory cap is NOT honored on this path —
+                # warn and tally like the feature-parallel case
+                log.warning("histogram_pool_size inert under the strict "
+                            "leaf-wise fallback (%s): full per-leaf "
+                            "histogram state stays resident"
+                            % ", ".join(reasons))
+                self._count("hist_pool_fallbacks")
             self._batched_decision = False
             return False
         self._batched_decision = True

@@ -180,6 +180,31 @@ class Dataset:
                 widest = max(widest, total)
         return device_bins_pow2(widest)
 
+    def packed_mirror(self) -> np.ndarray:
+        """Packed-word mirror of the bin matrix: i32 [n, ceil(F/4)], 4
+        uint8 bins per word (little-endian bitcast of the row-major
+        matrix — the layout ``ops/histogram.bins_to_words`` produces on
+        device).
+
+        Round-6 packed-bin histogram mode: the kernel's one-hot build
+        compares 4 features per 32-bit lane (ops/hist_pallas.py
+        ``histogram_leaves_packed_pallas``), so the dataset keeps this
+        mirror alongside ``bins`` and the booster ships it ONCE instead
+        of re-deriving the word view inside every traced tree.  Built
+        lazily and cached; invalidated implicitly by never mutating
+        ``bins`` after construction (the Dataset contract)."""
+        cached = getattr(self, "_packed_mirror", None)
+        if cached is not None and cached.shape[0] == self.bins.shape[0]:
+            return cached
+        n, num_f = self.bins.shape
+        pad = (-num_f) % 4
+        b = self.bins if not pad else \
+            np.concatenate([self.bins,
+                            np.zeros((n, pad), np.uint8)], axis=1)
+        self._packed_mirror = np.ascontiguousarray(b).view(np.int32) \
+            .reshape(n, (num_f + pad) // 4)
+        return self._packed_mirror
+
     def device_bundle_arrays(self):
         """EFB tables trimmed to ``device_n_bins`` width, or None
         (learner/grower.py DeviceBundle operands)."""

@@ -42,8 +42,11 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..ops.histogram import (bins_to_words, histogram_for_leaves_auto,
-                             root_histogram)
-from ..ops.round_fuse import partition_select_pallas, use_fused_partition
+                             ladder_profitable, root_histogram,
+                             wants_packed_mirror)
+from ..ops.round_fuse import (partition_payload_pallas,
+                              partition_select_pallas, use_fused_partition,
+                              use_fused_payload)
 from ..ops.split import (NEG_INF, VAR_CAT_BWD, VAR_CAT_FWD, SplitHyper,
                          categorical_left_bitset, find_best_split,
                          leaf_output)
@@ -51,6 +54,10 @@ from .grower import (CegbInput, DeviceBundle, TreeArrays, _INF_BOUND,
                      _empty_tree, _expand_hist, _expand_hist_col,
                      _feature_bin_of_rows, pv_vote_best_split,
                      sample_features_bynode)
+
+#: data size below which warmup width-matching is never worth its extra
+#: kernel compilations (tests patch this to exercise the ladder cheaply)
+_WARMUP_MIN_ROWS = 65536
 
 
 @functools.partial(jax.jit, static_argnames=("hp", "batch", "axis_name",
@@ -72,7 +79,8 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                                              jax.Array]] = None,
                       parallel_mode: str = "data", top_k: int = 20,
                       num_shards: int = 1,
-                      cegb: Optional[CegbInput] = None):
+                      cegb: Optional[CegbInput] = None,
+                      bins_words: Optional[jax.Array] = None):
     """Grow one tree with ``batch`` splits per histogram pass.
 
     Same operands and return contract as ``grow_tree`` (a 3-tuple with
@@ -138,13 +146,25 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         else row_mask.astype(grad.dtype)
     bins_t = lax.optimization_barrier(bins.T)
     # tree-invariant i32 word view of the row-major bins, hoisted out of
-    # the round loop: every compacted round's payload concat reuses it
-    bins_words = lax.optimization_barrier(bins_to_words(bins))
+    # the round loop: every compacted round's payload reuses it.  The
+    # booster passes the dataset's construction-time packed mirror
+    # (io/dataset.py packed_mirror) so serial trees skip even the
+    # one-time bitcast; derived in-jit otherwise (distributed shards).
+    bins_words = lax.optimization_barrier(
+        bins_to_words(bins) if bins_words is None else bins_words)
+    # transposed packed mirror for the round-6 packed histogram kernel
+    words_t = lax.optimization_barrier(bins_words.T) \
+        if wants_packed_mirror(hp.hist_kernel, hp.n_bins) else None
     # fused partition+key kernel (ops/round_fuse.py): numeric non-bundled
     # splits only — categorical bitsets / EFB inverse tables are per-row
     # gathers, kept on the XLA path
     fuse_partition = (use_fused_partition() and not hp.has_categorical
                       and bundle is None)
+    # payload-emitting partition variant: only the non-pooled path
+    # consumes the emitted matrix (the pooled path rebuilds its own keys
+    # for its extended leaf set)
+    pooled = 0 < hp.hist_pool_slots < hp.num_leaves
+    fuse_payload = fuse_partition and not pooled and use_fused_payload()
     from ..ops.histogram import use_pallas as _use_pallas
     INF = jnp.float32(_INF_BOUND)
 
@@ -205,6 +225,46 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         depth_ok = (hp.max_depth <= 0) | (depth < hp.max_depth)
         return res._replace(gain=jnp.where(depth_ok, res.gain, NEG_INF))
 
+    def forced_col_hist(ff, lor_now, fl):
+        """[B, C] VIRTUAL histogram column of leaf ``fl`` for feature
+        ``ff``, computed directly from the data in row blocks.
+
+        The pooled forced phase uses this instead of ``st["hist"]``: a
+        forced BFS schedule can prescribe a split for a leaf whose pool
+        slot was evicted rounds ago, so the column is re-derived from
+        the rows themselves (same exact sums; may differ from the
+        subtraction-chain histogram only in f32 rounding — the same
+        deviation class as the pool's direct child rebuilds).  Virtual
+        bins via ``_feature_bin_of_rows`` make EFB default-bin
+        completion unnecessary."""
+        colv = _feature_bin_of_rows(bins_t, bundle, ff)
+        selm = (lor_now == fl) & (mask_f > 0)
+        iota_b = lax.iota(jnp.int32, hp.n_bins)
+        blk_ = min(1 << 17, n)
+        pad_ = (-n) % blk_
+        nb_ = (n + pad_) // blk_
+
+        def block(acc, xs):
+            colv_b, g_b, h_b, sel_b = xs
+            oh = (colv_b[None, :] == iota_b[:, None]).astype(jnp.float32)
+            gm = jnp.where(sel_b, g_b, 0.0)
+            hm = jnp.where(sel_b, h_b, 0.0)
+            vals = jnp.stack([gm, hm, sel_b.astype(jnp.float32),
+                              jnp.zeros_like(g_b)])          # [C, blk]
+            return acc + lax.dot_general(
+                vals, oh, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=lax.Precision.HIGHEST).T, None     # [B, C]
+
+        acc0 = jnp.zeros((hp.n_bins, 4), jnp.float32)
+        hf, _ = lax.scan(block, acc0, (
+            jnp.pad(colv, (0, pad_), constant_values=-1)
+            .reshape(nb_, blk_),
+            jnp.pad(grad, (0, pad_)).reshape(nb_, blk_),
+            jnp.pad(hess, (0, pad_)).reshape(nb_, blk_),
+            jnp.pad(selm, (0, pad_)).reshape(nb_, blk_)))
+        return _scaled(hf)
+
     def winner_bitset(h_phys, g_, h_, c_, feat, var, thr):
         """Left-category bitset of a CACHED best split, computed from the
         leaf's own histogram at best-split time (same inputs as the
@@ -238,7 +298,8 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     hist0_b = _scaled(root_histogram(
         bins_t, grad, hess, row_mask, n_bins=hp.n_bins,
         rows_per_block=hp.rows_per_block,
-        hist_dtype=hp.hist_dtype, axis_name=hist_axis))
+        hist_dtype=hp.hist_dtype, axis_name=hist_axis,
+        hist_kernel=hp.hist_kernel, bins_words_t=words_t))
     g0 = jnp.sum(grad * mask_f)
     h0 = jnp.sum(hess * mask_f)
     c0 = jnp.sum(mask_f)
@@ -274,7 +335,8 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     # bounded histogram pool (SplitHyper.hist_pool_slots): P slots + one
     # trash row; leaf_slot/slot_leaf carry the mapping, with trash entries
     # at index L / P so masked scatters need no branches
-    pooled = 0 < hp.hist_pool_slots < L
+    # (``pooled`` itself is derived up top, before the partition-fusion
+    # gates)
     P = hp.hist_pool_slots
     if pooled:
         assert P >= 3 * K + 2, \
@@ -324,8 +386,8 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         state["leaf_hi"] = jnp.zeros((L, num_f), jnp.int32).at[0].set(
             num_bins.astype(jnp.int32))
     if forced is not None:
-        assert not pooled, \
-            "forced splits do not compose with hist_pool_slots yet"
+        # composes with the bounded pool since round 6: the forced phase
+        # derives evicted leaves' columns directly (forced_col_hist)
         state["force_failed"] = jnp.bool_(False)
     if pooled:
         state["leaf_slot"] = jnp.full((L + 1,), -1, jnp.int32).at[0].set(0)
@@ -347,11 +409,35 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
               f_active = (f_leaf[i] >= 0) & ~st["force_failed"]
               fl = jnp.maximum(f_leaf[i], 0)
               ff, ft = f_feat[i], f_thr[i]
-              hf_col = st["hist"][fl, ff if bundle is None
-                                  else bundle.feat_col[ff]]      # [B, C]
-              hf = hf_col if bundle is None else \
-                  _expand_hist_col(hf_col, bundle, ff, st["sum_g"][fl],
-                                   st["sum_h"][fl], st["count"][fl])
+              if pooled:
+                  # resident pool slot -> one [B, C] slot read (the
+                  # common case: forced prefixes are shallow and the
+                  # pool holds >= 3K+2 slots); evicted -> re-derive the
+                  # virtual column from the data in row blocks
+                  # (round-6 lift of the forced x hist-pool carve-out)
+                  slot = st["leaf_slot"][fl]
+                  resident = (slot >= 0) & (slot < P)
+
+                  def hf_from_pool(_):
+                      hc = st["hist"][jnp.clip(slot, 0, P),
+                                      ff if bundle is None
+                                      else bundle.feat_col[ff]]
+                      return hc if bundle is None else \
+                          _expand_hist_col(hc, bundle, ff,
+                                           st["sum_g"][fl],
+                                           st["sum_h"][fl],
+                                           st["count"][fl])
+
+                  hf = lax.cond(resident, hf_from_pool,
+                                lambda _: forced_col_hist(
+                                    ff, st["leaf_of_row"], fl), None)
+              else:
+                  hf_col = st["hist"][fl, ff if bundle is None
+                                      else bundle.feat_col[ff]]  # [B, C]
+                  hf = hf_col if bundle is None else \
+                      _expand_hist_col(hf_col, bundle, ff,
+                                       st["sum_g"][fl],
+                                       st["sum_h"][fl], st["count"][fl])
               pgf, phf, pcf = st["sum_g"][fl], st["sum_h"][fl], \
                   st["count"][fl]
               lgf, lhf, lcf, gf, ok_f = gather_forced_split(
@@ -376,8 +462,14 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
               if hp.has_categorical:
                   var_f = jnp.where(is_cat[ff], VAR_CAT_ONEHOT,
                                     VAR_NUM_RIGHT)
-                  bs_f = winner_bitset(st["hist"][fl], pgf, phf, pcf,
-                                       ff, var_f, ft)
+                  if pooled:
+                      # same direct column carries the bitset (the pool
+                      # may not hold this leaf's histogram)
+                      bs_f = categorical_left_bitset(
+                          hf, num_bins[ff], var_f, ft, hp) & is_cat[ff]
+                  else:
+                      bs_f = winner_bitset(st["hist"][fl], pgf, phf, pcf,
+                                           ff, var_f, ft)
                   st["best_bitset"] = st["best_bitset"].at[fl].set(
                       jnp.where(use_f, bs_f, st["best_bitset"][fl]))
               forced_sel = (fl, use_f)
@@ -736,9 +828,23 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
           # ---- all K partitions in ONE widened pass (each row belongs to
           # at most one split parent, so the K moves compose by summation)
           sort_key = None
+          payload = None
           with jax.named_scope("partition"):
               feats_k = st["best_feat"][parents]                      # [K]
-              if fuse_partition:
+              if fuse_partition and fuse_payload:
+                  # payload-emitting variant: the next compacted round's
+                  # [n, W+3] payload rides the partition pass instead of
+                  # a separate XLA concat (round-6 glue elimination)
+                  lor, sort_key, payload = partition_payload_pallas(
+                      bins_t, bins_words, grad, hess, lor,
+                      mask_f.astype(jnp.int32),
+                      feats_k, st["best_thr"][parents],
+                      st["best_dl"][parents].astype(jnp.int32),
+                      nan_bin[feats_k].astype(jnp.int32),
+                      parents, new_leaves, valid.astype(jnp.int32),
+                      smaller, rows_per_block=min(hp.rows_per_block, 2048),
+                      interpret=not _use_pallas())
+              elif fuse_partition:
                   lor, sort_key = partition_select_pallas(
                       bins_t, lor, mask_f.astype(jnp.int32),
                       feats_k, st["best_thr"][parents],
@@ -784,19 +890,21 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
               small_cnt = (jnp.where(valid, jnp.minimum(l_cnt, r_cnt), 0.0)
                            if axis_name is None else None)
 
-              def hist_call(lv, cnts, skey=None):
+              def hist_call(lv, cnts, skey=None, pay=None):
                   return _scaled(histogram_for_leaves_auto(
                       bins, bins_t, grad, hess, lor, lv, row_mask,
                       n_bins=hp.n_bins, rows_per_block=hp.rows_per_block,
                       hist_dtype=hp.hist_dtype, axis_name=hist_axis,
-                      counts=cnts, bins_words=bins_words, sort_key=skey))
+                      counts=cnts, bins_words=bins_words, sort_key=skey,
+                      hist_kernel=hp.hist_kernel, bins_words_t=words_t,
+                      payload=pay))
 
               left_small = (l_cnt <= r_cnt)[:, None, None, None]
               if not pooled:
                   # the fused kernel's keys target exactly the `smaller`
                   # set; the pooled path's extended leaf set rebuilds its
                   # own keys
-                  h_small = hist_call(smaller, small_cnt, sort_key)
+                  h_small = hist_call(smaller, small_cnt, sort_key, payload)
                   h_parent = st["hist"][parents]
                   h_large = h_parent - h_small
                   h_left = jnp.where(left_small, h_small, h_large)
@@ -1007,13 +1115,21 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         # a failed/exhausted forced round leaves progress False; the
         # gain-based loops below must still run
         state["progress"] = jnp.bool_(True)
-    if warmup and n >= 65536 and forced is None:
+    if warmup and n >= _WARMUP_MIN_ROWS and forced is None \
+            and ladder_profitable(hp.hist_kernel, hp.n_bins):
         # width QUADRUPLING (1, 4, 16, ...): each width always covers the
         # frontier (it at most doubles per round), and since kernel cost
         # is K-independent below 128 channels (docs/PERF_NOTES.md round
         # 3), fewer warmup rounds beat finer width matching — profiled
         # ~2 full passes saved per tree vs doubling.  Skipped after a
         # forced phase: the forced frontier can exceed the warmup widths.
+        # Round 6: the ladder only pays where the K<=4 masked pass takes
+        # the radix-JOINT kernel (auto dispatch at >= 128 bins); every
+        # other mode's kernel is K-independent, so those configs SEED the
+        # round loop at full width straight from the root histogram —
+        # identical selections (top-k of a sub-K frontier picks the same
+        # leaves at any width), ~2 fewer compiled round bodies and no
+        # narrow warmup passes (ops/histogram.py ladder_profitable).
         kw = 1
         while kw < K:
             state = lax.cond(state["progress"] & (state["n_splits"] < L - 1),

@@ -37,8 +37,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..ops.histogram import (histogram_for_leaf_bucketed,
-                             histogram_for_leaf_masked, root_histogram)
+from ..ops.histogram import (bins_to_words, histogram_for_leaf_bucketed,
+                             histogram_for_leaf_masked, root_histogram,
+                             wants_packed_mirror)
 from ..ops.split import (NEG_INF, VAR_CAT_BWD, VAR_CAT_FWD, VAR_CAT_ONEHOT,
                          VAR_NUM_RIGHT, SplitHyper, SplitResult,
                          categorical_left_bitset, find_best_split, leaf_gain,
@@ -296,7 +297,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
               parallel_mode: str = "data", top_k: int = 20,
               num_shards: int = 1,
               cegb: Optional[CegbInput] = None,
-              hist_scale: Optional[jax.Array] = None):
+              hist_scale: Optional[jax.Array] = None,
+              bins_words: Optional[jax.Array] = None):
     """Grow one tree; returns (TreeArrays, leaf_of_row).
 
     bins: uint8 [n, F]; grad/hess: f32 [n]; row_mask: bool [n] or None
@@ -387,6 +389,14 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     # rematerializes the 28-byte-strided transpose inside every split
     # iteration (measured 2.5x on the whole tree loop)
     bins_t = lax.optimization_barrier(bins.T)
+    # packed-word mirror for the round-6 packed histogram mode (kept
+    # resident per tree like bins_t; ``bins_words`` lets the booster ship
+    # the dataset's construction-time mirror instead of re-deriving it)
+    if wants_packed_mirror(hp.hist_kernel, hp.n_bins):
+        words_t = lax.optimization_barrier(
+            (bins_to_words(bins) if bins_words is None else bins_words).T)
+    else:
+        words_t = None
     # quantized-levels mode (ops/quantize.py): grad/hess hold integer
     # levels; one deterministic multiply restores real units right after
     # each exact integer histogram accumulation
@@ -401,7 +411,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     hist0_b = _scaled(root_histogram(
         bins_t, grad, hess, row_mask, n_bins=hp.n_bins,
         rows_per_block=hp.rows_per_block,
-        hist_dtype=hp.hist_dtype, axis_name=hist_axis))
+        hist_dtype=hp.hist_dtype, axis_name=hist_axis,
+        hist_kernel=hp.hist_kernel, bins_words_t=words_t))
     g0 = jnp.sum(grad * mask_f)
     h0 = jnp.sum(hess * mask_f)
     c0 = jnp.sum(mask_f)
@@ -722,7 +733,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                 h_small = histogram_for_leaf_masked(
                     bins_t, grad, hess, leaf_of_row, smaller, row_mask,
                     n_bins=hp.n_bins, rows_per_block=hp.rows_per_block,
-                    hist_dtype=hp.hist_dtype, axis_name=hist_axis)
+                    hist_dtype=hp.hist_dtype, axis_name=hist_axis,
+                    hist_kernel=hp.hist_kernel, bins_words_t=words_t)
             else:
                 h_small = histogram_for_leaf_bucketed(
                     bins, grad, hess, leaf_of_row, smaller,

@@ -136,6 +136,11 @@ class Metric:
     #: device-kernel id (_dev_pointwise) — None means no pointwise device
     #: path; AUC/NDCG override eval_device with their own kernels
     _DEV_KIND: Optional[str] = None
+    #: True when eval_device_traced accepts the FULL [n, k] score matrix
+    #: (multiclass metrics); single-output device kernels take a [n]
+    #: column, so the fused scan only hands multiclass score matrices to
+    #: metrics that declare this (boosting/gbdt.py fused_valid_ok)
+    _DEV_MULTI: bool = False
 
     def eval_device(self, score_dev, objective=None
                     ) -> Optional[List[Tuple[str, float]]]:
@@ -389,6 +394,30 @@ class MultiLoglossMetric(Metric):
             p_true = np.clip(p_true / np.maximum(p.sum(axis=1), 1e-15), 1e-15, None)
         return [(self.NAME, self._avg(-np.log(p_true)))]
 
+    _DEV_MULTI = True
+
+    def eval_device_traced(self, score_dev, objective=None):
+        """Traced multiclass logloss over the [n, k] score matrix — the
+        fused scan's per-round valid eval (round 6: multiclass rides the
+        fused path).  Same formulation as host ``eval`` in device f32
+        (the accepted device-eval precision class)."""
+        import jax.numpy as jnp
+        y, w = self._dev_arrays()
+        idx = y.astype(jnp.int32)
+        p = self._dev_convert(score_dev, objective)
+        if objective is None or not objective.need_convert_output:
+            ex = jnp.exp(score_dev - jnp.max(score_dev, axis=1,
+                                             keepdims=True))
+            p = ex / jnp.sum(ex, axis=1, keepdims=True)
+        p_true = jnp.maximum(p[jnp.arange(p.shape[0]), idx], 1e-15)
+        if getattr(objective, "NAME", "") == "multiclassova":
+            p_true = jnp.maximum(
+                p_true / jnp.maximum(jnp.sum(p, axis=1), 1e-15), 1e-15)
+        losses = -jnp.log(p_true)
+        val = jnp.mean(losses) if w is None else \
+            jnp.sum(losses * w) / jnp.float32(self.sum_weight)
+        return jnp.reshape(val.astype(jnp.float32), (1,))
+
 
 class MultiErrorMetric(Metric):
     NAME = "multi_error"
@@ -402,6 +431,24 @@ class MultiErrorMetric(Metric):
         rank = (score > true_score[:, None]).sum(axis=1)
         err = rank >= k
         return [(self.NAME, self._avg(err.astype(np.float64)))]
+
+    _DEV_MULTI = True
+
+    def eval_device_traced(self, score_dev, objective=None):
+        """Traced top-k multiclass error over the [n, k] score matrix
+        (fused-scan valid eval; mirrors host ``eval`` — rank counting is
+        integer-exact, so only ties at f32-vs-f64 score resolution can
+        deviate, the same class as every other device metric)."""
+        import jax.numpy as jnp
+        topk = self.config.multi_error_top_k
+        y, w = self._dev_arrays()
+        idx = y.astype(jnp.int32)
+        true_score = score_dev[jnp.arange(score_dev.shape[0]), idx]
+        rank = jnp.sum(score_dev > true_score[:, None], axis=1)
+        err = (rank >= topk).astype(jnp.float32)
+        val = jnp.mean(err) if w is None else \
+            jnp.sum(err * w) / jnp.float32(self.sum_weight)
+        return jnp.reshape(val, (1,))
 
 
 class AucMuMetric(Metric):

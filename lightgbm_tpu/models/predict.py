@@ -73,6 +73,125 @@ def predict_bins_leaf(tree: TreeArrays, bins: jax.Array,
     return (-node - 1).astype(jnp.int32)
 
 
+def tree_path_masks(tree: TreeArrays):
+    """DEVICE-side leaf path-direction masks from a grown tree's arrays.
+
+    The forest predictors build mpos/mneg on the host from the model
+    list; in-training valid scoring (the fused scan, the classic loop's
+    per-iteration update) only has the traced ``TreeArrays``, so the
+    masks are derived on device: child pointers invert into parent
+    pointers with masked scatters (leaf l is encoded ``-(l+1)``; node
+    validity is ``i < num_leaves - 1`` since nodes are created
+    sequentially — a valid node's ``left_child == -1`` genuinely means
+    leaf 0), then every leaf walks up its ancestor chain in lockstep
+    (``lax.while_loop``, bounded by tree depth, [L, ni]-sized work).
+
+    Returns (mpos bf16 [L, ni], mneg bf16 [L, ni], depth i32 [L]) —
+    depth is counted during the walk, NOT read from ``leaf_depth``, so
+    stub arrays (model-file imports) work too."""
+    ni = tree.left_child.shape[0]
+    L = ni + 1
+    iota_n = jnp.arange(ni, dtype=jnp.int32)
+    valid_node = iota_n < tree.num_leaves - 1
+    lc, rc = tree.left_child, tree.right_child
+
+    def scatter(dst, tgt, val):
+        return dst.at[tgt].set(val, mode="drop")
+
+    node_par = jnp.full((ni + 1,), -1, jnp.int32)
+    node_side = jnp.zeros((ni + 1,), jnp.int32)
+    node_par = scatter(node_par, jnp.where(valid_node & (lc >= 0), lc,
+                                           ni + 1), iota_n)
+    node_par = scatter(node_par, jnp.where(valid_node & (rc >= 0), rc,
+                                           ni + 1), iota_n)
+    node_side = scatter(node_side, jnp.where(valid_node & (rc >= 0), rc,
+                                             ni + 1), 1)
+    leaf_par = jnp.full((L,), -1, jnp.int32)
+    leaf_side = jnp.zeros((L,), jnp.int32)
+    leaf_par = scatter(leaf_par, jnp.where(valid_node & (lc < 0),
+                                           -lc - 1, L), iota_n)
+    leaf_par = scatter(leaf_par, jnp.where(valid_node & (rc < 0),
+                                           -rc - 1, L), iota_n)
+    leaf_side = scatter(leaf_side, jnp.where(valid_node & (rc < 0),
+                                             -rc - 1, L), 1)
+    rows = jnp.arange(L)
+
+    def cond(c):
+        return jnp.any(c[0] >= 0)
+
+    def body(c):
+        cur, side, mp, mn, dep = c
+        act = cur >= 0
+        tgt = jnp.where(act, cur, ni)
+        mp = mp.at[rows, tgt].add(
+            jnp.where(act & (side == 0), 1.0, 0.0), mode="drop")
+        mn = mn.at[rows, tgt].add(
+            jnp.where(act & (side == 1), 1.0, 0.0), mode="drop")
+        safe = jnp.maximum(cur, 0)
+        nxt = jnp.where(act, node_par[safe], -1)
+        nside = jnp.where(act, node_side[safe], 0)
+        return (nxt, nside, mp, mn, dep + act.astype(jnp.int32))
+
+    zero = jnp.zeros((L, ni), jnp.float32)
+    _, _, mpos, mneg, depth = lax.while_loop(
+        cond, body, (leaf_par, leaf_side, zero, zero,
+                     jnp.zeros((L,), jnp.int32)))
+    return (mpos.astype(jnp.bfloat16), mneg.astype(jnp.bfloat16), depth)
+
+
+#: row-block width for predict_bins_tree_matmul — bounds the [ni, blk]
+#: decision-bit planes (~66 MB bf16 at 255 leaves)
+_MATMUL_VALID_BLOCK = 131_072
+
+
+@jax.jit
+def predict_bins_tree_matmul(tree: TreeArrays, bins_t: jax.Array,
+                             nan_bin: jax.Array) -> jax.Array:
+    """Leaf VALUE per row for one device tree — the matmul
+    path-aggregation formulation of ``predict_bins_tree`` (round-6
+    fused-valid lift, VERDICT r5 #4: the per-iteration frontier walk
+    cost ~107 ms/iter at 1M/200k — depth x O(n) random gathers, the
+    slowest TPU primitive).  NUMERIC un-bundled trees only (categorical
+    bitsets / EFB inverse tables are per-row gathers; those models keep
+    the frontier walk).
+
+    ``bins_t``: u8/i32 [F, n] TRANSPOSED valid bins (cached by the
+    booster).  Every node's decision bit comes from one contiguous row
+    gather; rows match leaves by counting satisfied path conditions
+    (two [L, ni] x [ni, blk] bf16 matmuls per row block — small-integer
+    exact, so the output is BIT-identical to the frontier walk: exactly
+    one real leaf matches per row and dead slots contribute +0.0)."""
+    n = bins_t.shape[1]
+    mpos, mneg, depth = tree_path_masks(tree)
+    feat = jnp.maximum(tree.split_feature, 0)
+    thr = tree.split_bin
+    dl = tree.default_left
+    nanb = nan_bin[feat]
+    value = tree.leaf_value
+
+    def block(b0, rows):
+        cols = lax.dynamic_slice_in_dim(bins_t, b0, rows, axis=1)[feat] \
+            .astype(jnp.int32)                              # [ni, blk]
+        go = jnp.where(cols == nanb[:, None], dl[:, None],
+                       cols <= thr[:, None])
+        bits = go.astype(jnp.bfloat16)
+        counts = lax.dot_general(
+            mpos, bits, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) + lax.dot_general(
+            mneg, 1.0 - bits, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)             # [L, blk]
+        sel = counts.astype(jnp.int32) == depth[:, None]
+        return jnp.sum(value[:, None] * sel.astype(jnp.float32), axis=0)
+
+    outs = []
+    b0 = 0
+    while b0 < n:
+        rows = min(_MATMUL_VALID_BLOCK, n - b0)
+        outs.append(block(b0, rows))
+        b0 += rows
+    return outs[0] if len(outs) == 1 else jnp.concatenate(outs)
+
+
 class ForestArrays(NamedTuple):
     """Stacked per-tree operands for the matmul batch predictor
     (``predict_numeric_forest``).  Built host-side by

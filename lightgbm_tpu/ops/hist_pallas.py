@@ -411,6 +411,270 @@ def histogram_payload_pallas(payload: jax.Array, leaves: jax.Array,
     return jnp.pad(out, ((0, 0), (0, 0), (0, 0), (0, 1)))
 
 
+def _swar_byte_eq_planes(word: jax.Array, iota_bins: jax.Array):
+    """Per-byte equality one-hot planes from PACKED bin words.
+
+    ``word``: i32 [blk], 4 feature bins per lane (little-endian);
+    ``iota_bins``: i32 [B].  Returns i32 0/1 [4, B, blk] — plane k is the
+    one-hot of feature k's bin.
+
+    The round-5 floor analysis pinned the flat kernel at ~21% of int8
+    peak because the one-hot BUILD runs 32-bit vector compares — one
+    compare per (feature, bin, row) element, and v5e has no sub-32-bit
+    vector cmp (round-4 probe: "Target does not support this
+    comparison").  Packing 4 bins per lane makes each 32-bit op carry 4
+    features: XOR against the replicated-bin pattern ``b * 0x01010101``
+    and an exact SWAR zero-byte detect (the carry-free
+    ``~(((x & 0x7f..) + 0x7f..) | x | 0x7f..)`` form — per-byte exact,
+    unlike the borrow-propagating ``x - 0x01010101`` variant) compress
+    the 4 compares into 2 lane ops; the per-feature bit extraction is
+    shifts/masks, which the VPU issues independently of the compare
+    port.  Compare-op count per (word, bin, row): 2 vs the flat
+    kernel's 4 — the "packed" mode's throughput claim (chip A/B pends a
+    device window; docs/PERF_NOTES.md round 6)."""
+    rep = jnp.int32(0x01010101)
+    low7 = jnp.int32(0x7F7F7F7F)
+    x = word[None, :] ^ (iota_bins * rep)[:, None]          # [B, blk]
+    z = ~(((x & low7) + low7) | x | low7)   # byte k high bit <=> byte k == 0
+    planes = [((z >> (8 * k + 7)) & 1) for k in range(4)]   # i32 0/1 [B, blk]
+    return jnp.stack(planes)                                # [4, B, blk]
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_f", "n_bins", "rows_per_block",
+                                    "compute_dtype", "interpret"))
+def histogram_leaves_packed_pallas(words_t: jax.Array, grad: jax.Array,
+                                   hess: jax.Array, leaf_of_row: jax.Array,
+                                   leaves: jax.Array, *, num_f: int,
+                                   n_bins: int, rows_per_block: int = 2048,
+                                   compute_dtype=jnp.bfloat16,
+                                   interpret: bool = False) -> jax.Array:
+    """Masked multi-leaf histogram from the PACKED-word bin mirror:
+    f32 [K, F, n_bins, 4].
+
+    ``words_t``: i32 [W, n] transposed packed mirror (4 uint8 bins per
+    word, little-endian — ``ops/histogram.bins_to_words(bins).T``; kept
+    resident by the dataset/grower so no per-call bitcast happens).
+    Equivalent to ``histogram_leaves_pallas`` on the unpacked operands —
+    same masked value channels, same accumulator dtype contract — with
+    the one-hot built 4-features-per-lane (``_swar_byte_eq_planes``).
+    """
+    W, n = words_t.shape
+    assert 4 * W >= num_f
+    K = leaves.shape[0]
+    blk = min(rows_per_block, max(128, _round_up(n, 128)))
+    n_pad = _round_up(max(n, 1), blk)
+    if n_pad != n:
+        # pad rows carry word 0 and lor -1: excluded by the sel mask
+        words_t = jnp.pad(words_t, ((0, 0), (0, n_pad - n)))
+        grad = jnp.pad(grad, (0, n_pad - n))
+        hess = jnp.pad(hess, (0, n_pad - n))
+        leaf_of_row = jnp.pad(leaf_of_row, (0, n_pad - n),
+                              constant_values=-1)
+    nb = n_pad // blk
+    f_pad = 4 * W
+
+    def kernel(words_ref, g_ref, h_ref, lor_ref, leaves_ref, out_ref):
+        step = pl.program_id(0)
+
+        @pl.when(step == 0)
+        def _():
+            out_ref[:] = jnp.zeros_like(out_ref)
+
+        lor_b = lor_ref[0, :]                               # [blk] i32
+        sel = lor_b[None, :] == leaves_ref[0, :][:, None]   # [K, blk]
+        if _is_int8(compute_dtype):
+            # integer masking by multiply is NaN-safe post-cast
+            seli = sel.astype(jnp.int32)
+            gm = seli * g_ref[0, :][None, :].astype(jnp.int32)
+            hm = seli * h_ref[0, :][None, :].astype(jnp.int32)
+            vals = jnp.concatenate([gm, hm, seli], axis=0).astype(jnp.int8)
+        else:
+            m = sel.astype(jnp.float32)
+            # where(), not multiply: 0 * NaN = NaN would poison sums
+            gm = jnp.where(sel, g_ref[0, :][None, :], 0.0)
+            hm = jnp.where(sel, h_ref[0, :][None, :], 0.0)
+            vals = jnp.concatenate([gm, hm, m], axis=0).astype(compute_dtype)
+        iota = lax.iota(jnp.int32, n_bins)
+        for j in range(W):
+            planes = _swar_byte_eq_planes(words_ref[j], iota)  # [4, B, blk]
+            oh_i = planes.reshape(4 * n_bins, blk)
+            if _is_int8(compute_dtype):
+                oh = oh_i.astype(jnp.int8)
+                acc = lax.dot_general(vals, oh, (((1,), (1,)), ((), ())),
+                                      preferred_element_type=jnp.int32)
+            else:
+                oh = oh_i.astype(compute_dtype)
+                acc = lax.dot_general(vals, oh, (((1,), (1,)), ((), ())),
+                                      preferred_element_type=jnp.float32,
+                                      precision=_prec(compute_dtype))
+            out_ref[:, j * 4 * n_bins:(j + 1) * 4 * n_bins] += acc
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((W, blk), lambda i: (0, i)),
+            pl.BlockSpec((1, blk), lambda i: (0, i)),
+            pl.BlockSpec((1, blk), lambda i: (0, i)),
+            pl.BlockSpec((1, blk), lambda i: (0, i)),
+            pl.BlockSpec((1, K), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((3 * K, f_pad * n_bins), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((3 * K, f_pad * n_bins),
+                                       _acc_dtype(compute_dtype)),
+        interpret=interpret,
+    )(words_t, grad[None, :], hess[None, :], leaf_of_row[None, :],
+      leaves[None, :])
+    out = out.astype(jnp.float32)
+    out = out.reshape(3, K, f_pad, n_bins)[:, :, :num_f]
+    out = out.transpose(1, 2, 3, 0)
+    return jnp.pad(out, ((0, 0), (0, 0), (0, 0), (0, 1)))
+
+
+#: VMEM budget for the radix2 accumulator (f32/i32 [p*nhi, nch*3K*p*nlo]);
+#: beyond it the dispatcher falls back to the flat kernel.  The flat
+#: kernel's [3K, F*B] accumulator at the shipped K=42/255-bin config is
+#: ~4 MB and already crowds double-buffering at blk=2048 (round-4 note);
+#: radix2 multiplies that by its diagonal-waste factor p.
+_RADIX2_ACC_BYTES = 8 << 20
+
+
+def radix2_pick_p(num_f: int, K: int, n_bins: int) -> int:
+    """Feature group width for the shared-radix kernel: largest p in
+    (4, 2) whose accumulator fits ``_RADIX2_ACC_BYTES``; 0 = does not
+    fit (caller falls back to the flat kernel)."""
+    for p in (4, 2):
+        f_pad = _round_up(num_f, p)
+        if 3 * K * f_pad * n_bins * p * 4 <= _RADIX2_ACC_BYTES:
+            return p
+    return 0
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n_bins", "rows_per_block", "p",
+                                    "compute_dtype", "interpret"))
+def histogram_leaves_radix2_pallas(bins_t: jax.Array, grad: jax.Array,
+                                   hess: jax.Array, leaf_of_row: jax.Array,
+                                   leaves: jax.Array, *, n_bins: int,
+                                   rows_per_block: int = 1024, p: int = 2,
+                                   compute_dtype=jnp.bfloat16,
+                                   interpret: bool = False) -> jax.Array:
+    """SHARED-radix masked multi-leaf histogram: f32 [K, F, n_bins, 4].
+
+    The flat masked kernel builds a B-wide one-hot per feature (the
+    32-bit-compare floor, ~2 VPU ops per (feature, bin, row)); the joint
+    radix kernel's (leaf, hi) build scales with K and loses above K=4
+    (docs/PERF_NOTES.md round 3).  This kernel splits bin = 16*hi + lo
+    and builds BOTH nibble one-hots ONCE per row block — nhi + nlo = 32
+    compare elements per feature-row instead of 256, K-independent — then
+    rides the K split-batch leaf channels on the rhs as value-masked lo
+    planes:
+
+        acc[(f, hi), (ch, f', lo)] = sum_r hi_oh[f,hi,r] * (vals[ch,r] * lo_oh[f',lo,r])
+
+    keeping only the f == f' diagonal.  The p-fold off-diagonal waste is
+    the price of full MXU tiles (same trade the single/joint radix
+    kernels shipped); ``radix2_pick_p`` bounds the accumulator.  Bit
+    contract identical to the flat kernel (int8 -> exact i32, float ->
+    f32 accumulation over the same row axis).
+    """
+    num_f, n = bins_t.shape
+    K = leaves.shape[0]
+    nhi, nlo = n_bins // 16, 16
+    M = p * nhi
+    NW = 3 * K * p * nlo
+    blk = min(rows_per_block, max(128, _round_up(n, 128)))
+    n_pad = _round_up(max(n, 1), blk)
+    if n_pad != n:
+        bins_t = jnp.pad(bins_t, ((0, 0), (0, n_pad - n)))
+        grad = jnp.pad(grad, (0, n_pad - n))
+        hess = jnp.pad(hess, (0, n_pad - n))
+        leaf_of_row = jnp.pad(leaf_of_row, (0, n_pad - n),
+                              constant_values=-1)
+    f_pad = _round_up(num_f, p)
+    if f_pad != num_f:
+        bins_t = jnp.pad(bins_t, ((0, f_pad - num_f), (0, 0)))
+    nch = f_pad // p
+    nb = n_pad // blk
+    prec = _prec(compute_dtype)
+
+    def kernel(bins_ref, g_ref, h_ref, lor_ref, leaves_ref, out_ref):
+        step = pl.program_id(0)
+
+        @pl.when(step == 0)
+        def _():
+            out_ref[:] = jnp.zeros_like(out_ref)
+
+        lor_b = lor_ref[0, :]
+        sel = lor_b[None, :] == leaves_ref[0, :][:, None]   # [K, blk]
+        int8_mode = _is_int8(compute_dtype)
+        if int8_mode:
+            seli = sel.astype(jnp.int32)
+            gm = seli * g_ref[0, :][None, :].astype(jnp.int32)
+            hm = seli * h_ref[0, :][None, :].astype(jnp.int32)
+            vals = jnp.concatenate([gm, hm, seli], axis=0)  # [3K, blk] i32
+        else:
+            m = sel.astype(jnp.float32)
+            gm = jnp.where(sel, g_ref[0, :][None, :], 0.0)
+            hm = jnp.where(sel, h_ref[0, :][None, :], 0.0)
+            vals = jnp.concatenate([gm, hm, m], axis=0) \
+                .astype(compute_dtype)                      # [3K, blk]
+        b_blk = bins_ref[:].astype(jnp.int32)
+        iota_h = lax.iota(jnp.int32, nhi)
+        iota_l = lax.iota(jnp.int32, nlo)
+        for c0 in range(nch):
+            chunk = b_blk[c0 * p:(c0 + 1) * p]              # [p, blk]
+            hi = chunk >> 4
+            lo = chunk & 15
+            if int8_mode:
+                # i8 elementwise multiplies don't legalize in Mosaic:
+                # mask in i32, cast both dot operands to i8 pre-dot
+                hi_oh = (hi[:, None, :] == iota_h[None, :, None]
+                         ).astype(jnp.int8).reshape(M, blk)
+                lo_ohi = (lo[:, None, :] == iota_l[None, :, None]
+                          ).astype(jnp.int32).reshape(p * nlo, blk)
+                vlo = (vals[:, None, :] * lo_ohi[None, :, :]
+                       ).reshape(NW, blk).astype(jnp.int8)
+                acc = lax.dot_general(hi_oh, vlo, (((1,), (1,)), ((), ())),
+                                      preferred_element_type=jnp.int32)
+            else:
+                hi_oh = (hi[:, None, :] == iota_h[None, :, None]
+                         ).astype(compute_dtype).reshape(M, blk)
+                lo_oh = (lo[:, None, :] == iota_l[None, :, None]
+                         ).astype(compute_dtype).reshape(p * nlo, blk)
+                vlo = (vals[:, None, :] * lo_oh[None, :, :]).reshape(NW, blk)
+                acc = lax.dot_general(hi_oh, vlo, (((1,), (1,)), ((), ())),
+                                      preferred_element_type=jnp.float32,
+                                      precision=prec)       # [M, NW]
+            out_ref[:, c0 * NW:(c0 + 1) * NW] += acc
+
+    out = pl.pallas_call(
+        kernel, grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((f_pad, blk), lambda i: (0, i)),
+            pl.BlockSpec((1, blk), lambda i: (0, i)),
+            pl.BlockSpec((1, blk), lambda i: (0, i)),
+            pl.BlockSpec((1, blk), lambda i: (0, i)),
+            pl.BlockSpec((1, K), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((M, nch * NW), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((M, nch * NW),
+                                       _acc_dtype(compute_dtype)),
+        interpret=interpret,
+    )(bins_t, grad[None, :], hess[None, :], leaf_of_row[None, :],
+      leaves[None, :])
+    out = out.astype(jnp.float32)
+    # rows (p_l, nhi); cols (nch, 3K-ch, p_r, nlo) — keep the f == f' diag
+    out = out.reshape(p, nhi, nch, 3 * K, p, nlo)
+    idx = jnp.arange(p)
+    out = out[idx, :, :, :, idx]            # [p, nhi, nch, 3K, nlo]
+    out = out.transpose(3, 2, 0, 1, 4)      # [3K, nch, p, nhi, nlo]
+    out = out.reshape(3, K, f_pad, n_bins)[:, :, :num_f]
+    out = out.transpose(1, 2, 3, 0)
+    return jnp.pad(out, ((0, 0), (0, 0), (0, 0), (0, 1)))
+
+
 def _radix_shapes(n_bins: int, p: int):
     """Radix split of the bin axis: bin = hi * nlo + lo with nlo = 16.
 

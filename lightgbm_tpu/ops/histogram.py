@@ -33,6 +33,69 @@ from jax import lax
 
 NUM_CHANNELS = 4  # grad, hess, count, pad
 
+#: histogram-build formulations selectable via the ``hist_kernel`` config
+#: key (round 6 — VERDICT r5 #1: the one-hot contraction is
+#: formulation-bound, so the comparison itself must change).  All modes
+#: are bit-identical on the same inputs; only the kernel arithmetic
+#: differs:
+#:   auto   — measured dispatch: radix single/joint where round-3 data
+#:            says they win, the new packed/radix2 formulations where
+#:            the one-hot build floor binds (see _masked_kernel_for);
+#:   onehot — the flat one-hot kernels everywhere (the bit-identity
+#:            reference path);
+#:   packed — 4 bins per i32 lane, SWAR compares
+#:            (hist_pallas.histogram_leaves_packed_pallas);
+#:   radix2 — shared hi/lo nibble planes reused across all K leaf
+#:            channels (hist_pallas.histogram_leaves_radix2_pallas).
+HIST_KERNELS = ("auto", "onehot", "packed", "radix2")
+
+
+def resolve_hist_kernel(name) -> str:
+    """Validate a ``hist_kernel`` value; LightGBMError names the key."""
+    n = str(name or "auto").strip().lower()
+    if n not in HIST_KERNELS:
+        from ..utils import log
+        log.fatal("unknown hist_kernel=%r (expected one of %s)"
+                  % (name, "/".join(HIST_KERNELS)))
+    return n
+
+
+# test hook: lets the CPU suite exercise the mode kernels through the
+# Pallas interpreter (use_pallas() is False off-TPU)
+_MODE_TEST_INTERPRET = False
+
+
+def wants_packed_mirror(hist_kernel, n_bins: int) -> bool:
+    """True when the resolved masked-pass kernel may consume the packed
+    word mirror — the callers' cue to keep ``bins_words_t`` resident."""
+    hk = resolve_hist_kernel(hist_kernel)
+    if hk == "packed":
+        return True
+    return hk == "auto" and not _radix_ok(n_bins) and not _no_packed()
+
+
+def ladder_profitable(hist_kernel, n_bins: int) -> bool:
+    """True when the batched grower's width-matched warmup ladder still
+    pays: only where the K<=4 masked pass takes the radix-JOINT kernel,
+    whose build scales with the leaf count (auto dispatch at >= 128
+    bins).  Every other mode's masked kernel is K-independent below one
+    MXU channel tile (round-3 measurement; packed/onehot/radix2 share
+    one build per block), so those configs seed the round loop at full
+    width straight from the root histogram instead — identical
+    selections (widths always cover the frontier), fewer compiled round
+    bodies (docs/PERF_NOTES.md round 6)."""
+    return resolve_hist_kernel(hist_kernel) == "auto" and _radix_ok(n_bins)
+
+
+def _no_packed() -> bool:
+    import os
+    return bool(os.environ.get("LGBMTPU_NO_PACKED"))  # perf A/B hatch
+
+
+def _no_radix2() -> bool:
+    import os
+    return bool(os.environ.get("LGBMTPU_NO_RADIX2"))  # perf A/B hatch
+
 
 def _round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
@@ -167,13 +230,18 @@ def histogram_for_leaf_masked(bins_t: jax.Array, grad: jax.Array,
                               row_mask: Optional[jax.Array] = None, *,
                               n_bins: int = 256, rows_per_block: int = 4096,
                               hist_dtype: str = "float32",
-                              axis_name: Optional[str] = None) -> jax.Array:
+                              axis_name: Optional[str] = None,
+                              hist_kernel: str = "auto",
+                              bins_words_t: Optional[jax.Array] = None
+                              ) -> jax.Array:
     """Leaf histogram by masking: one full-data pass with non-leaf rows
-    zeroed.  O(n) per call but with NO compaction machinery.  On TPU the
-    single-group radix kernel carries it (~1.7x the flat one-hot kernel,
-    docs/PERF_NOTES.md round 3); ``bins_t`` is the TRANSPOSED [F, n]
-    matrix."""
-    if use_pallas() and _radix_ok(n_bins):
+    zeroed.  O(n) per call but with NO compaction machinery.  Under
+    ``hist_kernel=auto`` on TPU the single-group radix kernel carries it
+    (~1.7x the flat one-hot kernel, docs/PERF_NOTES.md round 3);
+    ``bins_t`` is the TRANSPOSED [F, n] matrix."""
+    hk = resolve_hist_kernel(hist_kernel)
+    if (use_pallas() or _MODE_TEST_INTERPRET) and hk == "auto" \
+            and _radix_ok(n_bins):
         from .hist_pallas import histogram_radix_single_pallas
         lor = jnp.asarray(leaf_of_row, jnp.int32)
         sel = lor == jnp.asarray(leaf, jnp.int32)
@@ -183,7 +251,8 @@ def histogram_for_leaf_masked(bins_t: jax.Array, grad: jax.Array,
         hist = histogram_radix_single_pallas(
             bins_t, grad, hess, lor1, n_bins=n_bins,
             rows_per_block=min(rows_per_block, 2048),
-            compute_dtype=jnp.dtype(hist_dtype).type)
+            compute_dtype=jnp.dtype(hist_dtype).type,
+            interpret=not use_pallas())
         if axis_name is not None:
             hist = lax.psum(hist, axis_name)
         return hist
@@ -191,8 +260,40 @@ def histogram_for_leaf_masked(bins_t: jax.Array, grad: jax.Array,
     hist = histogram_for_leaves_masked(
         bins_t, grad, hess, leaf_of_row, leaf_arr, row_mask, n_bins=n_bins,
         rows_per_block=rows_per_block, hist_dtype=hist_dtype,
-        axis_name=axis_name)
+        axis_name=axis_name, hist_kernel=hk, bins_words_t=bins_words_t)
     return hist[0]
+
+
+def _masked_kernel_for(hk: str, n_bins: int, K: int, num_f: int,
+                       have_words: bool) -> str:
+    """Resolve the masked-pass kernel for a mode: one of
+    flat / packed / radix2 / radix_joint.
+
+    auto keeps the round-3 measured dispatch (radix joint at K<=4 and
+    >= 128 bins) and routes the two cases the round-5 floor analysis
+    proved formulation-bound to the new kernels: the >= 128-bin K>4
+    masked pass (256-wide one-hot build, ~21% of int8 peak) to the
+    shared-radix kernel, and the sub-128-bin masked pass (build-phase
+    share grows as the dot shrinks, ~17% peak at 63 bins) to the
+    packed-compare kernel.  Explicit modes force their kernel where its
+    shape constraints hold and fall back to flat (bit-identical) where
+    they don't."""
+    from .hist_pallas import radix2_pick_p
+    radix2_fits = (n_bins % 16 == 0 and n_bins >= 16
+                   and radix2_pick_p(num_f, K, n_bins) > 0)
+    if hk == "packed":
+        return "packed" if have_words else "flat"
+    if hk == "radix2":
+        return "radix2" if radix2_fits else "flat"
+    if hk == "auto":
+        if _radix_ok(n_bins):
+            if K <= 4:
+                return "radix_joint"
+            if radix2_fits and not _no_radix2():
+                return "radix2"
+        elif have_words and not _no_packed():
+            return "packed"
+    return "flat"
 
 
 def histogram_for_leaves_masked(bins_t: jax.Array, grad: jax.Array,
@@ -202,7 +303,9 @@ def histogram_for_leaves_masked(bins_t: jax.Array, grad: jax.Array,
                                 n_bins: int = 256,
                                 rows_per_block: int = 4096,
                                 hist_dtype: str = "float32",
-                                axis_name: Optional[str] = None
+                                axis_name: Optional[str] = None,
+                                hist_kernel: str = "auto",
+                                bins_words_t: Optional[jax.Array] = None
                                 ) -> jax.Array:
     """Histograms of K leaves in ONE data pass -> f32 [K, F, B, C].
 
@@ -212,13 +315,25 @@ def histogram_for_leaves_masked(bins_t: jax.Array, grad: jax.Array,
     (learner/batch_grower.py).  Widening channels also fills the MXU's
     sublane dimension (M = 4K instead of 4).  ``leaves``: i32 [K]; invalid
     slots may repeat a leaf (their histograms are simply unused).
+
+    ``hist_kernel`` selects the build formulation (``HIST_KERNELS``; all
+    modes bit-identical); ``bins_words_t`` is the resident packed-word
+    mirror [W, n] the packed mode consumes (io/dataset.py
+    ``packed_mirror``).
     """
+    hk = resolve_hist_kernel(hist_kernel)
     K = leaves.shape[0]
+    num_f = bins_t.shape[0]
     leaves = jnp.asarray(leaves, jnp.int32)
     lor = jnp.asarray(leaf_of_row, jnp.int32)
     if row_mask is not None:
         lor = jnp.where(row_mask, lor, -1)
-    if use_pallas() and _radix_ok(n_bins) and K <= 4:
+    kern_active = use_pallas() or _MODE_TEST_INTERPRET
+    kern = _masked_kernel_for(hk, n_bins, K, num_f,
+                              bins_words_t is not None) \
+        if kern_active else "xla"
+    interp = not use_pallas()
+    if kern == "radix_joint":
         # joint (leaf, hi) radix kernel: measured 4.0/5.0/7.5 ms per 1M-row
         # pass at K=1/2/4 vs the flat kernel's K-independent ~9.8
         # (docs/PERF_NOTES.md round 3) — the warmup-round accelerator
@@ -226,16 +341,38 @@ def histogram_for_leaves_masked(bins_t: jax.Array, grad: jax.Array,
         hist = histogram_radix_joint_pallas(
             bins_t, grad, hess, lor, leaves, n_bins=n_bins,
             rows_per_block=min(rows_per_block, 2048),
-            compute_dtype=jnp.dtype(hist_dtype).type)
+            compute_dtype=jnp.dtype(hist_dtype).type, interpret=interp)
         if axis_name is not None:
             hist = lax.psum(hist, axis_name)
         return hist
-    if use_pallas():
+    if kern == "radix2":
+        from .hist_pallas import (histogram_leaves_radix2_pallas,
+                                  radix2_pick_p)
+        hist = histogram_leaves_radix2_pallas(
+            bins_t, grad, hess, lor, leaves, n_bins=n_bins,
+            rows_per_block=min(rows_per_block, 1024),
+            p=radix2_pick_p(num_f, K, n_bins),
+            compute_dtype=jnp.dtype(hist_dtype).type, interpret=interp)
+        if axis_name is not None:
+            hist = lax.psum(hist, axis_name)
+        return hist
+    if kern == "packed":
+        from .hist_pallas import histogram_leaves_packed_pallas
+        hist = histogram_leaves_packed_pallas(
+            bins_words_t, grad, hess, lor, leaves, num_f=num_f,
+            n_bins=n_bins,
+            rows_per_block=min(rows_per_block, _pallas_blk(hist_dtype, n_bins)),
+            compute_dtype=jnp.dtype(hist_dtype).type, interpret=interp)
+        if axis_name is not None:
+            hist = lax.psum(hist, axis_name)
+        return hist
+    if kern == "flat":
         from .hist_pallas import histogram_leaves_pallas
         hist = histogram_leaves_pallas(
             bins_t, grad, hess, lor, leaves, n_bins=n_bins,
             rows_per_block=min(rows_per_block, _pallas_blk(hist_dtype, n_bins)),
-            compute_dtype=jnp.dtype(hist_dtype).type)         # [K, F, B, C]
+            compute_dtype=jnp.dtype(hist_dtype).type,
+            interpret=interp)                                 # [K, F, B, C]
     else:
         sel = lor[None, :] == leaves[:, None]                 # [K, n]
         m = sel.astype(grad.dtype)
@@ -269,7 +406,8 @@ def _rows_leaves_hist(bins_rows: jax.Array, grad: jax.Array,
             compute_dtype=jnp.dtype(hist_dtype).type)
     return histogram_for_leaves_masked(
         jnp.asarray(bins_rows).T, grad, hess, lor, leaves, None,
-        n_bins=n_bins, rows_per_block=rows_per_block, hist_dtype=hist_dtype)
+        n_bins=n_bins, rows_per_block=rows_per_block, hist_dtype=hist_dtype,
+        hist_kernel="onehot")
 
 
 # test hook: lets the CPU suite exercise the payload Pallas kernel via the
@@ -307,7 +445,10 @@ def histogram_for_leaves_auto(bins_rows: jax.Array, bins_t: jax.Array,
                               buckets=(4, 8, 16, 64),
                               counts: Optional[jax.Array] = None,
                               bins_words: Optional[jax.Array] = None,
-                              sort_key: Optional[jax.Array] = None
+                              sort_key: Optional[jax.Array] = None,
+                              hist_kernel: str = "auto",
+                              bins_words_t: Optional[jax.Array] = None,
+                              payload: Optional[jax.Array] = None
                               ) -> jax.Array:
     """K-leaf histograms with frontier compaction -> f32 [K, F, B, C].
 
@@ -337,7 +478,14 @@ def histogram_for_leaves_auto(bins_rows: jax.Array, bins_t: jax.Array,
     ``sort_key`` (i32 [n], optional): precomputed (selected ? row :
     row | 2^30) keys from the fused partition kernel (ops/round_fuse.py);
     built here from the membership mask otherwise.
+    ``payload`` (i32 [n, W+3], optional): the full compaction payload
+    already emitted by the payload-fused partition kernel
+    (ops/round_fuse.py ``partition_payload_pallas``) — skips the XLA
+    concat entirely (round-6 glue elimination).
+    ``hist_kernel``/``bins_words_t``: masked-pass formulation + packed
+    mirror, forwarded to ``histogram_for_leaves_masked``.
     """
+    hist_kernel = resolve_hist_kernel(hist_kernel)
     n = grad.shape[0]
     leaves = jnp.asarray(leaves, jnp.int32)
     lor = jnp.asarray(leaf_of_row, jnp.int32)
@@ -372,26 +520,32 @@ def histogram_for_leaves_auto(bins_rows: jax.Array, bins_t: jax.Array,
         if s < n and s not in sizes:
             sizes.append(s)
 
-    def full_branch(_):
+    def full_branch(operands):
         return histogram_for_leaves_masked(
             bins_t, grad, hess, lor, leaves, None, n_bins=n_bins,
-            rows_per_block=rows_per_block, hist_dtype=hist_dtype)
+            rows_per_block=rows_per_block, hist_dtype=hist_dtype,
+            hist_kernel=hist_kernel, bins_words_t=bins_words_t)
 
     def make_branch(S: int):
         def branch(operands):
-            key_, grad_, hess_, lor_ = operands
-            # One payload matrix holding (bin words, grad, hess, leaf) so
-            # the branch does a SINGLE contiguous row gather — separate
-            # gathers are DMA-descriptor bound (~9 ns/row each).  The bin
-            # words are the hoisted tree-invariant view; only 12 bytes per
-            # row are fresh.  Built INSIDE the branch so full-pass rounds
-            # skip the concat and the sort entirely.
-            payload_ = jnp.concatenate([
-                bins_words,
-                lax.bitcast_convert_type(grad_, jnp.int32)[:, None],
-                lax.bitcast_convert_type(hess_, jnp.int32)[:, None],
-                lor_[:, None],
-            ], axis=1)                                        # [n, W+3] i32
+            if payload is not None:
+                key_, payload_ = operands
+            else:
+                key_, grad_, hess_, lor_ = operands
+                # One payload matrix holding (bin words, grad, hess, leaf)
+                # so the branch does a SINGLE contiguous row gather —
+                # separate gathers are DMA-descriptor bound (~9 ns/row
+                # each).  The bin words are the hoisted tree-invariant
+                # view; only 12 bytes per row are fresh.  Built INSIDE the
+                # branch so full-pass rounds skip the concat and the sort
+                # entirely.  (The payload-fused partition kernel hands the
+                # matrix in pre-built instead — ops/round_fuse.py.)
+                payload_ = jnp.concatenate([
+                    bins_words,
+                    lax.bitcast_convert_type(grad_, jnp.int32)[:, None],
+                    lax.bitcast_convert_type(hess_, jnp.int32)[:, None],
+                    lor_[:, None],
+                ], axis=1)                                    # [n, W+3] i32
             idxc = jnp.sort(key_, stable=False)[:S] & ((1 << 30) - 1)
             pc = payload_[idxc]                               # [S, W+3]
             if _use_payload_kernel():
@@ -420,7 +574,9 @@ def histogram_for_leaves_auto(bins_rows: jax.Array, bins_t: jax.Array,
     j = jnp.int32(0)
     for k, s in enumerate(sizes):  # sizes descending: smallest fit wins
         j = jnp.where(cnt <= s, jnp.int32(k + 1), j)
-    hist = lax.switch(j, branches, (sort_key, grad, hess, lor))
+    operands = (sort_key, payload) if payload is not None \
+        else (sort_key, grad, hess, lor)
+    hist = lax.switch(j, branches, operands)
     if axis_name is not None:
         hist = lax.psum(hist, axis_name)
     return hist
@@ -493,15 +649,20 @@ def root_histogram(bins_t: jax.Array, grad: jax.Array, hess: jax.Array,
                    row_mask: Optional[jax.Array] = None, *,
                    n_bins: int = 256, rows_per_block: int = 4096,
                    hist_dtype: str = "float32",
-                   axis_name: Optional[str] = None) -> jax.Array:
+                   axis_name: Optional[str] = None,
+                   hist_kernel: str = "auto",
+                   bins_words_t: Optional[jax.Array] = None) -> jax.Array:
     """Root histogram from the TRANSPOSED [F, n] bin matrix."""
-    if use_pallas():
-        # single-leaf delegation picks the radix kernel when bins allow
+    hist_kernel = resolve_hist_kernel(hist_kernel)
+    if use_pallas() or _MODE_TEST_INTERPRET:
+        # single-leaf delegation picks the mode kernel (radix single
+        # under auto when bins allow, packed/radix2/flat otherwise)
         lor = jnp.zeros(grad.shape, jnp.int32)
         return histogram_for_leaf_masked(
             bins_t, grad, hess, lor, jnp.int32(0), row_mask, n_bins=n_bins,
             rows_per_block=rows_per_block, hist_dtype=hist_dtype,
-            axis_name=axis_name)
+            axis_name=axis_name, hist_kernel=hist_kernel,
+            bins_words_t=bins_words_t)
     m = jnp.ones_like(grad) if row_mask is None else row_mask.astype(grad.dtype)
     vals_t = jnp.stack([jnp.where(m > 0, grad, 0.0),
                         jnp.where(m > 0, hess, 0.0), m,

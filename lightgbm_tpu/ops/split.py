@@ -77,6 +77,11 @@ class SplitHyper:
     # docs/PERF_NOTES.md; the speed mode the benchmark uses, analogous to
     # the reference GPU docs recommending single precision).
     hist_dtype: str = "float32"
+    # histogram-build formulation (ops/histogram.py HIST_KERNELS):
+    # "auto" = measured dispatch incl. the round-6 packed / shared-radix
+    # kernels, "onehot" = the flat one-hot reference path, "packed" /
+    # "radix2" = force a formulation.  All modes are bit-identical.
+    hist_kernel: str = "auto"
     # per-leaf histogram strategy: "masked" = flat full-data pass with
     # non-leaf rows zeroed (no compaction; TPU-friendly), "bucketed" =
     # nonzero+gather into power-of-two buckets (wins only when leaves are

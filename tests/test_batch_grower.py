@@ -236,23 +236,30 @@ def test_batched_monotone_respected():
     assert (np.diff(pred) >= -1e-6).all()
 
 
-def test_warmup_rounds_same_tree_large_n():
-    """The width-matched warmup rounds (n >= 65536 gate) change kernel
-    shapes, not selection: the grown tree matches the no-warmup result on
-    identical inputs."""
+def test_warmup_rounds_same_tree_large_n(monkeypatch):
+    """The width-matched warmup rounds change kernel shapes, not
+    selection: the grown tree matches the no-warmup result on identical
+    inputs.  Round 6 gates the ladder to configs whose masked pass takes
+    the K-scaling radix-joint kernel (auto dispatch, >= 128 bins —
+    ops/histogram.py ladder_profitable), so the test runs there, with
+    the row gate patched down to keep it CPU-cheap."""
+    import lightgbm_tpu.learner.batch_grower as BG
+    monkeypatch.setattr(BG, "_WARMUP_MIN_ROWS", 1024)
     rng = np.random.default_rng(4)
-    n, f = 70_000, 6
-    bins = rng.integers(0, 31, size=(n, f)).astype(np.uint8)
-    logit = (bins[:, 0] / 16.0 - 1.0) + 0.5 * (bins[:, 1] > 20)
+    n, f = 6000, 6
+    bins = rng.integers(0, 128, size=(n, f)).astype(np.uint8)
+    logit = (bins[:, 0] / 64.0 - 1.0) + 0.5 * (bins[:, 1] > 80)
     y = (logit + rng.normal(scale=0.5, size=n) > 0).astype(np.float32)
     g = (1 / (1 + np.exp(-logit)) - y).astype(np.float32)
     h = np.full(n, 0.25, np.float32)
-    hp = SplitHyper(num_leaves=15, min_data_in_leaf=5, n_bins=32)
+    hp = SplitHyper(num_leaves=15, min_data_in_leaf=5, n_bins=128)
     args = (jnp.asarray(bins), jnp.asarray(g), jnp.asarray(h), None,
-            jnp.asarray(np.full(f, 31, np.int32)),
+            jnp.asarray(np.full(f, 128, np.int32)),
             jnp.asarray(np.full(f, -1, np.int32)),
             jnp.asarray(np.zeros(f, bool)), None, hp)
-    t_warm, lor_warm = grow_tree_batched(*args, batch=4)   # warmup active
+    from lightgbm_tpu.ops.histogram import ladder_profitable
+    assert ladder_profitable(hp.hist_kernel, hp.n_bins)
+    t_warm, lor_warm = grow_tree_batched.__wrapped__(*args, batch=4)
     t_ref, lor_ref = grow_tree_batched(*args, batch=4, warmup=False)
     # the warmup widths always cover the whole frontier (frontier after r
     # rounds <= 2^r), so the grown tree must be IDENTICAL, not just equal

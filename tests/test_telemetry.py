@@ -206,9 +206,10 @@ def test_batched_fallback_warns_and_counts(synthetic_binary):
     assert bst._gbdt.metrics.counter("batched_path_fallbacks") == 1
 
 
-def test_forced_splits_pool_fallback_counts(tmp_path, synthetic_binary):
-    """Forced splits force the bounded pool off — warned and tallied as
-    hist_pool_fallbacks so the silent slow path stays visible."""
+def test_forced_splits_pool_composes_no_fallback(tmp_path, synthetic_binary):
+    """Forced splits COMPOSE with the bounded pool since round 6 (the
+    batched forced phase derives evicted leaves' columns directly) — no
+    hist_pool_fallbacks tally, pool slots engaged."""
     X, y = synthetic_binary
     forced = tmp_path / "forced.json"
     forced.write_text(json.dumps({"feature": 0, "threshold": 0.0}))
@@ -217,7 +218,8 @@ def test_forced_splits_pool_fallback_counts(tmp_path, synthetic_binary):
          "forcedsplits_filename": str(forced)}
     ds = lgb.Dataset(X[:300], label=y[:300], params=p)
     bst = lgb.Booster(params=p, train_set=ds)
-    assert bst._gbdt.metrics.counter("hist_pool_fallbacks") == 1
+    assert bst._gbdt.metrics.counter("hist_pool_fallbacks") == 0
+    assert 0 < bst._gbdt.hp.hist_pool_slots < bst._gbdt.hp.num_leaves
 
 
 def test_memory_snapshot_shape():

@@ -1,0 +1,147 @@
+#!/usr/bin/env python3
+"""Compare two BENCH_r*.json captures and flag throughput regressions.
+
+Round-6 satellite of the bench capture protocol: VERDICT r5 #2 showed a
+round could quietly ship a flagship number 2x off its re-runs.  With
+bench.py now refusing noisy captures outright, this tool closes the
+other half of the loop — CI (or a human) diffs the new round's capture
+against the previous one and gets a nonzero exit when the headline (or
+any shared sub-measurement) regressed beyond tolerance.
+
+Accepts either the driver wrapper layout ({"parsed": {...}}, the
+BENCH_r*.json files at the repo root) or a bare bench.py payload line.
+Comparable metrics: the headline ``vs_baseline`` (higher = faster,
+normalized against the fixed reference-CPU anchor so two captures of
+different rounds stay comparable) and ``speed_mode_bins63.vs_baseline``
+when both captures carry it.
+
+Exit codes (tools/_report.py convention):
+  0 — comparable, no regression beyond --threshold,
+  1 — at least one regression beyond --threshold,
+  2 — unusable input (missing file, unparseable JSON, no headline, a
+      refused/noisy capture, or mismatched metric names).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _report  # noqa: E402
+
+
+def load_payload(path: str) -> Dict[str, Any]:
+    """BENCH_r*.json wrapper or bare bench payload -> the payload dict.
+
+    Raises ValueError with a reason for every unusable shape."""
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+    except OSError as e:
+        raise ValueError("cannot read %s: %s" % (path, e))
+    except json.JSONDecodeError as e:
+        raise ValueError("unparseable JSON in %s: %s" % (path, e))
+    if not isinstance(obj, dict):
+        raise ValueError("%s: top-level JSON is not an object" % path)
+    payload = obj.get("parsed", obj)
+    if not isinstance(payload, dict) or "metric" not in payload:
+        raise ValueError("%s: no bench payload (missing 'metric')" % path)
+    if payload.get("quality") == "noisy":
+        raise ValueError("%s: capture was refused as noisy "
+                         "(rejected_value=%s) — not comparable evidence"
+                         % (path, payload.get("rejected_value")))
+    if not isinstance(payload.get("vs_baseline"), (int, float)) \
+            or payload["vs_baseline"] <= 0:
+        raise ValueError("%s: no positive vs_baseline headline "
+                         "(value=%r)" % (path, payload.get("vs_baseline")))
+    return payload
+
+
+def _series(payload: Dict[str, Any]) -> List[Tuple[str, float]]:
+    """(name, vs_baseline) rows this payload carries, headline first."""
+    rows = [("headline", float(payload["vs_baseline"]))]
+    sub = payload.get("speed_mode_bins63")
+    if isinstance(sub, dict) and \
+            isinstance(sub.get("vs_baseline"), (int, float)) \
+            and sub["vs_baseline"] > 0:
+        rows.append(("speed_mode_bins63", float(sub["vs_baseline"])))
+    return rows
+
+
+def compare(old: Dict[str, Any], new: Dict[str, Any],
+            threshold: float) -> Dict[str, Any]:
+    if old.get("metric") != new.get("metric"):
+        raise ValueError(
+            "metric mismatch: %r vs %r — different bench configurations "
+            "are not comparable" % (old.get("metric"), new.get("metric")))
+    old_rows = dict(_series(old))
+    rows = []
+    for name, new_vb in _series(new):
+        if name not in old_rows:
+            continue
+        old_vb = old_rows[name]
+        # vs_baseline is work/seconds against a FIXED anchor, so the
+        # ratio of two captures is the throughput ratio
+        change = new_vb / old_vb - 1.0
+        rows.append({
+            "series": name,
+            "old_vs_baseline": old_vb,
+            "new_vs_baseline": new_vb,
+            "change_pct": round(100.0 * change, 2),
+            "regression": bool(change < -threshold),
+        })
+    return {
+        "tool": "bench_compare",
+        "metric": new.get("metric"),
+        "threshold_pct": round(100.0 * threshold, 2),
+        "old_platform": old.get("platform"),
+        "new_platform": new.get("platform"),
+        "rows": rows,
+        "regressions": [r["series"] for r in rows if r["regression"]],
+    }
+
+
+def _render_text(payload: Dict[str, Any]) -> str:
+    lines = ["bench_compare: %s (threshold %.1f%%)"
+             % (payload["metric"], payload["threshold_pct"])]
+    for r in payload["rows"]:
+        flag = "REGRESSION" if r["regression"] else "ok"
+        lines.append("  %-18s %8.4f -> %8.4f  (%+.2f%%)  %s"
+                     % (r["series"], r["old_vs_baseline"],
+                        r["new_vs_baseline"], r["change_pct"], flag))
+    if not payload["rows"]:
+        lines.append("  (no shared series)")
+    if payload["old_platform"] != payload["new_platform"]:
+        lines.append("  note: platforms differ (%s vs %s)"
+                     % (payload["old_platform"], payload["new_platform"]))
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Diff two BENCH_r*.json captures; nonzero exit on a "
+                    "throughput regression beyond the threshold.")
+    ap.add_argument("old", help="previous round's BENCH_r*.json")
+    ap.add_argument("new", help="this round's BENCH_r*.json")
+    ap.add_argument("--threshold", type=float, default=0.05,
+                    help="relative regression tolerance (default 0.05)")
+    _report.add_format_arg(ap)
+    args = ap.parse_args(argv)
+    try:
+        old = load_payload(args.old)
+        new = load_payload(args.new)
+        result = compare(old, new, args.threshold)
+    except ValueError as e:
+        print("bench_compare: error: %s" % e, file=sys.stderr)
+        return _report.EXIT_ERROR
+    _report.emit(result, args.format, _render_text)
+    return _report.EXIT_FINDINGS if result["regressions"] \
+        else _report.EXIT_OK
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
