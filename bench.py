@@ -373,7 +373,11 @@ def main_e2e():
 
 def _time_kernel_run(feat, label, max_bin, hist_dtype):
     """Scan-chained BENCH_ITERS training iterations at one bin width;
-    returns wall seconds (steady-state, post-warmup)."""
+    returns ``(compile_s, run_s)`` — first-call wall minus steady run
+    (trace + XLA compile + warmup dispatch), and the steady-state
+    post-warmup wall.  Splitting the two makes compile-time regressions
+    (ISSUE 7: recompiles that the process cache should absorb) visible
+    in the BENCH line instead of hiding inside a single number."""
     import jax
     import jax.numpy as jnp
     from lightgbm_tpu.learner.batch_grower import grow_tree_batched
@@ -443,12 +447,15 @@ def _time_kernel_run(feat, label, max_bin, hist_dtype):
         return scores
 
     scores = jnp.zeros(n, jnp.float32)
+    t0 = time.time()
     out = run(scores, bins_d, label_d)    # compile + warmup
     float(out[0])                  # force readback through the tunnel
+    first_s = time.time() - t0
     t0 = time.time()
     out = run(scores, bins_d, label_d)
     float(out[0])
-    return time.time() - t0
+    run_s = time.time() - t0
+    return max(first_s - run_s, 0.0), run_s
 
 
 def main():
@@ -468,12 +475,14 @@ def main():
     # recommendation).  BENCH_HIST_DTYPE=bfloat16/float32 to A/B.
     hist_dtype = os.environ.get("BENCH_HIST_DTYPE", "int8")
     capture = _capture_quality()
-    elapsed = _time_kernel_run(feat, label, MAX_BIN, hist_dtype)
+    compile_s, elapsed = _time_kernel_run(feat, label, MAX_BIN, hist_dtype)
     baseline_equiv = BASELINE_S_PER_ROW_ITER * n * BENCH_ITERS
     payload = {
         "metric": f"higgs_synth_{n}rows_{BENCH_ITERS}iters_leaves{NUM_LEAVES}",
         "value": round(elapsed, 3),
         "unit": "seconds",
+        "compile_s": round(compile_s, 3),
+        "run_s": round(elapsed, 3),
         "vs_baseline": round(baseline_equiv / elapsed, 4),
         "platform": jax.devices()[0].platform,
         "hist_kernel": HIST_KERNEL,
@@ -485,9 +494,10 @@ def main():
         # the same line — vs_baseline stays normalized against the
         # published 255-bin CPU run, exactly like the reference's own
         # 63-bin GPU chart
-        e63 = _time_kernel_run(feat, label, 63, hist_dtype)
+        c63, e63 = _time_kernel_run(feat, label, 63, hist_dtype)
         payload["speed_mode_bins63"] = {
             "value": round(e63, 3),
+            "compile_s": round(c63, 3),
             "vs_baseline": round(baseline_equiv / e63, 4),
         }
     # sampled AFTER the timed runs so peak covers the measurement itself

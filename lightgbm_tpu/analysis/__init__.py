@@ -8,6 +8,8 @@ Rule families (stable IDs; full catalog in docs/STATIC_ANALYSIS.md):
   * ``CFG2xx`` — config-registry contracts: every param read registered
     in config.py, no dead registered keys, docs/Parameters.md in sync.
   * ``OBS3xx`` — telemetry contracts: counter names declared once.
+  * ``GRW4xx`` — grower capability contracts: fallback-to-strict
+    branches in ``learner/`` need a justified suppression entry.
   * ``LNT0xx`` — lint infrastructure (syntax errors, malformed/stale
     suppressions).
 
@@ -22,6 +24,7 @@ in ``tools/tpulint_suppressions.txt``.
 """
 
 from . import contracts  # noqa: F401 — rule registration side effect
+from . import grwrules   # noqa: F401 — rule registration side effect
 from . import jaxrules   # noqa: F401 — rule registration side effect
 from .cli import build_rules, main
 from .core import (FileContext, LintRun, LintRunner, Rule, Violation,

@@ -9,9 +9,9 @@ constraints that shape everything here:
     package by file path precisely so that ``lightgbm_tpu/__init__``
     (which imports jax) never runs.
   * rules carry **stable IDs** (TPU1xx = JAX/TPU hazards, CFG2xx =
-    config-registry contracts, OBS3xx = telemetry contracts, LNT0xx =
-    lint-infrastructure diagnostics) so suppressions stay valid across
-    refactors.
+    config-registry contracts, OBS3xx = telemetry contracts, GRW4xx =
+    grower capability contracts, LNT0xx = lint-infrastructure
+    diagnostics) so suppressions stay valid across refactors.
   * suppression is per-line (``# tpulint: disable=RULE[,RULE]``) or via a
     checked-in suppression file whose every entry requires a
     justification (see :class:`SuppressionFile`).
@@ -186,12 +186,42 @@ class SuppressionFile:
                 kept.append(v)
         return kept
 
-    def stale_entries(self) -> List[Violation]:
+    def stale_entries(self, linted_relpaths: Optional[Set[str]] = None,
+                      root: Optional[str] = None) -> List[Violation]:
+        """Unused entries that this run can actually JUDGE stale.
+
+        Staleness is a package-scope verdict: an entry pointing at a
+        file that exists under ``root`` but was not in this run's file
+        set (a single-file lint) is undecidable — only the full run, or
+        a run that linted the entry's target, may report it.  An entry
+        whose path substring matches no file on disk at all is stale in
+        any run.
+        """
         rel = os.path.basename(self.path) if self.path else "suppressions"
-        return [Violation("LNT004", SEVERITY_WARNING, rel, e.lineno, 0,
-                          f"stale suppression (matched nothing): "
-                          f"{e.rule_id} | {e.path_substr} | {e.line_substr}")
-                for e in self.entries if not e.used]
+        on_disk: Optional[List[str]] = None
+        if linted_relpaths is not None and root is not None:
+            on_disk = []
+            for dirpath, dirnames, filenames in os.walk(root):
+                dirnames[:] = [d for d in dirnames if d != "__pycache__"
+                               and not d.startswith(".")]
+                for fn in filenames:
+                    if fn.endswith(".py"):
+                        p = os.path.relpath(os.path.join(dirpath, fn), root)
+                        on_disk.append(p.replace(os.sep, "/"))
+        out = []
+        for e in self.entries:
+            if e.used:
+                continue
+            if linted_relpaths is not None and not any(
+                    e.path_substr in r for r in linted_relpaths):
+                if on_disk is not None and any(
+                        e.path_substr in r for r in on_disk):
+                    continue      # target exists but was out of scope
+            out.append(Violation(
+                "LNT004", SEVERITY_WARNING, rel, e.lineno, 0,
+                f"stale suppression (matched nothing): "
+                f"{e.rule_id} | {e.path_substr} | {e.line_substr}"))
+        return out
 
 
 class LintRun:
@@ -293,7 +323,10 @@ class LintRunner:
             for i in range(1, len(ctx.lines) + 1):
                 line_text[(ctx.relpath, i)] = ctx.line_text(i)
         violations = self.suppressions.filter(violations, line_text)
-        violations.extend(self.suppressions.stale_entries())
+        violations.extend(self.suppressions.stale_entries(
+            linted_relpaths={c.relpath.replace(os.sep, "/")
+                             for c in run.contexts},
+            root=self.root))
         violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule_id))
         stats: Dict[str, object] = {
             "files_checked": len(files),

@@ -1,0 +1,84 @@
+"""Grower/learner capability rules (GRW4xx).
+
+The batched and distributed growers (``learner/batch_grower.py``,
+``learner/grower.py``) do not support every feature combination the
+strict serial learner does — forced splits under voting, advanced
+monotone under voting, CEGB under any distributed mode.  Those gaps are
+legitimate, but each one is a silent capability cliff: a user flips one
+config knob and the booster quietly reroutes whole trees through the
+slow strict path (or refuses outright).  Round 6 audited the existing
+carve-outs; GRW401 freezes that audit.  Every assert/raise/warning text
+in ``learner/`` that routes a feature to the "strict learner"/"strict
+grower" must carry a justified entry in the checked-in suppression file
+— so a NEW fallback branch cannot land without a reviewer reading its
+justification, and a removed one leaves a stale entry (LNT004) that
+forces the suppression file to shrink with it.
+
+Lexical by design, like the TPU1xx family: the carve-outs announce
+themselves in their message strings (that is what makes them debuggable
+at 2am), so the message string is the stable thing to key on.
+Docstrings and comments are exempt — they describe the cadence the
+batched grower *matches*, not a branch that abandons it.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Set
+
+from .core import (FileContext, Rule, SEVERITY_ERROR, Violation,
+                   register_rule)
+
+#: the phrases a fallback branch's message uses to name the reroute
+#: target.  Matched case-insensitively against STRING CONSTANTS only
+#: (asserts, raises, log/warning calls) — never docstrings or comments.
+_FALLBACK_PHRASES = ("strict learner", "strict grower")
+
+
+@register_rule
+class StrictLearnerFallback(Rule):
+    id = "GRW401"
+    name = "strict-learner-fallback"
+    severity = SEVERITY_ERROR
+    description = ("learner/ branch routes a feature combination back to "
+                   "the strict serial learner — each such capability "
+                   "carve-out needs a justified suppression-file entry")
+
+    def _applies(self, ctx: FileContext) -> bool:
+        rel = ctx.relpath.replace("\\", "/")
+        return "learner/" in rel or rel.startswith("learner")
+
+    def _docstring_ids(self, tree: ast.Module) -> Set[int]:
+        """ids of Constant nodes in docstring / bare-string-statement
+        position (module, class, def bodies AND standalone ``Expr``
+        strings) — prose, not fallback-branch messages."""
+        out: Set[int] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Expr) and \
+                    isinstance(node.value, ast.Constant) and \
+                    isinstance(node.value.value, str):
+                out.add(id(node.value))
+        return out
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        if not self._applies(ctx):
+            return
+        prose = self._docstring_ids(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)):
+                continue
+            if id(node) in prose:
+                continue
+            low = node.value.lower()
+            phrase = next((p for p in _FALLBACK_PHRASES if p in low), None)
+            if phrase is None:
+                continue
+            yield self.violation(
+                ctx, node.lineno, node.col_offset,
+                f"fallback-to-strict branch (message names the "
+                f"`{phrase}`) — capability carve-outs in learner/ "
+                "require a justified entry in "
+                "tools/tpulint_suppressions.txt; either support the "
+                "combination in this grower or add the entry with the "
+                "reason it cannot be supported")

@@ -36,6 +36,7 @@ from ..metrics import Metric, create_metrics
 from ..models.predict import predict_bins_leaf, predict_bins_tree
 from ..models.tree import Tree
 from ..objectives import ObjectiveFunction, create_objective
+from ..ops.compile_cache import get_or_build as cc_get_or_build, sig as cc_sig
 from ..ops.quantize import (discretize_gradients_levels,
                             renew_leaf_values)
 from ..ops.split import SplitHyper
@@ -235,10 +236,11 @@ class GBDT:
         self._pad_rows = 0
         self._pad_cols = 0
         tl = {"data_parallel": "data", "voting_parallel": "voting",
-              "feature_parallel": "feature"}.get(str(config.tree_learner),
-                                                 str(config.tree_learner))
+              "feature_parallel": "feature",
+              "gspmd": "data_gspmd"}.get(str(config.tree_learner),
+                                         str(config.tree_learner))
         n_dev = jax.device_count()
-        if tl in ("data", "voting", "feature") and n_dev > 1:
+        if tl in ("data", "voting", "feature", "data_gspmd") and n_dev > 1:
             from jax.sharding import Mesh
             from ..parallel.feature_parallel import FEATURE_AXIS
             from ..parallel.mesh import DATA_AXIS
@@ -282,6 +284,22 @@ class GBDT:
                     self.nan_bin_arr = jnp.pad(self.nan_bin_arr, (0, pad_f),
                                                constant_values=-1)
                     self.is_cat_arr = jnp.pad(self.is_cat_arr, (0, pad_f))
+            elif tl == "data_gspmd":
+                # GSPMD: no explicit shard_map — the ordinary serial code
+                # paths run over row-sharded arrays and XLA's partitioner
+                # inserts the collectives (parallel/gspmd.py).  No row
+                # padding and no per-mode grower dispatch; when n does
+                # not divide the mesh, placement falls back to
+                # replicated (device_put refuses uneven shards) and the
+                # program runs unpartitioned but correct.
+                if train_set.num_data % n_dev:
+                    log.warning(
+                        f"tree_learner=data_gspmd: {train_set.num_data} "
+                        f"rows do not divide the {n_dev}-device mesh; "
+                        "arrays stay replicated (unpartitioned). Use "
+                        "tree_learner=data for padded sharding of "
+                        "uneven row counts.")
+                self.bins = self._place_rows(self.bins)
             else:
                 # pad rows so n divides the mesh (padded rows masked out)
                 self._pad_rows = (-train_set.num_data) % n_dev
@@ -304,7 +322,7 @@ class GBDT:
 
         n = train_set.num_data
         k = self.num_tree_per_iteration
-        self.scores = jnp.zeros((n, k), jnp.float32)
+        self.scores = self._place_rows(jnp.zeros((n, k), jnp.float32))
         self.init_scores = np.zeros(k)
         self._init_base_score()
 
@@ -330,6 +348,28 @@ class GBDT:
         """Bump a telemetry counter in this booster's registry and the
         process-global one (obs/metrics.py)."""
         count_event(name, value, self.metrics)
+
+    def _place_rows(self, x):
+        """Under ``tree_learner=data_gspmd``, place ``x`` with dim 0
+        sharded over the data mesh (the GSPMD partitioner keys off input
+        shardings — parallel/gspmd.py); identity in every other mode."""
+        if self.parallel_mode == "data_gspmd" and self.mesh is not None \
+                and x is not None:
+            from ..parallel.gspmd import row_sharded
+            return row_sharded(self.mesh, x)
+        return x
+
+    def _config_signature(self):
+        """Canonical-config signature for process compile-cache keys:
+        every registered parameter's repr, sorted.  Conservatively
+        over-keyed — any config difference forces a fresh cache entry,
+        which is always correct: the fused runner closes over booster
+        state derived from (config, datasets) only, and the datasets
+        enter the key as anchors (ops/compile_cache.py)."""
+        from ..config import _CANONICAL
+        c = self.config
+        return tuple((name, repr(getattr(c, name, None)))
+                     for name in sorted(_CANONICAL))
 
     def _hist_rounds_per_tree(self) -> int:
         """Analytic histogram-pass count one grown tree costs: the strict
@@ -357,7 +397,9 @@ class GBDT:
         rounds = self._hist_rounds_per_tree()
         B = self.hp.n_bins
         F = self.bins.shape[1]
-        if self.parallel_mode == "data":
+        if self.parallel_mode in ("data", "data_gspmd"):
+            # data_gspmd reduces the same logical histogram payload; the
+            # partitioner, not shard_map, chooses the wire schedule
             return rounds * F * B * 3 * 4
         if self.parallel_mode == "voting":
             return splits * 2 * int(self.config.top_k) * B * 3 * 4
@@ -434,6 +476,20 @@ class GBDT:
         # numeric guard policy (robustness/guards.py); validated by
         # Config.check_param_conflict, re-derived on reset_config
         self.nan_policy = str(config.nan_policy or "none")
+        # collective_overlap (ISSUE 7): "on" forces the chunked
+        # overlapped-psum schedule, "off" the single blocking psum,
+        # "auto" engages it exactly where the explicit shard_map modes
+        # issue per-round collectives the scheduler can hide.  The GSPMD
+        # mode ignores it (the partitioner owns the schedule), and
+        # LGBMTPU_NO_OVERLAP kills it at trace time either way
+        # (ops/histogram.py reduce_hist).
+        ov = str(config.collective_overlap or "auto")
+        if ov not in ("auto", "on", "off"):
+            log.warning("collective_overlap=%r not one of auto/on/off; "
+                        "using 'auto'" % ov)
+            ov = "auto"
+        self._overlap = (ov == "on") or (
+            ov == "auto" and self.parallel_mode in ("data", "voting"))
         self._resolve_auto_params(config)
         self.hp = _hp_from_config(config, train_set.device_n_bins())
         if bool(train_set.categorical_array().any()):
@@ -553,10 +609,13 @@ class GBDT:
         # distributed modes pad rows/columns after construction, so they
         # keep the in-jit derivation
         self.bins_words = None
-        if self.parallel_mode is None:
+        if self.parallel_mode in (None, "data_gspmd"):
+            # data_gspmd qualifies too: it never pads rows, so the
+            # construction-time mirror stays valid (sharded like bins)
             from ..ops.histogram import wants_packed_mirror
             if wants_packed_mirror(self.hp.hist_kernel, self.hp.n_bins):
-                self.bins_words = jnp.asarray(train_set.packed_mirror())
+                self.bins_words = self._place_rows(
+                    jnp.asarray(train_set.packed_mirror()))
 
     def _init_base_score(self) -> None:
         has_init_score = self.train_set.metadata.init_score is not None
@@ -734,14 +793,15 @@ class GBDT:
             self.objective.init(train_set.metadata, train_set.num_data)
         for m in self.train_metrics:
             m.init(train_set.metadata, train_set.num_data)
-        self.bins = jnp.asarray(train_set.bins)
+        self.bins = self._place_rows(jnp.asarray(train_set.bins))
         if getattr(self, "bins_words", None) is not None:
-            self.bins_words = jnp.asarray(train_set.packed_mirror())
+            self.bins_words = self._place_rows(
+                jnp.asarray(train_set.packed_mirror()))
         self.sample_strategy = create_sample_strategy(
             self.config, train_set.num_data)
         n = train_set.num_data
         k = self.num_tree_per_iteration
-        self.scores = jnp.zeros((n, k), jnp.float32)
+        self.scores = self._place_rows(jnp.zeros((n, k), jnp.float32))
         self._init_base_score()
         self.invalidate_score_cache()
 
@@ -1010,7 +1070,9 @@ class GBDT:
                 # the eager per-iteration loop — jit_safe is the single
                 # source of that contract
                 and self.objective.jit_safe
-                and self.parallel_mode is None
+                # data_gspmd runs the fused scan over sharded inputs —
+                # same serial program, partitioner-inserted collectives
+                and self.parallel_mode in (None, "data_gspmd")
                 and not self.linear
                 and self.cegb is None
                 # the per-round numeric guard is a host-side check; the
@@ -1307,7 +1369,15 @@ class GBDT:
                 xs = (qkeys, nkeys, fmasks, iters) if has_fm else \
                     (qkeys, nkeys, iters)
                 return jax.lax.scan(body, (scores, vscores, es0), xs)
-            return jax.jit(run)
+            # donate the train/valid score buffers (args 0 and 7): both
+            # are reassigned from the runner's outputs at the call site,
+            # so the old buffers are dead the moment the call returns —
+            # donation lets XLA update them in place instead of holding
+            # two [n, k] copies live.  CPU buffers cannot be donated
+            # (jax warns and ignores), so gate on accelerator backends.
+            donate = (0, 7) if jax.default_backend() in ("tpu", "gpu") \
+                else ()
+            return jax.jit(run, donate_argnums=donate)
 
         finished = False
         done = 0
@@ -1334,8 +1404,36 @@ class GBDT:
             key = (T, has_fm, nvalid,
                    (es_rounds, es_first) if use_es else None)
             if key not in self._fused_cache:
-                self._count("fused_runner_cache_misses")
-                self._fused_cache[key] = make_runner(T, has_fm)
+                # the booster dict is only a per-train view now; the
+                # compiled runner itself lives in the PROCESS cache, so
+                # a new booster (or reset_config re-derivation) over the
+                # same datasets + config reuses the compiled program
+                # instead of paying XLA again (ISSUE 7 satellite fix).
+                # Keyed on the full config signature + array geometry;
+                # the datasets enter as ANCHORS: their tokens extend the
+                # key (a different dataset with identical shapes cannot
+                # reuse a closure over the old one's device arrays) and
+                # bound the entry's lifetime (no pinned dead HBM).
+                fsig = None if self.forced_splits is None else tuple(
+                    np.asarray(a).tobytes() for a in self.forced_splits)
+                cc_key = ("train_fused", key, k, self._config_signature(),
+                          fsig,
+                          cc_sig((self.scores, self.bins, self.bins_words,
+                                  tuple(self.valid_scores))))
+                built = []
+
+                def _build():
+                    built.append(True)
+                    return make_runner(T, has_fm)
+
+                self._fused_cache[key] = cc_get_or_build(
+                    cc_key, _build,
+                    anchors=(self.train_set, *self.valid_sets),
+                    metrics=self.metrics)
+                if built:
+                    self._count("fused_runner_cache_misses")
+                else:
+                    self._count("fused_runner_cache_hits")
             else:
                 self._count("fused_runner_cache_hits")
             fmasks = None
@@ -1435,7 +1533,12 @@ class GBDT:
         shard_map-distributed mode; reference CreateTreeLearner
         tree_learner.cpp:15).  ``hist_scale``: [2] (g, h) scales in
         quantized-levels mode."""
-        if self.parallel_mode is None:
+        if self.parallel_mode in (None, "data_gspmd"):
+            if self.parallel_mode == "data_gspmd":
+                # serial program over row-sharded inputs: GSPMD inserts
+                # the same logical reductions the explicit path psums
+                self._count("collective_allreduce_bytes_est",
+                            self._collective_bytes_per_tree())
             args = (self.bins, g, h, row_mask, self.num_bins_arr,
                     self.nan_bin_arr, self.is_cat_arr, feature_mask, self.hp)
             if self._use_batched_grower():
@@ -1483,6 +1586,10 @@ class GBDT:
             h = jnp.pad(h, (0, p))
             row_mask = jnp.pad(jnp.ones(g.shape[0] - p, bool)
                                if row_mask is None else row_mask, (0, p))
+        overlap = self._overlap
+        if overlap:
+            self._count("collective_overlap_rounds",
+                        self._hist_rounds_per_tree())
         if self.parallel_mode in ("data", "voting") \
                 and self._use_batched_grower():
             with obs_trace.span("collective_grow_dispatch",
@@ -1495,7 +1602,8 @@ class GBDT:
                     monotone=self.monotone_arr, hist_scale=hist_scale,
                     interaction_sets=self.interaction_sets,
                     parallel_mode=self.parallel_mode,
-                    top_k=int(self.config.top_k))
+                    top_k=int(self.config.top_k), overlap=overlap,
+                    metrics=self.metrics)
             return arrays, (lor[:-p] if p else lor)
         with obs_trace.span("collective_grow_dispatch",
                             mode=self.parallel_mode, batched=False):
@@ -1505,7 +1613,8 @@ class GBDT:
                 bundle=self.bundle, parallel_mode=self.parallel_mode,
                 top_k=int(self.config.top_k), monotone=self.monotone_arr,
                 rng_key=node_key, interaction_sets=self.interaction_sets,
-                forced=self.forced_splits, hist_scale=hist_scale)
+                forced=self.forced_splits, hist_scale=hist_scale,
+                overlap=overlap, metrics=self.metrics)
         return arrays, (lor[:-p] if p else lor)
 
     def _use_batched_grower(self) -> bool:
@@ -1538,8 +1647,10 @@ class GBDT:
         voting_unsupported = self.parallel_mode == "voting" and \
             self.forced_splits is not None
         # extra_trees / by-node sampling need per-node rng keys, which the
-        # sharded batched wrapper does not plumb yet — serial only
-        rng_parallel = self.parallel_mode is not None and (
+        # sharded batched wrapper does not plumb yet — serial only.
+        # data_gspmd runs the SERIAL code path (keys plumb normally), so
+        # it is exempt like serial.
+        rng_parallel = self.parallel_mode not in (None, "data_gspmd") and (
             self.hp.extra_trees or self.hp.feature_fraction_bynode < 1.0
             or self.forced_splits is not None)
         # CEGB is batched-capable (batch_grower round-4 lift); it only
@@ -1550,7 +1661,8 @@ class GBDT:
             ("extra_trees/bynode-sampling/forced-splits-under-"
              "distributed", rng_parallel),
             ("unsupported-parallel-mode",
-             self.parallel_mode not in (None, "data", "voting")),
+             self.parallel_mode not in (None, "data", "voting",
+                                        "data_gspmd")),
         ) if hit]
         if reasons:
             log.warning("tpu_split_batch > 1 ignored (%s): falling back "
@@ -1736,12 +1848,21 @@ class GBDT:
                     nan_d = jnp.asarray(
                         np.ascontiguousarray(np.isnan(rchunk).T),
                         jnp.bfloat16)
-                res = predict_bitset_forest(fb, bins_t, k,
-                                            cat_feats=cat_feats,
-                                            lin=lin, raw=raw_d,
-                                            raw_nan=nan_d)
+                # route the (module-jitted) predictor lookup through the
+                # process compile cache so predict programs share the
+                # round_compile_hits/misses telemetry with the round
+                # bodies — a new shape is a counted miss, a repeat a hit
+                fn = cc_get_or_build(
+                    ("predict_bitset_forest",
+                     cc_sig((fb, bins_t, k, cat_feats, lin, raw_d, nan_d))),
+                    lambda: predict_bitset_forest, metrics=self.metrics)
+                res = fn(fb, bins_t, k, cat_feats=cat_feats,
+                         lin=lin, raw=raw_d, raw_nan=nan_d)
             else:
-                res = predict_numeric_forest(fa, bins_t, k)
+                fn = cc_get_or_build(
+                    ("predict_numeric_forest", cc_sig((fa, bins_t, k))),
+                    lambda: predict_numeric_forest, metrics=self.metrics)
+                res = fn(fa, bins_t, k)
             outs.append(np.asarray(res, np.float64)[:rows])
         out = np.concatenate(outs, axis=0)
         return out[:, 0] if k == 1 else out

@@ -152,6 +152,22 @@ def log_telemetry(path: str, period: int = 1) -> Callable:
             if fused_now > state["fused_seen"]:
                 rec["fused_replay"] = True
             state["fused_seen"] = fused_now
+        # XLA compile activity is counted process-globally (the
+        # jax.monitoring listener has no booster handle, obs/
+        # compile_events.py), so the compile-count gate signal rides
+        # every record as a separate scope — cumulative process totals,
+        # not per-booster deltas
+        from .obs.metrics import global_metrics
+        rec["process_counters"] = {
+            "xla_compile_events":
+                global_metrics.counter("xla_compile_events"),
+            "xla_program_lowerings":
+                global_metrics.counter("xla_program_lowerings"),
+            "round_compile_hits":
+                global_metrics.counter("round_compile_hits"),
+            "round_compile_misses":
+                global_metrics.counter("round_compile_misses"),
+        }
         rec.update(mem)
         try:
             with open(path, "a") as f:

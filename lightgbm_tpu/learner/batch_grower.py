@@ -42,8 +42,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..ops.histogram import (bins_to_words, histogram_for_leaves_auto,
-                             ladder_profitable, root_histogram,
-                             wants_packed_mirror)
+                             ladder_profitable, overlap_enabled,
+                             root_histogram, wants_packed_mirror)
 from ..ops.round_fuse import (partition_payload_pallas,
                               partition_select_pallas, use_fused_partition,
                               use_fused_payload)
@@ -62,7 +62,8 @@ _WARMUP_MIN_ROWS = 65536
 
 @functools.partial(jax.jit, static_argnames=("hp", "batch", "axis_name",
                                              "warmup", "parallel_mode",
-                                             "top_k", "num_shards"))
+                                             "top_k", "num_shards",
+                                             "overlap"))
 def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                       row_mask: Optional[jax.Array], num_bins: jax.Array,
                       nan_bin: jax.Array, is_cat: jax.Array,
@@ -80,7 +81,8 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                       parallel_mode: str = "data", top_k: int = 20,
                       num_shards: int = 1,
                       cegb: Optional[CegbInput] = None,
-                      bins_words: Optional[jax.Array] = None):
+                      bins_words: Optional[jax.Array] = None,
+                      overlap: bool = False):
     """Grow one tree with ``batch`` splits per histogram pass.
 
     Same operands and return contract as ``grow_tree`` (a 3-tuple with
@@ -299,7 +301,8 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         bins_t, grad, hess, row_mask, n_bins=hp.n_bins,
         rows_per_block=hp.rows_per_block,
         hist_dtype=hp.hist_dtype, axis_name=hist_axis,
-        hist_kernel=hp.hist_kernel, bins_words_t=words_t))
+        hist_kernel=hp.hist_kernel, bins_words_t=words_t,
+        overlap=overlap))
     g0 = jnp.sum(grad * mask_f)
     h0 = jnp.sum(hess * mask_f)
     c0 = jnp.sum(mask_f)
@@ -307,9 +310,15 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         g0 = g0 * hist_scale[0]
         h0 = h0 * hist_scale[1]
     if axis_name is not None:
-        g0 = lax.psum(g0, axis_name)
-        h0 = lax.psum(h0, axis_name)
-        c0 = lax.psum(c0, axis_name)
+        if overlap_enabled(overlap):
+            # one [3]-vector psum instead of three scalar collectives:
+            # same per-element sums (bit-identical), one less blocking
+            # round-trip for the scheduler to hide
+            g0, h0, c0 = lax.psum(jnp.stack([g0, h0, c0]), axis_name)
+        else:
+            g0 = lax.psum(g0, axis_name)
+            h0 = lax.psum(h0, axis_name)
+            c0 = lax.psum(c0, axis_name)
     root_out = leaf_output(g0, h0, hp.lambda_l1, hp.lambda_l2,
                            hp.max_delta_step)
     empty_path = jnp.zeros((num_f,), bool)
@@ -897,7 +906,7 @@ def grow_tree_batched(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                       hist_dtype=hp.hist_dtype, axis_name=hist_axis,
                       counts=cnts, bins_words=bins_words, sort_key=skey,
                       hist_kernel=hp.hist_kernel, bins_words_t=words_t,
-                      payload=pay))
+                      payload=pay, overlap=overlap))
 
               left_small = (l_cnt <= r_cnt)[:, None, None, None]
               if not pooled:

@@ -38,8 +38,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..ops.histogram import (bins_to_words, histogram_for_leaf_bucketed,
-                             histogram_for_leaf_masked, root_histogram,
-                             wants_packed_mirror)
+                             histogram_for_leaf_masked, overlap_enabled,
+                             root_histogram, wants_packed_mirror)
 from ..ops.split import (NEG_INF, VAR_CAT_BWD, VAR_CAT_FWD, VAR_CAT_ONEHOT,
                          VAR_NUM_RIGHT, SplitHyper, SplitResult,
                          categorical_left_bitset, find_best_split, leaf_gain,
@@ -283,7 +283,7 @@ def _child_best(hist: jax.Array, g: jax.Array, h: jax.Array, c: jax.Array,
 
 @functools.partial(jax.jit, static_argnames=("hp", "axis_name",
                                              "parallel_mode", "top_k",
-                                             "num_shards"))
+                                             "num_shards", "overlap"))
 def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
               row_mask: Optional[jax.Array], num_bins: jax.Array,
               nan_bin: jax.Array, is_cat: jax.Array,
@@ -298,7 +298,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
               num_shards: int = 1,
               cegb: Optional[CegbInput] = None,
               hist_scale: Optional[jax.Array] = None,
-              bins_words: Optional[jax.Array] = None):
+              bins_words: Optional[jax.Array] = None,
+              overlap: bool = False):
     """Grow one tree; returns (TreeArrays, leaf_of_row).
 
     bins: uint8 [n, F]; grad/hess: f32 [n]; row_mask: bool [n] or None
@@ -412,7 +413,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         bins_t, grad, hess, row_mask, n_bins=hp.n_bins,
         rows_per_block=hp.rows_per_block,
         hist_dtype=hp.hist_dtype, axis_name=hist_axis,
-        hist_kernel=hp.hist_kernel, bins_words_t=words_t))
+        hist_kernel=hp.hist_kernel, bins_words_t=words_t,
+        overlap=overlap))
     g0 = jnp.sum(grad * mask_f)
     h0 = jnp.sum(hess * mask_f)
     c0 = jnp.sum(mask_f)
@@ -421,9 +423,14 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         h0 = h0 * hist_scale[1]
     if axis_name is not None and mode != "feature":
         # feature mode holds ALL rows on every shard: sums already global
-        g0 = lax.psum(g0, axis_name)
-        h0 = lax.psum(h0, axis_name)
-        c0 = lax.psum(c0, axis_name)
+        if overlap_enabled(overlap):
+            # one [3]-vector psum (bit-identical per-element sums),
+            # one fewer blocking collective round-trip
+            g0, h0, c0 = lax.psum(jnp.stack([g0, h0, c0]), axis_name)
+        else:
+            g0 = lax.psum(g0, axis_name)
+            h0 = lax.psum(h0, axis_name)
+            c0 = lax.psum(c0, axis_name)
 
     if mode == "voting" and axis_name is not None:
         # locally relaxed validity thresholds
@@ -734,13 +741,15 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                     bins_t, grad, hess, leaf_of_row, smaller, row_mask,
                     n_bins=hp.n_bins, rows_per_block=hp.rows_per_block,
                     hist_dtype=hp.hist_dtype, axis_name=hist_axis,
-                    hist_kernel=hp.hist_kernel, bins_words_t=words_t)
+                    hist_kernel=hp.hist_kernel, bins_words_t=words_t,
+                    overlap=overlap)
             else:
                 h_small = histogram_for_leaf_bucketed(
                     bins, grad, hess, leaf_of_row, smaller,
                     jnp.minimum(lcn, rcn), row_mask,
                     n_bins=hp.n_bins, rows_per_block=hp.rows_per_block,
-                    hist_dtype=hp.hist_dtype, axis_name=hist_axis)
+                    hist_dtype=hp.hist_dtype, axis_name=hist_axis,
+                    overlap=overlap)
             h_small = _scaled(h_small)
             h_parent = st.hist[bl]
             h_large = h_parent - h_small

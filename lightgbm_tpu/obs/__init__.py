@@ -16,11 +16,12 @@ coarse host paths, and no file is ever written unless a ``*_output``
 config key (or the callback) asks for one.
 """
 
-from . import memory, metrics, trace
+from . import compile_events, memory, metrics, trace
 from .metrics import MetricsRegistry, count_event, global_metrics
 
-__all__ = ["trace", "metrics", "memory", "MetricsRegistry",
-           "global_metrics", "count_event", "observe_training"]
+__all__ = ["trace", "metrics", "memory", "compile_events",
+           "MetricsRegistry", "global_metrics", "count_event",
+           "observe_training"]
 
 import contextlib
 from typing import Iterator
@@ -43,6 +44,10 @@ def observe_training(config) -> Iterator[None]:
     telemetry."""
     from ..utils import log
     from ..utils.paths import check_output_path
+    # arm the process-wide XLA compile-event counters (idempotent, one
+    # dict-add per compile) so every observed run's telemetry carries
+    # xla_compile_events / xla_program_lowerings
+    compile_events.install()
     trace_path = str(getattr(config, "trace_output", "") or "")
     profile_dir = str(getattr(config, "profile_dir", "") or "")
     # probe writability only when this session would own the export —

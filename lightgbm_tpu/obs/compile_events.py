@@ -1,0 +1,73 @@
+"""XLA compile-event listener -> telemetry counters.
+
+Recompilation regressions are invisible in test *results* — a cache-key
+bug that recompiles every round body still trains correctly, it just
+silently eats the BENCH headline (ISSUE 7).  ``jax.monitoring`` emits a
+duration event per compile stage; this module folds two of them into the
+telemetry registry so they ride ``Booster.telemetry()``, the
+``log_telemetry`` JSONL and the tier-1 compile-count regression gate
+(tests/test_compile_cache.py):
+
+  * ``/jax/core/compile/backend_compile_duration`` — one per XLA backend
+    compile -> ``xla_compile_events``.  NOT emitted when the persistent
+    compilation cache (tests/.jax_cache) serves the executable, so it
+    undercounts on warmed CI machines.
+  * ``/jax/core/compile/jaxpr_to_mlir_module_duration`` — one per
+    jaxpr->MLIR lowering -> ``xla_program_lowerings``.  Lowering happens
+    on every in-process trace-cache miss regardless of the persistent
+    cache, so this is the deterministic gate signal: N distinct programs
+    lowered is N, cold disk cache or warm.
+
+Listeners are process-global and jax has no targeted unregister, so
+installation is once-per-process and idempotent (``install()``); the
+counters are cheap enough (one dict add per *compile*, not per dispatch)
+to leave permanently armed.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from .metrics import count_event
+
+_INSTALLED: Optional[bool] = None   # None = never attempted
+_LOCK = threading.Lock()
+
+#: event-name fragments -> counter (substring match survives the exact
+#: key names drifting across jax versions, which they historically do)
+_BACKEND_COMPILE = "backend_compile"
+_LOWERING = "jaxpr_to_mlir"
+
+
+def _on_duration_event(event: str, duration: float, **kwargs) -> None:
+    # keyword args (jax >= 0.4.36 passes platform/version tags) are
+    # accepted and ignored; the counter is the artifact
+    if _BACKEND_COMPILE in event:
+        count_event("xla_compile_events")
+    elif _LOWERING in event:
+        count_event("xla_program_lowerings")
+
+
+def install() -> bool:
+    """Arm the process-wide compile-event listener.  Returns True when
+    the listener is (now or already) active, False when this jax build
+    has no ``jax.monitoring`` duration-listener hook (the counters then
+    simply stay at zero — callers never need to branch)."""
+    global _INSTALLED
+    with _LOCK:
+        if _INSTALLED is not None:
+            return _INSTALLED
+        try:
+            from jax import monitoring
+            register = monitoring.register_event_duration_secs_listener
+        except (ImportError, AttributeError):
+            _INSTALLED = False
+            return False
+        register(_on_duration_event)
+        _INSTALLED = True
+        return True
+
+
+def installed() -> bool:
+    return bool(_INSTALLED)

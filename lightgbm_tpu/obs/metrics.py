@@ -39,6 +39,16 @@ COUNTERS: Dict[str, str] = {
     "batched_path_fallbacks": "batched-grower bailouts to the strict path",
     "fused_runner_cache_hits": "fused round-runner compile-cache hits",
     "fused_runner_cache_misses": "fused round-runner compile-cache misses",
+    "round_compile_hits":
+        "process-level compile-cache hits (ops/compile_cache.py)",
+    "round_compile_misses":
+        "process-level compile-cache misses (ops/compile_cache.py)",
+    "collective_overlap_rounds":
+        "histogram rounds dispatched with the overlapped (chunked) psum",
+    "xla_compile_events":
+        "XLA backend compiles observed by the obs/ compile-event listener",
+    "xla_program_lowerings":
+        "jaxpr->MLIR lowerings observed by the obs/ compile-event listener",
     "collective_allreduce_bytes_est":
         "estimated bytes all-reduced across workers (data-parallel)",
     "nan_guard_trips": "rounds where the numeric guard saw non-finite values",

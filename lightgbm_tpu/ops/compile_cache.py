@@ -1,0 +1,205 @@
+"""Process-level compiled-program cache shared across trees, boosters
+and repeated ``train()`` calls.
+
+The round-7 orchestration problem (ISSUE 7, ROADMAP item 1): every
+compiled round body the package builds per *call* — the fused round
+runner ``jax.jit``-ed inside ``GBDT.train_fused``, the ``shard_map``
+wrappers rebuilt per tree in ``parallel/data_parallel.py``, the GSPMD
+fused-scan entry — dies with the object that built it.  Back-to-back
+``train()`` calls in one process each paid the full XLA compile again
+(the old ``GBDT._fused_cache`` dict lived on the booster, reset by
+``_derive_learner_state``), and every tree of a distributed run re-ran
+Python tracing for a program whose compiled executable already existed.
+
+This registry is the single process-level home for such programs:
+
+  * **Keyed on meaning, not identity** — a cache key is (entry name,
+    shape signature, hyper signature, kernel/mode statics).  Helper
+    builders (:func:`sig`, :func:`mesh_signature`) render arrays as
+    (shape, dtype) and meshes as (axes, device grid) so two callers
+    with the same program geometry share one compiled runner.
+  * **Weakly anchored** — entries whose compiled closure captures a
+    Dataset's device arrays register the dataset as an *anchor*: the
+    entry is evicted the moment the dataset is garbage-collected, so
+    the cache never pins a dead dataset's HBM.  Anchor tokens are
+    monotonic (never recycled), so an ``id()`` reused by a new object
+    can never alias a dead key.
+  * **Bounded** — LRU beyond ``max_entries``
+    (``LGBMTPU_COMPILE_CACHE_SIZE`` overrides; the compiled runners a
+    training process legitimately alternates between number in the
+    single digits).
+  * **Counted** — every lookup bumps ``round_compile_hits`` /
+    ``round_compile_misses`` (obs/metrics.py), per-booster and
+    process-global, which is what the tier-1 compile-count regression
+    gate asserts on: a second ``train()`` over identical shapes must
+    show zero misses.
+
+Counter bumps happen on the host at build/lookup time only — never
+inside jitted code (a traced bump would count compilations, not
+executions; obs/metrics.py module contract).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import weakref
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Hashable, Iterable, Optional, Tuple
+
+from ..obs.metrics import MetricsRegistry, count_event
+
+#: default LRU bound; override with LGBMTPU_COMPILE_CACHE_SIZE
+DEFAULT_MAX_ENTRIES = 64
+
+
+def _max_entries_from_env() -> int:
+    try:
+        return max(1, int(os.environ.get("LGBMTPU_COMPILE_CACHE_SIZE",
+                                         DEFAULT_MAX_ENTRIES)))
+    except ValueError:
+        return DEFAULT_MAX_ENTRIES
+
+
+def sig(x: Any) -> Hashable:
+    """Hashable *shape signature* of a pytree of arrays.
+
+    Arrays (anything with ``.shape``/``.dtype``) render as
+    ``("arr", shape, dtype)``; ``None`` stays ``None``; containers
+    recurse (namedtuples keep their type name so two different record
+    layouts with identical leaves cannot collide); scalars pass through
+    when hashable.  Only GEOMETRY is captured — array *contents* must be
+    either traced arguments of the cached program or covered by an
+    anchor/key component the caller supplies.
+    """
+    if x is None:
+        return None
+    if hasattr(x, "shape") and hasattr(x, "dtype"):
+        return ("arr", tuple(x.shape), str(x.dtype))
+    if isinstance(x, tuple) and hasattr(x, "_fields"):  # namedtuple
+        return (type(x).__name__,) + tuple(sig(v) for v in x)
+    if isinstance(x, (tuple, list)):
+        return ("seq",) + tuple(sig(v) for v in x)
+    if isinstance(x, dict):
+        return ("map",) + tuple(sorted((k, sig(v)) for k, v in x.items()))
+    try:
+        hash(x)
+        return x
+    except TypeError:
+        return repr(x)
+
+
+def mesh_signature(mesh: Any) -> Hashable:
+    """Signature of a jax ``Mesh``: axis names, device-grid shape and the
+    (platform, id) of every device — two meshes over the same physical
+    devices share compiled programs, a changed topology cannot."""
+    if mesh is None:
+        return None
+    return (tuple(mesh.axis_names), tuple(mesh.devices.shape),
+            tuple((d.platform, d.id) for d in mesh.devices.flat))
+
+
+class CompileCache:
+    """Bounded, weakly-anchored LRU of built callables (usually
+    ``jax.jit`` wrappers).  Thread-safe; builders run outside the lock
+    (building is cheap — the XLA compile itself happens lazily on first
+    call of the returned wrapper, under jax's own locking)."""
+
+    def __init__(self, max_entries: Optional[int] = None) -> None:
+        self._entries: "OrderedDict[Hashable, Callable]" = OrderedDict()
+        self._anchor_tokens: "weakref.WeakKeyDictionary[Any, int]" = \
+            weakref.WeakKeyDictionary()
+        self._anchor_keys: Dict[int, set] = {}
+        self._next_token = 0
+        self._lock = threading.RLock()
+        self.max_entries = max_entries or _max_entries_from_env()
+        self._hits = 0
+        self._misses = 0
+
+    # ------------------------------------------------------------ anchors
+    def anchor_token(self, obj: Any) -> Optional[int]:
+        """Monotonic token for ``obj``'s lifetime.  Include it in a cache
+        key to bind the entry to the object's identity; entries tagged
+        with it (via ``get_or_build(anchors=...)``) are evicted when the
+        object is collected.  ``None`` passes through."""
+        if obj is None:
+            return None
+        with self._lock:
+            tok = self._anchor_tokens.get(obj)
+            if tok is None:
+                tok = self._next_token
+                self._next_token += 1
+                self._anchor_tokens[obj] = tok
+                weakref.finalize(obj, self._drop_anchor, tok)
+            return tok
+
+    def _drop_anchor(self, tok: int) -> None:
+        with self._lock:
+            for key in self._anchor_keys.pop(tok, ()):
+                self._entries.pop(key, None)
+
+    # ------------------------------------------------------------- lookup
+    def get_or_build(self, key: Hashable, builder: Callable[[], Callable],
+                     *, anchors: Iterable[Any] = (),
+                     metrics: Optional[MetricsRegistry] = None) -> Callable:
+        """Return the cached callable for ``key``, building (and
+        counting a miss) when absent.  ``anchors``: objects whose device
+        arrays the built callable closes over — their tokens both extend
+        the key (so a *different* dataset with identical shapes can
+        never reuse a closure over the old one's arrays) and bound the
+        entry's lifetime to theirs."""
+        toks = tuple(self.anchor_token(a) for a in anchors)
+        full_key = (key, toks)
+        with self._lock:
+            fn = self._entries.get(full_key)
+            if fn is not None:
+                self._entries.move_to_end(full_key)
+                self._hits += 1
+        if fn is not None:
+            count_event("round_compile_hits", 1, metrics)
+            return fn
+        fn = builder()
+        count_event("round_compile_misses", 1, metrics)
+        with self._lock:
+            self._misses += 1
+            # a racing builder may have landed first; last write wins —
+            # both callables trace to the same program
+            self._entries[full_key] = fn
+            self._entries.move_to_end(full_key)
+            for tok in toks:
+                if tok is not None:
+                    self._anchor_keys.setdefault(tok, set()).add(full_key)
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)
+        return fn
+
+    # -------------------------------------------------------------- admin
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"entries": len(self._entries), "hits": self._hits,
+                    "misses": self._misses,
+                    "max_entries": self.max_entries}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._anchor_keys.clear()
+            self._hits = 0
+            self._misses = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
+#: the process-wide cache every round-body entry shares (fused runners,
+#: shard_map wrappers, GSPMD entries, device predict programs)
+GLOBAL_COMPILE_CACHE = CompileCache()
+
+
+def get_or_build(key: Hashable, builder: Callable[[], Callable], *,
+                 anchors: Iterable[Any] = (),
+                 metrics: Optional[MetricsRegistry] = None) -> Callable:
+    """Module-level convenience over :data:`GLOBAL_COMPILE_CACHE`."""
+    return GLOBAL_COMPILE_CACHE.get_or_build(key, builder, anchors=anchors,
+                                             metrics=metrics)

@@ -97,6 +97,51 @@ def _no_radix2() -> bool:
     return bool(os.environ.get("LGBMTPU_NO_RADIX2"))  # perf A/B hatch
 
 
+def _no_overlap() -> bool:
+    import os
+    return bool(os.environ.get("LGBMTPU_NO_OVERLAP"))  # perf A/B hatch
+
+
+def overlap_enabled(overlap: bool) -> bool:
+    """Trace-time resolution of the overlapped-collective request:
+    the caller's ``overlap`` flag gated by the ``LGBMTPU_NO_OVERLAP``
+    A/B hatch.  Shared by :func:`reduce_hist` and the growers' scalar
+    root reductions so one env var kills every overlapped schedule."""
+    return bool(overlap) and not _no_overlap()
+
+
+def reduce_hist(hist: jax.Array, axis_name: Optional[str],
+                overlap: bool = False) -> jax.Array:
+    """All-reduce a histogram across ``axis_name`` (no-op when serial).
+
+    The single sink every histogram builder's cross-device reduction
+    flows through (``collective_overlap``, ISSUE 7).  With ``overlap``
+    off this is exactly the blocking ``lax.psum`` the builders always
+    issued.  With it on (and a leading axis to split), the reduction is
+    issued as TWO independent psums over disjoint leading-axis halves,
+    concatenated back together.  Bit-identical to the single psum: the
+    halves are disjoint slices, and each element still sums the same
+    per-device contributions in the same deterministic all-reduce order
+    — only the *scheduling* changes.  Two independent collective
+    start/done pairs give XLA's latency-hiding scheduler (TPU) a window
+    to overlap the first half's wire time with the second half's local
+    compute, instead of one monolithic blocking all-reduce.
+
+    ``LGBMTPU_NO_OVERLAP`` is the trace-time A/B hatch (same contract
+    as ``LGBMTPU_NO_PACKED``): set it to force the single-psum schedule
+    regardless of config.
+    """
+    if axis_name is None:
+        return hist
+    if overlap_enabled(overlap) and hist.ndim >= 1 \
+            and int(hist.shape[0]) >= 2:
+        k = int(hist.shape[0]) // 2
+        lo = lax.psum(hist[:k], axis_name)
+        hi = lax.psum(hist[k:], axis_name)
+        return jnp.concatenate([lo, hi], axis=0)
+    return lax.psum(hist, axis_name)
+
+
 def _round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
@@ -232,7 +277,8 @@ def histogram_for_leaf_masked(bins_t: jax.Array, grad: jax.Array,
                               hist_dtype: str = "float32",
                               axis_name: Optional[str] = None,
                               hist_kernel: str = "auto",
-                              bins_words_t: Optional[jax.Array] = None
+                              bins_words_t: Optional[jax.Array] = None,
+                              overlap: bool = False
                               ) -> jax.Array:
     """Leaf histogram by masking: one full-data pass with non-leaf rows
     zeroed.  O(n) per call but with NO compaction machinery.  Under
@@ -253,14 +299,13 @@ def histogram_for_leaf_masked(bins_t: jax.Array, grad: jax.Array,
             rows_per_block=min(rows_per_block, 2048),
             compute_dtype=jnp.dtype(hist_dtype).type,
             interpret=not use_pallas())
-        if axis_name is not None:
-            hist = lax.psum(hist, axis_name)
-        return hist
+        return reduce_hist(hist, axis_name, overlap)
     leaf_arr = jnp.asarray(leaf, jnp.int32).reshape(1)
     hist = histogram_for_leaves_masked(
         bins_t, grad, hess, leaf_of_row, leaf_arr, row_mask, n_bins=n_bins,
         rows_per_block=rows_per_block, hist_dtype=hist_dtype,
-        axis_name=axis_name, hist_kernel=hk, bins_words_t=bins_words_t)
+        axis_name=axis_name, hist_kernel=hk, bins_words_t=bins_words_t,
+        overlap=overlap)
     return hist[0]
 
 
@@ -305,7 +350,8 @@ def histogram_for_leaves_masked(bins_t: jax.Array, grad: jax.Array,
                                 hist_dtype: str = "float32",
                                 axis_name: Optional[str] = None,
                                 hist_kernel: str = "auto",
-                                bins_words_t: Optional[jax.Array] = None
+                                bins_words_t: Optional[jax.Array] = None,
+                                overlap: bool = False
                                 ) -> jax.Array:
     """Histograms of K leaves in ONE data pass -> f32 [K, F, B, C].
 
@@ -342,9 +388,7 @@ def histogram_for_leaves_masked(bins_t: jax.Array, grad: jax.Array,
             bins_t, grad, hess, lor, leaves, n_bins=n_bins,
             rows_per_block=min(rows_per_block, 2048),
             compute_dtype=jnp.dtype(hist_dtype).type, interpret=interp)
-        if axis_name is not None:
-            hist = lax.psum(hist, axis_name)
-        return hist
+        return reduce_hist(hist, axis_name, overlap)
     if kern == "radix2":
         from .hist_pallas import (histogram_leaves_radix2_pallas,
                                   radix2_pick_p)
@@ -353,9 +397,7 @@ def histogram_for_leaves_masked(bins_t: jax.Array, grad: jax.Array,
             rows_per_block=min(rows_per_block, 1024),
             p=radix2_pick_p(num_f, K, n_bins),
             compute_dtype=jnp.dtype(hist_dtype).type, interpret=interp)
-        if axis_name is not None:
-            hist = lax.psum(hist, axis_name)
-        return hist
+        return reduce_hist(hist, axis_name, overlap)
     if kern == "packed":
         from .hist_pallas import histogram_leaves_packed_pallas
         hist = histogram_leaves_packed_pallas(
@@ -363,9 +405,7 @@ def histogram_for_leaves_masked(bins_t: jax.Array, grad: jax.Array,
             n_bins=n_bins,
             rows_per_block=min(rows_per_block, _pallas_blk(hist_dtype, n_bins)),
             compute_dtype=jnp.dtype(hist_dtype).type, interpret=interp)
-        if axis_name is not None:
-            hist = lax.psum(hist, axis_name)
-        return hist
+        return reduce_hist(hist, axis_name, overlap)
     if kern == "flat":
         from .hist_pallas import histogram_leaves_pallas
         hist = histogram_leaves_pallas(
@@ -388,9 +428,7 @@ def histogram_for_leaves_masked(bins_t: jax.Array, grad: jax.Array,
                                 hist_dtype=hist_dtype)        # [F, B, C*K]
         F, B = hist.shape[0], hist.shape[1]
         hist = hist.reshape(F, B, C, K).transpose(3, 0, 1, 2)  # [K, F, B, C]
-    if axis_name is not None:
-        hist = lax.psum(hist, axis_name)
-    return hist
+    return reduce_hist(hist, axis_name, overlap)
 
 
 def _rows_leaves_hist(bins_rows: jax.Array, grad: jax.Array,
@@ -448,7 +486,8 @@ def histogram_for_leaves_auto(bins_rows: jax.Array, bins_t: jax.Array,
                               sort_key: Optional[jax.Array] = None,
                               hist_kernel: str = "auto",
                               bins_words_t: Optional[jax.Array] = None,
-                              payload: Optional[jax.Array] = None
+                              payload: Optional[jax.Array] = None,
+                              overlap: bool = False
                               ) -> jax.Array:
     """K-leaf histograms with frontier compaction -> f32 [K, F, B, C].
 
@@ -577,9 +616,7 @@ def histogram_for_leaves_auto(bins_rows: jax.Array, bins_t: jax.Array,
     operands = (sort_key, payload) if payload is not None \
         else (sort_key, grad, hess, lor)
     hist = lax.switch(j, branches, operands)
-    if axis_name is not None:
-        hist = lax.psum(hist, axis_name)
-    return hist
+    return reduce_hist(hist, axis_name, overlap)
 
 
 def histogram_for_leaf_bucketed(bins: jax.Array, grad: jax.Array,
@@ -588,7 +625,8 @@ def histogram_for_leaf_bucketed(bins: jax.Array, grad: jax.Array,
                                 row_mask: Optional[jax.Array] = None, *,
                                 n_bins: int = 256, rows_per_block: int = 4096,
                                 min_bucket: int = 8192, hist_dtype: str = "float32",
-                                axis_name: Optional[str] = None) -> jax.Array:
+                                axis_name: Optional[str] = None,
+                                overlap: bool = False) -> jax.Array:
     """Histogram of one leaf touching only ~leaf_count rows.
 
     The TPU reformulation of the reference's ordered-index iteration
@@ -640,9 +678,7 @@ def histogram_for_leaf_bucketed(bins: jax.Array, grad: jax.Array,
 
     hist = lax.switch(j, [make_branch(sz) for sz in sizes],
                       (mask, grad, hess))
-    if axis_name is not None:
-        hist = lax.psum(hist, axis_name)
-    return hist
+    return reduce_hist(hist, axis_name, overlap)
 
 
 def root_histogram(bins_t: jax.Array, grad: jax.Array, hess: jax.Array,
@@ -651,7 +687,8 @@ def root_histogram(bins_t: jax.Array, grad: jax.Array, hess: jax.Array,
                    hist_dtype: str = "float32",
                    axis_name: Optional[str] = None,
                    hist_kernel: str = "auto",
-                   bins_words_t: Optional[jax.Array] = None) -> jax.Array:
+                   bins_words_t: Optional[jax.Array] = None,
+                   overlap: bool = False) -> jax.Array:
     """Root histogram from the TRANSPOSED [F, n] bin matrix."""
     hist_kernel = resolve_hist_kernel(hist_kernel)
     if use_pallas() or _MODE_TEST_INTERPRET:
@@ -662,7 +699,7 @@ def root_histogram(bins_t: jax.Array, grad: jax.Array, hess: jax.Array,
             bins_t, grad, hess, lor, jnp.int32(0), row_mask, n_bins=n_bins,
             rows_per_block=rows_per_block, hist_dtype=hist_dtype,
             axis_name=axis_name, hist_kernel=hist_kernel,
-            bins_words_t=bins_words_t)
+            bins_words_t=bins_words_t, overlap=overlap)
     m = jnp.ones_like(grad) if row_mask is None else row_mask.astype(grad.dtype)
     vals_t = jnp.stack([jnp.where(m > 0, grad, 0.0),
                         jnp.where(m > 0, hess, 0.0), m,
@@ -670,6 +707,4 @@ def root_histogram(bins_t: jax.Array, grad: jax.Array, hess: jax.Array,
     hist = histogram_rows_t(bins_t, vals_t, n_bins=n_bins,
                             rows_per_block=rows_per_block,
                             hist_dtype=hist_dtype)
-    if axis_name is not None:
-        hist = lax.psum(hist, axis_name)
-    return hist
+    return reduce_hist(hist, axis_name, overlap)

@@ -37,9 +37,32 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .compat import shard_map
 
 from ..learner.grower import TreeArrays, grow_tree
+from ..ops.compile_cache import get_or_build, mesh_signature, sig
 from ..ops.split import SplitHyper
 from .mesh import DATA_AXIS
 from ..ops.table import take_small_table
+
+
+def _cached_shard_map(entry: str, mesh: Mesh, local, in_specs, out_specs,
+                      key_extra, metrics=None):
+    """jit-wrapped ``shard_map`` program, reused across calls.
+
+    Every entry here used to rebuild ``shard_map(local, ...)`` per call
+    — per TREE from the booster loop — re-running Python tracing for a
+    program whose compiled executable already existed (ISSUE 7).  The
+    process-level compile cache (ops/compile_cache.py) keys on (entry
+    name, mesh signature, argument shape signatures, statics): ``local``
+    closes over statics only (hp, mode flags, scalars — all in the key),
+    never over arrays, so a key hit is a program hit and no anchors are
+    needed.  The ``jax.jit`` wrapper is what makes the cached object
+    carry the compiled program (a bare shard_map call re-traces)."""
+    key = (entry, mesh_signature(mesh), key_extra)
+
+    def build():
+        return jax.jit(shard_map(local, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_vma=False))
+
+    return get_or_build(key, build, metrics=metrics)
 
 
 def grow_tree_sharded(mesh: Mesh, bins: jax.Array, grad: jax.Array,
@@ -50,7 +73,8 @@ def grow_tree_sharded(mesh: Mesh, bins: jax.Array, grad: jax.Array,
                       bundle=None, parallel_mode: str = "data",
                       top_k: int = 20, monotone=None, rng_key=None,
                       interaction_sets=None, forced=None,
-                      hist_scale=None) -> Tuple[TreeArrays, jax.Array]:
+                      hist_scale=None, overlap: bool = False,
+                      metrics=None) -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree with rows sharded over ``mesh``'s data axis.
 
     bins [n, F] uint8, grad/hess [n] — n must divide the mesh size (pad +
@@ -89,11 +113,17 @@ def grow_tree_sharded(mesh: Mesh, bins: jax.Array, grad: jax.Array,
                          axis_name=DATA_AXIS, bundle=bd, monotone=mono,
                          rng_key=key, interaction_sets=isets, forced=fsp,
                          parallel_mode=parallel_mode, top_k=top_k,
-                         num_shards=mesh.devices.size, hist_scale=hs)
+                         num_shards=mesh.devices.size, hist_scale=hs,
+                         overlap=overlap)
 
-    fn = shard_map(local, mesh=mesh,
-                   in_specs=tuple(s for s in in_specs),
-                   out_specs=out_specs, check_vma=False)
+    fn = _cached_shard_map(
+        "grow_tree_sharded", mesh, local, tuple(s for s in in_specs),
+        out_specs,
+        (hp, parallel_mode, top_k, overlap,
+         sig((bins, grad, hess, row_mask, num_bins, nan_bin, is_cat,
+              feature_mask, bundle, monotone, rng_key, interaction_sets,
+              forced, hist_scale))),
+        metrics=metrics)
     return fn(bins, grad, hess, row_mask, num_bins, nan_bin, is_cat,
               feature_mask, bundle, monotone, rng_key, interaction_sets,
               forced, hist_scale)
@@ -104,8 +134,9 @@ def train_step_sharded(mesh: Mesh, bins: jax.Array, scores: jax.Array,
                        num_bins: jax.Array, nan_bin: jax.Array,
                        is_cat: jax.Array, hp: SplitHyper, *,
                        learning_rate: float = 0.1,
-                       objective: str = "binary"
-                       ) -> Tuple[TreeArrays, jax.Array]:
+                       objective: str = "binary",
+                       overlap: bool = False,
+                       metrics=None) -> Tuple[TreeArrays, jax.Array]:
     """One FULL boosting step (gradients -> tree -> score update), rows
     sharded — the unit the driver dry-runs multi-chip.  Gradient math is
     elementwise (trivially shards); the tree grower psums histograms/stats.
@@ -128,13 +159,16 @@ def train_step_sharded(mesh: Mesh, bins: jax.Array, scores: jax.Array,
             g = sc - y
             h = jnp.ones_like(sc)
         tree, leaf_of_row = grow_tree(b, g, h, m, nb, nanb, cat, None, hp,
-                                      axis_name=DATA_AXIS)
+                                      axis_name=DATA_AXIS, overlap=overlap)
         new_scores = sc + learning_rate * take_small_table(tree.leaf_value,
                                                            leaf_of_row)
         return tree, new_scores
 
-    fn = shard_map(local, mesh=mesh, in_specs=in_specs,
-                   out_specs=out_specs, check_vma=False)
+    fn = _cached_shard_map(
+        "train_step_sharded", mesh, local, in_specs, out_specs,
+        (hp, learning_rate, objective, overlap,
+         sig((bins, scores, label, row_mask, num_bins, nan_bin, is_cat))),
+        metrics=metrics)
     return fn(bins, scores, label, row_mask, num_bins, nan_bin, is_cat)
 
 
@@ -144,8 +178,9 @@ def train_fused_sharded(mesh: Mesh, bins: jax.Array, scores: jax.Array,
                         hp: SplitHyper, *, num_rounds: int,
                         learning_rate: float = 0.1, batch: int = 8,
                         objective: str = "binary",
-                        quantize: bool = False, seed: int = 0
-                        ) -> Tuple[TreeArrays, jax.Array]:
+                        quantize: bool = False, seed: int = 0,
+                        overlap: bool = False,
+                        metrics=None) -> Tuple[TreeArrays, jax.Array]:
     """The flagship FUSED round scan (GBDT.train_fused's inner program:
     gradients -> batched tree -> score update, ``num_rounds`` rounds in
     one ``lax.scan``) composed with the data mesh — every round's
@@ -190,14 +225,19 @@ def train_fused_sharded(mesh: Mesh, bins: jax.Array, scores: jax.Array,
                 hist_scale = jnp.stack([gs, hs])
             tree, lor = grow_tree_batched(
                 b, g, h, None, nb, nanb, cat, None, hp, batch=batch,
-                axis_name=DATA_AXIS, hist_scale=hist_scale)
+                axis_name=DATA_AXIS, hist_scale=hist_scale,
+                overlap=overlap)
             sc = sc + learning_rate * take_small_table(tree.leaf_value, lor)
             return sc, tree
         sc, trees = jax.lax.scan(step, sc, jnp.arange(num_rounds))
         return trees, sc
 
-    fn = shard_map(local, mesh=mesh, in_specs=in_specs,
-                   out_specs=out_specs, check_vma=False)
+    fn = _cached_shard_map(
+        "train_fused_sharded", mesh, local, in_specs, out_specs,
+        (hp, num_rounds, learning_rate, batch, objective, quantize, seed,
+         overlap,
+         sig((bins, scores, label, num_bins, nan_bin, is_cat))),
+        metrics=metrics)
     return fn(bins, scores, label, num_bins, nan_bin, is_cat)
 
 
@@ -213,7 +253,8 @@ def grow_tree_batched_sharded(mesh: Mesh, bins: jax.Array, grad: jax.Array,
                               hist_scale: Optional[jax.Array] = None,
                               interaction_sets: Optional[jax.Array] = None,
                               parallel_mode: str = "data",
-                              top_k: int = 20
+                              top_k: int = 20, overlap: bool = False,
+                              metrics=None
                               ) -> Tuple[TreeArrays, jax.Array]:
     """Batched-round grower (learner/batch_grower.py) under the data mesh:
     K splits per psum-ed widened histogram pass ("data"), or per LOCAL
@@ -244,9 +285,15 @@ def grow_tree_batched_sharded(mesh: Mesh, bins: jax.Array, grad: jax.Array,
                                  axis_name=DATA_AXIS, hist_scale=hs,
                                  interaction_sets=isets,
                                  parallel_mode=parallel_mode, top_k=top_k,
-                                 num_shards=mesh.devices.size)
+                                 num_shards=mesh.devices.size,
+                                 overlap=overlap)
 
-    fn = shard_map(local, mesh=mesh, in_specs=in_specs,
-                   out_specs=out_specs, check_vma=False)
+    fn = _cached_shard_map(
+        "grow_tree_batched_sharded", mesh, local, in_specs, out_specs,
+        (hp, batch, parallel_mode, top_k, overlap,
+         sig((bins, grad, hess, row_mask, num_bins, nan_bin, is_cat,
+              feature_mask, bundle, monotone, hist_scale,
+              interaction_sets))),
+        metrics=metrics)
     return fn(bins, grad, hess, row_mask, num_bins, nan_bin, is_cat,
               feature_mask, bundle, monotone, hist_scale, interaction_sets)

@@ -1,0 +1,11 @@
+"""GRW401 positive: learner code routing a feature combination back to
+the strict learner in an assert message and a warning call."""
+
+
+def grow_batched(bins, forced, parallel_mode, log):
+    if parallel_mode == "voting":
+        assert forced is None, \
+            "forced splits need the strict learner under voting"
+    if forced is not None:
+        log.warning("falling back to the strict grower for forced splits")
+    return bins

@@ -1,0 +1,259 @@
+"""Process compile-cache tests (ops/compile_cache.py) and the ISSUE-7
+compile-count regression gate.
+
+Unit surface: key signatures (``sig``/``mesh_signature``), hit/miss
+counter wiring into a passed MetricsRegistry, LRU bounding, and weak
+anchoring (entry evicted when the anchoring object is collected; tokens
+monotonic, never recycled).
+
+Integration surface: a second identical ``train()`` in the same process
+must add ZERO ``round_compile_misses`` (the cross-call reuse the cache
+exists for), the XLA program-lowering count of a 2-tree smoke train must
+stay under a fixed ceiling (obs/compile_events.py listener — lowerings
+fire per in-process trace-cache miss, so the gate is deterministic even
+with tests/.jax_cache warm), and the telemetry JSONL carries the
+process-scope compile counters on every record.
+"""
+
+import collections
+import gc
+import json
+
+import jax
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.obs import compile_events
+from lightgbm_tpu.obs.metrics import MetricsRegistry, global_metrics
+from lightgbm_tpu.ops import compile_cache as cc
+
+
+# --------------------------------------------------------------- unit: keys
+
+def test_sig_geometry():
+    a = np.zeros((4, 3), np.float32)
+    assert cc.sig(a) == ("arr", (4, 3), "float32")
+    assert cc.sig(None) is None
+    assert cc.sig([a, None, 5]) == \
+        ("seq", ("arr", (4, 3), "float32"), None, 5)
+    # dict keys sorted -> insertion order cannot split the cache
+    assert cc.sig({"b": 1, "a": 2}) == cc.sig({"a": 2, "b": 1})
+    # namedtuples keep their type name: two record layouts with
+    # identical leaves cannot collide
+    A = collections.namedtuple("A", "x y")
+    B = collections.namedtuple("B", "x y")
+    assert cc.sig(A(1, 2))[0] == "A"
+    assert cc.sig(A(1, 2)) != cc.sig(B(1, 2))
+    # unhashable scalars degrade to repr, never raise
+    assert isinstance(cc.sig({1, 2}), str)
+
+
+def test_mesh_signature():
+    from jax.sharding import Mesh
+    devs = np.array(jax.devices())
+    m1 = Mesh(devs, ("data",))
+    m2 = Mesh(devs, ("data",))
+    assert cc.mesh_signature(None) is None
+    # same topology -> same signature (shared compiled programs)
+    assert cc.mesh_signature(m1) == cc.mesh_signature(m2)
+    if devs.size >= 2:
+        m3 = Mesh(devs.reshape(2, -1), ("data", "model"))
+        assert cc.mesh_signature(m1) != cc.mesh_signature(m3)
+
+
+# ------------------------------------------------------- unit: cache object
+
+def test_hit_miss_counters_and_stats():
+    cache = cc.CompileCache(max_entries=8)
+    m = MetricsRegistry()
+    builds = []
+
+    def build():
+        builds.append(1)
+        return lambda: 42
+
+    f1 = cache.get_or_build("k", build, metrics=m)
+    f2 = cache.get_or_build("k", build, metrics=m)
+    assert f1 is f2 and f1() == 42
+    assert len(builds) == 1
+    st = cache.stats()
+    assert (st["entries"], st["hits"], st["misses"]) == (1, 1, 1)
+    counters = m.snapshot()["counters"]
+    assert counters["round_compile_misses"] == 1
+    assert counters["round_compile_hits"] == 1
+    cache.clear()
+    assert len(cache) == 0
+    assert cache.stats()["misses"] == 0
+
+
+def test_lru_eviction():
+    cache = cc.CompileCache(max_entries=2)
+    mk = lambda v: (lambda: (lambda: v))  # noqa: E731
+    cache.get_or_build("k1", mk(1))
+    cache.get_or_build("k2", mk(2))
+    cache.get_or_build("k1", mk(1))      # touch k1 -> k2 is now LRU
+    cache.get_or_build("k3", mk(3))      # evicts k2
+    assert len(cache) == 2
+    misses_before = cache.stats()["misses"]
+    cache.get_or_build("k1", mk(1))      # still resident
+    assert cache.stats()["misses"] == misses_before
+    cache.get_or_build("k2", mk(2))      # gone -> rebuilt
+    assert cache.stats()["misses"] == misses_before + 1
+
+
+def test_anchor_eviction_and_monotonic_tokens():
+    cache = cc.CompileCache(max_entries=8)
+
+    class Obj:
+        pass
+
+    o = Obj()
+    tok = cache.anchor_token(o)
+    assert cache.anchor_token(o) == tok   # stable while alive
+    cache.get_or_build("k", lambda: (lambda: 1), anchors=(o,))
+    assert len(cache) == 1
+    del o
+    gc.collect()
+    # the moment the anchor dies, the entry (a closure over its device
+    # arrays, in real use) must be gone — no dead-HBM pinning
+    assert len(cache) == 0
+    o2 = Obj()
+    tok2 = cache.anchor_token(o2)
+    # tokens are monotonic, never recycled: a reused id() cannot alias
+    assert tok2 > tok
+
+
+def test_anchors_extend_the_key():
+    cache = cc.CompileCache(max_entries=8)
+
+    class Obj:
+        pass
+
+    a, b = Obj(), Obj()
+    fa = cache.get_or_build("k", lambda: (lambda: "a"), anchors=(a,))
+    fb = cache.get_or_build("k", lambda: (lambda: "b"), anchors=(b,))
+    # same key, different anchor -> different entry: a NEW dataset with
+    # identical shapes can never reuse a closure over the old one's arrays
+    assert fa is not fb
+    assert len(cache) == 2
+
+
+def test_cache_size_env(monkeypatch):
+    monkeypatch.setenv("LGBMTPU_COMPILE_CACHE_SIZE", "3")
+    assert cc.CompileCache().max_entries == 3
+    monkeypatch.setenv("LGBMTPU_COMPILE_CACHE_SIZE", "not-a-number")
+    assert cc.CompileCache().max_entries == cc.DEFAULT_MAX_ENTRIES
+
+
+# ------------------------------------------------- integration: train reuse
+
+def _problem(n=400, f=6, seed=3):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    y = ((X @ rng.normal(size=f)) > 0).astype(np.float64)
+    return X, y
+
+
+def test_repeated_train_data_mode_zero_new_misses():
+    """ISSUE-7 acceptance: back-to-back identical data-parallel trains —
+    the second call's shard_map round bodies must ALL be cache hits
+    (``round_compile_misses`` delta == 0), even through a brand-new
+    Dataset object (the shard_map entries key on geometry, not data)."""
+    X, y = _problem()
+    p = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 5,
+         "verbose": -1, "tree_learner": "data"}
+
+    def run():
+        return lgb.train(p, lgb.Dataset(X, label=y, params=p),
+                         num_boost_round=5)
+
+    run()
+    st = cc.GLOBAL_COMPILE_CACHE.stats()
+    bst = run()
+    st2 = cc.GLOBAL_COMPILE_CACHE.stats()
+    assert st2["misses"] == st["misses"], \
+        "second identical train recompiled a round body"
+    assert st2["hits"] > st["hits"]
+    assert np.isfinite(bst.predict(X)).all()
+
+
+def test_repeated_fused_train_same_dataset_reuses_runner():
+    """The fused-round runner (GBDT.train_fused) lives in the PROCESS
+    cache anchored on its datasets: retraining over the SAME Dataset
+    object adds zero misses and bumps ``fused_runner_cache_hits``."""
+    X, y = _problem(seed=7)
+    # tpu_split_batch > 1 opts into the batched grower, a fused-path
+    # prerequisite (its auto policy only kicks in at >= 100k rows)
+    p = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 5,
+         "verbose": -1, "tpu_split_batch": 4}
+    ds = lgb.Dataset(X, label=y, params=p)
+    bst1 = lgb.train(p, ds, num_boost_round=3)
+    assert bst1._gbdt.metrics.counter("fused_rounds") > 0, \
+        "plain train() no longer takes the fused path — test premise broken"
+    st = cc.GLOBAL_COMPILE_CACHE.stats()
+    bst2 = lgb.train(p, ds, num_boost_round=3)
+    st2 = cc.GLOBAL_COMPILE_CACHE.stats()
+    assert st2["misses"] == st["misses"]
+    assert st2["hits"] > st["hits"]
+    assert bst2._gbdt.metrics.counter("fused_runner_cache_hits") > 0
+    np.testing.assert_allclose(bst1.predict(X), bst2.predict(X))
+
+
+# ------------------------------------------- integration: compile-count gate
+
+# Ceiling for ONE cold 2-tree smoke train (program lowerings, i.e.
+# distinct traced programs: binning + fused runner + metrics + predict
+# helpers).  Measured ~30 on the 8-device CPU mesh; 3x headroom so the
+# gate only trips on structural regressions (e.g. a round body re-traced
+# per tree), not on a helper being added.
+FIRST_TRAIN_LOWERING_CEILING = 90
+# A second identical train over the same Dataset must be near-zero: the
+# process cache returns the SAME jit wrappers, so jax's trace cache
+# holds.  Small allowance for per-call host glue.
+SECOND_TRAIN_LOWERING_CEILING = 4
+
+
+def test_compile_count_gate_two_tree_smoke():
+    assert compile_events.install() or compile_events.installed()
+    X, y = _problem(seed=11)
+    p = {"objective": "binary", "num_leaves": 7, "min_data_in_leaf": 5,
+         "verbose": -1}
+    ds = lgb.Dataset(X, label=y, params=p)
+
+    def lowerings():
+        return global_metrics.counter("xla_program_lowerings")
+
+    base = lowerings()
+    lgb.train(p, ds, num_boost_round=2)
+    first = lowerings() - base
+    assert first <= FIRST_TRAIN_LOWERING_CEILING, \
+        f"2-tree smoke train lowered {first} programs " \
+        f"(ceiling {FIRST_TRAIN_LOWERING_CEILING}) — a round body is " \
+        "being re-traced; check ops/compile_cache.py routing"
+    base = lowerings()
+    lgb.train(p, ds, num_boost_round=2)
+    second = lowerings() - base
+    assert second <= SECOND_TRAIN_LOWERING_CEILING, \
+        f"identical retrain lowered {second} new programs — the " \
+        "process compile cache is not being reused"
+
+
+# ------------------------------------------------ integration: telemetry
+
+def test_telemetry_jsonl_carries_process_compile_counters(tmp_path):
+    tele = tmp_path / "tele.jsonl"
+    X, y = _problem(seed=13)
+    p = {"objective": "binary", "num_leaves": 7, "min_data_in_leaf": 5,
+         "verbose": -1, "telemetry_output": str(tele)}
+    lgb.train(p, lgb.Dataset(X, label=y, params=p), num_boost_round=3)
+    recs = [json.loads(line) for line in tele.read_text().splitlines()]
+    assert recs
+    for rec in recs:
+        pc = rec["process_counters"]
+        for key in ("xla_compile_events", "xla_program_lowerings",
+                    "round_compile_hits", "round_compile_misses"):
+            assert isinstance(pc[key], int) and pc[key] >= 0
+    # the listener is live in an observed run, so by the last record the
+    # process has lowered at least one program
+    assert recs[-1]["process_counters"]["xla_program_lowerings"] > 0

@@ -98,7 +98,7 @@ def test_voting_parallel_learns(problem):
     assert int(tree_v.split_bin[0]) == int(tree_s.split_bin[0])
 
 
-@pytest.mark.parametrize("tl", ["data", "voting", "feature"])
+@pytest.mark.parametrize("tl", ["data", "voting", "feature", "data_gspmd"])
 def test_tree_learner_config_end_to_end(tl):
     """Public API: params tree_learner=data/voting/feature trains over all
     visible devices (reference CreateTreeLearner dispatch)."""
@@ -371,3 +371,153 @@ def test_pooled_grower_composes_with_shard_map(problem):
     np.testing.assert_array_equal(np.asarray(tree_p.split_bin),
                                   np.asarray(tree_f.split_bin))
     np.testing.assert_array_equal(np.asarray(lor_p), np.asarray(lor_f))
+
+
+# --------------------------------------------------------------- round 6
+def _int_grads(problem, seed=5):
+    """Integer-valued f32 gradients (test_hist_modes idiom): sums are
+    exact under ANY reduction order, so a single differing bit between
+    two collective schedules proves a real divergence, not float
+    reassociation."""
+    n = problem[0].shape[0]
+    rng = np.random.default_rng(seed)
+    return (jnp.asarray(rng.integers(-8, 8, n).astype(np.float32)),
+            jnp.asarray(rng.integers(1, 8, n).astype(np.float32)))
+
+
+def _assert_trees_identical(a_tree, a_lor, b_tree, b_lor):
+    np.testing.assert_array_equal(np.asarray(a_tree.split_feature),
+                                  np.asarray(b_tree.split_feature))
+    np.testing.assert_array_equal(np.asarray(a_tree.split_bin),
+                                  np.asarray(b_tree.split_bin))
+    # bit-identity, not allclose: the overlapped reduction must change
+    # the SCHEDULE only, never a single accumulated bit
+    np.testing.assert_array_equal(np.asarray(a_tree.leaf_value),
+                                  np.asarray(b_tree.leaf_value))
+    np.testing.assert_array_equal(np.asarray(a_lor), np.asarray(b_lor))
+
+
+@pytest.mark.parametrize("mode", ["data", "voting"])
+def test_overlapped_psum_bit_identical_batched(problem, mode):
+    """Round 6 overlap: the chunked psum (two independent half-
+    collectives over disjoint leading-axis slices) is bit-identical to
+    the blocking reduction — per-element sums are untouched, only the
+    start/done schedule changes (docs/PERF_NOTES.md round 7)."""
+    from lightgbm_tpu.parallel.data_parallel import grow_tree_batched_sharded
+    bins, _, _, nb, nanb, cat = map(jnp.asarray, problem)
+    g, h = _int_grads(problem)
+    mesh = _mesh(DATA_AXIS)
+    kw = {"parallel_mode": mode, "top_k": 4} if mode == "voting" else {}
+    tree_b, lor_b = grow_tree_batched_sharded(
+        mesh, bins, g, h, None, nb, nanb, cat, None, HP, batch=4,
+        overlap=False, **kw)
+    tree_o, lor_o = grow_tree_batched_sharded(
+        mesh, bins, g, h, None, nb, nanb, cat, None, HP, batch=4,
+        overlap=True, **kw)
+    _assert_trees_identical(tree_b, lor_b, tree_o, lor_o)
+
+
+def test_overlapped_psum_bit_identical_strict(problem):
+    """Same contract for the strict (batch=1 cadence) sharded grower —
+    its root stat reduction stacks g0/h0/c0 into ONE psum under overlap,
+    which must also be bit-exact (disjoint lanes of one array)."""
+    bins, _, _, nb, nanb, cat = map(jnp.asarray, problem)
+    g, h = _int_grads(problem, seed=6)
+    mesh = _mesh(DATA_AXIS)
+    tree_b, lor_b = grow_tree_sharded(mesh, bins, g, h, None, nb, nanb,
+                                      cat, None, HP, overlap=False)
+    tree_o, lor_o = grow_tree_sharded(mesh, bins, g, h, None, nb, nanb,
+                                      cat, None, HP, overlap=True)
+    _assert_trees_identical(tree_b, lor_b, tree_o, lor_o)
+
+
+def test_overlapped_psum_bit_identical_int8(problem):
+    """int8 histogram mode (quantized integer gradient LEVELS, exact
+    integer accumulation): overlap on/off trees bit-identical with
+    hist_scale threading."""
+    import dataclasses
+    from lightgbm_tpu.ops.quantize import discretize_gradients_levels
+    from lightgbm_tpu.parallel.data_parallel import grow_tree_batched_sharded
+    bins, _, _, nb, nanb, cat = map(jnp.asarray, problem)
+    g, h = _int_grads(problem, seed=7)
+    gq, hq, gs, hs = discretize_gradients_levels(
+        g / 8.0, h / 8.0, jax.random.PRNGKey(2), n_levels=4,
+        stochastic=False)
+    hist_scale = jnp.stack([gs, hs])
+    hp8 = dataclasses.replace(HP, hist_dtype="int8")
+    mesh = _mesh(DATA_AXIS)
+    tree_b, lor_b = grow_tree_batched_sharded(
+        mesh, bins, gq, hq, None, nb, nanb, cat, None, hp8, batch=4,
+        hist_scale=hist_scale, overlap=False)
+    tree_o, lor_o = grow_tree_batched_sharded(
+        mesh, bins, gq, hq, None, nb, nanb, cat, None, hp8, batch=4,
+        hist_scale=hist_scale, overlap=True)
+    _assert_trees_identical(tree_b, lor_b, tree_o, lor_o)
+
+
+def test_no_overlap_env_hatch_is_blocking(problem, monkeypatch):
+    """LGBMTPU_NO_OVERLAP=1 must force the blocking reduction even when
+    overlap=True is requested (the perf A/B hatch reads the env at
+    trace time) — and, being bit-identical by contract, the output
+    still matches."""
+    from lightgbm_tpu.ops.histogram import overlap_enabled
+    monkeypatch.setenv("LGBMTPU_NO_OVERLAP", "1")
+    assert not overlap_enabled(True)
+    monkeypatch.delenv("LGBMTPU_NO_OVERLAP")
+    assert overlap_enabled(True)
+    assert not overlap_enabled(False)
+
+
+def test_gspmd_fused_scan_matches_shard_map(problem):
+    """Round 6: the dedicated GSPMD fused-scan entry (parallel/gspmd.py,
+    tree_learner=data_gspmd) — sharding CONSTRAINTS into the serial
+    fused program — must grow the same trees as the explicit shard_map
+    fused scan (quantized levels: exact sums; the serial discretizer's
+    global max equals the explicit path's pmax of shard maxes)."""
+    from lightgbm_tpu.parallel.data_parallel import train_fused_sharded
+    from lightgbm_tpu.parallel.gspmd import train_fused_gspmd
+
+    bins, _, _, nb, nanb, cat = map(jnp.asarray, problem)
+    label = jnp.asarray((np.asarray(bins[:, 0]) > 8).astype(np.float32))
+    T = 3
+    mesh = _mesh(DATA_AXIS)
+    trees_e, sc_e = train_fused_sharded(
+        mesh, bins, jnp.zeros(bins.shape[0], jnp.float32), label,
+        nb, nanb, cat, HP, num_rounds=T, batch=4, quantize=True)
+    trees_g, sc_g = train_fused_gspmd(
+        mesh, bins, jnp.zeros(bins.shape[0], jnp.float32), label,
+        nb, nanb, cat, HP, num_rounds=T, batch=4, quantize=True)
+    np.testing.assert_array_equal(np.asarray(trees_g.split_feature),
+                                  np.asarray(trees_e.split_feature))
+    np.testing.assert_array_equal(np.asarray(trees_g.split_bin),
+                                  np.asarray(trees_e.split_bin))
+    np.testing.assert_array_equal(np.asarray(trees_g.num_leaves),
+                                  np.asarray(trees_e.num_leaves))
+    np.testing.assert_allclose(np.asarray(sc_g), np.asarray(sc_e),
+                               atol=1e-5)
+
+
+@pytest.mark.parametrize("n", [1000, 1001])
+def test_gspmd_booster_state_is_row_sharded(n):
+    """tree_learner=data_gspmd places the booster's bins/scores with a
+    row NamedSharding over the 8-device mesh — without padding.  Rows
+    not divisible by the mesh fall back to replicated placement
+    (device_put refuses uneven shards) but still train correctly."""
+    import lightgbm_tpu as lgb
+    rng = np.random.default_rng(4)
+    f = 6
+    X = rng.normal(size=(n, f))
+    y = ((X @ rng.normal(size=f)) > 0).astype(np.float64)
+    p = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 5,
+         "verbose": -1, "tree_learner": "data_gspmd"}
+    bst = lgb.train(p, lgb.Dataset(X, label=y, params=p),
+                    num_boost_round=2, keep_training_booster=True)
+    gb = bst._gbdt
+    assert gb.parallel_mode == "data_gspmd"
+    assert gb.mesh is not None
+    assert gb.bins.shape[0] == n          # no row padding, either way
+    if n % 8 == 0:
+        assert not gb.scores.sharding.is_fully_replicated
+    else:
+        assert gb.scores.sharding.is_fully_replicated
+    assert np.isfinite(bst.predict(X)).all()

@@ -59,6 +59,7 @@ RULE_FIXTURES = [
     ("TPU104", "tpu104_bad.py", "tpu104_ok.py"),
     ("TPU105", "tpu105_bad.py", "tpu105_ok.py"),
     ("TPU106", "parallel/tpu106_bad.py", "parallel/tpu106_ok.py"),
+    ("GRW401", "learner/grw401_bad.py", "learner/grw401_ok.py"),
 ]
 
 
