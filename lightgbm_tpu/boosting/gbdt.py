@@ -1758,6 +1758,14 @@ class GBDT:
     #: vs ~1 s device (round 4).
     DEVICE_PREDICT_MIN_WORK = 20_000_000
 
+    #: _device_predict_raw row-block geometry, as class attributes so
+    #: tests can shrink them to exercise blocking/bucketing without
+    #: million-row inputs.  BLOCK bounds the [ni, n] decision-bit
+    #: transients (~0.5 GB bf16 per 1M rows at 255 leaves); QUANTUM is
+    #: the tail padding grain.
+    PREDICT_BLOCK_ROWS = 1_048_576
+    PREDICT_TAIL_QUANTUM = 131_072
+
     def predict_raw(self, X: np.ndarray, start_iteration: int = 0,
                     num_iteration: int = -1, early=None) -> np.ndarray:
         X = np.asarray(X, dtype=np.float64)
@@ -1803,12 +1811,18 @@ class GBDT:
         if not models:
             return None
         # row blocks bound the [ni, n] decision-bit transients of the
-        # matmul predictors (~0.5 GB bf16 per 1M rows at 255 leaves);
-        # ragged tails pad UP to a 131072 multiple so at most 8 block
-        # shapes ever compile (a fresh shape per remainder would pay
-        # seconds of XLA compile per distinct predict size)
-        blk = 1_048_576
-        tail_q = 131_072
+        # matmul predictors; ragged tails pad UP so a fresh shape per
+        # remainder never pays seconds of XLA compile per distinct
+        # predict size.  predict_bucketing=on (default) pads the tail to
+        # a GEOMETRIC ladder of quantum multiples {q, 2q, 4q, ..., blk},
+        # bounding the compiled program count at log2(blk/q)+1 across
+        # ANY mix of request row counts; =off keeps the pre-serving
+        # next-multiple-of-q padding (up to blk/q shapes).  Padded rows
+        # are sliced off and the matmul predictors are per-row exact, so
+        # outputs are bit-identical either way.
+        blk = int(self.PREDICT_BLOCK_ROWS)
+        tail_q = min(int(self.PREDICT_TAIL_QUANTUM), blk)
+        bucketing = self.config.predict_bucketing == "on"
         general = (any(t.is_linear for t in models)
                    or bool(self.hp.has_categorical)
                    or self.bundle is not None)
@@ -1828,10 +1842,18 @@ class GBDT:
             bins_np = self.train_set.bin_external(X)
         outs = []
         n_all = bins_np.shape[0]
+        total_pad = 0
         for r0 in range(0, n_all, blk):
             chunk = bins_np[r0:r0 + blk]
             rows = chunk.shape[0]
-            pad = (-rows) % min(tail_q, blk)
+            if bucketing:
+                target = tail_q
+                while target < rows:
+                    target *= 2
+                pad = min(target, blk) - rows
+            else:
+                pad = (-rows) % tail_q
+            total_pad += pad
             if pad:
                 chunk = np.concatenate(
                     [chunk, np.zeros((pad, chunk.shape[1]), chunk.dtype)])
@@ -1864,6 +1886,10 @@ class GBDT:
                     lambda: predict_numeric_forest, metrics=self.metrics)
                 res = fn(fa, bins_t, k)
             outs.append(np.asarray(res, np.float64)[:rows])
+        if bucketing:
+            self._count("predict_bucketed_calls")
+            if total_pad:
+                self._count("predict_bucket_pad_rows", total_pad)
         out = np.concatenate(outs, axis=0)
         return out[:, 0] if k == 1 else out
 

@@ -358,14 +358,23 @@ def booster_eval_names(b_id: int) -> str:
 class _FastPredictor:
     """Single-row fast predict (reference c_api.h:1162
     LGBM_BoosterPredictForMatSingleRowFastInit + SingleRowPredictor cache,
-    src/c_api.cpp): tree arrays are stacked ONCE at init so each row is a
-    handful of [T]-vector numpy steps instead of per-call model setup.
-    Falls back to the Booster's own per-tree path for models the stacked
-    walk does not cover (categorical splits, linear leaves) — results are
-    bit-identical to batch predict either way."""
+    src/c_api.cpp): by default rows route through the serving tier's
+    bucket-1 compiled predictor (serving/predictor.py, exact mode) — the
+    SAME compiled leaf-index program every call, so repeated single-row
+    C-API prediction is zero-recompile, and the host f64 finish keeps it
+    bit-identical to ``Booster.predict`` on the same single row for
+    EVERY model shape (categorical, linear, text-loaded; linear-leaf
+    BATCH predict reassociates its BLAS dot, so single-row is the parity
+    anchor).  ``LGBMTPU_NO_SERVE_FASTPATH=1`` (or a serving
+    build failure, warned once) falls back to the pre-serving behavior:
+    stacked numpy walk for plain numeric models, per-row
+    ``Booster.predict`` otherwise — results bit-identical either way."""
 
     def __init__(self, booster, ncol: int, raw_score: bool):
+        import os
+
         from .models.tree import _CAT_MASK, _DEFAULT_LEFT_MASK
+        from .utils import log
         self.booster = booster
         self.ncol = ncol
         self.raw = bool(raw_score)
@@ -375,6 +384,18 @@ class _FastPredictor:
         self.fallback = any(t.is_linear or (t.decision_type & _CAT_MASK).any()
                             for t in trees)
         self.n_trees_snapshot = len(trees)
+        self._served = None
+        if os.environ.get("LGBMTPU_NO_SERVE_FASTPATH", "") != "1":
+            try:
+                from .serving.buckets import BucketLadder
+                from .serving.predictor import CompiledPredictor
+                self._served = CompiledPredictor.from_booster(
+                    booster, ladder=BucketLadder((1,)), exact=True)
+            except Exception as e:
+                log.warning(f"fast predict: serving path unavailable "
+                            f"({type(e).__name__}: {e}); using the "
+                            "stacked-walk path")
+                self._served = None
         if self.fallback:
             return
         T = len(trees)
@@ -409,6 +430,15 @@ class _FastPredictor:
             # booster trained further since init: refresh the stacked
             # arrays so fast predict stays bit-identical to batch predict
             self.__init__(self.booster, self.ncol, self.raw)
+        if self._served is not None:
+            # serving tier: the bucket-1 compiled leaf program (one XLA
+            # program, reused every call) + host f64 finish — bit-identical
+            # to both legacy paths below for every model shape
+            out = np.asarray(self._served.predict(
+                row.reshape(1, -1), raw_score=True), np.float64).reshape(-1)
+            if not self.raw:
+                out = self._transform(out)
+            return out
         if self.fallback:
             return np.atleast_1d(self.booster.predict(
                 row.reshape(1, -1), raw_score=self.raw))

@@ -260,6 +260,9 @@ _PARAMS: List[Tuple[str, Any, Tuple[str, ...], Tuple[Tuple[str, float], ...]]] =
     ("tpu_split_batch", 1, (), ((">", 0),)),      # splits per histogram pass; AUTO POLICY: unset at >=100k rows resolves to min(42, num_leaves-1)
     ("hist_kernel", "auto", (), ()),              # histogram build formulation: auto|onehot|packed|radix2 (ops/histogram.py HIST_KERNELS; all modes bit-identical — onehot = flat reference, packed = 4 bins per i32 lane SWAR compares, radix2 = shared hi/lo nibble planes reused across split-batch leaf channels)
     ("collective_overlap", "auto", (), ()),       # distributed histogram-reduction schedule: auto|on|off (ops/histogram.py reduce_hist; "on"/auto-under-data/voting splits each psum into two independent half-collectives — bit-identical sums — so XLA's latency-hiding scheduler can overlap wire time with local compute; LGBMTPU_NO_OVERLAP is the trace-time A/B hatch; data_gspmd ignores it, the partitioner owns its schedule)
+    ("serving_buckets", [1, 8, 64, 512, 4096], (), ()),  # serving-tier row-count bucket ladder (lightgbm_tpu/serving/): requests are padded up to the smallest bucket >= n (oversize requests chunk by the largest), so every request re-enters an already-compiled program and XLA never lowers at steady state; sorted/deduped, all entries > 0
+    ("predict_bucketing", "on", (), ()),          # batch Booster.predict shape-thrash fix: on|off (boosting/gbdt.py _device_predict_raw pads block tails up to a geometric ladder of tail-quantum multiples instead of the next exact multiple, bounding compiled program count at log2(block/quantum)+1 across ANY mix of row counts; bit-identical — padded rows are sliced off and the path-count matmuls are per-row exact; counters predict_bucketed_calls/predict_bucket_pad_rows)
+    ("serving_telemetry_output", "", (), ()),     # serving per-request JSONL path (serving/server.py PredictionServer: one record per predict() with model/version, rows, buckets hit, pad rows, latency_s; "" disables)
 ]
 
 # Reference-LightGBM parameters this port ACCEPTS but never reads: they
@@ -467,6 +470,16 @@ class Config:
                                    "halt_and_keep_best"):
             log.fatal(f"unknown nan_policy={self.nan_policy!r} (expected "
                       "none/raise/skip_round/halt_and_keep_best)")
+        self.predict_bucketing = str(self.predict_bucketing or "on") \
+            .strip().lower()
+        if self.predict_bucketing not in ("on", "off"):
+            log.fatal(f"unknown predict_bucketing={self.predict_bucketing!r} "
+                      "(expected on/off)")
+        if not self.serving_buckets or \
+                any(int(b) <= 0 for b in self.serving_buckets):
+            log.fatal(f"serving_buckets must be a non-empty list of positive "
+                      f"row counts, got {self.serving_buckets!r}")
+        self.serving_buckets = sorted({int(b) for b in self.serving_buckets})
         # max_depth implies a num_leaves cap when num_leaves not explicit
         if self.max_depth > 0 and not self.is_explicit("num_leaves"):
             full = 1 << min(self.max_depth, 30)

@@ -245,12 +245,56 @@ class LinearLeaves(NamedTuple):
     featmask: jax.Array  # bf16 [T, L, Fr] 1 where the leaf uses the col
 
 
-@functools.partial(jax.jit, static_argnames=("k", "cat_feats"))
+def _leaf_onehot(feat, thr, dl, nanb, mpos, mneg, depth, bins_t,
+                 cat=None, int8: bool = False):
+    """Boolean leaf one-hot [L, n] for ONE stacked tree: decision bits
+    from contiguous row gathers, rows matched to leaves by counting
+    satisfied path conditions with two [L, ni] x [ni, n] matmuls.
+
+    Shared by the value predictors and ``predict_forest_leaves``.  All
+    operands are small integers, so the counts are exact in either
+    operand dtype: bf16 ops / f32 accumulation (``int8=False``, the MXU
+    default) or int8 ops / i32 accumulation (``int8=True``) produce the
+    SAME integer counts — the leaf selection is dtype-invariant, which
+    is what lets serving offer int8 inference without an output change.
+    ``cat``: optional (catn, catf, catb, cat_feats, iota_b) categorical
+    extension (see ``BitsetForest``)."""
+    op_t = jnp.int8 if int8 else jnp.bfloat16
+    acc_t = jnp.int32 if int8 else jnp.float32
+    one = 1 if int8 else 1.0
+    cols = bins_t[feat].astype(jnp.int32)               # [ni, n]
+    go = jnp.where(cols == nanb[:, None], dl[:, None],
+                   cols <= thr[:, None])
+    bits = go.astype(op_t)
+    if cat is not None:
+        catn, catf, catb, cat_feats, iota_b = cat
+        cbits = jnp.zeros((catn.shape[0], bins_t.shape[1]), acc_t)
+        catb_op = catb.astype(op_t)
+        for cf in cat_feats:
+            oh_cf = (bins_t[cf][None, :] == iota_b[:, None]
+                     ).astype(op_t)                     # [Bc, n]
+            sel_cf = (catf == cf).astype(op_t)[:, None]
+            cbits = cbits + lax.dot_general(
+                catb_op * sel_cf, oh_cf, (((1,), (0,)), ((), ())),
+                preferred_element_type=acc_t)           # [C, n]
+        # dead pad slots aim at row ni and drop
+        bits = bits.at[catn].set(cbits.astype(op_t), mode="drop")
+    counts = lax.dot_general(
+        mpos.astype(op_t), bits, (((1,), (0,)), ((), ())),
+        preferred_element_type=acc_t) + lax.dot_general(
+        mneg.astype(op_t), one - bits, (((1,), (0,)), ((), ())),
+        preferred_element_type=acc_t)                   # [L, n] exact ints
+    return (counts.astype(jnp.int32) == depth[:, None]) \
+        & (depth[:, None] >= 0)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "cat_feats", "int8"))
 def predict_bitset_forest(fb: BitsetForest, bins_t: jax.Array, k: int,
                           cat_feats: tuple = (),
                           lin: "LinearLeaves" = None,
                           raw: jax.Array = None,
-                          raw_nan: jax.Array = None) -> jax.Array:
+                          raw_nan: jax.Array = None,
+                          int8: bool = False) -> jax.Array:
     """Batched prediction over ANY stacked forest — the round-5
     generalization of ``predict_numeric_forest`` to categorical /
     EFB-bundled / linear models (VERDICT r4 #5: those kept
@@ -279,30 +323,9 @@ def predict_bitset_forest(fb: BitsetForest, bins_t: jax.Array, k: int,
         else:
             feat, thr, dl, nanb, catn, catf, catb, mpos, mneg, depth, \
                 value, cls = xs
-        ni = feat.shape[0]
-        cols = bins_t[feat]                                 # [ni, n]
-        go = jnp.where(cols == nanb[:, None], dl[:, None],
-                       cols <= thr[:, None])
-        bits = go.astype(jnp.bfloat16)
-        if cat_feats:
-            cbits = jnp.zeros((catn.shape[0], n), jnp.float32)
-            for cf in cat_feats:
-                oh_cf = (bins_t[cf][None, :] == iota_b[:, None]
-                         ).astype(jnp.bfloat16)             # [Bc, n]
-                sel_cf = (catf == cf).astype(jnp.bfloat16)[:, None]
-                cbits = cbits + lax.dot_general(
-                    catb * sel_cf, oh_cf, (((1,), (0,)), ((), ())),
-                    preferred_element_type=jnp.float32)     # [C, n]
-            # dead pad slots aim at row ni and drop
-            bits = bits.at[catn].set(cbits.astype(jnp.bfloat16),
-                                     mode="drop")
-        counts = lax.dot_general(
-            mpos, bits, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32) + lax.dot_general(
-            mneg, 1.0 - bits, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)             # [L, n]
-        sel = (counts.astype(jnp.int32) == depth[:, None]) \
-            & (depth[:, None] >= 0)                         # [L, n]
+        cat = (catn, catf, catb, cat_feats, iota_b) if cat_feats else None
+        sel = _leaf_onehot(feat, thr, dl, nanb, mpos, mneg, depth,
+                           bins_t, cat=cat, int8=int8)      # [L, n]
         if lin is None:
             contrib = jnp.sum(value[:, None] * sel.astype(jnp.float32),
                               axis=0)
@@ -328,9 +351,9 @@ def predict_bitset_forest(fb: BitsetForest, bins_t: jax.Array, k: int,
     return out
 
 
-@functools.partial(jax.jit, static_argnames=("k",))
+@functools.partial(jax.jit, static_argnames=("k", "int8"))
 def predict_numeric_forest(fa: ForestArrays, bins_t: jax.Array,
-                           k: int) -> jax.Array:
+                           k: int, int8: bool = False) -> jax.Array:
     """Batched prediction over a stacked all-numeric forest — the
     matmul reformulation of tree traversal (TPU redesign of the
     reference's per-row walk, tree.h:137 ``Predict``).
@@ -351,17 +374,8 @@ def predict_numeric_forest(fa: ForestArrays, bins_t: jax.Array,
 
     def tree_body(out, xs):
         feat, thr, dl, nanb, mpos, mneg, depth, value, cls = xs
-        cols = bins_t[feat].astype(jnp.int32)           # [ni, n]
-        go = jnp.where(cols == nanb[:, None], dl[:, None],
-                       cols <= thr[:, None])
-        bits = go.astype(jnp.bfloat16)
-        counts = lax.dot_general(
-            mpos, bits, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32) + lax.dot_general(
-            mneg, 1.0 - bits, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)         # [L, n] exact ints
-        sel = (counts.astype(jnp.int32) == depth[:, None]) \
-            & (depth[:, None] >= 0)
+        sel = _leaf_onehot(feat, thr, dl, nanb, mpos, mneg, depth,
+                           bins_t, int8=int8)            # [L, n]
         contrib = jnp.sum(value[:, None] * sel.astype(jnp.float32),
                           axis=0)                        # [n]
         return out.at[:, cls].add(contrib), None
@@ -369,3 +383,34 @@ def predict_numeric_forest(fa: ForestArrays, bins_t: jax.Array,
     out0 = jnp.zeros((n, k), jnp.float32)
     out, _ = lax.scan(tree_body, out0, fa)
     return out
+
+
+@functools.partial(jax.jit, static_argnames=("cat_feats", "int8"))
+def predict_forest_leaves(fb: BitsetForest, bins_t: jax.Array,
+                          cat_feats: tuple = (),
+                          int8: bool = False) -> jax.Array:
+    """LEAF INDEX per row for every tree of a stacked forest — i32
+    [T, n].  The serving tier's exact-mode device program: because the
+    path-count matmuls are integer-exact (``_leaf_onehot``), the leaf a
+    row lands in is independent of batch padding AND of the operand
+    dtype (bf16 vs int8), so the host can finish the prediction in f64
+    (gather leaf values, accumulate in tree order) and match the
+    reference host walk BIT-FOR-BIT on the unpadded rows.
+
+    Rows that are pure padding still land in SOME leaf (bin 0
+    everywhere descends deterministically); callers slice them off.
+    """
+    Bc = fb.catb.shape[-1]
+    iota_b = lax.iota(jnp.int32, Bc)
+
+    def tree_body(carry, xs):
+        feat, thr, dl, nanb, catn, catf, catb, mpos, mneg, depth, \
+            value, cls = xs
+        cat = (catn, catf, catb, cat_feats, iota_b) if cat_feats else None
+        sel = _leaf_onehot(feat, thr, dl, nanb, mpos, mneg, depth,
+                           bins_t, cat=cat, int8=int8)   # [L, n]
+        # exactly one live leaf matches per row; argmax picks it
+        return carry, jnp.argmax(sel, axis=0).astype(jnp.int32)
+
+    _, leaves = lax.scan(tree_body, 0, tuple(fb))
+    return leaves

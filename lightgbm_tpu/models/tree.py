@@ -229,7 +229,17 @@ class Tree:
         gbdt_prediction.cpp) — frontier of node ids, numerical + categorical
         decisions with missing handling; linear leaves add coeff·x with NaN
         fallback to the plain output (tree.h:587)."""
-        leaf = self.predict_leaf_index(X)
+        return self.values_from_leaf_index(X, self.predict_leaf_index(X))
+
+    def values_from_leaf_index(self, X: np.ndarray,
+                               leaf: np.ndarray) -> np.ndarray:
+        """Leaf-index -> f64 output values (the value half of ``predict``).
+
+        Split out so the serving tier's exact mode can compute leaf
+        indices ON DEVICE (models/predict.py ``predict_forest_leaves``,
+        integer-exact and padding-invariant) and still finish with this
+        host f64 computation — bit-identical to the full host walk,
+        linear leaves included."""
         base = self.leaf_value[leaf]
         if not self.is_linear:
             return base

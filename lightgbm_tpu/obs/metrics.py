@@ -60,6 +60,24 @@ COUNTERS: Dict[str, str] = {
     "checkpoint_resumes": "trainings resumed from a checkpoint",
     "checkpoints_skipped_invalid":
         "corrupt checkpoints skipped during resume scan",
+    "serve_requests": "serving-tier predict() requests served",
+    "serve_rows": "real (unpadded) rows served by the serving tier",
+    "serve_bucket_hits":
+        "serving request chunks that re-entered an already-warm bucket",
+    "serve_pad_waste_rows":
+        "padding rows added to reach bucket shapes (wasted device work)",
+    "serve_hot_swaps":
+        "registry publishes that atomically replaced a live model version",
+    "serve_host_fallback_requests":
+        "serving requests answered by the host booster fallback path",
+    "serve_compile_hits":
+        "serving-scope compile-cache hits (ops/compile_cache.py)",
+    "serve_compile_misses":
+        "serving-scope compile-cache misses (ops/compile_cache.py)",
+    "predict_bucketed_calls":
+        "predict_raw device blocks padded to the geometric bucket ladder",
+    "predict_bucket_pad_rows":
+        "padding rows added by predict_raw bucketing (predict_bucketing=on)",
 }
 
 

@@ -1,0 +1,35 @@
+"""Low-latency serving tier (ISSUE 8, ROADMAP item 2).
+
+The training side compiles one program per round geometry; serving
+traffic is the opposite regime — millions of small requests of
+arbitrary row counts, where a single XLA lowering (hundreds of ms)
+dwarfs the forest math (microseconds).  This package makes the compile
+count *finite and front-loaded*:
+
+  * ``buckets.BucketLadder`` — requests pad up to a small geometric
+    ladder of row counts (``serving_buckets`` config key), so every
+    request re-enters an already-compiled program.
+  * ``predictor.CompiledPredictor`` — one immutable model compiled for
+    bucketed serving.  Exact mode computes leaf indices on device
+    (integer-exact path-count matmuls, models/predict.py
+    ``predict_forest_leaves``) and finishes in host f64 — BIT-identical
+    to ``Booster.predict`` on the unpadded rows, linear leaves and all.
+  * ``standalone.build_standalone`` — threshold tables straight from
+    model text, no training Dataset required.
+  * ``registry.ModelRegistry`` — name/version keyed models with atomic
+    zero-downtime hot-swap.
+  * ``server.PredictionServer`` — the request-facing facade: bucket
+    routing, telemetry counters, per-request JSONL.
+
+Measured with ``tools/bench_serve.py``; compile programs are counted by
+the obs/compile_events.py listener, and the tier-1 gate asserts ZERO
+new lowerings over >= 100 mixed-shape steady-state requests.
+"""
+
+from .buckets import BucketLadder
+from .predictor import CompiledPredictor, StandaloneUnsupported
+from .registry import ModelRegistry
+from .server import PredictionServer
+
+__all__ = ["BucketLadder", "CompiledPredictor", "StandaloneUnsupported",
+           "ModelRegistry", "PredictionServer"]

@@ -1,0 +1,247 @@
+"""One immutable model compiled for bucketed serving.
+
+The serving contract is two-sided:
+
+  * **Zero recompiles at steady state** — every device entry is a
+    module-level jitted program invoked at bucket-quantized shapes
+    through ops/compile_cache.py, so after one warmup pass per bucket
+    the XLA lowering count stays FLAT over any mix of request shapes
+    (tests assert this through the obs/compile_events.py counter).
+  * **Bit-identity with ``Booster.predict``** — the default *exact*
+    mode runs only the integer part on device: per-tree leaf indices
+    from ``predict_forest_leaves`` (path-count matmuls over small
+    integers — exact in bf16 OR int8, hence padding- and
+    dtype-invariant), then gathers leaf values and accumulates per tree
+    in host float64, the same arithmetic and order as the host walk
+    (``Tree.values_from_leaf_index`` + ascending-tree accumulation).
+    Linear leaves ride the same host path.
+
+Converted scores (``raw_score=False``) transform the raw margins on
+the HOST in f64 (``basic._objective_string_transform``) — bitwise what
+a text-loaded ``Booster.predict`` returns, and shape-independent (a
+device conversion would lower a program per unpadded output shape,
+breaking the zero-recompile contract).  A TRAINED booster's own
+``predict`` converts through the objective's f32 device kernel, so for
+sigmoid/softmax objectives the trained-vs-served converted scores agree
+to f32 rounding rather than bitwise; raw margins are bitwise always.
+
+The optional *fast* mode (``exact=False``) keeps the whole sum on
+device (``predict_bitset_forest`` f32) — bit-identical to the trained
+booster's own device predict path, and still padding-invariant for
+non-linear models (one-hot value selection + fixed-order tree adds),
+but f32 rather than the host walk's f64.  Linear models force exact
+mode (their f32 coefficient dot is reassociation-sensitive).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..obs.metrics import MetricsRegistry, count_event
+from ..ops import compile_cache as cc
+from ..utils import log
+from .buckets import BucketLadder
+from .standalone import StandaloneUnsupported, build_standalone
+
+
+class RequestStats:
+    """Per-request accounting the server turns into counters/JSONL."""
+
+    __slots__ = ("rows", "chunks", "pad_rows", "warm_chunks", "fallback")
+
+    def __init__(self) -> None:
+        self.rows = 0
+        self.chunks: List[Tuple[int, int]] = []  # (bucket, real rows)
+        self.pad_rows = 0
+        self.warm_chunks = 0
+        self.fallback = False
+
+
+class CompiledPredictor:
+    """Immutable compiled view of one model.
+
+    Arrays never change after construction — a hot-swap builds a NEW
+    predictor and atomically replaces the registry entry, so in-flight
+    requests keep predicting on the forest they resolved.  Compile-cache
+    entries are anchored on the predictor: when the last reference to a
+    swapped-out model drops, its programs leave the cache with it.
+    """
+
+    def __init__(self, booster, *, ladder: Optional[BucketLadder] = None,
+                 exact: bool = True, int8: bool = False,
+                 metrics: Optional[MetricsRegistry] = None) -> None:
+        from ..basic import Booster  # lazy: basic imports a lot
+        if not isinstance(booster, Booster):
+            raise log.LightGBMError(
+                "CompiledPredictor requires a Booster (use from_model_text "
+                "/ from_model_file for text artifacts)")
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        self.ladder = ladder if ladder is not None else BucketLadder()
+        self.int8 = bool(int8)
+        self.k = max(1, booster.num_model_per_iteration())
+        self.num_features = booster.num_feature()
+        self.trees = list(booster._get_trees())
+        self._warm: set = set()        # buckets already traced
+        self._warm_lock = threading.Lock()
+        self._fallback = None          # host Booster when unsupported
+        self._lin = None
+        g = booster._gbdt
+        if g is not None:
+            from ..models.model_io import objective_to_string
+            self.objective_str = objective_to_string(
+                g.objective.NAME if g.objective else "none", g.config)
+            ds = g.train_set
+            self._binner = ds.bin_external_pred
+            self.fb, lin, self.cat_feats = \
+                g._forest_bitset_arrays(self.trees, self.k)
+            self._lin = lin
+        else:
+            self.objective_str = booster._loaded["objective"]
+            try:
+                binner, self.fb, self.cat_feats = build_standalone(
+                    self.trees, self.num_features, self.k)
+            except StandaloneUnsupported as e:
+                log.warning(f"serving: standalone tables unavailable "
+                            f"({e}); requests use the host booster")
+                self._fallback = booster
+                self.fb = None
+                self.cat_feats = ()
+                self.exact = True
+                return
+            self._binner = binner.bin
+        self.exact = bool(exact)
+        if not self.exact and (self._lin is not None
+                               or any(t.is_linear for t in self.trees)):
+            log.warning("serving: fast (device-sum) mode is not "
+                        "padding-stable for linear leaves; using exact mode")
+            self.exact = True
+
+    # ------------------------------------------------------------ factories
+    @classmethod
+    def from_booster(cls, booster, **kw) -> "CompiledPredictor":
+        return cls(booster, **kw)
+
+    @classmethod
+    def from_model_text(cls, model_str: str, **kw) -> "CompiledPredictor":
+        from ..basic import Booster
+        return cls(Booster(model_str=model_str), **kw)
+
+    @classmethod
+    def from_model_file(cls, model_file: str, **kw) -> "CompiledPredictor":
+        from ..basic import Booster
+        return cls(Booster(model_file=model_file), **kw)
+
+    # ------------------------------------------------------------- internals
+    def _leaves_for_chunk(self, bins: np.ndarray, rows: int,
+                          bucket: int) -> np.ndarray:
+        """Device leaf indices for one bucket-padded chunk: i32
+        [T, rows] (padding sliced off)."""
+        import jax.numpy as jnp
+
+        from ..models.predict import predict_forest_leaves
+        padded = np.zeros((bucket, bins.shape[1]), bins.dtype)
+        padded[:rows] = bins
+        bins_t = jnp.asarray(np.ascontiguousarray(padded.T))
+        fn = cc.get_or_build(
+            ("serve_leaves", cc.sig((self.fb, bins_t)), self.cat_feats,
+             self.int8),
+            lambda: predict_forest_leaves, anchors=(self,),
+            metrics=self.metrics, counter_ns="serve")
+        lv = fn(self.fb, bins_t, cat_feats=self.cat_feats, int8=self.int8)
+        return np.asarray(lv)[:, :rows]
+
+    def _sums_for_chunk(self, bins: np.ndarray, rows: int,
+                        bucket: int) -> np.ndarray:
+        """Fast mode: full device f32 sums for one padded chunk,
+        f64-cast and sliced — [rows, k]."""
+        import jax.numpy as jnp
+
+        from ..models.predict import predict_bitset_forest
+        padded = np.zeros((bucket, bins.shape[1]), bins.dtype)
+        padded[:rows] = bins
+        bins_t = jnp.asarray(np.ascontiguousarray(padded.T))
+        fn = cc.get_or_build(
+            ("serve_sums", cc.sig((self.fb, bins_t)), self.k,
+             self.cat_feats, self.int8),
+            lambda: predict_bitset_forest, anchors=(self,),
+            metrics=self.metrics, counter_ns="serve")
+        res = fn(self.fb, bins_t, self.k, cat_feats=self.cat_feats,
+                 int8=self.int8)
+        return np.asarray(res, np.float64)[:rows]
+
+    def _mark_chunk(self, bucket: int, stats: RequestStats) -> None:
+        with self._warm_lock:
+            if bucket in self._warm:
+                stats.warm_chunks += 1
+            else:
+                self._warm.add(bucket)
+
+    # -------------------------------------------------------------- predict
+    def predict_ex(self, X, raw_score: bool = True):
+        """(output, RequestStats).  Output matches ``Booster.predict``:
+        [n] for single-output models, [n, k] for multiclass."""
+        X = np.asarray(X, np.float64)
+        if X.ndim == 1:
+            X = X.reshape(1, -1)
+        n = X.shape[0]
+        stats = RequestStats()
+        stats.rows = n
+        if self._fallback is not None:
+            stats.fallback = True
+            count_event("serve_host_fallback_requests", 1, self.metrics)
+            return self._fallback.predict(X, raw_score=raw_score), stats
+        bins = self._binner(X)
+        chunks = self.ladder.chunks(n)
+        for off, rows, bucket in chunks:
+            stats.chunks.append((bucket, rows))
+            stats.pad_rows += bucket - rows
+            self._mark_chunk(bucket, stats)
+        if self.exact:
+            leaves = np.empty((len(self.trees), n), np.int32)
+            for off, rows, bucket in chunks:
+                leaves[:, off:off + rows] = self._leaves_for_chunk(
+                    bins[off:off + rows], rows, bucket)
+            out = np.zeros((n, self.k))
+            # ascending tree order, one f64 add per tree — the exact
+            # accumulation of the host walk (basic.py _predict_loaded)
+            for ti, t in enumerate(self.trees):
+                out[:, ti % self.k] += t.values_from_leaf_index(
+                    X, leaves[ti])
+        else:
+            out = np.zeros((n, self.k))
+            for off, rows, bucket in chunks:
+                out[off:off + rows] = self._sums_for_chunk(
+                    bins[off:off + rows], rows, bucket)
+        if not raw_score:
+            from ..basic import _objective_string_transform
+            out = _objective_string_transform(out, self.objective_str)
+        return (out[:, 0] if self.k == 1 else out), stats
+
+    def predict(self, X, raw_score: bool = True):
+        return self.predict_ex(X, raw_score=raw_score)[0]
+
+    # --------------------------------------------------------------- warmup
+    def warmup(self) -> Dict[int, float]:
+        """Trace + compile every bucket program up front; returns
+        {bucket: seconds} (the cold-compile cost a live request never
+        pays).  Idempotent — warm buckets take the trace-cache hit
+        path and cost microseconds."""
+        import time
+        if self._fallback is not None:
+            return {}
+        timings: Dict[int, float] = {}
+        width = self.num_features
+        for b in self.ladder.sizes:
+            t0 = time.perf_counter()
+            bins = self._binner(np.zeros((b, width)))
+            if self.exact:
+                self._leaves_for_chunk(bins, b, b)
+            else:
+                self._sums_for_chunk(bins, b, b)
+            timings[b] = time.perf_counter() - t0
+            with self._warm_lock:
+                self._warm.add(b)
+        return timings

@@ -1,0 +1,89 @@
+"""Multi-model registry with zero-downtime hot-swap.
+
+Models are keyed by name; each publish gets a monotonically increasing
+version per name.  The swap itself is one dict assignment under a lock
+— requests resolve their entry ONCE at arrival and keep a strong
+reference to that entry's (immutable) predictor, so a request that was
+in flight when a new version landed finishes entirely on the old
+forest: outputs are always old-model or new-model, never a mix
+(tests/test_serving.py hammers this from concurrent threads).  The old
+predictor is garbage-collected when the last in-flight request drops
+it, which also evicts its compile-cache entries (they are anchored on
+the predictor object).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, NamedTuple, Optional
+
+from ..obs.metrics import MetricsRegistry, count_event
+from ..utils import log
+from .predictor import CompiledPredictor
+
+
+class ModelEntry(NamedTuple):
+    name: str
+    version: int
+    predictor: CompiledPredictor
+    published_unix: float
+
+
+class ModelRegistry:
+    def __init__(self, metrics: Optional[MetricsRegistry] = None) -> None:
+        self._entries: Dict[str, ModelEntry] = {}
+        self._next_version: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        self.metrics = metrics
+
+    def publish(self, name: str, predictor: CompiledPredictor,
+                version: Optional[int] = None) -> ModelEntry:
+        """Atomically install ``predictor`` as the live version of
+        ``name``.  The predictor should be fully built (and ideally
+        warmed) BEFORE publishing — the swap takes effect for the very
+        next request."""
+        with self._lock:
+            if version is None:
+                version = self._next_version.get(name, 0) + 1
+            self._next_version[name] = max(
+                version, self._next_version.get(name, 0))
+            replacing = name in self._entries
+            entry = ModelEntry(name=name, version=int(version),
+                               predictor=predictor,
+                               published_unix=time.time())
+            self._entries[name] = entry
+        if replacing:
+            count_event("serve_hot_swaps", 1, self.metrics)
+        return entry
+
+    def get(self, name: str) -> ModelEntry:
+        with self._lock:
+            entry = self._entries.get(name)
+        if entry is None:
+            raise log.LightGBMError(
+                f"serving registry has no model named {name!r} "
+                f"(published: {sorted(self._entries) or 'none'})")
+        return entry
+
+    def unpublish(self, name: str) -> None:
+        with self._lock:
+            self._entries.pop(name, None)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._entries)
+
+    def info(self) -> List[dict]:
+        with self._lock:
+            entries = list(self._entries.values())
+        return [{"name": e.name, "version": e.version,
+                 "num_trees": len(e.predictor.trees),
+                 "int8": e.predictor.int8,
+                 "exact": e.predictor.exact,
+                 "fallback": e.predictor._fallback is not None,
+                 "published_unix": e.published_unix} for e in entries]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)

@@ -1,0 +1,130 @@
+"""Request-facing serving facade.
+
+``PredictionServer`` ties the pieces together: a ``BucketLadder`` from
+the ``serving_buckets`` config key, a ``ModelRegistry`` for hot-swap,
+per-request telemetry counters (obs/metrics.py) and an optional
+per-request JSONL stream (``serving_telemetry_output``).  It is a
+library-level server — transport (HTTP/gRPC) is out of scope; callers
+embed it and drive ``predict()`` from their own request loop, which is
+also exactly what tools/bench_serve.py and the tier-1 steady-state
+zero-lowerings gate do.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..config import Config
+from ..obs.metrics import MetricsRegistry, count_event
+from .buckets import BucketLadder
+from .predictor import CompiledPredictor
+from .registry import ModelEntry, ModelRegistry
+
+
+class PredictionServer:
+    def __init__(self, params: Optional[Dict[str, Any]] = None,
+                 registry: Optional[ModelRegistry] = None) -> None:
+        cfg = params if isinstance(params, Config) else Config(params or {})
+        self.ladder = BucketLadder(cfg.serving_buckets)
+        self.metrics = MetricsRegistry()
+        self.registry = registry if registry is not None \
+            else ModelRegistry(metrics=self.metrics)
+        self._tele_path = str(cfg.serving_telemetry_output or "")
+        self._tele_lock = threading.Lock()
+        self._tele_file = None
+
+    # ------------------------------------------------------------- publish
+    def publish(self, name: str, *, booster=None, model_text: str = None,
+                model_file: str = None, version: Optional[int] = None,
+                int8: bool = False, exact: bool = True,
+                warmup: bool = True) -> ModelEntry:
+        """Build, (optionally) warm, then atomically publish a model.
+
+        Exactly one of ``booster`` / ``model_text`` / ``model_file``
+        selects the source.  ``warmup=True`` (default) compiles every
+        bucket program BEFORE the swap, so the new version's first
+        request pays zero compile time — that is what makes the swap
+        zero-downtime rather than merely atomic.  The per-bucket
+        compile seconds land on ``entry_compile_s(name)``."""
+        from ..utils import log
+        sources = [s is not None for s in (booster, model_text, model_file)]
+        if sum(sources) != 1:
+            raise log.LightGBMError(
+                "publish() needs exactly one of booster=, model_text=, "
+                "model_file=")
+        kw = dict(ladder=self.ladder, int8=int8, exact=exact,
+                  metrics=self.metrics)
+        if booster is not None:
+            predictor = CompiledPredictor.from_booster(booster, **kw)
+        elif model_text is not None:
+            predictor = CompiledPredictor.from_model_text(model_text, **kw)
+        else:
+            predictor = CompiledPredictor.from_model_file(model_file, **kw)
+        compile_s = predictor.warmup() if warmup else {}
+        entry = self.registry.publish(name, predictor, version=version)
+        self._last_compile_s = dict(compile_s)
+        return entry
+
+    def entry_compile_s(self) -> Dict[int, float]:
+        """Per-bucket warmup compile seconds of the LAST publish()."""
+        return dict(getattr(self, "_last_compile_s", {}))
+
+    # ------------------------------------------------------------- predict
+    def predict(self, name: str, X, raw_score: bool = True) -> np.ndarray:
+        """Serve one request against the current live version of
+        ``name``.  The entry is resolved once — a concurrent hot-swap
+        cannot change the forest mid-request."""
+        entry = self.registry.get(name)
+        t0 = time.perf_counter()
+        out, stats = entry.predictor.predict_ex(X, raw_score=raw_score)
+        latency_s = time.perf_counter() - t0
+        count_event("serve_requests", 1, self.metrics)
+        count_event("serve_rows", stats.rows, self.metrics)
+        if stats.pad_rows:
+            count_event("serve_pad_waste_rows", stats.pad_rows, self.metrics)
+        if stats.warm_chunks:
+            count_event("serve_bucket_hits", stats.warm_chunks, self.metrics)
+        self._emit(entry, stats, latency_s, raw_score)
+        return out
+
+    # ----------------------------------------------------------- telemetry
+    def _emit(self, entry: ModelEntry, stats, latency_s: float,
+              raw_score: bool) -> None:
+        if not self._tele_path:
+            return
+        rec = {"ts": time.time(), "model": entry.name,
+               "version": entry.version, "rows": stats.rows,
+               "buckets": [b for b, _ in stats.chunks],
+               "pad_rows": stats.pad_rows,
+               "warm_chunks": stats.warm_chunks,
+               "fallback": stats.fallback,
+               "latency_s": latency_s, "raw_score": raw_score}
+        line = json.dumps(rec) + "\n"
+        with self._tele_lock:
+            if self._tele_file is None:
+                from ..utils.paths import check_output_path
+                if not check_output_path(self._tele_path,
+                                         key="serving_telemetry_output"):
+                    self._tele_path = ""   # warned once; disable
+                    return
+                self._tele_file = open(self._tele_path, "a")
+            self._tele_file.write(line)
+            self._tele_file.flush()
+
+    def stats(self) -> Dict[str, Any]:
+        snap = self.metrics.snapshot()["counters"]
+        return {"models": self.registry.info(),
+                "buckets": list(self.ladder.sizes),
+                "counters": {k: v for k, v in snap.items()
+                             if k.startswith("serve_")}}
+
+    def close(self) -> None:
+        with self._tele_lock:
+            if self._tele_file is not None:
+                self._tele_file.close()
+                self._tele_file = None

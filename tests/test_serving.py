@@ -1,0 +1,504 @@
+"""Serving-tier tests (lightgbm_tpu/serving/): bucket ladder, padded
+bit-identity, hot-swap, and the zero-recompile steady-state gate.
+
+The serving contract under test, both sides:
+
+  * bit-identity — bucketed (padded) serving output is ``np.array_equal``
+    to ``Booster.predict`` on the unpadded input, across numeric /
+    categorical / linear / multiclass / int8 forests, trained AND
+    text-loaded, raw and converted scores;
+  * zero recompiles — after one warmup pass per bucket, the
+    ``xla_program_lowerings`` counter (obs/compile_events.py, fires per
+    trace-cache miss) stays FLAT over 100+ mixed-shape requests, multiple
+    live models included.
+
+Plus the satellites: the gbdt batch-predict tail bucketing
+(``predict_bucketing``), the single-row C-API fast path riding the
+bucket-1 program, registry hot-swap semantics under concurrency, the
+per-request JSONL telemetry, and the bench_serve -> bench_compare gate.
+"""
+
+import json
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.obs import compile_events
+from lightgbm_tpu.obs.metrics import global_metrics
+from lightgbm_tpu.serving import (BucketLadder, CompiledPredictor,
+                                  ModelRegistry, PredictionServer,
+                                  StandaloneUnsupported)
+
+
+def _lowerings() -> int:
+    assert compile_events.install() or compile_events.installed()
+    return int(global_metrics.counter("xla_program_lowerings"))
+
+
+# ------------------------------------------------------------ shared models
+@pytest.fixture(scope="module")
+def reg_model():
+    """Numeric regression forest with NaN-bearing features."""
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(400, 6))
+    X[rng.random(X.shape) < 0.08] = np.nan
+    y = np.nansum(X[:, :3], axis=1) + rng.normal(scale=0.1, size=400)
+    bst = lgb.train({"objective": "regression", "num_iterations": 10,
+                     "num_leaves": 15, "min_data_in_leaf": 5,
+                     "verbosity": -1}, lgb.Dataset(X, label=y))
+    return bst, X
+
+
+@pytest.fixture(scope="module")
+def cat_model():
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(400, 5))
+    X[:, 4] = rng.integers(0, 8, size=400)
+    y = X[:, 0] + (X[:, 4] > 3) + rng.normal(scale=0.1, size=400)
+    bst = lgb.train({"objective": "regression", "num_iterations": 8,
+                     "num_leaves": 15, "categorical_feature": [4],
+                     "min_data_in_leaf": 5, "verbosity": -1},
+                    lgb.Dataset(X, label=y))
+    return bst, X
+
+
+@pytest.fixture(scope="module")
+def linear_model():
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(400, 4))
+    y = X @ np.array([1.0, -2.0, 0.5, 0.0]) + rng.normal(scale=0.05,
+                                                         size=400)
+    bst = lgb.train({"objective": "regression", "num_iterations": 6,
+                     "num_leaves": 8, "linear_tree": True,
+                     "verbosity": -1}, lgb.Dataset(X, label=y))
+    return bst, X
+
+
+@pytest.fixture(scope="module")
+def multi_model():
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(450, 5))
+    y = (rng.integers(0, 3, size=450)).astype(np.float64)
+    bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                     "num_iterations": 5, "num_leaves": 10,
+                     "verbosity": -1}, lgb.Dataset(X, label=y))
+    return bst, X
+
+
+# ------------------------------------------------------------ bucket ladder
+def test_bucket_ladder_table():
+    lad = BucketLadder((1, 8, 64, 512))
+    table = {1: 1, 2: 8, 8: 8, 9: 64, 64: 64, 65: 512, 512: 512,
+             513: 512, 5000: 512}  # oversize -> largest (chunked)
+    for n, b in table.items():
+        assert lad.bucket_for(n) == b, (n, b)
+    # chunks: full max-bucket chunks then a ladder-fitted tail
+    assert lad.chunks(5) == [(0, 5, 8)]
+    assert lad.chunks(64) == [(0, 64, 64)]
+    assert lad.chunks(513) == [(0, 512, 512), (512, 1, 1)]
+    assert lad.chunks(1100) == [(0, 512, 512), (512, 512, 512),
+                                (1024, 76, 512)]
+    assert lad.pad_rows(5) == 3
+    assert lad.pad_rows(64) == 0
+
+
+def test_bucket_ladder_validation():
+    with pytest.raises(lgb.LightGBMError):
+        BucketLadder(())
+    with pytest.raises(lgb.LightGBMError):
+        BucketLadder((0, 8))
+    with pytest.raises(lgb.LightGBMError):
+        BucketLadder((-4,))
+    # dedupe + sort
+    assert BucketLadder((64, 8, 8, 1)).sizes == (1, 8, 64)
+
+
+def test_config_serving_keys():
+    from lightgbm_tpu.config import Config
+    cfg = Config({})
+    assert cfg.serving_buckets == [1, 8, 64, 512, 4096]
+    assert cfg.predict_bucketing == "on"
+    cfg = Config({"serving_buckets": [64, 8, 8],
+                  "predict_bucketing": "off"})
+    assert cfg.serving_buckets == [8, 64]
+    assert cfg.predict_bucketing == "off"
+    with pytest.raises(lgb.LightGBMError):
+        Config({"predict_bucketing": "sometimes"})
+    with pytest.raises(lgb.LightGBMError):
+        Config({"serving_buckets": []})
+    with pytest.raises(lgb.LightGBMError):
+        Config({"serving_buckets": [0, 8]})
+
+
+# ----------------------------------------------------- padded bit-identity
+SIZES = (1, 3, 8, 37, 64, 130)
+LADDER = BucketLadder((1, 8, 64))
+
+
+def _assert_bit_identical(bst, X, **kw):
+    pred = CompiledPredictor.from_booster(bst, ladder=LADDER, **kw)
+    assert pred._fallback is None
+    g = bst._gbdt
+    # serving converts margins on the host in f64 (the text-loaded
+    # Booster semantics); a TRAINED booster's own predict converts via
+    # the objective's f32 device kernel, so for transform objectives
+    # the converted comparison is f32-rounding-close, raw is bitwise
+    conv_exact = g is None or g.objective is None \
+        or not g.objective.need_convert_output
+    for n in SIZES:
+        for raw in (True, False):
+            got = np.asarray(pred.predict(X[:n], raw_score=raw))
+            want = np.asarray(bst.predict(X[:n], raw_score=raw))
+            if raw or conv_exact:
+                assert np.array_equal(got, want), (n, raw, kw)
+            else:
+                np.testing.assert_allclose(got, want, rtol=1e-6,
+                                           atol=1e-7)
+                if n > 1:  # conversion is per-row: padding-invariant
+                    sub = np.asarray(pred.predict(X[:n - 1],
+                                                  raw_score=False))
+                    assert np.array_equal(got[:n - 1], sub), (n, kw)
+
+
+def test_exact_bit_identity_numeric(reg_model):
+    _assert_bit_identical(*reg_model)
+
+
+def test_exact_bit_identity_numeric_int8(reg_model):
+    # int8 device ops select the same integer leaves: small-integer
+    # matmuls are exact in both dtypes
+    _assert_bit_identical(*reg_model, int8=True)
+
+
+def test_exact_bit_identity_categorical(cat_model):
+    _assert_bit_identical(*cat_model)
+    _assert_bit_identical(*cat_model, int8=True)
+
+
+def test_exact_bit_identity_linear(linear_model):
+    _assert_bit_identical(*linear_model)
+
+
+def test_exact_bit_identity_multiclass(multi_model):
+    _assert_bit_identical(*multi_model)
+
+
+def test_exact_bit_identity_text_loaded(reg_model, cat_model):
+    for bst, X in (reg_model, cat_model):
+        loaded = lgb.Booster(model_str=bst.model_to_string())
+        pred = CompiledPredictor.from_model_text(bst.model_to_string(),
+                                                 ladder=LADDER)
+        assert pred._fallback is None  # standalone tables built
+        for n in SIZES:
+            for raw in (True, False):
+                got = pred.predict(X[:n], raw_score=raw)
+                want = loaded.predict(X[:n], raw_score=raw)
+                assert np.array_equal(np.asarray(got),
+                                      np.asarray(want)), (n, raw)
+
+
+def test_fast_mode_close_and_linear_forces_exact(reg_model, linear_model):
+    bst, X = reg_model
+    pred = CompiledPredictor.from_booster(bst, ladder=LADDER, exact=False)
+    assert not pred.exact
+    got = pred.predict(X[:50])
+    np.testing.assert_allclose(got, bst.predict(X[:50], raw_score=True),
+                               rtol=1e-5, atol=1e-5)
+    # fast mode is padding-invariant even though it is f32
+    assert np.array_equal(pred.predict(X[:49]), np.asarray(got)[:49])
+    lb, lX = linear_model
+    lpred = CompiledPredictor.from_booster(lb, ladder=LADDER, exact=False)
+    assert lpred.exact  # forced: linear f32 dot is reassociation-sensitive
+
+
+def test_standalone_fallback(reg_model, monkeypatch):
+    bst, X = reg_model
+    import lightgbm_tpu.serving.predictor as sp
+
+    def boom(*a, **k):
+        raise StandaloneUnsupported("forced by test")
+    monkeypatch.setattr(sp, "build_standalone", boom)
+    pred = CompiledPredictor.from_model_text(bst.model_to_string())
+    assert pred._fallback is not None
+    base = global_metrics.counter("serve_host_fallback_requests")
+    out, stats = pred.predict_ex(X[:9])
+    assert stats.fallback
+    assert global_metrics.counter("serve_host_fallback_requests") == base + 1
+    assert np.array_equal(
+        out, lgb.Booster(model_str=bst.model_to_string())
+        .predict(X[:9], raw_score=True))
+
+
+def test_standalone_rejects_empty():
+    from lightgbm_tpu.serving.standalone import build_standalone
+    with pytest.raises(StandaloneUnsupported):
+        build_standalone([], 4, 1)
+
+
+# ------------------------------------------------------------- registry
+def test_registry_semantics(reg_model, cat_model):
+    bst, _ = reg_model
+    cbst, _ = cat_model
+    reg = ModelRegistry()
+    p1 = CompiledPredictor.from_booster(bst, ladder=LADDER)
+    p2 = CompiledPredictor.from_booster(cbst, ladder=LADDER)
+    e1 = reg.publish("m", p1)
+    assert (e1.version, len(reg)) == (1, 1)
+    base = global_metrics.counter("serve_hot_swaps")
+    e2 = reg.publish("m", p2)
+    assert e2.version == 2
+    assert global_metrics.counter("serve_hot_swaps") == base + 1
+    assert reg.get("m").predictor is p2
+    info = reg.info()[0]
+    assert info["name"] == "m" and info["version"] == 2
+    with pytest.raises(lgb.LightGBMError, match="ghost"):
+        reg.get("ghost")
+    reg.unpublish("m")
+    assert len(reg) == 0
+
+
+def test_publish_source_validation(reg_model):
+    bst, _ = reg_model
+    srv = PredictionServer({"serving_buckets": [1, 8]})
+    with pytest.raises(lgb.LightGBMError):
+        srv.publish("m")
+    with pytest.raises(lgb.LightGBMError):
+        srv.publish("m", booster=bst, model_text=bst.model_to_string())
+    srv.publish("m", model_text=bst.model_to_string(), warmup=False)
+    assert srv.registry.get("m").version == 1
+
+
+def test_hot_swap_concurrent_never_mixes(reg_model):
+    """Requests racing a stream of hot-swaps must each see exactly ONE
+    model's forest — outputs always equal one booster's reference,
+    never a blend."""
+    bst, X = reg_model
+    rng = np.random.default_rng(9)
+    Xq = np.nan_to_num(X[:33])
+    # second model: same forest + shifted labels -> disjoint outputs
+    y2 = np.nansum(X[:, :3], axis=1) + 1000.0
+    bst2 = lgb.train({"objective": "regression", "num_iterations": 10,
+                      "num_leaves": 15, "min_data_in_leaf": 5,
+                      "verbosity": -1},
+                     lgb.Dataset(np.nan_to_num(X), label=y2))
+    ref1 = bst.predict(Xq, raw_score=True)
+    ref2 = bst2.predict(Xq, raw_score=True)
+    assert not np.array_equal(ref1, ref2)
+    srv = PredictionServer({"serving_buckets": [8, 64]})
+    srv.publish("m", booster=bst)
+    srv.publish("swap-src", booster=bst2)  # pre-build both predictors
+    p1 = srv.registry.get("m").predictor
+    p2 = srv.registry.get("swap-src").predictor
+    stop = threading.Event()
+    errors = []
+
+    def requester():
+        while not stop.is_set():
+            out = np.asarray(srv.predict("m", Xq))
+            if not (np.array_equal(out, ref1) or np.array_equal(out, ref2)):
+                errors.append(out)
+                return
+
+    threads = [threading.Thread(target=requester) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for i in range(60):  # hammer swaps under load
+        srv.registry.publish("m", p2 if i % 2 == 0 else p1)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors, "a request observed a mixed/unknown forest"
+    assert srv.registry.get("m").version >= 60
+
+
+# ------------------------------------------- steady-state zero lowerings
+def test_steady_state_zero_lowerings(reg_model, multi_model):
+    """The tentpole CI gate: after one warmup pass per bucket, 100+
+    mixed-shape requests across MULTIPLE live models must add zero XLA
+    lowerings (every request re-enters a compiled bucket program)."""
+    bst, X = reg_model
+    mbst, mX = multi_model
+    srv = PredictionServer({"serving_buckets": [1, 8, 64]})
+    srv.publish("reg", booster=bst)          # warmup=True compiles all
+    srv.publish("multi", booster=mbst)       # buckets up front
+    base = _lowerings()
+    rng = np.random.default_rng(4)
+    for i in range(110):
+        n = int(rng.integers(1, 130))
+        if i % 3 == 2:
+            srv.predict("multi", mX[:n], raw_score=(i % 2 == 0))
+        else:
+            srv.predict("reg", X[:n], raw_score=(i % 2 == 0))
+    assert _lowerings() - base == 0, \
+        "serving steady state lowered new XLA programs"
+    counters = srv.stats()["counters"]
+    assert counters["serve_requests"] == 110
+    assert counters["serve_bucket_hits"] > 0
+    assert counters["serve_pad_waste_rows"] > 0
+
+
+# ------------------------------------------------- gbdt predict bucketing
+def _patch_predict_geometry(monkeypatch):
+    from lightgbm_tpu.boosting.gbdt import GBDT
+    monkeypatch.setattr(GBDT, "PREDICT_BLOCK_ROWS", 1024)
+    monkeypatch.setattr(GBDT, "PREDICT_TAIL_QUANTUM", 64)
+    monkeypatch.setattr(GBDT, "DEVICE_PREDICT_MIN_WORK", 0)
+
+
+def test_gbdt_bucketing_bit_identity_and_optout(reg_model, monkeypatch):
+    _patch_predict_geometry(monkeypatch)
+    bst, X = reg_model
+    rng = np.random.default_rng(5)
+    Xbig = rng.normal(size=(2600, X.shape[1]))
+    p_off = {"objective": "regression", "num_iterations": 10,
+             "num_leaves": 15, "min_data_in_leaf": 5, "verbosity": -1,
+             "predict_bucketing": "off"}
+    rng2 = np.random.default_rng(0)
+    Xt = rng2.normal(size=(400, 6))
+    Xt[rng2.random(Xt.shape) < 0.08] = np.nan
+    yt = np.nansum(Xt[:, :3], axis=1) + rng2.normal(scale=0.1, size=400)
+    bst_off = lgb.train(p_off, lgb.Dataset(Xt, label=yt))
+    g_on, g_off = bst._gbdt, bst_off._gbdt
+    c0 = global_metrics.counter("predict_bucketed_calls")
+    for n in (1, 63, 64, 65, 333, 1024, 1500, 2600):
+        a = g_on._device_predict_raw(Xbig[:n], 0, 10)
+        b = g_off._device_predict_raw(Xbig[:n], 0, 10)
+        # bucket padding never changes values (padded rows sliced off,
+        # per-row-exact matmuls)
+        assert np.array_equal(a, b), n
+        if n > 1:
+            sub = g_on._device_predict_raw(Xbig[:n - 1], 0, 10)
+            assert np.array_equal(a[:n - 1], sub), n
+    assert global_metrics.counter("predict_bucketed_calls") > c0
+
+
+def test_gbdt_bucketing_bounds_lowerings(reg_model, monkeypatch):
+    """With blk=1024 / quantum=64 the geometric ladder admits exactly
+    {64, 128, 256, 512, 1024} tail shapes: warm those, then ANY mix of
+    row counts must lower nothing new."""
+    _patch_predict_geometry(monkeypatch)
+    bst, X = reg_model
+    g = bst._gbdt
+    rng = np.random.default_rng(6)
+    Xbig = rng.normal(size=(2600, X.shape[1]))
+    for n in (64, 128, 256, 512, 1024):
+        g._device_predict_raw(Xbig[:n], 0, 10)
+    base = _lowerings()
+    for n in (1, 17, 63, 90, 200, 333, 400, 999, 1023, 1500, 2047, 2600):
+        g._device_predict_raw(Xbig[:n], 0, 10)
+    assert _lowerings() - base == 0, \
+        "bucketed batch predict lowered a new tail shape"
+
+
+# --------------------------------------------------- capi single-row path
+def test_capi_fastpath_parity_and_zero_lowerings(reg_model, cat_model):
+    from lightgbm_tpu import capi_impl as C
+    for bst, X in (reg_model, cat_model):
+        fid = C.fastpredict_init(C._new_handle(bst), X.shape[1], 1)
+        fp = C._handles[fid]
+        assert fp._served is not None
+        for i in range(6):
+            got = fp.predict_row(X[i])
+            want = np.asarray(bst.predict(X[i:i + 1], raw_score=True),
+                              np.float64).reshape(-1)
+            assert np.array_equal(np.asarray(got, np.float64), want)
+    # steady state: repeated single-row predicts lower nothing
+    bst, X = reg_model
+    fid = C.fastpredict_init(C._new_handle(bst), X.shape[1], 1)
+    fp = C._handles[fid]
+    fp.predict_row(X[0])
+    base = _lowerings()
+    for i in range(50):
+        fp.predict_row(X[i % 40])
+    assert _lowerings() - base == 0
+
+
+def test_capi_fastpath_hatch_parity(reg_model, monkeypatch):
+    from lightgbm_tpu import capi_impl as C
+    bst, X = reg_model
+    monkeypatch.setenv("LGBMTPU_NO_SERVE_FASTPATH", "1")
+    fid = C.fastpredict_init(C._new_handle(bst), X.shape[1], 0)
+    fp = C._handles[fid]
+    assert fp._served is None  # hatch: legacy stacked walk
+    for i in range(4):
+        got = fp.predict_row(X[i])
+        want = np.asarray(bst.predict(X[i:i + 1], raw_score=False),
+                          np.float64).reshape(-1)
+        assert np.array_equal(np.asarray(got, np.float64), want)
+
+
+def test_capi_fastpath_refresh_after_update(synthetic_regression):
+    from lightgbm_tpu import capi_impl as C
+    X, y = synthetic_regression
+    bst = lgb.train({"objective": "regression", "num_iterations": 3,
+                     "num_leaves": 10, "verbosity": -1},
+                    lgb.Dataset(X, label=y))
+    fid = C.fastpredict_init(C._new_handle(bst), X.shape[1], 1)
+    fp = C._handles[fid]
+    assert np.array_equal(fp.predict_row(X[0]),
+                          bst.predict(X[:1], raw_score=True))
+    bst.update()  # grow a tree in place -> snapshot must refresh
+    got = fp.predict_row(X[0])
+    assert np.array_equal(got, bst.predict(X[:1], raw_score=True))
+
+
+# ------------------------------------------------------------- telemetry
+def test_per_request_jsonl_telemetry(reg_model, tmp_path):
+    bst, X = reg_model
+    path = tmp_path / "serve.jsonl"
+    srv = PredictionServer({"serving_buckets": [8, 64],
+                            "serving_telemetry_output": str(path)})
+    srv.publish("m", booster=bst, warmup=False)
+    srv.predict("m", X[:5])
+    srv.predict("m", X[:40], raw_score=False)
+    srv.close()
+    recs = [json.loads(line) for line in path.read_text().splitlines()]
+    assert len(recs) == 2
+    assert recs[0]["model"] == "m" and recs[0]["version"] == 1
+    assert recs[0]["rows"] == 5 and recs[0]["buckets"] == [8]
+    assert recs[0]["pad_rows"] == 3
+    assert recs[1]["rows"] == 40 and recs[1]["buckets"] == [64]
+    assert recs[1]["raw_score"] is False
+    assert all(r["latency_s"] > 0 for r in recs)
+
+
+# ------------------------------------------------------ bench integration
+def test_bench_serve_and_compare_gate(tmp_path):
+    sys.path.insert(0, "tools")
+    try:
+        import bench_compare
+        import bench_serve
+    finally:
+        sys.path.pop(0)
+    old = tmp_path / "old.json"
+    new = tmp_path / "new.json"
+    rc = bench_serve.main(["--requests", "24", "--trees", "4",
+                           "--leaves", "8", "--features", "4",
+                           "--buckets", "1,8", "--out", str(old),
+                           "--format", "json"])
+    assert rc == 0  # steady_lowerings == 0 is part of the exit contract
+    payload = json.loads(old.read_text())
+    assert payload["kind"] == "serve"
+    assert payload["steady_lowerings"] == 0
+    for row in payload["buckets"].values():
+        assert row["p99_ms"] >= row["p50_ms"] > 0
+        assert row["rows_per_s"] > 0 and row["compile_s"] >= 0
+    # same capture -> no regression
+    new.write_text(old.read_text())
+    assert bench_compare.main([str(old), str(new)]) == 0
+    # inflate new p99s -> regression gate fires (exit 1)
+    worse = json.loads(old.read_text())
+    worse["overall"]["p99_ms"] *= 10
+    for row in worse["buckets"].values():
+        row["p99_ms"] *= 10
+    new.write_text(json.dumps(worse))
+    assert bench_compare.main([str(old), str(new),
+                               "--threshold", "0.5"]) == 1
+    # serve vs training-bench captures are not comparable (exit 2)
+    bad = json.loads(old.read_text())
+    bad.pop("kind")
+    new.write_text(json.dumps(bad))
+    assert bench_compare.main([str(old), str(new)]) == 2

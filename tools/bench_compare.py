@@ -15,6 +15,12 @@ normalized against the fixed reference-CPU anchor so two captures of
 different rounds stay comparable) and ``speed_mode_bins63.vs_baseline``
 when both captures carry it.
 
+Round-8 serving tier: also accepts ``kind="serve"`` payloads from
+tools/bench_serve.py.  Serve captures gate on request LATENCY, not
+throughput-vs-anchor: the compared series are per-bucket (and overall)
+``p99_ms``, LOWER is better, and a rise beyond --threshold is the
+regression.  Both sides must be serve captures of the same metric.
+
 Exit codes (tools/_report.py convention):
   0 — comparable, no regression beyond --threshold,
   1 — at least one regression beyond --threshold,
@@ -50,6 +56,12 @@ def load_payload(path: str) -> Dict[str, Any]:
     payload = obj.get("parsed", obj)
     if not isinstance(payload, dict) or "metric" not in payload:
         raise ValueError("%s: no bench payload (missing 'metric')" % path)
+    if payload.get("kind") == "serve":
+        # serving captures gate on p99 latency, not vs_baseline
+        if not _serve_series(payload):
+            raise ValueError("%s: serve payload carries no positive "
+                             "p99_ms series" % path)
+        return payload
     if payload.get("quality") == "noisy":
         raise ValueError("%s: capture was refused as noisy "
                          "(rejected_value=%s) — not comparable evidence"
@@ -72,12 +84,69 @@ def _series(payload: Dict[str, Any]) -> List[Tuple[str, float]]:
     return rows
 
 
+def _serve_series(payload: Dict[str, Any]) -> List[Tuple[str, float]]:
+    """(name, p99_ms) rows of a kind="serve" payload: overall first,
+    then one per bucket.  LOWER is better."""
+    rows: List[Tuple[str, float]] = []
+    ov = payload.get("overall")
+    if isinstance(ov, dict) and isinstance(ov.get("p99_ms"),
+                                           (int, float)) \
+            and ov["p99_ms"] > 0:
+        rows.append(("overall", float(ov["p99_ms"])))
+    buckets = payload.get("buckets")
+    if isinstance(buckets, dict):
+        for b in sorted(buckets, key=lambda s: int(s)):
+            r = buckets[b]
+            if isinstance(r, dict) and isinstance(r.get("p99_ms"),
+                                                  (int, float)) \
+                    and r["p99_ms"] > 0:
+                rows.append(("bucket%s" % b, float(r["p99_ms"])))
+    return rows
+
+
+def _compare_serve(old: Dict[str, Any], new: Dict[str, Any],
+                   threshold: float) -> Dict[str, Any]:
+    old_rows = dict(_serve_series(old))
+    rows = []
+    for name, new_p99 in _serve_series(new):
+        if name not in old_rows:
+            continue
+        old_p99 = old_rows[name]
+        # latency: HIGHER is the regression direction
+        change = new_p99 / old_p99 - 1.0
+        rows.append({
+            "series": name,
+            "old_p99_ms": old_p99,
+            "new_p99_ms": new_p99,
+            "change_pct": round(100.0 * change, 2),
+            "regression": bool(change > threshold),
+        })
+    if not rows:
+        raise ValueError("serve captures share no p99 series "
+                         "(different bucket ladders?)")
+    return {
+        "tool": "bench_compare",
+        "kind": "serve",
+        "metric": new.get("metric"),
+        "threshold_pct": round(100.0 * threshold, 2),
+        "old_platform": old.get("platform"),
+        "new_platform": new.get("platform"),
+        "rows": rows,
+        "regressions": [r["series"] for r in rows if r["regression"]],
+    }
+
+
 def compare(old: Dict[str, Any], new: Dict[str, Any],
             threshold: float) -> Dict[str, Any]:
     if old.get("metric") != new.get("metric"):
         raise ValueError(
             "metric mismatch: %r vs %r — different bench configurations "
             "are not comparable" % (old.get("metric"), new.get("metric")))
+    if old.get("kind") == "serve" or new.get("kind") == "serve":
+        if old.get("kind") != new.get("kind"):
+            raise ValueError("cannot compare a serve capture against a "
+                             "training bench capture")
+        return _compare_serve(old, new, threshold)
     old_rows = dict(_series(old))
     rows = []
     for name, new_vb in _series(new):
@@ -110,9 +179,14 @@ def _render_text(payload: Dict[str, Any]) -> str:
              % (payload["metric"], payload["threshold_pct"])]
     for r in payload["rows"]:
         flag = "REGRESSION" if r["regression"] else "ok"
-        lines.append("  %-18s %8.4f -> %8.4f  (%+.2f%%)  %s"
-                     % (r["series"], r["old_vs_baseline"],
-                        r["new_vs_baseline"], r["change_pct"], flag))
+        if "old_p99_ms" in r:
+            lines.append("  %-18s %8.3f ms -> %8.3f ms  (%+.2f%%)  %s"
+                         % (r["series"], r["old_p99_ms"],
+                            r["new_p99_ms"], r["change_pct"], flag))
+        else:
+            lines.append("  %-18s %8.4f -> %8.4f  (%+.2f%%)  %s"
+                         % (r["series"], r["old_vs_baseline"],
+                            r["new_vs_baseline"], r["change_pct"], flag))
     if not payload["rows"]:
         lines.append("  (no shared series)")
     if payload["old_platform"] != payload["new_platform"]:

@@ -1,0 +1,219 @@
+#!/usr/bin/env python3
+"""Serving-tier latency harness: p50/p95/p99 + rows/s per bucket.
+
+Drives ``lightgbm_tpu.serving.PredictionServer`` with a mixed-shape
+request stream (sizes spread across every bucket of the ladder) and
+reports, per bucket:
+
+  * ``p50_ms`` / ``p95_ms`` / ``p99_ms`` request latency,
+  * ``rows_per_s`` steady-state throughput,
+  * ``compile_s`` — the cold warmup compile cost the bucket paid ONCE
+    at publish (the cost a live request never sees),
+  * ``run_s`` / ``requests`` — total warm time and request count.
+
+It also captures ``steady_lowerings``: the ``xla_program_lowerings``
+delta over the whole timed stream, which the serving contract says must
+be ZERO (every request re-enters an already-compiled bucket program).
+
+The JSON payload is tagged ``kind="serve"`` and feeds
+tools/bench_compare.py, which gates on per-bucket p99 (lower is
+better) with the usual 0/1/2 exit convention.
+
+Usage:
+  python tools/bench_serve.py --requests 200 --trees 20 \
+      --buckets 1,8,64,512 --out /tmp/SERVE_new.json --format json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _report  # noqa: E402
+
+import numpy as np  # noqa: E402
+
+
+def _pcts(lat_s: List[float]) -> Dict[str, float]:
+    a = np.asarray(lat_s, np.float64) * 1e3
+    return {"p50_ms": float(np.percentile(a, 50)),
+            "p95_ms": float(np.percentile(a, 95)),
+            "p99_ms": float(np.percentile(a, 99))}
+
+
+def _request_sizes(buckets: List[int], requests: int,
+                   rng: np.random.Generator) -> List[int]:
+    """A request stream that exercises every bucket: sizes drawn
+    uniformly from each bucket's (prev_bucket, bucket] range,
+    interleaved so no bucket is measured only cold-cache."""
+    ranges = []
+    lo = 1
+    for b in buckets:
+        ranges.append((lo, b))
+        lo = b + 1
+    sizes = []
+    for i in range(requests):
+        lo_i, hi_i = ranges[i % len(ranges)]
+        sizes.append(int(rng.integers(lo_i, hi_i + 1)))
+    rng.shuffle(sizes)
+    return sizes
+
+
+def run(requests: int, features: int, trees: int, leaves: int,
+        buckets: List[int], seed: int, raw_score: bool) -> Dict[str, Any]:
+    import jax
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.obs import compile_events
+    from lightgbm_tpu.obs.metrics import global_metrics
+    from lightgbm_tpu.serving import PredictionServer
+
+    compile_events.install()
+
+    def lowerings() -> int:
+        return int(global_metrics.counter("xla_program_lowerings"))
+
+    rng = np.random.default_rng(seed)
+    n_train = max(4000, 4 * leaves)
+    Xt = rng.normal(size=(n_train, features))
+    y = np.sum(Xt[:, : max(1, features // 2)], axis=1) \
+        + rng.normal(scale=0.1, size=n_train)
+    booster = lgb.train(
+        {"objective": "regression", "num_iterations": trees,
+         "num_leaves": leaves, "min_data_in_leaf": 5, "verbosity": -1},
+        lgb.Dataset(Xt, label=y))
+
+    server = PredictionServer({"serving_buckets": buckets})
+    t0 = time.perf_counter()
+    server.publish("bench", booster=booster, warmup=True)
+    publish_s = time.perf_counter() - t0
+    compile_s = server.entry_compile_s()
+
+    sizes = _request_sizes(buckets, requests, rng)
+    max_n = max(sizes)
+    X = rng.normal(size=(max_n, features))
+
+    # one extra pass over every bucket so the timed stream is pure
+    # steady state, then assert zero lowerings across the whole stream
+    for b in buckets:
+        server.predict("bench", X[:b], raw_score=raw_score)
+    base_lowerings = lowerings()
+
+    per_bucket_lat: Dict[int, List[float]] = {b: [] for b in buckets}
+    per_bucket_rows: Dict[int, int] = {b: 0 for b in buckets}
+    all_lat: List[float] = []
+    t_stream0 = time.perf_counter()
+    for n in sizes:
+        t1 = time.perf_counter()
+        server.predict("bench", X[:n], raw_score=raw_score)
+        dt = time.perf_counter() - t1
+        b = server.ladder.bucket_for(n)
+        per_bucket_lat[b].append(dt)
+        per_bucket_rows[b] += n
+        all_lat.append(dt)
+    stream_s = time.perf_counter() - t_stream0
+    steady = lowerings() - base_lowerings
+
+    bucket_rows: Dict[str, Any] = {}
+    for b in buckets:
+        lat = per_bucket_lat[b]
+        if not lat:
+            continue
+        run_s = float(sum(lat))
+        row = _pcts(lat)
+        row.update({
+            "requests": len(lat),
+            "rows": per_bucket_rows[b],
+            "rows_per_s": per_bucket_rows[b] / run_s if run_s > 0 else 0.0,
+            "run_s": run_s,
+            "compile_s": float(compile_s.get(b, 0.0)),
+        })
+        bucket_rows[str(b)] = row
+    overall = _pcts(all_lat)
+    overall.update({"requests": len(all_lat),
+                    "rows": int(sum(per_bucket_rows.values())),
+                    "rows_per_s": sum(per_bucket_rows.values()) / stream_s
+                    if stream_s > 0 else 0.0,
+                    "run_s": stream_s})
+    return {
+        "tool": "bench_serve",
+        "kind": "serve",
+        "metric": "serve_latency_f%d_t%d_l%d" % (features, trees, leaves),
+        "platform": jax.default_backend(),
+        "requests": requests,
+        "raw_score": raw_score,
+        "buckets": bucket_rows,
+        "overall": overall,
+        "publish_s": publish_s,
+        "compile_s_total": float(sum(compile_s.values())),
+        "steady_lowerings": int(steady),
+        "counters": server.stats()["counters"],
+    }
+
+
+def _render_text(payload: Dict[str, Any]) -> str:
+    lines = ["bench_serve: %s on %s (%d requests)"
+             % (payload["metric"], payload["platform"],
+                payload["requests"])]
+    lines.append("  %-8s %6s %9s %9s %9s %12s %9s"
+                 % ("bucket", "reqs", "p50_ms", "p95_ms", "p99_ms",
+                    "rows_per_s", "compile_s"))
+    for b in sorted(payload["buckets"], key=int):
+        r = payload["buckets"][b]
+        lines.append("  %-8s %6d %9.3f %9.3f %9.3f %12.0f %9.3f"
+                     % (b, r["requests"], r["p50_ms"], r["p95_ms"],
+                        r["p99_ms"], r["rows_per_s"], r["compile_s"]))
+    o = payload["overall"]
+    lines.append("  %-8s %6d %9.3f %9.3f %9.3f %12.0f"
+                 % ("overall", o["requests"], o["p50_ms"], o["p95_ms"],
+                    o["p99_ms"], o["rows_per_s"]))
+    lines.append("  steady-state lowerings: %d (contract: 0)"
+                 % payload["steady_lowerings"])
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Serving-tier latency capture (p50/p95/p99 per "
+                    "bucket); JSON feeds tools/bench_compare.py.")
+    ap.add_argument("--requests", type=int, default=200)
+    ap.add_argument("--features", type=int, default=8)
+    ap.add_argument("--trees", type=int, default=20)
+    ap.add_argument("--leaves", type=int, default=31)
+    ap.add_argument("--buckets", default="1,8,64,512",
+                    help="comma-separated serving bucket ladder")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--converted", action="store_true",
+                    help="serve converted scores instead of raw margins")
+    ap.add_argument("--out", default="",
+                    help="also write the JSON payload to this path")
+    _report.add_format_arg(ap)
+    args = ap.parse_args(argv)
+    try:
+        buckets = sorted({int(b) for b in args.buckets.split(",") if b})
+        if not buckets or any(b <= 0 for b in buckets):
+            raise ValueError("--buckets needs positive row counts")
+        payload = run(args.requests, args.features, args.trees,
+                      args.leaves, buckets, args.seed,
+                      raw_score=not args.converted)
+    except ValueError as e:
+        print("bench_serve: error: %s" % e, file=sys.stderr)
+        return _report.EXIT_ERROR
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(payload, f, indent=2, sort_keys=True)
+    _report.emit(payload, args.format, _render_text)
+    # a nonzero steady-state lowering count is an actionable finding:
+    # the zero-recompile contract is broken
+    return _report.EXIT_FINDINGS if payload["steady_lowerings"] > 0 \
+        else _report.EXIT_OK
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

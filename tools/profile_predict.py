@@ -2,6 +2,11 @@
 aggregation, same parsing as profile_bench).
 
 Usage: PCAT=1 PROWS=1000000 PTREES=100 python tools/profile_predict.py
+
+PSERVE=1 profiles the serving tier instead: requests of mixed sizes
+stream through a warmed PredictionServer (bucket ladder from PBUCKETS,
+default "64,4096,65536"), so the trace shows the bucket-padded
+leaf-index programs rather than the raw batch predictor.
 """
 import glob
 import gzip
@@ -17,6 +22,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 CAT = bool(int(os.environ.get("PCAT", "1")))
 N = int(os.environ.get("PROWS", "1000000"))
 TREES = int(os.environ.get("PTREES", "100"))
+SERVE = bool(int(os.environ.get("PSERVE", "0")))
+BUCKETS = sorted({int(b) for b in
+                  os.environ.get("PBUCKETS", "64,4096,65536").split(",")})
 
 import jax
 import lightgbm_tpu as lgb
@@ -40,12 +48,25 @@ gb = bst._gbdt
 X = np.concatenate([rng.normal(size=(N, 24)),
                     rng.integers(0, 32, size=(N, 4)).astype(float)],
                    axis=1) if CAT else rng.normal(size=(N, 28))
-gb.predict_raw(X)          # warm
+if SERVE:
+    from lightgbm_tpu.serving import PredictionServer
+    srv = PredictionServer({"serving_buckets": BUCKETS})
+    srv.publish("prof", booster=bst, warmup=True)   # warm = all buckets
+    # mixed request sizes, one per bucket range, repeated
+    sizes = [max(1, b - b // 3) for b in BUCKETS if b <= N] * 4
+
+    def profiled():
+        for n in sizes:
+            srv.predict("prof", X[:n])
+else:
+    def profiled():
+        gb.predict_raw(X)
+    profiled()             # warm
 
 tdir = "/tmp/jaxprof_pred"
 os.system(f"rm -rf {tdir}")
 with jax.profiler.trace(tdir):
-    gb.predict_raw(X)
+    profiled()
 
 files = glob.glob(f"{tdir}/**/*.trace.json.gz", recursive=True)
 with gzip.open(files[0], "rt") as fh:
