@@ -18,6 +18,7 @@ from typing import List, Optional
 from . import contracts  # noqa: F401 — registers CFG2xx/OBS3xx rules
 from . import grwrules   # noqa: F401 — registers GRW4xx rules
 from . import jaxrules   # noqa: F401 — registers TPU1xx rules
+from . import rbsrules   # noqa: F401 — registers RBS5xx rules
 from .core import (LintRunner, SEVERITY_ERROR, SEVERITY_WARNING,
                    registered_rules)
 from .reporters import (EXIT_ERROR, exit_code, render_json, render_text)

@@ -1,0 +1,124 @@
+"""Robustness rules (RBS5xx).
+
+PR 9's elastic-recovery work added several wait-and-retry loops to the
+codebase (heartbeat monitoring, cluster relaunch, startup backoff), and
+each one had to answer the same review question: *what bounds this
+loop?*  An unbounded retry — ``while True: poll(); time.sleep(...)`` —
+is the classic distributed-systems hang: the caller's failure detector
+never fires because the process is "making progress" (sleeping), and the
+job burns its deadline invisibly.  RBS501 freezes the review rule:
+
+  every ``while`` loop that sleeps between attempts must carry visible
+  evidence of a bound — an attempt counter, a deadline/timeout compare,
+  or a clock comparison — in its test or body.
+
+Heuristic by design (this is a linter, not a prover): a loop whose test
+is a comparison, or whose test/body compares something named like a
+bound (``attempt``/``retries``/``deadline``/``timeout``/``remaining``/
+``budget``/``limit``) or reads a clock (``time()``/``monotonic()``/
+``perf_counter()``) inside a comparison, counts as bounded.  ``for``
+loops are bounded by construction and never flagged.  A loop that is
+genuinely bounded through some other mechanism takes a justified
+suppression-file entry (tools/tpulint_suppressions.txt) — making the
+reviewer read the justification is the point.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from .core import (FileContext, Rule, SEVERITY_ERROR, Violation,
+                   register_rule)
+
+#: call names that put the loop to sleep between attempts.  Matched
+#: against the final attribute/name segment, so ``time.sleep``,
+#: ``_time.sleep`` and a bare ``sleep`` all count; backoff-helper names
+#: (``exponential_backoff(...)``, ``retry_wait(...)``) count too.
+_SLEEP_TOKENS = ("backoff", "retry_wait")
+
+#: identifier fragments that signal a bound when they appear inside a
+#: comparison in the loop's test or body
+_BOUND_TOKENS = ("attempt", "retries", "tries", "deadline", "timeout",
+                 "remaining", "budget", "limit", "max_")
+
+#: clock reads — a comparison against one of these is a wall-clock bound
+_CLOCK_CALLS = ("time", "monotonic", "perf_counter")
+
+
+def _call_name(node: ast.Call) -> str:
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return ""
+
+
+def _is_sleep_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    low = _call_name(node).lower()
+    return low == "sleep" or any(t in low for t in _SLEEP_TOKENS)
+
+
+def _compare_is_bound(cmp: ast.Compare) -> bool:
+    """Does this comparison mention a bound-ish name or a clock read?"""
+    for sub in ast.walk(cmp):
+        ident = ""
+        if isinstance(sub, ast.Name):
+            ident = sub.id
+        elif isinstance(sub, ast.Attribute):
+            ident = sub.attr
+        elif isinstance(sub, ast.Call):
+            ident = _call_name(sub)
+            if ident.lower() in _CLOCK_CALLS:
+                return True
+        low = ident.lower()
+        if low and any(t in low for t in _BOUND_TOKENS):
+            return True
+    return False
+
+
+@register_rule
+class UnboundedRetrySleep(Rule):
+    id = "RBS501"
+    name = "unbounded-retry-sleep"
+    severity = SEVERITY_ERROR
+    description = ("while-loop sleeps between attempts with no visible "
+                   "bound (attempt counter, deadline/timeout compare, or "
+                   "clock comparison) — unbounded retries hang jobs "
+                   "invisibly")
+
+    def _bounded(self, loop: ast.While) -> bool:
+        # a comparison as (part of) the loop test IS the bound:
+        # ``while attempts < n`` / ``while time.time() < deadline`` —
+        # and even ``while x < 5`` shows the author thought about exit
+        for sub in ast.walk(loop.test):
+            if isinstance(sub, ast.Compare):
+                return True
+        # otherwise look for a bound-flavored comparison in the body
+        # (``if now > deadline: break`` / ``if attempt >= retries:``)
+        for stmt in loop.body:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Compare) and _compare_is_bound(sub):
+                    return True
+        return False
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.While):
+                continue
+            sleeps = [sub for stmt in node.body for sub in ast.walk(stmt)
+                      if _is_sleep_call(sub)]
+            if not sleeps:
+                continue
+            if self._bounded(node):
+                continue
+            first = min(sleeps, key=lambda c: c.lineno)
+            yield self.violation(
+                ctx, node.lineno, node.col_offset,
+                f"while-loop sleeps between attempts (sleep at line "
+                f"{first.lineno}) with no visible attempt/deadline "
+                "bound — cap the retries or compare against a "
+                "deadline, or add a justified suppression")

@@ -239,13 +239,18 @@ class GBDT:
               "feature_parallel": "feature",
               "gspmd": "data_gspmd"}.get(str(config.tree_learner),
                                          str(config.tree_learner))
-        n_dev = jax.device_count()
+        # active_devices(), not jax.devices(): after an elastic eviction
+        # (robustness/elastic.py) the survivor window restricts every
+        # fresh mesh — a resumed booster re-pads and re-shards its rows
+        # over the reduced set through this one site
+        from ..parallel.mesh import active_devices
+        n_dev = len(active_devices())
         if tl in ("data", "voting", "feature", "data_gspmd") and n_dev > 1:
             from jax.sharding import Mesh
             from ..parallel.feature_parallel import FEATURE_AXIS
             from ..parallel.mesh import DATA_AXIS
             axis = FEATURE_AXIS if tl == "feature" else DATA_AXIS
-            self.mesh = Mesh(np.array(jax.devices()), (axis,))
+            self.mesh = Mesh(np.array(active_devices()), (axis,))
             self.parallel_mode = tl
             if tl == "feature":
                 if self.bundle is not None:

@@ -9,7 +9,8 @@ python-package/lightgbm/callback.py — ``early_stopping`` :278 with min_delta,
 from __future__ import annotations
 
 import collections
-from typing import Any, Callable, Dict, List
+import os
+from typing import Any, Callable, Dict, List, Optional
 
 from .utils import log
 
@@ -100,7 +101,42 @@ def reset_parameter(**kwargs: Any) -> Callable:
     return _callback
 
 
-def log_telemetry(path: str, period: int = 1) -> Callable:
+def _prune_stale_telemetry(path: str, cut: int) -> int:
+    """Drop telemetry records with ``iteration >= cut`` from ``path``
+    (atomic rewrite).  A killed run emits records for rounds PAST the
+    checkpoint its successor resumes from; without pruning, the resumed
+    run re-emits those indices and the file carries duplicate/overlapping
+    iterations (or, when every checkpoint was lost, a full restart's
+    indices interleaved with the stale tail).  Unparseable lines are kept
+    verbatim — pruning must never eat a record it does not understand.
+    Returns the number of dropped records."""
+    import json
+    try:
+        with open(path) as f:
+            lines = f.readlines()
+    except OSError:
+        return 0
+    kept, dropped = [], 0
+    for ln in lines:
+        try:
+            it = int(json.loads(ln).get("iteration", -1))
+        except (ValueError, TypeError):
+            kept.append(ln)
+            continue
+        if it >= cut:
+            dropped += 1
+        else:
+            kept.append(ln)
+    if dropped:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.writelines(kept)
+        os.replace(tmp, path)
+    return dropped
+
+
+def log_telemetry(path: str, period: int = 1,
+                  resume_from: Optional[int] = None) -> Callable:
     """Append one JSONL telemetry record per boosting iteration to
     ``path`` (the callback behind the ``telemetry_output=<path>`` config
     key; also usable directly in a ``callbacks=[...]`` list).
@@ -121,16 +157,31 @@ def log_telemetry(path: str, period: int = 1) -> Callable:
     Each record carries a ``"run"`` id unique to this callback instance:
     several train() runs appending to ONE file (``cv()`` folds share the
     ``telemetry_output`` path) stay distinguishable even though their
-    iteration indices and per-booster counters each restart at 0."""
+    iteration indices and per-booster counters each restart at 0.
+
+    ``resume_from`` (set by the engine on ``resume="auto"``) is the
+    ABSOLUTE iteration this run restarts at: before its first record is
+    written, existing records at or past that index — emitted by the
+    killed predecessor for rounds the checkpoint rolled back — are
+    pruned, so the file reads as one continuous per-iteration history
+    with no duplicate or overlapping indices."""
     import json
     import time as _time
 
     state: Dict[str, Any] = {"t_last": None, "fused_seen": 0,
-                             "run": next(_TELEMETRY_RUN_SEQ)}
+                             "run": next(_TELEMETRY_RUN_SEQ),
+                             "pruned": resume_from is None}
 
     def _callback(env: CallbackEnv) -> None:
         if period > 0 and (env.iteration + 1) % period != 0:
             return
+        if not state["pruned"]:
+            state["pruned"] = True
+            n = _prune_stale_telemetry(path, int(resume_from))
+            if n:
+                log.info(f"telemetry_output: pruned {n} stale record(s) "
+                         f"at iteration >= {resume_from} left by the "
+                         "interrupted predecessor run")
         from .obs import memory as obs_memory, trace as obs_trace
         now = _time.time()
         dt = None if state["t_last"] is None else now - state["t_last"]

@@ -174,6 +174,9 @@ _PARAMS: List[Tuple[str, Any, Tuple[str, ...], Tuple[Tuple[str, float], ...]]] =
     ("checkpoint_keep", 3, (), ((">", 0),)),                      # newest checkpoints retained (older ones pruned)
     ("nan_policy", "none", (), ()),                               # per-round finite guard on grad/hess/scores: none|raise|skip_round|halt_and_keep_best
     ("cluster_timeout_s", 3600.0, ("cluster_timeout",), ((">", 0.0),)),  # parallel.cluster.launch worker deadline
+    ("heartbeat_interval_s", 5.0, (), ((">", 0.0),)),             # elastic liveness: seconds between per-round worker heartbeat markers (robustness/elastic.py; same file substrate as the startup-barrier ready markers)
+    ("heartbeat_timeout_s", 30.0, (), ((">", 0.0),)),             # elastic liveness: a worker silent past this is DEAD (evicted); staleness between heartbeat_interval_s and this marks it SLOW (bounded wait + warn + elastic_slow_worker_rounds counter)
+    ("elastic", "off", (), ()),                                   # worker-loss policy: on|off. off (default) = a post-barrier worker death fail-fasts the whole job (pre-PR-9 behavior); on = evict the silent worker, rebuild the mesh over the survivor set, re-shard rows, resume from the newest checkpoint (robustness/elastic.py, docs/ROBUSTNESS.md "Elastic recovery")
     ("use_quantized_grad", False, (), ()),
     ("num_grad_quant_bins", 4, (), ()),
     ("quant_train_renew_leaf", False, (), ()),
@@ -263,6 +266,7 @@ _PARAMS: List[Tuple[str, Any, Tuple[str, ...], Tuple[Tuple[str, float], ...]]] =
     ("serving_buckets", [1, 8, 64, 512, 4096], (), ()),  # serving-tier row-count bucket ladder (lightgbm_tpu/serving/): requests are padded up to the smallest bucket >= n (oversize requests chunk by the largest), so every request re-enters an already-compiled program and XLA never lowers at steady state; sorted/deduped, all entries > 0
     ("predict_bucketing", "on", (), ()),          # batch Booster.predict shape-thrash fix: on|off (boosting/gbdt.py _device_predict_raw pads block tails up to a geometric ladder of tail-quantum multiples instead of the next exact multiple, bounding compiled program count at log2(block/quantum)+1 across ANY mix of row counts; bit-identical — padded rows are sliced off and the path-count matmuls are per-row exact; counters predict_bucketed_calls/predict_bucket_pad_rows)
     ("serving_telemetry_output", "", (), ()),     # serving per-request JSONL path (serving/server.py PredictionServer: one record per predict() with model/version, rows, buckets hit, pad rows, latency_s; "" disables)
+    ("serving_max_inflight", 64, (), ((">", 0),)),  # serving-tier admission control: bound on concurrently served predict() requests (serving/server.py); a request arriving with the bound already in flight is rejected FAST (ServerOverloaded + serve_rejected_requests counter) instead of queueing unboundedly
 ]
 
 # Reference-LightGBM parameters this port ACCEPTS but never reads: they
@@ -475,6 +479,15 @@ class Config:
         if self.predict_bucketing not in ("on", "off"):
             log.fatal(f"unknown predict_bucketing={self.predict_bucketing!r} "
                       "(expected on/off)")
+        self.elastic = str(self.elastic or "off").strip().lower()
+        if self.elastic not in ("on", "off"):
+            log.fatal(f"unknown elastic={self.elastic!r} (expected on/off)")
+        if float(self.heartbeat_timeout_s) < float(self.heartbeat_interval_s):
+            log.fatal(
+                f"heartbeat_timeout_s={self.heartbeat_timeout_s} must be >= "
+                f"heartbeat_interval_s={self.heartbeat_interval_s} (a worker "
+                "cannot be declared dead faster than it is expected to "
+                "publish)")
         if not self.serving_buckets or \
                 any(int(b) <= 0 for b in self.serving_buckets):
             log.fatal(f"serving_buckets must be a non-empty list of positive "

@@ -108,7 +108,17 @@ def train(params: Dict[str, Any], train_set: Dataset,
         # before round 1, not as a mid-training crash.
         if check_output_path(str(cfg.telemetry_output),
                              key="telemetry_output"):
-            callbacks.append(log_telemetry(str(cfg.telemetry_output)))
+            # resume="auto" threads the ABSOLUTE restart round into the
+            # callback so stale records from the interrupted
+            # predecessor (rounds past the checkpoint) are pruned
+            # instead of left to overlap the re-trained indices; a
+            # from-scratch resume (no valid checkpoint) prunes from 0
+            resume_from = None
+            if resume is not None:
+                resume_from = resume_state.iteration \
+                    if resume_state is not None else 0
+            callbacks.append(log_telemetry(str(cfg.telemetry_output),
+                                           resume_from=resume_from))
     mgr = None
     if ckpt_dir:
         # periodic atomic checkpoints (robustness/checkpoint.py).  Same

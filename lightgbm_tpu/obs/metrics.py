@@ -60,6 +60,14 @@ COUNTERS: Dict[str, str] = {
     "checkpoint_resumes": "trainings resumed from a checkpoint",
     "checkpoints_skipped_invalid":
         "corrupt checkpoints skipped during resume scan",
+    "elastic_slow_worker_rounds":
+        "rounds a lagging-but-alive worker kept the monitor in bounded wait",
+    "elastic_evictions":
+        "workers declared dead and evicted by the heartbeat monitor",
+    "elastic_reshapes":
+        "mesh rebuilds over a survivor set after an eviction",
+    "elastic_resumes":
+        "post-reshape trainings resumed from the newest checkpoint",
     "serve_requests": "serving-tier predict() requests served",
     "serve_rows": "real (unpadded) rows served by the serving tier",
     "serve_bucket_hits":
@@ -74,6 +82,10 @@ COUNTERS: Dict[str, str] = {
         "serving-scope compile-cache hits (ops/compile_cache.py)",
     "serve_compile_misses":
         "serving-scope compile-cache misses (ops/compile_cache.py)",
+    "serve_rejected_requests":
+        "serving requests rejected by the in-flight admission bound",
+    "serve_deadline_exceeded":
+        "serving requests rejected because their deadline_ms had passed",
     "predict_bucketed_calls":
         "predict_raw device blocks padded to the geometric bucket ladder",
     "predict_bucket_pad_rows":

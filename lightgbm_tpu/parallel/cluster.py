@@ -39,6 +39,7 @@ from typing import Any, Dict, Optional, Sequence
 
 import numpy as np
 
+from ..obs.metrics import count_event
 from ..utils import log
 
 
@@ -124,7 +125,8 @@ def launch(params: Dict[str, Any], data, label=None, *,
            devices_per_worker: int = 0,
            timeout_s: Optional[float] = None,
            startup_retries: int = 2,
-           startup_window_s: Optional[float] = None):
+           startup_window_s: Optional[float] = None,
+           faults: Sequence = ()):
     """Run data-parallel training across ``n_workers`` fresh processes and
     return the trained Booster (identical on every rank; rank 0's copy).
 
@@ -145,19 +147,39 @@ def launch(params: Dict[str, Any], data, label=None, *,
     ``startup_window_s=None`` gives the barrier min(STARTUP_WINDOW_S,
     timeout_s) seconds — raise it for pods with slow multi-host
     initialization.
+
+    Elastic mode (``elastic=on`` in params, docs/ROBUSTNESS.md): workers
+    publish per-round heartbeats (robustness/elastic.py markers) and
+    rank 0 drops an atomic model snapshot every ``checkpoint_interval``
+    rounds.  A post-barrier worker death — or a rank whose heartbeats go
+    silent past ``heartbeat_timeout_s`` while its peers advance — is
+    EVICTED instead of fatal: the parent re-shards the rows over the
+    survivors, bumps the coordination epoch and relaunches them from the
+    newest snapshot.  A lagging-but-alive rank only draws a warning and
+    the ``elastic_slow_worker_rounds`` counter.  With ``elastic=off``
+    (default) the pre-elastic fail-fast behavior is preserved verbatim.
+    ``faults`` takes :class:`~..robustness.faults.FaultSpec` entries
+    applied (first epoch only) by the matching worker — the scripted
+    fault drill's injection channel.
     """
     import time as _time
 
     from ..basic import Booster
 
     timeout_s = _resolve_timeout(params, timeout_s)
-    worker_map = _machines_to_worker_map(machines, n_workers,
-                                         local_listen_port)
-    coordinator = worker_map[0]
+    elastic_on = str(params.get("elastic", "off") or "off") \
+        .strip().lower() == "on"
+    hb_cfg = {
+        "interval": float(params.get("heartbeat_interval_s", 5.0) or 5.0),
+        "timeout": float(params.get("heartbeat_timeout_s", 30.0) or 30.0),
+    }
+    snapshot_every = int(params.get("checkpoint_interval", 5) or 5)
+    host_entries = None
+    if machines:
+        host_entries = [e.strip() for e in machines.split(",")
+                        if e.strip()]
     with tempfile.TemporaryDirectory(prefix="lgbtpu_cluster_") as tmp:
-        specs = []        # per-rank spec file paths (worker argv)
-        spec_dicts = []   # the same specs, kept in memory for the parent
-        shards = None
+        X = y = None
         if isinstance(data, (str, os.PathLike)):
             if label is not None or weight is not None or group is not None:
                 log.fatal("launch(data=<path>): label/weight/group must "
@@ -166,37 +188,6 @@ def launch(params: Dict[str, Any], data, label=None, *,
         else:
             X = np.asarray(data, np.float64)
             y = None if label is None else np.asarray(label)
-            shards = _shard_rows(X.shape[0], n_workers, group)
-        for rank in range(n_workers):
-            spec: Dict[str, Any] = {
-                "rank": rank, "num_machines": n_workers,
-                "machines": ",".join(worker_map),
-                "coordinator": coordinator,
-                "params": {k: v for k, v in params.items()},
-                "num_boost_round": int(num_boost_round),
-                "devices_per_worker": int(devices_per_worker),
-                "out_path": os.path.join(tmp, "model.txt"),
-                "ready_path": os.path.join(tmp, f"ready_{rank}"),
-            }
-            if shards is None:
-                spec["data_path"] = str(data)
-            else:
-                idx, grp_sizes = shards[rank]
-                shard_path = os.path.join(tmp, f"shard_{rank}.npz")
-                payload = {"X": X[idx]}
-                if y is not None:
-                    payload["y"] = y[idx]
-                if weight is not None:
-                    payload["w"] = np.asarray(weight)[idx]
-                if grp_sizes is not None:
-                    payload["g"] = grp_sizes
-                np.savez(shard_path, **payload)
-                spec["shard_path"] = shard_path
-            spec_path = os.path.join(tmp, f"spec_{rank}.json")
-            with open(spec_path, "w") as fh:
-                json.dump(spec, fh)
-            specs.append(spec_path)
-            spec_dicts.append(spec)
 
         if startup_window_s is None:
             startup_window_s = STARTUP_WINDOW_S
@@ -204,38 +195,150 @@ def launch(params: Dict[str, Any], data, label=None, *,
         # pre-barrier hang would hit the main deadline first and be
         # classified 'runtime' (non-retryable)
         startup_window_s = min(float(startup_window_s), timeout_s)
-        last_fail = None
-        for attempt in range(startup_retries + 1):
-            outcome, detail = _run_attempt(specs, spec_dicts, tmp,
-                                           timeout_s, startup_window_s,
-                                           attempt)
-            if outcome == "ok":
-                with open(spec_dicts[0]["out_path"]) as fh:
-                    return Booster(model_str=fh.read())
-            if outcome == "runtime":
-                # post-barrier death: retrying would redo a long train
-                # on the same inputs that just failed — fail fast with
-                # the named worker's diagnosis
-                log.fatal(f"cluster launch failed: {detail}")
-            last_fail = detail
-            if attempt < startup_retries:
-                delay = 2.0 * (attempt + 1)
-                log.warning(
-                    "cluster startup attempt %d/%d failed (%s); retrying "
-                    "in %.0f s" % (attempt + 1, startup_retries + 1,
-                                   detail.splitlines()[0], delay))
-                _time.sleep(delay)
-        log.fatal(f"cluster launch failed after {startup_retries + 1} "
-                  f"startup attempts: {last_fail}")
+
+        snapshot_path = os.path.join(tmp, "elastic_snapshot.txt") \
+            if elastic_on else None
+        n_live = n_workers
+        epoch = 0
+        while True:
+            worker_map = _machines_to_worker_map(
+                ",".join(host_entries) if host_entries else None,
+                n_live, local_listen_port)
+            specs, spec_dicts = _write_specs(
+                tmp, params, data, X, y, weight, group, n_live, epoch,
+                worker_map, num_boost_round, devices_per_worker,
+                snapshot_path, snapshot_every,
+                faults if epoch == 0 else ())
+            last_fail = None
+            runtime_fail = None
+            for attempt in range(startup_retries + 1):
+                outcome, detail, bad = _run_attempt(
+                    specs, spec_dicts, tmp, timeout_s, startup_window_s,
+                    attempt, hb=dict(hb_cfg, dir=tmp, epoch=epoch)
+                    if elastic_on else None)
+                if outcome == "ok":
+                    with open(spec_dicts[0]["out_path"]) as fh:
+                        return Booster(model_str=fh.read())
+                if outcome == "runtime":
+                    if not elastic_on or not bad or len(bad) >= n_live:
+                        # post-barrier death: retrying would redo a long
+                        # train on the same inputs that just failed —
+                        # fail fast with the named worker's diagnosis
+                        # (today's behavior, kept verbatim for
+                        # elastic=off)
+                        log.fatal(f"cluster launch failed: {detail}")
+                    runtime_fail = (detail, bad)
+                    break
+                last_fail = detail
+                if attempt < startup_retries:
+                    delay = 2.0 * (attempt + 1)
+                    log.warning(
+                        "cluster startup attempt %d/%d failed (%s); "
+                        "retrying in %.0f s"
+                        % (attempt + 1, startup_retries + 1,
+                           detail.splitlines()[0], delay))
+                    _time.sleep(delay)
+            else:
+                log.fatal(f"cluster launch failed after "
+                          f"{startup_retries + 1} startup attempts: "
+                          f"{last_fail}")
+            # ---- elastic recovery: evict, reshape, relaunch survivors
+            detail, bad = runtime_fail
+            count_event("elastic_evictions", len(bad))
+            count_event("elastic_reshapes", 1)
+            count_event("elastic_resumes", 1)
+            has_snap = snapshot_path and os.path.exists(snapshot_path)
+            log.warning(
+                "elastic: evicting worker(s) %s (%s); reshaping %d->%d "
+                "workers and relaunching from %s"
+                % (sorted(bad), detail.splitlines()[0], n_live,
+                   n_live - len(bad),
+                   "the newest model snapshot" if has_snap
+                   else "scratch (no snapshot yet)"))
+            if host_entries:
+                host_entries = [h for r, h in enumerate(host_entries)
+                                if r not in set(bad)]
+            n_live -= len(bad)
+            epoch += 1
+
+
+def _write_specs(tmp: str, params: Dict[str, Any], data, X, y, weight,
+                 group, n_workers: int, epoch: int, worker_map: list,
+                 num_boost_round: int, devices_per_worker: int,
+                 snapshot_path: Optional[str], snapshot_every: int,
+                 faults: Sequence):
+    """Materialise one epoch's per-rank shards + job specs.  Each epoch
+    re-stripes the rows over the CURRENT worker count — the reshape half
+    of elastic recovery — and threads the heartbeat/snapshot/fault
+    plumbing into the worker specs."""
+    coordinator = worker_map[0]
+    shards = None
+    if X is not None:
+        shards = _shard_rows(X.shape[0], n_workers, group)
+    fault_by_rank = {}
+    for f in faults:
+        fault_by_rank[int(f.rank)] = {
+            "kind": f.kind, "at_round": int(f.at_round),
+            "seconds": float(getattr(f, "seconds", 0.0))}
+    specs = []        # per-rank spec file paths (worker argv)
+    spec_dicts = []   # the same specs, kept in memory for the parent
+    for rank in range(n_workers):
+        spec: Dict[str, Any] = {
+            "rank": rank, "num_machines": n_workers,
+            "machines": ",".join(worker_map),
+            "coordinator": coordinator,
+            "params": {k: v for k, v in params.items()},
+            "num_boost_round": int(num_boost_round),
+            "devices_per_worker": int(devices_per_worker),
+            "out_path": os.path.join(tmp, "model.txt"),
+            "ready_path": os.path.join(tmp, f"ready_e{epoch}_{rank}"),
+        }
+        if snapshot_path:
+            spec["hb_dir"] = tmp
+            spec["epoch"] = int(epoch)
+            spec["snapshot_path"] = snapshot_path
+            spec["snapshot_interval"] = int(snapshot_every)
+            if rank in fault_by_rank:
+                spec["fault"] = fault_by_rank[rank]
+        if shards is None:
+            spec["data_path"] = str(data)
+        else:
+            idx, grp_sizes = shards[rank]
+            shard_path = os.path.join(tmp, f"shard_e{epoch}_{rank}.npz")
+            payload = {"X": X[idx]}
+            if y is not None:
+                payload["y"] = y[idx]
+            if weight is not None:
+                payload["w"] = np.asarray(weight)[idx]
+            if grp_sizes is not None:
+                payload["g"] = grp_sizes
+            np.savez(shard_path, **payload)
+            spec["shard_path"] = shard_path
+        spec_path = os.path.join(tmp, f"spec_e{epoch}_{rank}.json")
+        with open(spec_path, "w") as fh:
+            json.dump(spec, fh)
+        specs.append(spec_path)
+        spec_dicts.append(spec)
+    return specs, spec_dicts
 
 
 def _run_attempt(spec_paths, specs, tmp: str, timeout_s: float,
-                 startup_window_s: float, attempt: int):
+                 startup_window_s: float, attempt: int, hb=None):
     """One spawn-and-wait pass over all ranks (``specs`` are the parsed
-    dicts behind ``spec_paths``).  Returns ``("ok", None)``,
-    ``("startup", msg)`` (failure before every rank cleared the barrier —
-    retryable) or ``("runtime", msg)`` (failure after — fatal).  The
-    message names the failing worker(s) and carries their log tails."""
+    dicts behind ``spec_paths``).  Returns ``("ok", None, [])``,
+    ``("startup", msg, ranks)`` (failure before every rank cleared the
+    barrier — retryable) or ``("runtime", msg, ranks)`` (failure after —
+    fatal unless elastic recovery claims the named ranks).  The message
+    names the failing worker(s) and carries their log tails.
+
+    ``hb`` (elastic mode) is ``{dir, epoch, interval, timeout}``: the
+    parent then also reads the workers' per-round heartbeat markers.  A
+    rank whose marker is stale past ``interval`` while a peer has
+    advanced draws a slow-worker warning (once per lagging round); stale
+    past ``timeout`` it is declared dead — killed and reported as a
+    runtime failure naming it — since a worker can hang without exiting
+    (the drop-heartbeats drill).  A GLOBAL stall trips no eviction: if
+    no peer advances either, only the overall deadline applies."""
     import time as _time
 
     ready_paths = [s["ready_path"] for s in specs]
@@ -281,7 +384,7 @@ def _run_attempt(spec_paths, specs, tmp: str, timeout_s: float,
                     env=env, stdout=lf, stderr=subprocess.STDOUT))
             except OSError as e:
                 return "startup", (f"spawning worker {rank} failed: "
-                                   f"{type(e).__name__}: {e}")
+                                   f"{type(e).__name__}: {e}"), [rank]
 
         # poll ALL workers against one shared deadline: the first crash
         # kills the survivors immediately (they would otherwise hang in
@@ -294,6 +397,9 @@ def _run_attempt(spec_paths, specs, tmp: str, timeout_s: float,
         barrier_passed = False
         fail = None
         startup_failure = False
+        bad_ranks: list = []
+        hb_t0 = None          # wall clock at barrier pass (grace ref for
+        hb_warned = set()     # ranks that never published)
         live = dict(enumerate(procs))
         while live and fail is None:
             if not barrier_passed:
@@ -308,17 +414,60 @@ def _run_attempt(spec_paths, specs, tmp: str, timeout_s: float,
                     logs[rank].flush()
                     ready = os.path.exists(ready_paths[rank])
                     startup_failure = not ready
+                    bad_ranks = [rank]
                     fail = ("worker %d exited %d %s the startup barrier; "
                             "log tail:\n%s"
                             % (rank, rc,
                                "after" if ready else "before",
                                _log_tail(logs[rank].name)))
+            if hb is not None and barrier_passed and live and fail is None:
+                # elastic liveness: read the workers' per-round heartbeat
+                # markers.  Eviction needs BOTH a stale marker and an
+                # advanced peer — a global stall (everyone stuck in one
+                # collective) is left to the overall deadline.
+                from ..robustness.elastic import (heartbeat_path,
+                                                  read_heartbeat)
+                if hb_t0 is None:
+                    hb_t0 = _time.time()
+                now_w = _time.time()
+                rounds, stamps = {}, {}
+                for r in live:
+                    d = read_heartbeat(
+                        heartbeat_path(hb["dir"], hb["epoch"], r))
+                    if d is not None:
+                        rounds[r] = int(d.get("round", -1))
+                        stamps[r] = float(d.get("unix_time", hb_t0))
+                lead = max(rounds.values()) if rounds else -1
+                for r in sorted(live):
+                    rd = rounds.get(r, -1)
+                    if rd >= lead or lead < 0:
+                        continue
+                    age = now_w - stamps.get(r, hb_t0)
+                    if age >= hb["timeout"]:
+                        logs[r].flush()
+                        startup_failure = False
+                        bad_ranks = [r]
+                        fail = ("worker %d heartbeat silent for %.1fs "
+                                "(timeout %.1fs) at round %d while peers "
+                                "reached round %d; log tail:\n%s"
+                                % (r, age, hb["timeout"], rd, lead,
+                                   _log_tail(logs[r].name)))
+                        break
+                    if age >= hb["interval"] and (r, lead) not in hb_warned:
+                        hb_warned.add((r, lead))
+                        count_event("elastic_slow_worker_rounds", 1)
+                        log.warning(
+                            "elastic: worker %d slow (last heartbeat "
+                            "%.1fs ago at round %d; peers at round %d, "
+                            "timeout %.1fs) — waiting, not evicting"
+                            % (r, age, rd, lead, hb["timeout"]))
             if live and fail is None:
                 now = _time.monotonic()
                 if not barrier_passed and now > barrier_deadline:
                     stuck = sorted(r for r in live
                                    if not os.path.exists(ready_paths[r]))
                     startup_failure = True
+                    bad_ranks = stuck
                     for r in stuck[:2]:
                         logs[r].flush()
                     tails = "\n".join(
@@ -329,6 +478,7 @@ def _run_attempt(spec_paths, specs, tmp: str, timeout_s: float,
                             % (stuck, startup_window_s, tails))
                 elif now > deadline:
                     stuck = sorted(live)
+                    bad_ranks = stuck
                     for r in stuck[:2]:
                         logs[r].flush()
                     tails = "\n".join(
@@ -353,9 +503,9 @@ def _run_attempt(spec_paths, specs, tmp: str, timeout_s: float,
             # leaking a FileNotFoundError from the model read
             return "runtime", ("all workers exited 0 but rank 0 never "
                                "wrote the model; rank 0 log tail:\n"
-                               + _log_tail(logs[0].name))
-        return "ok", None
-    return ("startup" if startup_failure else "runtime"), fail
+                               + _log_tail(logs[0].name)), []
+        return "ok", None, []
+    return ("startup" if startup_failure else "runtime"), fail, bad_ranks
 
 
 def _worker_main(spec_path: str) -> None:
@@ -388,6 +538,41 @@ def _worker_main(spec_path: str) -> None:
             kwargs["group"] = z["g"]
     else:
         data = spec["data_path"]
+    hb_dir = spec.get("hb_dir")
+    if hb_dir:
+        # elastic plumbing: per-round heartbeat publishing (+ scripted
+        # fault execution for drills), rank-0 model snapshots, and
+        # continuation from the parent's newest snapshot after a reshape
+        import time as _time
+
+        from ..robustness.elastic import publish_heartbeat
+        rank, epoch = int(spec["rank"]), int(spec.get("epoch", 0))
+        fault = spec.get("fault")
+
+        def on_round(it: int) -> None:
+            if fault:
+                kind = fault.get("kind")
+                at = int(fault.get("at_round", 0))
+                if kind == "kill" and it >= at:
+                    # abrupt death — no cleanup, no heartbeat, exactly a
+                    # preempted host (parent sees the nonzero exit)
+                    os._exit(17)
+                if kind == "drop_heartbeats" and it >= at:
+                    return
+                if kind == "stall" and it == at:
+                    _time.sleep(float(fault.get("seconds", 0.0)))
+            publish_heartbeat(hb_dir, epoch, rank, it)
+
+        kwargs["on_round"] = on_round
+        snap = spec.get("snapshot_path")
+        if snap:
+            if rank == 0:
+                kwargs["snapshot_path"] = snap
+                kwargs["snapshot_interval"] = int(
+                    spec.get("snapshot_interval", 0))
+            if epoch > 0 and os.path.exists(snap):
+                with open(snap) as fh:
+                    kwargs["init_model_text"] = fh.read()
     booster = launcher.train_multihost(
         spec["params"], data, num_boost_round=spec["num_boost_round"],
         **kwargs)

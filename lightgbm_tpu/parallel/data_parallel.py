@@ -39,7 +39,7 @@ from .compat import shard_map
 from ..learner.grower import TreeArrays, grow_tree
 from ..ops.compile_cache import get_or_build, mesh_signature, sig
 from ..ops.split import SplitHyper
-from .mesh import DATA_AXIS
+from .mesh import DATA_AXIS, make_mesh
 from ..ops.table import take_small_table
 
 
@@ -65,7 +65,7 @@ def _cached_shard_map(entry: str, mesh: Mesh, local, in_specs, out_specs,
     return get_or_build(key, build, metrics=metrics)
 
 
-def grow_tree_sharded(mesh: Mesh, bins: jax.Array, grad: jax.Array,
+def grow_tree_sharded(mesh: Optional[Mesh], bins: jax.Array, grad: jax.Array,
                       hess: jax.Array, row_mask: Optional[jax.Array],
                       num_bins: jax.Array, nan_bin: jax.Array,
                       is_cat: jax.Array, feature_mask: Optional[jax.Array],
@@ -82,8 +82,13 @@ def grow_tree_sharded(mesh: Mesh, bins: jax.Array, grad: jax.Array,
     ``parallel_mode``: "data" (full-histogram psum) or "voting" (PV-Tree
     top-k vote, voting_parallel_tree_learner.cpp — psums only the voted
     features' histogram slices).  Returns (replicated TreeArrays,
-    row-sharded leaf_of_row).
+    row-sharded leaf_of_row).  ``mesh=None`` resolves to the ACTIVE
+    device mesh (parallel/mesh.py) — after an elastic eviction that is
+    the survivor window, so recovery needs no mesh plumbing here.
     """
+    if mesh is None:
+        mesh = make_mesh()
+
     def rep(x):
         return None if x is None else jax.tree.map(lambda _: P(), x)
 
@@ -129,7 +134,8 @@ def grow_tree_sharded(mesh: Mesh, bins: jax.Array, grad: jax.Array,
               forced, hist_scale)
 
 
-def train_step_sharded(mesh: Mesh, bins: jax.Array, scores: jax.Array,
+def train_step_sharded(mesh: Optional[Mesh], bins: jax.Array,
+                       scores: jax.Array,
                        label: jax.Array, row_mask: Optional[jax.Array],
                        num_bins: jax.Array, nan_bin: jax.Array,
                        is_cat: jax.Array, hp: SplitHyper, *,
@@ -140,7 +146,11 @@ def train_step_sharded(mesh: Mesh, bins: jax.Array, scores: jax.Array,
     """One FULL boosting step (gradients -> tree -> score update), rows
     sharded — the unit the driver dry-runs multi-chip.  Gradient math is
     elementwise (trivially shards); the tree grower psums histograms/stats.
+    ``mesh=None`` resolves to the active (possibly survivor-restricted)
+    mesh.
     """
+    if mesh is None:
+        mesh = make_mesh()
     in_specs = (P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
                 P(DATA_AXIS) if row_mask is not None else None,
                 P(), P(), P())
@@ -172,7 +182,8 @@ def train_step_sharded(mesh: Mesh, bins: jax.Array, scores: jax.Array,
     return fn(bins, scores, label, row_mask, num_bins, nan_bin, is_cat)
 
 
-def train_fused_sharded(mesh: Mesh, bins: jax.Array, scores: jax.Array,
+def train_fused_sharded(mesh: Optional[Mesh], bins: jax.Array,
+                        scores: jax.Array,
                         label: jax.Array, num_bins: jax.Array,
                         nan_bin: jax.Array, is_cat: jax.Array,
                         hp: SplitHyper, *, num_rounds: int,
@@ -194,11 +205,14 @@ def train_fused_sharded(mesh: Mesh, bins: jax.Array, scores: jax.Array,
     discretization with globally psum-maxed scales and DETERMINISTIC
     rounding (stochastic rounding is off here — a per-shard stochastic
     draw from the same fold would correlate noise across shards; fold
-    the shard index into the key before enabling it)."""
+    the shard index into the key before enabling it).  ``mesh=None``
+    resolves to the active (possibly survivor-restricted) mesh."""
     from jax import lax
     from ..learner.batch_grower import grow_tree_batched
     if quantize:
         from ..ops.quantize import discretize_gradients_levels
+    if mesh is None:
+        mesh = make_mesh()
 
     in_specs = (P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(), P(), P())
     out_specs = (
@@ -241,7 +255,8 @@ def train_fused_sharded(mesh: Mesh, bins: jax.Array, scores: jax.Array,
     return fn(bins, scores, label, num_bins, nan_bin, is_cat)
 
 
-def grow_tree_batched_sharded(mesh: Mesh, bins: jax.Array, grad: jax.Array,
+def grow_tree_batched_sharded(mesh: Optional[Mesh], bins: jax.Array,
+                              grad: jax.Array,
                               hess: jax.Array,
                               row_mask: Optional[jax.Array],
                               num_bins: jax.Array, nan_bin: jax.Array,
@@ -258,8 +273,11 @@ def grow_tree_batched_sharded(mesh: Mesh, bins: jax.Array, grad: jax.Array,
                               ) -> Tuple[TreeArrays, jax.Array]:
     """Batched-round grower (learner/batch_grower.py) under the data mesh:
     K splits per psum-ed widened histogram pass ("data"), or per LOCAL
-    pass with PV-Tree voted slice reduction ("voting")."""
+    pass with PV-Tree voted slice reduction ("voting").  ``mesh=None``
+    resolves to the active (possibly survivor-restricted) mesh."""
     from ..learner.batch_grower import grow_tree_batched
+    if mesh is None:
+        mesh = make_mesh()
 
     def rep(x):
         return None if x is None else jax.tree.map(lambda _: P(), x)

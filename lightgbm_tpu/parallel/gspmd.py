@@ -38,7 +38,7 @@ from ..learner.grower import TreeArrays
 from ..ops.compile_cache import get_or_build, mesh_signature, sig
 from ..ops.split import SplitHyper
 from ..ops.table import take_small_table
-from .mesh import DATA_AXIS
+from .mesh import DATA_AXIS, make_mesh
 
 
 def row_sharded(mesh: Mesh, x):
@@ -67,7 +67,8 @@ def replicated(mesh: Mesh, x):
     return jax.device_put(x, NamedSharding(mesh, P()))
 
 
-def train_fused_gspmd(mesh: Mesh, bins: jax.Array, scores: jax.Array,
+def train_fused_gspmd(mesh: Optional[Mesh], bins: jax.Array,
+                      scores: jax.Array,
                       label: jax.Array, num_bins: jax.Array,
                       nan_bin: jax.Array, is_cat: jax.Array,
                       hp: SplitHyper, *, num_rounds: int,
@@ -92,6 +93,10 @@ def train_fused_gspmd(mesh: Mesh, bins: jax.Array, scores: jax.Array,
     from ..learner.batch_grower import grow_tree_batched
     if quantize:
         from ..ops.quantize import discretize_gradients_levels
+    # mesh=None resolves to the active (possibly survivor-restricted)
+    # mesh, matching the explicit shard_map entries' elastic contract
+    if mesh is None:
+        mesh = make_mesh()
     # uneven rows: skip the constraints entirely (with_sharding_constraint
     # would silently relax them to replicated anyway) — see row_sharded
     even = int(bins.shape[0]) % int(mesh.devices.size) == 0

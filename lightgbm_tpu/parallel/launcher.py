@@ -116,7 +116,11 @@ def train_multihost(params: Dict[str, Any], data,
                     label: Optional[np.ndarray] = None,
                     weight: Optional[np.ndarray] = None,
                     group: Optional[np.ndarray] = None,
-                    num_boost_round: int = 100):
+                    num_boost_round: int = 100,
+                    on_round=None,
+                    init_model_text: Optional[str] = None,
+                    snapshot_path: Optional[str] = None,
+                    snapshot_interval: int = 0):
     """Data-parallel training from per-process row shards.
 
     Every process passes ITS OWN rows; returns an identical Booster on all
@@ -124,6 +128,17 @@ def train_multihost(params: Dict[str, Any], data,
     so shards bin identically (reference dataset_loader.cpp rank-sharded
     loading + bin-mapper allgather).  Uses the same grow_tree under
     shard_map as single-host ``tree_learner=data``.
+
+    Elastic hooks (parallel/cluster.py + robustness/elastic.py):
+    ``on_round(it)`` fires after each completed round — the cluster
+    worker publishes its liveness heartbeat there.  ``init_model_text``
+    continues a prior model: its trees are kept, the remaining rounds of
+    the TOTAL ``num_boost_round`` are trained, and the score cache is
+    rebuilt by predicting the prior model on this rank's rows.
+    ``snapshot_path`` + ``snapshot_interval`` make rank 0 publish an
+    atomic model-text snapshot every that-many rounds — the recovery
+    point an elastic relaunch resumes from (the multihost loop has no
+    engine CheckpointManager; the snapshot is this tier's checkpoint).
     """
     import jax
     import jax.numpy as jnp
@@ -275,9 +290,60 @@ def train_multihost(params: Dict[str, Any], data,
         parts = sorted(scores.addressable_shards, key=lambda s: s.index)
         return np.concatenate([np.asarray(s.data) for s in parts])[:n_local]
 
-    scores = jax.device_put(jnp.zeros(g_shape, jnp.float32), sharding)
+    def _assemble(tree_list):
+        booster = Booster.__new__(Booster)
+        booster.params = params
+        booster.best_iteration = -1
+        booster.best_score = {}
+        booster.train_set = None
+        booster.pandas_categorical = None
+        booster._gbdt = None
+        feature_infos = []
+        for j in range(local.num_total_features):
+            m = local.mappers[j]
+            feature_infos.append(
+                "none" if m.is_trivial()
+                else f"[{m.min_val:g}:{m.max_val:g}]")
+        booster._loaded = {
+            "trees": list(tree_list), "num_class": 1,
+            "num_tree_per_iteration": 1,
+            "max_feature_idx": data.shape[1] - 1,
+            "objective": obj_name if obj_name != "binary"
+            else "binary sigmoid:1",
+            "feature_names": local.feature_names,
+            "feature_infos": feature_infos,
+        }
+        return booster
+
+    def _snapshot(tree_list):
+        # atomic temp + rename, same idiom as the checkpoint manifest: a
+        # relaunching parent never reads a half-written snapshot
+        text = _assemble(tree_list).model_to_string()
+        tmp_path = snapshot_path + ".tmp"
+        with open(tmp_path, "w") as fh:
+            fh.write(text)
+        os.replace(tmp_path, snapshot_path)
+
     trees = []
-    for it in range(num_boost_round):
+    start_round = 0
+    if init_model_text:
+        # elastic continuation: keep the prior trees, rebuild this rank's
+        # score cache from the prior model's raw prediction on its rows
+        prior = Booster(model_str=init_model_text)
+        trees = list(prior._loaded["trees"])
+        start_round = len(trees)
+        if start_round >= num_boost_round:
+            log.warning(f"train_multihost: init model already has "
+                        f"{start_round} trees (target {num_boost_round}); "
+                        "nothing to train")
+        raw = np.asarray(prior.predict(data, raw_score=True),
+                         np.float32).reshape(-1)
+        sc_l = np.pad(raw, (0, pad))
+        scores = jax.make_array_from_process_local_data(sharding, sc_l,
+                                                        g_shape)
+    else:
+        scores = jax.device_put(jnp.zeros(g_shape, jnp.float32), sharding)
+    for it in range(start_round, num_boost_round):
         if obj_name in fast_objs:
             arrays, scores = step(scores, bins_g, label_g, mask_g)
         else:
@@ -295,25 +361,11 @@ def train_multihost(params: Dict[str, Any], data,
             lambda x: np.asarray(jax.device_get(x)), arrays), local)
         t.apply_shrinkage(lr)
         trees.append(t)
+        if snapshot_path and snapshot_interval > 0 \
+                and jax.process_index() == 0 \
+                and (it + 1) % snapshot_interval == 0:
+            _snapshot(trees)
+        if on_round is not None:
+            on_round(it)
 
-    booster = Booster.__new__(Booster)
-    booster.params = params
-    booster.best_iteration = -1
-    booster.best_score = {}
-    booster.train_set = None
-    booster.pandas_categorical = None
-    booster._gbdt = None
-    feature_infos = []
-    for j in range(local.num_total_features):
-        m = local.mappers[j]
-        feature_infos.append(
-            "none" if m.is_trivial()
-            else f"[{m.min_val:g}:{m.max_val:g}]")
-    booster._loaded = {
-        "trees": trees, "num_class": 1, "num_tree_per_iteration": 1,
-        "max_feature_idx": data.shape[1] - 1,
-        "objective": obj_name if obj_name != "binary" else "binary sigmoid:1",
-        "feature_names": local.feature_names,
-        "feature_infos": feature_infos,
-    }
-    return booster
+    return _assemble(trees)

@@ -14,7 +14,8 @@ construction (reference ``Network::Init`` equivalent, config.h:1086-1110
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+import contextlib
+from typing import Iterator, Optional, Sequence
 
 import jax
 import numpy as np
@@ -23,12 +24,64 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 DATA_AXIS = "data"
 FEATURE_AXIS = "feature"
 
+#: elastic-recovery device restriction (robustness/elastic.py): ``None``
+#: means every visible device participates; an int N caps mesh
+#: construction to the first N devices.  After an eviction the recovery
+#: layer sets this to the survivor count, so a resumed booster rebuilds
+#: its mesh — and re-pads/re-shards its rows — over the reduced set
+#: without any plumbing through the booster constructors.
+_DEVICE_LIMIT: Optional[int] = None
+
+
+def set_device_limit(n: Optional[int]) -> None:
+    """Restrict mesh construction to the first ``n`` visible devices
+    (``None`` lifts the restriction).  Affects FUTURE mesh builds only;
+    live boosters keep the mesh they were constructed with."""
+    global _DEVICE_LIMIT
+    if n is not None:
+        n = int(n)
+        total = len(jax.devices())
+        if not 1 <= n <= total:
+            raise ValueError(
+                f"device limit {n} out of range [1, {total}]")
+    _DEVICE_LIMIT = n
+
+
+def device_limit() -> Optional[int]:
+    return _DEVICE_LIMIT
+
+
+def active_devices() -> list:
+    """The devices mesh construction may use: ``jax.devices()``, cut to
+    the elastic survivor window when one is set."""
+    devs = jax.devices()
+    if _DEVICE_LIMIT is not None:
+        devs = devs[:_DEVICE_LIMIT]
+    return list(devs)
+
+
+def active_device_count() -> int:
+    return len(active_devices())
+
+
+@contextlib.contextmanager
+def device_window(n: Optional[int]) -> Iterator[None]:
+    """Scoped :func:`set_device_limit` — the elastic recovery loop (and
+    the reduced-mesh reference runs in tests/drills) brackets each
+    training epoch with this so a crash cannot leak the restriction."""
+    prev = _DEVICE_LIMIT
+    set_device_limit(n)
+    try:
+        yield
+    finally:
+        set_device_limit(prev)
+
 
 def make_mesh(n_devices: Optional[int] = None,
               axis: str = DATA_AXIS) -> Mesh:
-    """1-D mesh over available devices (rows for data-parallel, features
+    """1-D mesh over active devices (rows for data-parallel, features
     for feature-parallel)."""
-    devs = jax.devices()
+    devs = active_devices()
     if n_devices is not None:
         devs = devs[:n_devices]
     return Mesh(np.array(devs), (axis,))

@@ -12,17 +12,27 @@ Three pillars (docs/ROBUSTNESS.md):
     scores with a ``nan_policy`` config
     (``raise`` | ``skip_round`` | ``halt_and_keep_best``),
   * :mod:`.faults` — the injection harness tests use to kill training
-    mid-run, corrupt/truncate checkpoints and poison gradients, so the
-    recovery paths above stay verifiable instead of theoretical.
+    mid-run, corrupt/truncate checkpoints, poison gradients and script
+    worker faults, so the recovery paths above stay verifiable instead
+    of theoretical,
+  * :mod:`.elastic` — worker liveness (per-round heartbeat markers, a
+    bounded-wait monitor distinguishing slow from dead) and elastic
+    recovery (``elastic=on``: evict the silent worker, reshape the mesh
+    over the survivors, resume from the newest checkpoint — bit-for-bit
+    under the deterministic quantized config).
 
 Everything is off by default: without ``checkpoint_dir`` no file is ever
 written, and ``nan_policy=none`` adds zero per-iteration work (the guard
 is gated before any device sync).
 """
 
-from . import checkpoint, faults, guards
+from . import checkpoint, elastic, faults, guards
 from .checkpoint import CheckpointManager, load_latest_checkpoint
+from .elastic import ElasticSession, HeartbeatMonitor, WorkerEvicted, \
+    run_elastic_training
 from .guards import NumericHalt
 
-__all__ = ["checkpoint", "guards", "faults", "CheckpointManager",
-           "load_latest_checkpoint", "NumericHalt"]
+__all__ = ["checkpoint", "guards", "faults", "elastic",
+           "CheckpointManager", "load_latest_checkpoint", "NumericHalt",
+           "ElasticSession", "HeartbeatMonitor", "WorkerEvicted",
+           "run_elastic_training"]

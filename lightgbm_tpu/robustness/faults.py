@@ -12,7 +12,12 @@ drill) uses against a REAL training run:
     the newest checkpoint on disk, driving the skip-and-fall-back path,
   * :func:`poison_gradients` — a context manager that patches the
     gradient step to emit NaN/inf at one chosen round, driving the
-    ``nan_policy`` guards.
+    ``nan_policy`` guards,
+  * :func:`kill_worker` / :func:`stall_worker` / :func:`drop_heartbeats`
+    — scripted WORKER faults for the elastic-recovery drills
+    (robustness/elastic.py, tools/fault_drill.py): declarative
+    :class:`FaultSpec` records an elastic session (or the cluster
+    launcher) applies to one virtual/real rank at a chosen round.
 
 Only tests and drills import this module; nothing in the training stack
 depends on it.
@@ -21,6 +26,7 @@ depends on it.
 from __future__ import annotations
 
 import contextlib
+import dataclasses
 import os
 from typing import Callable, Iterator, Optional
 
@@ -45,6 +51,54 @@ def kill_training(at_iteration: int) -> Callable:
                 f"injected kill at iteration {env.iteration}")
     _callback.order = 100
     return _callback
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One scripted worker fault.
+
+    ``kind`` is ``"kill"`` (the rank dies: heartbeats stop forever and —
+    on the real cluster — the process exits), ``"stall"`` (the rank is
+    alive but its round-``at_round`` heartbeat lands ``seconds`` late:
+    the monitor must warn and WAIT, not evict) or ``"drop_heartbeats"``
+    (the rank keeps computing but never publishes again — from the
+    monitor's file-level view this is indistinguishable from death, so
+    it IS evicted; the drill that asserts this documents the monitor's
+    observability boundary).
+    """
+    kind: str
+    rank: int
+    at_round: int = 0
+    seconds: float = 0.0
+
+
+def kill_worker(rank: int, at_round: int) -> FaultSpec:
+    """The worker at ``rank`` dies at boosting round ``at_round``
+    (0-based, absolute): no heartbeat for that round or any later one.
+    The elastic monitor must detect it within ``heartbeat_timeout_s``
+    and evict; rounds since the newest checkpoint are lost, exactly like
+    a preemption."""
+    return FaultSpec("kill", int(rank), int(at_round))
+
+
+def stall_worker(rank: int, seconds: float,
+                 at_round: int = 1) -> FaultSpec:
+    """The worker at ``rank`` stays ALIVE but publishes its round
+    ``at_round`` heartbeat ``seconds`` late (a GC pause, a slow host,
+    a congested interconnect).  With ``seconds`` below
+    ``heartbeat_timeout_s`` the monitor must classify it *slow* —
+    bounded wait + warning + ``elastic_slow_worker_rounds`` — and must
+    NOT evict."""
+    return FaultSpec("stall", int(rank), int(at_round), float(seconds))
+
+
+def drop_heartbeats(rank: int, at_round: int = 0) -> FaultSpec:
+    """The worker at ``rank`` silently stops publishing heartbeats from
+    round ``at_round`` on while still computing.  The monitor cannot
+    tell this from death, so the rank is evicted after
+    ``heartbeat_timeout_s`` — the drill asserting this pins down what
+    the liveness layer can and cannot observe."""
+    return FaultSpec("drop_heartbeats", int(rank), int(at_round))
 
 
 def newest_checkpoint_path(directory: str) -> Optional[str]:

@@ -26,6 +26,14 @@ from .predictor import CompiledPredictor
 from .registry import ModelEntry, ModelRegistry
 
 
+class ServerOverloaded(Exception):
+    """Request rejected by admission control — either the in-flight
+    bound (``serving_max_inflight``) was already saturated, or the
+    request's ``deadline_ms`` had passed before any predict work began.
+    The rejection is FAST (no predictor work, no queueing): the caller's
+    load balancer should retry elsewhere or shed."""
+
+
 class PredictionServer:
     def __init__(self, params: Optional[Dict[str, Any]] = None,
                  registry: Optional[ModelRegistry] = None) -> None:
@@ -34,6 +42,9 @@ class PredictionServer:
         self.metrics = MetricsRegistry()
         self.registry = registry if registry is not None \
             else ModelRegistry(metrics=self.metrics)
+        self.max_inflight = int(cfg.serving_max_inflight)
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
         self._tele_path = str(cfg.serving_telemetry_output or "")
         self._tele_lock = threading.Lock()
         self._tele_file = None
@@ -75,14 +86,52 @@ class PredictionServer:
         return dict(getattr(self, "_last_compile_s", {}))
 
     # ------------------------------------------------------------- predict
-    def predict(self, name: str, X, raw_score: bool = True) -> np.ndarray:
+    def predict(self, name: str, X, raw_score: bool = True,
+                deadline_ms: Optional[float] = None) -> np.ndarray:
         """Serve one request against the current live version of
         ``name``.  The entry is resolved once — a concurrent hot-swap
-        cannot change the forest mid-request."""
-        entry = self.registry.get(name)
-        t0 = time.perf_counter()
-        out, stats = entry.predictor.predict_ex(X, raw_score=raw_score)
-        latency_s = time.perf_counter() - t0
+        cannot change the forest mid-request.
+
+        Admission control (docs/SERVING.md): at most
+        ``serving_max_inflight`` requests execute concurrently; one more
+        is rejected with :class:`ServerOverloaded` BEFORE any predictor
+        work, so overload surfaces as a fast bounded failure instead of
+        an unbounded queue.  ``deadline_ms`` is the caller's remaining
+        latency budget: a request admitted after its budget already
+        elapsed is likewise rejected up front (the caller has stopped
+        waiting; finishing the predict would burn device time on an
+        answer nobody reads).  Rejections are counted on
+        ``serve_rejected_requests`` / ``serve_deadline_exceeded``."""
+        t_admit = time.perf_counter()
+        if deadline_ms is not None and float(deadline_ms) <= 0:
+            count_event("serve_deadline_exceeded", 1, self.metrics)
+            count_event("serve_rejected_requests", 1, self.metrics)
+            raise ServerOverloaded(
+                f"request deadline_ms={deadline_ms} already exceeded at "
+                "admission")
+        with self._inflight_lock:
+            if self._inflight >= self.max_inflight:
+                count_event("serve_rejected_requests", 1, self.metrics)
+                raise ServerOverloaded(
+                    f"{self._inflight} requests in flight >= "
+                    f"serving_max_inflight={self.max_inflight}")
+            self._inflight += 1
+        try:
+            entry = self.registry.get(name)
+            t0 = time.perf_counter()
+            if deadline_ms is not None \
+                    and (t0 - t_admit) * 1000.0 >= float(deadline_ms):
+                # budget burned while waiting on admission bookkeeping
+                count_event("serve_deadline_exceeded", 1, self.metrics)
+                count_event("serve_rejected_requests", 1, self.metrics)
+                raise ServerOverloaded(
+                    f"request deadline_ms={deadline_ms} expired before "
+                    "predict start")
+            out, stats = entry.predictor.predict_ex(X, raw_score=raw_score)
+            latency_s = time.perf_counter() - t0
+        finally:
+            with self._inflight_lock:
+                self._inflight -= 1
         count_event("serve_requests", 1, self.metrics)
         count_event("serve_rows", stats.rows, self.metrics)
         if stats.pad_rows:
@@ -91,6 +140,11 @@ class PredictionServer:
             count_event("serve_bucket_hits", stats.warm_chunks, self.metrics)
         self._emit(entry, stats, latency_s, raw_score)
         return out
+
+    def inflight(self) -> int:
+        """Currently admitted (executing) request count."""
+        with self._inflight_lock:
+            return self._inflight
 
     # ----------------------------------------------------------- telemetry
     def _emit(self, entry: ModelEntry, stats, latency_s: float,

@@ -1,0 +1,16 @@
+"""RBS501 bad: polls forever with a sleep and no visible bound.
+
+The only comparison in the body is the success check — nothing names an
+attempt count, deadline, or clock, so a dead server hangs this caller
+until the job scheduler kills it from outside.
+"""
+
+import time
+
+
+def wait_for_ready(client):
+    while True:
+        status = client.poll()
+        if status == "ready":
+            return status
+        time.sleep(1.0)

@@ -1,0 +1,31 @@
+"""RBS501 ok: every sleeping retry loop carries a visible bound."""
+
+import time
+
+
+def wait_with_attempts(client, retries=10):
+    attempt = 0
+    while attempt < retries:          # bound in the loop test
+        if client.poll() == "ready":
+            return True
+        attempt += 1
+        time.sleep(0.5)
+    return False
+
+
+def wait_with_deadline(client, timeout_s=30.0):
+    deadline = time.monotonic() + timeout_s
+    while True:
+        if client.poll() == "ready":
+            return True
+        if time.monotonic() > deadline:   # clock-vs-deadline bound in body
+            return False
+        time.sleep(0.5)
+
+
+def wait_for_range(client):
+    for _ in range(20):               # for-loops are bounded by construction
+        if client.poll() == "ready":
+            return True
+        time.sleep(0.5)
+    return False

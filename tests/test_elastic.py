@@ -1,0 +1,335 @@
+"""Elastic-recovery tests (lightgbm_tpu/robustness/elastic.py).
+
+Two layers, matching docs/ROBUSTNESS.md "Elastic recovery":
+
+  * liveness unit tests — heartbeat marker atomicity/namespacing, the
+    healthy/suspect/dead classifier at controlled clocks, the bounded
+    wait and its eviction verdict, slow-rank counting (once per
+    rank x round);
+  * recovery drills on the virtual mesh — kill at round k across
+    {strict, batched} x {data, data_gspmd}, slow-worker warn-not-evict,
+    heartbeat-drop eviction, corrupt-newest-checkpoint fallback,
+    ``elastic=off`` fail-fast — each asserting the continued run's model
+    text (``model_core``) is bit-for-bit identical to an uninterrupted
+    run at the reduced mesh size AND to the serial learner.
+
+Plus the tier-1 exit-code gate over ``tools/fault_drill.py --quick``.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.parallel.mesh import device_window
+from lightgbm_tpu.robustness.elastic import (DEAD, HEALTHY, SUSPECT,
+                                             HeartbeatMonitor,
+                                             WorkerEvicted, heartbeat_path,
+                                             model_core, publish_heartbeat,
+                                             read_heartbeat,
+                                             run_elastic_training)
+from lightgbm_tpu.robustness.faults import (corrupt_checkpoint,
+                                            drop_heartbeats, kill_worker,
+                                            stall_worker)
+from lightgbm_tpu.utils.log import LightGBMError
+
+pytestmark = pytest.mark.fault
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+BASE = dict(objective="binary", num_leaves=7, learning_rate=0.5,
+            min_data_in_leaf=5, deterministic=True, seed=7,
+            use_quantized_grad=True, stochastic_rounding=False,
+            tree_learner="data", checkpoint_interval=2,
+            heartbeat_interval_s=0.2, heartbeat_timeout_s=1.0,
+            elastic="on", verbosity=-1)
+
+ROUNDS = 8
+WORKERS = 4
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.RandomState(0)
+    X = rng.randint(0, 8, size=(200, 5)).astype(np.float64)
+    y = (X[:, 0] + X[:, 1] > 7).astype(np.float64)
+    return X, y
+
+
+_REF_CACHE = {}
+
+
+def _ref(data, mesh, **over):
+    """Uninterrupted reference model core at a fixed mesh size
+    (serial learner when mesh <= 1), memoized per EFFECTIVE config so
+    scenarios sharing a configuration share one reference training."""
+    X, y = data
+    p = {k: v for k, v in dict(BASE, **over).items()
+         if k not in ("checkpoint_interval", "heartbeat_interval_s",
+                      "heartbeat_timeout_s", "elastic")}
+    p.setdefault("tpu_split_batch", 1)
+    if mesh <= 1:
+        p["tree_learner"] = "serial"   # before the key: serial is serial
+    key = (mesh, tuple(sorted(p.items())))
+    if key in _REF_CACHE:
+        return _REF_CACHE[key]
+    if mesh <= 1:
+        bst = lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=ROUNDS)
+    else:
+        with device_window(mesh):
+            bst = lgb.train(p, lgb.Dataset(X, label=y),
+                            num_boost_round=ROUNDS)
+    core = model_core(bst.model_to_string())
+    _REF_CACHE[key] = core
+    return core
+
+
+# ------------------------------------------------------------------ liveness
+def test_heartbeat_roundtrip_and_epoch_namespace(tmp_path):
+    d = str(tmp_path)
+    p = publish_heartbeat(d, epoch=3, rank=1, round_idx=7, now=123.0)
+    assert p == heartbeat_path(d, 3, 1)
+    hb = read_heartbeat(p)
+    assert hb["rank"] == 1 and hb["round"] == 7 and hb["epoch"] == 3
+    assert hb["unix_time"] == 123.0
+    # the epoch is in the NAME: a marker from epoch 3 is invisible to an
+    # epoch-4 monitor — a zombie's stale heartbeat cannot alias into the
+    # post-reshape incarnation
+    assert read_heartbeat(heartbeat_path(d, 4, 1)) is None
+    assert not os.path.exists(p + ".tmp")   # atomic publish leaves no husk
+
+
+def test_read_heartbeat_torn_file(tmp_path):
+    p = tmp_path / "hb_e0_r0.json"
+    p.write_text('{"rank": 0, "round"')   # torn mid-write
+    assert read_heartbeat(str(p)) is None
+
+
+def test_classify_states(tmp_path):
+    d = str(tmp_path)
+    mon = HeartbeatMonitor(d, [0, 1, 2], interval_s=1.0, timeout_s=5.0)
+    now = mon._t0
+    publish_heartbeat(d, 0, 0, round_idx=4, now=now)        # at round
+    publish_heartbeat(d, 0, 1, round_idx=3, now=now - 2.0)  # lagging
+    # rank 2 never published; its age runs from monitor construction
+    rep = mon.classify(4, now=now + 1.0)
+    assert rep.states[0] == HEALTHY
+    assert rep.states[1] == SUSPECT
+    assert rep.states[2] == SUSPECT        # inside grace, not yet dead
+    rep = mon.classify(4, now=now + 10.0)  # past timeout for both
+    assert rep.states[0] == HEALTHY
+    assert rep.dead == [1, 2]
+    assert not rep.all_healthy
+
+
+def test_classify_ahead_is_healthy(tmp_path):
+    # a rank that raced AHEAD (published round 5 while we expect 4) is
+    # healthy — progress is progress
+    d = str(tmp_path)
+    mon = HeartbeatMonitor(d, [0], interval_s=1.0, timeout_s=5.0)
+    publish_heartbeat(d, 0, 0, round_idx=5, now=mon._t0 - 60.0)
+    assert mon.classify(4, now=mon._t0).states[0] == HEALTHY
+
+
+def test_wait_round_returns_when_all_publish(tmp_path):
+    d = str(tmp_path)
+    mon = HeartbeatMonitor(d, [0, 1], interval_s=0.1, timeout_s=2.0)
+    publish_heartbeat(d, 0, 0, round_idx=1)
+
+    def late_publish(_poll):   # rank 1 lands during the wait
+        publish_heartbeat(d, 0, 1, round_idx=1)
+    rep = mon.wait_round(1, sleep=late_publish)
+    assert rep.all_healthy
+
+
+def test_wait_round_evicts_silent_rank(tmp_path):
+    d = str(tmp_path)
+    mon = HeartbeatMonitor(d, [0, 1], interval_s=0.05, timeout_s=0.3)
+    publish_heartbeat(d, 0, 0, round_idx=2)
+    with pytest.raises(WorkerEvicted) as ei:
+        mon.wait_round(2)
+    assert ei.value.ranks == [1]
+    assert ei.value.round_idx == 2
+
+
+def test_slow_rank_counted_once_per_round(tmp_path):
+    d = str(tmp_path)
+    mon = HeartbeatMonitor(d, [0, 1], interval_s=0.05, timeout_s=10.0)
+    publish_heartbeat(d, 0, 0, round_idx=1)
+    publish_heartbeat(d, 0, 1, round_idx=0)   # one round behind
+    ticks = {"n": 0}
+
+    def slow_then_arrive(_poll):
+        ticks["n"] += 1
+        import time
+        time.sleep(0.06)                      # age rank 1 past interval_s
+        if ticks["n"] >= 3:
+            publish_heartbeat(d, 0, 1, round_idx=1)
+    rep = mon.wait_round(1, sleep=slow_then_arrive)
+    assert rep.all_healthy
+    assert mon.slow_rounds == 1               # once, not once per poll
+
+
+def test_model_core_strips_params_trailer():
+    text = ("tree\nversion=v4\n...\nparameters:\n[seed: 7]\n"
+            "end of parameters\n\npandas_categorical:[]\n")
+    core = model_core(text)
+    assert "parameters:" not in core
+    assert "[seed: 7]" not in core
+    assert core.startswith("tree\n")
+    assert "pandas_categorical" in core
+    assert model_core("no trailer here") == "no trailer here"
+
+
+# ------------------------------------------------------------------- drills
+@pytest.mark.parametrize("learner", ["data", "data_gspmd"])
+@pytest.mark.parametrize("grower", ["strict", "batched"])
+def test_kill_matrix_bit_identity(tmp_path, data, learner, grower):
+    """Kill a worker mid-run; the recovered model must equal the
+    uninterrupted reduced-mesh run AND the serial run, byte for byte."""
+    X, y = data
+    over = dict(tree_learner=learner,
+                tpu_split_batch=4 if grower == "batched" else 1)
+    bst, rep = run_elastic_training(
+        dict(BASE, **over), X, y, num_boost_round=ROUNDS,
+        n_workers=WORKERS, workdir=str(tmp_path),
+        faults=[kill_worker(2, at_round=4)])
+    core = model_core(bst.model_to_string())
+    assert len(rep["evictions"]) == 1
+    assert rep["evictions"][0]["ranks"] == [2]
+    assert rep["final_mesh"] == WORKERS - 1
+    assert rep["resumes"] == 1
+    assert core == _ref(data, WORKERS - 1, **over)
+    assert core == _ref(data, 1, **over)
+
+
+def test_slow_worker_warned_not_evicted(tmp_path, data):
+    X, y = data
+    bst, rep = run_elastic_training(
+        dict(BASE), X, y, num_boost_round=ROUNDS, n_workers=WORKERS,
+        workdir=str(tmp_path),
+        faults=[stall_worker(1, seconds=0.5, at_round=2)])
+    assert rep["slow_rounds"] >= 1
+    assert rep["evictions"] == []
+    assert rep["final_mesh"] == WORKERS
+    # the stalled run IS the undisturbed run, just later
+    assert model_core(bst.model_to_string()) == _ref(data, WORKERS)
+
+
+def test_drop_heartbeats_evicts(tmp_path, data):
+    """A rank that computes but stops publishing is observationally dead
+    — the monitor's contract is about what it can SEE."""
+    X, y = data
+    bst, rep = run_elastic_training(
+        dict(BASE), X, y, num_boost_round=ROUNDS, n_workers=WORKERS,
+        workdir=str(tmp_path), faults=[drop_heartbeats(3, at_round=2)])
+    assert len(rep["evictions"]) == 1
+    assert rep["evictions"][0]["ranks"] == [3]
+    assert model_core(bst.model_to_string()) == _ref(data, WORKERS - 1)
+
+
+def test_corrupt_newest_checkpoint_falls_back(tmp_path, data):
+    """Corrupt the newest checkpoint at the kill round: recovery's
+    ``resume="auto"`` must fall back to the older checkpoint and still
+    land bit-exact (it just replays more rounds)."""
+    X, y = data
+    state = {"done": False}
+
+    def corruptor(env):
+        if env.iteration >= 4 and not state["done"]:
+            state["done"] = True
+            corrupt_checkpoint(str(tmp_path / "ckpt"),
+                               mode="garbage_manifest")
+    corruptor.order = 55   # after checkpoint (40), before liveness (60)
+    bst, rep = run_elastic_training(
+        dict(BASE), X, y, num_boost_round=ROUNDS, n_workers=WORKERS,
+        workdir=str(tmp_path), faults=[kill_worker(2, at_round=4)],
+        callbacks=[corruptor])
+    assert state["done"]
+    assert len(rep["evictions"]) == 1
+    core = model_core(bst.model_to_string())
+    assert core == _ref(data, WORKERS - 1)
+    assert core == _ref(data, 1)
+
+
+def test_elastic_off_fails_fast(tmp_path, data):
+    X, y = data
+    with pytest.raises(LightGBMError, match="elastic=on"):
+        run_elastic_training(
+            dict(BASE, elastic="off"), X, y, num_boost_round=ROUNDS,
+            n_workers=WORKERS, workdir=str(tmp_path),
+            faults=[kill_worker(0, at_round=1)])
+    # detection happened, recovery did not: no second epoch directory
+    assert not (tmp_path / "coord" / "hb_e1_r0.json").exists()
+
+
+def test_elastic_config_validation():
+    from lightgbm_tpu.config import Config
+    with pytest.raises(LightGBMError, match="elastic"):
+        Config({"elastic": "maybe"})
+    with pytest.raises(LightGBMError, match="heartbeat_timeout_s"):
+        Config({"heartbeat_timeout_s": 0.1, "heartbeat_interval_s": 1.0})
+    assert Config({"elastic": "ON "}).elastic == "on"   # normalized
+
+
+# ------------------------------------------------------------- cluster specs
+def test_cluster_write_specs_threads_elastic_plumbing(tmp_path):
+    """Spec building for the real multi-process tier (no spawning): the
+    per-epoch restripe + heartbeat/snapshot/fault threading."""
+    from lightgbm_tpu.parallel.cluster import _write_specs
+    X = np.arange(40, dtype=np.float64).reshape(20, 2)
+    y = np.arange(20, dtype=np.float64)
+    specs = _write_specs(
+        str(tmp_path), {"objective": "regression"}, None, X, y, None, None,
+        n_workers=2, epoch=1, worker_map=["127.0.0.1:9001",
+                                          "127.0.0.1:9002"],
+        num_boost_round=5, devices_per_worker=1,
+        snapshot_path=str(tmp_path / "snap.txt"), snapshot_every=2,
+        faults=[kill_worker(1, at_round=3)])
+    import json
+    spec_paths, spec_dicts = specs
+    assert len(spec_paths) == len(spec_dicts) == 2
+    loaded = []
+    for rank in range(2):
+        sp = os.path.join(str(tmp_path), f"spec_e1_{rank}.json")
+        assert os.path.exists(sp)
+        with open(sp) as f:
+            loaded.append(json.load(f))
+        assert os.path.exists(
+            os.path.join(str(tmp_path), f"shard_e1_{rank}.npz"))
+    for rank, spec in enumerate(loaded):
+        assert spec["rank"] == rank
+        assert spec["epoch"] == 1
+        assert spec["hb_dir"] == str(tmp_path)
+        assert spec["snapshot_interval"] == 2
+        assert spec["ready_path"].endswith(f"ready_e1_{rank}")
+    assert "fault" not in loaded[0]
+    assert loaded[1]["fault"] == {"kind": "kill", "at_round": 3,
+                                  "seconds": 0.0}
+    # the two epoch-1 shards tile the rows exactly once
+    n = sum(np.load(os.path.join(str(tmp_path),
+                                 f"shard_e1_{r}.npz"))["X"].shape[0]
+            for r in range(2))
+    assert n == 20
+
+
+# ------------------------------------------------------------------ CI gate
+def test_fault_drill_quick_gate():
+    """tools/fault_drill.py --quick is the tier-1 recovery gate: exit 0
+    means kill -> detect -> reshape -> resume -> bit-identity verify all
+    held on the virtual mesh."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "fault_drill.py"),
+         "--quick", "--format", "json"],
+        capture_output=True, text=True, timeout=540, cwd=REPO,
+        env=dict(os.environ))
+    assert proc.returncode == 0, \
+        f"fault drill failed:\n{proc.stdout}\n{proc.stderr}"
+    import json
+    payload = json.loads(proc.stdout)
+    assert payload["passed"] is True
+    assert payload["scenarios"][0]["checks"][
+        "bit_identical_reduced_mesh"] is True

@@ -108,6 +108,19 @@ def test_checkpoint_inspect_tool(data, tmp_path):
     assert tool.main([ck]) == 0
     assert tool.main([ck, "--json"]) == 0
     assert tool.main([ck, "--format", "json"]) == 0
+    assert tool.main([ck, "--verify-all"]) == 0
+    # damage an OLDER checkpoint: the default newest-only gate still
+    # passes, but the chain an elastic recovery may fall back through
+    # does not (--verify-all sha256-checks every manifest)
+    from lightgbm_tpu.robustness.checkpoint import (MODEL_NAME,
+                                                    checkpoint_dirs)
+    oldest = checkpoint_dirs(ck)[-1][1]
+    mp = os.path.join(oldest, MODEL_NAME)
+    blob = bytearray(open(mp, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    open(mp, "wb").write(bytes(blob))
+    assert tool.main([ck]) == 0
+    assert tool.main([ck, "--verify-all"]) == 2
     faults.corrupt_checkpoint(ck, "flip_byte")
     assert tool.main([ck, "--verify"]) == 2
 
@@ -369,13 +382,14 @@ def test_cluster_startup_failure_retries(data, tiny_model_text, monkeypatch):
     sleeps = []
 
     def fake_run_attempt(spec_paths, specs, tmp, timeout_s, window_s,
-                         attempt):
+                         attempt, hb=None):
         attempts.append(attempt)
         if len(attempts) < 3:
-            return "startup", "worker 1 exited 1 before the startup barrier"
+            return ("startup",
+                    "worker 1 exited 1 before the startup barrier", [1])
         with open(specs[0]["out_path"], "w") as fh:
             fh.write(tiny_model_text)
-        return "ok", None
+        return "ok", None, []
 
     monkeypatch.setattr(cluster, "_run_attempt", fake_run_attempt)
     monkeypatch.setattr(time, "sleep", lambda s: sleeps.append(s))
@@ -394,10 +408,10 @@ def test_cluster_runtime_failure_fails_fast(data, monkeypatch):
     attempts = []
 
     def fake_run_attempt(spec_paths, specs, tmp, timeout_s, window_s,
-                         attempt):
+                         attempt, hb=None):
         attempts.append(attempt)
         return "runtime", ("worker 1 exited 1 after the startup barrier; "
-                           "log tail:\nboom")
+                           "log tail:\nboom"), [1]
 
     monkeypatch.setattr(cluster, "_run_attempt", fake_run_attempt)
     with pytest.raises(lgb.LightGBMError, match="worker 1"):
@@ -411,10 +425,10 @@ def test_cluster_startup_exhaustion_names_worker(data, monkeypatch):
     X, y, _, _ = data
 
     def fake_run_attempt(spec_paths, specs, tmp, timeout_s, window_s,
-                         attempt):
+                         attempt, hb=None):
         return "startup", ("workers [0, 1] never reached the startup "
                            "barrier within 300 s\n--- worker 0 log tail "
-                           "---\nImportError: nope")
+                           "---\nImportError: nope"), [0, 1]
 
     monkeypatch.setattr(cluster, "_run_attempt", fake_run_attempt)
     monkeypatch.setattr(time, "sleep", lambda s: None)
@@ -423,6 +437,36 @@ def test_cluster_startup_exhaustion_names_worker(data, monkeypatch):
                        startup_retries=1)
     msg = str(ei.value)
     assert "2 startup attempts" in msg and "ImportError: nope" in msg
+
+
+def test_cluster_elastic_evicts_and_relaunches(data, tiny_model_text,
+                                               monkeypatch):
+    """elastic=on turns a post-barrier runtime failure naming dead ranks
+    into an eviction + reduced-worker relaunch on a fresh epoch (no
+    processes spawned here — the attempt layer is faked)."""
+    from lightgbm_tpu.parallel import cluster
+    X, y, _, _ = data
+    calls = []
+
+    def fake_run_attempt(spec_paths, specs, tmp, timeout_s, window_s,
+                         attempt, hb=None):
+        calls.append((len(specs), specs[0].get("epoch"), hb))
+        if len(calls) == 1:
+            return "runtime", "worker 1 heartbeat silent for 9.9s", [1]
+        with open(specs[0]["out_path"], "w") as fh:
+            fh.write(tiny_model_text)
+        return "ok", None, []
+
+    monkeypatch.setattr(cluster, "_run_attempt", fake_run_attempt)
+    with capture_logs() as msgs:
+        bst = cluster.launch(_params(elastic="on", verbose=0), X, y,
+                             num_boost_round=2, n_workers=2,
+                             startup_retries=1)
+    assert bst.num_trees() == 2
+    # attempt 1: both workers, epoch 0; relaunch: the survivor, epoch 1
+    assert [(c[0], c[1]) for c in calls] == [(2, 0), (1, 1)]
+    assert all(c[2] is not None for c in calls)   # hb config threaded
+    assert any("evict" in m for m in msgs)
 
 
 # --------------------------------------------- manager unit behaviors

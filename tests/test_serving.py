@@ -502,3 +502,102 @@ def test_bench_serve_and_compare_gate(tmp_path):
     bad.pop("kind")
     new.write_text(json.dumps(bad))
     assert bench_compare.main([str(old), str(new)]) == 2
+
+
+# ------------------------------------------------------- admission control
+def test_admission_deadline_rejection(reg_model):
+    """A request whose latency budget is already gone is rejected FAST
+    (before any predictor work) and counted."""
+    from lightgbm_tpu.serving.server import ServerOverloaded
+    bst, X = reg_model
+    srv = PredictionServer({"serving_buckets": [8, 64]})
+    srv.publish("m", booster=bst, warmup=False)
+    Xq = np.nan_to_num(X[:8])
+    with pytest.raises(ServerOverloaded):
+        srv.predict("m", Xq, deadline_ms=0)
+    with pytest.raises(ServerOverloaded):
+        srv.predict("m", Xq, deadline_ms=-5.0)
+    counters = srv.stats()["counters"]
+    assert counters["serve_deadline_exceeded"] == 2
+    assert counters["serve_rejected_requests"] == 2
+    assert counters.get("serve_requests", 0) == 0   # nothing admitted
+    # a generous deadline sails through and counts as served
+    out = srv.predict("m", Xq, deadline_ms=60_000.0)
+    assert out.shape[0] == 8
+    assert srv.stats()["counters"]["serve_requests"] == 1
+    # no-deadline requests are unaffected by admission control
+    assert srv.predict("m", Xq).shape[0] == 8
+
+
+def test_admission_inflight_bound(reg_model):
+    """At most serving_max_inflight requests execute concurrently; the
+    next one is shed immediately with ServerOverloaded."""
+    import threading
+    from lightgbm_tpu.serving.server import ServerOverloaded
+    bst, X = reg_model
+    srv = PredictionServer({"serving_buckets": [8, 64],
+                            "serving_max_inflight": 2})
+    assert srv.max_inflight == 2
+    srv.publish("m", booster=bst, warmup=False)
+    Xq = np.nan_to_num(X[:8])
+
+    gate = threading.Event()
+    entered = threading.Barrier(3, timeout=30)
+    real_get = srv.registry.get
+
+    def slow_get(name):
+        entered.wait()      # both in-flight requests admitted...
+        gate.wait(30)       # ...and parked inside the predict section
+        return real_get(name)
+    srv.registry.get = slow_get
+
+    results = []
+
+    def req():
+        try:
+            results.append(srv.predict("m", Xq).shape[0])
+        except ServerOverloaded:
+            results.append("rejected")
+    threads = [threading.Thread(target=req) for _ in range(2)]
+    for t in threads:
+        t.start()
+    entered.wait()                    # 2 requests now hold in-flight slots
+    assert srv.inflight() == 2
+    with pytest.raises(ServerOverloaded, match="in flight"):
+        srv.predict("m", Xq)          # third is shed, fast
+    gate.set()
+    for t in threads:
+        t.join(30)
+    srv.registry.get = real_get
+    assert sorted(results) == [8, 8]
+    assert srv.inflight() == 0        # slots released
+    counters = srv.stats()["counters"]
+    assert counters["serve_rejected_requests"] == 1
+    assert counters["serve_requests"] == 2
+
+
+def test_admission_rejection_releases_slot(reg_model):
+    """A deadline rejection taken AFTER admission must not leak its
+    in-flight slot."""
+    from lightgbm_tpu.serving.server import ServerOverloaded
+    bst, X = reg_model
+    srv = PredictionServer({"serving_buckets": [8], "serving_max_inflight": 1})
+    srv.publish("m", booster=bst, warmup=False)
+    Xq = np.nan_to_num(X[:8])
+    real_get = srv.registry.get
+
+    def slow_get(name):   # burn the (tiny) budget inside the admitted section
+        import time
+        time.sleep(0.05)
+        return real_get(name)
+    srv.registry.get = slow_get
+    with pytest.raises(ServerOverloaded, match="expired"):
+        srv.predict("m", Xq, deadline_ms=1.0)
+    srv.registry.get = real_get
+    assert srv.inflight() == 0
+    assert srv.predict("m", Xq).shape[0] == 8   # slot was released
+
+
+def test_serving_max_inflight_config_validation():
+    with pytest.raises(lgb.LightGBMError):
+        PredictionServer({"serving_max_inflight": 0})

@@ -307,3 +307,49 @@ def _restore_global_timer():
     yield
     global_timer.disable()
     global_timer.reset()
+
+
+def test_telemetry_continuous_after_resume(tmp_path):
+    """Regression (PR 9): a killed run leaves telemetry records for
+    rounds PAST the checkpoint its successor resumes from; the resumed
+    run must prune that stale tail so the file reads as ONE continuous
+    per-iteration history — no duplicate or overlapping indices."""
+    from lightgbm_tpu.robustness.faults import kill_training
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(200, 5)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+    tel = str(tmp_path / "tele.jsonl")
+    p = {"objective": "binary", "num_leaves": 7, "min_data_in_leaf": 5,
+         "seed": 7, "deterministic": True, "verbosity": -1,
+         "checkpoint_dir": str(tmp_path / "ck"), "checkpoint_interval": 3,
+         "telemetry_output": tel}
+    with pytest.raises(Exception):
+        lgb.train(dict(p), lgb.Dataset(X, label=y), num_boost_round=12,
+                  callbacks=[kill_training(at_iteration=7)])
+    # the kill at iteration 7 post-dates the newest checkpoint (round 6):
+    # iterations 6..7 in the file are stale relative to the resume point
+    stale = [json.loads(ln)["iteration"] for ln in open(tel)]
+    assert max(stale) >= 6
+    bst = lgb.train(dict(p), lgb.Dataset(X, label=y), num_boost_round=12,
+                    resume="auto")
+    assert bst.num_trees() == 12
+    iters = [json.loads(ln)["iteration"] for ln in open(tel)]
+    assert iters == sorted(iters)                  # monotone
+    assert len(iters) == len(set(iters))           # no duplicates
+    assert iters == list(range(12))                # one continuous history
+
+
+def test_telemetry_prune_keeps_unparseable_lines(tmp_path):
+    from lightgbm_tpu.callback import _prune_stale_telemetry
+    path = str(tmp_path / "t.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps({"iteration": 0}) + "\n")
+        f.write("NOT JSON {{{\n")
+        f.write(json.dumps({"iteration": 5}) + "\n")
+        f.write(json.dumps({"no_iteration_key": True}) + "\n")
+    assert _prune_stale_telemetry(path, cut=3) == 1
+    lines = open(path).read().splitlines()
+    assert len(lines) == 3
+    assert lines[1] == "NOT JSON {{{"
+    # records without an iteration index are kept (iteration -1 < cut)
+    assert json.loads(lines[2]) == {"no_iteration_key": True}

@@ -60,6 +60,7 @@ RULE_FIXTURES = [
     ("TPU105", "tpu105_bad.py", "tpu105_ok.py"),
     ("TPU106", "parallel/tpu106_bad.py", "parallel/tpu106_ok.py"),
     ("GRW401", "learner/grw401_bad.py", "learner/grw401_ok.py"),
+    ("RBS501", "rbs501_bad.py", "rbs501_ok.py"),
 ]
 
 
@@ -485,3 +486,23 @@ def test_dunder_main_import_is_inert():
     import importlib
     mod = importlib.import_module("lightgbm_tpu.analysis.__main__")
     assert hasattr(mod, "main")
+
+
+def test_rbs501_suppression_support(tmp_path):
+    """A genuinely-bounded-by-other-means retry loop is silenced either
+    inline or by a justified suppression-file entry."""
+    src = open(os.path.join(FIXTURES, "rbs501_bad.py")).read()
+    f = tmp_path / "inline.py"
+    f.write_text(src.replace(
+        "while True:", "while True:  # tpulint: disable=RBS501"))
+    violations, _ = lint(str(f), root=str(tmp_path))
+    assert not [v for v in violations if v.rule_id == "RBS501"]
+    g = tmp_path / "filecase.py"
+    g.write_text(src)
+    supp = tmp_path / "supp.txt"
+    supp.write_text("RBS501 | filecase.py | while True | intentional: "
+                    "the job scheduler's external watchdog bounds this "
+                    "daemon loop\n")
+    violations, _ = lint(str(g), root=str(tmp_path),
+                         suppressions=str(supp))
+    assert violations == []

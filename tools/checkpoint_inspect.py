@@ -15,6 +15,13 @@ Exit codes (tools/_report.py convention):
   1 — the directory holds no checkpoints at all,
   2 — the newest checkpoint is invalid (resume would fall back to an
       older one — or fail entirely when none validates).
+
+``--verify-all`` hardens the gate for elastic recovery (docs/
+ROBUSTNESS.md): EVERY manifest must sha256-validate, not just the
+newest.  An eviction-triggered resume falls back through the chain when
+the newest checkpoint is corrupt, so a rotting older checkpoint is a
+latent recovery failure even while normal resumes still succeed — with
+``--verify-all`` any invalid checkpoint exits 2.
 """
 
 from __future__ import annotations
@@ -58,6 +65,8 @@ def build_report(directory: str) -> Dict[str, Any]:
         "directory": directory,
         "checkpoints": entries,
         "newest_valid": entries[0]["valid"] if entries else None,
+        "all_valid": all(e["valid"] for e in entries) if entries else None,
+        "invalid_count": sum(1 for e in entries if not e["valid"]),
     }
 
 
@@ -78,10 +87,11 @@ def _render_report(payload: Dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
-def exit_code(payload: Dict[str, Any]) -> int:
+def exit_code(payload: Dict[str, Any], verify_all: bool = False) -> int:
     if not payload["checkpoints"]:
         return EXIT_FINDINGS
-    return EXIT_OK if payload["newest_valid"] else EXIT_ERROR
+    ok = payload["all_valid"] if verify_all else payload["newest_valid"]
+    return EXIT_OK if ok else EXIT_ERROR
 
 
 def main(argv=None) -> int:
@@ -91,6 +101,11 @@ def main(argv=None) -> int:
                     help="exit nonzero unless the newest checkpoint "
                          "validates (the default behavior; kept as an "
                          "explicit flag for CI readability)")
+    ap.add_argument("--verify-all", action="store_true",
+                    help="exit nonzero unless EVERY checkpoint's manifest "
+                         "sha256-validates — guards the whole fallback "
+                         "chain an elastic recovery may walk, not just "
+                         "the newest entry")
     add_format_arg(ap)
     ap.add_argument("--json", action="store_true",
                     help="deprecated spelling of --format json (NOTE: "
@@ -100,7 +115,7 @@ def main(argv=None) -> int:
     payload = build_report(args.checkpoint_dir)
     fmt = "json" if args.json else args.format
     emit(payload, fmt, _render_report)
-    return exit_code(payload)
+    return exit_code(payload, verify_all=args.verify_all)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,264 @@
+#!/usr/bin/env python
+"""Scripted fault drill: kill -> detect -> reshape -> resume -> verify.
+
+    python tools/fault_drill.py [--quick] [--rounds N] [--workers N]
+                                [--format text|json]
+
+Runs the elastic-recovery machinery (robustness/elastic.py) against
+scripted faults (robustness/faults.py) on the virtual CPU mesh and
+verifies the recovery CONTRACT, not just survival: the continued run's
+model text must be bit-for-bit identical (modulo the serialized-params
+trailer — ``model_core()``) to an uninterrupted run at the reduced mesh
+size AND to the serial learner, and every checkpoint manifest in the
+chain the resume walked must sha256-validate
+(tools/checkpoint_inspect.py ``--verify-all`` semantics).
+
+Scenarios (``--quick`` runs only the first — the tier-1 CI gate):
+
+  kill        worker killed mid-run -> heartbeat silence -> eviction ->
+              mesh reshape -> checkpoint resume -> bit-identity verify
+  stall       worker pauses one round -> warned + counted
+              (``elastic_slow_worker_rounds``), NOT evicted; final model
+              identical to the undisturbed full-mesh run
+  drop        worker stops publishing heartbeats but keeps computing ->
+              evicted (observationally identical to death — documents
+              the monitor's observability boundary)
+  corrupt     newest checkpoint corrupted between kill and resume ->
+              recovery falls back to the older checkpoint and STILL
+              reproduces the reduced-mesh model bit-for-bit
+  fail_fast   same kill with ``elastic=off`` -> today's fail-fast error,
+              no recovery attempted
+
+Exit codes (tools/_report.py convention):
+  0 — every scenario passed
+  1 — a scenario's verification failed (recovery broken)
+  2 — drill could not run (internal error)
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+from typing import Any, Dict, List
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# the drill needs a >1-device virtual mesh; both knobs must be set
+# before jax (transitively: lightgbm_tpu) is imported
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=4 "
+                               + os.environ.get("XLA_FLAGS", ""))
+
+from _report import (EXIT_ERROR, EXIT_FINDINGS, EXIT_OK,  # noqa: E402
+                     add_format_arg, emit)
+
+#: deterministic quantized config — the regime ROBUSTNESS.md documents
+#: as mesh-size-invariant, which is what makes bit-identity checkable
+BASE_PARAMS = dict(objective="binary", num_leaves=7, learning_rate=0.5,
+                   min_data_in_leaf=5, deterministic=True, seed=7,
+                   use_quantized_grad=True, stochastic_rounding=False,
+                   tree_learner="data", checkpoint_interval=2,
+                   heartbeat_interval_s=0.2, heartbeat_timeout_s=1.0,
+                   elastic="on", verbosity=-1)
+
+
+def _data():
+    import numpy as np
+    rng = np.random.RandomState(0)
+    X = rng.randint(0, 8, size=(200, 5)).astype(np.float64)
+    y = (X[:, 0] + X[:, 1] > 7).astype(np.float64)
+    return X, y
+
+
+def _ref_model(X, y, rounds: int, mesh: int) -> str:
+    """Uninterrupted reference at a fixed mesh size (serial when 1)."""
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.parallel.mesh import device_window
+    from lightgbm_tpu.robustness.elastic import model_core
+    p = {k: v for k, v in BASE_PARAMS.items()
+         if k not in ("checkpoint_interval", "heartbeat_interval_s",
+                      "heartbeat_timeout_s", "elastic")}
+    if mesh <= 1:
+        p["tree_learner"] = "serial"
+        booster = lgb.train(p, lgb.Dataset(X, label=y),
+                            num_boost_round=rounds)
+    else:
+        with device_window(mesh):
+            booster = lgb.train(p, lgb.Dataset(X, label=y),
+                                num_boost_round=rounds)
+    return model_core(booster.model_to_string())
+
+
+def _verify_checkpoints(workdir: str) -> Dict[str, Any]:
+    """checkpoint_inspect --verify-all over the session's chain."""
+    import checkpoint_inspect
+    payload = checkpoint_inspect.build_report(os.path.join(workdir, "ckpt"))
+    return {"count": len(payload["checkpoints"]),
+            "all_valid": bool(payload["all_valid"]),
+            "invalid_count": payload["invalid_count"]}
+
+
+def _run(X, y, rounds, workers, workdir, faults, **over):
+    from lightgbm_tpu.robustness.elastic import (model_core,
+                                                 run_elastic_training)
+    params = dict(BASE_PARAMS, **over)
+    booster, rep = run_elastic_training(
+        params, X, y, num_boost_round=rounds, n_workers=workers,
+        workdir=workdir, faults=faults)
+    return model_core(booster.model_to_string()), rep
+
+
+def scenario_kill(X, y, rounds, workers, corrupt_newest=False):
+    from lightgbm_tpu.robustness.faults import (corrupt_checkpoint,
+                                                kill_worker)
+    kill_at = max(1, rounds // 2)
+    callbacks = []
+    if corrupt_newest:
+        # corrupt the newest checkpoint the moment the kill lands, so
+        # the recovery's resume="auto" must fall back one step
+        def _corruptor(workdir):
+            state = {"done": False}
+
+            def _cb(env):
+                if env.iteration >= kill_at and not state["done"]:
+                    state["done"] = True
+                    corrupt_checkpoint(os.path.join(workdir, "ckpt"),
+                                       mode="garbage_manifest")
+            _cb.order = 55    # after checkpoint (40), before liveness (60)
+            return _cb
+    with tempfile.TemporaryDirectory() as td:
+        faults = [kill_worker(workers - 2, at_round=kill_at)]
+        from lightgbm_tpu.robustness.elastic import (ElasticSession,
+                                                     model_core)
+        cbs = [_corruptor(td)] if corrupt_newest else None
+        session = ElasticSession(dict(BASE_PARAMS), X, y,
+                                 num_boost_round=rounds,
+                                 n_workers=workers, workdir=td,
+                                 faults=faults, callbacks=cbs)
+        booster = session.train()
+        core = model_core(booster.model_to_string())
+        rep = session.report.to_dict()
+        ckpt = _verify_checkpoints(td)
+    ref_reduced = _ref_model(X, y, rounds, workers - 1)
+    ref_serial = _ref_model(X, y, rounds, 1)
+    checks = {
+        "evicted": len(rep["evictions"]) == 1,
+        "reshaped": rep["final_mesh"] == workers - 1,
+        "resumed": rep["resumes"] >= 1,
+        "bit_identical_reduced_mesh": core == ref_reduced,
+        "bit_identical_serial": core == ref_serial,
+        # on the corrupt drill the newest checkpoint is broken BY DESIGN;
+        # what matters is that recovery still landed bit-exact off the
+        # older one — so the chain check is only asserted when clean
+        "checkpoint_chain_valid": (True if corrupt_newest
+                                   else ckpt["all_valid"]),
+    }
+    return {"name": "corrupt" if corrupt_newest else "kill",
+            "kill_at_round": kill_at, "checks": checks,
+            "checkpoints": ckpt, "elastic_report": rep,
+            "passed": all(checks.values())}
+
+
+def scenario_stall(X, y, rounds, workers):
+    from lightgbm_tpu.robustness.faults import stall_worker
+    with tempfile.TemporaryDirectory() as td:
+        core, rep = _run(X, y, rounds, workers, td,
+                         [stall_worker(1, seconds=0.5, at_round=2)])
+    ref_full = _ref_model(X, y, rounds, workers)
+    checks = {
+        "warned_not_evicted": rep["slow_rounds"] >= 1,
+        "no_eviction": not rep["evictions"],
+        "bit_identical_full_mesh": core == ref_full,
+    }
+    return {"name": "stall", "checks": checks, "elastic_report": rep,
+            "passed": all(checks.values())}
+
+
+def scenario_drop(X, y, rounds, workers):
+    from lightgbm_tpu.robustness.faults import drop_heartbeats
+    with tempfile.TemporaryDirectory() as td:
+        core, rep = _run(X, y, rounds, workers, td,
+                         [drop_heartbeats(workers - 1, at_round=2)])
+    ref_reduced = _ref_model(X, y, rounds, workers - 1)
+    checks = {
+        "evicted": len(rep["evictions"]) == 1,
+        "bit_identical_reduced_mesh": core == ref_reduced,
+    }
+    return {"name": "drop", "checks": checks, "elastic_report": rep,
+            "passed": all(checks.values())}
+
+
+def scenario_fail_fast(X, y, rounds, workers):
+    from lightgbm_tpu.robustness.faults import kill_worker
+    from lightgbm_tpu.utils.log import LightGBMError
+    failed_fast, detail = False, ""
+    try:
+        with tempfile.TemporaryDirectory() as td:
+            _run(X, y, rounds, workers, td,
+                 [kill_worker(0, at_round=1)], elastic="off")
+        detail = "no error raised"
+    except LightGBMError as e:
+        failed_fast, detail = True, str(e)
+    checks = {"failed_fast": failed_fast,
+              "no_recovery_attempted": "elastic=on" in detail}
+    return {"name": "fail_fast", "detail": detail, "checks": checks,
+            "passed": all(checks.values())}
+
+
+def run_drill(quick: bool, rounds: int, workers: int) -> Dict[str, Any]:
+    X, y = _data()
+    scenarios: List[Dict[str, Any]] = [scenario_kill(X, y, rounds, workers)]
+    if not quick:
+        scenarios.append(scenario_stall(X, y, rounds, workers))
+        scenarios.append(scenario_drop(X, y, rounds, workers))
+        scenarios.append(scenario_kill(X, y, rounds, workers,
+                                       corrupt_newest=True))
+        scenarios.append(scenario_fail_fast(X, y, rounds, workers))
+    return {"tool": "fault_drill", "mode": "quick" if quick else "full",
+            "rounds": rounds, "workers": workers,
+            "scenarios": scenarios,
+            "passed": all(s["passed"] for s in scenarios)}
+
+
+def _render(payload: Dict[str, Any]) -> str:
+    lines = [f"fault drill ({payload['mode']}): "
+             f"{payload['workers']} workers x {payload['rounds']} rounds"]
+    for s in payload["scenarios"]:
+        verdict = "PASS" if s["passed"] else "FAIL"
+        checks = " ".join(f"{k}={'ok' if v else 'FAIL'}"
+                          for k, v in s["checks"].items())
+        lines.append(f"  {s['name']:<10} {verdict}  {checks}")
+    lines.append("drill: " + ("PASS" if payload["passed"] else "FAIL"))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--quick", action="store_true",
+                    help="kill scenario only (tier-1 CI gate)")
+    ap.add_argument("--rounds", type=int, default=8)
+    ap.add_argument("--workers", type=int, default=4)
+    add_format_arg(ap)
+    args = ap.parse_args(argv)
+    if args.workers < 2:
+        print("fault_drill: need --workers >= 2 (one to lose)",
+              file=sys.stderr)
+        return EXIT_ERROR
+    try:
+        payload = run_drill(args.quick, args.rounds, args.workers)
+    except Exception as e:   # drill infrastructure broke, not a finding
+        print(f"fault_drill: internal error: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return EXIT_ERROR
+    emit(payload, args.format, _render)
+    return EXIT_OK if payload["passed"] else EXIT_FINDINGS
+
+
+if __name__ == "__main__":
+    sys.exit(main())
