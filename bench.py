@@ -256,6 +256,24 @@ def _memory_result():
     return obs_memory.memory_snapshot()
 
 
+def _collective_result():
+    """Collective-overlap probe (obs/collective.py) next to the headline:
+    per-pass reduce time and how much of it the split-psum overlap hides
+    (``overlap_efficiency`` drops to 0.0 under ``LGBMTPU_NO_OVERLAP=1``,
+    the same A/B knob the training path honors).  ``None`` on a 1-device
+    mesh — there is no collective to measure."""
+    try:
+        import jax
+        if jax.device_count() < 2:
+            return None
+        from lightgbm_tpu.obs.collective import measure_collective
+        from lightgbm_tpu.parallel.mesh import make_mesh
+        res = measure_collective(make_mesh(), (256, MAX_BIN + 1, 4))
+        return {k: round(float(v), 9) for k, v in res.items()}
+    except Exception as e:   # the probe must never sink the bench line
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def _synth_higgs(n, f, rng, w=None):
     """Higgs-shaped synthetic binary data (separable-ish continuous
     features; BASELINE.md pairs its 130.094 s with AUC 0.845724 on the real
@@ -363,6 +381,9 @@ def main_e2e():
         "capture_quality": capture,
         "memory": _memory_result(),
     }
+    coll = _collective_result()
+    if coll is not None:
+        payload["collective"] = coll
     if with_valid and getattr(gb, "_last_fused_evals", None):
         # the in-scan device AUC of the final round (proof the valid set
         # actually rode the fused path)
@@ -502,6 +523,9 @@ def main():
         }
     # sampled AFTER the timed runs so peak covers the measurement itself
     payload["memory"] = _memory_result()
+    coll = _collective_result()
+    if coll is not None:
+        payload["collective"] = coll
     print(json.dumps(_quality_gate(payload)))
 
 

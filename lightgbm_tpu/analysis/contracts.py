@@ -540,3 +540,98 @@ class UndeclaredCounter(Rule):
                     f"declared telemetry counter `{name}` is never "
                     "bumped or read anywhere — remove it or wire the "
                     "instrumentation point")
+
+
+# --------------------------------------------------------- event journal
+def load_declared_events(events_path: str) -> Dict[str, int]:
+    """``EVENTS`` declaration in obs/events.py: name -> lineno."""
+    with open(events_path, encoding="utf-8") as fh:
+        tree = ast.parse(fh.read(), filename=events_path)
+    for node in tree.body:
+        target = None
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            target = node.targets[0].id
+        elif isinstance(node, ast.AnnAssign) and \
+                isinstance(node.target, ast.Name):
+            target = node.target.id
+        if target == "EVENTS" and isinstance(node.value, ast.Dict):
+            out = {}
+            for k in node.value.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    out[k.value] = k.lineno
+            return out
+    return {}
+
+
+@register_rule
+class UndeclaredEvent(Rule):
+    id = "OBS302"
+    name = "undeclared-journal-event"
+    severity = SEVERITY_ERROR
+    description = ("an event journaled via `emit_event` under a name not "
+                   "declared in obs/events.py `EVENTS` (or declared but "
+                   "never emitted)")
+
+    def __init__(self, events_path: Optional[str] = None):
+        self._events_path = events_path
+
+    @staticmethod
+    def _collect_uses(run: LintRun) -> List[Tuple[str, int, int, str]]:
+        """(relpath, line, col, name) per emit_event call — gathered per
+        run, same runner-reuse discipline as OBS301."""
+        uses: List[Tuple[str, int, int, str]] = []
+        for ctx in run.contexts:
+            rel = ctx.relpath.replace("\\", "/")
+            if rel.endswith("obs/events.py"):
+                continue
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                first = node.args[0]
+                if not (isinstance(first, ast.Constant)
+                        and isinstance(first.value, str)):
+                    continue
+                is_emit = (isinstance(node.func, ast.Name)
+                           and node.func.id == "emit_event") or \
+                          (isinstance(node.func, ast.Attribute)
+                           and node.func.attr == "emit_event")
+                if is_emit:
+                    uses.append((ctx.relpath, node.lineno,
+                                 node.col_offset, first.value))
+        return uses
+
+    def finalize(self, run: LintRun) -> Iterable[Violation]:
+        path = self._events_path or os.path.join(
+            run.root, "lightgbm_tpu", "obs", "events.py")
+        try:
+            declared = load_declared_events(path)
+        except (OSError, SyntaxError):
+            return
+        events_rel = os.path.relpath(path, run.root)
+        if not declared:
+            yield self.violation(
+                events_rel, 1, 0,
+                "no EVENTS declaration found in obs/events.py — every "
+                "journal event name must be declared there once")
+            return
+        used_names = set()
+        for relpath, line, col, name in self._collect_uses(run):
+            used_names.add(name)
+            if name not in declared:
+                yield self.violation(
+                    relpath, line, col,
+                    f"journal event `{name}` is not declared in "
+                    "obs/events.py EVENTS — declare it (name + severity "
+                    "+ one-line meaning) so readers of the journal can "
+                    "rely on the schema")
+        # "never emitted anywhere" is only decidable on a whole-package
+        # run, mirroring OBS301's reverse direction
+        if not run.covers(os.path.dirname(os.path.dirname(path))):
+            return
+        for name, lineno in declared.items():
+            if name not in used_names:
+                yield self.violation(
+                    events_rel, lineno, 0,
+                    f"declared journal event `{name}` is never emitted "
+                    "anywhere — remove it or wire the emission site")

@@ -478,6 +478,7 @@ class GBDT:
         train_set = self.train_set
         self._fused_cache = {}   # compiled fused-round runners (train_fused)
         self._batched_decision = None   # memoized _use_batched_grower
+        self._collective_probed = False  # one-shot obs/collective probe
         # numeric guard policy (robustness/guards.py); validated by
         # Config.check_param_conflict, re-derived on reset_config
         self.nan_policy = str(config.nan_policy or "none")
@@ -1544,6 +1545,7 @@ class GBDT:
                 # the same logical reductions the explicit path psums
                 self._count("collective_allreduce_bytes_est",
                             self._collective_bytes_per_tree())
+                self._maybe_measure_collective(self._overlap)
             args = (self.bins, g, h, row_mask, self.num_bins_arr,
                     self.nan_bin_arr, self.is_cat_arr, feature_mask, self.hp)
             if self._use_batched_grower():
@@ -1595,6 +1597,7 @@ class GBDT:
         if overlap:
             self._count("collective_overlap_rounds",
                         self._hist_rounds_per_tree())
+        self._maybe_measure_collective(overlap)
         if self.parallel_mode in ("data", "voting") \
                 and self._use_batched_grower():
             with obs_trace.span("collective_grow_dispatch",
@@ -1621,6 +1624,39 @@ class GBDT:
                 forced=self.forced_splits, hist_scale=hist_scale,
                 overlap=overlap, metrics=self.metrics)
         return arrays, (lor[:-p] if p else lor)
+
+    def _maybe_measure_collective(self, overlap: bool) -> None:
+        """One-shot collective probe (obs/collective.py): measure this
+        mesh's per-pass histogram all-reduce cost and overlap
+        efficiency, gauged into the booster + global registries so
+        telemetry JSONL rows and bench payloads carry them.  Runs ONLY
+        when observability is configured (a trace recorder or event
+        journal is active, or telemetry_output is set) — the no-outputs
+        path never compiles a probe."""
+        if self._collective_probed or self.mesh is None:
+            return
+        from ..obs import events as obs_events
+        if obs_trace.active() is None and obs_events.active() is None \
+                and not str(getattr(self.config, "telemetry_output", "")
+                            or ""):
+            return
+        self._collective_probed = True
+        try:
+            from ..obs.collective import measure_collective
+            res = measure_collective(
+                self.mesh, (self.bins.shape[1], self.hp.n_bins, 4),
+                overlap=overlap, metrics=self.metrics)
+        except Exception as e:   # a probe failure must not stop training
+            log.warning("collective probe failed (%s: %s); overlap "
+                        "gauges unavailable this run"
+                        % (type(e).__name__, e))
+            return
+        per_round = res["collective_s_per_pass"] * \
+            self._hist_rounds_per_tree()
+        from ..obs.metrics import global_metrics
+        for registry in (self.metrics, global_metrics):
+            registry.set_gauge("collective_s_per_round",
+                               round(per_round, 9))
 
     def _use_batched_grower(self) -> bool:
         """Batched split rounds (learner/batch_grower.py) when requested and
@@ -1674,6 +1710,8 @@ class GBDT:
                         "to the strict leaf-wise learner"
                         % ", ".join(reasons))
             self._count("batched_path_fallbacks")
+            from ..obs.events import emit_event
+            emit_event("strict_learner_fallback", reasons=reasons)
             if pool_active:
                 # the pool lives in the batched grower only; the strict
                 # learner keeps the full [L, F, B, 4] state resident, so

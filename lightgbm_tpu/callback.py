@@ -197,8 +197,14 @@ def log_telemetry(path: str, period: int = 1,
         }
         gb = getattr(env.model, "_gbdt", None)
         if gb is not None:
-            counters = gb.metrics.snapshot()["counters"]
+            snap = gb.metrics.snapshot()
+            counters = snap["counters"]
             rec["counters"] = counters
+            if snap["gauges"]:
+                # collective probe results (overlap_efficiency,
+                # collective_s_per_pass/_per_round, obs/collective.py)
+                # and any other point-in-time samples
+                rec["gauges"] = snap["gauges"]
             fused_now = counters.get("fused_rounds", 0)
             if fused_now > state["fused_seen"]:
                 rec["fused_replay"] = True

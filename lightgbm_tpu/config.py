@@ -167,6 +167,7 @@ _PARAMS: List[Tuple[str, Any, Tuple[str, ...], Tuple[Tuple[str, float], ...]]] =
     # --- observability (obs/; docs/OBSERVABILITY.md) ---
     ("trace_output", "", ("trace_file", "trace_out"), ()),        # Chrome trace-event JSON path (Perfetto-loadable)
     ("telemetry_output", "", ("telemetry_file",), ()),            # per-iteration telemetry JSONL path
+    ("event_output", "", ("event_file", "event_journal"), ()),    # structured event-journal JSONL path (obs/events.py declared schema; lifecycle events: heartbeat/eviction/reshape/resume, checkpoint write/resume/corrupt-skip, nan_policy trips, serving hot-swap/overload)
     ("profile_dir", "", ("profiler_dir",), ()),                   # jax.profiler trace directory (device timeline)
     # --- robustness (robustness/; docs/ROBUSTNESS.md) ---
     ("checkpoint_dir", "", ("checkpoint_directory",), ()),        # periodic atomic training checkpoints under this directory; empty = off

@@ -18,7 +18,7 @@ from .basic import Booster, Dataset
 from .callback import (CallbackEnv, EarlyStopException, log_telemetry,
                        record_evaluation)
 from .config import normalize_params
-from .obs import observe_training, trace as obs_trace
+from .obs import events as obs_events, observe_training, trace as obs_trace
 from .robustness.guards import NumericHalt
 from .utils import log
 from .utils.paths import check_output_path
@@ -173,6 +173,13 @@ def train(params: Dict[str, Any], train_set: Dataset,
     # the root span every other span nests under.
     with observe_training(cfg), \
             phase("train", booster._gbdt.timer, global_timer):
+        if resume_state is not None:
+            # journal activates with the session just above, so the
+            # restore (which ran earlier) is journaled here; an elastic
+            # session's outer journal receives it either way
+            obs_events.emit_event(
+                "checkpoint_resume", round_idx=start_round,
+                total_rounds=int(num_boost_round))
         return _run_training(booster, params, train_set, rounds_to_run,
                              valid_pairs, train_in_valid, feval, fobj,
                              callbacks, cbs_before, cbs_after,
@@ -406,6 +413,7 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
     import types
     obs_cfg = types.SimpleNamespace(
         trace_output=params.get("trace_output", ""),
+        event_output=params.get("event_output", ""),
         profile_dir=params.get("profile_dir", ""))
     with observe_training(obs_cfg):
         for fi, (train_idx, test_idx) in enumerate(folds):

@@ -8,7 +8,13 @@ Three pillars (docs/OBSERVABILITY.md):
   * :mod:`.metrics` — process- and booster-scoped counters/gauges
     (``Booster.telemetry()``, per-iteration JSONL via the
     ``log_telemetry`` callback / ``telemetry_output=<path>``),
-  * :mod:`.memory` — host RSS and device memory sampling.
+  * :mod:`.memory` — host RSS and device memory sampling,
+  * :mod:`.events` — structured lifecycle event journal
+    (``event_output=<path>``, JSONL; declared schema, tpulint OBS302),
+  * :mod:`.merge` — cross-rank trace merging with barrier-anchored
+    clock alignment (cluster runs),
+  * :mod:`.collective` — collective-overlap probes
+    (``overlap_efficiency`` / ``collective_s_per_pass`` gauges).
 
 Everything is disabled by default and near-zero-cost when disabled: span
 emission is one module-global ``is None`` check, counters bump only on
@@ -16,10 +22,10 @@ coarse host paths, and no file is ever written unless a ``*_output``
 config key (or the callback) asks for one.
 """
 
-from . import compile_events, memory, metrics, trace
+from . import compile_events, events, memory, metrics, trace
 from .metrics import MetricsRegistry, count_event, global_metrics
 
-__all__ = ["trace", "metrics", "memory", "compile_events",
+__all__ = ["trace", "metrics", "memory", "compile_events", "events",
            "MetricsRegistry", "global_metrics", "count_event",
            "observe_training"]
 
@@ -50,19 +56,25 @@ def observe_training(config) -> Iterator[None]:
     compile_events.install()
     trace_path = str(getattr(config, "trace_output", "") or "")
     profile_dir = str(getattr(config, "profile_dir", "") or "")
+    event_path = str(getattr(config, "event_output", "") or "")
     # probe writability only when this session would own the export —
     # a joiner of an already-active session must not leave a zero-byte
     # stub at a path that will never be written
     if trace_path and trace.active() is None and \
             not check_output_path(trace_path, key="trace_output"):
         trace_path = ""
+    if event_path and events.active() is None and \
+            not check_output_path(event_path, key="event_output"):
+        event_path = ""
     recorder = trace.start(trace_path) if trace_path else None
+    journal = events.start(event_path) if event_path else None
     profiling = bool(profile_dir) and trace.start_profiler(profile_dir)
     try:
         yield
     finally:
         if profiling:
             trace.stop_profiler()
+        events.stop(journal)
         try:
             trace.stop(recorder, export_path=trace_path or None)
         except OSError as e:

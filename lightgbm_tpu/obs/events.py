@@ -1,0 +1,259 @@
+"""Structured event journal: lifecycle transitions as declared records.
+
+The trace (obs/trace.py) answers "where did the time go"; this journal
+answers "what HAPPENED" — the elastic lifecycle (suspect -> dead ->
+evict -> reshape -> resume), checkpoint writes and corrupt-skips,
+``nan_policy`` triggers, strict-learner fallbacks and serving hot-swaps
+previously surfaced only as log warnings, which no tool can join
+against a trace or a telemetry stream.  Each emission appends one JSONL
+record to the ``event_output=<path>`` sink::
+
+    {"event": ..., "severity": ..., "rank": ..., "round": ...,
+     "t_mono": <perf_counter s>, "unix_time": <wall s>, "payload": {...}}
+
+and, when a trace recorder is active, mirrors the same record into the
+trace as an instant event — so a merged multi-rank timeline
+(obs/merge.py) shows the eviction marker ON the round it interrupted.
+
+Schema discipline mirrors the counter registry (obs/metrics.py
+``COUNTERS`` / tpulint OBS301): every event name emitted anywhere must
+be declared once in :data:`EVENTS` with its severity and a one-line
+meaning — tpulint OBS302 parses the literal below by AST and fails the
+gate on an undeclared emission (or a declared-but-never-emitted name).
+
+Cost contract: disabled (no journal started) the emission fast path is
+one module-global ``is None`` check, exactly like span emission.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+from typing import Any, Dict, Iterator, Optional, Tuple
+
+from .metrics import count_event
+
+#: Every journal event name used anywhere in the package, declared once
+#: as ``name: (severity, one-line meaning)``.  Lint contract (tpulint
+#: OBS302, same discipline as OBS301 for counters): emitting an
+#: undeclared name — or declaring one nothing emits — fails
+#: ``python tools/tpulint.py``.  Keys are parsed from this literal by
+#: AST, so keep it a plain dict with string keys.
+EVENTS: Dict[str, Tuple[str, str]] = {
+    "barrier_release": (
+        "info", "a rank cleared the distributed startup barrier (the "
+                "cross-rank clock-alignment anchor, obs/merge.py)"),
+    "heartbeat_suspect": (
+        "warning", "a lagging-but-alive worker kept the monitor in "
+                   "bounded wait (warned, not evicted)"),
+    "heartbeat_dead": (
+        "error", "a worker stayed silent past heartbeat_timeout_s and "
+                 "was declared dead"),
+    "worker_evicted": (
+        "error", "dead worker(s) dropped from the job by elastic "
+                 "recovery"),
+    "mesh_reshape": (
+        "warning", "device mesh rebuilt over the survivor set after an "
+                   "eviction"),
+    "training_resumed": (
+        "info", "post-reshape training resumed from the newest "
+                "checkpoint/snapshot"),
+    "checkpoint_written": (
+        "info", "a checkpoint committed atomically to checkpoint_dir"),
+    "checkpoint_resume": (
+        "info", "a training run restored exact state from a checkpoint "
+                "(resume='auto')"),
+    "checkpoint_corrupt_skipped": (
+        "warning", "a corrupt/unreadable checkpoint was skipped during "
+                   "the resume scan"),
+    "nan_policy_trip": (
+        "warning", "the per-round finite guard saw non-finite "
+                   "grad/hess/scores (nan_policy decides the outcome)"),
+    "strict_learner_fallback": (
+        "warning", "tpu_split_batch > 1 ignored — training fell back to "
+                   "the strict leaf-wise learner"),
+    "serve_hot_swap": (
+        "info", "a registry publish atomically replaced a live model "
+                "version"),
+    "serve_overload_rejected": (
+        "warning", "a serving request rejected by admission control "
+                   "(in-flight bound or expired deadline)"),
+}
+
+#: the process-wide active journal; ``None`` = journaling disabled (the
+#: one-word fast-path check every emission point makes first)
+_ACTIVE: Optional["EventJournal"] = None
+_ACTIVE_LOCK = threading.Lock()
+
+
+class EventJournal:
+    """Appends declared-schema event records to a JSONL sink.
+
+    Thread-safe; the file opens lazily on the first record (a journal
+    that never sees an event writes no file) and every record is
+    flushed — a killed worker's journal is readable up to its last
+    completed emission."""
+
+    def __init__(self, path: str, rank: Optional[int] = None) -> None:
+        self.path = str(path)
+        self.rank = rank
+        self._lock = threading.Lock()
+        self._file = None
+        self._warned_names: set = set()
+        self._t0 = time.perf_counter()
+
+    def emit_event(self, name: str, *, rank: Optional[int] = None,
+                   round_idx: Optional[int] = None,
+                   **payload: Any) -> None:
+        sev_desc = EVENTS.get(name)
+        if sev_desc is None:
+            # runtime backstop behind the OBS302 static gate (dynamic
+            # names can dodge the AST check): record it anyway —
+            # dropping evidence is worse than an untracked name
+            if name not in self._warned_names:
+                self._warned_names.add(name)
+                from ..utils import log
+                log.warning(f"event {name!r} is not declared in "
+                            "obs/events.py EVENTS; recording with "
+                            "severity=error")
+            severity = "error"
+        else:
+            severity = sev_desc[0]
+        rec = {"event": name, "severity": severity,
+               "rank": self.rank if rank is None else int(rank),
+               "round": None if round_idx is None else int(round_idx),
+               "t_mono": round(time.perf_counter() - self._t0, 6),
+               "unix_time": round(time.time(), 6),
+               "payload": payload}
+        count_event("event_journal_records")
+        from . import trace as obs_trace
+        rec_trace = obs_trace.active()
+        if rec_trace is not None:
+            args = {"severity": severity, **payload}
+            if rec["rank"] is not None:
+                args["rank"] = rec["rank"]
+            if rec["round"] is not None:
+                args["round"] = rec["round"]
+            rec_trace.add_instant(name, args)
+        line = json.dumps(rec, default=str) + "\n"
+        try:
+            with self._lock:
+                if self._file is None:
+                    self._file = open(self.path, "a")
+                self._file.write(line)
+                self._file.flush()
+        except OSError as e:
+            # journaling must never take training down (disk filled,
+            # path vanished): degrade to a one-time warning
+            if "write_failed" not in self._warned_names:
+                self._warned_names.add("write_failed")
+                from ..utils import log
+                log.warning(f"event_output={self.path!r}: journal write "
+                            f"failed ({type(e).__name__}: {e}); further "
+                            "events dropped")
+            self._file = None
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+
+
+def active() -> Optional[EventJournal]:
+    return _ACTIVE
+
+
+def start(path: Optional[str] = None,
+          rank: Optional[int] = None) -> Optional[EventJournal]:
+    """Activate a fresh process-wide journal and return it.
+
+    Returns ``None`` when a journal is already active (nested training —
+    an elastic session owns the journal across its epochs and the inner
+    ``train()`` runs join it), mirroring the trace recorder's
+    nested-``start`` contract."""
+    global _ACTIVE
+    if not path:
+        return None
+    with _ACTIVE_LOCK:
+        if _ACTIVE is None:
+            _ACTIVE = EventJournal(path, rank=rank)
+            return _ACTIVE
+        active_path = _ACTIVE.path
+    if path != active_path:
+        from ..utils import log
+        log.warning(
+            f"an event journal is already active (writing to "
+            f"{active_path!r}); event_output={path!r} will NOT be "
+            "written — this run's events join the active journal")
+    return None
+
+
+def stop(journal: Optional[EventJournal]) -> None:
+    """Deactivate ``journal`` (a ``start()`` return value; ``None``
+    no-ops, pairing with the nested-``start`` contract)."""
+    global _ACTIVE
+    if journal is None:
+        return
+    with _ACTIVE_LOCK:
+        if _ACTIVE is journal:
+            _ACTIVE = None
+    journal.close()
+
+
+@contextlib.contextmanager
+def session(path: Optional[str], rank: Optional[int] = None
+            ) -> Iterator[Optional[EventJournal]]:
+    """``start``/``stop`` as a context manager (the elastic session and
+    the cluster parent bracket their whole epoch loop with this, so
+    events emitted BETWEEN inner ``train()`` runs — eviction, reshape,
+    resume — still land)."""
+    journal = start(path, rank=rank)
+    try:
+        yield journal
+    finally:
+        stop(journal)
+
+
+def emit_event(name: str, *, rank: Optional[int] = None,
+               round_idx: Optional[int] = None, **payload: Any) -> None:
+    """Record one event through the active journal; a single ``is
+    None`` check when journaling is disabled."""
+    journal = _ACTIVE
+    if journal is None:
+        return
+    journal.emit_event(name, rank=rank, round_idx=round_idx, **payload)
+
+
+def read_journal(path: str) -> list:
+    """Parse a journal JSONL file; unparseable lines are skipped (a
+    killed writer can leave a torn final line)."""
+    out = []
+    try:
+        with open(path) as fh:
+            for line in fh:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict) and "event" in rec:
+                    out.append(rec)
+    except OSError:
+        return []
+    return out
+
+
+def journal_tail(path: str, limit: int = 20) -> list:
+    """The last ``limit`` records of a journal (drill reports embed
+    this per scenario)."""
+    return read_journal(path)[-int(limit):]
+
+
+def find_rank_journals(base: str) -> list:
+    """Per-rank journal files next to ``base`` (the cluster parent's
+    ``event_output``), written under the ``<stem>.e<E>.r<R><ext>``
+    namespace (obs/merge.py naming rule)."""
+    from .merge import find_rank_files
+    return find_rank_files(base)

@@ -1,0 +1,220 @@
+"""Merge per-rank Chrome traces into one rank-aligned timeline.
+
+Cluster runs (parallel/cluster.py) give every worker its own trace
+namespace — ``trace_output=run.json`` becomes ``run.e<E>.r<R>.json`` per
+elastic epoch E and rank R — because each worker is a separate process
+with its own monotonic clock origin.  This module joins those files into
+ONE Perfetto-loadable timeline:
+
+  * **Clock alignment**: each rank records a ``barrier_release`` anchor
+    (``TraceRecorder.mark_anchor``) the instant ``jax.distributed.
+    initialize`` returns — a moment all ranks of an epoch observe
+    simultaneously, so aligning the anchors cancels both monotonic-origin
+    offsets AND per-rank wall-clock skew.  All timestamps are shifted
+    onto rank 0's clock (the reference rank of the earliest epoch);
+    epochs are chained through their lowest-rank anchor walls.
+  * **One process/track per rank**: merged events get ``pid = rank``
+    with a ``process_name`` metadata row, so Perfetto shows rank 0..N-1
+    as stacked tracks.
+  * **Elastic epochs as nested scopes**: each (epoch, rank) file
+    contributes a synthetic ``elastic_epoch`` span covering its extent,
+    so the reshape boundary is visible as a scope break on every track.
+  * **Event overlay**: journal rows (obs/events.py JSONL) become instant
+    events on the emitting rank's track, wall-time-mapped through the
+    same anchors — ``--events`` in tools/trace_report.py.
+
+A rank killed mid-epoch still merges: workers export incrementally every
+round, so the victim's file simply ends at its last completed round.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .metrics import count_event
+
+#: filename namespace for per-rank artifacts: ``base=run.json`` ->
+#: ``run.e0.r1.json`` (epoch 0, rank 1).  Applied identically to trace,
+#: telemetry and event-journal paths by the cluster launcher.
+_RANK_RE = re.compile(r"\.e(\d+)\.r(\d+)(\.[^.]+)?$")
+
+
+def rank_file_path(base: str, epoch: int, rank: int) -> str:
+    """``run.json`` -> ``run.e<epoch>.r<rank>.json``."""
+    root, ext = os.path.splitext(str(base))
+    return f"{root}.e{int(epoch)}.r{int(rank)}{ext}"
+
+
+def find_rank_files(base: str) -> List[str]:
+    """All per-rank siblings of ``base``, ordered (epoch, rank)."""
+    root, ext = os.path.splitext(str(base))
+    found = []
+    for path in glob.glob(glob.escape(root) + ".e*.r*" + ext):
+        m = _RANK_RE.search(path)
+        if m:
+            found.append((int(m.group(1)), int(m.group(2)), path))
+    return [p for _, _, p in sorted(found)]
+
+
+def _parse_epoch_rank(path: str) -> Tuple[int, int]:
+    m = _RANK_RE.search(path)
+    if m:
+        return int(m.group(1)), int(m.group(2))
+    return 0, 0
+
+
+def _load(path: str) -> Tuple[List[Dict[str, Any]], Dict[str, Any]]:
+    with open(path) as fh:
+        doc = json.load(fh)
+    if isinstance(doc, list):          # bare-list Chrome trace form
+        return [e for e in doc if isinstance(e, dict)], {}
+    if not isinstance(doc, dict) or not isinstance(
+            doc.get("traceEvents"), list):
+        raise ValueError(f"{path}: not a Chrome trace "
+                         "(no traceEvents array)")
+    events = [e for e in doc["traceEvents"] if isinstance(e, dict)]
+    side = doc.get("lgbtpu")
+    return events, side if isinstance(side, dict) else {}
+
+
+def merge_rank_traces(
+        paths: Sequence[str],
+        out_path: Optional[str] = None,
+        events_paths: Sequence[str] = ()) -> Dict[str, Any]:
+    """Merge per-rank trace files onto the reference rank's clock.
+
+    ``paths`` are per-rank exports (``rank_file_path`` naming, or any
+    Chrome trace whose ``lgbtpu`` block carries ``rank``/``epoch``/
+    anchor fields).  Returns the merged trace dict; also writes it to
+    ``out_path`` when given.  ``events_paths`` are event-journal JSONL
+    files overlaid as instant events."""
+    if not paths:
+        raise ValueError("merge_rank_traces: no trace files given")
+    files = []
+    for path in paths:
+        events, side = _load(path)
+        f_epoch, f_rank = _parse_epoch_rank(path)
+        epoch = int(side.get("epoch", f_epoch))
+        rank = int(side.get("rank", f_rank))
+        files.append({"path": path, "epoch": epoch, "rank": rank,
+                      "events": events, "side": side})
+    files.sort(key=lambda f: (f["epoch"], f["rank"]))
+
+    # Reference clock: the lowest (epoch, rank) file — rank 0 of the
+    # first epoch in any complete run.
+    ref = files[0]
+    ref_ts = float(ref["side"].get("anchor_ts_us", 0.0))
+    ref_wall = float(ref["side"].get(
+        "anchor_wall", ref["side"].get("wall_t0", 0.0)))
+    # Each epoch's barrier fires at one wall moment; take it from the
+    # epoch's lowest-rank file so cross-epoch offsets never depend on a
+    # skewed high rank's wall clock.
+    epoch_wall: Dict[int, float] = {}
+    for f in files:
+        wall = float(f["side"].get(
+            "anchor_wall", f["side"].get("wall_t0", ref_wall)))
+        epoch_wall.setdefault(f["epoch"], wall)
+
+    merged: List[Dict[str, Any]] = []
+    ranks_seen: Dict[int, bool] = {}
+    epochs: Dict[int, Dict[str, float]] = {}
+    for f in files:
+        rank = f["rank"]
+        ranks_seen[rank] = True
+        anchor_ts = float(f["side"].get("anchor_ts_us", 0.0))
+        # shift: local monotonic -> anchor-relative -> reference clock,
+        # offset by this epoch's (wall) distance from the reference
+        # epoch.  Within one epoch the wall terms are the epoch's own
+        # barrier wall, so per-rank wall skew cancels exactly.
+        shift = (ref_ts - anchor_ts
+                 + (epoch_wall[f["epoch"]] - ref_wall) * 1e6)
+        lo = hi = None
+        for ev in f["events"]:
+            if ev.get("ph") == "M":
+                continue                   # re-synthesized per rank
+            ev = dict(ev)
+            ts = float(ev.get("ts", 0.0)) + shift
+            ev["ts"] = round(ts, 3)
+            ev["pid"] = rank
+            merged.append(ev)
+            end = ts + float(ev.get("dur", 0.0))
+            lo = ts if lo is None else min(lo, ts)
+            hi = end if hi is None else max(hi, end)
+        if lo is not None:
+            # epoch scope on this rank's track: the file's whole extent
+            merged.append({"name": "elastic_epoch", "ph": "X",
+                           "ts": round(lo, 3),
+                           "dur": round(max(hi - lo, 1.0), 3),
+                           "pid": rank, "tid": 0,
+                           "args": {"epoch": f["epoch"],
+                                    "source": os.path.basename(f["path"])}})
+        span = epochs.setdefault(f["epoch"], {})
+        span["ranks"] = span.get("ranks", 0) + 1
+
+    for ev_path in events_paths:
+        overlay = _overlay_events(ev_path, ref_ts, ref_wall)
+        for ev in overlay:
+            # overlay rows can land on tracks no trace file contributed
+            # (the coordinator's pid -1) — they still need a
+            # process_name metadata row to label the track
+            ranks_seen[int(ev["pid"])] = True
+        merged.extend(overlay)
+
+    # Perfetto tolerates negative timestamps poorly; normalise so the merged
+    # timeline starts at zero and every ts is monotically sortable.
+    if merged:
+        t_min = min(float(e.get("ts", 0.0)) for e in merged)
+        if t_min < 0:
+            for e in merged:
+                e["ts"] = round(float(e.get("ts", 0.0)) - t_min, 3)
+    merged.sort(key=lambda e: float(e.get("ts", 0.0)))
+
+    meta = [{"name": "process_name", "ph": "M", "pid": r,
+             "args": {"name": ("coordinator" if r < 0
+                               else f"rank {r}")}}
+            for r in sorted(ranks_seen)]
+    doc = {"traceEvents": meta + merged, "displayTimeUnit": "ms",
+           "lgbtpu": {"merged": True,
+                      "ranks": sorted(r for r in ranks_seen if r >= 0),
+                      "epochs": sorted(epochs),
+                      "sources": [os.path.basename(f["path"])
+                                  for f in files]}}
+    count_event("trace_merges")
+    if out_path:
+        tmp = out_path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(doc, fh)
+        os.replace(tmp, out_path)
+    return doc
+
+
+def _overlay_events(path: str, ref_ts: float,
+                    ref_wall: float) -> List[Dict[str, Any]]:
+    """Journal JSONL rows -> instant events on the emitting rank's
+    track.  Journal rows carry wall time, which maps onto the merged
+    timeline through the reference anchor (wall -> ref clock); rows
+    without a rank (the cluster parent's journal) land on a
+    ``coordinator`` track at ``pid = -1``."""
+    from .events import read_journal
+    out: List[Dict[str, Any]] = []
+    for rec in read_journal(path):
+        try:
+            wall = float(rec["unix_time"])
+        except (KeyError, TypeError, ValueError):
+            continue
+        rank = rec.get("rank")
+        pid = int(rank) if isinstance(rank, int) and rank >= 0 else -1
+        args = {"severity": rec.get("severity")}
+        if rec.get("round") is not None:
+            args["round"] = rec["round"]
+        payload = rec.get("payload")
+        if isinstance(payload, dict):
+            args.update(payload)
+        out.append({"name": str(rec.get("event")), "ph": "i",
+                    "ts": round((wall - ref_wall) * 1e6 + ref_ts, 3),
+                    "pid": pid, "tid": 0, "s": "t", "args": args})
+    return out

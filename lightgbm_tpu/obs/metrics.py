@@ -90,6 +90,13 @@ COUNTERS: Dict[str, str] = {
         "predict_raw device blocks padded to the geometric bucket ladder",
     "predict_bucket_pad_rows":
         "padding rows added by predict_raw bucketing (predict_bucketing=on)",
+    "event_journal_records":
+        "structured events appended to the event journal (obs/events.py)",
+    "trace_merges":
+        "cross-rank trace merges performed (obs/merge.py)",
+    "collective_probe_runs":
+        "collective-overlap probe measurements compiled+timed "
+        "(obs/collective.py)",
 }
 
 

@@ -48,10 +48,32 @@ class TraceRecorder:
         self._t0 = time.perf_counter()
         self.pid = os.getpid()
         self.export_path = export_path
+        #: sidecar metadata the cross-rank merge (obs/merge.py) reads:
+        #: rank/epoch tags plus the barrier-release clock anchor.
+        #: Exported under a top-level ``lgbtpu`` key, which Perfetto
+        #: ignores — the file stays a plain Chrome trace.
+        self.meta: Dict[str, Any] = {"wall_t0": time.time()}
 
     def now_us(self) -> float:
         """Microseconds since this recorder started (trace ``ts`` unit)."""
         return (time.perf_counter() - self._t0) * 1e6
+
+    def set_meta(self, **kw: Any) -> None:
+        """Attach merge metadata (``rank=``, ``epoch=``, ...)."""
+        with self._lock:
+            self.meta.update(kw)
+
+    def mark_anchor(self) -> None:
+        """Record the clock-alignment anchor: call this the instant the
+        distributed startup barrier releases (``jax.distributed.
+        initialize`` returning), which every rank observes at the same
+        wall moment.  The merge shifts each rank's monotonic timeline so
+        these anchors coincide, cancelling per-rank wall-clock skew."""
+        anchor_ts = self.now_us()
+        with self._lock:
+            self.meta["anchor_wall"] = time.time()
+            self.meta["anchor_ts_us"] = round(anchor_ts, 3)
+        self.add_instant("barrier_release")
 
     def add_complete(self, name: str, ts_us: float, dur_us: float,
                      args: Optional[Dict[str, Any]] = None) -> None:
@@ -91,7 +113,11 @@ class TraceRecorder:
         ]
         with self._lock:
             events = list(self._events)
-        return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+            side = dict(self.meta)
+        # the `lgbtpu` key is ours, not Chrome's — trace viewers ignore
+        # unknown top-level keys, obs/merge.py reads the clock anchors
+        return {"traceEvents": meta + events, "displayTimeUnit": "ms",
+                "lgbtpu": side}
 
     def export(self, path: str) -> None:
         """Write the Chrome trace JSON (Perfetto-loadable) to ``path``."""

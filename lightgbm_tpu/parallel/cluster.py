@@ -39,6 +39,7 @@ from typing import Any, Dict, Optional, Sequence
 
 import numpy as np
 
+from ..obs.events import emit_event
 from ..obs.metrics import count_event
 from ..utils import log
 
@@ -165,6 +166,16 @@ def launch(params: Dict[str, Any], data, label=None, *,
     import time as _time
 
     from ..basic import Booster
+    from ..obs import events as obs_events
+
+    # the parent owns the run-level observability artifacts: its journal
+    # (at the configured event_output) carries the coordinator's view —
+    # heartbeat suspicion/death, evictions, reshapes, resumes — while
+    # each worker writes its own per-rank journal/trace next to the
+    # configured paths (see _write_specs); after a successful run the
+    # per-rank traces are merged back into the configured trace_output
+    trace_base = str(params.get("trace_output", "") or "")
+    event_base = str(params.get("event_output", "") or "")
 
     timeout_s = _resolve_timeout(params, timeout_s)
     elastic_on = str(params.get("elastic", "off") or "off") \
@@ -178,7 +189,8 @@ def launch(params: Dict[str, Any], data, label=None, *,
     if machines:
         host_entries = [e.strip() for e in machines.split(",")
                         if e.strip()]
-    with tempfile.TemporaryDirectory(prefix="lgbtpu_cluster_") as tmp:
+    with obs_events.session(event_base), \
+            tempfile.TemporaryDirectory(prefix="lgbtpu_cluster_") as tmp:
         X = y = None
         if isinstance(data, (str, os.PathLike)):
             if label is not None or weight is not None or group is not None:
@@ -217,6 +229,7 @@ def launch(params: Dict[str, Any], data, label=None, *,
                     attempt, hb=dict(hb_cfg, dir=tmp, epoch=epoch)
                     if elastic_on else None)
                 if outcome == "ok":
+                    _merge_cluster_outputs(trace_base, event_base)
                     with open(spec_dicts[0]["out_path"]) as fh:
                         return Booster(model_str=fh.read())
                 if outcome == "runtime":
@@ -248,6 +261,13 @@ def launch(params: Dict[str, Any], data, label=None, *,
             count_event("elastic_reshapes", 1)
             count_event("elastic_resumes", 1)
             has_snap = snapshot_path and os.path.exists(snapshot_path)
+            emit_event("worker_evicted", ranks=sorted(bad), epoch=epoch,
+                       detail=detail.splitlines()[0])
+            emit_event("mesh_reshape", epoch=epoch, mesh_from=n_live,
+                       mesh_to=n_live - len(bad))
+            emit_event("training_resumed", epoch=epoch + 1,
+                       mesh=n_live - len(bad),
+                       from_snapshot=bool(has_snap))
             log.warning(
                 "elastic: evicting worker(s) %s (%s); reshaping %d->%d "
                 "workers and relaunching from %s"
@@ -262,6 +282,33 @@ def launch(params: Dict[str, Any], data, label=None, *,
             epoch += 1
 
 
+def _merge_cluster_outputs(trace_base: str, event_base: str) -> None:
+    """Join the workers' per-rank traces into ONE rank-aligned timeline
+    at the configured ``trace_output`` path, overlaying every journal
+    (the parent's coordinator view + each rank's own) as instant
+    events.  A merge failure degrades to a warning — the per-rank files
+    survive for manual inspection either way."""
+    if not trace_base:
+        return
+    from ..obs.merge import find_rank_files, merge_rank_traces
+    paths = find_rank_files(trace_base)
+    if not paths:
+        return
+    events_paths = []
+    if event_base:
+        if os.path.exists(event_base):
+            events_paths.append(event_base)
+        events_paths.extend(find_rank_files(event_base))
+    try:
+        merge_rank_traces(paths, out_path=trace_base,
+                          events_paths=events_paths)
+        log.info(f"merged {len(paths)} per-rank trace(s) into "
+                 f"{trace_base!r}")
+    except (OSError, ValueError) as e:
+        log.warning(f"cluster trace merge into {trace_base!r} failed "
+                    f"({type(e).__name__}: {e}); per-rank traces kept")
+
+
 def _write_specs(tmp: str, params: Dict[str, Any], data, X, y, weight,
                  group, n_workers: int, epoch: int, worker_map: list,
                  num_boost_round: int, devices_per_worker: int,
@@ -271,6 +318,7 @@ def _write_specs(tmp: str, params: Dict[str, Any], data, X, y, weight,
     re-stripes the rows over the CURRENT worker count — the reshape half
     of elastic recovery — and threads the heartbeat/snapshot/fault
     plumbing into the worker specs."""
+    from ..obs.merge import rank_file_path
     coordinator = worker_map[0]
     shards = None
     if X is not None:
@@ -283,13 +331,24 @@ def _write_specs(tmp: str, params: Dict[str, Any], data, X, y, weight,
     specs = []        # per-rank spec file paths (worker argv)
     spec_dicts = []   # the same specs, kept in memory for the parent
     for rank in range(n_workers):
+        # every worker is its own process with its own clock, so the
+        # user's observability outputs become a per-(epoch, rank)
+        # namespace NEXT TO the configured path (obs/merge.py naming) —
+        # the parent merges traces back into the configured path and
+        # overlays the journals after a successful run
+        worker_params = {k: v for k, v in params.items()}
+        for key in ("trace_output", "telemetry_output", "event_output"):
+            base = str(params.get(key, "") or "")
+            if base:
+                worker_params[key] = rank_file_path(base, epoch, rank)
         spec: Dict[str, Any] = {
             "rank": rank, "num_machines": n_workers,
             "machines": ",".join(worker_map),
             "coordinator": coordinator,
-            "params": {k: v for k, v in params.items()},
+            "params": worker_params,
             "num_boost_round": int(num_boost_round),
             "devices_per_worker": int(devices_per_worker),
+            "epoch": int(epoch),
             "out_path": os.path.join(tmp, "model.txt"),
             "ready_path": os.path.join(tmp, f"ready_e{epoch}_{rank}"),
         }
@@ -415,6 +474,12 @@ def _run_attempt(spec_paths, specs, tmp: str, timeout_s: float,
                     ready = os.path.exists(ready_paths[rank])
                     startup_failure = not ready
                     bad_ranks = [rank]
+                    if hb is not None and ready:
+                        # a post-barrier process death is the hard form
+                        # of heartbeat silence — journal it as the same
+                        # lifecycle event the timeout path emits
+                        emit_event("heartbeat_dead", rank=rank,
+                                   reason="process_exit", exit_code=rc)
                     fail = ("worker %d exited %d %s the startup barrier; "
                             "log tail:\n%s"
                             % (rank, rc,
@@ -447,6 +512,10 @@ def _run_attempt(spec_paths, specs, tmp: str, timeout_s: float,
                         logs[r].flush()
                         startup_failure = False
                         bad_ranks = [r]
+                        emit_event("heartbeat_dead", rank=r, round_idx=rd,
+                                   reason="heartbeat_timeout",
+                                   age_s=round(age, 3),
+                                   timeout_s=hb["timeout"])
                         fail = ("worker %d heartbeat silent for %.1fs "
                                 "(timeout %.1fs) at round %d while peers "
                                 "reached round %d; log tail:\n%s"
@@ -456,6 +525,9 @@ def _run_attempt(spec_paths, specs, tmp: str, timeout_s: float,
                     if age >= hb["interval"] and (r, lead) not in hb_warned:
                         hb_warned.add((r, lead))
                         count_event("elastic_slow_worker_rounds", 1)
+                        emit_event("heartbeat_suspect", rank=r,
+                                   round_idx=rd, age_s=round(age, 3),
+                                   timeout_s=hb["timeout"])
                         log.warning(
                             "elastic: worker %d slow (last heartbeat "
                             "%.1fs ago at round %d; peers at round %d, "
@@ -517,10 +589,28 @@ def _worker_main(spec_path: str) -> None:
     with open(spec_path) as fh:
         spec = json.load(fh)
     from . import launcher
+    from ..obs import events as obs_events, trace as obs_trace
+
+    rank, epoch = int(spec["rank"]), int(spec.get("epoch", 0))
+    wp = spec.get("params", {})
+    trace_path = str(wp.get("trace_output", "") or "")
+    event_path = str(wp.get("event_output", "") or "")
+    tele_path = str(wp.get("telemetry_output", "") or "")
+    # the recorder starts BEFORE the barrier so initialize time is on the
+    # timeline; mark_anchor() right after the barrier releases is what
+    # lets the parent's merge put every rank on one clock
+    recorder = obs_trace.start(trace_path) if trace_path else None
+    if recorder is not None:
+        recorder.set_meta(rank=rank, epoch=epoch)
+    journal = obs_events.start(event_path, rank=rank) \
+        if event_path else None
 
     launcher.initialize(machines=spec["machines"],
                         num_machines=spec["num_machines"],
                         rank=spec["rank"])
+    if recorder is not None:
+        recorder.mark_anchor()
+    obs_events.emit_event("barrier_release", rank=rank, epoch=epoch)
     rp = spec.get("ready_path")
     if rp:
         # startup-barrier marker: the parent's liveness monitor uses it to
@@ -538,6 +628,30 @@ def _worker_main(spec_path: str) -> None:
             kwargs["group"] = z["g"]
     else:
         data = spec["data_path"]
+
+    def obs_round(it: int) -> None:
+        # incremental per-round observability: a worker killed mid-run
+        # (fault drill / real preemption) leaves its trace + telemetry
+        # readable up to the last COMPLETED round — the merge and the
+        # run report are built from exactly these partials
+        if tele_path:
+            import time as _time
+
+            from ..obs.metrics import global_metrics
+            rec = {"rank": rank, "epoch": epoch, "iteration": it,
+                   "unix_time": round(_time.time(), 3),
+                   "counters": global_metrics.snapshot()["counters"]}
+            try:
+                with open(tele_path, "a") as fh:
+                    fh.write(json.dumps(rec) + "\n")
+            except OSError:
+                pass
+        if recorder is not None:
+            try:
+                recorder.export(trace_path)
+            except OSError:
+                pass
+
     hb_dir = spec.get("hb_dir")
     if hb_dir:
         # elastic plumbing: per-round heartbeat publishing (+ scripted
@@ -546,10 +660,10 @@ def _worker_main(spec_path: str) -> None:
         import time as _time
 
         from ..robustness.elastic import publish_heartbeat
-        rank, epoch = int(spec["rank"]), int(spec.get("epoch", 0))
         fault = spec.get("fault")
 
         def on_round(it: int) -> None:
+            obs_round(it)
             if fault:
                 kind = fault.get("kind")
                 at = int(fault.get("at_round", 0))
@@ -573,9 +687,21 @@ def _worker_main(spec_path: str) -> None:
             if epoch > 0 and os.path.exists(snap):
                 with open(snap) as fh:
                     kwargs["init_model_text"] = fh.read()
-    booster = launcher.train_multihost(
-        spec["params"], data, num_boost_round=spec["num_boost_round"],
-        **kwargs)
+    elif trace_path or tele_path:
+        kwargs["on_round"] = obs_round
+    try:
+        booster = launcher.train_multihost(
+            spec["params"], data, num_boost_round=spec["num_boost_round"],
+            **kwargs)
+    finally:
+        obs_events.stop(journal)
+        if recorder is not None:
+            try:
+                obs_trace.stop(recorder, export_path=trace_path)
+            except OSError as e:
+                obs_trace.stop(recorder)
+                log.warning(f"trace export to {trace_path!r} failed "
+                            f"({type(e).__name__}: {e})")
     if spec["rank"] == 0:
         with open(spec["out_path"], "w") as fh:
             fh.write(booster.model_to_string())

@@ -258,10 +258,13 @@ def load_latest_checkpoint(directory: str) -> Optional[CheckpointState]:
     partial checkpoints are skipped with a warning, never an error — a
     corrupt newest checkpoint falls back to the previous valid one."""
     from ..obs import count_event
+    from ..obs.events import emit_event
     for it, path in checkpoint_dirs(directory):
         ok, reason = validate_checkpoint(path)
         if not ok:
             count_event("checkpoints_skipped_invalid")
+            emit_event("checkpoint_corrupt_skipped", path=path,
+                       reason=reason)
             log.warning(f"skipping invalid checkpoint {path}: {reason}")
             continue
         try:
@@ -282,6 +285,8 @@ def load_latest_checkpoint(directory: str) -> Optional[CheckpointState]:
                             valid_scores[name] = np.asarray(z[key])
         except (OSError, json.JSONDecodeError, ValueError, KeyError) as e:
             count_event("checkpoints_skipped_invalid")
+            emit_event("checkpoint_corrupt_skipped", path=path,
+                       reason=str(e))
             log.warning(f"skipping unreadable checkpoint {path}: {e}")
             continue
         return CheckpointState(
@@ -371,6 +376,8 @@ class CheckpointManager:
                             "continues without this checkpoint")
             return None
         g._count("checkpoints_written")
+        from ..obs.events import emit_event
+        emit_event("checkpoint_written", round_idx=it, path=path)
         self._prune()
         return path
 

@@ -54,6 +54,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from ..obs.events import emit_event
 from ..obs.metrics import count_event
 from ..utils import log
 from .faults import FaultSpec
@@ -216,6 +217,10 @@ class HeartbeatMonitor:
             self._warned.add(key)
             self.slow_rounds += 1
             count_event("elastic_slow_worker_rounds", 1, self.metrics)
+            emit_event("heartbeat_suspect", rank=r,
+                       round_idx=report.round_idx,
+                       age_s=round(report.ages[r], 3),
+                       timeout_s=self.timeout_s)
             log.warning(
                 f"elastic: worker {r} slow at round {report.round_idx} "
                 f"(last heartbeat {report.ages[r]:.2f}s ago, timeout "
@@ -260,6 +265,11 @@ class HeartbeatMonitor:
             report = self.classify(expect_round,
                                    now=time.time() + self.timeout_s)
         if report.dead:
+            for r in report.dead:
+                emit_event("heartbeat_dead", rank=r,
+                           round_idx=expect_round,
+                           age_s=round(report.ages.get(r, -1.0), 3),
+                           timeout_s=self.timeout_s)
             raise WorkerEvicted(report.dead, expect_round,
                                 time.time() - t_enter)
         return report
@@ -325,6 +335,12 @@ class ElasticSession:
         self.interval_s = float(cfg.heartbeat_interval_s)
         self.timeout_s = float(cfg.heartbeat_timeout_s)
         self.elastic_on = str(cfg.elastic) == "on"
+        # the SESSION owns the observability artifacts, not the inner
+        # train() runs: one trace/journal must span every epoch, or the
+        # eviction/reshape/resume events emitted BETWEEN epochs would be
+        # dropped and each epoch's export would overwrite the last
+        self.trace_output = str(getattr(cfg, "trace_output", "") or "")
+        self.event_output = str(getattr(cfg, "event_output", "") or "")
         self.X, self.y = X, y
         self.num_boost_round = int(num_boost_round)
         self.n_workers = int(n_workers)
@@ -387,8 +403,33 @@ class ElasticSession:
         """Run to ``num_boost_round`` rounds, reshaping through as many
         evictions as the fault plan (or real silence) produces.  Returns
         the final Booster; ``self.report`` holds the drill telemetry."""
+        from ..obs import events as obs_events, trace as obs_trace
+        from ..utils.paths import check_output_path
+        trace_path = self.trace_output
+        if trace_path and obs_trace.active() is None and \
+                not check_output_path(trace_path, key="trace_output"):
+            trace_path = ""
+        event_path = self.event_output
+        if event_path and obs_events.active() is None and \
+                not check_output_path(event_path, key="event_output"):
+            event_path = ""
+        recorder = obs_trace.start(trace_path) if trace_path else None
+        journal = obs_events.start(event_path) if event_path else None
+        try:
+            return self._train_epochs()
+        finally:
+            obs_events.stop(journal)
+            try:
+                obs_trace.stop(recorder, export_path=trace_path or None)
+            except OSError as e:
+                obs_trace.stop(recorder)
+                log.warning(f"trace export to {trace_path!r} failed "
+                            f"({type(e).__name__}: {e}); trace discarded")
+
+    def _train_epochs(self):
         from ..basic import Dataset
         from ..engine import train as _train
+        from ..obs import trace as obs_trace
         from ..parallel.mesh import device_window
 
         live = list(range(self.n_workers))
@@ -402,7 +443,11 @@ class ElasticSession:
             self.report.epochs.append(
                 {"epoch": epoch, "mesh": len(live), "ranks": list(live)})
             try:
-                with device_window(len(live)):
+                # each epoch is a nested scope on the merged timeline:
+                # the reshape boundary shows as a span break
+                with obs_trace.span("elastic_epoch", epoch=epoch,
+                                    mesh=len(live)), \
+                        device_window(len(live)):
                     ds = Dataset(self.X, label=self.y)
                     booster = _train(dict(self.params), ds,
                                      num_boost_round=self.num_boost_round,
@@ -423,6 +468,14 @@ class ElasticSession:
                 count_event("elastic_evictions", len(ev.ranks))
                 count_event("elastic_reshapes", 1)
                 count_event("elastic_resumes", 1)
+                emit_event("worker_evicted", round_idx=ev.round_idx,
+                           ranks=list(ev.ranks), epoch=epoch,
+                           detect_s=round(ev.detect_s, 3))
+                emit_event("mesh_reshape", round_idx=ev.round_idx,
+                           epoch=epoch, mesh_from=len(live),
+                           mesh_to=len(survivors))
+                emit_event("training_resumed", round_idx=ev.round_idx,
+                           epoch=epoch + 1, mesh=len(survivors))
                 self.report.evictions.append(
                     {"ranks": ev.ranks, "round": ev.round_idx,
                      "detect_s": round(ev.detect_s, 3), "epoch": epoch})

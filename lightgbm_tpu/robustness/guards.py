@@ -63,6 +63,8 @@ def enforce_nan_policy(gb, grad, hess) -> bool:
         return False
     it = gb.iter_
     gb._count("nan_guard_trips")
+    from ..obs.events import emit_event
+    emit_event("nan_policy_trip", round_idx=it, policy=policy)
     if policy == "raise":
         gb._count("nan_guard_raises")
         raise LightGBMError(

@@ -55,6 +55,9 @@ class ModelRegistry:
             self._entries[name] = entry
         if replacing:
             count_event("serve_hot_swaps", 1, self.metrics)
+            from ..obs.events import emit_event
+            emit_event("serve_hot_swap", model=name,
+                       version=int(version))
         return entry
 
     def get(self, name: str) -> ModelEntry:

@@ -12,18 +12,25 @@ zero-lowerings gate do.
 
 from __future__ import annotations
 
+import collections
 import json
 import threading
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 
 from ..config import Config
+from ..obs.events import emit_event
 from ..obs.metrics import MetricsRegistry, count_event
 from .buckets import BucketLadder
 from .predictor import CompiledPredictor
 from .registry import ModelEntry, ModelRegistry
+
+#: rolling latency window entry cap — bounds snapshot memory under
+#: sustained load; 4096 completions cover the percentile window at any
+#: realistic request rate
+_WINDOW_MAX = 4096
 
 
 class ServerOverloaded(Exception):
@@ -44,7 +51,16 @@ class PredictionServer:
             else ModelRegistry(metrics=self.metrics)
         self.max_inflight = int(cfg.serving_max_inflight)
         self._inflight = 0
+        #: requests that have entered predict() but not yet resolved
+        #: admission (accepted or rejected) — the library-level analogue
+        #: of a queue depth; admission is fast so this gauge spikes only
+        #: under contention on the admission lock itself
+        self._pending = 0
         self._inflight_lock = threading.Lock()
+        #: rolling completion window for the live metrics snapshot:
+        #: (wall time, latency_s, rows) per served request
+        self._window: collections.deque = collections.deque(
+            maxlen=_WINDOW_MAX)
         self._tele_path = str(cfg.serving_telemetry_output or "")
         self._tele_lock = threading.Lock()
         self._tele_file = None
@@ -103,19 +119,35 @@ class PredictionServer:
         answer nobody reads).  Rejections are counted on
         ``serve_rejected_requests`` / ``serve_deadline_exceeded``."""
         t_admit = time.perf_counter()
-        if deadline_ms is not None and float(deadline_ms) <= 0:
-            count_event("serve_deadline_exceeded", 1, self.metrics)
-            count_event("serve_rejected_requests", 1, self.metrics)
-            raise ServerOverloaded(
-                f"request deadline_ms={deadline_ms} already exceeded at "
-                "admission")
         with self._inflight_lock:
-            if self._inflight >= self.max_inflight:
+            self._pending += 1
+            self.metrics.set_gauge("serve_queue_depth", self._pending)
+        try:
+            if deadline_ms is not None and float(deadline_ms) <= 0:
+                count_event("serve_deadline_exceeded", 1, self.metrics)
                 count_event("serve_rejected_requests", 1, self.metrics)
+                emit_event("serve_overload_rejected", model=name,
+                           reason="deadline_at_admission",
+                           deadline_ms=float(deadline_ms))
                 raise ServerOverloaded(
-                    f"{self._inflight} requests in flight >= "
-                    f"serving_max_inflight={self.max_inflight}")
-            self._inflight += 1
+                    f"request deadline_ms={deadline_ms} already exceeded "
+                    "at admission")
+            with self._inflight_lock:
+                if self._inflight >= self.max_inflight:
+                    count_event("serve_rejected_requests", 1, self.metrics)
+                    emit_event("serve_overload_rejected", model=name,
+                               reason="inflight_bound",
+                               inflight=self._inflight,
+                               max_inflight=self.max_inflight)
+                    raise ServerOverloaded(
+                        f"{self._inflight} requests in flight >= "
+                        f"serving_max_inflight={self.max_inflight}")
+                self._inflight += 1
+                self.metrics.set_gauge("serve_inflight", self._inflight)
+        finally:
+            with self._inflight_lock:
+                self._pending -= 1
+                self.metrics.set_gauge("serve_queue_depth", self._pending)
         try:
             entry = self.registry.get(name)
             t0 = time.perf_counter()
@@ -124,6 +156,9 @@ class PredictionServer:
                 # budget burned while waiting on admission bookkeeping
                 count_event("serve_deadline_exceeded", 1, self.metrics)
                 count_event("serve_rejected_requests", 1, self.metrics)
+                emit_event("serve_overload_rejected", model=name,
+                           reason="deadline_before_predict",
+                           deadline_ms=float(deadline_ms))
                 raise ServerOverloaded(
                     f"request deadline_ms={deadline_ms} expired before "
                     "predict start")
@@ -132,12 +167,15 @@ class PredictionServer:
         finally:
             with self._inflight_lock:
                 self._inflight -= 1
+                self.metrics.set_gauge("serve_inflight", self._inflight)
         count_event("serve_requests", 1, self.metrics)
         count_event("serve_rows", stats.rows, self.metrics)
         if stats.pad_rows:
             count_event("serve_pad_waste_rows", stats.pad_rows, self.metrics)
         if stats.warm_chunks:
             count_event("serve_bucket_hits", stats.warm_chunks, self.metrics)
+        with self._inflight_lock:
+            self._window.append((time.time(), latency_s, stats.rows))
         self._emit(entry, stats, latency_s, raw_score)
         return out
 
@@ -151,13 +189,16 @@ class PredictionServer:
               raw_score: bool) -> None:
         if not self._tele_path:
             return
+        with self._inflight_lock:
+            inflight, pending = self._inflight, self._pending
         rec = {"ts": time.time(), "model": entry.name,
                "version": entry.version, "rows": stats.rows,
                "buckets": [b for b, _ in stats.chunks],
                "pad_rows": stats.pad_rows,
                "warm_chunks": stats.warm_chunks,
                "fallback": stats.fallback,
-               "latency_s": latency_s, "raw_score": raw_score}
+               "latency_s": latency_s, "raw_score": raw_score,
+               "inflight": inflight, "queue_depth": pending}
         line = json.dumps(rec) + "\n"
         with self._tele_lock:
             if self._tele_file is None:
@@ -176,6 +217,102 @@ class PredictionServer:
                 "buckets": list(self.ladder.sizes),
                 "counters": {k: v for k, v in snap.items()
                              if k.startswith("serve_")}}
+
+    # ------------------------------------------------------ live snapshot
+    def metrics_snapshot(self, window_s: float = 60.0) -> Dict[str, Any]:
+        """Live aggregate view over the last ``window_s`` seconds:
+        latency percentiles (p50/p95/p99 ms), throughput (requests/s,
+        rows/s), admission gauges (``serve_inflight`` /
+        ``serve_queue_depth``), serve counters and per-model live
+        versions — the JSON shape ``prometheus_text`` renders."""
+        now = time.time()
+        cutoff = now - float(window_s)
+        with self._inflight_lock:
+            while self._window and self._window[0][0] < cutoff:
+                self._window.popleft()
+            samples = list(self._window)
+            inflight, pending = self._inflight, self._pending
+        latencies = sorted(s[1] for s in samples)
+        rows = sum(s[2] for s in samples)
+        if samples:
+            # normalize rates over the OBSERVED span (not the window
+            # length) so a fresh server isn't under-reported
+            span = max(now - samples[0][0], 1e-9)
+            # cap: a single just-landed sample would otherwise divide
+            # by ~0 and report an absurd rate
+            span = max(span, min(float(window_s), 1.0))
+        else:
+            span = float(window_s)
+
+        def _pct(q: float) -> Optional[float]:
+            if not latencies:
+                return None
+            idx = min(len(latencies) - 1,
+                      max(0, int(round(q * (len(latencies) - 1)))))
+            return round(latencies[idx] * 1000.0, 4)
+
+        counters = self.metrics.snapshot()["counters"]
+        return {
+            "window_s": float(window_s),
+            "requests_in_window": len(samples),
+            "latency_ms": {"p50": _pct(0.50), "p95": _pct(0.95),
+                           "p99": _pct(0.99)},
+            "requests_per_s": round(len(samples) / span, 4),
+            "rows_per_s": round(rows / span, 4),
+            "inflight": inflight,
+            "queue_depth": pending,
+            "max_inflight": self.max_inflight,
+            "models": self.registry.info(),
+            "counters": {k: v for k, v in counters.items()
+                         if k.startswith("serve_")},
+        }
+
+    def prometheus_text(self, window_s: float = 60.0) -> str:
+        """The snapshot as Prometheus text exposition (version 0.0.4):
+        counters as ``counter``, gauges/percentiles as ``gauge``, model
+        versions as a labeled gauge — scrape-ready for a caller's
+        ``/metrics`` endpoint."""
+        snap = self.metrics_snapshot(window_s=window_s)
+        lines: List[str] = []
+
+        def _gauge(name: str, value, help_text: str,
+                   labels: str = "") -> None:
+            lines.append(f"# HELP lgbtpu_{name} {help_text}")
+            lines.append(f"# TYPE lgbtpu_{name} gauge")
+            val = "NaN" if value is None else repr(float(value))
+            lines.append(f"lgbtpu_{name}{labels} {val}")
+
+        for q, label in (("p50", "0.5"), ("p95", "0.95"), ("p99", "0.99")):
+            v = snap["latency_ms"][q]
+            lines.append(f"# HELP lgbtpu_serve_latency_ms request latency "
+                         f"{q} over the rolling window")
+            lines.append("# TYPE lgbtpu_serve_latency_ms gauge")
+            lines.append('lgbtpu_serve_latency_ms{quantile="%s"} %s'
+                         % (label, "NaN" if v is None else repr(float(v))))
+        _gauge("serve_requests_per_s", snap["requests_per_s"],
+               "requests completed per second over the rolling window")
+        _gauge("serve_rows_per_s", snap["rows_per_s"],
+               "real rows served per second over the rolling window")
+        _gauge("serve_inflight", snap["inflight"],
+               "requests currently executing")
+        _gauge("serve_queue_depth", snap["queue_depth"],
+               "requests awaiting an admission decision")
+        _gauge("serve_max_inflight", snap["max_inflight"],
+               "configured admission bound (serving_max_inflight)")
+        for name, val in sorted(snap["counters"].items()):
+            lines.append(f"# HELP lgbtpu_{name} serving counter "
+                         "(obs/metrics.py)")
+            lines.append(f"# TYPE lgbtpu_{name} counter")
+            lines.append(f"lgbtpu_{name} {repr(float(val))}")
+        for info in sorted(snap["models"],
+                           key=lambda m: str(m.get("name"))):
+            lines.append("# HELP lgbtpu_serve_model_version live "
+                         "published version per model")
+            lines.append("# TYPE lgbtpu_serve_model_version gauge")
+            lines.append('lgbtpu_serve_model_version{model="%s"} %s'
+                         % (info.get("name"),
+                            repr(float(info.get("version", 0)))))
+        return "\n".join(lines) + "\n"
 
     def close(self) -> None:
         with self._tele_lock:

@@ -1,0 +1,10 @@
+"""Toy EVENTS registry backing the OBS302 single-file fixtures.
+
+Only the declaration matters — tpulint reads the keys via ``ast``,
+mirroring the real ``lightgbm_tpu/obs/events.py`` schema registry.
+"""
+
+EVENTS = {
+    "declared_event": ("info", "an event the fixtures are allowed to "
+                               "journal"),
+}

@@ -1,0 +1,9 @@
+"""OBS302: journals an event under a name the obs/events.py EVENTS
+registry never declared — readers of the journal cannot rely on its
+schema."""
+
+from lightgbm_tpu.obs.events import emit_event
+
+
+def notify(rank):
+    emit_event("undeclared_event", rank=rank)

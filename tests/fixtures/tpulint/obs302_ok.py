@@ -1,0 +1,8 @@
+"""OBS302-clean: every journaled event name is declared in the
+obs/events.py EVENTS registry."""
+
+from lightgbm_tpu.obs.events import emit_event
+
+
+def notify(rank):
+    emit_event("declared_event", rank=rank)

@@ -1,4 +1,6 @@
-"""Reads an unregistered key and bumps an undeclared counter."""
+"""Reads an unregistered key, bumps an undeclared counter and journals
+an undeclared event."""
+from .obs.events import emit_event
 from .obs.metrics import count_event
 
 
@@ -8,4 +10,5 @@ def build(params, config):
     lvl = config.stale_doc_key
     depth = config.undocumented_key
     count_event("undeclared_counter")           # OBS301
+    emit_event("undeclared_event")              # OBS302
     return n + mystery + lvl + depth

@@ -1,0 +1,3 @@
+EVENTS = {
+    "never_emitted": ("warning", "declared, but no site journals it"),
+}

@@ -1,4 +1,6 @@
-"""Reads every registered key; bumps the one declared counter."""
+"""Reads every registered key; bumps the one declared counter and
+journals the one declared event."""
+from .obs.events import emit_event
 from .obs.metrics import count_event
 
 
@@ -6,4 +8,5 @@ def build(params, config):
     n = params.get("num_widgets", 8)
     rate = config.gadget_rate
     count_event("widgets_built", n)
+    emit_event("widget_built", count=n)
     return n * rate

@@ -1,0 +1,3 @@
+EVENTS = {
+    "widget_built": ("info", "core.build() finished a widget batch"),
+}

@@ -61,6 +61,7 @@ RULE_FIXTURES = [
     ("TPU106", "parallel/tpu106_bad.py", "parallel/tpu106_ok.py"),
     ("GRW401", "learner/grw401_bad.py", "learner/grw401_ok.py"),
     ("RBS501", "rbs501_bad.py", "rbs501_ok.py"),
+    ("OBS302", "obs302_bad.py", "obs302_ok.py"),
 ]
 
 
@@ -156,6 +157,10 @@ def test_contract_rules_fire_on_bad_project():
     msgs = " / ".join(v.message for v in by_rule["OBS301"])
     assert len(by_rule["OBS301"]) == 2
     assert "undeclared_counter" in msgs and "never_bumped" in msgs
+    # OBS302: journaled-undeclared + declared-never-emitted
+    msgs = " / ".join(v.message for v in by_rule["OBS302"])
+    assert len(by_rule["OBS302"]) == 2
+    assert "undeclared_event" in msgs and "never_emitted" in msgs
 
 
 def test_contract_rules_quiet_on_ok_project():

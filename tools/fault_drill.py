@@ -105,13 +105,16 @@ def _verify_checkpoints(workdir: str) -> Dict[str, Any]:
 
 
 def _run(X, y, rounds, workers, workdir, faults, **over):
+    from lightgbm_tpu.obs.events import journal_tail
     from lightgbm_tpu.robustness.elastic import (model_core,
                                                  run_elastic_training)
-    params = dict(BASE_PARAMS, **over)
+    ev_path = os.path.join(workdir, "events.jsonl")
+    params = dict(BASE_PARAMS, event_output=ev_path, **over)
     booster, rep = run_elastic_training(
         params, X, y, num_boost_round=rounds, n_workers=workers,
         workdir=workdir, faults=faults)
-    return model_core(booster.model_to_string()), rep
+    return (model_core(booster.model_to_string()), rep,
+            journal_tail(ev_path))
 
 
 def scenario_kill(X, y, rounds, workers, corrupt_newest=False):
@@ -134,25 +137,33 @@ def scenario_kill(X, y, rounds, workers, corrupt_newest=False):
             return _cb
     with tempfile.TemporaryDirectory() as td:
         faults = [kill_worker(workers - 2, at_round=kill_at)]
+        from lightgbm_tpu.obs.events import journal_tail
         from lightgbm_tpu.robustness.elastic import (ElasticSession,
                                                      model_core)
         cbs = [_corruptor(td)] if corrupt_newest else None
-        session = ElasticSession(dict(BASE_PARAMS), X, y,
-                                 num_boost_round=rounds,
+        ev_path = os.path.join(td, "events.jsonl")
+        session = ElasticSession(dict(BASE_PARAMS, event_output=ev_path),
+                                 X, y, num_boost_round=rounds,
                                  n_workers=workers, workdir=td,
                                  faults=faults, callbacks=cbs)
         booster = session.train()
         core = model_core(booster.model_to_string())
         rep = session.report.to_dict()
         ckpt = _verify_checkpoints(td)
+        tail = journal_tail(ev_path)
     ref_reduced = _ref_model(X, y, rounds, workers - 1)
     ref_serial = _ref_model(X, y, rounds, 1)
+    journaled = {e.get("event") for e in tail}
     checks = {
         "evicted": len(rep["evictions"]) == 1,
         "reshaped": rep["final_mesh"] == workers - 1,
         "resumed": rep["resumes"] >= 1,
         "bit_identical_reduced_mesh": core == ref_reduced,
         "bit_identical_serial": core == ref_serial,
+        # the structured journal must narrate the same recovery the
+        # elastic report claims (obs/events.py)
+        "journal_narrates_recovery": {"worker_evicted", "mesh_reshape",
+                                      "training_resumed"} <= journaled,
         # on the corrupt drill the newest checkpoint is broken BY DESIGN;
         # what matters is that recovery still landed bit-exact off the
         # older one — so the chain check is only asserted when clean
@@ -162,14 +173,14 @@ def scenario_kill(X, y, rounds, workers, corrupt_newest=False):
     return {"name": "corrupt" if corrupt_newest else "kill",
             "kill_at_round": kill_at, "checks": checks,
             "checkpoints": ckpt, "elastic_report": rep,
-            "passed": all(checks.values())}
+            "journal_tail": tail, "passed": all(checks.values())}
 
 
 def scenario_stall(X, y, rounds, workers):
     from lightgbm_tpu.robustness.faults import stall_worker
     with tempfile.TemporaryDirectory() as td:
-        core, rep = _run(X, y, rounds, workers, td,
-                         [stall_worker(1, seconds=0.5, at_round=2)])
+        core, rep, tail = _run(X, y, rounds, workers, td,
+                               [stall_worker(1, seconds=0.5, at_round=2)])
     ref_full = _ref_model(X, y, rounds, workers)
     checks = {
         "warned_not_evicted": rep["slow_rounds"] >= 1,
@@ -177,27 +188,28 @@ def scenario_stall(X, y, rounds, workers):
         "bit_identical_full_mesh": core == ref_full,
     }
     return {"name": "stall", "checks": checks, "elastic_report": rep,
-            "passed": all(checks.values())}
+            "journal_tail": tail, "passed": all(checks.values())}
 
 
 def scenario_drop(X, y, rounds, workers):
     from lightgbm_tpu.robustness.faults import drop_heartbeats
     with tempfile.TemporaryDirectory() as td:
-        core, rep = _run(X, y, rounds, workers, td,
-                         [drop_heartbeats(workers - 1, at_round=2)])
+        core, rep, tail = _run(X, y, rounds, workers, td,
+                               [drop_heartbeats(workers - 1, at_round=2)])
     ref_reduced = _ref_model(X, y, rounds, workers - 1)
     checks = {
         "evicted": len(rep["evictions"]) == 1,
         "bit_identical_reduced_mesh": core == ref_reduced,
     }
     return {"name": "drop", "checks": checks, "elastic_report": rep,
-            "passed": all(checks.values())}
+            "journal_tail": tail, "passed": all(checks.values())}
 
 
 def scenario_fail_fast(X, y, rounds, workers):
     from lightgbm_tpu.robustness.faults import kill_worker
     from lightgbm_tpu.utils.log import LightGBMError
     failed_fast, detail = False, ""
+    tail: List[Dict[str, Any]] = []
     try:
         with tempfile.TemporaryDirectory() as td:
             _run(X, y, rounds, workers, td,
@@ -208,7 +220,7 @@ def scenario_fail_fast(X, y, rounds, workers):
     checks = {"failed_fast": failed_fast,
               "no_recovery_attempted": "elastic=on" in detail}
     return {"name": "fail_fast", "detail": detail, "checks": checks,
-            "passed": all(checks.values())}
+            "journal_tail": tail, "passed": all(checks.values())}
 
 
 def run_drill(quick: bool, rounds: int, workers: int) -> Dict[str, Any]:
@@ -234,6 +246,10 @@ def _render(payload: Dict[str, Any]) -> str:
         checks = " ".join(f"{k}={'ok' if v else 'FAIL'}"
                           for k, v in s["checks"].items())
         lines.append(f"  {s['name']:<10} {verdict}  {checks}")
+        tail = s.get("journal_tail") or []
+        if tail:
+            seq = " -> ".join(e.get("event", "?") for e in tail[-8:])
+            lines.append(f"             journal: {seq}")
     lines.append("drill: " + ("PASS" if payload["passed"] else "FAIL"))
     return "\n".join(lines)
 

@@ -1,0 +1,212 @@
+#!/usr/bin/env python
+"""One pane of glass over a training run's observability artifacts.
+
+    python tools/run_report.py [--trace T.json] [--events E.jsonl]
+                               [--telemetry TEL.jsonl]
+                               [--quick] [--format text|json]
+
+Joins the three artifact families one run can emit — the Chrome trace
+(``trace_output``), the structured event journal (``event_output``,
+obs/events.py) and the telemetry JSONL (``telemetry_output``) — into a
+single report: top phases, the event timeline, the final counter
+snapshot with the compile-cache and collective-overlap columns pulled
+out.  Any subset of the artifacts may be given; at least one must be.
+
+``--quick`` is the CI gate mode: it only validates that every provided
+artifact parses and carries its expected schema (trace has span
+events, journal has records, telemetry has rows) and reports findings
+without the full join.
+
+Exit codes (tools/_report.py convention): 0 — every provided artifact
+is present and non-degenerate, 1 — findings (an artifact parsed but is
+empty/spanless), 2 — an artifact is unreadable or not its format (or
+no artifact was given at all).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from _report import (EXIT_ERROR, EXIT_FINDINGS, EXIT_OK,  # noqa: E402
+                     add_format_arg, emit)
+import trace_report  # noqa: E402
+
+#: final-snapshot counters surfaced as the "compile" join column
+_COMPILE_COUNTERS = (
+    "round_compile_hits", "round_compile_misses",
+    "fused_runner_cache_hits", "fused_runner_cache_misses",
+    "xla_compile_events", "xla_program_lowerings",
+    "serve_compile_hits", "serve_compile_misses",
+)
+
+#: final-snapshot gauges surfaced as the "collective" join column
+_COLLECTIVE_GAUGES = (
+    "collective_s_per_pass", "collective_s_blocked",
+    "collective_s_per_round", "overlap_efficiency", "overlap_on",
+)
+
+
+def load_telemetry(path: str) -> List[Dict[str, Any]]:
+    """Telemetry JSONL rows (one per round); torn lines are skipped."""
+    rows: List[Dict[str, Any]] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(row, dict):
+                rows.append(row)
+    return rows
+
+
+def telemetry_stats(rows: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Final-state summary of the per-round telemetry stream."""
+    counters: Dict[str, Any] = {}
+    gauges: Dict[str, Any] = {}
+    iters = []
+    for row in rows:
+        if isinstance(row.get("counters"), dict):
+            counters = row["counters"]
+        if isinstance(row.get("gauges"), dict):
+            gauges = row["gauges"]
+        it = row.get("iteration")
+        if isinstance(it, (int, float)):
+            iters.append(int(it))
+    return {
+        "rows": len(rows),
+        "first_round": min(iters) if iters else None,
+        "last_round": max(iters) if iters else None,
+        "counters": counters,
+        "gauges": gauges,
+        "compile": {k: counters[k] for k in _COMPILE_COUNTERS
+                    if k in counters},
+        "collective": {k: gauges[k] for k in _COLLECTIVE_GAUGES
+                       if k in gauges},
+    }
+
+
+def build_report(trace_doc: Optional[Dict[str, Any]],
+                 events: Optional[List[Dict[str, Any]]],
+                 telemetry: Optional[List[Dict[str, Any]]],
+                 paths: Dict[str, str],
+                 quick: bool = False) -> Dict[str, Any]:
+    payload: Dict[str, Any] = {"tool": "run_report", "quick": quick,
+                               "sources": paths}
+    findings: List[str] = []
+    if trace_doc is not None:
+        phases = trace_report.phase_stats(trace_doc)
+        if not phases:
+            findings.append("trace has no complete (ph=X) span events")
+        if quick:
+            payload["trace"] = {"span_kinds": len(phases)}
+        else:
+            tr = trace_report.build_report(trace_doc,
+                                           trace=paths.get("trace", ""))
+            tr.pop("tool", None)
+            payload["trace"] = tr
+    if events is not None:
+        if not events:
+            findings.append("event journal holds no records")
+        stats = trace_report.event_stats(events)
+        if not quick:
+            stats["timeline"] = [
+                {"event": r.get("event"), "rank": r.get("rank"),
+                 "round": r.get("round"),
+                 "severity": r.get("severity")} for r in events]
+        payload["events"] = stats
+    if telemetry is not None:
+        if not telemetry:
+            findings.append("telemetry stream holds no rows")
+        payload["telemetry"] = telemetry_stats(telemetry) if not quick \
+            else {"rows": len(telemetry)}
+    payload["findings"] = findings
+    return payload
+
+
+def _render_report(payload: Dict[str, Any]) -> str:
+    lines = []
+    for f in payload["findings"]:
+        lines.append(f"FINDING: {f}")
+    tr = payload.get("trace")
+    if tr and "phases" in tr:
+        sub = dict(tr)
+        sub.setdefault("tool", "trace_report")
+        lines.append(trace_report._render_report(sub))
+    elif tr is not None:
+        lines.append(f"trace: {tr.get('span_kinds', 0)} span kind(s)")
+    ev = payload.get("events")
+    if ev is not None:
+        lines.append("")
+        lines.append(f"event journal: {ev['count']} record(s)")
+        for name in sorted(ev.get("by_name", {})):
+            lines.append(f"  {name}: {ev['by_name'][name]}")
+    tel = payload.get("telemetry")
+    if tel is not None:
+        lines.append("")
+        lines.append(f"telemetry: {tel['rows']} row(s)")
+        if tel.get("last_round") is not None:
+            lines.append(f"  rounds {tel['first_round']}"
+                         f"..{tel['last_round']}")
+        for section in ("compile", "collective"):
+            vals = tel.get(section) or {}
+            if vals:
+                lines.append(f"  {section}:")
+                for k in sorted(vals):
+                    lines.append(f"    {k}: {vals[k]}")
+    if not payload["findings"]:
+        lines.append("")
+        lines.append("run artifacts healthy")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--trace", default=None,
+                    help="Chrome trace JSON (trace_output=...)")
+    ap.add_argument("--events", default=None,
+                    help="event-journal JSONL (event_output=...)")
+    ap.add_argument("--telemetry", default=None,
+                    help="telemetry JSONL (telemetry_output=...)")
+    ap.add_argument("--quick", action="store_true",
+                    help="schema-validation gate only (CI mode)")
+    add_format_arg(ap)
+    args = ap.parse_args(argv)
+    if not (args.trace or args.events or args.telemetry):
+        print("run_report: no artifacts given — pass at least one of "
+              "--trace/--events/--telemetry", file=sys.stderr)
+        return EXIT_ERROR
+    paths = {}
+    try:
+        trace_doc = None
+        if args.trace:
+            trace_doc = trace_report.load_trace(args.trace)
+            paths["trace"] = args.trace
+        events = None
+        if args.events:
+            events = trace_report.load_events(args.events)
+            paths["events"] = args.events
+        telemetry = None
+        if args.telemetry:
+            telemetry = load_telemetry(args.telemetry)
+            paths["telemetry"] = args.telemetry
+    except (OSError, ValueError) as e:
+        print(f"run_report: {e}", file=sys.stderr)
+        return EXIT_ERROR
+    payload = build_report(trace_doc, events, telemetry, paths,
+                           quick=args.quick)
+    emit(payload, args.format, _render_report)
+    return EXIT_FINDINGS if payload["findings"] else EXIT_OK
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,7 +1,8 @@
 #!/usr/bin/env python
 """Summarize a Chrome trace JSON produced by ``trace_output=<path>``.
 
-    python tools/trace_report.py TRACE.json [--top N] [--format text|json]
+    python tools/trace_report.py TRACE.json [--top N] [--events E.jsonl]
+                                            [--format text|json]
 
 Prints the top phases by total time (total / count / avg / max), the
 span-tree depth, and — when the trace carries ``memory`` counter events
@@ -10,9 +11,16 @@ marks.  The numbers here are host wall-clock spans (dispatch + any host
 sync); use a ``profile_dir`` jax.profiler capture for device-side kernel
 attribution.
 
+Merged multi-rank traces (obs/merge.py — the coordinator writes one
+when per-rank cluster traces exist) carry an ``lgbtpu`` metadata block;
+the report then adds the rank/epoch inventory and a per-rank span
+breakdown.  ``--events journal.jsonl`` overlays the structured event
+journal (obs/events.py): event counts by name/severity and the
+error-severity timeline.
+
 Exit codes (tools/_report.py convention): 0 — trace has span events,
 1 — parseable but empty trace (no ``ph: X`` events), 2 — unreadable or
-not a Chrome trace.
+not a Chrome trace (or an unreadable --events file).
 """
 
 from __future__ import annotations
@@ -21,7 +29,7 @@ import argparse
 import json
 import os
 import sys
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
@@ -68,6 +76,58 @@ def phase_stats(doc: Dict[str, Any]) -> List[Dict[str, Any]]:
     return rows
 
 
+def load_events(path: str) -> List[Dict[str, Any]]:
+    """Journal rows from an obs/events.py JSONL file.  Torn trailing
+    lines (a writer killed mid-append) are skipped, matching
+    ``events.read_journal``; this stays stdlib-only so the report tools
+    never import the package."""
+    rows: List[Dict[str, Any]] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(row, dict) and "event" in row:
+                rows.append(row)
+    return rows
+
+
+def event_stats(rows: List[Dict[str, Any]]) -> Dict[str, Any]:
+    by_name: Dict[str, int] = {}
+    by_severity: Dict[str, int] = {}
+    errors: List[Dict[str, Any]] = []
+    for row in rows:
+        name = str(row.get("event"))
+        sev = str(row.get("severity", "info"))
+        by_name[name] = by_name.get(name, 0) + 1
+        by_severity[sev] = by_severity.get(sev, 0) + 1
+        if sev == "error":
+            errors.append({"event": name, "rank": row.get("rank"),
+                           "round": row.get("round"),
+                           "unix_time": row.get("unix_time")})
+    return {"count": len(rows), "by_name": by_name,
+            "by_severity": by_severity, "errors": errors}
+
+
+def rank_stats(doc: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Per-rank span totals of a merged multi-rank trace (pid == rank;
+    pid -1 is the coordinator's journal overlay)."""
+    agg: Dict[int, Dict[str, float]] = {}
+    for ev in doc["traceEvents"]:
+        if ev.get("ph") != "X" or "pid" not in ev:
+            continue
+        s = agg.setdefault(int(ev["pid"]), {"total_us": 0.0, "count": 0})
+        s["total_us"] += float(ev.get("dur", 0.0))
+        s["count"] += 1
+    return [{"rank": rank, "span_total_s": s["total_us"] / 1e6,
+             "span_count": int(s["count"])}
+            for rank, s in sorted(agg.items())]
+
+
 def memory_high_water(doc: Dict[str, Any]) -> Dict[str, float]:
     """Max of each ``memory`` counter-track series (``ph: C``)."""
     high: Dict[str, float] = {}
@@ -81,15 +141,26 @@ def memory_high_water(doc: Dict[str, Any]) -> Dict[str, float]:
 
 
 def build_report(doc: Dict[str, Any], trace: str = "",
-                 top: int = 15) -> Dict[str, Any]:
+                 top: int = 15,
+                 events: Optional[List[Dict[str, Any]]] = None
+                 ) -> Dict[str, Any]:
     """The full report payload (all phases — ``top`` only trims text)."""
-    return {
+    payload = {
         "tool": "trace_report",
         "trace": trace,
         "phases": phase_stats(doc),
         "memory_high_water": memory_high_water(doc),
         "top": top,
     }
+    side = doc.get("lgbtpu")
+    if isinstance(side, dict) and side.get("merged"):
+        payload["merged"] = {"ranks": side.get("ranks", []),
+                             "epochs": side.get("epochs", []),
+                             "sources": side.get("sources", [])}
+        payload["per_rank"] = rank_stats(doc)
+    if events is not None:
+        payload["events"] = event_stats(events)
+    return payload
 
 
 def _render_report(payload: Dict[str, Any]) -> str:
@@ -118,6 +189,26 @@ def _render_report(payload: Dict[str, Any]) -> str:
             unit = " MB" if k.endswith("_mb") else \
                 (" bytes" if "bytes" in k else "")
             lines.append(f"  {k}: {v:,.2f}{unit}")
+    merged = payload.get("merged")
+    if merged:
+        lines.append("")
+        lines.append(f"merged multi-rank trace: ranks {merged['ranks']}, "
+                     f"elastic epochs {merged['epochs']}")
+        for r in payload.get("per_rank", []):
+            who = "coordinator" if r["rank"] < 0 else f"rank {r['rank']}"
+            lines.append(f"  {who}: {r['span_count']} spans, "
+                         f"{r['span_total_s']:.3f}s total")
+    ev = payload.get("events")
+    if ev is not None:
+        lines.append("")
+        lines.append(f"event journal: {ev['count']} record(s)")
+        for name in sorted(ev["by_name"]):
+            lines.append(f"  {name}: {ev['by_name'][name]}")
+        if ev["errors"]:
+            lines.append("  error-severity timeline:")
+            for e in ev["errors"]:
+                lines.append(f"    {e['event']} (rank {e['rank']}, "
+                             f"round {e['round']})")
     return "\n".join(lines)
 
 
@@ -131,14 +222,19 @@ def main(argv=None) -> int:
     ap.add_argument("trace", help="Chrome trace JSON (trace_output=...)")
     ap.add_argument("--top", type=int, default=15,
                     help="phases to show (default 15)")
+    ap.add_argument("--events", default=None, metavar="JOURNAL",
+                    help="overlay an event-journal JSONL "
+                         "(event_output=...)")
     add_format_arg(ap)
     args = ap.parse_args(argv)
     try:
         doc = load_trace(args.trace)
+        events = load_events(args.events) if args.events else None
     except (OSError, ValueError) as e:
         print(f"trace_report: {e}", file=sys.stderr)
         return EXIT_ERROR
-    payload = build_report(doc, trace=args.trace, top=args.top)
+    payload = build_report(doc, trace=args.trace, top=args.top,
+                           events=events)
     emit(payload, args.format, _render_report)
     return EXIT_OK if payload["phases"] else EXIT_FINDINGS
 
