@@ -635,3 +635,99 @@ class UndeclaredEvent(Rule):
                     events_rel, lineno, 0,
                     f"declared journal event `{name}` is never emitted "
                     "anywhere — remove it or wire the emission site")
+
+
+# ------------------------------------------------------------------ SLOs
+def load_declared_slos(slo_path: str) -> Dict[str, int]:
+    """``SLOS`` declaration in obs/slo.py: name -> lineno (same pure-
+    literal AST contract as COUNTERS/EVENTS)."""
+    with open(slo_path, encoding="utf-8") as fh:
+        tree = ast.parse(fh.read(), filename=slo_path)
+    for node in tree.body:
+        target = None
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            target = node.targets[0].id
+        elif isinstance(node, ast.AnnAssign) and \
+                isinstance(node.target, ast.Name):
+            target = node.target.id
+        if target == "SLOS" and isinstance(node.value, ast.Dict):
+            out = {}
+            for k in node.value.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    out[k.value] = k.lineno
+            return out
+    return {}
+
+
+@register_rule
+class UndeclaredSlo(Rule):
+    id = "OBS303"
+    name = "undeclared-slo"
+    severity = SEVERITY_ERROR
+    description = ("an SLO watched via `watch_slo` under a name not "
+                   "declared in obs/slo.py `SLOS` (or declared but never "
+                   "watched)")
+
+    def __init__(self, slo_path: Optional[str] = None):
+        self._slo_path = slo_path
+
+    @staticmethod
+    def _collect_uses(run: LintRun) -> List[Tuple[str, int, int, str]]:
+        """(relpath, line, col, name) per watch_slo call — gathered per
+        run, same runner-reuse discipline as OBS301/OBS302."""
+        uses: List[Tuple[str, int, int, str]] = []
+        for ctx in run.contexts:
+            rel = ctx.relpath.replace("\\", "/")
+            if rel.endswith("obs/slo.py"):
+                continue
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                first = node.args[0]
+                if not (isinstance(first, ast.Constant)
+                        and isinstance(first.value, str)):
+                    continue
+                is_watch = (isinstance(node.func, ast.Name)
+                            and node.func.id == "watch_slo") or \
+                           (isinstance(node.func, ast.Attribute)
+                            and node.func.attr == "watch_slo")
+                if is_watch:
+                    uses.append((ctx.relpath, node.lineno,
+                                 node.col_offset, first.value))
+        return uses
+
+    def finalize(self, run: LintRun) -> Iterable[Violation]:
+        path = self._slo_path or os.path.join(
+            run.root, "lightgbm_tpu", "obs", "slo.py")
+        try:
+            declared = load_declared_slos(path)
+        except (OSError, SyntaxError):
+            return
+        slo_rel = os.path.relpath(path, run.root)
+        if not declared:
+            yield self.violation(
+                slo_rel, 1, 0,
+                "no SLOS declaration found in obs/slo.py — every SLO "
+                "name must be declared there once")
+            return
+        used_names = set()
+        for relpath, line, col, name in self._collect_uses(run):
+            used_names.add(name)
+            if name not in declared:
+                yield self.violation(
+                    relpath, line, col,
+                    f"SLO `{name}` is not declared in obs/slo.py SLOS — "
+                    "declare it (domain + direction + default budget + "
+                    "one-line meaning) so operators can rely on the "
+                    "alert vocabulary")
+        # the reverse direction ("declared but never watched") is only
+        # decidable on a whole-package run, like OBS301/OBS302
+        if not run.covers(os.path.dirname(os.path.dirname(path))):
+            return
+        for name, lineno in declared.items():
+            if name not in used_names:
+                yield self.violation(
+                    slo_rel, lineno, 0,
+                    f"declared SLO `{name}` is never watched anywhere — "
+                    "remove it or wire a watch_slo site that can feed it")

@@ -717,6 +717,17 @@ class Booster:
         return {"counters": {}, "gauges": {}, "phases": {},
                 "memory": obs_memory.memory_snapshot()}
 
+    def prometheus_text(self) -> str:
+        """Training-side Prometheus text exposition (obs/prom.py):
+        telemetry counters/gauges plus watchtower rollup gauges and SLO
+        state when a watchtower is attached — same format as
+        ``PredictionServer.prometheus_text`` so training and serving
+        share one scrape pipeline."""
+        if self._gbdt is not None:
+            return self._gbdt.prometheus_text()
+        from .obs import prom
+        return prom.training_text({}, {})
+
     # ---------------------------------------------------------- evaluation
     def eval_train(self):
         out = self._gbdt.eval_train()

@@ -169,6 +169,9 @@ _PARAMS: List[Tuple[str, Any, Tuple[str, ...], Tuple[Tuple[str, float], ...]]] =
     ("telemetry_output", "", ("telemetry_file",), ()),            # per-iteration telemetry JSONL path
     ("event_output", "", ("event_file", "event_journal"), ()),    # structured event-journal JSONL path (obs/events.py declared schema; lifecycle events: heartbeat/eviction/reshape/resume, checkpoint write/resume/corrupt-skip, nan_policy trips, serving hot-swap/overload)
     ("profile_dir", "", ("profiler_dir",), ()),                   # jax.profiler trace directory (device timeline)
+    ("slo_config", "", ("slos",), ()),                            # declarative SLO watching (obs/slo.py SLOS table): ""/off = disabled; "on" = every declared SLO at default budget; or "name[:budget],name2" to pick/override (e.g. "serving_p99_ms:25,compile_miss_storm"); breaches emit slo_breach/slo_recovered journal events with multi-window burn-rate logic
+    ("rollup_window_s", 60.0, ("rollup_window",), ((">", 0.0),)), # time-series rollup window length in seconds (obs/timeseries.py ring; feeds SLO evaluation and tools/obs_top.py)
+    ("anomaly_detection", "off", (), ()),                         # baseline-relative training-loop anomaly detection: on|off (obs/anomaly.py; robust z on round time, eval divergence/plateau, compile-miss burst, host-RSS slope — journal events + counters, never hard failures)
     # --- robustness (robustness/; docs/ROBUSTNESS.md) ---
     ("checkpoint_dir", "", ("checkpoint_directory",), ()),        # periodic atomic training checkpoints under this directory; empty = off
     ("checkpoint_interval", 10, (), ((">", 0),)),                 # boosting rounds between checkpoints
@@ -483,6 +486,17 @@ class Config:
         self.elastic = str(self.elastic or "off").strip().lower()
         if self.elastic not in ("on", "off"):
             log.fatal(f"unknown elastic={self.elastic!r} (expected on/off)")
+        self.anomaly_detection = \
+            str(self.anomaly_detection or "off").strip().lower()
+        if self.anomaly_detection not in ("on", "off"):
+            log.fatal(f"unknown anomaly_detection="
+                      f"{self.anomaly_detection!r} (expected on/off)")
+        if str(self.slo_config or "").strip():
+            from .obs.slo import parse_slo_config
+            try:
+                parse_slo_config(self.slo_config)
+            except ValueError as e:
+                log.fatal(f"invalid slo_config={self.slo_config!r}: {e}")
         if float(self.heartbeat_timeout_s) < float(self.heartbeat_interval_s):
             log.fatal(
                 f"heartbeat_timeout_s={self.heartbeat_timeout_s} must be >= "

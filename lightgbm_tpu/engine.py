@@ -10,6 +10,7 @@ results, honor EarlyStopException, set ``best_iteration``/``best_score``.
 from __future__ import annotations
 
 import copy
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 
 import numpy as np
@@ -138,6 +139,9 @@ def train(params: Dict[str, Any], train_set: Dataset,
                 # retention and a later resume='auto' see only THIS run
                 fresh=resume_state is None)
             callbacks.append(mgr.callback())
+    tower = _build_watchtower(cfg, booster)
+    if tower is not None:
+        callbacks.append(_watchtower_callback(tower, booster))
     callbacks = sorted(callbacks, key=lambda cb: getattr(cb, "order", 0))
     if mgr is not None:
         # the manager snapshots peer-callback state (early-stopping
@@ -180,10 +184,102 @@ def train(params: Dict[str, Any], train_set: Dataset,
             obs_events.emit_event(
                 "checkpoint_resume", round_idx=start_round,
                 total_rounds=int(num_boost_round))
-        return _run_training(booster, params, train_set, rounds_to_run,
-                             valid_pairs, train_in_valid, feval, fobj,
-                             callbacks, cbs_before, cbs_after,
-                             start_round=start_round)
+        try:
+            return _run_training(booster, params, train_set, rounds_to_run,
+                                 valid_pairs, train_in_valid, feval, fobj,
+                                 callbacks, cbs_before, cbs_after,
+                                 start_round=start_round)
+        finally:
+            if tower is not None:
+                # flush the final partial rollup window and run the SLO
+                # evaluator over it while the journal is still active
+                tower.close()
+
+
+def _build_watchtower(cfg, booster):
+    """Build the training-side watchtower (obs/timeseries.py rollup ring
+    + obs/slo.py burn-rate evaluator + obs/anomaly.py detector) when
+    ``slo_config``/``anomaly_detection`` enables it; ``None`` — and zero
+    per-round work — otherwise.  Attached to the booster as
+    ``gb.watchtower`` so ``Booster.prometheus_text()`` can export rollup
+    gauges and SLO state."""
+    from .obs.slo import parse_slo_config
+    try:
+        enabled = parse_slo_config(cfg.slo_config)
+    except ValueError:
+        enabled = {}   # check_param_conflict already rejected bad specs
+    anomaly_on = str(cfg.anomaly_detection or "off").strip().lower() == "on"
+    if not enabled and not anomaly_on:
+        return None
+    from .obs.metrics import count_event
+    from .obs.slo import SloEvaluator, Watchtower
+    from .obs.timeseries import Rollup, default_rollup_path
+    gb = booster._gbdt
+    hook = lambda n, v=1: count_event(n, v, gb.metrics)
+    tele = str(cfg.telemetry_output or "")
+    rollup = Rollup(window_s=float(cfg.rollup_window_s),
+                    out_path=default_rollup_path(tele) if tele else None,
+                    count=hook)
+    evaluator = None
+    if enabled:
+        evaluator = SloEvaluator(enabled, emit=obs_events.emit_event,
+                                 count=hook)
+        # training-domain SLOs only; the serving pair is fed (and
+        # watched) by PredictionServer
+        evaluator.watch_slo("nan_guard_trip_rate")
+        evaluator.watch_slo("compile_miss_storm")
+        evaluator.watch_slo("overlap_efficiency_floor")
+        evaluator.watch_slo("heartbeat_staleness_s")
+    anomaly = None
+    if anomaly_on:
+        from .obs.anomaly import AnomalyDetector
+        anomaly = AnomalyDetector(emit=obs_events.emit_event, count=hook)
+    tower = Watchtower(rollup, slo=evaluator, anomaly=anomaly)
+    gb.watchtower = tower
+    return tower
+
+
+def _watchtower_callback(tower, booster):
+    """Per-round watchtower feed: round wall-time sample, cumulative
+    telemetry counters/gauges, eval metrics — then the anomaly checks
+    and the SLO evaluator over any windows that just closed.  Runs after
+    the eval callbacks (order 55) and is fused-safe: it only READS the
+    device-computed eval list, so watched runs keep the fused fast
+    path."""
+    from .obs import memory as obs_memory
+    gb = booster._gbdt
+    state = {"t_prev": time.perf_counter()}
+
+    def _callback(env: CallbackEnv) -> None:
+        now = time.perf_counter()
+        round_s = now - state["t_prev"]
+        state["t_prev"] = now
+        rollup = tower.rollup
+        rollup.observe_sample("round_s", round_s)
+        rollup.observe_gauge("iteration", float(env.iteration))
+        snap = gb.metrics.snapshot()
+        for name, val in snap["counters"].items():
+            rollup.observe_counter(name, val)
+        for name, val in snap["gauges"].items():
+            rollup.observe_gauge(name, val)
+        evals = {}
+        for item in env.evaluation_result_list or []:
+            key = f"{item[0]}.{item[1]}"
+            evals[key] = (float(item[2]), bool(item[3]))
+            rollup.observe_gauge("eval." + key, float(item[2]))
+        if tower.anomaly is not None:
+            counters = snap["counters"]
+            misses = counters.get("round_compile_misses", 0) \
+                + counters.get("fused_runner_cache_misses", 0)
+            tower.anomaly.observe_round(
+                env.iteration, round_s=round_s, evals=evals or None,
+                compile_misses=float(misses),
+                host_rss_mb=obs_memory.host_rss_mb())
+        tower.evaluate()
+
+    _callback.order = 55
+    _callback.fused_safe = True
+    return _callback
 
 
 def _run_training(booster, params, train_set, num_boost_round, valid_pairs,

@@ -1,0 +1,227 @@
+"""Baseline-relative anomaly detection for the training loop.
+
+Watches the per-round signals the loop already produces — round
+wall-time, eval metrics, compile-cache misses, host RSS — and flags
+departures from the run's OWN recent history (no absolute thresholds to
+mistune across hardware):
+
+  * **round_time_spike** — robust rolling z-score (median/MAD) on round
+    wall-time; a round ``z_threshold`` scaled-MADs above the trailing
+    median fires.
+  * **eval_divergence** — an eval metric moving in the wrong direction
+    for ``divergence_rounds`` consecutive rounds.
+  * **eval_plateau** — an eval metric whose relative range over the
+    last ``plateau_rounds`` rounds stays within ``plateau_tol`` (fires
+    once per metric; signal for early stopping / wasted compute).
+  * **compile_miss_burst** — new compile-cache misses after the warmup
+    rounds (steady-state training should lower nothing new).
+  * **rss_slope** — least-squares slope of host RSS over the window
+    exceeding ``rss_slope_mb`` MB/round (leak indicator).
+
+Findings are journal events (``anomaly_detected``) and counters
+(``anomalies_detected``) — never hard failures; the loop keeps running.
+Per-kind cooldown stops a sustained shift from flooding the journal.
+
+Contracts: stdlib-only, never imports jax; sinks injected like
+obs/slo.py so the file also loads standalone for tools/obs_top.py.
+Nothing is constructed unless ``anomaly_detection=on`` — the all-off
+default costs zero per-round work.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+
+def _median(vals: List[float]) -> float:
+    s = sorted(vals)
+    n = len(s)
+    mid = n // 2
+    if n % 2:
+        return s[mid]
+    return 0.5 * (s[mid - 1] + s[mid])
+
+
+def robust_z(value: float, history: List[float]) -> float:
+    """z-score of ``value`` against ``history`` using median/MAD.
+    MAD of a quiet (near-constant) history is floored at 5% of the
+    median so identical-timing rounds don't make any jitter infinite."""
+    med = _median(history)
+    mad = _median([abs(v - med) for v in history])
+    scale = max(1.4826 * mad, 0.05 * abs(med), 1e-6)
+    return (value - med) / scale
+
+
+class AnomalyDetector:
+    """Per-round detector; one instance per training run.
+
+    ``observe_round`` is the single entry point the loop calls with
+    whatever signals that round produced (all optional) and returns the
+    findings fired this round (each already journaled/counted through
+    the injected sinks)."""
+
+    def __init__(self, window: int = 32, min_history: int = 8,
+                 z_threshold: float = 4.0,
+                 divergence_rounds: int = 5,
+                 plateau_rounds: int = 20, plateau_tol: float = 1e-4,
+                 rss_slope_mb: float = 2.0,
+                 compile_warmup_rounds: int = 8,
+                 cooldown_rounds: Optional[int] = None,
+                 emit: Optional[Callable] = None,
+                 count: Optional[Callable] = None) -> None:
+        self.window = int(window)
+        self.min_history = int(min_history)
+        self.z_threshold = float(z_threshold)
+        self.divergence_rounds = int(divergence_rounds)
+        self.plateau_rounds = int(plateau_rounds)
+        self.plateau_tol = float(plateau_tol)
+        self.rss_slope_mb = float(rss_slope_mb)
+        self.compile_warmup_rounds = int(compile_warmup_rounds)
+        self.cooldown_rounds = self.window if cooldown_rounds is None \
+            else int(cooldown_rounds)
+        self._emit = emit
+        self._count_hook = count
+        self._round_s: deque = deque(maxlen=self.window)
+        self._rss: deque = deque(maxlen=self.window)
+        self._evals: Dict[str, deque] = {}
+        self._worse_streak: Dict[str, int] = {}
+        self._plateau_fired: Dict[str, bool] = {}
+        self._compile_prev: Optional[float] = None
+        self._rounds_seen = 0
+        self._last_fired: Dict[str, int] = {}
+        self.findings_total = 0
+
+    # ------------------------------------------------------------ intake
+    def observe_round(self, iteration: int,
+                      round_s: Optional[float] = None,
+                      evals: Optional[Dict[str, tuple]] = None,
+                      compile_misses: Optional[float] = None,
+                      host_rss_mb: Optional[float] = None
+                      ) -> List[Dict[str, Any]]:
+        """Feed one round.  ``evals`` maps series name -> (value,
+        higher_better).  Returns the findings fired this round."""
+        self._rounds_seen += 1
+        findings: List[Dict[str, Any]] = []
+        if round_s is not None:
+            findings.extend(self._check_round_time(iteration, float(round_s)))
+            self._round_s.append(float(round_s))
+        if evals:
+            for name, (value, higher_better) in evals.items():
+                findings.extend(self._check_eval(
+                    iteration, name, float(value), bool(higher_better)))
+        if compile_misses is not None:
+            findings.extend(
+                self._check_compile(iteration, float(compile_misses)))
+        if host_rss_mb is not None:
+            self._rss.append(float(host_rss_mb))
+            findings.extend(self._check_rss(iteration))
+        for f in findings:
+            self._fire(f)
+        return findings
+
+    # ------------------------------------------------------------ checks
+    def _cooled(self, kind: str, iteration: int) -> bool:
+        last = self._last_fired.get(kind)
+        return last is None or iteration - last >= self.cooldown_rounds
+
+    def _check_round_time(self, iteration: int,
+                          round_s: float) -> List[Dict[str, Any]]:
+        if len(self._round_s) < self.min_history:
+            return []
+        z = robust_z(round_s, list(self._round_s))
+        if z < self.z_threshold or not self._cooled("round_time_spike",
+                                                    iteration):
+            return []
+        return [{"kind": "round_time_spike", "round_idx": iteration,
+                 "value": round_s, "z": round(z, 2),
+                 "baseline": round(_median(list(self._round_s)), 6)}]
+
+    def _check_eval(self, iteration: int, name: str, value: float,
+                    higher_better: bool) -> List[Dict[str, Any]]:
+        hist = self._evals.setdefault(
+            name, deque(maxlen=max(self.window, self.plateau_rounds)))
+        out: List[Dict[str, Any]] = []
+        if hist:
+            prev = hist[-1]
+            worse = value < prev if higher_better else value > prev
+            streak = self._worse_streak.get(name, 0) + 1 if worse else 0
+            self._worse_streak[name] = streak
+            if streak >= self.divergence_rounds and \
+                    self._cooled(f"eval_divergence:{name}", iteration):
+                out.append({"kind": "eval_divergence",
+                            "round_idx": iteration, "metric": name,
+                            "value": value, "streak": streak})
+        hist.append(value)
+        if len(hist) >= self.plateau_rounds and \
+                not self._plateau_fired.get(name):
+            tail = list(hist)[-self.plateau_rounds:]
+            span = max(tail) - min(tail)
+            denom = max(abs(_median(tail)), 1e-12)
+            if span / denom <= self.plateau_tol:
+                self._plateau_fired[name] = True
+                out.append({"kind": "eval_plateau",
+                            "round_idx": iteration, "metric": name,
+                            "value": value,
+                            "rounds": self.plateau_rounds})
+        return out
+
+    def _check_compile(self, iteration: int,
+                       misses: float) -> List[Dict[str, Any]]:
+        prev, self._compile_prev = self._compile_prev, misses
+        if prev is None or self._rounds_seen <= self.compile_warmup_rounds:
+            return []
+        delta = misses - prev
+        if delta <= 0 or not self._cooled("compile_miss_burst", iteration):
+            return []
+        return [{"kind": "compile_miss_burst", "round_idx": iteration,
+                 "new_misses": delta, "total_misses": misses}]
+
+    def _check_rss(self, iteration: int) -> List[Dict[str, Any]]:
+        n = len(self._rss)
+        if n < self.min_history:
+            return []
+        ys = list(self._rss)
+        xbar = (n - 1) / 2.0
+        ybar = sum(ys) / n
+        num = sum((i - xbar) * (y - ybar) for i, y in enumerate(ys))
+        den = sum((i - xbar) ** 2 for i in range(n))
+        slope = num / den if den else 0.0
+        if slope <= self.rss_slope_mb or not self._cooled("rss_slope",
+                                                          iteration):
+            return []
+        return [{"kind": "rss_slope", "round_idx": iteration,
+                 "slope_mb_per_round": round(slope, 3),
+                 "rss_mb": round(ys[-1], 1)}]
+
+    # ------------------------------------------------------------- sinks
+    def _fire(self, finding: Dict[str, Any]) -> None:
+        kind = finding["kind"]
+        key = kind if kind != "eval_divergence" else \
+            f"{kind}:{finding['metric']}"
+        self._last_fired[key] = int(finding["round_idx"])
+        self.findings_total += 1
+        self._count("anomalies_detected")
+        self.emit_event("anomaly_detected", **finding)
+
+    def emit_event(self, name: str, **payload: Any) -> None:
+        """Journal sink; silently absent standalone (tools/obs_top.py)."""
+        sink = self._emit
+        if sink is None:
+            try:
+                from .events import emit_event as sink
+            except ImportError:
+                return
+        try:
+            sink(name, **payload)
+        except Exception:
+            self._emit = None
+
+    def _count(self, name: str, value: float = 1) -> None:
+        hook = self._count_hook
+        if hook is None:
+            return
+        try:
+            hook(name, value)
+        except Exception:
+            self._count_hook = None

@@ -80,6 +80,16 @@ EVENTS: Dict[str, Tuple[str, str]] = {
     "serve_overload_rejected": (
         "warning", "a serving request rejected by admission control "
                    "(in-flight bound or expired deadline)"),
+    "slo_breach": (
+        "error", "a declared SLO (obs/slo.py SLOS) went over budget for "
+                 "enough burn-rate windows to page"),
+    "slo_recovered": (
+        "info", "a breached SLO returned within budget for the required "
+                "consecutive windows"),
+    "anomaly_detected": (
+        "warning", "the training loop departed from its own recent "
+                   "baseline (obs/anomaly.py: round-time spike, eval "
+                   "divergence/plateau, compile-miss burst, RSS slope)"),
 }
 
 #: the process-wide active journal; ``None`` = journaling disabled (the

@@ -97,6 +97,15 @@ COUNTERS: Dict[str, str] = {
     "collective_probe_runs":
         "collective-overlap probe measurements compiled+timed "
         "(obs/collective.py)",
+    "rollup_windows_closed":
+        "time-series rollup windows finalized into the ring "
+        "(obs/timeseries.py)",
+    "slo_breaches":
+        "SLO burn-rate breach transitions emitted (obs/slo.py)",
+    "slo_recoveries":
+        "SLO recovery transitions after a breach (obs/slo.py)",
+    "anomalies_detected":
+        "baseline-relative training anomalies flagged (obs/anomaly.py)",
 }
 
 

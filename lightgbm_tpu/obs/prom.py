@@ -1,0 +1,106 @@
+"""Shared Prometheus text-exposition formatting (version 0.0.4).
+
+One formatter for every ``/metrics`` surface in the repo: the serving
+tier (``PredictionServer.prometheus_text``), the training side
+(``Booster.prometheus_text`` — telemetry counters + rollup gauges), and
+SLO state (``lgbtpu_slo_ok{name=...}``).  Training and serving speak
+one exposition format because they share these helpers, not because
+they duplicate the string templates.
+
+Stdlib-only, never imports jax — tools/obs_top.py loads this module's
+siblings standalone and the formatting must stay importable beside a
+live cluster.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional
+
+#: metric-name prefix shared by every exposition surface in the repo
+PREFIX = "lgbtpu_"
+
+_NAME_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def sanitize(name: str) -> str:
+    """Map an internal metric/gauge name (may contain dots, e.g.
+    ``eval.l2``) onto the Prometheus name charset."""
+    return _NAME_BAD.sub("_", str(name))
+
+
+def format_value(value: Any) -> str:
+    """Prometheus sample value: ``NaN`` for missing, ``repr(float)``
+    otherwise (full precision, matches the serving exposition)."""
+    return "NaN" if value is None else repr(float(value))
+
+
+def gauge_lines(name: str, value: Any, help_text: str,
+                labels: str = "") -> List[str]:
+    """HELP/TYPE/sample triple for one gauge."""
+    return [f"# HELP {PREFIX}{name} {help_text}",
+            f"# TYPE {PREFIX}{name} gauge",
+            f"{PREFIX}{name}{labels} {format_value(value)}"]
+
+
+def counter_lines(name: str, value: Any, help_text: str) -> List[str]:
+    """HELP/TYPE/sample triple for one (cumulative) counter."""
+    return [f"# HELP {PREFIX}{name} {help_text}",
+            f"# TYPE {PREFIX}{name} counter",
+            f"{PREFIX}{name} {format_value(value)}"]
+
+
+def slo_lines(slo_state: Dict[str, Dict[str, Any]]) -> List[str]:
+    """SLO compliance as labeled gauges: ``lgbtpu_slo_ok{name=...}``
+    (1 = within budget) plus the last observed value per SLO.  Input is
+    ``SloEvaluator.state()``; empty dict -> no lines."""
+    lines: List[str] = []
+    for name in sorted(slo_state):
+        st = slo_state[name]
+        lines.append('# HELP %sslo_ok declarative SLO compliance '
+                     '(obs/slo.py; 1 = within budget)' % PREFIX)
+        lines.append(f"# TYPE {PREFIX}slo_ok gauge")
+        lines.append('%sslo_ok{name="%s"} %s'
+                     % (PREFIX, name,
+                        format_value(1.0 if st.get("ok", True) else 0.0)))
+        lines.append('# HELP %sslo_value last observed value per SLO '
+                     '(budget in the slo_budget gauge)' % PREFIX)
+        lines.append(f"# TYPE {PREFIX}slo_value gauge")
+        lines.append('%sslo_value{name="%s"} %s'
+                     % (PREFIX, name, format_value(st.get("last_value"))))
+        lines.append('# HELP %sslo_budget configured budget per SLO'
+                     % PREFIX)
+        lines.append(f"# TYPE {PREFIX}slo_budget gauge")
+        lines.append('%sslo_budget{name="%s"} %s'
+                     % (PREFIX, name, format_value(st.get("budget"))))
+    return lines
+
+
+def render(lines: List[str]) -> str:
+    """Join exposition lines into the final scrape body."""
+    return "\n".join(lines) + "\n"
+
+
+def training_text(counters: Dict[str, Any],
+                  gauges: Optional[Dict[str, Any]] = None,
+                  rollup_gauges: Optional[Dict[str, Any]] = None,
+                  slo_state: Optional[Dict[str, Dict[str, Any]]] = None
+                  ) -> str:
+    """Training-side exposition: telemetry counters (cumulative), live
+    gauges, the watchtower's latest rollup gauges (prefixed
+    ``rollup_``), and SLO state.  ``Booster.prometheus_text`` feeds
+    this from ``telemetry()`` + the attached watchtower."""
+    lines: List[str] = []
+    for name, val in sorted((counters or {}).items()):
+        lines.extend(counter_lines(
+            sanitize(name), val, "training counter (obs/metrics.py)"))
+    for name, val in sorted((gauges or {}).items()):
+        lines.extend(gauge_lines(
+            sanitize(name), val, "training gauge (obs/metrics.py)"))
+    for name, val in sorted((rollup_gauges or {}).items()):
+        lines.extend(gauge_lines(
+            "rollup_" + sanitize(name), val,
+            "latest rollup-window gauge (obs/timeseries.py)"))
+    if slo_state:
+        lines.extend(slo_lines(slo_state))
+    return render(lines)

@@ -1,0 +1,368 @@
+"""Bounded in-memory ring of windowed telemetry rollups.
+
+PR 10's artifacts are snapshots and post-mortem joins; nothing could say
+"p99 has been over budget for 5 of the last 6 windows" while a run is
+live.  This module is the time axis those judgements need: observations
+(cumulative counters, gauges, latency samples, journal events) land in
+the CURRENT fixed-width wall-clock window; a window that ends is
+finalized into an immutable dict and pushed onto a bounded ring.  One
+``Rollup`` API serves training (``Booster.telemetry()`` counters,
+``round_s``, compile hits/misses, heartbeat state), serving
+(``PredictionServer`` latency/inflight/queue) and the event journal —
+the feeders at the bottom map each of the three existing JSONL row
+shapes onto it, so live processes and offline tailers (tools/obs_top.py)
+build the identical windows.
+
+Finalized window shape (everything JSON-serializable)::
+
+    {"t_start": ..., "t_end": ..., "window_s": ...,
+     "counters": {name: {"delta": d, "rate": d/window_s}},
+     "gauges":   {name: {"last": v, "min": v, "max": v, "n": k}},
+     "samples":  {name: {"count": k, "p50": v, "p95": v, "p99": v,
+                         "max": v}},
+     "events":   {name: count}}
+
+Contracts:
+  * **stdlib-only, never imports jax or numpy** — tools/obs_top.py loads
+    this file standalone (``importlib`` by path) beside a live cluster.
+  * **No threads.**  Rollups advance synchronously inside the
+    observation call; an idle rollup costs nothing.  Gap windows (no
+    observations for several widths) are synthesized empty so burn-rate
+    logic sees a contiguous window sequence.
+  * **Deterministic.**  Quantiles come from a bounded per-window sample
+    buffer decimated by stride doubling (never random reservoirs), so a
+    replay of the same rows yields bit-identical windows.
+  * Counters are CUMULATIVE values assumed to start at 0 within the
+    feeder's lifetime (the repo's registries guarantee this); per-window
+    deltas are clamped at 0 so a process restart cannot produce a
+    negative rate.
+
+Optional persistence: ``out_path`` appends each finalized window as one
+JSON line (``default_rollup_path`` names it next to
+``telemetry_output``), same degrade-to-warning-once contract as every
+other observability sink.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+#: per-window sample-buffer cap; past it the buffer is decimated (every
+#: 2nd kept) and the keep-stride doubles — bounded memory, deterministic
+_SAMPLES_MAX = 512
+
+#: quantiles a finalized window reports for each sample series
+_QUANTILES = (("p50", 0.50), ("p95", 0.95), ("p99", 0.99))
+
+
+def _quantile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank quantile over a sorted list (the serving snapshot's
+    convention, so a rollup p99 matches ``metrics_snapshot``'s)."""
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+class _Window:
+    """One accumulating window (mutable until finalized)."""
+
+    __slots__ = ("t_start", "t_end", "counter_delta", "gauges",
+                 "samples", "sample_strides", "sample_seen", "events")
+
+    def __init__(self, t_start: float, width: float) -> None:
+        self.t_start = t_start
+        self.t_end = t_start + width
+        self.counter_delta: Dict[str, float] = {}
+        self.gauges: Dict[str, List[float]] = {}   # [last, min, max, n]
+        self.samples: Dict[str, List[float]] = {}
+        self.sample_strides: Dict[str, int] = {}
+        self.sample_seen: Dict[str, int] = {}
+        self.events: Dict[str, int] = {}
+
+
+class Rollup:
+    """Fixed-width windowed rollups on a bounded ring.
+
+    ``window_s`` is the window width, ``max_windows`` bounds the ring of
+    finalized windows (oldest evicted first).  ``count`` is an optional
+    counter hook (obs/metrics.py ``count_event`` when running inside the
+    package; ``None`` standalone) bumped once per finalized window."""
+
+    def __init__(self, window_s: float = 60.0, max_windows: int = 240,
+                 out_path: Optional[str] = None,
+                 count: Optional[Callable] = None) -> None:
+        if float(window_s) <= 0:
+            raise ValueError(f"window_s must be > 0, got {window_s!r}")
+        self.window_s = float(window_s)
+        self.max_windows = int(max_windows)
+        self.out_path = str(out_path) if out_path else None
+        self._count_hook = count
+        self._completed: deque = deque(maxlen=self.max_windows)
+        self._cur: Optional[_Window] = None
+        self._counter_prev: Dict[str, float] = {}
+        self._out_file = None
+        self._out_failed = False
+
+    # ------------------------------------------------------- observations
+    def observe_counter(self, name: str, cumulative: float,
+                        t: Optional[float] = None) -> None:
+        """Feed a CUMULATIVE counter value; the window keeps the delta
+        vs the previously observed value (clamped at 0)."""
+        w = self._window_for(t)
+        prev = self._counter_prev.get(name, 0.0)
+        cumulative = float(cumulative)
+        delta = cumulative - prev
+        if delta > 0:
+            w.counter_delta[name] = w.counter_delta.get(name, 0.0) + delta
+        elif name not in w.counter_delta:
+            # a zero/negative delta still marks the counter as observed
+            # this window (an SLO needs "0 misses" distinct from "no
+            # data")
+            w.counter_delta[name] = 0.0
+        self._counter_prev[name] = cumulative
+
+    def observe_delta(self, name: str, increment: float = 1.0,
+                      t: Optional[float] = None) -> None:
+        """Feed a per-event increment directly (rows with no cumulative
+        counter, e.g. per-request serving JSONL)."""
+        w = self._window_for(t)
+        w.counter_delta[name] = w.counter_delta.get(name, 0.0) \
+            + float(increment)
+
+    def observe_gauge(self, name: str, value: float,
+                      t: Optional[float] = None) -> None:
+        w = self._window_for(t)
+        v = float(value)
+        g = w.gauges.get(name)
+        if g is None:
+            w.gauges[name] = [v, v, v, 1]
+        else:
+            g[0] = v
+            g[1] = min(g[1], v)
+            g[2] = max(g[2], v)
+            g[3] += 1
+
+    def observe_sample(self, name: str, value: float,
+                       t: Optional[float] = None) -> None:
+        """Feed one latency/duration sample into the window's bounded
+        quantile buffer."""
+        w = self._window_for(t)
+        buf = w.samples.setdefault(name, [])
+        seen = w.sample_seen.get(name, 0)
+        stride = w.sample_strides.get(name, 1)
+        w.sample_seen[name] = seen + 1
+        if seen % stride == 0:
+            buf.append(float(value))
+            if len(buf) >= _SAMPLES_MAX:
+                # deterministic decimation: keep every 2nd sample and
+                # double the keep-stride for the window's remainder
+                del buf[1::2]
+                w.sample_strides[name] = stride * 2
+
+    def observe_event(self, name: str, t: Optional[float] = None) -> None:
+        w = self._window_for(t)
+        w.events[name] = w.events.get(name, 0) + 1
+
+    # ------------------------------------------------------------ windows
+    def _window_for(self, t: Optional[float]) -> _Window:
+        now = time.time() if t is None else float(t)
+        if self._cur is None:
+            self._cur = _Window(now, self.window_s)
+            return self._cur
+        if now < self._cur.t_end:
+            return self._cur
+        # close the current window, then synthesize empty gap windows so
+        # downstream burn-rate counting sees a contiguous sequence; gaps
+        # beyond the ring size are skipped (they would evict anyway)
+        self._close(self._cur)
+        start = self._cur.t_end
+        gaps = int((now - start) // self.window_s)
+        n_synth = min(gaps, self.max_windows)
+        start += (gaps - n_synth) * self.window_s
+        for _ in range(n_synth):
+            gap = _Window(start, self.window_s)
+            self._close(gap)
+            start = gap.t_end
+        # start = t_end + gaps*window_s <= now < start + window_s
+        self._cur = _Window(start, self.window_s)
+        return self._cur
+
+    def _finalize(self, w: _Window) -> Dict[str, Any]:
+        counters = {name: {"delta": round(d, 9),
+                           "rate": round(d / self.window_s, 9)}
+                    for name, d in w.counter_delta.items()}
+        gauges = {name: {"last": g[0], "min": g[1], "max": g[2],
+                         "n": g[3]}
+                  for name, g in w.gauges.items()}
+        samples = {}
+        for name, buf in w.samples.items():
+            if not buf:
+                continue
+            vals = sorted(buf)
+            row = {"count": w.sample_seen.get(name, len(buf)),
+                   "max": vals[-1]}
+            for label, q in _QUANTILES:
+                row[label] = _quantile(vals, q)
+            samples[name] = row
+        return {"t_start": w.t_start, "t_end": w.t_end,
+                "window_s": self.window_s, "counters": counters,
+                "gauges": gauges, "samples": samples,
+                "events": dict(w.events)}
+
+    def _close(self, w: _Window) -> None:
+        fin = self._finalize(w)
+        self._completed.append(fin)
+        if self._count_hook is not None:
+            self._count("rollup_windows_closed")
+        self._persist(fin)
+
+    def _count(self, name: str, value: float = 1) -> None:
+        """Forward a counter bump to the injected hook (obs/metrics.py
+        ``count_event`` inside the package; no-op standalone)."""
+        try:
+            self._count_hook(name, value)
+        except Exception:      # a broken hook must never stop training
+            self._count_hook = None
+
+    def _persist(self, fin: Dict[str, Any]) -> None:
+        if not self.out_path or self._out_failed:
+            return
+        try:
+            if self._out_file is None:
+                self._out_file = open(self.out_path, "a")
+            self._out_file.write(json.dumps(fin) + "\n")
+            self._out_file.flush()
+        except OSError as e:
+            # rollup persistence must never take the host process down;
+            # degrade to a one-time stderr note (stdlib-only file: the
+            # package logger is not importable standalone)
+            self._out_failed = True
+            self._out_file = None
+            print(f"rollup: write to {self.out_path!r} failed "
+                  f"({type(e).__name__}: {e}); persistence disabled",
+                  file=sys.stderr)
+
+    # ------------------------------------------------------------ queries
+    def completed(self) -> List[Dict[str, Any]]:
+        """Finalized windows, oldest..newest."""
+        return list(self._completed)
+
+    def current(self) -> Optional[Dict[str, Any]]:
+        """The in-progress window in finalized shape (``None`` before
+        the first observation)."""
+        return None if self._cur is None else self._finalize(self._cur)
+
+    def flush(self) -> None:
+        """Force-close the current window (end of run / ``--once``
+        renders); the next observation opens a fresh one."""
+        if self._cur is None:
+            return
+        self._close(self._cur)
+        self._cur = None
+
+    def latest_gauges(self) -> Dict[str, float]:
+        """Most recent ``last`` value per gauge across the ring and the
+        in-progress window (the Prometheus-export view)."""
+        out: Dict[str, float] = {}
+        windows = list(self._completed)
+        cur = self.current()
+        if cur is not None:
+            windows.append(cur)
+        for w in windows:
+            for name, g in w.get("gauges", {}).items():
+                out[name] = g["last"]
+        return out
+
+    def close(self) -> None:
+        self.flush()
+        if self._out_file is not None:
+            try:
+                self._out_file.close()
+            except OSError:
+                pass
+            self._out_file = None
+
+
+# ------------------------------------------------------------------ feeders
+def default_rollup_path(telemetry_output: str) -> str:
+    """Rollup JSONL path next to ``telemetry_output``:
+    ``tele.jsonl`` -> ``tele.rollup.jsonl``."""
+    root, ext = os.path.splitext(str(telemetry_output))
+    return f"{root}.rollup{ext or '.jsonl'}"
+
+
+def feed_telemetry_row(rollup: Rollup, row: Dict[str, Any]) -> None:
+    """One per-iteration telemetry JSONL row (callback.py
+    ``log_telemetry`` shape) -> rollup observations."""
+    if not isinstance(row, dict):
+        return
+    t = row.get("unix_time")
+    t = float(t) if isinstance(t, (int, float)) else None
+    it = row.get("iter_time_s")
+    if isinstance(it, (int, float)):
+        rollup.observe_sample("round_s", float(it), t=t)
+    counters = row.get("counters")
+    if isinstance(counters, dict):
+        for name, val in counters.items():
+            if isinstance(val, (int, float)):
+                rollup.observe_counter(name, float(val), t=t)
+    for key in ("gauges", "process_counters"):
+        vals = row.get(key)
+        if isinstance(vals, dict):
+            for name, val in vals.items():
+                if isinstance(val, (int, float)):
+                    if key == "process_counters":
+                        rollup.observe_counter(name, float(val), t=t)
+                    else:
+                        rollup.observe_gauge(name, float(val), t=t)
+    evals = row.get("evals")
+    if isinstance(evals, dict):
+        for name, val in evals.items():
+            if isinstance(val, (int, float)):
+                rollup.observe_gauge(f"eval.{name}", float(val), t=t)
+    rss = row.get("host_rss_mb")
+    if isinstance(rss, (int, float)):
+        rollup.observe_gauge("host_rss_mb", float(rss), t=t)
+    if isinstance(row.get("iteration"), (int, float)):
+        rollup.observe_gauge("iteration", float(row["iteration"]), t=t)
+
+
+def feed_serving_row(rollup: Rollup, row: Dict[str, Any]) -> None:
+    """One per-request serving JSONL row (serving/server.py ``_emit``
+    shape) -> rollup observations."""
+    if not isinstance(row, dict):
+        return
+    t = row.get("ts")
+    t = float(t) if isinstance(t, (int, float)) else None
+    lat = row.get("latency_s")
+    if isinstance(lat, (int, float)):
+        rollup.observe_sample("latency_ms", float(lat) * 1000.0, t=t)
+    rollup.observe_delta("serve_requests", 1.0, t=t)
+    rows = row.get("rows")
+    if isinstance(rows, (int, float)):
+        rollup.observe_delta("serve_rows", float(rows), t=t)
+    pad = row.get("pad_rows")
+    if isinstance(pad, (int, float)) and pad:
+        rollup.observe_delta("serve_pad_waste_rows", float(pad), t=t)
+    for key in ("inflight", "queue_depth"):
+        val = row.get(key)
+        if isinstance(val, (int, float)):
+            rollup.observe_gauge(f"serve_{key}", float(val), t=t)
+
+
+def feed_journal_record(rollup: Rollup, rec: Dict[str, Any]) -> None:
+    """One event-journal JSONL record (obs/events.py shape) -> a
+    per-window event tally."""
+    if not isinstance(rec, dict):
+        return
+    name = rec.get("event")
+    if not isinstance(name, str):
+        return
+    t = rec.get("unix_time")
+    t = float(t) if isinstance(t, (int, float)) else None
+    rollup.observe_event(name, t=t)

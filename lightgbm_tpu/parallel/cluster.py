@@ -184,6 +184,11 @@ def launch(params: Dict[str, Any], data, label=None, *,
         "interval": float(params.get("heartbeat_interval_s", 5.0) or 5.0),
         "timeout": float(params.get("heartbeat_timeout_s", 30.0) or 30.0),
     }
+    # parent-side watchtower: the coordinator is the only process that
+    # sees every rank's heartbeat age, so the heartbeat_staleness_s SLO
+    # lives here (one instance across epochs/attempts — burn-rate state
+    # must survive a reshape to catch slow-burn liveness decay)
+    hb_tower = _build_heartbeat_tower(params) if elastic_on else None
     snapshot_every = int(params.get("checkpoint_interval", 5) or 5)
     host_entries = None
     if machines:
@@ -226,9 +231,14 @@ def launch(params: Dict[str, Any], data, label=None, *,
             for attempt in range(startup_retries + 1):
                 outcome, detail, bad = _run_attempt(
                     specs, spec_dicts, tmp, timeout_s, startup_window_s,
-                    attempt, hb=dict(hb_cfg, dir=tmp, epoch=epoch)
+                    attempt, hb=dict(hb_cfg, dir=tmp, epoch=epoch,
+                                     tower=hb_tower)
                     if elastic_on else None)
                 if outcome == "ok":
+                    if hb_tower is not None:
+                        # flush + final evaluate while the parent journal
+                        # is still active
+                        hb_tower.close()
                     _merge_cluster_outputs(trace_base, event_base)
                     with open(spec_dicts[0]["out_path"]) as fh:
                         return Booster(model_str=fh.read())
@@ -381,6 +391,30 @@ def _write_specs(tmp: str, params: Dict[str, Any], data, X, y, weight,
     return specs, spec_dicts
 
 
+def _build_heartbeat_tower(params: Dict[str, Any]):
+    """Parent-side watchtower over elastic liveness.  The coordinator is
+    the only process that sees every rank's heartbeat age, so the
+    ``heartbeat_staleness_s`` SLO is evaluated here: each monitor poll
+    feeds the max observed age as a rollup gauge, and burn-rate breaches
+    land in the parent's journal next to the ``heartbeat_suspect``/
+    ``heartbeat_dead`` events.  Returns ``None`` — zero extra work in
+    the poll loop — unless ``slo_config`` enables the SLO."""
+    from ..obs.slo import SloEvaluator, Watchtower, parse_slo_config
+    from ..obs.timeseries import Rollup
+    try:
+        enabled = parse_slo_config(params.get("slo_config", ""))
+    except ValueError:
+        enabled = {}    # config layer rejects bad specs before launch
+    if "heartbeat_staleness_s" not in enabled:
+        return None
+    rollup = Rollup(
+        window_s=float(params.get("rollup_window_s", 60.0) or 60.0),
+        count=count_event)
+    evaluator = SloEvaluator(enabled, emit=emit_event, count=count_event)
+    evaluator.watch_slo("heartbeat_staleness_s")
+    return Watchtower(rollup, slo=evaluator)
+
+
 def _run_attempt(spec_paths, specs, tmp: str, timeout_s: float,
                  startup_window_s: float, attempt: int, hb=None):
     """One spawn-and-wait pass over all ranks (``specs`` are the parsed
@@ -503,6 +537,15 @@ def _run_attempt(spec_paths, specs, tmp: str, timeout_s: float,
                         rounds[r] = int(d.get("round", -1))
                         stamps[r] = float(d.get("unix_time", hb_t0))
                 lead = max(rounds.values()) if rounds else -1
+                tower = hb.get("tower")
+                if tower is not None and live:
+                    # max age across live ranks — the SLO watches the
+                    # WORST rank, matching the eviction policy above
+                    staleness = max(now_w - stamps.get(r, hb_t0)
+                                    for r in live)
+                    tower.rollup.observe_gauge("heartbeat_staleness_s",
+                                               staleness, t=now_w)
+                    tower.evaluate()
                 for r in sorted(live):
                     rd = rounds.get(r, -1)
                     if rd >= lead or lead < 0:

@@ -21,8 +21,11 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from ..config import Config
+from ..obs import prom
 from ..obs.events import emit_event
 from ..obs.metrics import MetricsRegistry, count_event
+from ..obs.slo import SloEvaluator, Watchtower, parse_slo_config
+from ..obs.timeseries import Rollup
 from .buckets import BucketLadder
 from .predictor import CompiledPredictor
 from .registry import ModelEntry, ModelRegistry
@@ -64,6 +67,23 @@ class PredictionServer:
         self._tele_path = str(cfg.serving_telemetry_output or "")
         self._tele_lock = threading.Lock()
         self._tele_file = None
+        #: serving-side watchtower (rollup windows + burn-rate SLOs) —
+        #: built only when slo_config enables at least one SLO; the
+        #: all-off default adds zero per-request work
+        self._tower: Optional[Watchtower] = None
+        self._tower_lock = threading.Lock()
+        try:
+            enabled = parse_slo_config(cfg.slo_config)
+        except ValueError:
+            enabled = {}    # config layer already rejected bad specs
+        if enabled:
+            hook = lambda n, v=1: count_event(n, v, self.metrics)
+            rollup = Rollup(window_s=float(cfg.rollup_window_s),
+                            count=hook)
+            ev = SloEvaluator(enabled, emit=emit_event, count=hook)
+            ev.watch_slo("serving_p99_ms")
+            ev.watch_slo("serving_error_rate")
+            self._tower = Watchtower(rollup, slo=ev)
 
     # ------------------------------------------------------------- publish
     def publish(self, name: str, *, booster=None, model_text: str = None,
@@ -129,6 +149,7 @@ class PredictionServer:
                 emit_event("serve_overload_rejected", model=name,
                            reason="deadline_at_admission",
                            deadline_ms=float(deadline_ms))
+                self._feed_tower()
                 raise ServerOverloaded(
                     f"request deadline_ms={deadline_ms} already exceeded "
                     "at admission")
@@ -139,6 +160,7 @@ class PredictionServer:
                                reason="inflight_bound",
                                inflight=self._inflight,
                                max_inflight=self.max_inflight)
+                    self._feed_tower()
                     raise ServerOverloaded(
                         f"{self._inflight} requests in flight >= "
                         f"serving_max_inflight={self.max_inflight}")
@@ -159,6 +181,7 @@ class PredictionServer:
                 emit_event("serve_overload_rejected", model=name,
                            reason="deadline_before_predict",
                            deadline_ms=float(deadline_ms))
+                self._feed_tower()
                 raise ServerOverloaded(
                     f"request deadline_ms={deadline_ms} expired before "
                     "predict start")
@@ -176,6 +199,7 @@ class PredictionServer:
             count_event("serve_bucket_hits", stats.warm_chunks, self.metrics)
         with self._inflight_lock:
             self._window.append((time.time(), latency_s, stats.rows))
+        self._feed_tower(latency_s=latency_s)
         self._emit(entry, stats, latency_s, raw_score)
         return out
 
@@ -210,6 +234,34 @@ class PredictionServer:
                 self._tele_file = open(self._tele_path, "a")
             self._tele_file.write(line)
             self._tele_file.flush()
+
+    def _feed_tower(self, latency_s: Optional[float] = None) -> None:
+        """Advance the serving watchtower: push this completion (or
+        rejection) into the current rollup window and run the burn-rate
+        evaluator over any windows that just closed.  Reads admission
+        state from the metrics gauges (already maintained under the
+        inflight lock) so it is safe to call while holding it."""
+        tower = self._tower
+        if tower is None:
+            return
+        with self._tower_lock:
+            r = tower.rollup
+            if latency_s is not None:
+                r.observe_sample("latency_ms", latency_s * 1000.0)
+            r.observe_counter("serve_requests",
+                              self.metrics.counter("serve_requests"))
+            r.observe_counter("serve_rejected_requests",
+                              self.metrics.counter("serve_rejected_requests"))
+            for g in ("serve_inflight", "serve_queue_depth"):
+                val = self.metrics.gauge(g)
+                if val is not None:
+                    r.observe_gauge(g, val)
+            tower.evaluate()
+
+    @property
+    def watchtower(self) -> Optional[Watchtower]:
+        """The serving-side watchtower, or None when slo_config is off."""
+        return self._tower
 
     def stats(self) -> Dict[str, Any]:
         snap = self.metrics.snapshot()["counters"]
@@ -252,7 +304,7 @@ class PredictionServer:
             return round(latencies[idx] * 1000.0, 4)
 
         counters = self.metrics.snapshot()["counters"]
-        return {
+        out: Dict[str, Any] = {
             "window_s": float(window_s),
             "requests_in_window": len(samples),
             "latency_ms": {"p50": _pct(0.50), "p95": _pct(0.95),
@@ -266,6 +318,10 @@ class PredictionServer:
             "counters": {k: v for k, v in counters.items()
                          if k.startswith("serve_")},
         }
+        if self._tower is not None:
+            with self._tower_lock:
+                out["slo"] = self._tower.slo_state()
+        return out
 
     def prometheus_text(self, window_s: float = 60.0) -> str:
         """The snapshot as Prometheus text exposition (version 0.0.4):
@@ -274,47 +330,44 @@ class PredictionServer:
         ``/metrics`` endpoint."""
         snap = self.metrics_snapshot(window_s=window_s)
         lines: List[str] = []
-
-        def _gauge(name: str, value, help_text: str,
-                   labels: str = "") -> None:
-            lines.append(f"# HELP lgbtpu_{name} {help_text}")
-            lines.append(f"# TYPE lgbtpu_{name} gauge")
-            val = "NaN" if value is None else repr(float(value))
-            lines.append(f"lgbtpu_{name}{labels} {val}")
-
         for q, label in (("p50", "0.5"), ("p95", "0.95"), ("p99", "0.99")):
-            v = snap["latency_ms"][q]
-            lines.append(f"# HELP lgbtpu_serve_latency_ms request latency "
-                         f"{q} over the rolling window")
-            lines.append("# TYPE lgbtpu_serve_latency_ms gauge")
-            lines.append('lgbtpu_serve_latency_ms{quantile="%s"} %s'
-                         % (label, "NaN" if v is None else repr(float(v))))
-        _gauge("serve_requests_per_s", snap["requests_per_s"],
-               "requests completed per second over the rolling window")
-        _gauge("serve_rows_per_s", snap["rows_per_s"],
-               "real rows served per second over the rolling window")
-        _gauge("serve_inflight", snap["inflight"],
-               "requests currently executing")
-        _gauge("serve_queue_depth", snap["queue_depth"],
-               "requests awaiting an admission decision")
-        _gauge("serve_max_inflight", snap["max_inflight"],
-               "configured admission bound (serving_max_inflight)")
+            lines.extend(prom.gauge_lines(
+                "serve_latency_ms", snap["latency_ms"][q],
+                f"request latency {q} over the rolling window",
+                labels='{quantile="%s"}' % label))
+        lines.extend(prom.gauge_lines(
+            "serve_requests_per_s", snap["requests_per_s"],
+            "requests completed per second over the rolling window"))
+        lines.extend(prom.gauge_lines(
+            "serve_rows_per_s", snap["rows_per_s"],
+            "real rows served per second over the rolling window"))
+        lines.extend(prom.gauge_lines(
+            "serve_inflight", snap["inflight"],
+            "requests currently executing"))
+        lines.extend(prom.gauge_lines(
+            "serve_queue_depth", snap["queue_depth"],
+            "requests awaiting an admission decision"))
+        lines.extend(prom.gauge_lines(
+            "serve_max_inflight", snap["max_inflight"],
+            "configured admission bound (serving_max_inflight)"))
         for name, val in sorted(snap["counters"].items()):
-            lines.append(f"# HELP lgbtpu_{name} serving counter "
-                         "(obs/metrics.py)")
-            lines.append(f"# TYPE lgbtpu_{name} counter")
-            lines.append(f"lgbtpu_{name} {repr(float(val))}")
+            lines.extend(prom.counter_lines(
+                name, val, "serving counter (obs/metrics.py)"))
         for info in sorted(snap["models"],
                            key=lambda m: str(m.get("name"))):
-            lines.append("# HELP lgbtpu_serve_model_version live "
-                         "published version per model")
-            lines.append("# TYPE lgbtpu_serve_model_version gauge")
-            lines.append('lgbtpu_serve_model_version{model="%s"} %s'
-                         % (info.get("name"),
-                            repr(float(info.get("version", 0)))))
-        return "\n".join(lines) + "\n"
+            lines.extend(prom.gauge_lines(
+                "serve_model_version", info.get("version", 0),
+                "live published version per model",
+                labels='{model="%s"}' % info.get("name")))
+        if self._tower is not None:
+            with self._tower_lock:
+                lines.extend(prom.slo_lines(self._tower.slo_state()))
+        return prom.render(lines)
 
     def close(self) -> None:
+        if self._tower is not None:
+            with self._tower_lock:
+                self._tower.close()
         with self._tele_lock:
             if self._tele_file is not None:
                 self._tele_file.close()

@@ -1,0 +1,10 @@
+"""Toy SLOS registry backing the OBS303 single-file fixtures.
+
+Only the declaration matters — tpulint reads the keys via ``ast``,
+mirroring the real ``lightgbm_tpu/obs/slo.py`` schema registry.
+"""
+
+SLOS = {
+    "declared_slo": ("training", "max", 1.0,
+                     "an SLO the fixtures are allowed to watch"),
+}

@@ -1,0 +1,8 @@
+"""OBS303: watches an SLO under a name the obs/slo.py SLOS registry
+never declared — operators cannot rely on the alert vocabulary."""
+
+from lightgbm_tpu.obs.slo import SloEvaluator
+
+
+def arm(evaluator: SloEvaluator):
+    evaluator.watch_slo("undeclared_slo")

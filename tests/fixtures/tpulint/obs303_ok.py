@@ -1,0 +1,8 @@
+"""OBS303-clean: every watched SLO name is declared in the obs/slo.py
+SLOS registry."""
+
+from lightgbm_tpu.obs.slo import SloEvaluator
+
+
+def arm(evaluator: SloEvaluator):
+    evaluator.watch_slo("declared_slo")

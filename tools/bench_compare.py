@@ -31,8 +31,10 @@ Exit codes (tools/_report.py convention):
 from __future__ import annotations
 
 import argparse
+import glob as _glob
 import json
 import os
+import re
 import sys
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -174,6 +176,137 @@ def compare(old: Dict[str, Any], new: Dict[str, Any],
     }
 
 
+# ------------------------------------------------------------------ trend
+_ROUND_RE = re.compile(r"BENCH_r(\d+)", re.IGNORECASE)
+
+
+def expand_captures(args: List[str]) -> List[str]:
+    """Each argument may be a file, a directory (its BENCH_r*.json
+    members), or a glob.  The union is ordered by embedded round number
+    (``BENCH_r(\\d+)``), then name, deduplicated."""
+    paths: List[str] = []
+    for a in args:
+        if os.path.isdir(a):
+            paths.extend(_glob.glob(os.path.join(a, "BENCH_r*.json")))
+        elif any(c in a for c in "*?["):
+            paths.extend(_glob.glob(a))
+        else:
+            paths.append(a)
+    seen = set()
+    uniq = []
+    for p in paths:
+        key = os.path.abspath(p)
+        if key not in seen:
+            seen.add(key)
+            uniq.append(p)
+
+    def _key(p: str):
+        m = _ROUND_RE.search(os.path.basename(p))
+        return (int(m.group(1)) if m else 1 << 30, os.path.basename(p))
+
+    return sorted(uniq, key=_key)
+
+
+def trend(paths: List[str], threshold: float) -> Dict[str, Any]:
+    """Cross-round trajectory over a sequence of captures: one row per
+    file (usable or not, with the refusal reason), regression flags
+    between CONSECUTIVE usable rows beyond ``threshold``.  Raises
+    ValueError when no capture in the set is usable."""
+    rows: List[Dict[str, Any]] = []
+    prev_vb: Optional[float] = None
+    prev_round: Optional[Any] = None
+    regressions: List[str] = []
+    usable = 0
+    for path in paths:
+        base = os.path.basename(path)
+        m = _ROUND_RE.search(base)
+        rnd = int(m.group(1)) if m else None
+        row: Dict[str, Any] = {"round": rnd, "file": base}
+        try:
+            payload = load_payload(path)
+        except ValueError as e:
+            row.update(usable=False, reason=str(e).split(": ", 1)[-1])
+            rows.append(row)
+            continue
+        if payload.get("kind") == "serve":
+            row.update(usable=False,
+                       reason="serve capture (trend tracks training "
+                              "vs_baseline)")
+            rows.append(row)
+            continue
+        usable += 1
+        vb = float(payload["vs_baseline"])
+        row.update(usable=True, vs_baseline=vb,
+                   metric=payload.get("metric"),
+                   platform=payload.get("platform"),
+                   quality=payload.get("quality"))
+        for extra in ("compile_s", "run_s"):
+            if isinstance(payload.get(extra), (int, float)):
+                row[extra] = payload[extra]
+        sub = payload.get("speed_mode_bins63")
+        if isinstance(sub, dict) and \
+                isinstance(sub.get("vs_baseline"), (int, float)):
+            row["speed_mode_bins63"] = float(sub["vs_baseline"])
+        if prev_vb is not None:
+            change = vb / prev_vb - 1.0
+            row["change_pct"] = round(100.0 * change, 2)
+            if change < -threshold:
+                row["regression"] = True
+                label = "r%s->r%s" % (prev_round, rnd) \
+                    if prev_round is not None and rnd is not None \
+                    else base
+                regressions.append(label)
+        prev_vb, prev_round = vb, rnd
+        rows.append(row)
+    if not usable:
+        raise ValueError("no usable capture in the set (%d files)"
+                         % len(paths))
+    return {
+        "tool": "bench_compare",
+        "mode": "trend",
+        "threshold_pct": round(100.0 * threshold, 2),
+        "captures": len(paths),
+        "usable": usable,
+        "rows": rows,
+        "regressions": regressions,
+    }
+
+
+def _render_trend(payload: Dict[str, Any]) -> str:
+    lines = ["bench trend: %d captures, %d usable (threshold %.1f%%)"
+             % (payload["captures"], payload["usable"],
+                payload["threshold_pct"])]
+    lines.append("  %-6s %-22s %-12s %-9s %-8s %s"
+                 % ("round", "file", "vs_baseline", "change", "bins63",
+                    "notes"))
+    for r in payload["rows"]:
+        rnd = "r%02d" % r["round"] if r.get("round") is not None else "-"
+        if not r.get("usable"):
+            lines.append("  %-6s %-22s %-12s %-9s %-8s unusable: %s"
+                         % (rnd, r["file"], "-", "-", "-",
+                            r.get("reason", "?")))
+            continue
+        change = "%+.2f%%" % r["change_pct"] \
+            if "change_pct" in r else "-"
+        bins63 = "%.4f" % r["speed_mode_bins63"] \
+            if "speed_mode_bins63" in r else "-"
+        notes = []
+        if r.get("regression"):
+            notes.append("REGRESSION")
+        if r.get("quality") and r["quality"] != "ok":
+            notes.append("quality=%s" % r["quality"])
+        if r.get("compile_s") is not None:
+            notes.append("compile_s=%.2f" % r["compile_s"])
+        if r.get("run_s") is not None:
+            notes.append("run_s=%.2f" % r["run_s"])
+        lines.append("  %-6s %-22s %-12.4f %-9s %-8s %s"
+                     % (rnd, r["file"], r["vs_baseline"], change, bins63,
+                        " ".join(notes)))
+    if payload["regressions"]:
+        lines.append("  regressions: " + ", ".join(payload["regressions"]))
+    return "\n".join(lines)
+
+
 def _render_text(payload: Dict[str, Any]) -> str:
     lines = ["bench_compare: %s (threshold %.1f%%)"
              % (payload["metric"], payload["threshold_pct"])]
@@ -197,17 +330,41 @@ def _render_text(payload: Dict[str, Any]) -> str:
 
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
-        description="Diff two BENCH_r*.json captures; nonzero exit on a "
-                    "throughput regression beyond the threshold.")
-    ap.add_argument("old", help="previous round's BENCH_r*.json")
-    ap.add_argument("new", help="this round's BENCH_r*.json")
+        description="Diff two BENCH_r*.json captures (default), or chart "
+                    "a whole directory of them with --trend; nonzero exit "
+                    "on a throughput regression beyond the threshold.")
+    ap.add_argument("captures", nargs="+",
+                    help="two BENCH_r*.json files (compare mode), or any "
+                         "mix of files/dirs/globs with --trend")
+    ap.add_argument("--trend", action="store_true",
+                    help="cross-round trajectory over every capture "
+                         "instead of a two-file diff")
     ap.add_argument("--threshold", type=float, default=0.05,
                     help="relative regression tolerance (default 0.05)")
     _report.add_format_arg(ap)
     args = ap.parse_args(argv)
+    if args.trend:
+        paths = expand_captures(args.captures)
+        if not paths:
+            print("bench_compare: error: no captures matched",
+                  file=sys.stderr)
+            return _report.EXIT_ERROR
+        try:
+            result = trend(paths, args.threshold)
+        except ValueError as e:
+            print("bench_compare: error: %s" % e, file=sys.stderr)
+            return _report.EXIT_ERROR
+        _report.emit(result, args.format, _render_trend)
+        return _report.EXIT_FINDINGS if result["regressions"] \
+            else _report.EXIT_OK
+    if len(args.captures) != 2:
+        print("bench_compare: error: compare mode takes exactly two "
+              "captures (got %d); did you mean --trend?"
+              % len(args.captures), file=sys.stderr)
+        return _report.EXIT_ERROR
     try:
-        old = load_payload(args.old)
-        new = load_payload(args.new)
+        old = load_payload(args.captures[0])
+        new = load_payload(args.captures[1])
         result = compare(old, new, args.threshold)
     except ValueError as e:
         print("bench_compare: error: %s" % e, file=sys.stderr)

@@ -65,7 +65,23 @@ BASE_PARAMS = dict(objective="binary", num_leaves=7, learning_rate=0.5,
                    use_quantized_grad=True, stochastic_rounding=False,
                    tree_learner="data", checkpoint_interval=2,
                    heartbeat_interval_s=0.2, heartbeat_timeout_s=1.0,
-                   elastic="on", verbosity=-1)
+                   elastic="on", verbosity=-1,
+                   # watchtower riding along: purely observational, so
+                   # the bit-identity checks below still hold
+                   slo_config="on", anomaly_detection="on",
+                   rollup_window_s=0.5)
+
+#: the watchtower knobs above — stripped from reference runs
+_WATCHTOWER_KEYS = ("slo_config", "anomaly_detection", "rollup_window_s")
+
+
+def _watchtower_summary(tail: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Breach/recovery/anomaly tallies for one scenario's journal tail."""
+    import run_report
+    slo = run_report.slo_stats(tail)
+    return {"breaches": slo["breaches"], "recoveries": slo["recoveries"],
+            "anomalies": slo["anomalies"],
+            "unrecovered": slo["unrecovered"]}
 
 
 def _data():
@@ -83,7 +99,8 @@ def _ref_model(X, y, rounds: int, mesh: int) -> str:
     from lightgbm_tpu.robustness.elastic import model_core
     p = {k: v for k, v in BASE_PARAMS.items()
          if k not in ("checkpoint_interval", "heartbeat_interval_s",
-                      "heartbeat_timeout_s", "elastic")}
+                      "heartbeat_timeout_s", "elastic")
+         + _WATCHTOWER_KEYS}
     if mesh <= 1:
         p["tree_learner"] = "serial"
         booster = lgb.train(p, lgb.Dataset(X, label=y),
@@ -173,7 +190,9 @@ def scenario_kill(X, y, rounds, workers, corrupt_newest=False):
     return {"name": "corrupt" if corrupt_newest else "kill",
             "kill_at_round": kill_at, "checks": checks,
             "checkpoints": ckpt, "elastic_report": rep,
-            "journal_tail": tail, "passed": all(checks.values())}
+            "journal_tail": tail,
+            "watchtower": _watchtower_summary(tail),
+            "passed": all(checks.values())}
 
 
 def scenario_stall(X, y, rounds, workers):
@@ -188,7 +207,9 @@ def scenario_stall(X, y, rounds, workers):
         "bit_identical_full_mesh": core == ref_full,
     }
     return {"name": "stall", "checks": checks, "elastic_report": rep,
-            "journal_tail": tail, "passed": all(checks.values())}
+            "journal_tail": tail,
+            "watchtower": _watchtower_summary(tail),
+            "passed": all(checks.values())}
 
 
 def scenario_drop(X, y, rounds, workers):
@@ -202,7 +223,9 @@ def scenario_drop(X, y, rounds, workers):
         "bit_identical_reduced_mesh": core == ref_reduced,
     }
     return {"name": "drop", "checks": checks, "elastic_report": rep,
-            "journal_tail": tail, "passed": all(checks.values())}
+            "journal_tail": tail,
+            "watchtower": _watchtower_summary(tail),
+            "passed": all(checks.values())}
 
 
 def scenario_fail_fast(X, y, rounds, workers):
@@ -220,7 +243,9 @@ def scenario_fail_fast(X, y, rounds, workers):
     checks = {"failed_fast": failed_fast,
               "no_recovery_attempted": "elastic=on" in detail}
     return {"name": "fail_fast", "detail": detail, "checks": checks,
-            "journal_tail": tail, "passed": all(checks.values())}
+            "journal_tail": tail,
+            "watchtower": _watchtower_summary(tail),
+            "passed": all(checks.values())}
 
 
 def run_drill(quick: bool, rounds: int, workers: int) -> Dict[str, Any]:
@@ -246,9 +271,22 @@ def _render(payload: Dict[str, Any]) -> str:
         checks = " ".join(f"{k}={'ok' if v else 'FAIL'}"
                           for k, v in s["checks"].items())
         lines.append(f"  {s['name']:<10} {verdict}  {checks}")
+        wt = s.get("watchtower")
+        if wt is not None:
+            col = (f"slo {wt['breaches']}b/{wt['recoveries']}r "
+                   f"anomalies={wt['anomalies']}")
+            if wt["unrecovered"]:
+                col += " UNRECOVERED:" + ",".join(wt["unrecovered"])
+            lines.append(f"             watchtower: {col}")
         tail = s.get("journal_tail") or []
         if tail:
-            seq = " -> ".join(e.get("event", "?") for e in tail[-8:])
+            # breach/anomaly records always make the cut, even when
+            # routine events crowd the last 8 slots
+            hot = {"slo_breach", "slo_recovered", "anomaly_detected"}
+            extra = [e for e in tail[:-8] if e.get("event") in hot]
+            keep = 8 - min(8, len(extra))
+            shown = extra[-8:] + (tail[-keep:] if keep else [])
+            seq = " -> ".join(e.get("event", "?") for e in shown)
             lines.append(f"             journal: {seq}")
     lines.append("drill: " + ("PASS" if payload["passed"] else "FAIL"))
     return "\n".join(lines)

@@ -1,0 +1,359 @@
+#!/usr/bin/env python3
+"""Live "top"-style watchtower dashboard over the repo's JSONL streams.
+
+Tails the per-iteration telemetry, per-request serving telemetry, and
+event-journal JSONL files a run was configured with (``--telemetry`` /
+``--serving`` / ``--events``), rank-merged via the ``<root>.e<E>.r<R>``
+convention (obs/merge.py naming — the base path plus every per-rank
+sibling is followed).  Rows feed the same rollup/SLO machinery the
+package uses in-process (obs/timeseries.py + obs/slo.py, loaded here BY
+FILE PATH — this tool never imports jax, or the package, so it runs
+beside a live cluster without stealing a device or recompiling
+anything).
+
+Renders four panes in-terminal: training rounds (round_s, compile
+hits/misses, eval metrics), serving (latency percentiles, throughput,
+inflight/queue), SLO state (per-name ok/BREACHED with burn-rate
+violation counts), and the most recent journal events.
+
+Modes: default is a live loop redrawn every ``--interval`` seconds;
+``--once`` renders one frame and exits (CI artifact / smoke check);
+``--html`` writes a static HTML render to the given path.  Exit codes
+follow tools/_report.py: 0 healthy, 1 at least one SLO currently
+breached (``--once`` only), 2 no usable input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import html as _html
+import importlib.util
+import json
+import os
+import re
+import sys
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_OBS_DIR = os.path.join(REPO_ROOT, "lightgbm_tpu", "obs")
+
+#: obs/merge.py rank-file convention, re-implemented locally: importing
+#: the package would import jax (lightgbm_tpu/__init__.py)
+_RANK_RE = re.compile(r"\.e(\d+)\.r(\d+)(\.[^.]+)?$")
+
+EXIT_OK = 0
+EXIT_FINDINGS = 1
+EXIT_ERROR = 2
+
+
+def _load_obs_module(name: str):
+    """Load lightgbm_tpu/obs/<name>.py standalone by file path.  The
+    modules are stdlib-only by contract (asserted in
+    tests/test_watchtower.py under a jax-poisoned interpreter)."""
+    key = f"_obs_top_{name}"
+    if key in sys.modules:
+        return sys.modules[key]
+    spec = importlib.util.spec_from_file_location(
+        key, os.path.join(_OBS_DIR, name + ".py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[key] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+timeseries = _load_obs_module("timeseries")
+slo_mod = _load_obs_module("slo")
+
+
+# ------------------------------------------------------------ file tailing
+def expand_rank_files(base: str) -> List[str]:
+    """``base`` plus every ``<root>.e<E>.r<R><ext>`` sibling, sorted by
+    (epoch, rank) — the merged view obs/merge.py produces at rest."""
+    out = [base] if os.path.exists(base) else []
+    root, ext = os.path.splitext(base)
+    ranked: List[Tuple[int, int, str]] = []
+    for path in glob.glob(glob.escape(root) + ".e*.r*" + ext):
+        m = _RANK_RE.search(path)
+        if m:
+            ranked.append((int(m.group(1)), int(m.group(2)), path))
+    out.extend(p for _, _, p in sorted(ranked))
+    return out
+
+
+class Tail:
+    """Incremental JSONL reader over a base path + rank siblings.
+    Re-globs on every poll (ranks appear mid-run under elastic
+    reshapes) and remembers a byte offset per file; a shrunk file
+    (truncation/rewrite) is re-read from the top."""
+
+    def __init__(self, base: str) -> None:
+        self.base = base
+        self._offsets: Dict[str, int] = {}
+        self.files_seen = 0
+
+    def poll(self) -> List[Dict[str, Any]]:
+        rows: List[Dict[str, Any]] = []
+        files = expand_rank_files(self.base) if self.base else []
+        self.files_seen = len(files)
+        for path in files:
+            try:
+                size = os.path.getsize(path)
+                off = self._offsets.get(path, 0)
+                if size < off:
+                    off = 0
+                if size == off:
+                    continue
+                with open(path, "r", encoding="utf-8") as fh:
+                    fh.seek(off)
+                    chunk = fh.read()
+                    self._offsets[path] = fh.tell()
+            except OSError:
+                continue
+            for line in chunk.splitlines():
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rows.append(json.loads(line))
+                except ValueError:
+                    continue   # torn tail write — picked up next poll
+        return rows
+
+
+# ----------------------------------------------------------- aggregation
+class Watch:
+    """The dashboard's state: one rollup fed from all three streams,
+    an SLO evaluator over its windows, and the raw tails for the
+    training/serving/events panes."""
+
+    def __init__(self, telemetry: str = "", serving: str = "",
+                 events: str = "", window_s: float = 10.0,
+                 slo_spec: str = "on") -> None:
+        self.tails = {"telemetry": Tail(telemetry),
+                      "serving": Tail(serving),
+                      "events": Tail(events)}
+        self.rollup = timeseries.Rollup(window_s=window_s,
+                                        max_windows=720)
+        self.slo = slo_mod.SloEvaluator(slo_spec)
+        for name in self.slo.enabled:
+            self.slo.watch_slo(name)
+        self.last_training: Optional[Dict[str, Any]] = None
+        self.last_serving: Optional[Dict[str, Any]] = None
+        self.recent_events: List[Dict[str, Any]] = []
+        self.rows_total = 0
+
+    def poll(self, force_flush: bool = False) -> None:
+        for row in self.tails["telemetry"].poll():
+            timeseries.feed_telemetry_row(self.rollup, row)
+            self.last_training = row
+            self.rows_total += 1
+        for row in self.tails["serving"].poll():
+            timeseries.feed_serving_row(self.rollup, row)
+            self.last_serving = row
+            self.rows_total += 1
+        for rec in self.tails["events"].poll():
+            timeseries.feed_journal_record(self.rollup, rec)
+            self.recent_events.append(rec)
+            self.rows_total += 1
+        self.recent_events = self.recent_events[-200:]
+        # close the live window once its span is over on the WALL clock
+        # (a stalled stream must not park a breach in a never-closed
+        # window); --once flushes unconditionally so historical fixture
+        # sets evaluate their final window too
+        cur = self.rollup.current()
+        if cur is not None and (force_flush
+                                or cur["t_end"] <= time.time()):
+            self.rollup.flush()
+        self.slo.evaluate(self.rollup.completed())
+
+    def inputs_seen(self) -> int:
+        return sum(t.files_seen for t in self.tails.values())
+
+    def breached(self) -> List[str]:
+        return self.slo.breached()
+
+
+# -------------------------------------------------------------- rendering
+def _fmt(v: Any, nd: int = 3) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{nd}f}"
+    return str(v)
+
+
+def _series(watch: Watch, kind: str, name: str) -> Optional[Dict[str, Any]]:
+    """Latest value row for a gauge/sample/counter across the ring
+    (newest window that observed it), preferring the live window."""
+    windows = watch.rollup.completed()
+    cur = watch.rollup.current()
+    if cur is not None:
+        windows = windows + [cur]
+    for w in reversed(windows):
+        row = (w.get(kind) or {}).get(name)
+        if row is not None:
+            return row
+    return None
+
+
+def render_frame(watch: Watch, now: Optional[float] = None) -> str:
+    now = time.time() if now is None else now
+    lines: List[str] = []
+    lines.append("lgbtpu obs_top — %s   windows=%d   rows=%d"
+                 % (time.strftime("%H:%M:%S", time.localtime(now)),
+                    len(watch.rollup.completed()), watch.rows_total))
+
+    lines.append("")
+    lines.append("TRAINING")
+    tr = watch.last_training
+    if tr is None:
+        lines.append("  (no telemetry rows)")
+    else:
+        rs = _series(watch, "samples", "round_s") or {}
+        counters = tr.get("counters") or {}
+        lines.append("  round=%s  round_s p50=%s p99=%s max=%s"
+                     % (_fmt(tr.get("iteration")), _fmt(rs.get("p50")),
+                        _fmt(rs.get("p99")), _fmt(rs.get("max"))))
+        lines.append("  compile hits/misses=%s/%s  fused hits/misses=%s/%s"
+                     "  nan_trips=%s"
+                     % (_fmt(counters.get("round_compile_hits", 0)),
+                        _fmt(counters.get("round_compile_misses", 0)),
+                        _fmt(counters.get("fused_runner_cache_hits", 0)),
+                        _fmt(counters.get("fused_runner_cache_misses", 0)),
+                        _fmt(counters.get("nan_guard_trips", 0))))
+        evals = tr.get("evals") or {}
+        if evals:
+            parts = []
+            for k in sorted(evals)[:4]:
+                v = evals[k]
+                v = v[0] if isinstance(v, (list, tuple)) else v
+                parts.append(f"{k}={_fmt(v, 6)}")
+            lines.append("  evals: " + "  ".join(parts))
+
+    lines.append("")
+    lines.append("SERVING")
+    lat = _series(watch, "samples", "latency_ms")
+    if lat is None:
+        lines.append("  (no serving rows)")
+    else:
+        req = _series(watch, "counters", "serve_requests") or {}
+        inflight = _series(watch, "gauges", "serve_inflight") or {}
+        queue = _series(watch, "gauges", "serve_queue_depth") or {}
+        lines.append("  latency_ms p50=%s p95=%s p99=%s max=%s (n=%s)"
+                     % (_fmt(lat.get("p50")), _fmt(lat.get("p95")),
+                        _fmt(lat.get("p99")), _fmt(lat.get("max")),
+                        _fmt(lat.get("count"))))
+        lines.append("  req/s=%s  inflight=%s  queue=%s"
+                     % (_fmt(req.get("rate")), _fmt(inflight.get("last")),
+                        _fmt(queue.get("last"))))
+
+    lines.append("")
+    lines.append("SLO")
+    state = watch.slo.state()
+    if not state:
+        lines.append("  (no SLOs enabled)")
+    for name in sorted(state):
+        st = state[name]
+        flag = "ok      " if st["ok"] else "BREACHED"
+        lines.append("  %-26s %s  last=%-10s budget=%s(%s)  "
+                     "violations=%d/%d"
+                     % (name, flag, _fmt(st["last_value"]),
+                        _fmt(st["budget"]), st["direction"],
+                        st["violations"], st["history_windows"]))
+
+    lines.append("")
+    lines.append("EVENTS (last %d)" % min(len(watch.recent_events), 8))
+    if not watch.recent_events:
+        lines.append("  (no journal records)")
+    for rec in watch.recent_events[-8:]:
+        t = rec.get("unix_time")
+        stamp = time.strftime("%H:%M:%S", time.localtime(t)) \
+            if isinstance(t, (int, float)) else "--:--:--"
+        payload = rec.get("payload") or {}
+        extra = " ".join(f"{k}={payload[k]}" for k in sorted(payload)[:3])
+        lines.append("  %s  %-9s %-24s %s"
+                     % (stamp, str(rec.get("severity", "")),
+                        str(rec.get("event", "?")), extra))
+    return "\n".join(lines) + "\n"
+
+
+def render_html(watch: Watch) -> str:
+    frame = render_frame(watch)
+    breached = watch.breached()
+    color = "#b00020" if breached else "#2e7d32"
+    status = ("BREACHED: " + ", ".join(breached)) if breached else "healthy"
+    return ("<!DOCTYPE html><html><head><meta charset='utf-8'>"
+            "<title>lgbtpu obs_top</title></head><body "
+            "style='font-family:monospace;background:#111;color:#ddd'>"
+            f"<h2 style='color:{color}'>watchtower: "
+            f"{_html.escape(status)}</h2>"
+            f"<pre>{_html.escape(frame)}</pre></body></html>\n")
+
+
+# ------------------------------------------------------------------- main
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="obs_top.py",
+        description="live watchtower dashboard over telemetry/serving/"
+                    "journal JSONL (stdlib-only; never imports jax)")
+    ap.add_argument("--telemetry", default="",
+                    help="telemetry_output base path (rank siblings "
+                         "<root>.e<E>.r<R> are followed)")
+    ap.add_argument("--serving", default="",
+                    help="serving_telemetry_output base path")
+    ap.add_argument("--events", default="",
+                    help="event_output journal base path")
+    ap.add_argument("--window", type=float, default=10.0,
+                    help="rollup window seconds (default 10)")
+    ap.add_argument("--slo", default="on",
+                    help="slo_config spec to evaluate while tailing "
+                         "(default: on = every declared SLO)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="live-mode redraw seconds (default 2)")
+    ap.add_argument("--once", action="store_true",
+                    help="render one frame and exit (exit 1 if an SLO "
+                         "is currently breached, 2 if no inputs)")
+    ap.add_argument("--html", default="",
+                    help="also write a static HTML render to this path")
+    args = ap.parse_args(argv)
+
+    if not (args.telemetry or args.serving or args.events):
+        print("obs_top: no inputs — pass --telemetry/--serving/--events",
+              file=sys.stderr)
+        return EXIT_ERROR
+    try:
+        watch = Watch(args.telemetry, args.serving, args.events,
+                      window_s=args.window, slo_spec=args.slo)
+    except ValueError as e:
+        print(f"obs_top: {e}", file=sys.stderr)
+        return EXIT_ERROR
+
+    if args.once:
+        watch.poll(force_flush=True)
+        if watch.inputs_seen() == 0:
+            print("obs_top: no input files found", file=sys.stderr)
+            return EXIT_ERROR
+        sys.stdout.write(render_frame(watch))
+        if args.html:
+            with open(args.html, "w", encoding="utf-8") as fh:
+                fh.write(render_html(watch))
+        return EXIT_FINDINGS if watch.breached() else EXIT_OK
+
+    try:
+        while True:
+            watch.poll()
+            frame = render_frame(watch)
+            sys.stdout.write("\x1b[2J\x1b[H" + frame)
+            sys.stdout.flush()
+            if args.html:
+                with open(args.html, "w", encoding="utf-8") as fh:
+                    fh.write(render_html(watch))
+            time.sleep(max(args.interval, 0.1))
+    except KeyboardInterrupt:
+        return EXIT_OK
+
+
+if __name__ == "__main__":
+    sys.exit(main())

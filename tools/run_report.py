@@ -51,6 +51,51 @@ _COLLECTIVE_GAUGES = (
     "collective_s_per_round", "overlap_efficiency", "overlap_on",
 )
 
+#: final-snapshot counters surfaced as the "watchtower" join column
+_WATCHTOWER_COUNTERS = (
+    "rollup_windows_closed", "slo_breaches", "slo_recoveries",
+    "anomalies_detected",
+)
+
+
+def slo_stats(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Replay the journal's watchtower records into an SLO ledger.
+
+    Breach/recover events carry the SLO name in ``payload.slo``; a name
+    whose LAST transition is a breach is "unrecovered" — the signal the
+    ``--quick`` CI gate turns into a nonzero exit."""
+    last_state: Dict[str, str] = {}
+    breaches = recoveries = anomalies = 0
+    anomaly_kinds: Dict[str, int] = {}
+    for rec in events:
+        name = rec.get("event")
+        payload = rec.get("payload") or {}
+        slo = payload.get("slo") if isinstance(payload, dict) else None
+        if name == "slo_breach":
+            breaches += 1
+            if isinstance(slo, str):
+                last_state[slo] = "breached"
+        elif name == "slo_recovered":
+            recoveries += 1
+            if isinstance(slo, str):
+                last_state[slo] = "ok"
+        elif name == "anomaly_detected":
+            anomalies += 1
+            kind = payload.get("kind") if isinstance(payload, dict) \
+                else None
+            if isinstance(kind, str):
+                anomaly_kinds[kind] = anomaly_kinds.get(kind, 0) + 1
+    unrecovered = sorted(n for n, s in last_state.items()
+                         if s == "breached")
+    return {
+        "breaches": breaches,
+        "recoveries": recoveries,
+        "anomalies": anomalies,
+        "anomaly_kinds": anomaly_kinds,
+        "last_state": last_state,
+        "unrecovered": unrecovered,
+    }
+
 
 def load_telemetry(path: str) -> List[Dict[str, Any]]:
     """Telemetry JSONL rows (one per round); torn lines are skipped."""
@@ -92,6 +137,8 @@ def telemetry_stats(rows: List[Dict[str, Any]]) -> Dict[str, Any]:
                     if k in counters},
         "collective": {k: gauges[k] for k in _COLLECTIVE_GAUGES
                        if k in gauges},
+        "watchtower": {k: counters[k] for k in _WATCHTOWER_COUNTERS
+                       if k in counters},
     }
 
 
@@ -124,6 +171,14 @@ def build_report(trace_doc: Optional[Dict[str, Any]],
                  "round": r.get("round"),
                  "severity": r.get("severity")} for r in events]
         payload["events"] = stats
+        slo = slo_stats(events)
+        if slo["breaches"] or slo["recoveries"] or slo["anomalies"]:
+            payload["slo"] = slo
+        # fires in quick AND full mode: an unrecovered breach is the
+        # one journal state that should fail a CI gate outright
+        if slo["unrecovered"]:
+            findings.append("run ends with unrecovered slo_breach: "
+                            + ", ".join(slo["unrecovered"]))
     if telemetry is not None:
         if not telemetry:
             findings.append("telemetry stream holds no rows")
@@ -150,6 +205,19 @@ def _render_report(payload: Dict[str, Any]) -> str:
         lines.append(f"event journal: {ev['count']} record(s)")
         for name in sorted(ev.get("by_name", {})):
             lines.append(f"  {name}: {ev['by_name'][name]}")
+    slo = payload.get("slo")
+    if slo is not None:
+        lines.append("")
+        lines.append(f"watchtower: {slo['breaches']} breach(es), "
+                     f"{slo['recoveries']} recovery(ies), "
+                     f"{slo['anomalies']} anomaly(ies)")
+        for name in sorted(slo.get("last_state", {})):
+            state = slo["last_state"][name]
+            flag = "UNRECOVERED" if state == "breached" else "ok"
+            lines.append(f"  slo {name}: {flag}")
+        for kind in sorted(slo.get("anomaly_kinds", {})):
+            lines.append(f"  anomaly {kind}: "
+                         f"{slo['anomaly_kinds'][kind]}")
     tel = payload.get("telemetry")
     if tel is not None:
         lines.append("")
@@ -157,7 +225,7 @@ def _render_report(payload: Dict[str, Any]) -> str:
         if tel.get("last_round") is not None:
             lines.append(f"  rounds {tel['first_round']}"
                          f"..{tel['last_round']}")
-        for section in ("compile", "collective"):
+        for section in ("compile", "collective", "watchtower"):
             vals = tel.get(section) or {}
             if vals:
                 lines.append(f"  {section}:")
