@@ -122,3 +122,98 @@ class UnboundedRetrySleep(Rule):
                 f"{first.lineno}) with no visible attempt/deadline "
                 "bound — cap the retries or compare against a "
                 "deadline, or add a justified suppression")
+
+
+#: socket read calls that block until the peer speaks — the final
+#: attribute segment is matched (``sock.recv``, ``conn.accept``, ...)
+_RECV_NAMES = ("recv", "recv_into", "recvfrom", "accept")
+
+
+def _get_is_blocking(node: ast.Call) -> bool:
+    """``q.get()`` / ``q.get(True)`` / ``q.get(block=True)`` with no
+    ``timeout=`` is a blocking queue/pipe read.  ``d.get(key)`` /
+    ``d.get(key, default)`` — a positional non-``True`` first argument —
+    is the dict idiom and never blocks; ``get(False)`` /
+    ``get_nowait()`` don't block either."""
+    if any(kw.arg == "timeout" for kw in node.keywords):
+        return False
+    if node.args:
+        first = node.args[0]
+        return isinstance(first, ast.Constant) and first.value is True
+    for kw in node.keywords:
+        if kw.arg == "block":
+            return (isinstance(kw.value, ast.Constant)
+                    and kw.value.value is True)
+    return True
+
+
+@register_rule
+class UnboundedBlockingIO(Rule):
+    id = "RBS502"
+    name = "unbounded-blocking-io"
+    severity = SEVERITY_ERROR
+    description = ("queue/pipe get() or socket recv()/accept() without a "
+                   "timeout in the serving tier or the cluster launcher — "
+                   "a dead peer turns the caller into a hung process the "
+                   "failure detector never sees")
+
+    def _applies(self, ctx: FileContext) -> bool:
+        rel = ctx.relpath.replace("\\", "/")
+        return ("serving/" in rel or rel.startswith("serving")
+                or rel.endswith("parallel/cluster.py"))
+
+    def _scan_scope(self, ctx: FileContext,
+                    body: Iterable[ast.AST]) -> Iterable[Violation]:
+        """One lexical scope (module or function), nested functions
+        excluded — they are their own scopes, so a ``settimeout`` in a
+        helper can't excuse an unbounded ``recv`` in its caller."""
+        nested = []
+        calls = []
+        has_socket_bound = False
+        stack = list(body)
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                nested.append(n)
+                continue
+            if isinstance(n, ast.Call):
+                name = _call_name(n)
+                # visible socket-level bound in this scope:
+                # ``sock.settimeout(...)`` or
+                # ``socket.create_connection(addr, timeout=...)``
+                # (create_connection's timeout lands on the returned
+                # socket, bounding its later recv too)
+                if name == "settimeout":
+                    has_socket_bound = True
+                if (name == "create_connection"
+                        and (len(n.args) >= 2
+                             or any(kw.arg == "timeout"
+                                    for kw in n.keywords))):
+                    has_socket_bound = True
+                calls.append(n)
+            stack.extend(ast.iter_child_nodes(n))
+        for call in calls:
+            name = _call_name(call)
+            if name == "get" and isinstance(call.func, ast.Attribute) \
+                    and _get_is_blocking(call):
+                yield self.violation(
+                    ctx, call.lineno, call.col_offset,
+                    "blocking .get() without timeout= — a dead producer "
+                    "hangs this consumer forever; pass timeout= (or "
+                    "block=False) and handle Empty")
+            elif name in _RECV_NAMES \
+                    and isinstance(call.func, ast.Attribute) \
+                    and not has_socket_bound:
+                yield self.violation(
+                    ctx, call.lineno, call.col_offset,
+                    f"socket .{name}() with no settimeout()/"
+                    "create_connection(timeout=) in scope — a silent "
+                    "peer blocks this read forever; set a timeout "
+                    "derived from the caller's deadline")
+        for fn in nested:
+            yield from self._scan_scope(ctx, fn.body)
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        if not self._applies(ctx):
+            return
+        yield from self._scan_scope(ctx, ctx.tree.body)

@@ -271,6 +271,10 @@ _PARAMS: List[Tuple[str, Any, Tuple[str, ...], Tuple[Tuple[str, float], ...]]] =
     ("predict_bucketing", "on", (), ()),          # batch Booster.predict shape-thrash fix: on|off (boosting/gbdt.py _device_predict_raw pads block tails up to a geometric ladder of tail-quantum multiples instead of the next exact multiple, bounding compiled program count at log2(block/quantum)+1 across ANY mix of row counts; bit-identical — padded rows are sliced off and the path-count matmuls are per-row exact; counters predict_bucketed_calls/predict_bucket_pad_rows)
     ("serving_telemetry_output", "", (), ()),     # serving per-request JSONL path (serving/server.py PredictionServer: one record per predict() with model/version, rows, buckets hit, pad rows, latency_s; "" disables)
     ("serving_max_inflight", 64, (), ((">", 0),)),  # serving-tier admission control: bound on concurrently served predict() requests (serving/server.py); a request arriving with the bound already in flight is rejected FAST (ServerOverloaded + serve_rejected_requests counter) instead of queueing unboundedly
+    ("serving_replicas", 0, (), ((">=", 0),)),      # replicated serving fleet size (serving/fleet.py FleetServer): 0 (default) = OFF, single-process PredictionServer semantics with no extra processes or files; N >= 1 spawns N replica worker processes (each a full PredictionServer + warmed bucket ladder) behind a failover router
+    ("serving_retry_budget", 2, (), ((">=", 0),)),  # fleet router failover bound: a request whose replica dies or misses its sub-deadline is transparently re-dispatched to a surviving replica at most this many times (request_failover journal events + fleet_request_failovers counter); 0 = no failover, first error surfaces
+    ("fleet_heartbeat_interval_s", 0.5, (), ((">", 0.0),)),  # serving-replica liveness: seconds between a replica's heartbeat markers (same file substrate as training heartbeats, robustness/elastic.py; faster default than heartbeat_interval_s because serving replicas beat on wall time, not boosting rounds)
+    ("fleet_heartbeat_timeout_s", 3.0, (), ((">", 0.0),)),   # serving-replica liveness: a replica silent past this is DEAD — evicted from the routing table, killed, respawned and re-warmed from the fleet manifest before it rejoins; staleness between ~2x fleet_heartbeat_interval_s and this marks it SUSPECT (deprioritized, not evicted)
 ]
 
 # Reference-LightGBM parameters this port ACCEPTS but never reads: they
@@ -503,6 +507,13 @@ class Config:
                 f"heartbeat_interval_s={self.heartbeat_interval_s} (a worker "
                 "cannot be declared dead faster than it is expected to "
                 "publish)")
+        if float(self.fleet_heartbeat_timeout_s) < \
+                float(self.fleet_heartbeat_interval_s):
+            log.fatal(
+                f"fleet_heartbeat_timeout_s={self.fleet_heartbeat_timeout_s} "
+                f"must be >= fleet_heartbeat_interval_s="
+                f"{self.fleet_heartbeat_interval_s} (a replica cannot be "
+                "declared dead faster than it is expected to beat)")
         if not self.serving_buckets or \
                 any(int(b) <= 0 for b in self.serving_buckets):
             log.fatal(f"serving_buckets must be a non-empty list of positive "

@@ -80,6 +80,33 @@ EVENTS: Dict[str, Tuple[str, str]] = {
     "serve_overload_rejected": (
         "warning", "a serving request rejected by admission control "
                    "(in-flight bound or expired deadline)"),
+    "replica_spawned": (
+        "info", "the fleet router spawned a serving replica process "
+                "(initial bring-up or respawn after eviction)"),
+    "replica_dead": (
+        "error", "a serving replica stayed silent past "
+                 "fleet_heartbeat_timeout_s (or its process exited) and "
+                 "was declared dead"),
+    "replica_evicted": (
+        "error", "a dead serving replica was dropped from the fleet "
+                 "routing table (no further requests routed to it)"),
+    "replica_rejoined": (
+        "info", "a respawned serving replica finished warming its bucket "
+                "ladder from the fleet manifest and re-entered the "
+                "routing table"),
+    "rolling_swap_started": (
+        "info", "FleetRegistry.publish began a rolling hot-swap: "
+                "replicas will be drained-warmed-swapped one at a time"),
+    "rolling_swap_completed": (
+        "info", "a rolling hot-swap converged: every replica serves the "
+                "new version and the fleet manifest was committed"),
+    "rolling_swap_aborted": (
+        "error", "a replica died mid-rollout; already-swapped replicas "
+                 "were rolled back to the manifest version"),
+    "request_failover": (
+        "warning", "a fleet request's dispatch attempt failed (replica "
+                   "dead or sub-deadline exceeded) and was transparently "
+                   "re-dispatched to a surviving replica"),
     "slo_breach": (
         "error", "a declared SLO (obs/slo.py SLOS) went over budget for "
                  "enough burn-rate windows to page"),

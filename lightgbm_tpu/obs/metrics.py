@@ -86,6 +86,15 @@ COUNTERS: Dict[str, str] = {
         "serving requests rejected by the in-flight admission bound",
     "serve_deadline_exceeded":
         "serving requests rejected because their deadline_ms had passed",
+    "fleet_request_failovers":
+        "fleet request dispatch attempts re-dispatched to a surviving "
+        "replica (serving/fleet.py)",
+    "fleet_replica_respawns":
+        "dead serving replicas respawned by the fleet monitor",
+    "fleet_rolling_swaps":
+        "rolling hot-swaps completed across every fleet replica",
+    "fleet_rolling_swap_aborts":
+        "rolling hot-swaps aborted mid-rollout and rolled back",
     "predict_bucketed_calls":
         "predict_raw device blocks padded to the geometric bucket ladder",
     "predict_bucket_pad_rows":

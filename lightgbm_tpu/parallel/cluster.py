@@ -116,6 +116,76 @@ def _log_tail(path: str, limit: int = 2000) -> str:
         return f"<log unreadable: {e}>"
 
 
+# ---------------------------------------------------------------------------
+# shared spawn/barrier plumbing — used by the training cluster below AND
+# the serving fleet (serving/fleet.py), which runs the same
+# spec-file + subprocess + ready-marker protocol for its replicas
+# ---------------------------------------------------------------------------
+
+def worker_env(devices_per_worker: int = 0) -> Dict[str, str]:
+    """Environment for a spawned worker process.
+
+    Drops only sitecustomize-injection PYTHONPATH entries (their
+    premature jax import breaks platform forcing) — user entries that
+    make ``lightgbm_tpu`` importable must survive.  With
+    ``devices_per_worker > 0`` the virtual-device XLA flags are set here
+    because they MUST land before the worker imports jax (package import
+    runs at interpreter start, before any worker main executes)."""
+    env = dict(os.environ)
+    pp = [e for e in env.get("PYTHONPATH", "").split(os.pathsep)
+          if e and not e.rstrip("/").endswith(".axon_site")]
+    if pp:
+        env["PYTHONPATH"] = os.pathsep.join(pp)
+    else:
+        env.pop("PYTHONPATH", None)
+    if devices_per_worker > 0:
+        flags = env.get("XLA_FLAGS", "")
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count="
+            f"{devices_per_worker}").strip()
+        env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def spawn_worker(module: str, spec_path: str, log_path: str, *,
+                 devices_per_worker: int = 0):
+    """Spawn ``python -m <module> <spec_path>`` with :func:`worker_env`.
+
+    Returns ``(proc, log_file)``.  Worker output goes to a per-worker
+    log FILE, never a pipe: a worker blocking on a full 64KB stdout pipe
+    mid-collective would deadlock the job.  The opened log handle is
+    closed on a failed spawn; the ``OSError`` propagates."""
+    env = worker_env(devices_per_worker)
+    lf = open(log_path, "wb")
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", module, spec_path],
+            env=env, stdout=lf, stderr=subprocess.STDOUT)
+    except OSError:
+        lf.close()
+        raise
+    return proc, lf
+
+
+def wait_for_markers(paths: Sequence[str], timeout_s: float, *,
+                     alive=None, poll_s: float = 0.05) -> bool:
+    """Bounded startup barrier: poll until every marker file in
+    ``paths`` exists.  ``alive()`` (optional) is consulted each pass and
+    aborts the wait early when it returns False — a spawned process that
+    already died will never write its marker, and waiting out the full
+    window for it only delays the failure report.  Returns True when all
+    markers landed within ``timeout_s``."""
+    import time as _time
+    deadline = _time.monotonic() + float(timeout_s)
+    while _time.monotonic() < deadline:
+        if all(os.path.exists(p) for p in paths):
+            return True
+        if alive is not None and not alive():
+            return False
+        _time.sleep(max(0.005, float(poll_s)))
+    return all(os.path.exists(p) for p in paths)
+
+
 def launch(params: Dict[str, Any], data, label=None, *,
            weight: Optional[np.ndarray] = None,
            group: Optional[np.ndarray] = None,
@@ -446,38 +516,16 @@ def _run_attempt(spec_paths, specs, tmp: str, timeout_s: float,
     logs = []
     try:
         for rank, spec_path in enumerate(spec_paths):
-            env = dict(os.environ)
-            # drop only sitecustomize-injection entries (their premature
-            # jax import breaks platform forcing); user PYTHONPATH entries
-            # that make lightgbm_tpu importable must survive
-            pp = [e for e in env.get("PYTHONPATH", "").split(os.pathsep)
-                  if e and not e.rstrip("/").endswith(".axon_site")]
-            if pp:
-                env["PYTHONPATH"] = os.pathsep.join(pp)
-            else:
-                env.pop("PYTHONPATH", None)
-            if devices_per_worker > 0:
-                # MUST happen before the worker imports jax (package import
-                # runs at interpreter start, before _worker_main executes),
-                # so the flags travel in the spawn env, not in-process
-                flags = env.get("XLA_FLAGS", "")
-                env["XLA_FLAGS"] = (
-                    flags + " --xla_force_host_platform_device_count="
-                    f"{devices_per_worker}").strip()
-                env["JAX_PLATFORMS"] = "cpu"
-            # per-rank per-attempt log files, not pipes: a worker blocking
-            # on a full 64KB stdout pipe mid-collective would deadlock
-            lf = open(os.path.join(tmp, f"worker_{rank}.a{attempt}.log"),
-                      "wb")
-            logs.append(lf)
             try:
-                procs.append(subprocess.Popen(
-                    [sys.executable, "-m", "lightgbm_tpu.parallel.cluster",
-                     spec_path],
-                    env=env, stdout=lf, stderr=subprocess.STDOUT))
+                proc, lf = spawn_worker(
+                    "lightgbm_tpu.parallel.cluster", spec_path,
+                    os.path.join(tmp, f"worker_{rank}.a{attempt}.log"),
+                    devices_per_worker=devices_per_worker)
             except OSError as e:
                 return "startup", (f"spawning worker {rank} failed: "
                                    f"{type(e).__name__}: {e}"), [rank]
+            logs.append(lf)
+            procs.append(proc)
 
         # poll ALL workers against one shared deadline: the first crash
         # kills the survivors immediately (they would otherwise hang in

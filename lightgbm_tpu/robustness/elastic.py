@@ -125,6 +125,23 @@ def read_heartbeat(path: str) -> Optional[dict]:
         return None
 
 
+def age_state(age_s: float, *, interval_s: float, timeout_s: float) -> str:
+    """Classify a heartbeat by wall-clock AGE alone (serving-replica
+    liveness, serving/fleet.py).  Training liveness is round-anchored —
+    :meth:`HeartbeatMonitor.classify` calls a marker carrying the
+    expected round healthy regardless of age — but serving replicas beat
+    on wall time with no round to anchor on, so state is pure staleness:
+    fresh under two beat intervals is HEALTHY (one marker may always be
+    in flight), silent past ``timeout_s`` is DEAD (evict + respawn), and
+    the band between is SUSPECT — deprioritized by the router, not
+    evicted."""
+    if age_s >= float(timeout_s):
+        return DEAD
+    if age_s >= 2.0 * float(interval_s):
+        return SUSPECT
+    return HEALTHY
+
+
 @dataclass
 class LivenessReport:
     """One classification pass over the live ranks at a given round."""

@@ -65,6 +65,16 @@ class FaultSpec:
     monitor's file-level view this is indistinguishable from death, so
     it IS evicted; the drill that asserts this documents the monitor's
     observability boundary).
+
+    Serving-fleet drills (tools/fault_drill.py ``serve_*`` scenarios)
+    reuse the same record against replica PROCESSES: ``"kill_replica"``
+    (SIGKILL the replica in slot ``rank`` after ``seconds`` of load —
+    the router must fail requests over, evict within
+    ``fleet_heartbeat_timeout_s``, respawn and re-warm) and
+    ``"stall_replica"`` (SIGSTOP for ``seconds`` then SIGCONT: frozen
+    heartbeats mark it suspect, requests route around it, and it must
+    rejoin WITHOUT being evicted when the stall is under the timeout).
+    ``at_round`` is meaningless for serving faults and stays 0.
     """
     kind: str
     rank: int
@@ -90,6 +100,26 @@ def stall_worker(rank: int, seconds: float,
     bounded wait + warning + ``elastic_slow_worker_rounds`` — and must
     NOT evict."""
     return FaultSpec("stall", int(rank), int(at_round), float(seconds))
+
+
+def kill_replica(slot: int, after_s: float = 0.0) -> FaultSpec:
+    """The serving replica in ``slot`` is SIGKILLed ``after_s`` seconds
+    into the drill's open-loop load window.  The fleet contract under
+    this fault: zero failed CLIENT requests (in-flight work on the dead
+    replica fails over within its deadline budget), eviction within
+    ``fleet_heartbeat_timeout_s``, then respawn -> warm-from-manifest ->
+    rejoin — the journal narrates ``replica_dead -> replica_evicted ->
+    replica_spawned -> replica_rejoined``."""
+    return FaultSpec("kill_replica", int(slot), 0, float(after_s))
+
+
+def stall_replica(slot: int, seconds: float) -> FaultSpec:
+    """The serving replica in ``slot`` freezes (SIGSTOP) for ``seconds``
+    then resumes (SIGCONT) — a GC pause or a host hiccup, not a death.
+    With ``seconds`` under ``fleet_heartbeat_timeout_s`` the router must
+    classify it SUSPECT (deprioritized; its requests fail over), must
+    NOT evict, and must route to it again once its heartbeats resume."""
+    return FaultSpec("stall_replica", int(slot), 0, float(seconds))
 
 
 def drop_heartbeats(rank: int, at_round: int = 0) -> FaultSpec:

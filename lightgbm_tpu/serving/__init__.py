@@ -24,12 +24,31 @@ count *finite and front-loaded*:
 Measured with ``tools/bench_serve.py``; compile programs are counted by
 the obs/compile_events.py listener, and the tier-1 gate asserts ZERO
 new lowerings over >= 100 mixed-shape steady-state requests.
+
+PR 12 adds the replicated tier on top (``serving_replicas`` config
+key, default 0 = everything below this line stays off):
+
+  * ``fleet.FleetServer`` — router over N replica processes (each a
+    full ``PredictionServer``) with heartbeat-driven lifecycle
+    (evict/respawn/re-warm), deadline-budgeted failover and per-replica
+    Prometheus families.
+  * ``fleet.FleetRegistry`` — persisted model manifest whose
+    ``publish`` performs the rolling drain-warm-swap across replicas,
+    committing only after the whole fleet converged (aborted rollouts
+    roll back; respawns warm the committed version).
+
+Drilled by ``tools/fault_drill.py`` ``serve_kill`` / ``serve_stall`` /
+``serve_swap_abort``; loaded by ``tools/bench_serve.py --open-loop``.
 """
 
 from .buckets import BucketLadder
+from .fleet import (FleetRegistry, FleetRequestFailed, FleetServer,
+                    RollingSwapAborted)
 from .predictor import CompiledPredictor, StandaloneUnsupported
 from .registry import ModelRegistry
-from .server import PredictionServer
+from .server import PredictionServer, ServerOverloaded
 
 __all__ = ["BucketLadder", "CompiledPredictor", "StandaloneUnsupported",
-           "ModelRegistry", "PredictionServer"]
+           "ModelRegistry", "PredictionServer", "ServerOverloaded",
+           "FleetServer", "FleetRegistry", "FleetRequestFailed",
+           "RollingSwapAborted"]

@@ -35,6 +35,10 @@ from .registry import ModelEntry, ModelRegistry
 #: realistic request rate
 _WINDOW_MAX = 4096
 
+#: default bound (ms) on how long close() waits for in-flight requests
+#: to drain before tearing the server down anyway
+_CLOSE_DRAIN_MS = 5000.0
+
 
 class ServerOverloaded(Exception):
     """Request rejected by admission control — either the in-flight
@@ -59,6 +63,10 @@ class PredictionServer:
         #: of a queue depth; admission is fast so this gauge spikes only
         #: under contention on the admission lock itself
         self._pending = 0
+        #: set by close(): new requests are rejected with the typed
+        #: ServerOverloaded path while the drain runs, so a shutdown
+        #: race never surfaces as a half-torn registry lookup
+        self._closing = False
         self._inflight_lock = threading.Lock()
         #: rolling completion window for the live metrics snapshot:
         #: (wall time, latency_s, rows) per served request
@@ -138,11 +146,30 @@ class PredictionServer:
         waiting; finishing the predict would burn device time on an
         answer nobody reads).  Rejections are counted on
         ``serve_rejected_requests`` / ``serve_deadline_exceeded``."""
+        out, _ = self.serve(name, X, raw_score=raw_score,
+                            deadline_ms=deadline_ms)
+        return out
+
+    def serve(self, name: str, X, raw_score: bool = True,
+              deadline_ms: Optional[float] = None):
+        """``predict`` plus provenance: returns ``(out, version)`` where
+        ``version`` is the registry version that actually served the
+        request.  The entry is resolved exactly once, so the returned
+        version IS the single version behind every row of ``out`` — the
+        primitive the fleet router's rolling-swap version fence stamps
+        into replica responses (serving/fleet.py)."""
         t_admit = time.perf_counter()
         with self._inflight_lock:
             self._pending += 1
             self.metrics.set_gauge("serve_queue_depth", self._pending)
         try:
+            if self._closing:
+                count_event("serve_rejected_requests", 1, self.metrics)
+                emit_event("serve_overload_rejected", model=name,
+                           reason="server_closing")
+                self._feed_tower()
+                raise ServerOverloaded(
+                    "server is closing; new work rejected")
             if deadline_ms is not None and float(deadline_ms) <= 0:
                 count_event("serve_deadline_exceeded", 1, self.metrics)
                 count_event("serve_rejected_requests", 1, self.metrics)
@@ -201,7 +228,7 @@ class PredictionServer:
             self._window.append((time.time(), latency_s, stats.rows))
         self._feed_tower(latency_s=latency_s)
         self._emit(entry, stats, latency_s, raw_score)
-        return out
+        return out, entry.version
 
     def inflight(self) -> int:
         """Currently admitted (executing) request count."""
@@ -364,7 +391,22 @@ class PredictionServer:
                 lines.extend(prom.slo_lines(self._tower.slo_state()))
         return prom.render(lines)
 
-    def close(self) -> None:
+    def close(self, deadline_ms: Optional[float] = None) -> bool:
+        """Graceful shutdown: new requests are rejected immediately via
+        the typed :class:`ServerOverloaded` path, in-flight requests are
+        drained (bounded by ``deadline_ms``, default 5 s) and only then
+        are the predictors unpublished and the sinks torn down — a
+        racing ``predict()`` never observes a half-torn registry.
+        Returns ``True`` when the drain completed before the bound."""
+        self._closing = True
+        budget_ms = _CLOSE_DRAIN_MS if deadline_ms is None \
+            else float(deadline_ms)
+        deadline = time.perf_counter() + max(0.0, budget_ms) / 1000.0
+        while self.inflight() > 0 and time.perf_counter() < deadline:
+            time.sleep(0.001)
+        drained = self.inflight() == 0
+        for name in self.registry.names():
+            self.registry.unpublish(name)
         if self._tower is not None:
             with self._tower_lock:
                 self._tower.close()
@@ -372,3 +414,4 @@ class PredictionServer:
             if self._tele_file is not None:
                 self._tele_file.close()
                 self._tele_file = None
+        return drained

@@ -1,0 +1,17 @@
+"""Fixture: blocking reads with no timeout (RBS502 must fire)."""
+
+
+def drain_result_queue(q):
+    # blocking queue read: a dead producer hangs this forever
+    return q.get()
+
+
+def wait_for_reply(conn):
+    # block=True without timeout= is the same hazard spelled out
+    return conn.get(block=True)
+
+
+def read_frame(sock):
+    # no settimeout() anywhere in this scope
+    header = sock.recv(4)
+    return header

@@ -1,0 +1,23 @@
+"""Fixture: every blocking read carries a visible bound (RBS502 quiet)."""
+
+
+def drain_result_queue(q, opts):
+    item = q.get(timeout=2.0)        # bounded queue read
+    mode = opts.get("mode")          # dict idiom: never blocks
+    fallback = opts.get("mode", "x")
+    return item, mode, fallback
+
+
+def poll_result_queue(q):
+    return q.get(block=False)        # non-blocking read
+
+
+def read_frame(sock):
+    sock.settimeout(2.0)             # bound every later read
+    return sock.recv(4)
+
+
+def fetch(address):
+    import socket
+    conn = socket.create_connection(address, 1.5)   # timeout lands on conn
+    return conn.recv(4)

@@ -1,0 +1,134 @@
+"""Replicated serving fleet tests (lightgbm_tpu/serving/fleet.py).
+
+The fleet contract under test:
+
+  * default OFF — ``serving_replicas`` defaults to 0 and
+    ``FleetServer`` refuses to build, so the single-process
+    ``PredictionServer`` path is untouched (no processes, no files);
+  * parity — a fleet answer is ``np.array_equal`` to
+    ``Booster.predict`` on the same rows (each replica is a full
+    bucketed ``PredictionServer``);
+  * failover — SIGKILL of a replica under load loses ZERO client
+    requests (``request_failover`` absorbs it) and the slot is
+    evicted, respawned, warmed from the manifest and rejoined;
+  * rolling swap — ``publish`` of a new version converges every
+    replica and every response carries exactly one version.
+
+The heavier end-to-end narrative (eviction latency, journal ordering,
+swap ABORT + rollback) lives in ``tools/fault_drill.py``
+``serve_*`` scenarios, gated by ``--quick`` in tests/test_elastic.py.
+"""
+
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.serving import FleetServer, PredictionServer
+from lightgbm_tpu.utils.log import LightGBMError
+
+pytestmark = pytest.mark.skipif(
+    os.name == "nt", reason="fleet replicas use POSIX signals")
+
+
+@pytest.fixture(scope="module")
+def fleet_model():
+    rng = np.random.default_rng(11)
+    X = rng.normal(size=(300, 5))
+    y = np.sum(X[:, :2], axis=1) + rng.normal(scale=0.1, size=300)
+    b1 = lgb.train({"objective": "regression", "num_iterations": 5,
+                    "num_leaves": 7, "min_data_in_leaf": 5,
+                    "verbosity": -1}, lgb.Dataset(X, label=y))
+    b2 = lgb.train({"objective": "regression", "num_iterations": 5,
+                    "num_leaves": 7, "min_data_in_leaf": 5,
+                    "learning_rate": 0.3, "verbosity": -1},
+                   lgb.Dataset(X, label=y))
+    return b1, b2, X
+
+
+@pytest.fixture(scope="module")
+def fleet(fleet_model, tmp_path_factory):
+    _, _, _ = fleet_model
+    srv = FleetServer(
+        {"serving_replicas": 2, "serving_buckets": [1, 8],
+         "fleet_heartbeat_interval_s": 0.2,
+         "fleet_heartbeat_timeout_s": 1.5},
+        workdir=str(tmp_path_factory.mktemp("fleet")))
+    yield srv
+    srv.close()
+
+
+def test_serving_replicas_defaults_off():
+    cfg = Config({})
+    assert cfg.serving_replicas == 0
+    with pytest.raises(LightGBMError, match="serving_replicas"):
+        FleetServer({"serving_replicas": 0})
+    # the single-process path neither reads fleet state nor spawns
+    # anything — construction is the same as before the fleet existed
+    server = PredictionServer({"serving_buckets": [1, 8]})
+    assert server.inflight() == 0
+    server.close()
+
+
+def test_fleet_parity_and_provenance(fleet, fleet_model):
+    b1, _, X = fleet_model
+    v = fleet.publish("m", booster=b1)
+    assert v == 1
+    r = fleet.predict_ex("m", X[:5])
+    assert r["version"] == 1 and r["failovers"] == 0
+    assert r["replica"] in (0, 1)
+    ref = b1.predict(X[:5], raw_score=True)
+    assert np.array_equal(np.asarray(r["out"]).ravel(), ref.ravel())
+    # unknown model surfaces the registry's typed error, not a retry loop
+    with pytest.raises(LightGBMError, match="no model named"):
+        fleet.predict("nope", X[:3])
+
+
+def test_fleet_rolling_swap_converges(fleet, fleet_model):
+    _, b2, X = fleet_model
+    v2 = fleet.publish("m", booster=b2)
+    assert v2 == 2
+    live = fleet.replica_versions()
+    assert live and all(m["m"] == 2 for m in live.values())
+    assert int(fleet.registry.current("m")["version"]) == 2
+    r = fleet.predict_ex("m", X[:3])
+    assert r["version"] == 2
+    ref = b2.predict(X[:3], raw_score=True)
+    assert np.array_equal(np.asarray(r["out"]).ravel(), ref.ravel())
+
+
+def test_fleet_kill_failover_zero_errors(fleet, fleet_model):
+    _, b2, X = fleet_model
+    pids = fleet.replica_pids()
+    os.kill(pids[0], signal.SIGKILL)
+    # every request during death + eviction + respawn must still answer
+    for _ in range(20):
+        out = fleet.predict("m", X[:3], deadline_ms=10_000)
+        assert out.shape[0] == 3
+        time.sleep(0.02)
+    assert fleet.metrics.counter("fleet_request_failovers") >= 1
+    deadline = time.monotonic() + 60.0
+    while time.monotonic() < deadline:
+        if all(s == "healthy" for s in fleet.states().values()):
+            break
+        time.sleep(0.1)
+    assert all(s == "healthy" for s in fleet.states().values())
+    assert fleet.metrics.counter("fleet_replica_respawns") >= 1
+    # the rejoined replica warmed the committed manifest version
+    live = fleet.replica_versions()
+    assert live and all(m["m"] == 2 for m in live.values())
+
+
+def test_fleet_snapshot_and_prometheus(fleet):
+    snap = fleet.metrics_snapshot(window_s=60.0)
+    assert snap["requests_in_window"] >= 1
+    assert {r["slot"] for r in snap["replicas"]} == {0, 1}
+    assert snap["counters"]["serve_requests"] >= 1
+    txt = fleet.prometheus_text()
+    assert "fleet_latency_ms" in txt
+    assert 'fleet_replica_state{replica="0"}' in txt
+    assert "fleet_replica_model_version" in txt

@@ -601,3 +601,50 @@ def test_admission_rejection_releases_slot(reg_model):
 def test_serving_max_inflight_config_validation():
     with pytest.raises(lgb.LightGBMError):
         PredictionServer({"serving_max_inflight": 0})
+
+
+def test_close_drains_inflight_and_rejects_new(reg_model):
+    """Graceful shutdown contract (PR 12): ``close()`` lets admitted
+    requests FINISH (bounded by its deadline) while new arrivals get
+    the typed ``ServerOverloaded`` rejection — never an exception from
+    a half-torn registry — and the registry empties only after the
+    drain.  Hammered from concurrent threads to chase the race."""
+    from lightgbm_tpu.serving.server import ServerOverloaded
+    bst, X = reg_model
+    srv = PredictionServer({"serving_buckets": [1, 8]})
+    srv.publish("m", booster=bst)
+    Xq = X[:8]
+    srv.predict("m", Xq)                 # warm: requests are now fast
+
+    results = {"ok": 0, "rejected": 0, "other": []}
+    lock = threading.Lock()
+    start = threading.Barrier(9)
+
+    def _hammer():
+        start.wait()
+        for _ in range(40):
+            try:
+                srv.predict("m", Xq)
+                with lock:
+                    results["ok"] += 1
+            except ServerOverloaded:
+                with lock:
+                    results["rejected"] += 1
+            except Exception as e:       # the race close() must not lose
+                with lock:
+                    results["other"].append(f"{type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=_hammer) for _ in range(8)]
+    for t in threads:
+        t.start()
+    start.wait()                         # close lands mid-hammer
+    drained = srv.close(deadline_ms=10_000)
+    for t in threads:
+        t.join(timeout=30.0)
+    assert results["other"] == []        # only served or typed-rejected
+    assert results["rejected"] >= 1      # close() really did shed work
+    assert drained is True
+    assert srv.inflight() == 0
+    assert len(srv.registry) == 0        # torn down only after drain
+    with pytest.raises(ServerOverloaded, match="closing"):
+        srv.predict("m", Xq)

@@ -61,6 +61,7 @@ RULE_FIXTURES = [
     ("TPU106", "parallel/tpu106_bad.py", "parallel/tpu106_ok.py"),
     ("GRW401", "learner/grw401_bad.py", "learner/grw401_ok.py"),
     ("RBS501", "rbs501_bad.py", "rbs501_ok.py"),
+    ("RBS502", "serving/rbs502_bad.py", "serving/rbs502_ok.py"),
     ("OBS302", "obs302_bad.py", "obs302_ok.py"),
     ("OBS303", "obs303_bad.py", "obs303_ok.py"),
 ]

@@ -19,9 +19,24 @@ The JSON payload is tagged ``kind="serve"`` and feeds
 tools/bench_compare.py, which gates on per-bucket p99 (lower is
 better) with the usual 0/1/2 exit convention.
 
+``--open-loop`` switches from the closed loop (next request leaves when
+the previous one returns — measures service time) to an OPEN loop:
+requests arrive on a fixed wall-clock schedule (``--rate`` per second)
+regardless of completions, each dispatched from its own thread — the
+queueing regime a real front-end sees, where a slow server builds
+backlog instead of slowing the offered load.  With ``--replicas N`` the
+open loop drives a ``serving.FleetServer`` (N replica processes behind
+the failover router) instead of an in-process ``PredictionServer``; the
+payload gains ``errors`` (requests that failed outright — the fleet
+contract says 0) and ``achieved_rps``, and keeps the same
+``overall``/``buckets`` p99 shape so bench_compare's serve gate reads
+it unchanged.
+
 Usage:
   python tools/bench_serve.py --requests 200 --trees 20 \
       --buckets 1,8,64,512 --out /tmp/SERVE_new.json --format json
+  python tools/bench_serve.py --open-loop --rate 80 --replicas 3 \
+      --requests 400 --buckets 1,8,64
 """
 
 from __future__ import annotations
@@ -157,6 +172,131 @@ def run(requests: int, features: int, trees: int, leaves: int,
     }
 
 
+def run_open_loop(requests: int, features: int, trees: int, leaves: int,
+                  buckets: List[int], seed: int, raw_score: bool,
+                  rate: float, replicas: int) -> Dict[str, Any]:
+    """Open-loop arrival generator: request ``i`` is dispatched at
+    ``t0 + i/rate`` from its own thread whether or not earlier requests
+    returned.  Latency therefore includes QUEUEING under backlog, which
+    is the number an operator's p99 SLO is actually about."""
+    import threading
+
+    import jax
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.serving import BucketLadder
+
+    rng = np.random.default_rng(seed)
+    n_train = max(4000, 4 * leaves)
+    Xt = rng.normal(size=(n_train, features))
+    y = np.sum(Xt[:, : max(1, features // 2)], axis=1) \
+        + rng.normal(scale=0.1, size=n_train)
+    booster = lgb.train(
+        {"objective": "regression", "num_iterations": trees,
+         "num_leaves": leaves, "min_data_in_leaf": 5, "verbosity": -1},
+        lgb.Dataset(Xt, label=y))
+
+    params: Dict[str, Any] = {"serving_buckets": buckets}
+    if replicas > 0:
+        from lightgbm_tpu.serving import FleetServer
+        params["serving_replicas"] = replicas
+        target = FleetServer(params)
+    else:
+        from lightgbm_tpu.serving import PredictionServer
+        target = PredictionServer(params)
+    try:
+        t0 = time.perf_counter()
+        target.publish("bench", booster=booster)
+        publish_s = time.perf_counter() - t0
+
+        sizes = _request_sizes(buckets, requests, rng)
+        X = rng.normal(size=(max(sizes), features))
+        for b in buckets:            # steady state before the clock runs
+            target.predict("bench", X[:b], raw_score=raw_score)
+
+        ladder = BucketLadder(buckets)
+        lock = threading.Lock()
+        done: List[Any] = []         # (n, latency_s, error_or_None)
+
+        def _one(n: int) -> None:
+            t1 = time.perf_counter()
+            err = None
+            try:
+                target.predict("bench", X[:n], raw_score=raw_score)
+            except Exception as e:
+                err = f"{type(e).__name__}: {e}"
+            with lock:
+                done.append((n, time.perf_counter() - t1, err))
+
+        threads: List[threading.Thread] = []
+        t_stream0 = time.perf_counter()
+        for i, n in enumerate(sizes):
+            due = t_stream0 + i / rate
+            wait = due - time.perf_counter()
+            if wait > 0:
+                time.sleep(wait)
+            th = threading.Thread(target=_one, args=(n,), daemon=True)
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join(timeout=60.0)
+        stream_s = time.perf_counter() - t_stream0
+
+        ok = [(n, dt) for n, dt, err in done if err is None]
+        errors = [err for _, _, err in done if err is not None]
+        per_bucket_lat: Dict[int, List[float]] = {b: [] for b in buckets}
+        per_bucket_rows: Dict[int, int] = {b: 0 for b in buckets}
+        for n, dt in ok:
+            b = ladder.bucket_for(n)
+            per_bucket_lat[b].append(dt)
+            per_bucket_rows[b] += n
+        bucket_rows: Dict[str, Any] = {}
+        for b in buckets:
+            lat = per_bucket_lat[b]
+            if not lat:
+                continue
+            row = _pcts(lat)
+            row.update({"requests": len(lat),
+                        "rows": per_bucket_rows[b],
+                        "rows_per_s": per_bucket_rows[b] / stream_s
+                        if stream_s > 0 else 0.0,
+                        "run_s": float(sum(lat)),
+                        "compile_s": 0.0})
+            bucket_rows[str(b)] = row
+        overall = _pcts([dt for _, dt in ok]) if ok else \
+            {"p50_ms": 0.0, "p95_ms": 0.0, "p99_ms": 0.0}
+        overall.update({"requests": len(ok),
+                        "rows": int(sum(per_bucket_rows.values())),
+                        "rows_per_s": sum(per_bucket_rows.values())
+                        / stream_s if stream_s > 0 else 0.0,
+                        "run_s": stream_s})
+        return {
+            "tool": "bench_serve",
+            "kind": "serve",
+            "mode": "open_loop",
+            "metric": "serve_openloop_f%d_t%d_l%d_r%g"
+                      % (features, trees, leaves, rate),
+            "platform": jax.default_backend(),
+            "requests": requests,
+            "raw_score": raw_score,
+            "rate_rps": float(rate),
+            "achieved_rps": len(done) / stream_s if stream_s > 0 else 0.0,
+            "replicas": int(replicas),
+            "errors": len(errors),
+            "error_samples": errors[:5],
+            "buckets": bucket_rows,
+            "overall": overall,
+            "publish_s": publish_s,
+            # the recompile contract is measured by the closed loop
+            # (in-process counter); replica processes own their own
+            "steady_lowerings": 0,
+            "counters": {},
+        }
+    finally:
+        if replicas > 0:
+            target.close()
+
+
 def _render_text(payload: Dict[str, Any]) -> str:
     lines = ["bench_serve: %s on %s (%d requests)"
              % (payload["metric"], payload["platform"],
@@ -173,8 +313,14 @@ def _render_text(payload: Dict[str, Any]) -> str:
     lines.append("  %-8s %6d %9.3f %9.3f %9.3f %12.0f"
                  % ("overall", o["requests"], o["p50_ms"], o["p95_ms"],
                     o["p99_ms"], o["rows_per_s"]))
-    lines.append("  steady-state lowerings: %d (contract: 0)"
-                 % payload["steady_lowerings"])
+    if payload.get("mode") == "open_loop":
+        lines.append("  open loop: offered %.1f rps, achieved %.1f rps, "
+                     "%d replica(s), %d error(s)"
+                     % (payload["rate_rps"], payload["achieved_rps"],
+                        payload["replicas"], payload["errors"]))
+    else:
+        lines.append("  steady-state lowerings: %d (contract: 0)"
+                     % payload["steady_lowerings"])
     return "\n".join(lines)
 
 
@@ -191,6 +337,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--converted", action="store_true",
                     help="serve converted scores instead of raw margins")
+    ap.add_argument("--open-loop", action="store_true",
+                    help="fixed-rate arrivals (queueing regime) instead "
+                         "of the closed measurement loop")
+    ap.add_argument("--rate", type=float, default=50.0,
+                    help="open-loop offered load, requests per second")
+    ap.add_argument("--replicas", type=int, default=0,
+                    help="open-loop only: drive a FleetServer with this "
+                         "many replica processes (0 = in-process server)")
     ap.add_argument("--out", default="",
                     help="also write the JSON payload to this path")
     _report.add_format_arg(ap)
@@ -199,9 +353,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         buckets = sorted({int(b) for b in args.buckets.split(",") if b})
         if not buckets or any(b <= 0 for b in buckets):
             raise ValueError("--buckets needs positive row counts")
-        payload = run(args.requests, args.features, args.trees,
-                      args.leaves, buckets, args.seed,
-                      raw_score=not args.converted)
+        if args.open_loop:
+            if args.rate <= 0:
+                raise ValueError("--rate needs a positive request rate")
+            payload = run_open_loop(
+                args.requests, args.features, args.trees, args.leaves,
+                buckets, args.seed, raw_score=not args.converted,
+                rate=args.rate, replicas=max(0, args.replicas))
+        else:
+            payload = run(args.requests, args.features, args.trees,
+                          args.leaves, buckets, args.seed,
+                          raw_score=not args.converted)
     except ValueError as e:
         print("bench_serve: error: %s" % e, file=sys.stderr)
         return _report.EXIT_ERROR
@@ -209,10 +371,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         with open(args.out, "w") as f:
             json.dump(payload, f, indent=2, sort_keys=True)
     _report.emit(payload, args.format, _render_text)
-    # a nonzero steady-state lowering count is an actionable finding:
-    # the zero-recompile contract is broken
-    return _report.EXIT_FINDINGS if payload["steady_lowerings"] > 0 \
-        else _report.EXIT_OK
+    # actionable findings: a broken zero-recompile contract (closed
+    # loop) or failed client requests (open loop — the fleet contract
+    # says failover absorbs replica faults)
+    return _report.EXIT_FINDINGS \
+        if (payload["steady_lowerings"] > 0
+            or payload.get("errors", 0) > 0) else _report.EXIT_OK
 
 
 if __name__ == "__main__":

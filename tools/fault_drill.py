@@ -13,7 +13,8 @@ size AND to the serial learner, and every checkpoint manifest in the
 chain the resume walked must sha256-validate
 (tools/checkpoint_inspect.py ``--verify-all`` semantics).
 
-Scenarios (``--quick`` runs only the first — the tier-1 CI gate):
+Scenarios (``--quick`` runs the first training one AND the first
+serving one — together the tier-1 CI gate):
 
   kill        worker killed mid-run -> heartbeat silence -> eviction ->
               mesh reshape -> checkpoint resume -> bit-identity verify
@@ -28,6 +29,22 @@ Scenarios (``--quick`` runs only the first — the tier-1 CI gate):
               reproduces the reduced-mesh model bit-for-bit
   fail_fast   same kill with ``elastic=off`` -> today's fail-fast error,
               no recovery attempted
+
+Serving-fleet scenarios (serving/fleet.py, PR 12):
+
+  serve_kill        SIGKILL one of 3 replicas under client load ->
+                    ZERO failed requests (in-flight work fails over),
+                    eviction within ``fleet_heartbeat_timeout_s``,
+                    respawn + warm-from-manifest + rejoin; the journal
+                    narrates ``replica_dead -> replica_evicted ->
+                    replica_spawned -> replica_rejoined``
+  serve_stall       SIGSTOP a replica for LESS than the heartbeat
+                    timeout -> requests route around it, NO eviction,
+                    replica serves again after SIGCONT
+  serve_swap_abort  kill a replica mid rolling hot-swap -> rollout
+                    aborts (``rolling_swap_aborted``), already-swapped
+                    replicas roll back, every response carries exactly
+                    one model version, fleet converges on the OLD one
 
 Exit codes (tools/_report.py convention):
   0 — every scenario passed
@@ -248,6 +265,239 @@ def scenario_fail_fast(X, y, rounds, workers):
             "passed": all(checks.values())}
 
 
+# ------------------------------------------------------------ serving fleet
+#: 3 replicas, sub-second liveness, tiny two-bucket ladder — the
+#: smallest fleet where "kill one" leaves a quorum to fail over to
+_SERVE_PARAMS = dict(serving_buckets=[1, 8], serving_replicas=3,
+                     serving_retry_budget=2,
+                     fleet_heartbeat_interval_s=0.2,
+                     fleet_heartbeat_timeout_s=1.0,
+                     slo_config="on", rollup_window_s=0.5, verbosity=-1)
+
+
+def _serve_boosters(X, y):
+    """Two tiny distinguishable models: v1 to serve, v2 to roll to."""
+    import lightgbm_tpu as lgb
+    p = dict(objective="binary", num_leaves=7, min_data_in_leaf=5,
+             deterministic=True, seed=7, verbosity=-1)
+    b1 = lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=3)
+    b2 = lgb.train(dict(p, learning_rate=0.3), lgb.Dataset(X, label=y),
+                   num_boost_round=3)
+    return b1, b2
+
+
+def _journal_events(path: str) -> List[str]:
+    from lightgbm_tpu.obs.events import read_journal
+    return [e.get("event", "?") for e in read_journal(path)]
+
+
+def _eviction_ordered(evs: List[str]) -> bool:
+    """``replica_dead -> replica_evicted -> replica_spawned ->
+    replica_rejoined`` in order, starting the search at the death (the
+    startup ``replica_spawned`` burst precedes it and must not
+    satisfy the respawn step)."""
+    i = 0
+    try:
+        for name in ("replica_dead", "replica_evicted",
+                     "replica_spawned", "replica_rejoined"):
+            i = evs.index(name, i) + 1
+    except ValueError:
+        return False
+    return True
+
+
+def scenario_serve_kill(X, y):
+    import time
+
+    from lightgbm_tpu.robustness.faults import kill_replica
+    from lightgbm_tpu.serving import FleetServer
+    b1, _ = _serve_boosters(X, y)
+    errs: List[str] = []
+    versions = set()
+    evict_s = None
+    with tempfile.TemporaryDirectory() as td:
+        ev = os.path.join(td, "serve_events.jsonl")
+        fleet = FleetServer(dict(_SERVE_PARAMS, event_output=ev),
+                            workdir=td)
+        try:
+            fleet.publish("m", booster=b1)
+            timeout_s = fleet.hb_timeout_s
+            t0 = time.monotonic()
+            killed_at = None
+            while time.monotonic() - t0 < 45.0:
+                try:
+                    r = fleet.predict_ex("m", X[:3], deadline_ms=10_000)
+                    versions.add(r["version"])
+                except Exception as e:          # noqa: BLE001 — tallied
+                    errs.append(f"{type(e).__name__}: {e}")
+                now = time.monotonic()
+                if killed_at is None and now - t0 >= 0.5:
+                    fleet.inject(kill_replica(0))
+                    killed_at = now
+                if killed_at is not None and evict_s is None and \
+                        fleet.metrics.counter(
+                            "fleet_replica_respawns") >= 1:
+                    evict_s = now - killed_at
+                if evict_s is not None and all(
+                        s == "healthy"
+                        for s in fleet.states().values()):
+                    break                       # respawn rejoined
+                time.sleep(0.02)
+            recovered = all(s == "healthy"
+                            for s in fleet.states().values())
+            failovers = int(fleet.metrics.counter(
+                "fleet_request_failovers"))
+        finally:
+            fleet.close()
+        evs = _journal_events(ev)
+        from lightgbm_tpu.obs.events import journal_tail
+        tail = journal_tail(ev)
+    checks = {
+        "zero_failed_requests": not errs,
+        "failover_absorbed_kill": failovers >= 1
+        and "request_failover" in evs,
+        "evicted_within_timeout": evict_s is not None
+        and evict_s <= timeout_s + 1.0,
+        "respawned_and_rejoined": recovered
+        and "replica_rejoined" in evs,
+        "journal_ordered": _eviction_ordered(evs),
+        "single_version_responses": versions == {1},
+    }
+    return {"name": "serve_kill", "checks": checks,
+            "eviction_latency_s": evict_s, "failovers": failovers,
+            "request_errors": errs[:5], "journal_tail": tail,
+            "watchtower": _watchtower_summary(tail),
+            "passed": all(checks.values())}
+
+
+def scenario_serve_stall(X, y):
+    import time
+
+    from lightgbm_tpu.robustness.faults import stall_replica
+    from lightgbm_tpu.serving import FleetServer
+    b1, _ = _serve_boosters(X, y)
+    errs: List[str] = []
+    with tempfile.TemporaryDirectory() as td:
+        ev = os.path.join(td, "serve_events.jsonl")
+        fleet = FleetServer(dict(_SERVE_PARAMS, event_output=ev),
+                            workdir=td)
+        try:
+            fleet.publish("m", booster=b1)
+            fleet.inject(stall_replica(1, seconds=0.5))
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < 3.0:   # through stall + resume
+                try:
+                    fleet.predict("m", X[:3], deadline_ms=10_000)
+                except Exception as e:          # noqa: BLE001 — tallied
+                    errs.append(f"{type(e).__name__}: {e}")
+                time.sleep(0.02)
+            respawns = int(fleet.metrics.counter(
+                "fleet_replica_respawns"))
+            healthy_after = all(s == "healthy"
+                                for s in fleet.states().values())
+        finally:
+            fleet.close()
+        evs = _journal_events(ev)
+        from lightgbm_tpu.obs.events import journal_tail
+        tail = journal_tail(ev)
+    checks = {
+        "zero_failed_requests": not errs,
+        "not_evicted": respawns == 0 and "replica_evicted" not in evs,
+        "serves_after_resume": healthy_after,
+    }
+    return {"name": "serve_stall", "checks": checks,
+            "request_errors": errs[:5], "journal_tail": tail,
+            "watchtower": _watchtower_summary(tail),
+            "passed": all(checks.values())}
+
+
+def scenario_serve_swap_abort(X, y):
+    import threading
+    import time
+
+    from lightgbm_tpu.robustness.faults import kill_replica
+    from lightgbm_tpu.serving import FleetServer, RollingSwapAborted
+    b1, b2 = _serve_boosters(X, y)
+    errs: List[str] = []
+    versions = set()
+    outcome: Dict[str, Any] = {}
+    with tempfile.TemporaryDirectory() as td:
+        ev = os.path.join(td, "serve_events.jsonl")
+        fleet = FleetServer(dict(_SERVE_PARAMS, event_output=ev),
+                            workdir=td)
+        try:
+            v1 = fleet.publish("m", booster=b1)
+
+            stop = threading.Event()
+
+            def _load() -> None:
+                while not stop.is_set():
+                    try:
+                        r = fleet.predict_ex("m", X[:3],
+                                             deadline_ms=10_000)
+                        versions.add(r["version"])
+                    except Exception as e:      # noqa: BLE001 — tallied
+                        errs.append(f"{type(e).__name__}: {e}")
+                    time.sleep(0.01)
+
+            loader = threading.Thread(target=_load, daemon=True)
+            loader.start()
+
+            # the drill seam fires after each per-replica swap: the
+            # moment slot 0 took v2, kill slot 2 — the rollout MUST
+            # notice (dead socket or bumped incarnation) and abort
+            killed = {"done": False}
+
+            def _mid_swap_kill(slot: int) -> None:
+                if slot == 0 and not killed["done"]:
+                    killed["done"] = True
+                    fleet.inject(kill_replica(2))
+
+            fleet.swap_fault_hook = _mid_swap_kill
+            try:
+                outcome["version"] = fleet.publish("m", booster=b2)
+            except RollingSwapAborted as e:
+                outcome["aborted"] = str(e)
+            finally:
+                fleet.swap_fault_hook = None
+
+            # convergence: killed replica respawns warming the OLD
+            # manifest (the abort never committed v2)
+            deadline = time.monotonic() + 45.0
+            while time.monotonic() < deadline:
+                if all(s == "healthy"
+                       for s in fleet.states().values()):
+                    break
+                time.sleep(0.1)
+            stop.set()
+            loader.join(timeout=15.0)
+            live = fleet.replica_versions()
+            manifest = fleet.registry.current("m")
+        finally:
+            fleet.close()
+        evs = _journal_events(ev)
+        from lightgbm_tpu.obs.events import journal_tail
+        tail = journal_tail(ev)
+    checks = {
+        "rollout_aborted": "aborted" in outcome,
+        "journal_has_abort": "rolling_swap_aborted" in evs,
+        "manifest_kept_old_version":
+            manifest is not None and int(manifest["version"]) == v1,
+        "fleet_converged_on_old_version":
+            bool(live) and all(m.get("m") == v1 for m in live.values()),
+        "zero_failed_requests": not errs,
+        # the version fence: every response is entirely one version —
+        # v1 before/after, possibly v2 from an already-swapped replica
+        # mid-rollout, never anything else
+        "single_version_responses": versions <= {1, 2} and 1 in versions,
+    }
+    return {"name": "serve_swap_abort", "checks": checks,
+            "outcome": outcome, "versions_observed": sorted(versions),
+            "request_errors": errs[:5], "journal_tail": tail,
+            "watchtower": _watchtower_summary(tail),
+            "passed": all(checks.values())}
+
+
 def run_drill(quick: bool, rounds: int, workers: int) -> Dict[str, Any]:
     X, y = _data()
     scenarios: List[Dict[str, Any]] = [scenario_kill(X, y, rounds, workers)]
@@ -257,6 +507,12 @@ def run_drill(quick: bool, rounds: int, workers: int) -> Dict[str, Any]:
         scenarios.append(scenario_kill(X, y, rounds, workers,
                                        corrupt_newest=True))
         scenarios.append(scenario_fail_fast(X, y, rounds, workers))
+    # the serving-fleet gate: kill-one-of-three under load is part of
+    # --quick (tier-1); the stall and swap-abort drills ride the full run
+    scenarios.append(scenario_serve_kill(X, y))
+    if not quick:
+        scenarios.append(scenario_serve_stall(X, y))
+        scenarios.append(scenario_serve_swap_abort(X, y))
     return {"tool": "fault_drill", "mode": "quick" if quick else "full",
             "rounds": rounds, "workers": workers,
             "scenarios": scenarios,
