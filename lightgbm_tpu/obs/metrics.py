@@ -91,6 +91,9 @@ COUNTERS: Dict[str, str] = {
         "replica (serving/fleet.py)",
     "fleet_replica_respawns":
         "dead serving replicas respawned by the fleet monitor",
+    "fleet_replica_respawn_failures":
+        "fleet monitor per-slot poll failures (e.g. a respawn failing "
+        "at the OS level); the slot is abandoned after the limit",
     "fleet_rolling_swaps":
         "rolling hot-swaps completed across every fleet replica",
     "fleet_rolling_swap_aborts":

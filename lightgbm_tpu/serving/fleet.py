@@ -33,7 +33,11 @@ a front-end **router** (:class:`FleetServer`) that:
 Replica processes are spawned with the cluster layer's shared plumbing
 (parallel/cluster.py :func:`~..parallel.cluster.spawn_worker`: spec
 JSON + per-replica log files + ready markers) and speak a
-length-prefixed pickle protocol over a localhost TCP socket.  EVERY
+length-prefixed pickle protocol over a localhost TCP socket.  Every
+wire message in either direction leads with a per-fleet random auth
+token (distributed via the 0600 replica spec files, verified with a
+constant-time compare) so no unauthenticated local peer can ever reach
+``pickle.loads`` — pickle is arbitrary code execution.  EVERY
 blocking ``get()``/``recv()`` in this module carries a deadline
 (tpulint RBS502 ``unbounded-blocking-io``): an unbounded read is
 exactly the bug class that turns a dead replica into a hung router.
@@ -46,6 +50,7 @@ untouched.
 from __future__ import annotations
 
 import collections
+import hmac
 import json
 import os
 import pickle
@@ -99,6 +104,12 @@ _RESPAWN_LIMIT = 3
 #: wire-message size cap (refuses absurd frames before allocating)
 _MAX_MSG = 1 << 30
 
+#: length of the per-fleet auth token (hex chars, so also wire bytes);
+#: the token gates BOTH directions of every connection before any
+#: pickle.loads — an unauthenticated local peer must never reach the
+#: unpickler (pickle is arbitrary code execution)
+_AUTH_LEN = 32
+
 #: replica-slot lifecycle states beyond the heartbeat trio
 _WARMING = "warming"
 _FAILED = "failed"
@@ -122,8 +133,11 @@ class RollingSwapAborted(Exception):
 
 
 # ---------------------------------------------------------------------------
-# wire protocol: 4-byte big-endian length + pickle, one request per
-# connection.  Every read/write recomputes its socket timeout from the
+# wire protocol: auth token + 4-byte big-endian length + pickle, one
+# request per connection.  Every message in EITHER direction leads with
+# the fleet's random shared token, verified (constant time) before the
+# frame is unpickled — a local peer without the token never reaches the
+# unpickler.  Every read/write recomputes its socket timeout from the
 # caller's deadline — no unbounded recv anywhere (RBS502).
 # ---------------------------------------------------------------------------
 
@@ -134,10 +148,11 @@ def _remaining_s(deadline_mono: float) -> float:
     return rem
 
 
-def _send_msg(sock: socket.socket, obj: Any, deadline_mono: float) -> None:
+def _send_msg(sock: socket.socket, obj: Any, deadline_mono: float,
+              auth: bytes) -> None:
     payload = pickle.dumps(obj, protocol=4)
     sock.settimeout(_remaining_s(deadline_mono))
-    sock.sendall(struct.pack(">I", len(payload)) + payload)
+    sock.sendall(auth + struct.pack(">I", len(payload)) + payload)
 
 
 def _recv_exact(sock: socket.socket, n: int, deadline_mono: float) -> bytes:
@@ -153,7 +168,11 @@ def _recv_exact(sock: socket.socket, n: int, deadline_mono: float) -> bytes:
     return b"".join(chunks)
 
 
-def _recv_msg(sock: socket.socket, deadline_mono: float) -> Any:
+def _recv_msg(sock: socket.socket, deadline_mono: float,
+              auth: bytes) -> Any:
+    peer = _recv_exact(sock, len(auth), deadline_mono)
+    if not hmac.compare_digest(peer, auth):
+        raise ValueError("fleet wire auth token mismatch")
     (n,) = struct.unpack(">I", _recv_exact(sock, 4, deadline_mono))
     if n > _MAX_MSG:
         raise ValueError(f"fleet wire message of {n} bytes exceeds cap")
@@ -281,15 +300,20 @@ class FleetRegistry:
 # ---------------------------------------------------------------------------
 
 def _replica_serve_conn(server, conn: socket.socket,
-                        stop: threading.Event) -> None:
+                        stop: threading.Event, auth: bytes) -> None:
     """Handle one request connection (its own thread).  The wire
     deadline is the request's own ``deadline_ms`` budget (default
-    applies otherwise) — a stalled router cannot pin a handler
-    forever."""
+    applies otherwise; publish/unpublish get the rolling-swap window,
+    since a full-ladder warmup can far outlive the request default and
+    the reply MUST land — a publish that succeeds locally but times out
+    on the wire would leave this replica ahead of the fleet).  A
+    stalled router cannot pin a handler forever."""
     deadline = time.monotonic() + _DEFAULT_DEADLINE_MS / 1000.0
     try:
-        msg = _recv_msg(conn, deadline)
+        msg = _recv_msg(conn, deadline, auth)
         op = msg.get("op")
+        if op in ("publish", "unpublish"):
+            deadline = time.monotonic() + _SWAP_TIMEOUT_S
         if op == "predict":
             sub = msg.get("deadline_ms")
             if sub is not None:
@@ -335,7 +359,7 @@ def _replica_serve_conn(server, conn: socket.socket,
         else:
             reply = {"ok": False, "error": "BadOp",
                      "message": f"unknown op {op!r}"}
-        _send_msg(conn, reply, deadline)
+        _send_msg(conn, reply, deadline, auth)
     except (OSError, EOFError, ValueError, pickle.PickleError):
         pass          # peer vanished / torn frame: nothing to answer
     finally:
@@ -359,6 +383,7 @@ def _replica_main(spec_path: str) -> None:
         spec = json.load(fh)
     slot = int(spec["slot"])
     incarnation = int(spec["incarnation"])
+    auth = str(spec["auth"]).encode("ascii")
     params = dict(spec.get("params") or {})
     with obs_events.session(params.get("event_output"), rank=slot):
         server = PredictionServer(params)
@@ -408,7 +433,7 @@ def _replica_main(spec_path: str) -> None:
                 break
             threading.Thread(
                 target=_replica_serve_conn,
-                args=(server, conn, stop),
+                args=(server, conn, stop, auth),
                 daemon=True).start()
         lsock.close()
         server.close()            # graceful: drain, then tear down
@@ -477,10 +502,19 @@ class FleetServer:
             params or {})
         self._own_workdir = workdir is None
         self.workdir = workdir or tempfile.mkdtemp(prefix="lgbm_fleet_")
+        # the workdir holds the replica specs (which carry the wire auth
+        # token) — keep it private to the serving user
+        try:
+            os.chmod(self.workdir, 0o700)
+        except OSError:
+            pass
         self.coord_dir = os.path.join(self.workdir, "coord")
         self.logs_dir = os.path.join(self.workdir, "logs")
         for d in (self.coord_dir, self.logs_dir):
             os.makedirs(d, exist_ok=True)
+        #: shared secret gating every wire message in both directions;
+        #: replicas learn it from their (0600) spec file
+        self._auth = os.urandom(_AUTH_LEN // 2).hex().encode("ascii")
         self.metrics = MetricsRegistry()
         self.registry = FleetRegistry(
             os.path.join(self.workdir, "models"), metrics=self.metrics)
@@ -509,6 +543,11 @@ class FleetServer:
         #: wall clock.  None in production.
         self.swap_fault_hook = None
         self._lock = threading.Lock()
+        #: serializes whole stage->rollout->commit sequences: concurrent
+        #: publishes would interleave drain/swap RPCs and manifest
+        #: commits, leaving replicas on divergent versions the
+        #: per-request fence cannot repair
+        self._publish_lock = threading.Lock()
         self._window: collections.deque = collections.deque(
             maxlen=_WINDOW_MAX)
         self._rr = 0
@@ -556,9 +595,13 @@ class FleetServer:
                 "ready_path": s.ready_path,
                 "manifest_path": self.registry.manifest_path,
                 "hb_interval_s": self.hb_interval_s,
+                "auth": self._auth.decode("ascii"),
                 "params": self._replica_params(s)}
         spec_path = os.path.join(self.workdir, f"spec_{tag}.json")
-        with open(spec_path, "w") as fh:
+        # owner-only from birth: the spec carries the wire auth token
+        fd = os.open(spec_path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC,
+                     0o600)
+        with os.fdopen(fd, "w") as fh:
             json.dump(spec, fh)
         s.state = _WARMING
         s.draining = False
@@ -668,56 +711,87 @@ class FleetServer:
             for s in slots:
                 if self._stop.is_set():
                     return
-                if s.state == _FAILED:
-                    continue
-                if s.state == _WARMING:
-                    if os.path.exists(s.ready_path):
-                        self._promote(s, rejoin=s.incarnation > 0)
-                        continue
-                    died = s.proc is not None and s.proc.poll() is not None
-                    timed_out = now - s.spawn_unix > _SPAWN_WINDOW_S
-                    if died or timed_out:
-                        s.respawn_failures += 1
-                        if s.respawn_failures > _RESPAWN_LIMIT:
-                            s.state = _FAILED
-                            log.warning(
-                                f"fleet: replica slot {s.slot} failed "
-                                f"{s.respawn_failures} consecutive "
-                                "respawns; abandoning the slot")
-                            continue
+                try:
+                    self._check_slot(s, now)
+                except Exception as e:
+                    # a respawn can fail at the OS level (fork/exec,
+                    # fd exhaustion, disk full writing the spec) — that
+                    # must degrade ONE slot, never kill the monitor
+                    # thread that keeps the rest of the fleet alive
+                    s.respawn_failures += 1
+                    count_event("fleet_replica_respawn_failures", 1,
+                                self.metrics)
+                    if s.state == DEAD:
+                        # _declare_dead failed before its respawn
+                        # launched; an immediately-expired warming
+                        # window re-enters the respawn path next poll
+                        s.state = _WARMING
+                        s.spawn_unix = 0.0
+                    if s.respawn_failures > _RESPAWN_LIMIT:
+                        s.state = _FAILED
                         log.warning(
-                            f"fleet: replica slot {s.slot} died during "
-                            "bring-up; respawning "
-                            f"(attempt {s.respawn_failures})")
-                        s.incarnation += 1
-                        count_event("fleet_replica_respawns", 1,
-                                    self.metrics)
-                        self._spawn(s)
-                    continue
-                if s.state == DEAD:
-                    continue        # already respawning
-                if s.proc is not None and s.proc.poll() is not None:
-                    self._declare_dead(
-                        s, f"process_exit:{s.proc.returncode}",
-                        age_s=0.0)
-                    continue
-                hb = read_heartbeat(heartbeat_path(
-                    self.coord_dir, s.incarnation, s.slot))
-                last = float(hb["unix_time"]) if hb else s.ready_unix
-                age = max(0.0, now - last)
-                state = age_state(age, interval_s=self.hb_interval_s,
-                                  timeout_s=self.hb_timeout_s)
-                if state == DEAD:
-                    self._declare_dead(s, "heartbeat_timeout", age)
-                elif state == SUSPECT and s.state == HEALTHY:
-                    s.state = SUSPECT
-                    s.suspect_since = now
-                    emit_event("heartbeat_suspect", rank=s.slot,
-                               age_s=round(age, 3),
-                               timeout_s=self.hb_timeout_s)
-                elif state == HEALTHY and s.state == SUSPECT:
-                    s.state = HEALTHY
-                    s.suspect_since = None
+                            f"fleet: replica slot {s.slot} monitor "
+                            f"failure ({type(e).__name__}: {e}); "
+                            f"{s.respawn_failures} consecutive failures"
+                            " — abandoning the slot")
+                    else:
+                        log.warning(
+                            f"fleet: replica slot {s.slot} monitor "
+                            f"failure ({type(e).__name__}: {e}); "
+                            "will retry next poll")
+
+    def _check_slot(self, s: _ReplicaSlot, now: float) -> None:
+        """One monitor poll for one slot (exceptions are the caller's
+        problem — it keeps the monitor thread alive)."""
+        if s.state == _FAILED:
+            return
+        if s.state == _WARMING:
+            if os.path.exists(s.ready_path):
+                self._promote(s, rejoin=s.incarnation > 0)
+                return
+            died = s.proc is not None and s.proc.poll() is not None
+            timed_out = now - s.spawn_unix > _SPAWN_WINDOW_S
+            if died or timed_out:
+                s.respawn_failures += 1
+                if s.respawn_failures > _RESPAWN_LIMIT:
+                    s.state = _FAILED
+                    log.warning(
+                        f"fleet: replica slot {s.slot} failed "
+                        f"{s.respawn_failures} consecutive "
+                        "respawns; abandoning the slot")
+                    return
+                log.warning(
+                    f"fleet: replica slot {s.slot} died during "
+                    "bring-up; respawning "
+                    f"(attempt {s.respawn_failures})")
+                s.incarnation += 1
+                count_event("fleet_replica_respawns", 1,
+                            self.metrics)
+                self._spawn(s)
+            return
+        if s.state == DEAD:
+            return          # already respawning
+        if s.proc is not None and s.proc.poll() is not None:
+            self._declare_dead(
+                s, f"process_exit:{s.proc.returncode}", age_s=0.0)
+            return
+        hb = read_heartbeat(heartbeat_path(
+            self.coord_dir, s.incarnation, s.slot))
+        last = float(hb["unix_time"]) if hb else s.ready_unix
+        age = max(0.0, now - last)
+        state = age_state(age, interval_s=self.hb_interval_s,
+                          timeout_s=self.hb_timeout_s)
+        if state == DEAD:
+            self._declare_dead(s, "heartbeat_timeout", age)
+        elif state == SUSPECT and s.state == HEALTHY:
+            s.state = SUSPECT
+            s.suspect_since = now
+            emit_event("heartbeat_suspect", rank=s.slot,
+                       age_s=round(age, 3),
+                       timeout_s=self.hb_timeout_s)
+        elif state == HEALTHY and s.state == SUSPECT:
+            s.state = HEALTHY
+            s.suspect_since = None
 
     # -------------------------------------------------------------- routing
     def _pick(self, exclude: set) -> Optional[_ReplicaSlot]:
@@ -744,13 +818,20 @@ class FleetServer:
 
     def _rpc(self, s: _ReplicaSlot, msg: dict, timeout_s: float) -> dict:
         """One bounded request/response round trip to a replica."""
+        port = s.port   # snapshot: the monitor clears it on eviction
+        if port is None:
+            # declared dead between _pick and here — an OSError keeps
+            # this on the ordinary failover path instead of surfacing
+            # a TypeError to the client
+            raise OSError(f"replica {s.slot} has no live port "
+                          "(mid-respawn)")
         deadline = time.monotonic() + max(0.05, float(timeout_s))
         sock = socket.create_connection(
-            ("127.0.0.1", int(s.port)),
+            ("127.0.0.1", int(port)),
             timeout=min(_CONNECT_CAP_S, max(0.05, float(timeout_s))))
         try:
-            _send_msg(sock, msg, deadline)
-            reply = _recv_msg(sock, deadline)
+            _send_msg(sock, msg, deadline, self._auth)
+            reply = _recv_msg(sock, deadline, self._auth)
         finally:
             try:
                 sock.close()
@@ -877,11 +958,14 @@ class FleetServer:
         at a time (drain -> warm -> swap behind the router).  Raises
         :class:`RollingSwapAborted` if a replica dies mid-rollout —
         already-swapped replicas are rolled back first, so the fleet
-        always converges on ONE version."""
-        return self.registry.publish(
-            name, booster=booster, model_text=model_text,
-            model_file=model_file, version=version,
-            rollout=self._rollout)
+        always converges on ONE version.  The whole
+        stage->rollout->commit sequence runs under a rollout mutex:
+        concurrent publishes execute one after the other."""
+        with self._publish_lock:
+            return self.registry.publish(
+                name, booster=booster, model_text=model_text,
+                model_file=model_file, version=version,
+                rollout=self._rollout)
 
     def _drain(self, s: _ReplicaSlot) -> None:
         """Bounded wait for the replica's in-flight count to reach
@@ -928,12 +1012,20 @@ class FleetServer:
                         f"replica {s.slot} rejected version {version}: "
                         f"{reply.get('error')}: {reply.get('message')}")
             except RollingSwapAborted:
+                # the replica REJECTED the version (typed reply): it
+                # still serves the old one, so only the already-swapped
+                # replicas need rolling back
                 self._rollback(name, old, swapped)
                 s.draining = False
                 raise
             except (OSError, EOFError, ValueError,
                     pickle.PickleError) as e:
-                self._rollback(name, old, swapped)
+                # ambiguous wire failure: the publish may have LANDED on
+                # the replica even though the reply never did (death,
+                # stall, torn frame) — include it in the rollback so it
+                # cannot keep serving the new version while the manifest
+                # and the rest of the fleet keep the old one
+                self._rollback(name, old, swapped + [s])
                 s.draining = False
                 raise RollingSwapAborted(
                     f"replica {s.slot} died mid-swap "
@@ -949,24 +1041,33 @@ class FleetServer:
 
     def _rollback(self, name: str, old: Optional[dict],
                   swapped: List[_ReplicaSlot]) -> None:
-        """Best-effort convergence back to the manifest version on the
-        replicas that already took the new one.  A replica that fails
-        the rollback too is left to the liveness monitor: its respawn
-        warms from the (uncommitted-into) manifest, which still names
-        the old version."""
+        """Convergence back to the manifest version on the replicas
+        that (may) have taken the new one.  A replica whose rollback
+        RPC cannot CONFIRM the old version is killed outright: it might
+        still be serving the new version, and its respawn warms from
+        the (uncommitted-into) manifest, which still names the old one
+        — so the single-version fence holds either way."""
         for s in swapped:
+            confirmed = False
             try:
                 if old is None:
-                    self._rpc(s, {"op": "unpublish", "name": name},
-                              timeout_s=5.0)
+                    reply = self._rpc(s, {"op": "unpublish",
+                                          "name": name},
+                                      timeout_s=5.0)
                 else:
-                    self._rpc(
+                    reply = self._rpc(
                         s, {"op": "publish", "name": name,
                             "path": old["path"],
                             "version": int(old["version"])},
                         timeout_s=_SWAP_TIMEOUT_S)
+                confirmed = bool(reply.get("ok"))
             except (OSError, EOFError, ValueError, pickle.PickleError):
                 pass
+            if not confirmed and s.proc is not None:
+                try:
+                    s.proc.kill()
+                except OSError:
+                    pass
             s.draining = False
 
     # ----------------------------------------------------- fault injection
