@@ -731,3 +731,99 @@ class UndeclaredSlo(Rule):
                     slo_rel, lineno, 0,
                     f"declared SLO `{name}` is never watched anywhere — "
                     "remove it or wire a watch_slo site that can feed it")
+
+
+# ----------------------------------------------------------------- spans
+def load_declared_spans(spans_path: str) -> Dict[str, int]:
+    """``SPANS`` declaration in obs/reqtrace.py: name -> lineno (same
+    pure-literal AST contract as COUNTERS/EVENTS/SLOS)."""
+    with open(spans_path, encoding="utf-8") as fh:
+        tree = ast.parse(fh.read(), filename=spans_path)
+    for node in tree.body:
+        target = None
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            target = node.targets[0].id
+        elif isinstance(node, ast.AnnAssign) and \
+                isinstance(node.target, ast.Name):
+            target = node.target.id
+        if target == "SPANS" and isinstance(node.value, ast.Dict):
+            out = {}
+            for k in node.value.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    out[k.value] = k.lineno
+            return out
+    return {}
+
+
+@register_rule
+class UndeclaredSpan(Rule):
+    id = "OBS304"
+    name = "undeclared-span"
+    severity = SEVERITY_ERROR
+    description = ("a request-trace span recorded via `record_span` under "
+                   "a name not declared in obs/reqtrace.py `SPANS` (or "
+                   "declared but never recorded)")
+
+    def __init__(self, spans_path: Optional[str] = None):
+        self._spans_path = spans_path
+
+    @staticmethod
+    def _collect_uses(run: LintRun) -> List[Tuple[str, int, int, str]]:
+        """(relpath, line, col, name) per record_span call — gathered
+        per run, same runner-reuse discipline as OBS301/OBS302/OBS303."""
+        uses: List[Tuple[str, int, int, str]] = []
+        for ctx in run.contexts:
+            rel = ctx.relpath.replace("\\", "/")
+            if rel.endswith("obs/reqtrace.py"):
+                continue
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                first = node.args[0]
+                if not (isinstance(first, ast.Constant)
+                        and isinstance(first.value, str)):
+                    continue
+                is_rec = (isinstance(node.func, ast.Name)
+                          and node.func.id == "record_span") or \
+                         (isinstance(node.func, ast.Attribute)
+                          and node.func.attr == "record_span")
+                if is_rec:
+                    uses.append((ctx.relpath, node.lineno,
+                                 node.col_offset, first.value))
+        return uses
+
+    def finalize(self, run: LintRun) -> Iterable[Violation]:
+        path = self._spans_path or os.path.join(
+            run.root, "lightgbm_tpu", "obs", "reqtrace.py")
+        try:
+            declared = load_declared_spans(path)
+        except (OSError, SyntaxError):
+            return
+        spans_rel = os.path.relpath(path, run.root)
+        if not declared:
+            yield self.violation(
+                spans_rel, 1, 0,
+                "no SPANS declaration found in obs/reqtrace.py — every "
+                "request-trace span name must be declared there once")
+            return
+        used_names = set()
+        for relpath, line, col, name in self._collect_uses(run):
+            used_names.add(name)
+            if name not in declared:
+                yield self.violation(
+                    relpath, line, col,
+                    f"trace span `{name}` is not declared in "
+                    "obs/reqtrace.py SPANS — declare it (name + one-line "
+                    "meaning) so trace consumers can rely on the span "
+                    "vocabulary")
+        # the reverse direction ("declared but never recorded") is only
+        # decidable on a whole-package run, like OBS301/OBS302/OBS303
+        if not run.covers(os.path.dirname(os.path.dirname(path))):
+            return
+        for name, lineno in declared.items():
+            if name not in used_names:
+                yield self.violation(
+                    spans_rel, lineno, 0,
+                    f"declared trace span `{name}` is never recorded "
+                    "anywhere — remove it or wire the record_span site")

@@ -172,6 +172,7 @@ _PARAMS: List[Tuple[str, Any, Tuple[str, ...], Tuple[Tuple[str, float], ...]]] =
     ("slo_config", "", ("slos",), ()),                            # declarative SLO watching (obs/slo.py SLOS table): ""/off = disabled; "on" = every declared SLO at default budget; or "name[:budget],name2" to pick/override (e.g. "serving_p99_ms:25,compile_miss_storm"); breaches emit slo_breach/slo_recovered journal events with multi-window burn-rate logic
     ("rollup_window_s", 60.0, ("rollup_window",), ((">", 0.0),)), # time-series rollup window length in seconds (obs/timeseries.py ring; feeds SLO evaluation and tools/obs_top.py)
     ("anomaly_detection", "off", (), ()),                         # baseline-relative training-loop anomaly detection: on|off (obs/anomaly.py; robust z on round time, eval divergence/plateau, compile-miss burst, host-RSS slope — journal events + counters, never hard failures)
+    ("request_trace", "off", (), ()),                             # request-scoped distributed tracing across the serving tier (obs/reqtrace.py): off (default; zero per-request work) | errors (tail-based: keep failed/failed-over/deadline-breached/slowest-k traces only) | sample:<p> (errors + keep fraction p of healthy requests) | all; kept traces carry a per-request span tree (router dispatch, retry attempts, replica queue wait, admission, bucket pad, device run, value gather) merged onto the router's clock, plus exemplar trace ids on latency quantiles and a per-process crash flight recorder
     # --- robustness (robustness/; docs/ROBUSTNESS.md) ---
     ("checkpoint_dir", "", ("checkpoint_directory",), ()),        # periodic atomic training checkpoints under this directory; empty = off
     ("checkpoint_interval", 10, (), ((">", 0),)),                 # boosting rounds between checkpoints
@@ -501,6 +502,13 @@ class Config:
                 parse_slo_config(self.slo_config)
             except ValueError as e:
                 log.fatal(f"invalid slo_config={self.slo_config!r}: {e}")
+        self.request_trace = \
+            str(self.request_trace or "off").strip().lower()
+        from .obs.reqtrace import parse_request_trace
+        try:
+            parse_request_trace(self.request_trace)
+        except ValueError as e:
+            log.fatal(f"invalid request_trace={self.request_trace!r}: {e}")
         if float(self.heartbeat_timeout_s) < float(self.heartbeat_interval_s):
             log.fatal(
                 f"heartbeat_timeout_s={self.heartbeat_timeout_s} must be >= "

@@ -117,6 +117,12 @@ EVENTS: Dict[str, Tuple[str, str]] = {
         "warning", "the training loop departed from its own recent "
                    "baseline (obs/anomaly.py: round-time spike, eval "
                    "divergence/plateau, compile-miss burst, RSS slope)"),
+    "flight_recorder_dumped": (
+        "warning", "a process's crash flight-recorder ring (recent "
+                   "spans + events, obs/reqtrace.py) was dumped to disk "
+                   "— by the dying process on SIGTERM/fatal exception, "
+                   "or by the fleet parent from the last mirrored "
+                   "heartbeat sidecar when a replica was SIGKILLed"),
 }
 
 #: the process-wide active journal; ``None`` = journaling disabled (the
@@ -165,6 +171,8 @@ class EventJournal:
                "unix_time": round(time.time(), 6),
                "payload": payload}
         count_event("event_journal_records")
+        from . import reqtrace
+        reqtrace.note_event(rec)
         from . import trace as obs_trace
         rec_trace = obs_trace.active()
         if rec_trace is not None:

@@ -67,6 +67,49 @@ def _parse_epoch_rank(path: str) -> Tuple[int, int]:
     return 0, 0
 
 
+def find_fleet_artifacts(workdir: str,
+                         telemetry_base: Optional[str] = None,
+                         event_base: Optional[str] = None
+                         ) -> Dict[str, List[Dict[str, Any]]]:
+    """Discover a serving fleet's per-replica artifacts under its
+    workdir (serving/fleet.py layout).
+
+    Replica files reuse the cluster rank namespace with the replica's
+    RESPAWN INCARNATION in the epoch position: ``serving.jsonl`` ->
+    ``serving.e<incarnation>.r<slot>.jsonl``.  Three families:
+
+      * ``flight``    — crash flight-recorder dumps
+        (``<workdir>/flight/flight.e*.r*.json``), written on SIGTERM /
+        fatal exception by the replica or on kill-detection by the
+        router.
+      * ``telemetry`` — per-replica serving telemetry JSONL (default
+        base ``<workdir>/obs/serving.jsonl``; override with
+        ``telemetry_base`` when the fleet was configured with an
+        explicit ``serving_telemetry_output``).
+      * ``journal``   — per-replica event journals, discovered only
+        when ``event_base`` names the fleet's ``event_output``.
+
+    Each entry is ``{"slot", "incarnation", "path"}``, ordered
+    (slot, incarnation) so dashboards can pane per replica slot with
+    respawns stacked chronologically.
+    """
+    def _scan(base: str) -> List[Dict[str, Any]]:
+        rows = []
+        for path in find_rank_files(base):
+            inc, slot = _parse_epoch_rank(path)
+            rows.append({"slot": slot, "incarnation": inc, "path": path})
+        rows.sort(key=lambda r: (r["slot"], r["incarnation"]))
+        return rows
+
+    out: Dict[str, List[Dict[str, Any]]] = {
+        "flight": _scan(os.path.join(workdir, "flight", "flight.json")),
+        "telemetry": _scan(telemetry_base or os.path.join(
+            workdir, "obs", "serving.jsonl")),
+        "journal": _scan(event_base) if event_base else [],
+    }
+    return out
+
+
 def _load(path: str) -> Tuple[List[Dict[str, Any]], Dict[str, Any]]:
     with open(path) as fh:
         doc = json.load(fh)

@@ -118,6 +118,14 @@ COUNTERS: Dict[str, str] = {
         "SLO recovery transitions after a breach (obs/slo.py)",
     "anomalies_detected":
         "baseline-relative training anomalies flagged (obs/anomaly.py)",
+    "request_traces_kept":
+        "request span trees retained by tail-based sampling "
+        "(obs/reqtrace.py)",
+    "request_traces_sampled_out":
+        "healthy request traces dropped by the sampling fraction "
+        "(obs/reqtrace.py)",
+    "flight_recorder_dumps":
+        "crash flight-recorder rings dumped to disk (obs/reqtrace.py)",
 }
 
 

@@ -36,11 +36,21 @@ def format_value(value: Any) -> str:
 
 
 def gauge_lines(name: str, value: Any, help_text: str,
-                labels: str = "") -> List[str]:
-    """HELP/TYPE/sample triple for one gauge."""
+                labels: str = "",
+                exemplar: Optional[Any] = None) -> List[str]:
+    """HELP/TYPE/sample triple for one gauge.
+
+    ``exemplar`` is an optional ``(trace_id, value)`` pair appended to
+    the sample line in OpenMetrics exemplar syntax
+    (``... # {trace_id="..."} <value>``) so a latency quantile can
+    point at the concrete request trace behind it."""
+    sample = f"{PREFIX}{name}{labels} {format_value(value)}"
+    if exemplar is not None:
+        sample += ' # {trace_id="%s"} %s' % (exemplar[0],
+                                             format_value(exemplar[1]))
     return [f"# HELP {PREFIX}{name} {help_text}",
             f"# TYPE {PREFIX}{name} gauge",
-            f"{PREFIX}{name}{labels} {format_value(value)}"]
+            sample]
 
 
 def counter_lines(name: str, value: Any, help_text: str) -> List[str]:

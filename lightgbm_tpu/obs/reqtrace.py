@@ -1,0 +1,466 @@
+"""Request-scoped distributed tracing for the serving fleet.
+
+The fleet router (serving/fleet.py) mints one :class:`RequestTrace` per
+``predict_ex`` call; the trace id + parent span ride the authenticated
+wire protocol as an *optional* message field, the replica records its
+own spans against its local clock, and the reply carries them back where
+:meth:`RequestTrace.graft` re-anchors them onto the router's clock using
+the same wall-clock anchor technique as ``obs/merge.py`` — one request,
+one coherent Perfetto-loadable tree across processes.
+
+Three cooperating pieces live here:
+
+  * ``RequestTrace`` — a span tree under construction (trace id, span id
+    allocator, ``record_span`` and cross-process ``graft``),
+  * ``TraceKeeper`` — tail-based sampling: failed / failed-over /
+    deadline-breached and slowest-k traces are always kept, healthy ones
+    by a deterministic fraction of the trace id
+    (``request_trace=off|errors|sample:<p>|all``),
+  * ``FlightRecorder`` — a bounded ring of each process's most recent
+    spans + journal events, dumped atomically on SIGTERM / fatal
+    exception / (by the parent, from a mirrored heartbeat sidecar) on
+    SIGKILL detection, so postmortems read the victim's final seconds.
+
+This module is stdlib-only (no jax/numpy) so tools can load it by path,
+and with ``request_trace=off`` nothing here is ever constructed — the
+hot path stays a single ``is None`` check in the callers.
+"""
+
+from __future__ import annotations
+
+import heapq
+import json
+import os
+import signal
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+#: Every span name recorded anywhere in the package, declared once with a
+#: one-line meaning.  This is a lint contract (tpulint OBS304): recording
+#: an undeclared name — or declaring one nothing records — fails
+#: `python tools/tpulint.py`.  Keys are parsed from this literal by AST,
+#: so keep it a plain ``str: str`` dict.
+SPANS: Dict[str, str] = {
+    "request":
+        "router-side root: one FleetServer.predict_ex call end to end",
+    "router_dispatch":
+        "router picking a routable replica for one dispatch attempt",
+    "attempt":
+        "one wire round trip to a replica (args: slot, incarnation, "
+        "outcome)",
+    "replica_serve":
+        "replica-side root: one PredictionServer.serve call (also the "
+        "root of standalone-server traces)",
+    "replica_queue_wait":
+        "admission bookkeeping + queue wait between arrival and the "
+        "predictor call",
+    "admission_check":
+        "deadline / closing / max-inflight admission decision",
+    "bucket_pad":
+        "padding + transpose + host->device transfer for one chunk "
+        "(args: bucket)",
+    "device_run":
+        "compiled bucket program execution incl. result sync "
+        "(args: bucket)",
+    "value_gather":
+        "exact-mode host float64 leaf-value accumulation over trees",
+}
+
+#: Bounded ring of kept traces per keeper (router or standalone server).
+_TRACE_RING_MAX = 512
+
+#: Slowest-k healthy traces always kept by tail-based sampling.
+_SLOWEST_K = 4
+
+#: Flight-recorder ring bounds (spans / journal events per process).
+_FLIGHT_RING_MAX = 256
+
+
+def parse_request_trace(spec: Any) -> Tuple[str, float]:
+    """Parse a ``request_trace`` policy into ``(mode, p)``.
+
+    ``off`` -> ("off", 0.0); ``errors`` -> ("errors", 0.0);
+    ``all`` -> ("all", 1.0); ``sample:<p>`` -> ("sample", p) with
+    0 <= p <= 1.  Raises ``ValueError`` on anything else so config
+    validation can reject bad specs at construction time.
+    """
+    text = str(spec or "off").strip().lower()
+    if text in ("off", "false", "0", "none", ""):
+        return ("off", 0.0)
+    if text == "errors":
+        return ("errors", 0.0)
+    if text in ("all", "on", "true", "1"):
+        return ("all", 1.0)
+    if text.startswith("sample:"):
+        p = float(text.split(":", 1)[1])
+        if not (0.0 <= p <= 1.0):
+            raise ValueError(
+                "request_trace sample fraction must be in [0, 1], got %r"
+                % (spec,))
+        return ("sample", p)
+    raise ValueError(
+        "request_trace must be off|errors|sample:<p>|all, got %r" % (spec,))
+
+
+class RequestTrace:
+    """A span tree under construction for one request.
+
+    Span timestamps are microseconds relative to the trace's own
+    ``perf_counter`` origin; ``wall_t0`` (wall-clock seconds at origin)
+    is the anchor used by :meth:`graft` to re-base spans recorded on a
+    different process's clock — the ``obs/merge.py`` technique at
+    request granularity.
+    """
+
+    __slots__ = ("trace_id", "t0_perf", "wall_t0", "spans", "_next_id")
+
+    def __init__(self, trace_id: Optional[str] = None,
+                 wall_t0: Optional[float] = None) -> None:
+        self.trace_id = trace_id if trace_id else os.urandom(8).hex()
+        self.t0_perf = time.perf_counter()
+        self.wall_t0 = float(wall_t0) if wall_t0 is not None else time.time()
+        self.spans: List[Dict[str, Any]] = []
+        self._next_id = 0
+
+    def new_id(self) -> int:
+        """Allocate a span id (ids are per-trace, dense from 1)."""
+        self._next_id += 1
+        return self._next_id
+
+    def us(self, t_perf: float) -> float:
+        """Microseconds since trace origin for a ``perf_counter`` stamp."""
+        return (t_perf - self.t0_perf) * 1e6
+
+    def record_span(self, name: str, t0_us: float, dur_us: float,
+                    parent: Optional[int] = None, tid: int = 0,
+                    span_id: Optional[int] = None,
+                    **args: Any) -> int:
+        """Append one completed span; returns its span id.
+
+        ``span_id`` lets callers pre-allocate an id (via :meth:`new_id`)
+        so children recorded earlier can parent onto a span that closes
+        later (e.g. the request root).
+        """
+        sid = span_id if span_id is not None else self.new_id()
+        self.spans.append({
+            "name": name,
+            "span_id": sid,
+            "parent": parent,
+            "ts": float(t0_us),
+            "dur": float(dur_us),
+            "tid": int(tid),
+            "args": dict(args) if args else {},
+        })
+        _note_span(self.trace_id, name, dur_us)
+        return sid
+
+    def graft(self, spans: List[Dict[str, Any]], wall_t0: float,
+              parent: Optional[int], tid: int) -> None:
+        """Re-anchor spans recorded on another process's clock.
+
+        ``spans`` carry timestamps relative to *that* process's trace
+        origin whose wall time was ``wall_t0``; the shift onto this
+        trace's timeline is the wall-clock delta between the two origins
+        (the ``obs/merge.py`` anchor shift).  Span ids are remapped into
+        this trace's id space; spans whose parent is not in the grafted
+        set are re-parented onto ``parent`` (the wire attempt span).
+        """
+        shift = (float(wall_t0) - self.wall_t0) * 1e6
+        idmap: Dict[int, int] = {}
+        for ev in spans:
+            idmap[int(ev["span_id"])] = self.new_id()
+        for ev in spans:
+            old_parent = ev.get("parent")
+            self.spans.append({
+                "name": ev["name"],
+                "span_id": idmap[int(ev["span_id"])],
+                "parent": (idmap[int(old_parent)]
+                           if old_parent is not None and
+                           int(old_parent) in idmap else parent),
+                "ts": float(ev["ts"]) + shift,
+                "dur": float(ev["dur"]),
+                "tid": int(tid),
+                "args": dict(ev.get("args") or {}),
+            })
+
+    def to_dict(self, **meta: Any) -> Dict[str, Any]:
+        d: Dict[str, Any] = {
+            "trace_id": self.trace_id,
+            "wall_t0": self.wall_t0,
+            "spans": list(self.spans),
+        }
+        d.update(meta)
+        return d
+
+
+def to_chrome(trace: Dict[str, Any]) -> Dict[str, Any]:
+    """Render one kept trace dict as a Perfetto-loadable Chrome trace.
+
+    The router's spans run on tid 0; grafted replica spans keep the tid
+    the router assigned (1 + slot), with thread_name metadata rows so
+    Perfetto labels the lanes.
+    """
+    events: List[Dict[str, Any]] = [{
+        "name": "process_name", "ph": "M", "pid": 0, "tid": 0,
+        "args": {"name": "request %s" % trace.get("trace_id", "?")},
+    }]
+    tids = sorted({int(s.get("tid", 0)) for s in trace.get("spans", ())})
+    for tid in tids:
+        label = "router" if tid == 0 else "replica slot %d" % (tid - 1)
+        events.append({"name": "thread_name", "ph": "M", "pid": 0,
+                       "tid": tid, "args": {"name": label}})
+    base = min((float(s["ts"]) for s in trace.get("spans", ())),
+               default=0.0)
+    for s in trace.get("spans", ()):
+        args = dict(s.get("args") or {})
+        if s.get("parent") is not None:
+            args["parent_span"] = s["parent"]
+        args["span_id"] = s["span_id"]
+        events.append({
+            "name": s["name"], "ph": "X", "pid": 0,
+            "tid": int(s.get("tid", 0)),
+            "ts": float(s["ts"]) - base,
+            "dur": float(s["dur"]),
+            "args": args,
+        })
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "lgbtpu": {"request_trace": True,
+                   "trace_id": trace.get("trace_id"),
+                   "status": trace.get("status"),
+                   "keep_reason": trace.get("keep_reason")},
+    }
+
+
+class TraceKeeper:
+    """Tail-based sampling over finished traces.
+
+    Failed / failed-over / deadline-breached traces are always kept, as
+    are the rolling slowest-k healthy ones; remaining healthy traces are
+    kept when a deterministic hash of the trace id falls under the
+    configured fraction (so a retried request keeps or drops
+    consistently across processes).
+    """
+
+    __slots__ = ("mode", "p", "_ring", "_slowest", "_lock", "_count")
+
+    def __init__(self, mode: str, p: float,
+                 count: Optional[Callable[..., None]] = None) -> None:
+        self.mode = mode
+        self.p = float(p)
+        self._ring: deque = deque(maxlen=_TRACE_RING_MAX)
+        # min-heap of (latency_s, trace_id) — the slowest-k watermark
+        self._slowest: List[Tuple[float, str]] = []
+        self._lock = threading.Lock()
+        self._count = count if count is not None else (lambda *a, **k: None)
+
+    def finish(self, tr: RequestTrace, *, model: str, status: str,
+               failovers: int = 0, deadline_breached: bool = False,
+               latency_s: float = 0.0) -> Optional[str]:
+        """Decide keep/drop for a finished trace; returns the keep
+        reason (``error``/``failover``/``deadline``/``slow``/``sampled``)
+        or ``None`` when sampled out."""
+        reason: Optional[str] = None
+        if status != "ok":
+            reason = "error"
+        elif failovers > 0:
+            reason = "failover"
+        elif deadline_breached:
+            reason = "deadline"
+        if reason is None and self.mode == "errors":
+            with self._lock:
+                reason = self._slow_check(latency_s, tr.trace_id)
+            if reason is None:
+                self._count("request_traces_sampled_out")
+                return None
+        if reason is None:
+            with self._lock:
+                reason = self._slow_check(latency_s, tr.trace_id)
+            if reason is None and self._hash_keep(tr.trace_id):
+                reason = "sampled"
+            if reason is None:
+                self._count("request_traces_sampled_out")
+                return None
+        with self._lock:
+            self._ring.append(tr.to_dict(
+                model=model, status=status, failovers=int(failovers),
+                deadline_breached=bool(deadline_breached),
+                latency_s=float(latency_s), keep_reason=reason))
+        self._count("request_traces_kept")
+        return reason
+
+    def _slow_check(self, latency_s: float, trace_id: str) -> Optional[str]:
+        # caller holds the lock
+        if len(self._slowest) < _SLOWEST_K:
+            heapq.heappush(self._slowest, (float(latency_s), trace_id))
+            return "slow"
+        if latency_s > self._slowest[0][0]:
+            heapq.heapreplace(self._slowest, (float(latency_s), trace_id))
+            return "slow"
+        return None
+
+    def _hash_keep(self, trace_id: str) -> bool:
+        if self.p >= 1.0:
+            return True
+        if self.p <= 0.0:
+            return False
+        return int(trace_id, 16) % 10000 < self.p * 10000
+
+    def recent(self, limit: Optional[int] = None) -> List[Dict[str, Any]]:
+        """Most recent kept traces, oldest first."""
+        with self._lock:
+            out = list(self._ring)
+        return out[-limit:] if limit else out
+
+
+# ---------------------------------------------------------------------------
+# crash flight recorder
+
+
+class FlightRecorder:
+    """Bounded ring of a process's most recent spans + journal events,
+    dumped atomically when the process is about to die (or, for SIGKILL,
+    by the parent from the last heartbeat-mirrored sidecar snapshot)."""
+
+    __slots__ = ("path", "_spans", "_events", "_lock", "_count", "meta",
+                 "_dumped")
+
+    def __init__(self, path: str, maxlen: int = _FLIGHT_RING_MAX,
+                 count: Optional[Callable[..., None]] = None,
+                 **meta: Any) -> None:
+        self.path = path
+        self._spans: deque = deque(maxlen=maxlen)
+        self._events: deque = deque(maxlen=maxlen)
+        self._lock = threading.Lock()
+        self._count = count if count is not None else (lambda *a, **k: None)
+        self.meta = dict(meta)
+        self._dumped = False
+
+    def note_span(self, trace_id: str, name: str, dur_us: float) -> None:
+        with self._lock:
+            self._spans.append({"trace_id": trace_id, "name": name,
+                                "dur_us": float(dur_us),
+                                "unix_time": time.time()})
+
+    def note_event(self, record: Dict[str, Any]) -> None:
+        with self._lock:
+            self._events.append(dict(record))
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"meta": dict(self.meta),
+                    "unix_time": time.time(),
+                    "spans": list(self._spans),
+                    "events": list(self._events)}
+
+    def publish(self, sidecar_path: str) -> None:
+        """Mirror the current ring to a small sidecar file (called from
+        the heartbeat loop) so the parent can dump on our behalf if we
+        are SIGKILLed without warning."""
+        try:
+            _atomic_write_json(sidecar_path, self.snapshot())
+        except OSError:
+            pass
+
+    def dump(self, reason: str) -> bool:
+        """Write the ring to ``self.path`` atomically; first dump wins
+        (a replica's own SIGTERM dump is not overwritten by the parent's
+        later kill-detection dump)."""
+        with self._lock:
+            if self._dumped or os.path.exists(self.path):
+                return False
+            self._dumped = True
+        doc = self.snapshot()
+        doc["reason"] = reason
+        try:
+            _atomic_write_json(self.path, doc)
+        except OSError:
+            return False
+        self._count("flight_recorder_dumps")
+        return True
+
+
+def dump_snapshot(path: str, snap: Dict[str, Any], reason: str) -> bool:
+    """Parent-side dump of a mirrored sidecar snapshot on behalf of a
+    process that died without dumping (SIGKILL detection)."""
+    if not snap or os.path.exists(path):
+        return False
+    doc = dict(snap)
+    doc["reason"] = reason
+    try:
+        _atomic_write_json(path, doc)
+    except OSError:
+        return False
+    return True
+
+
+def read_snapshot(path: str) -> Optional[Dict[str, Any]]:
+    """Torn-write-safe read of a flight sidecar / dump (None when absent
+    or unparsable — the writer may have died mid-rename)."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _atomic_write_json(path: str, doc: Dict[str, Any]) -> None:
+    d = os.path.dirname(os.path.abspath(path))
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = path + ".tmp.%d" % os.getpid()
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(doc, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+# module-level active recorder: one `is None` check on hot paths keeps
+# request_trace=off free of any flight-recorder work
+_RECORDER: Optional[FlightRecorder] = None
+
+
+def set_recorder(rec: Optional[FlightRecorder]) -> None:
+    global _RECORDER
+    _RECORDER = rec
+
+
+def get_recorder() -> Optional[FlightRecorder]:
+    return _RECORDER
+
+
+def _note_span(trace_id: str, name: str, dur_us: float) -> None:
+    rec = _RECORDER
+    if rec is None:
+        return
+    rec.note_span(trace_id, name, dur_us)
+
+
+def note_event(record: Dict[str, Any]) -> None:
+    """Mirror a journal event into the active flight recorder (called by
+    obs/events.emit_event; a single ``is None`` check when no recorder
+    is installed)."""
+    rec = _RECORDER
+    if rec is None:
+        return
+    rec.note_event(record)
+
+
+def install_signal_dump(rec: FlightRecorder) -> None:
+    """Dump the ring on SIGTERM, then re-raise with the default handler
+    so the process still dies with the right status.  Main thread only
+    (signal.signal requirement); a no-op when that doesn't hold."""
+    def _handler(signum: int, frame: Any) -> None:
+        rec.dump("sigterm")
+        signal.signal(signum, signal.SIG_DFL)
+        os.kill(os.getpid(), signum)
+
+    try:
+        signal.signal(signal.SIGTERM, _handler)
+    except ValueError:
+        # not the main thread — the fatal-exception dump still covers us
+        pass

@@ -72,7 +72,8 @@ class _Window:
     """One accumulating window (mutable until finalized)."""
 
     __slots__ = ("t_start", "t_end", "counter_delta", "gauges",
-                 "samples", "sample_strides", "sample_seen", "events")
+                 "samples", "sample_strides", "sample_seen",
+                 "sample_exemplar", "events")
 
     def __init__(self, t_start: float, width: float) -> None:
         self.t_start = t_start
@@ -82,6 +83,9 @@ class _Window:
         self.samples: Dict[str, List[float]] = {}
         self.sample_strides: Dict[str, int] = {}
         self.sample_seen: Dict[str, int] = {}
+        # per-series (value, exemplar-id) of the WORST observation that
+        # carried an exemplar (request trace id) this window
+        self.sample_exemplar: Dict[str, List[Any]] = {}
         self.events: Dict[str, int] = {}
 
 
@@ -148,10 +152,17 @@ class Rollup:
             g[3] += 1
 
     def observe_sample(self, name: str, value: float,
-                       t: Optional[float] = None) -> None:
+                       t: Optional[float] = None,
+                       exemplar: Optional[str] = None) -> None:
         """Feed one latency/duration sample into the window's bounded
-        quantile buffer."""
+        quantile buffer.  ``exemplar`` (a request trace id) tags the
+        observation; the window keeps the id of its worst tagged sample
+        so a quantile can point at a concrete trace."""
         w = self._window_for(t)
+        if exemplar is not None:
+            ex = w.sample_exemplar.get(name)
+            if ex is None or float(value) >= ex[0]:
+                w.sample_exemplar[name] = [float(value), str(exemplar)]
         buf = w.samples.setdefault(name, [])
         seen = w.sample_seen.get(name, 0)
         stride = w.sample_strides.get(name, 1)
@@ -208,6 +219,9 @@ class Rollup:
                    "max": vals[-1]}
             for label, q in _QUANTILES:
                 row[label] = _quantile(vals, q)
+            ex = w.sample_exemplar.get(name)
+            if ex is not None:
+                row["exemplar"] = ex[1]
             samples[name] = row
         return {"t_start": w.t_start, "t_end": w.t_end,
                 "window_s": self.window_s, "counters": counters,
@@ -341,7 +355,9 @@ def feed_serving_row(rollup: Rollup, row: Dict[str, Any]) -> None:
     t = float(t) if isinstance(t, (int, float)) else None
     lat = row.get("latency_s")
     if isinstance(lat, (int, float)):
-        rollup.observe_sample("latency_ms", float(lat) * 1000.0, t=t)
+        ex = row.get("trace_id")
+        rollup.observe_sample("latency_ms", float(lat) * 1000.0, t=t,
+                              exemplar=ex if isinstance(ex, str) else None)
     rollup.observe_delta("serve_requests", 1.0, t=t)
     rows = row.get("rows")
     if isinstance(rows, (int, float)):

@@ -67,6 +67,7 @@ import numpy as np
 from ..config import Config
 from ..obs import events as obs_events
 from ..obs import prom
+from ..obs import reqtrace
 from ..obs.events import emit_event
 from ..obs.metrics import MetricsRegistry, count_event
 from ..obs.slo import SloEvaluator, Watchtower, parse_slo_config
@@ -319,15 +320,27 @@ def _replica_serve_conn(server, conn: socket.socket,
             if sub is not None:
                 deadline = min(deadline,
                                time.monotonic() + float(sub) / 1000.0)
+            # optional trace context (obs/reqtrace.py): absent from old
+            # peers and with request_trace=off — both directions tolerate
+            # the missing key, keeping the wire format compatible
+            wire_tr = msg.get("trace")
+            tr = None
+            if isinstance(wire_tr, dict):
+                tr = reqtrace.RequestTrace(trace_id=wire_tr.get("id"))
             try:
                 out, ver = server.serve(
                     msg["name"], msg["X"],
                     raw_score=bool(msg.get("raw_score", True)),
-                    deadline_ms=sub)
+                    deadline_ms=sub, trace=tr)
                 reply = {"ok": True, "out": out, "version": int(ver)}
             except Exception as e:
                 reply = {"ok": False, "error": type(e).__name__,
                          "message": str(e)}
+            if tr is not None:
+                # replica spans ride back with the replica's wall-clock
+                # anchor; the router grafts them onto its own timeline
+                reply["trace"] = {"wall_t0": tr.wall_t0,
+                                  "spans": tr.spans}
         elif op == "publish":
             try:
                 entry = server.publish(
@@ -378,66 +391,112 @@ def _replica_main(spec_path: str) -> None:
     socket, start heartbeating — and only then write the ready marker
     that registers the replica healthy.  A client request can never
     reach a cold ladder."""
-    from .server import PredictionServer
     with open(spec_path) as fh:
         spec = json.load(fh)
     slot = int(spec["slot"])
     incarnation = int(spec["incarnation"])
     auth = str(spec["auth"]).encode("ascii")
     params = dict(spec.get("params") or {})
+    # crash flight recorder (obs/reqtrace.py): a bounded ring of this
+    # process's recent spans + journal events, dumped on SIGTERM / fatal
+    # exception; the heartbeat loop mirrors it to a coord-dir sidecar so
+    # the parent can dump on our behalf after a SIGKILL
+    rec = None
+    sidecar = ""
+    try:
+        mode, _ = reqtrace.parse_request_trace(
+            params.get("request_trace", "off"))
+    except ValueError:
+        mode = "off"
+    if mode != "off" and spec.get("flight_dir"):
+        from ..obs.merge import rank_file_path
+        dump_path = rank_file_path(
+            os.path.join(spec["flight_dir"], "flight.json"),
+            incarnation, slot)
+        sidecar = os.path.join(
+            spec["coord_dir"], f"flight_s{slot}_i{incarnation}.json")
+        rec = reqtrace.FlightRecorder(
+            dump_path, count=count_event, slot=slot,
+            incarnation=incarnation, pid=os.getpid())
+        reqtrace.set_recorder(rec)
+        reqtrace.install_signal_dump(rec)
     with obs_events.session(params.get("event_output"), rank=slot):
-        server = PredictionServer(params)
-        manifest = spec.get("manifest_path")
-        models: Dict[str, dict] = {}
-        if manifest:
-            try:
-                with open(manifest) as fh:
-                    models = json.load(fh).get("models", {})
-            except (OSError, ValueError):
-                models = {}   # empty fleet: nothing to warm yet
-        for name, info in sorted(models.items()):
-            server.publish(name, model_file=info["path"],
-                           version=int(info["version"]), warmup=True)
+        try:
+            _replica_body(spec, params, slot, incarnation, auth, rec,
+                          sidecar)
+        except BaseException:
+            if rec is not None and rec.dump("fatal_exception"):
+                emit_event("flight_recorder_dumped", rank=slot,
+                           slot=slot, incarnation=incarnation,
+                           reason="fatal_exception")
+            raise
+        finally:
+            reqtrace.set_recorder(None)
 
-        lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        lsock.bind(("127.0.0.1", 0))
-        lsock.listen(64)
-        port = lsock.getsockname()[1]
 
-        stop = threading.Event()
-        hb_interval = float(spec.get("hb_interval_s", 0.5))
+def _replica_body(spec: dict, params: Dict[str, Any], slot: int,
+                  incarnation: int, auth: bytes, rec, sidecar: str) -> None:
+    """Warm-listen-heartbeat-serve loop of one replica process (the
+    part of :func:`_replica_main` bracketed by the journal session and
+    the flight-recorder fatal-exception guard)."""
+    from .server import PredictionServer
+    server = PredictionServer(params)
+    manifest = spec.get("manifest_path")
+    models: Dict[str, dict] = {}
+    if manifest:
+        try:
+            with open(manifest) as fh:
+                models = json.load(fh).get("models", {})
+        except (OSError, ValueError):
+            models = {}   # empty fleet: nothing to warm yet
+    for name, info in sorted(models.items()):
+        server.publish(name, model_file=info["path"],
+                       version=int(info["version"]), warmup=True)
 
-        def _beat() -> None:
-            beat = 0
-            while not stop.is_set():
-                publish_heartbeat(spec["coord_dir"], incarnation, slot,
-                                  beat)
-                beat += 1
-                stop.wait(hb_interval)
+    lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(64)
+    port = lsock.getsockname()[1]
 
-        hb_thread = threading.Thread(target=_beat, daemon=True,
-                                     name=f"fleet-hb-{slot}")
-        hb_thread.start()
-        _atomic_json(spec["ready_path"],
-                     {"port": int(port), "pid": os.getpid(),
-                      "slot": slot, "incarnation": incarnation})
+    stop = threading.Event()
+    hb_interval = float(spec.get("hb_interval_s", 0.5))
 
-        lsock.settimeout(0.25)     # periodic stop-flag check
+    def _beat() -> None:
+        beat = 0
         while not stop.is_set():
-            try:
-                conn, _ = lsock.accept()
-            except socket.timeout:
-                continue
-            except OSError:
-                break
-            threading.Thread(
-                target=_replica_serve_conn,
-                args=(server, conn, stop, auth),
-                daemon=True).start()
-        lsock.close()
-        server.close()            # graceful: drain, then tear down
-        hb_thread.join(timeout=2.0 * hb_interval)
+            publish_heartbeat(spec["coord_dir"], incarnation, slot,
+                              beat)
+            if rec is not None and sidecar:
+                # mirror the flight ring beside the heartbeat so the
+                # parent holds a fresh snapshot to dump if we are
+                # SIGKILLed without warning
+                rec.publish(sidecar)
+            beat += 1
+            stop.wait(hb_interval)
+
+    hb_thread = threading.Thread(target=_beat, daemon=True,
+                                 name=f"fleet-hb-{slot}")
+    hb_thread.start()
+    _atomic_json(spec["ready_path"],
+                 {"port": int(port), "pid": os.getpid(),
+                  "slot": slot, "incarnation": incarnation})
+
+    lsock.settimeout(0.25)     # periodic stop-flag check
+    while not stop.is_set():
+        try:
+            conn, _ = lsock.accept()
+        except socket.timeout:
+            continue
+        except OSError:
+            break
+        threading.Thread(
+            target=_replica_serve_conn,
+            args=(server, conn, stop, auth),
+            daemon=True).start()
+    lsock.close()
+    server.close()            # graceful: drain, then tear down
+    hb_thread.join(timeout=2.0 * hb_interval)
 
 
 # ---------------------------------------------------------------------------
@@ -449,7 +508,8 @@ class _ReplicaSlot:
 
     __slots__ = ("slot", "incarnation", "proc", "log_file", "port",
                  "pid", "state", "draining", "spawn_unix", "ready_unix",
-                 "ready_path", "respawn_failures", "suspect_since")
+                 "ready_path", "respawn_failures", "suspect_since",
+                 "flight_mirror")
 
     def __init__(self, slot: int) -> None:
         self.slot = int(slot)
@@ -458,6 +518,9 @@ class _ReplicaSlot:
         self.log_file = None
         self.port: Optional[int] = None
         self.pid: Optional[int] = None
+        #: last flight-recorder sidecar snapshot mirrored from the
+        #: replica's heartbeats (the parent's dump source on SIGKILL)
+        self.flight_mirror: Optional[dict] = None
         self.state = _WARMING
         self.draining = False
         self.spawn_unix = 0.0
@@ -522,6 +585,24 @@ class FleetServer:
         self._journal = obs_events.start(self._event_base) \
             if self._event_base else None
         self._tele_base = str(cfg.serving_telemetry_output or "")
+        #: request-trace keeper (obs/reqtrace.py) — None with
+        #: request_trace=off (default): predict_ex then never mints a
+        #: trace, adds no wire field and allocates nothing per request
+        self._rt: Optional[reqtrace.TraceKeeper] = None
+        self.flight_dir = os.path.join(self.workdir, "flight")
+        mode, frac = reqtrace.parse_request_trace(cfg.request_trace)
+        if mode != "off":
+            self._rt = reqtrace.TraceKeeper(
+                mode, frac,
+                count=lambda n, v=1: count_event(n, v, self.metrics))
+            os.makedirs(self.flight_dir, exist_ok=True)
+            if not self._tele_base:
+                # give replicas a default per-replica telemetry base so
+                # tools/obs_top.py --fleet shows per-replica panes even
+                # when the caller never configured a telemetry sink
+                obs_dir = os.path.join(self.workdir, "obs")
+                os.makedirs(obs_dir, exist_ok=True)
+                self._tele_base = os.path.join(obs_dir, "serving.jsonl")
         self._tower: Optional[Watchtower] = None
         self._tower_lock = threading.Lock()
         try:
@@ -596,6 +677,8 @@ class FleetServer:
                 "manifest_path": self.registry.manifest_path,
                 "hb_interval_s": self.hb_interval_s,
                 "auth": self._auth.decode("ascii"),
+                "flight_dir": self.flight_dir if self._rt is not None
+                else "",
                 "params": self._replica_params(s)}
         spec_path = os.path.join(self.workdir, f"spec_{tag}.json")
         # owner-only from birth: the spec carries the wire auth token
@@ -606,6 +689,7 @@ class FleetServer:
         s.state = _WARMING
         s.draining = False
         s.port = None
+        s.flight_mirror = None       # stale ring from the old incarnation
         s.spawn_unix = time.time()
         s.proc, s.log_file = spawn_worker(
             "lightgbm_tpu.serving.fleet", spec_path,
@@ -672,6 +756,12 @@ class FleetServer:
                 except OSError:
                     pass
 
+    def _flight_sidecar(self, s: _ReplicaSlot) -> str:
+        """The coord-dir path the replica's heartbeat loop mirrors its
+        flight-recorder ring to (must match ``_replica_main``)."""
+        return os.path.join(self.coord_dir,
+                            f"flight_s{s.slot}_i{s.incarnation}.json")
+
     # -------------------------------------------------------------- monitor
     def _declare_dead(self, s: _ReplicaSlot, reason: str,
                       age_s: float) -> None:
@@ -688,6 +778,24 @@ class FleetServer:
                 s.proc.kill()
             except OSError:
                 pass
+        if self._rt is not None:
+            # dump the victim's flight ring on its behalf: a SIGKILLed
+            # replica never ran its own SIGTERM dump, but its heartbeat
+            # loop mirrored the ring into a coord-dir sidecar — the
+            # freshest copy of its final seconds (no-op when the replica
+            # already dumped itself; first dump wins)
+            from ..obs.merge import rank_file_path
+            snap = reqtrace.read_snapshot(self._flight_sidecar(s)) \
+                or s.flight_mirror
+            dump_path = rank_file_path(
+                os.path.join(self.flight_dir, "flight.json"),
+                s.incarnation, s.slot)
+            if snap and reqtrace.dump_snapshot(dump_path, snap,
+                                               "kill_detected"):
+                count_event("flight_recorder_dumps", 1, self.metrics)
+                emit_event("flight_recorder_dumped", slot=s.slot,
+                           incarnation=s.incarnation,
+                           reason="kill_detected")
         if s.log_file is not None:
             try:
                 s.log_file.close()
@@ -775,6 +883,12 @@ class FleetServer:
             self._declare_dead(
                 s, f"process_exit:{s.proc.returncode}", age_s=0.0)
             return
+        if self._rt is not None:
+            # mirror the replica's flight sidecar while it is alive so a
+            # SIGKILL between heartbeats still leaves us a recent ring
+            snap = reqtrace.read_snapshot(self._flight_sidecar(s))
+            if snap:
+                s.flight_mirror = snap
         hb = read_heartbeat(heartbeat_path(
             self.coord_dir, s.incarnation, s.slot))
         last = float(hb["unix_time"]) if hb else s.ready_unix
@@ -869,11 +983,19 @@ class FleetServer:
         last_err = "no live replicas"
         failovers = 0
         dispatched = 0
+        # request trace (obs/reqtrace.py): minted ONLY when a keeper is
+        # configured — the off path never touches any of this
+        keeper = self._rt
+        tr = root = None
+        if keeper is not None:
+            tr = reqtrace.RequestTrace()
+            root = tr.new_id()      # "request" span closes at the end
         while dispatched < attempts:
             remaining_ms = (hard_deadline - time.monotonic()) * 1000.0
             if remaining_ms <= 0:
                 last_err = f"deadline budget exhausted ({last_err})"
                 break
+            d0 = time.perf_counter() if tr is not None else 0.0
             s = self._pick(tried)
             if s is None:
                 # nothing routable right now (e.g. the whole fleet is
@@ -883,18 +1005,52 @@ class FleetServer:
                 time.sleep(min(self.hb_interval_s,
                                max(0.01, remaining_ms / 1000.0 / 4.0)))
                 continue
+            if tr is not None:
+                tr.record_span("router_dispatch", tr.us(d0),
+                               (time.perf_counter() - d0) * 1e6,
+                               parent=root, attempt=dispatched + 1,
+                               slot=s.slot)
             sub_ms = remaining_ms / float(attempts - dispatched)
             dispatched += 1
+            msg = {"op": "predict", "name": name, "X": X,
+                   "raw_score": bool(raw_score), "deadline_ms": sub_ms}
+            aid = None
+            a0 = 0.0
+            if tr is not None:
+                # the attempt span id rides the wire as the parent the
+                # replica's grafted spans hang from
+                aid = tr.new_id()
+                msg["trace"] = {"id": tr.trace_id, "parent": aid}
+                a0 = time.perf_counter()
             try:
-                reply = self._rpc(
-                    s, {"op": "predict", "name": name, "X": X,
-                        "raw_score": bool(raw_score),
-                        "deadline_ms": sub_ms},
-                    timeout_s=sub_ms / 1000.0)
+                reply = self._rpc(s, msg, timeout_s=sub_ms / 1000.0)
+                if tr is not None:
+                    wire = reply.get("trace")
+                    if isinstance(wire, dict):
+                        # re-anchor the replica's spans onto this
+                        # router's clock (obs/merge.py wall-anchor
+                        # technique), lane tid = 1 + slot
+                        tr.graft(wire.get("spans") or [],
+                                 wire.get("wall_t0", tr.wall_t0),
+                                 aid, 1 + s.slot)
                 if reply.get("ok"):
                     latency_s = time.monotonic() - t0
+                    if tr is not None:
+                        tr.record_span(
+                            "attempt", tr.us(a0),
+                            (time.perf_counter() - a0) * 1e6,
+                            span_id=aid, parent=root, slot=s.slot,
+                            incarnation=s.incarnation, outcome="ok")
+                        tr.record_span(
+                            "request", 0.0, tr.us(time.perf_counter()),
+                            span_id=root, model=name,
+                            failovers=failovers)
+                        keeper.finish(tr, model=name, status="ok",
+                                      failovers=failovers,
+                                      latency_s=latency_s)
                     self._record(latency_s, int(X.shape[0]) if X.ndim
-                                 else 1)
+                                 else 1, trace_id=tr.trace_id
+                                 if tr is not None else None)
                     return {"out": np.asarray(reply["out"]),
                             "version": int(reply["version"]),
                             "replica": s.slot,
@@ -906,12 +1062,31 @@ class FleetServer:
                     raise log.LightGBMError(str(reply.get("message")))
                 last_err = (f"replica {s.slot}: {reply.get('error')}: "
                             f"{reply.get('message')}")
-            except log.LightGBMError:
+            except log.LightGBMError as e:
+                if tr is not None:
+                    tr.record_span(
+                        "attempt", tr.us(a0),
+                        (time.perf_counter() - a0) * 1e6, span_id=aid,
+                        parent=root, slot=s.slot,
+                        incarnation=s.incarnation, outcome="error",
+                        error=str(e)[:200])
+                    tr.record_span(
+                        "request", 0.0, tr.us(time.perf_counter()),
+                        span_id=root, model=name, failovers=failovers)
+                    keeper.finish(tr, model=name, status="error",
+                                  failovers=failovers,
+                                  latency_s=time.monotonic() - t0)
                 raise
             except (OSError, EOFError, ValueError,
                     pickle.PickleError) as e:
                 last_err = (f"replica {s.slot}: "
                             f"{type(e).__name__}: {e}")
+            if tr is not None:
+                tr.record_span("attempt", tr.us(a0),
+                               (time.perf_counter() - a0) * 1e6,
+                               span_id=aid, parent=root, slot=s.slot,
+                               incarnation=s.incarnation,
+                               outcome="error", error=last_err[:200])
             tried.add((s.slot, s.incarnation))
             failovers += 1
             count_event("fleet_request_failovers", 1, self.metrics)
@@ -923,25 +1098,41 @@ class FleetServer:
                            1))
         count_event("serve_rejected_requests", 1, self.metrics)
         self._feed_tower()
+        if tr is not None:
+            tr.record_span("request", 0.0, tr.us(time.perf_counter()),
+                           span_id=root, model=name,
+                           failovers=failovers, error=last_err[:200])
+            keeper.finish(
+                tr, model=name, status="error", failovers=failovers,
+                deadline_breached=time.monotonic() >= hard_deadline,
+                latency_s=time.monotonic() - t0)
         raise FleetRequestFailed(
             f"request for {name!r} failed after {failovers} failover(s) "
             f"within deadline_ms={budget_ms:.0f}: {last_err}")
 
-    def _record(self, latency_s: float, rows: int) -> None:
+    def recent_traces(self, limit: Optional[int] = None) -> List[Dict]:
+        """Kept request span trees, oldest first ([] with
+        request_trace=off)."""
+        return self._rt.recent(limit) if self._rt is not None else []
+
+    def _record(self, latency_s: float, rows: int,
+                trace_id: Optional[str] = None) -> None:
         count_event("serve_requests", 1, self.metrics)
         count_event("serve_rows", rows, self.metrics)
         with self._lock:
-            self._window.append((time.time(), latency_s, rows))
-        self._feed_tower(latency_s=latency_s)
+            self._window.append((time.time(), latency_s, rows, trace_id))
+        self._feed_tower(latency_s=latency_s, exemplar=trace_id)
 
-    def _feed_tower(self, latency_s: Optional[float] = None) -> None:
+    def _feed_tower(self, latency_s: Optional[float] = None,
+                    exemplar: Optional[str] = None) -> None:
         tower = self._tower
         if tower is None:
             return
         with self._tower_lock:
             r = tower.rollup
             if latency_s is not None:
-                r.observe_sample("latency_ms", latency_s * 1000.0)
+                r.observe_sample("latency_ms", latency_s * 1000.0,
+                                 exemplar=exemplar)
             r.observe_counter("serve_requests",
                               self.metrics.counter("serve_requests"))
             r.observe_counter(
@@ -1164,6 +1355,12 @@ class FleetServer:
             "counters": {k: v for k, v in counters.items()
                          if k.startswith(("serve_", "fleet_"))},
         }
+        traced = [(s[1], s[3]) for s in samples
+                  if len(s) > 3 and s[3] is not None]
+        worst = max(traced) if traced else None
+        out["exemplars"] = {} if worst is None else {
+            "latency_ms": {"trace_id": worst[1],
+                           "latency_ms": round(worst[0] * 1000.0, 4)}}
         if self._tower is not None:
             with self._tower_lock:
                 out["slo"] = self._tower.slo_state()
@@ -1175,12 +1372,15 @@ class FleetServer:
         scraped live from each routable replica's own snapshot."""
         snap = self.metrics_snapshot(window_s=window_s)
         lines: List[str] = []
+        ex = (snap.get("exemplars") or {}).get("latency_ms")
         for q, label in (("p50", "0.5"), ("p95", "0.95"), ("p99", "0.99")):
             lines.extend(prom.gauge_lines(
                 "fleet_latency_ms", snap["latency_ms"][q],
                 f"client-observed request latency {q} (failover "
                 "included) over the rolling window",
-                labels='{quantile="%s"}' % label))
+                labels='{quantile="%s"}' % label,
+                exemplar=(ex["trace_id"], ex["latency_ms"])
+                if ex is not None and q == "p99" else None))
         lines.extend(prom.gauge_lines(
             "fleet_requests_per_s", snap["requests_per_s"],
             "requests completed per second over the rolling window"))

@@ -36,6 +36,7 @@ mode (their f32 coefficient dot is reassociation-sensitive).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -136,33 +137,52 @@ class CompiledPredictor:
 
     # ------------------------------------------------------------- internals
     def _leaves_for_chunk(self, bins: np.ndarray, rows: int,
-                          bucket: int) -> np.ndarray:
+                          bucket: int, trace=None,
+                          parent: Optional[int] = None) -> np.ndarray:
         """Device leaf indices for one bucket-padded chunk: i32
         [T, rows] (padding sliced off)."""
         import jax.numpy as jnp
 
         from ..models.predict import predict_forest_leaves
+        t_pad = time.perf_counter() if trace is not None else 0.0
         padded = np.zeros((bucket, bins.shape[1]), bins.dtype)
         padded[:rows] = bins
         bins_t = jnp.asarray(np.ascontiguousarray(padded.T))
+        if trace is not None:
+            t_run = time.perf_counter()
+            trace.record_span("bucket_pad", trace.us(t_pad),
+                              (t_run - t_pad) * 1e6, parent=parent,
+                              bucket=bucket)
         fn = cc.get_or_build(
             ("serve_leaves", cc.sig((self.fb, bins_t)), self.cat_feats,
              self.int8),
             lambda: predict_forest_leaves, anchors=(self,),
             metrics=self.metrics, counter_ns="serve")
         lv = fn(self.fb, bins_t, cat_feats=self.cat_feats, int8=self.int8)
-        return np.asarray(lv)[:, :rows]
+        out = np.asarray(lv)[:, :rows]
+        if trace is not None:
+            trace.record_span("device_run", trace.us(t_run),
+                              (time.perf_counter() - t_run) * 1e6,
+                              parent=parent, bucket=bucket)
+        return out
 
     def _sums_for_chunk(self, bins: np.ndarray, rows: int,
-                        bucket: int) -> np.ndarray:
+                        bucket: int, trace=None,
+                        parent: Optional[int] = None) -> np.ndarray:
         """Fast mode: full device f32 sums for one padded chunk,
         f64-cast and sliced — [rows, k]."""
         import jax.numpy as jnp
 
         from ..models.predict import predict_bitset_forest
+        t_pad = time.perf_counter() if trace is not None else 0.0
         padded = np.zeros((bucket, bins.shape[1]), bins.dtype)
         padded[:rows] = bins
         bins_t = jnp.asarray(np.ascontiguousarray(padded.T))
+        if trace is not None:
+            t_run = time.perf_counter()
+            trace.record_span("bucket_pad", trace.us(t_pad),
+                              (t_run - t_pad) * 1e6, parent=parent,
+                              bucket=bucket)
         fn = cc.get_or_build(
             ("serve_sums", cc.sig((self.fb, bins_t)), self.k,
              self.cat_feats, self.int8),
@@ -170,7 +190,12 @@ class CompiledPredictor:
             metrics=self.metrics, counter_ns="serve")
         res = fn(self.fb, bins_t, self.k, cat_feats=self.cat_feats,
                  int8=self.int8)
-        return np.asarray(res, np.float64)[:rows]
+        out = np.asarray(res, np.float64)[:rows]
+        if trace is not None:
+            trace.record_span("device_run", trace.us(t_run),
+                              (time.perf_counter() - t_run) * 1e6,
+                              parent=parent, bucket=bucket)
+        return out
 
     def _mark_chunk(self, bucket: int, stats: RequestStats) -> None:
         with self._warm_lock:
@@ -180,9 +205,14 @@ class CompiledPredictor:
                 self._warm.add(bucket)
 
     # -------------------------------------------------------------- predict
-    def predict_ex(self, X, raw_score: bool = True):
+    def predict_ex(self, X, raw_score: bool = True, trace=None,
+                   parent: Optional[int] = None):
         """(output, RequestStats).  Output matches ``Booster.predict``:
-        [n] for single-output models, [n, k] for multiclass."""
+        [n] for single-output models, [n, k] for multiclass.
+
+        ``trace``/``parent`` (obs/reqtrace.py) record per-chunk
+        bucket_pad / device_run spans and the exact-mode value_gather
+        span; ``trace=None`` (request_trace=off) adds no work."""
         X = np.asarray(X, np.float64)
         if X.ndim == 1:
             X = X.reshape(1, -1)
@@ -203,18 +233,26 @@ class CompiledPredictor:
             leaves = np.empty((len(self.trees), n), np.int32)
             for off, rows, bucket in chunks:
                 leaves[:, off:off + rows] = self._leaves_for_chunk(
-                    bins[off:off + rows], rows, bucket)
+                    bins[off:off + rows], rows, bucket,
+                    trace=trace, parent=parent)
+            t_gather = time.perf_counter() if trace is not None else 0.0
             out = np.zeros((n, self.k))
             # ascending tree order, one f64 add per tree — the exact
             # accumulation of the host walk (basic.py _predict_loaded)
             for ti, t in enumerate(self.trees):
                 out[:, ti % self.k] += t.values_from_leaf_index(
                     X, leaves[ti])
+            if trace is not None:
+                trace.record_span(
+                    "value_gather", trace.us(t_gather),
+                    (time.perf_counter() - t_gather) * 1e6,
+                    parent=parent, trees=len(self.trees))
         else:
             out = np.zeros((n, self.k))
             for off, rows, bucket in chunks:
                 out[off:off + rows] = self._sums_for_chunk(
-                    bins[off:off + rows], rows, bucket)
+                    bins[off:off + rows], rows, bucket,
+                    trace=trace, parent=parent)
         if not raw_score:
             from ..basic import _objective_string_transform
             out = _objective_string_transform(out, self.objective_str)

@@ -22,6 +22,7 @@ import numpy as np
 
 from ..config import Config
 from ..obs import prom
+from ..obs import reqtrace
 from ..obs.events import emit_event
 from ..obs.metrics import MetricsRegistry, count_event
 from ..obs.slo import SloEvaluator, Watchtower, parse_slo_config
@@ -75,6 +76,15 @@ class PredictionServer:
         self._tele_path = str(cfg.serving_telemetry_output or "")
         self._tele_lock = threading.Lock()
         self._tele_file = None
+        #: request-trace keeper (obs/reqtrace.py tail-based sampling) —
+        #: None with request_trace=off (default): the per-request fast
+        #: path then stays a single `is None` check, no span work at all
+        self._rt: Optional[reqtrace.TraceKeeper] = None
+        mode, frac = reqtrace.parse_request_trace(cfg.request_trace)
+        if mode != "off":
+            self._rt = reqtrace.TraceKeeper(
+                mode, frac,
+                count=lambda n, v=1: count_event(n, v, self.metrics))
         #: serving-side watchtower (rollup windows + burn-rate SLOs) —
         #: built only when slo_config enables at least one SLO; the
         #: all-off default adds zero per-request work
@@ -151,13 +161,49 @@ class PredictionServer:
         return out
 
     def serve(self, name: str, X, raw_score: bool = True,
-              deadline_ms: Optional[float] = None):
+              deadline_ms: Optional[float] = None,
+              trace: Optional["reqtrace.RequestTrace"] = None):
         """``predict`` plus provenance: returns ``(out, version)`` where
         ``version`` is the registry version that actually served the
         request.  The entry is resolved exactly once, so the returned
         version IS the single version behind every row of ``out`` — the
         primitive the fleet router's rolling-swap version fence stamps
-        into replica responses (serving/fleet.py)."""
+        into replica responses (serving/fleet.py).
+
+        ``trace`` is a request-trace context to record spans into (the
+        fleet replica loop passes the wire-propagated one); when absent
+        and ``request_trace`` is enabled a local trace is minted and
+        submitted to this server's tail-sampling keeper."""
+        tr = trace
+        keeper = self._rt
+        local = tr is None and keeper is not None
+        if local:
+            tr = reqtrace.RequestTrace()
+        if tr is None:
+            return self._serve(name, X, raw_score, deadline_ms,
+                               None, None, None)
+        # pre-allocate the replica root + queue-wait span ids so children
+        # recorded mid-flight can parent onto spans that close at the end
+        rid, qid = tr.new_id(), tr.new_id()
+        status, t0 = "ok", time.perf_counter()
+        try:
+            return self._serve(name, X, raw_score, deadline_ms,
+                               tr, rid, qid)
+        except BaseException:
+            status = "error"
+            raise
+        finally:
+            latency_s = time.perf_counter() - t0
+            tr.record_span("replica_serve", tr.us(t0), latency_s * 1e6,
+                           span_id=rid, model=name, status=status)
+            if local:
+                keeper.finish(tr, model=name, status=status,
+                              latency_s=latency_s)
+
+    def _serve(self, name: str, X, raw_score: bool,
+               deadline_ms: Optional[float],
+               tr: Optional["reqtrace.RequestTrace"],
+               rid: Optional[int], qid: Optional[int]):
         t_admit = time.perf_counter()
         with self._inflight_lock:
             self._pending += 1
@@ -197,9 +243,19 @@ class PredictionServer:
             with self._inflight_lock:
                 self._pending -= 1
                 self.metrics.set_gauge("serve_queue_depth", self._pending)
+        if tr is not None:
+            tr.record_span("admission_check", tr.us(t_admit),
+                           (time.perf_counter() - t_admit) * 1e6,
+                           parent=qid)
         try:
             entry = self.registry.get(name)
             t0 = time.perf_counter()
+            if tr is not None:
+                # arrival -> predictor start (admission bookkeeping +
+                # registry lookup), the replica-side queue wait
+                tr.record_span("replica_queue_wait", tr.us(t_admit),
+                               (t0 - t_admit) * 1e6, span_id=qid,
+                               parent=rid)
             if deadline_ms is not None \
                     and (t0 - t_admit) * 1000.0 >= float(deadline_ms):
                 # budget burned while waiting on admission bookkeeping
@@ -212,7 +268,8 @@ class PredictionServer:
                 raise ServerOverloaded(
                     f"request deadline_ms={deadline_ms} expired before "
                     "predict start")
-            out, stats = entry.predictor.predict_ex(X, raw_score=raw_score)
+            out, stats = entry.predictor.predict_ex(
+                X, raw_score=raw_score, trace=tr, parent=rid)
             latency_s = time.perf_counter() - t0
         finally:
             with self._inflight_lock:
@@ -224,10 +281,11 @@ class PredictionServer:
             count_event("serve_pad_waste_rows", stats.pad_rows, self.metrics)
         if stats.warm_chunks:
             count_event("serve_bucket_hits", stats.warm_chunks, self.metrics)
+        tid = tr.trace_id if tr is not None else None
         with self._inflight_lock:
-            self._window.append((time.time(), latency_s, stats.rows))
-        self._feed_tower(latency_s=latency_s)
-        self._emit(entry, stats, latency_s, raw_score)
+            self._window.append((time.time(), latency_s, stats.rows, tid))
+        self._feed_tower(latency_s=latency_s, exemplar=tid)
+        self._emit(entry, stats, latency_s, raw_score, trace_id=tid)
         return out, entry.version
 
     def inflight(self) -> int:
@@ -237,7 +295,7 @@ class PredictionServer:
 
     # ----------------------------------------------------------- telemetry
     def _emit(self, entry: ModelEntry, stats, latency_s: float,
-              raw_score: bool) -> None:
+              raw_score: bool, trace_id: Optional[str] = None) -> None:
         if not self._tele_path:
             return
         with self._inflight_lock:
@@ -250,6 +308,10 @@ class PredictionServer:
                "fallback": stats.fallback,
                "latency_s": latency_s, "raw_score": raw_score,
                "inflight": inflight, "queue_depth": pending}
+        if trace_id is not None:
+            # only traced requests carry the key — request_trace=off
+            # telemetry rows stay byte-identical to pre-trace builds
+            rec["trace_id"] = trace_id
         line = json.dumps(rec) + "\n"
         with self._tele_lock:
             if self._tele_file is None:
@@ -262,7 +324,8 @@ class PredictionServer:
             self._tele_file.write(line)
             self._tele_file.flush()
 
-    def _feed_tower(self, latency_s: Optional[float] = None) -> None:
+    def _feed_tower(self, latency_s: Optional[float] = None,
+                    exemplar: Optional[str] = None) -> None:
         """Advance the serving watchtower: push this completion (or
         rejection) into the current rollup window and run the burn-rate
         evaluator over any windows that just closed.  Reads admission
@@ -274,7 +337,8 @@ class PredictionServer:
         with self._tower_lock:
             r = tower.rollup
             if latency_s is not None:
-                r.observe_sample("latency_ms", latency_s * 1000.0)
+                r.observe_sample("latency_ms", latency_s * 1000.0,
+                                 exemplar=exemplar)
             r.observe_counter("serve_requests",
                               self.metrics.counter("serve_requests"))
             r.observe_counter("serve_rejected_requests",
@@ -289,6 +353,12 @@ class PredictionServer:
     def watchtower(self) -> Optional[Watchtower]:
         """The serving-side watchtower, or None when slo_config is off."""
         return self._tower
+
+    def recent_traces(self, limit: Optional[int] = None) -> List[Dict]:
+        """Kept request span trees (oldest first; [] when
+        request_trace=off or this server only records into wire-passed
+        fleet traces)."""
+        return self._rt.recent(limit) if self._rt is not None else []
 
     def stats(self) -> Dict[str, Any]:
         snap = self.metrics.snapshot()["counters"]
@@ -330,12 +400,18 @@ class PredictionServer:
                       max(0, int(round(q * (len(latencies) - 1)))))
             return round(latencies[idx] * 1000.0, 4)
 
+        traced = [(s[1], s[3]) for s in samples
+                  if len(s) > 3 and s[3] is not None]
+        worst = max(traced) if traced else None
         counters = self.metrics.snapshot()["counters"]
         out: Dict[str, Any] = {
             "window_s": float(window_s),
             "requests_in_window": len(samples),
             "latency_ms": {"p50": _pct(0.50), "p95": _pct(0.95),
                            "p99": _pct(0.99)},
+            "exemplars": {} if worst is None else {
+                "latency_ms": {"trace_id": worst[1],
+                               "latency_ms": round(worst[0] * 1000.0, 4)}},
             "requests_per_s": round(len(samples) / span, 4),
             "rows_per_s": round(rows / span, 4),
             "inflight": inflight,
@@ -356,12 +432,15 @@ class PredictionServer:
         versions as a labeled gauge — scrape-ready for a caller's
         ``/metrics`` endpoint."""
         snap = self.metrics_snapshot(window_s=window_s)
+        ex = snap.get("exemplars", {}).get("latency_ms")
         lines: List[str] = []
         for q, label in (("p50", "0.5"), ("p95", "0.95"), ("p99", "0.99")):
             lines.extend(prom.gauge_lines(
                 "serve_latency_ms", snap["latency_ms"][q],
                 f"request latency {q} over the rolling window",
-                labels='{quantile="%s"}' % label))
+                labels='{quantile="%s"}' % label,
+                exemplar=None if ex is None or q != "p99"
+                else (ex["trace_id"], ex["latency_ms"])))
         lines.extend(prom.gauge_lines(
             "serve_requests_per_s", snap["requests_per_s"],
             "requests completed per second over the rolling window"))

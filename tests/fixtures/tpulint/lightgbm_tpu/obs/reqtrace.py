@@ -1,0 +1,9 @@
+"""Toy SPANS registry backing the OBS304 single-file fixtures.
+
+Only the declaration matters — tpulint reads the keys via ``ast``,
+mirroring the real ``lightgbm_tpu/obs/reqtrace.py`` span registry.
+"""
+
+SPANS = {
+    "declared_span": "a span the fixtures are allowed to record",
+}

@@ -1,0 +1,9 @@
+"""OBS304: records a request-trace span under a name the
+obs/reqtrace.py SPANS registry never declared — trace consumers cannot
+rely on the span vocabulary."""
+
+from lightgbm_tpu.obs.reqtrace import RequestTrace
+
+
+def handle(tr: RequestTrace):
+    tr.record_span("undeclared_span", 0.0, 1.0)

@@ -1,0 +1,8 @@
+"""OBS304-clean: every recorded span name is declared in the
+obs/reqtrace.py SPANS registry."""
+
+from lightgbm_tpu.obs.reqtrace import RequestTrace
+
+
+def handle(tr: RequestTrace):
+    tr.record_span("declared_span", 0.0, 1.0)

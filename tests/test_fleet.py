@@ -132,3 +132,74 @@ def test_fleet_snapshot_and_prometheus(fleet):
     assert "fleet_latency_ms" in txt
     assert 'fleet_replica_state{replica="0"}' in txt
     assert "fleet_replica_model_version" in txt
+
+
+def test_fleet_trace_off_adds_nothing(fleet):
+    """The module fixture runs with the default ``request_trace=off``:
+    no keeper, no kept trees, no exemplars, no flight dir."""
+    assert fleet._rt is None
+    assert fleet.recent_traces() == []
+    assert fleet.metrics_snapshot()["exemplars"] == {}
+    assert "trace_id" not in fleet.prometheus_text()
+    assert not os.path.exists(fleet.flight_dir)
+
+
+def test_fleet_request_trace_end_to_end(fleet_model, tmp_path):
+    """One traced request -> ONE coherent cross-process span tree: the
+    router's request/dispatch/attempt spans plus the replica's
+    serve/queue/pad/run spans re-anchored onto the router's clock
+    (wall-anchor graft), with the exemplar surfaced in the p99 line."""
+    from lightgbm_tpu.obs.merge import find_fleet_artifacts
+    from lightgbm_tpu.obs.reqtrace import to_chrome
+    b1, _, X = fleet_model
+    srv = FleetServer(
+        {"serving_replicas": 2, "serving_buckets": [1, 8],
+         "fleet_heartbeat_interval_s": 0.2,
+         "fleet_heartbeat_timeout_s": 1.5,
+         "request_trace": "all"},
+        workdir=str(tmp_path))
+    try:
+        srv.publish("m", booster=b1)
+        for _ in range(4):
+            r = srv.predict_ex("m", X[:3], deadline_ms=10_000)
+        assert r["failovers"] == 0
+        traces = srv.recent_traces()
+        assert len(traces) == 4
+        t = traces[-1]
+        spans = t["spans"]
+        by_name = {}
+        for s in spans:
+            by_name.setdefault(s["name"], []).append(s)
+        for need in ("request", "router_dispatch", "attempt",
+                     "replica_serve", "replica_queue_wait",
+                     "admission_check", "bucket_pad", "device_run",
+                     "value_gather"):
+            assert need in by_name, f"missing span {need}"
+        root = by_name["request"][0]
+        att = by_name["attempt"][0]
+        serve = by_name["replica_serve"][0]
+        assert att["parent"] == root["span_id"]
+        assert by_name["router_dispatch"][0]["parent"] == root["span_id"]
+        # replica spans hang off the attempt, on the replica's lane
+        assert serve["parent"] == att["span_id"]
+        assert serve["tid"] == 1 + att["args"]["slot"]
+        assert by_name["device_run"][0]["tid"] == serve["tid"]
+        # re-anchored onto the ROUTER's clock: inside the request span
+        assert 0.0 <= serve["ts"] <= root["dur"]
+        ids = {s["span_id"] for s in spans}
+        assert all(s["parent"] is None or s["parent"] in ids
+                   for s in spans)
+        json_doc = to_chrome(t)
+        assert json_doc["lgbtpu"]["trace_id"] == t["trace_id"]
+        # exemplar: worst traced request's id rides the p99 gauge line
+        ex = srv.metrics_snapshot()["exemplars"]["latency_ms"]
+        assert any(x["trace_id"] == ex["trace_id"] for x in traces)
+        assert 'trace_id="%s"' % ex["trace_id"] in srv.prometheus_text()
+        # replica sidecars + per-replica telemetry are discoverable for
+        # the obs_top --fleet panes
+        time.sleep(0.5)
+        art = find_fleet_artifacts(str(tmp_path))
+        assert {r["slot"] for r in art["telemetry"]} == {0, 1}
+        assert art["flight"] == []          # nobody died
+    finally:
+        srv.close()

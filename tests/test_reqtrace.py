@@ -1,0 +1,271 @@
+"""Request-scoped distributed tracing (obs/reqtrace.py, PR 13).
+
+Pure host-side unit coverage: the trace-spec parser, span trees and the
+cross-process clock graft, tail-based sampling, quantile exemplars in
+the rollup + Prometheus surfaces, and the crash flight recorder's
+first-dump-wins discipline.  The end-to-end serving paths are covered
+by tests/test_serving.py (overhead guard) and tests/test_fleet.py
+(merged router/replica tree); the failure drills by
+tools/fault_drill.py ``serve_kill``.
+"""
+
+import json
+import os
+
+import pytest
+
+from lightgbm_tpu.obs import reqtrace
+from lightgbm_tpu.obs.reqtrace import (FlightRecorder, RequestTrace,
+                                       TraceKeeper, dump_snapshot,
+                                       parse_request_trace, read_snapshot,
+                                       to_chrome)
+
+
+# ------------------------------------------------------------- the parser
+def test_parse_request_trace():
+    assert parse_request_trace("off") == ("off", 0.0)
+    assert parse_request_trace("") == ("off", 0.0)
+    assert parse_request_trace("false") == ("off", 0.0)
+    assert parse_request_trace("errors") == ("errors", 0.0)
+    assert parse_request_trace("all") == ("all", 1.0)
+    assert parse_request_trace("on") == ("all", 1.0)
+    assert parse_request_trace("sample:0.25") == ("sample", 0.25)
+    assert parse_request_trace("SAMPLE:1") == ("sample", 1.0)
+    for bad in ("sample:", "sample:2", "sample:-0.1", "sometimes",
+                "sample:x"):
+        with pytest.raises(ValueError):
+            parse_request_trace(bad)
+
+
+def test_config_rejects_bad_request_trace():
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.utils.log import LightGBMError
+    assert Config({"request_trace": "errors"}).request_trace == "errors"
+    with pytest.raises(LightGBMError, match="request_trace"):
+        Config({"request_trace": "sometimes"})
+
+
+# ------------------------------------------------------------- span trees
+def test_span_tree_and_declared_names():
+    tr = RequestTrace()
+    assert len(tr.trace_id) == 16
+    root = tr.new_id()
+    child = tr.record_span("replica_serve", 0.0, 100.0, span_id=root,
+                           model="m")
+    assert child == root
+    leaf = tr.record_span("device_run", 10.0, 50.0, parent=root,
+                          bucket=8)
+    spans = tr.spans
+    assert [s["name"] for s in spans] == ["replica_serve", "device_run"]
+    assert spans[1]["parent"] == root and spans[1]["span_id"] == leaf
+    assert spans[1]["args"]["bucket"] == 8
+    # every recorded name must be in the declared SPANS registry (the
+    # OBS304 vocabulary this file's consumers rely on)
+    for s in spans:
+        assert s["name"] in reqtrace.SPANS
+
+
+def test_graft_reanchors_replica_spans_onto_router_clock():
+    router = RequestTrace()
+    aid = router.new_id()
+    replica = RequestTrace()
+    # replica's wall clock started 2 s after the router's
+    replica.wall_t0 = router.wall_t0 + 2.0
+    rid = replica.new_id()
+    replica.record_span("replica_serve", 1000.0, 500.0, span_id=rid)
+    replica.record_span("device_run", 1100.0, 200.0, parent=rid)
+    replica.record_span("bucket_pad", 1050.0, 40.0, parent=999999)
+    router.graft(replica.spans, replica.wall_t0, aid, tid=3)
+    got = {s["name"]: s for s in router.spans}
+    # +2 s wall offset -> +2e6 us shift on every grafted timestamp
+    assert got["replica_serve"]["ts"] == pytest.approx(1000.0 + 2e6)
+    assert got["device_run"]["ts"] == pytest.approx(1100.0 + 2e6)
+    # span ids are remapped into the router's id space, edges preserved
+    assert got["replica_serve"]["parent"] == aid
+    assert got["device_run"]["parent"] == got["replica_serve"]["span_id"]
+    # an unknown parent (ring truncation) re-anchors onto the attempt
+    assert got["bucket_pad"]["parent"] == aid
+    assert all(s["tid"] == 3 for s in router.spans)
+
+
+def test_to_chrome_is_perfetto_loadable():
+    tr = RequestTrace()
+    root = tr.new_id()
+    tr.record_span("request", 0.0, 900.0, span_id=root, model="m")
+    tr.record_span("attempt", 5.0, 800.0, parent=root, slot=1, tid=2)
+    doc = to_chrome(tr.to_dict(model="m", status="ok",
+                               keep_reason="sampled"))
+    events = doc["traceEvents"]
+    meta = [e for e in events if e.get("ph") == "M"]
+    xs = [e for e in events if e.get("ph") == "X"]
+    assert any(e["name"] == "process_name" for e in meta)
+    assert len(xs) == 2
+    assert all(e["ts"] >= 0 for e in xs)
+    assert doc["lgbtpu"]["request_trace"] is True
+    assert doc["lgbtpu"]["trace_id"] == tr.trace_id
+    json.dumps(doc)                       # must be serializable as-is
+
+
+# ------------------------------------------------------- tail-based keeper
+def _finish(keeper, **kw):
+    tr = RequestTrace()
+    args = dict(model="m", status="ok", latency_s=0.001)
+    args.update(kw)
+    return keeper.finish(tr, **args)
+
+
+def test_keeper_errors_mode_keeps_the_tail():
+    counts = {}
+    keeper = TraceKeeper(
+        "errors", 0.0,
+        count=lambda n, v=1: counts.__setitem__(n, counts.get(n, 0) + v))
+    assert _finish(keeper, status="error") == "error"
+    assert _finish(keeper, failovers=2) == "failover"
+    assert _finish(keeper, deadline_breached=True) == "deadline"
+    # the slowest-k watermark admits the first k healthy ones ...
+    for _ in range(reqtrace._SLOWEST_K):
+        assert _finish(keeper, latency_s=0.5) == "slow"
+    # ... then a faster-than-watermark healthy trace is sampled out
+    assert _finish(keeper, latency_s=0.0001) is None
+    kept = keeper.recent()
+    assert len(kept) == 3 + reqtrace._SLOWEST_K
+    assert counts["request_traces_kept"] == len(kept)
+    assert counts["request_traces_sampled_out"] == 1
+    assert {t["keep_reason"] for t in kept} == \
+        {"error", "failover", "deadline", "slow"}
+
+
+def test_keeper_sampling_is_deterministic_by_trace_id():
+    keeper = TraceKeeper("sample", 0.5)
+    keep, drop = 0, 0
+    for _ in range(400):
+        tr = RequestTrace()
+        again = keeper._hash_keep(tr.trace_id)
+        assert again == keeper._hash_keep(tr.trace_id)  # stable per id
+        keep += again
+        drop += not again
+    assert keep > 0 and drop > 0           # both sides of the coin
+    assert TraceKeeper("all", 1.0)._hash_keep("00" * 8)
+    assert not TraceKeeper("sample", 0.0)._hash_keep("ff" * 8)
+
+
+def test_keeper_all_mode_ring_is_bounded():
+    keeper = TraceKeeper("all", 1.0)
+    for _ in range(reqtrace._TRACE_RING_MAX + 7):
+        assert _finish(keeper) is not None
+    assert len(keeper.recent()) == reqtrace._TRACE_RING_MAX
+    assert len(keeper.recent(limit=5)) == 5
+
+
+# --------------------------------------------------------- flight recorder
+def test_flight_recorder_dump_first_wins(tmp_path):
+    path = str(tmp_path / "flight.e0.r1.json")
+    rec = FlightRecorder(path, slot=1, incarnation=0, pid=123)
+    rec.note_span("abcd", "replica_serve", 42.0)
+    rec.note_event({"event": "model_swapped", "unix_time": 1.0})
+    assert rec.dump("sigterm") is True
+    doc = read_snapshot(path)
+    assert doc["reason"] == "sigterm"
+    assert doc["meta"] == {"slot": 1, "incarnation": 0, "pid": 123}
+    assert doc["spans"][0]["name"] == "replica_serve"
+    assert doc["events"][0]["event"] == "model_swapped"
+    # a later dump (the parent's kill-detection path) must not clobber
+    # the victim's own final ring
+    rec2 = FlightRecorder(path, slot=1, incarnation=0, pid=123)
+    rec2.note_span("ffff", "replica_serve", 1.0)
+    assert rec2.dump("kill_detected") is False
+    assert dump_snapshot(path, rec2.snapshot(), "kill_detected") is False
+    assert read_snapshot(path)["reason"] == "sigterm"
+
+
+def test_flight_recorder_sidecar_roundtrip(tmp_path):
+    path = str(tmp_path / "flight.json")
+    side = str(tmp_path / "sidecar.json")
+    rec = FlightRecorder(path, maxlen=3, slot=0, incarnation=2, pid=9)
+    for i in range(5):
+        rec.note_span("t%d" % i, "device_run", float(i))
+    rec.publish(side)
+    snap = read_snapshot(side)
+    assert [s["trace_id"] for s in snap["spans"]] == ["t2", "t3", "t4"]
+    # the parent dumps the mirrored snapshot on behalf of the victim
+    assert dump_snapshot(path, snap, "kill_detected") is True
+    doc = read_snapshot(path)
+    assert doc["reason"] == "kill_detected"
+    assert doc["meta"]["incarnation"] == 2
+    assert read_snapshot(str(tmp_path / "missing.json")) is None
+    bad = tmp_path / "torn.json"
+    bad.write_text("{not json")
+    assert read_snapshot(str(bad)) is None
+
+
+def test_module_recorder_hooks(tmp_path):
+    path = str(tmp_path / "f.json")
+    rec = FlightRecorder(path, slot=0, incarnation=0, pid=1)
+    reqtrace.set_recorder(rec)
+    try:
+        tr = RequestTrace()
+        tr.record_span("admission_check", 0.0, 1.0)
+        reqtrace.note_event({"event": "request_failover"})
+    finally:
+        reqtrace.set_recorder(None)
+    snap = rec.snapshot()
+    assert snap["spans"][0]["name"] == "admission_check"
+    assert snap["spans"][0]["trace_id"] == tr.trace_id
+    assert snap["events"][0]["event"] == "request_failover"
+    # with no recorder installed the hooks are no-ops
+    RequestTrace().record_span("admission_check", 0.0, 1.0)
+    reqtrace.note_event({"event": "request_failover"})
+
+
+# ------------------------------------------------------------- exemplars
+def test_rollup_latency_exemplar_tracks_worst_sample():
+    from lightgbm_tpu.obs.timeseries import Rollup, feed_serving_row
+    r = Rollup(window_s=60.0)
+    feed_serving_row(r, {"ts": 1.0, "latency_s": 0.002,
+                         "trace_id": "aa" * 8})
+    feed_serving_row(r, {"ts": 2.0, "latency_s": 0.009,
+                         "trace_id": "bb" * 8})
+    feed_serving_row(r, {"ts": 3.0, "latency_s": 0.001})   # untraced
+    r.flush()
+    row = r.completed()[-1]["samples"]["latency_ms"]
+    assert row["exemplar"] == "bb" * 8
+    # a window with no traced observations carries no exemplar key
+    r2 = Rollup(window_s=60.0)
+    feed_serving_row(r2, {"ts": 1.0, "latency_s": 0.002})
+    r2.flush()
+    assert "exemplar" not in r2.completed()[-1]["samples"]["latency_ms"]
+
+
+def test_prom_gauge_exemplar_syntax():
+    from lightgbm_tpu.obs import prom
+    lines = prom.gauge_lines("serve_latency_ms", 12.5, "h",
+                             labels='{quantile="0.99"}',
+                             exemplar=("ab" * 8, 12.5))
+    assert lines[2] == ('lgbtpu_serve_latency_ms{quantile="0.99"} 12.5'
+                       ' # {trace_id="%s"} 12.5' % ("ab" * 8))
+    plain = prom.gauge_lines("serve_latency_ms", 12.5, "h")
+    assert "#" not in plain[2]
+
+
+# ---------------------------------------------------- fleet artifact scan
+def test_find_fleet_artifacts_layout(tmp_path):
+    from lightgbm_tpu.obs.merge import find_fleet_artifacts
+    wd = tmp_path / "fleet"
+    (wd / "flight").mkdir(parents=True)
+    (wd / "obs").mkdir()
+    (wd / "flight" / "flight.e0.r1.json").write_text("{}")
+    (wd / "flight" / "flight.e2.r0.json").write_text("{}")
+    (wd / "obs" / "serving.e0.r0.jsonl").write_text("")
+    (wd / "obs" / "serving.e0.r1.jsonl").write_text("")
+    art = find_fleet_artifacts(str(wd))
+    assert [(r["slot"], r["incarnation"]) for r in art["flight"]] == \
+        [(0, 2), (1, 0)]
+    assert [(r["slot"], r["incarnation"]) for r in art["telemetry"]] == \
+        [(0, 0), (1, 0)]
+    assert art["journal"] == []
+    ev = tmp_path / "events.jsonl"
+    sib = tmp_path / "events.e1.r2.jsonl"
+    sib.write_text("")
+    art = find_fleet_artifacts(str(wd), event_base=str(ev))
+    assert [os.path.basename(r["path"]) for r in art["journal"]] == \
+        [sib.name]

@@ -340,6 +340,54 @@ def test_steady_state_zero_lowerings(reg_model, multi_model):
     assert counters["serve_pad_waste_rows"] > 0
 
 
+@pytest.mark.parametrize("mode", ["off", "all"])
+def test_request_trace_overhead_guard(reg_model, multi_model, mode):
+    """PR13 CI guard over the same 110-mixed-request gate:
+    ``request_trace=off`` (default) must add ZERO per-request work — no
+    keeper, no trace minted, no trace id in the latency window, no
+    trace key in telemetry — and ``request_trace=all`` must still pass
+    the zero-lowerings gate (spans are host-side perf_counter reads,
+    never device work)."""
+    bst, X = reg_model
+    mbst, mX = multi_model
+    srv = PredictionServer({"serving_buckets": [1, 8, 64],
+                            "request_trace": mode})
+    srv.publish("reg", booster=bst)
+    srv.publish("multi", booster=mbst)
+    base = _lowerings()
+    rng = np.random.default_rng(4)
+    for i in range(110):
+        n = int(rng.integers(1, 130))
+        if i % 3 == 2:
+            srv.predict("multi", mX[:n], raw_score=(i % 2 == 0))
+        else:
+            srv.predict("reg", X[:n], raw_score=(i % 2 == 0))
+    assert _lowerings() - base == 0, \
+        f"request_trace={mode} lowered new XLA programs"
+    if mode == "off":
+        assert srv._rt is None                 # no keeper allocated
+        assert srv.recent_traces() == []
+        assert all(s[3] is None for s in srv._window)
+        assert srv.metrics_snapshot()["exemplars"] == {}
+        assert "trace_id" not in srv.prometheus_text()
+        counters = srv.stats()["counters"]
+        assert counters.get("request_traces_kept", 0) == 0
+    else:
+        traces = srv.recent_traces()
+        assert len(traces) == 110              # all mode keeps everything
+        names = {s["name"] for s in traces[-1]["spans"]}
+        assert {"replica_serve", "replica_queue_wait", "admission_check",
+                "bucket_pad", "device_run"} <= names
+        # every span id resolves inside its own tree
+        ids = {s["span_id"] for s in traces[-1]["spans"]}
+        assert all(s["parent"] is None or s["parent"] in ids
+                   for s in traces[-1]["spans"])
+        # the worst traced request surfaces as a quantile exemplar
+        ex = srv.metrics_snapshot()["exemplars"]["latency_ms"]
+        assert any(t["trace_id"] == ex["trace_id"] for t in traces)
+        assert 'trace_id="%s"' % ex["trace_id"] in srv.prometheus_text()
+
+
 # ------------------------------------------------- gbdt predict bucketing
 def _patch_predict_geometry(monkeypatch):
     from lightgbm_tpu.boosting.gbdt import GBDT

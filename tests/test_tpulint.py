@@ -64,6 +64,7 @@ RULE_FIXTURES = [
     ("RBS502", "serving/rbs502_bad.py", "serving/rbs502_ok.py"),
     ("OBS302", "obs302_bad.py", "obs302_ok.py"),
     ("OBS303", "obs303_bad.py", "obs303_ok.py"),
+    ("OBS304", "obs304_bad.py", "obs304_ok.py"),
 ]
 
 

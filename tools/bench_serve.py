@@ -62,6 +62,46 @@ def _pcts(lat_s: List[float]) -> Dict[str, float]:
             "p99_ms": float(np.percentile(a, 99))}
 
 
+#: payload column -> span name (obs/reqtrace.py SPANS) it sums
+_STAGE_SPANS = {"queue_ms": "replica_queue_wait",
+                "pad_ms": "bucket_pad",
+                "device_ms": "device_run",
+                "gather_ms": "value_gather"}
+
+
+def _stage_breakdown(traces: List[Dict[str, Any]]
+                     ) -> Dict[int, Dict[str, float]]:
+    """Mean per-stage milliseconds per bucket from kept request span
+    trees (``PredictionServer.recent_traces``).  A request is attributed
+    to the largest bucket its ``bucket_pad`` spans touched; stage time
+    is the SUM of that span name's durations within the request (a
+    request larger than the top bucket runs several chunks)."""
+    acc: Dict[int, Dict[str, float]] = {}
+    for t in traces:
+        spans = t.get("spans") or []
+        touched = [s["args"]["bucket"] for s in spans
+                   if s.get("name") == "bucket_pad"
+                   and "bucket" in (s.get("args") or {})]
+        if not touched:
+            continue
+        b = max(touched)
+        sums = {col: 0.0 for col in _STAGE_SPANS}
+        for s in spans:
+            for col, name in _STAGE_SPANS.items():
+                if s.get("name") == name:
+                    sums[col] += float(s.get("dur", 0.0)) / 1000.0
+        row = acc.setdefault(b, dict({c: 0.0 for c in _STAGE_SPANS},
+                                     n=0))
+        row["n"] += 1
+        for col in _STAGE_SPANS:
+            row[col] += sums[col]
+    out: Dict[int, Dict[str, float]] = {}
+    for b, row in acc.items():
+        n = max(row.pop("n"), 1)
+        out[b] = {col: row[col] / n for col in _STAGE_SPANS}
+    return out
+
+
 def _request_sizes(buckets: List[int], requests: int,
                    rng: np.random.Generator) -> List[int]:
     """A request stream that exercises every bucket: sizes drawn
@@ -104,7 +144,12 @@ def run(requests: int, features: int, trees: int, leaves: int,
          "num_leaves": leaves, "min_data_in_leaf": 5, "verbosity": -1},
         lgb.Dataset(Xt, label=y))
 
-    server = PredictionServer({"serving_buckets": buckets})
+    # tracing is on for the whole stream so every bucket row can carry
+    # its queue/pad/device/gather breakdown (span sums are measured
+    # INSIDE the request, so the percentile columns still time the same
+    # code path operators serve with when they enable request_trace)
+    server = PredictionServer({"serving_buckets": buckets,
+                               "request_trace": "all"})
     t0 = time.perf_counter()
     server.publish("bench", booster=booster, warmup=True)
     publish_s = time.perf_counter() - t0
@@ -135,6 +180,7 @@ def run(requests: int, features: int, trees: int, leaves: int,
     stream_s = time.perf_counter() - t_stream0
     steady = lowerings() - base_lowerings
 
+    stages = _stage_breakdown(server.recent_traces())
     bucket_rows: Dict[str, Any] = {}
     for b in buckets:
         lat = per_bucket_lat[b]
@@ -149,6 +195,9 @@ def run(requests: int, features: int, trees: int, leaves: int,
             "run_s": run_s,
             "compile_s": float(compile_s.get(b, 0.0)),
         })
+        if b in stages:
+            row["stage_ms"] = {col: round(v, 4)
+                               for col, v in stages[b].items()}
         bucket_rows[str(b)] = row
     overall = _pcts(all_lat)
     overall.update({"requests": len(all_lat),
@@ -301,14 +350,28 @@ def _render_text(payload: Dict[str, Any]) -> str:
     lines = ["bench_serve: %s on %s (%d requests)"
              % (payload["metric"], payload["platform"],
                 payload["requests"])]
-    lines.append("  %-8s %6s %9s %9s %9s %12s %9s"
-                 % ("bucket", "reqs", "p50_ms", "p95_ms", "p99_ms",
-                    "rows_per_s", "compile_s"))
+    has_stages = any("stage_ms" in r
+                     for r in payload["buckets"].values())
+    hdr = "  %-8s %6s %9s %9s %9s %12s %9s" \
+          % ("bucket", "reqs", "p50_ms", "p95_ms", "p99_ms",
+             "rows_per_s", "compile_s")
+    if has_stages:
+        hdr += " %9s %8s %9s %9s" % ("queue_ms", "pad_ms",
+                                     "device_ms", "gather_ms")
+    lines.append(hdr)
     for b in sorted(payload["buckets"], key=int):
         r = payload["buckets"][b]
-        lines.append("  %-8s %6d %9.3f %9.3f %9.3f %12.0f %9.3f"
-                     % (b, r["requests"], r["p50_ms"], r["p95_ms"],
-                        r["p99_ms"], r["rows_per_s"], r["compile_s"]))
+        row = "  %-8s %6d %9.3f %9.3f %9.3f %12.0f %9.3f" \
+              % (b, r["requests"], r["p50_ms"], r["p95_ms"],
+                 r["p99_ms"], r["rows_per_s"], r["compile_s"])
+        st = r.get("stage_ms")
+        if st is not None:
+            row += " %9.3f %8.3f %9.3f %9.3f" \
+                   % (st["queue_ms"], st["pad_ms"], st["device_ms"],
+                      st["gather_ms"])
+        elif has_stages:
+            row += " %9s %8s %9s %9s" % ("-", "-", "-", "-")
+        lines.append(row)
     o = payload["overall"]
     lines.append("  %-8s %6d %9.3f %9.3f %9.3f %12.0f"
                  % ("overall", o["requests"], o["p50_ms"], o["p95_ms"],

@@ -272,7 +272,37 @@ _SERVE_PARAMS = dict(serving_buckets=[1, 8], serving_replicas=3,
                      serving_retry_budget=2,
                      fleet_heartbeat_interval_s=0.2,
                      fleet_heartbeat_timeout_s=1.0,
-                     slo_config="on", rollup_window_s=0.5, verbosity=-1)
+                     slo_config="on", rollup_window_s=0.5,
+                     request_trace="errors", verbosity=-1)
+
+
+def _failover_trace(traces):
+    """The first kept span tree showing a completed failover: ≥2
+    attempt spans, the first erroring, a later one succeeding on a
+    DIFFERENT slot, with the winning attempt's replica-side
+    ``replica_serve`` span grafted under it (obs/reqtrace.py)."""
+    for t in traces:
+        spans = t.get("spans") or []
+        attempts = sorted((s for s in spans if s.get("name") == "attempt"),
+                          key=lambda s: s.get("ts", 0.0))
+        if len(attempts) < 2:
+            continue
+        first, ok_att = attempts[0], None
+        if (first.get("args") or {}).get("outcome") != "error":
+            continue
+        for a in attempts[1:]:
+            args = a.get("args") or {}
+            if args.get("outcome") == "ok" and \
+                    args.get("slot") != (first.get("args") or {}).get("slot"):
+                ok_att = a
+                break
+        if ok_att is None:
+            continue
+        served = [s for s in spans if s.get("name") == "replica_serve"
+                  and s.get("parent") == ok_att.get("span_id")]
+        if served:
+            return t, first, ok_att
+    return None, None, None
 
 
 def _serve_boosters(X, y):
@@ -334,6 +364,21 @@ def scenario_serve_kill(X, y):
                 if killed_at is None and now - t0 >= 0.5:
                     fleet.inject(kill_replica(0))
                     killed_at = now
+                    # burst back-to-back requests into the detection
+                    # window so at least one is routed AT the dead slot
+                    # and visibly fails over (the span tree the PR13
+                    # checks below read); pacing 0.02s per request
+                    # would race the monitor's process-exit poll
+                    while (fleet.metrics.counter(
+                               "fleet_request_failovers") < 1
+                           and fleet.states().get(0) == "healthy"
+                           and time.monotonic() - killed_at < 5.0):
+                        try:
+                            r = fleet.predict_ex("m", X[:3],
+                                                 deadline_ms=10_000)
+                            versions.add(r["version"])
+                        except Exception as e:  # noqa: BLE001
+                            errs.append(f"{type(e).__name__}: {e}")
                 if killed_at is not None and evict_s is None and \
                         fleet.metrics.counter(
                             "fleet_replica_respawns") >= 1:
@@ -347,11 +392,20 @@ def scenario_serve_kill(X, y):
                             for s in fleet.states().values())
             failovers = int(fleet.metrics.counter(
                 "fleet_request_failovers"))
+            traces = fleet.recent_traces()
         finally:
             fleet.close()
         evs = _journal_events(ev)
         from lightgbm_tpu.obs.events import journal_tail
         tail = journal_tail(ev)
+        # the victim's crash flight recorder: slot 0 died in its first
+        # incarnation, so the dump (written by the replica's SIGTERM
+        # handler, or by the router on kill detection from the last
+        # heartbeat snapshot) lands at flight/flight.e0.r0.json
+        from lightgbm_tpu.obs.reqtrace import read_snapshot
+        dump_path = os.path.join(td, "flight", "flight.e0.r0.json")
+        flight = read_snapshot(dump_path)
+    ftrace, att_fail, att_ok = _failover_trace(traces)
     checks = {
         "zero_failed_requests": not errs,
         "failover_absorbed_kill": failovers >= 1
@@ -362,12 +416,43 @@ def scenario_serve_kill(X, y):
         and "replica_rejoined" in evs,
         "journal_ordered": _eviction_ordered(evs),
         "single_version_responses": versions == {1},
+        # PR13: the kept span tree must SHOW the failover — attempt 1
+        # erroring on the killed slot, a later attempt succeeding on a
+        # different replica with its grafted replica-side spans
+        "trace_shows_failover": ftrace is not None
+        and (att_fail.get("args") or {}).get("slot") == 0,
+        "flight_dump_recovered": flight is not None
+        and (flight.get("meta") or {}).get("slot") == 0
+        and (flight.get("meta") or {}).get("incarnation") == 0,
     }
-    return {"name": "serve_kill", "checks": checks,
-            "eviction_latency_s": evict_s, "failovers": failovers,
-            "request_errors": errs[:5], "journal_tail": tail,
-            "watchtower": _watchtower_summary(tail),
-            "passed": all(checks.values())}
+    out = {"name": "serve_kill", "checks": checks,
+           "eviction_latency_s": evict_s, "failovers": failovers,
+           "request_errors": errs[:5], "journal_tail": tail,
+           "watchtower": _watchtower_summary(tail),
+           "passed": all(checks.values())}
+    if ftrace is not None:
+        out["failover_trace"] = {
+            "trace_id": ftrace.get("trace_id"),
+            "keep_reason": ftrace.get("keep_reason"),
+            "attempts": sum(1 for s in ftrace.get("spans", ())
+                            if s.get("name") == "attempt"),
+            "failed_slot": (att_fail.get("args") or {}).get("slot"),
+            "served_slot": (att_ok.get("args") or {}).get("slot"),
+        }
+    if flight is not None:
+        # the victim's final seconds, embedded for the postmortem
+        meta = flight.get("meta") or {}
+        out["flight_dump"] = {
+            "reason": flight.get("reason"),
+            "slot": meta.get("slot"),
+            "incarnation": meta.get("incarnation"),
+            "pid": meta.get("pid"),
+            "spans": len(flight.get("spans") or ()),
+            "events": len(flight.get("events") or ()),
+            "last_events": [e.get("event") for e in
+                            (flight.get("events") or [])[-5:]],
+        }
+    return out
 
 
 def scenario_serve_stall(X, y):

@@ -14,7 +14,10 @@ anything).
 Renders four panes in-terminal: training rounds (round_s, compile
 hits/misses, eval metrics), serving (latency percentiles, throughput,
 inflight/queue), SLO state (per-name ok/BREACHED with burn-rate
-violation counts), and the most recent journal events.
+violation counts), and the most recent journal events.  ``--fleet
+<workdir>`` adds a per-replica pane over a serving fleet's
+incarnation-namespaced telemetry siblings and lists any crash
+flight-recorder dumps found under ``<workdir>/flight``.
 
 Modes: default is a live loop redrawn every ``--interval`` seconds;
 ``--once`` renders one frame and exits (CI artifact / smoke check);
@@ -122,6 +125,48 @@ class Tail:
         return rows
 
 
+class FleetView:
+    """Per-replica pane state over a serving fleet's workdir
+    (serving/fleet.py layout, obs/merge.py ``find_fleet_artifacts``
+    naming re-implemented locally — importing the package would import
+    jax).  Replica telemetry siblings live at
+    ``<workdir>/obs/serving.jsonl.e<incarnation>.r<slot>`` and crash
+    flight-recorder dumps at ``<workdir>/flight/flight.e*.r*.json``."""
+
+    def __init__(self, workdir: str) -> None:
+        self.workdir = workdir
+        self._tails: Dict[str, Tail] = {}
+        #: (slot, incarnation) -> {"rows", "last"} aggregated per file
+        self.replicas: Dict[Tuple[int, int], Dict[str, Any]] = {}
+
+    def _scan(self, base: str) -> List[Tuple[int, int, str]]:
+        root, ext = os.path.splitext(base)
+        found = []
+        for path in glob.glob(glob.escape(root) + ".e*.r*" + ext):
+            m = _RANK_RE.search(path)
+            if m:           # epoch position carries the incarnation
+                found.append((int(m.group(2)), int(m.group(1)), path))
+        return sorted(found)
+
+    def flight_dumps(self) -> List[Tuple[int, int, str]]:
+        return self._scan(os.path.join(self.workdir, "flight",
+                                       "flight.json"))
+
+    def poll(self) -> int:
+        files = self._scan(os.path.join(self.workdir, "obs",
+                                        "serving.jsonl"))
+        for slot, inc, path in files:
+            tail = self._tails.get(path)
+            if tail is None:
+                tail = self._tails[path] = Tail(path)
+            agg = self.replicas.setdefault(
+                (slot, inc), {"rows": 0, "last": None})
+            for row in tail.poll():
+                agg["rows"] += 1
+                agg["last"] = row
+        return len(files)
+
+
 # ----------------------------------------------------------- aggregation
 class Watch:
     """The dashboard's state: one rollup fed from all three streams,
@@ -130,7 +175,12 @@ class Watch:
 
     def __init__(self, telemetry: str = "", serving: str = "",
                  events: str = "", window_s: float = 10.0,
-                 slo_spec: str = "on") -> None:
+                 slo_spec: str = "on", fleet: str = "") -> None:
+        self.fleet = FleetView(fleet) if fleet else None
+        if fleet and not serving:
+            # the fleet's default per-replica telemetry base feeds the
+            # aggregate SERVING pane too
+            serving = os.path.join(fleet, "obs", "serving.jsonl")
         self.tails = {"telemetry": Tail(telemetry),
                       "serving": Tail(serving),
                       "events": Tail(events)}
@@ -145,6 +195,8 @@ class Watch:
         self.rows_total = 0
 
     def poll(self, force_flush: bool = False) -> None:
+        if self.fleet is not None:
+            self.fleet.poll()
         for row in self.tails["telemetry"].poll():
             timeseries.feed_telemetry_row(self.rollup, row)
             self.last_training = row
@@ -169,7 +221,10 @@ class Watch:
         self.slo.evaluate(self.rollup.completed())
 
     def inputs_seen(self) -> int:
-        return sum(t.files_seen for t in self.tails.values())
+        n = sum(t.files_seen for t in self.tails.values())
+        if self.fleet is not None:
+            n += len(self.fleet.replicas)
+        return n
 
     def breached(self) -> List[str]:
         return self.slo.breached()
@@ -249,6 +304,29 @@ def render_frame(watch: Watch, now: Optional[float] = None) -> str:
                      % (_fmt(req.get("rate")), _fmt(inflight.get("last")),
                         _fmt(queue.get("last"))))
 
+    if watch.fleet is not None:
+        lines.append("")
+        lines.append("FLEET REPLICAS (%s)" % watch.fleet.workdir)
+        if not watch.fleet.replicas:
+            lines.append("  (no replica telemetry yet)")
+        for (slot, inc) in sorted(watch.fleet.replicas):
+            agg = watch.fleet.replicas[(slot, inc)]
+            last = agg["last"] or {}
+            tid = last.get("trace_id")
+            lat = last.get("latency_s")
+            lines.append("  slot=%d inc=%d  rows=%d  last latency_ms=%s"
+                         "  rows/req=%s%s"
+                         % (slot, inc, agg["rows"],
+                            _fmt(lat * 1000.0 if isinstance(
+                                lat, (int, float)) else None),
+                            _fmt(last.get("rows")),
+                            f"  trace={tid}" if tid else ""))
+        dumps = watch.fleet.flight_dumps()
+        if dumps:
+            lines.append("  flight dumps: " + "  ".join(
+                "%s (slot %d inc %d)" % (os.path.basename(p), s, i)
+                for s, i, p in dumps))
+
     lines.append("")
     lines.append("SLO")
     state = watch.slo.state()
@@ -305,6 +383,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="serving_telemetry_output base path")
     ap.add_argument("--events", default="",
                     help="event_output journal base path")
+    ap.add_argument("--fleet", default="",
+                    help="serving fleet workdir — adds a per-replica "
+                         "pane (incarnation-namespaced telemetry under "
+                         "<dir>/obs plus crash flight-recorder dumps "
+                         "under <dir>/flight)")
     ap.add_argument("--window", type=float, default=10.0,
                     help="rollup window seconds (default 10)")
     ap.add_argument("--slo", default="on",
@@ -319,13 +402,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="also write a static HTML render to this path")
     args = ap.parse_args(argv)
 
-    if not (args.telemetry or args.serving or args.events):
-        print("obs_top: no inputs — pass --telemetry/--serving/--events",
-              file=sys.stderr)
+    if not (args.telemetry or args.serving or args.events or args.fleet):
+        print("obs_top: no inputs — pass --telemetry/--serving/--events"
+              "/--fleet", file=sys.stderr)
         return EXIT_ERROR
     try:
         watch = Watch(args.telemetry, args.serving, args.events,
-                      window_s=args.window, slo_spec=args.slo)
+                      window_s=args.window, slo_spec=args.slo,
+                      fleet=args.fleet)
     except ValueError as e:
         print(f"obs_top: {e}", file=sys.stderr)
         return EXIT_ERROR
