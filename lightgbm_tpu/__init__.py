@@ -13,6 +13,7 @@ __version__ = "0.1.0"
 
 from .basic import Booster, Dataset, Sequence  # noqa: E402
 from .engine import cv, train  # noqa: E402
+from .io.streaming import stream_dataset  # noqa: E402
 from .callback import (early_stopping, log_evaluation,  # noqa: E402
                        log_telemetry, record_evaluation, reset_parameter)
 from .obs import global_metrics  # noqa: E402
@@ -34,7 +35,7 @@ __all__ = [
     "early_stopping", "log_evaluation", "log_telemetry",
     "record_evaluation", "reset_parameter", "global_metrics",
     "LGBMModel", "LGBMClassifier", "LGBMRegressor", "LGBMRanker",
-    "LightGBMError", "register_logger", "Sequence",
+    "LightGBMError", "register_logger", "Sequence", "stream_dataset",
     "plot_importance", "plot_split_value_histogram", "plot_metric",
     "plot_tree", "create_tree_digraph",
 ]

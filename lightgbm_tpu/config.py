@@ -207,6 +207,9 @@ _PARAMS: List[Tuple[str, Any, Tuple[str, ...], Tuple[Tuple[str, float], ...]]] =
     ("categorical_feature", "", ("cat_feature", "categorical_column", "cat_column",
                                  "categorical_features"), ()),
     ("forcedbins_filename", "", (), ()),
+    ("ingest_chunk_rows", 100000, (), ((">", 0),)),               # out-of-core streaming construction (io/streaming.py): rows per chunk in both the sketch pass and the bin+pack pass; peak host memory scales with this, not with the row count
+    ("ingest_memory_budget_mb", 0.0, (), ((">=", 0.0),)),         # out-of-core streaming construction: soft ceiling on the chunk working set in MB (0 = off); ingest_chunk_rows is clamped down so one raw+binned chunk fits the budget
+    ("ingest_sketch_accuracy", 0.001, (), ((">", 0.0), ("<", 0.5))),  # out-of-core streaming construction: relative accuracy alpha of the mergeable log-bucket quantile sketch used when a feature overflows the exact distinct tally; bin boundaries then sit within alpha relative error of the in-memory ones
     ("save_binary", False, ("is_save_binary", "is_save_binary_file"), ()),
     ("precise_float_parser", False, (), ()),
     ("parser_config_file", "", (), ()),

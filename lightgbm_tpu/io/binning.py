@@ -144,10 +144,36 @@ class BinMapper:
         NaN.  ``total_sample_cnt`` may exceed ``len(values)`` when zeros were
         elided by a sparse sampler; the difference is counted as zeros.
         """
-        m = cls()
         values = np.asarray(values, dtype=np.float64)
         na_cnt = int(np.isnan(values).sum())
         values = values[~np.isnan(values)]
+        dv, cnts = np.unique(values, return_counts=True)
+        return cls.find_bin_from_dist(
+            dv, cnts, na_cnt=na_cnt, total_sample_cnt=total_sample_cnt,
+            max_bin=max_bin, min_data_in_bin=min_data_in_bin,
+            use_missing=use_missing, zero_as_missing=zero_as_missing,
+            is_categorical=is_categorical, forced_bounds=forced_bounds)
+
+    @classmethod
+    def find_bin_from_dist(cls, distinct_values: np.ndarray,
+                           counts: np.ndarray, *, na_cnt: int,
+                           total_sample_cnt: int, max_bin: int,
+                           min_data_in_bin: int, use_missing: bool,
+                           zero_as_missing: bool, is_categorical: bool = False,
+                           forced_bounds: Optional[Sequence[float]] = None
+                           ) -> "BinMapper":
+        """``find_bin`` on a (distinct value, count) summary instead of raw
+        values — THE shared construction path.  ``find_bin`` reduces its
+        sample through ``np.unique`` and delegates here, so a streamed
+        exact tally (io/streaming.py pass 1) that reproduces the same
+        distinct/count multiset produces a bit-identical mapper.  NaN must
+        already be stripped from ``distinct_values`` and tallied in
+        ``na_cnt``; zeros elided upstream (sparse/streamed sources) are
+        recovered from ``total_sample_cnt`` exactly like ``find_bin``.
+        """
+        m = cls()
+        dv = np.asarray(distinct_values, dtype=np.float64)
+        cnts = np.asarray(counts, dtype=np.int64)
 
         if not use_missing:
             m.missing_type = MISSING_NONE
@@ -159,24 +185,28 @@ class BinMapper:
             m.missing_type = MISSING_NONE
 
         if is_categorical:
-            m._find_bin_categorical(values, total_sample_cnt, max_bin, na_cnt)
+            m._find_bin_categorical(dv, cnts, total_sample_cnt, max_bin,
+                                    na_cnt)
             return m
 
-        m._find_bin_numerical(values, total_sample_cnt, max_bin,
+        m._find_bin_numerical(dv, cnts, total_sample_cnt, max_bin,
                               min_data_in_bin, na_cnt, forced_bounds)
         return m
 
-    def _find_bin_numerical(self, values: np.ndarray, total_sample_cnt: int,
+    def _find_bin_numerical(self, dv: np.ndarray, cnts: np.ndarray,
+                            total_sample_cnt: int,
                             max_bin: int, min_data_in_bin: int, na_cnt: int,
                             forced_bounds: Optional[Sequence[float]]) -> None:
         self.bin_type = BIN_NUMERICAL
-        zero_cnt = max(0, total_sample_cnt - len(values) - na_cnt)
+        n_values = int(cnts.sum())
+        zero_cnt = max(0, total_sample_cnt - n_values - na_cnt)
         # zeros elided by sparse sampling come back as explicit zeros here
-        nonzero = values[np.abs(values) > K_ZERO_THRESHOLD]
-        zero_cnt += len(values) - len(nonzero)
-        if len(nonzero):
-            self.min_val = float(nonzero.min())
-            self.max_val = float(nonzero.max())
+        nz = np.abs(dv) > K_ZERO_THRESHOLD
+        zero_cnt += int(cnts[~nz].sum())
+        dv_nz, c_nz = dv[nz], cnts[nz]
+        if len(dv_nz):
+            self.min_val = float(dv_nz.min())
+            self.max_val = float(dv_nz.max())
 
         budget = max_bin - (1 if self.missing_type == MISSING_NAN else 0)
         budget = max(budget, 2)
@@ -188,9 +218,10 @@ class BinMapper:
         fb = sorted(float(b) for b in forced_bounds) if forced_bounds else []
         if fb:
             budget = max(budget - len(fb), 2)
-        neg = np.sort(nonzero[nonzero < 0])
-        pos = np.sort(nonzero[nonzero > 0])
-        n_neg, n_pos = len(neg), len(pos)
+        neg_mask = dv_nz < 0
+        pos_mask = dv_nz > 0
+        n_neg = int(c_nz[neg_mask].sum())
+        n_pos = int(c_nz[pos_mask].sum())
         n_nonzero = n_neg + n_pos
         bounds = []
         if n_nonzero == 0:
@@ -198,8 +229,7 @@ class BinMapper:
         elif zero_cnt == 0:
             # no zeros sampled (dense feature): bin the raw value range
             # directly, no dedicated zero bin
-            dv, cnts = np.unique(np.sort(nonzero), return_counts=True)
-            bounds = _greedy_find_bin(dv, cnts, budget, n_nonzero,
+            bounds = _greedy_find_bin(dv_nz, c_nz, budget, n_nonzero,
                                       min_data_in_bin)
         else:
             # proportional budget split around the dedicated zero bin
@@ -211,16 +241,16 @@ class BinMapper:
             if n_pos > 0:
                 right_budget = max(right_budget, 1)
             if n_neg > 0:
-                dv, cnts = np.unique(neg, return_counts=True)
-                nb = _greedy_find_bin(dv, cnts, left_budget,
+                nb = _greedy_find_bin(dv_nz[neg_mask], c_nz[neg_mask],
+                                      left_budget,
                                       n_neg + zero_cnt // 2, min_data_in_bin)
                 if nb:
                     nb[-1] = -K_ZERO_THRESHOLD  # close negatives below zero bin
                 bounds.extend(nb)
             bounds.append(K_ZERO_THRESHOLD)  # zero bin upper bound
             if n_pos > 0:
-                dv, cnts = np.unique(pos, return_counts=True)
-                pb = _greedy_find_bin(dv, cnts, right_budget,
+                pb = _greedy_find_bin(dv_nz[pos_mask], c_nz[pos_mask],
+                                      right_budget,
                                       n_pos + zero_cnt - zero_cnt // 2,
                                       min_data_in_bin)
                 bounds.extend(pb)
@@ -237,15 +267,20 @@ class BinMapper:
             self.num_bin += 1  # dedicated NaN bin appended last
         self.default_bin = int(np.searchsorted(ub, 0.0, side="left"))
 
-    def _find_bin_categorical(self, values: np.ndarray, total_sample_cnt: int,
+    def _find_bin_categorical(self, dv: np.ndarray, dcnts: np.ndarray,
+                              total_sample_cnt: int,
                               max_bin: int, na_cnt: int) -> None:
         self.bin_type = BIN_CATEGORICAL
-        vals = values.astype(np.int64)
-        if (vals < 0).any():
+        ivals = dv.astype(np.int64)
+        if (ivals[dcnts > 0] < 0).any():
             log.warning("Met negative value in categorical features, will convert "
                         "it to NaN")
-            vals = vals[vals >= 0]
-        cats, counts = np.unique(vals, return_counts=True)
+            keep = ivals >= 0
+            ivals, dcnts = ivals[keep], dcnts[keep]
+        # distinct floats can collapse onto one int code: re-aggregate
+        cats, inv = np.unique(ivals, return_inverse=True)
+        counts = np.bincount(inv, weights=dcnts.astype(np.float64),
+                             minlength=len(cats)).astype(np.int64)
         order = np.argsort(-counts, kind="stable")
         cats, counts = cats[order], counts[order]
         # cap at max_bin - 1; rare categories collapse into bin 0

@@ -28,6 +28,11 @@ from .bundling import (BundlePlan, apply_bundles, plan_bundles,
 
 MAX_UINT8_BINS = 256
 
+#: On-disk binary dataset format version (save_binary/load_binary).
+#: v1 = unversioned seed format (marker only); v2 adds the version field
+#: and streaming-ingest provenance.  Readers accept <= their own version.
+BINARY_FORMAT_VERSION = 2
+
 
 def device_bins_pow2(widest: int) -> int:
     """Device histogram bin-axis width for a widest-column bin count:
@@ -136,6 +141,11 @@ class Dataset:
         # EFB (reference FastFeatureBundling dataset.cpp:246): when set,
         # ``bins`` holds bundled physical columns [n, Fb]
         self.bundle_plan: Optional[BundlePlan] = None
+        # set by io/streaming.py: how this dataset was constructed
+        # (chunk size, sketch accuracy, which features were sketched) —
+        # persisted through save_binary so audits can tell a streamed
+        # build from an in-memory one
+        self.ingest_provenance: Optional[Dict[str, Any]] = None
 
     # ------------------------------------------------------------ properties
     @property
@@ -591,9 +601,13 @@ class Dataset:
             extra["bundle_valid"] = p.valid
             extra["bundle_default_bin"] = p.default_bin
             extra["bundle_inv_table"] = p.inv_table
+        if self.ingest_provenance is not None:
+            extra["provenance_json"] = json.dumps(self.ingest_provenance)
         with open(path, "wb") as fh:  # keep the exact name (np appends .npz)
             np.savez_compressed(
-                fh, lgbtpu_dataset=np.int32(1), bins=self.bins,
+                fh, lgbtpu_dataset=np.int32(1),
+                format_version=np.int64(BINARY_FORMAT_VERSION),
+                bins=self.bins,
                 label=md.label, mappers_json=mappers_json,
                 used_feature_idx=np.asarray(self.used_feature_idx, np.int64),
                 num_total_features=np.int64(self.num_total_features),
@@ -609,6 +623,13 @@ class Dataset:
         z = np.load(path, allow_pickle=True)
         if "lgbtpu_dataset" not in z:
             log.fatal(f"{path} is not a lightgbm_tpu binary dataset")
+        # v1 (seed) files carry only the marker; treat them as version 1
+        version = int(z["format_version"]) if "format_version" in z else 1
+        if version > BINARY_FORMAT_VERSION:
+            log.fatal(
+                f"Binary dataset {path!r} has format version {version}, but "
+                f"this build reads up to version {BINARY_FORMAT_VERSION}; "
+                "re-save it with a matching lightgbm_tpu version")
         ds = cls()
         ds.config = config or Config()
         ds.bins = z["bins"]
@@ -629,6 +650,8 @@ class Dataset:
             ds.metadata.set_position(z["position"])
         if "raw" in z:
             ds.raw = z["raw"]
+        if "provenance_json" in z:
+            ds.ingest_provenance = json.loads(str(z["provenance_json"]))
         if "bundle_json" in z:
             from .bundling import BundlePlan
             bundles = json.loads(str(z["bundle_json"]))

@@ -8,17 +8,35 @@ hot parse when built.  Label/weight/group columns follow the reference
 ``label_column``/``weight_column``/``group_column`` conventions including
 ``name:`` prefixes; companion files ``<data>.weight`` / ``<data>.query``
 are honored like the reference loader.
+
+The file is consumed as newline-aligned byte-range **stripes**
+(:func:`iter_stripe_texts`) rather than slurped whole: format
+autodetection reads only the first line, and each stripe is parsed
+independently — the same machinery the out-of-core pipeline
+(io/streaming.py ``TextStripeSource``) streams shard by shard, so
+single-shot loads and streamed ingest share one code path.
 """
 
 from __future__ import annotations
 
+import io
 import os
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
 from ..config import Config
 from ..utils import log
+
+#: Default byte-range stripe size.  One stripe is the parse unit (and
+#: the resume shard in streamed ingest); peak parser memory is O(stripe).
+STRIPE_BYTES = 16 << 20
+
+
+def read_first_line(path: str) -> str:
+    """Read only the first line — all format autodetection needs."""
+    with open(path) as f:
+        return f.readline()
 
 
 def _detect_format(first_line: str) -> str:
@@ -42,20 +60,128 @@ def _parse_column_spec(spec: str, header_names) -> Optional[int]:
     return int(s)
 
 
+def iter_stripe_texts(path: str, stripe_bytes: int = STRIPE_BYTES, *,
+                      skip_header: bool = False,
+                      start_offset: Optional[int] = None
+                      ) -> Iterator[Tuple[int, str]]:
+    """Yield ``(byte_offset, text)`` newline-aligned stripes of ``path``.
+
+    Each stripe is ~``stripe_bytes`` of whole lines: the read is extended
+    to the next newline so no line straddles two stripes.  ``byte_offset``
+    is where the stripe starts, usable with ``start_offset`` to resume
+    mid-file without re-reading the prefix.
+    """
+    with open(path, "rb") as f:
+        if start_offset is not None:
+            f.seek(start_offset)
+        elif skip_header:
+            f.readline()
+        while True:
+            off = f.tell()
+            buf = f.read(stripe_bytes)
+            if not buf:
+                return
+            if not buf.endswith(b"\n"):
+                buf += f.readline()
+            yield off, buf.decode()
+
+
+def parse_delimited_stripe(text: str, sep: str) -> Optional[np.ndarray]:
+    """Parse one CSV/TSV stripe into a 2-D float64 matrix (None if blank)."""
+    raw = np.genfromtxt(io.StringIO(text), delimiter=sep, dtype=np.float64)
+    if raw.size == 0:
+        return None
+    if raw.ndim == 0:
+        raw = raw.reshape(1, 1)
+    elif raw.ndim == 1:
+        raw = raw.reshape(1, -1)
+    return raw
+
+
+def parse_libsvm_stripe(text: str
+                        ) -> Tuple[np.ndarray, List[List[Tuple[int, float]]],
+                                   int]:
+    """Parse one LibSVM stripe → (labels, rows of (idx, value), max idx)."""
+    rows: List[List[Tuple[int, float]]] = []
+    labels: List[float] = []
+    max_idx = -1
+    for line in text.splitlines():
+        toks = line.strip().split()
+        if not toks:
+            continue
+        labels.append(float(toks[0]))
+        pairs = []
+        for t in toks[1:]:
+            i, v = t.split(":")
+            pairs.append((int(i), float(v)))
+            max_idx = max(max_idx, int(i))
+        rows.append(pairs)
+    return np.asarray(labels, dtype=np.float64), rows, max_idx
+
+
+def densify_libsvm_rows(rows: List[List[Tuple[int, float]]],
+                        width: int) -> np.ndarray:
+    """Densify parsed LibSVM rows at a given column width (absent
+    indices are implicit zeros, like the reference loader)."""
+    arr = np.zeros((len(rows), width))
+    for r, pairs in enumerate(rows):
+        for i, v in pairs:
+            arr[r, i] = v
+    return arr
+
+
+def split_meta_columns(raw: np.ndarray, config: Config, header_names
+                       ) -> Tuple[np.ndarray, Optional[np.ndarray],
+                                  Dict[str, Any]]:
+    """Split label/weight/group columns out of a parsed CSV/TSV matrix."""
+    meta: Dict[str, Any] = {}
+    label_col = _parse_column_spec(config.label_column or "0", header_names)
+    weight_col = _parse_column_spec(config.weight_column, header_names)
+    group_col = _parse_column_spec(config.group_column, header_names)
+    drop = [c for c in (label_col, weight_col, group_col) if c is not None]
+    label = raw[:, label_col] if label_col is not None else None
+    if weight_col is not None:
+        meta["weight"] = raw[:, weight_col]
+    if group_col is not None:
+        meta["group"] = qid_to_group_sizes(raw[:, group_col])
+    keep = [c for c in range(raw.shape[1]) if c not in drop]
+    return raw[:, keep], label, meta
+
+
+def qid_to_group_sizes(qid: np.ndarray) -> np.ndarray:
+    """Per-row query ids -> per-query sizes (contiguous runs)."""
+    qid = np.asarray(qid).astype(np.int64)
+    change = np.r_[True, qid[1:] != qid[:-1]]
+    return np.diff(np.r_[np.flatnonzero(change), len(qid)])
+
+
+def load_companion_files(path: str, meta: Dict[str, Any]) -> None:
+    """Fill ``meta`` from ``<data>.weight`` / ``.query`` / … side files
+    (reference dataset_loader.cpp), without overwriting inline columns."""
+    for suffix, key in ((".weight", "weight"), (".query", "group"),
+                        (".group", "group"), (".init", "init_score"),
+                        (".position", "position")):
+        side = path + suffix
+        if os.path.exists(side) and key not in meta:
+            vals = np.loadtxt(side)
+            meta[key] = vals.astype(np.int64) if key == "group" else vals
+
+
 def load_text_file(path: str, config: Config
                    ) -> Tuple[np.ndarray, Optional[np.ndarray], Dict[str, Any]]:
     """Load a train/test text file → (features, label, metadata dict).
 
     Supports CSV/TSV (label column configurable, default 0) and LibSVM
-    (label first, 1-based sparse idx:value pairs).
+    (label first, 1-based sparse idx:value pairs).  The file is parsed
+    stripe by stripe — never slurped whole — so peak parser memory is
+    the stripe size plus the output arrays.
     """
     try:
         from ..native import parse_text  # C++ fast path
     except ImportError:
         parse_text = None
 
-    with open(path) as f:
-        first = f.readline()
+    first = read_first_line(path)
     fmt = _detect_format(first)
     has_header = bool(config.header)
     header_names = None
@@ -65,57 +191,33 @@ def load_text_file(path: str, config: Config
 
     meta: Dict[str, Any] = {}
     if fmt == "libsvm":
-        rows = []
-        labels = []
+        all_rows: List[List[Tuple[int, float]]] = []
+        all_labels: List[np.ndarray] = []
         max_idx = -1
-        with open(path) as f:
-            for line in f:
-                toks = line.strip().split()
-                if not toks:
-                    continue
-                labels.append(float(toks[0]))
-                pairs = []
-                for t in toks[1:]:
-                    i, v = t.split(":")
-                    pairs.append((int(i), float(v)))
-                    max_idx = max(max_idx, int(i))
-                rows.append(pairs)
-        arr = np.zeros((len(rows), max_idx + 1))
-        for r, pairs in enumerate(rows):
-            for i, v in pairs:
-                arr[r, i] = v
-        label = np.asarray(labels)
+        for _, text in iter_stripe_texts(path, skip_header=has_header):
+            labels, rows, mi = parse_libsvm_stripe(text)
+            all_labels.append(labels)
+            all_rows.extend(rows)
+            max_idx = max(max_idx, mi)
+        arr = densify_libsvm_rows(all_rows, max_idx + 1)
+        label = np.concatenate(all_labels) if all_labels else \
+            np.zeros(0, np.float64)
     else:
         sep = "\t" if fmt == "tsv" else ","
         if parse_text is not None:
             raw = parse_text(path, sep, 1 if has_header else 0)
+            if raw.ndim == 1:
+                raw = raw.reshape(1, -1)
         else:
-            raw = np.genfromtxt(path, delimiter=sep,
-                                skip_header=1 if has_header else 0,
-                                dtype=np.float64)
-        if raw.ndim == 1:
-            raw = raw.reshape(1, -1)
-        label_col = _parse_column_spec(config.label_column or "0", header_names)
-        weight_col = _parse_column_spec(config.weight_column, header_names)
-        group_col = _parse_column_spec(config.group_column, header_names)
-        drop = [c for c in (label_col, weight_col, group_col) if c is not None]
-        label = raw[:, label_col] if label_col is not None else None
-        if weight_col is not None:
-            meta["weight"] = raw[:, weight_col]
-        if group_col is not None:
-            # per-row query ids -> per-query sizes (contiguous runs)
-            qid = raw[:, group_col].astype(np.int64)
-            change = np.r_[True, qid[1:] != qid[:-1]]
-            meta["group"] = np.diff(np.r_[np.flatnonzero(change), len(qid)])
-        keep = [c for c in range(raw.shape[1]) if c not in drop]
-        arr = raw[:, keep]
+            parts = [parse_delimited_stripe(text, sep)
+                     for _, text in iter_stripe_texts(
+                         path, skip_header=has_header)]
+            parts = [p for p in parts if p is not None]
+            if not parts:
+                log.fatal(f"No data rows found in {path!r}")
+            raw = parts[0] if len(parts) == 1 \
+                else np.concatenate(parts, axis=0)
+        arr, label, meta = split_meta_columns(raw, config, header_names)
 
-    # companion files (reference dataset_loader.cpp: <file>.weight, .query)
-    for suffix, key in ((".weight", "weight"), (".query", "group"),
-                        (".group", "group"), (".init", "init_score"),
-                        (".position", "position")):
-        side = path + suffix
-        if os.path.exists(side) and key not in meta:
-            vals = np.loadtxt(side)
-            meta[key] = vals.astype(np.int64) if key == "group" else vals
+    load_companion_files(path, meta)
     return arr, label, meta

@@ -1,0 +1,1153 @@
+"""Out-of-core streaming dataset construction.
+
+TPU-native re-design of the reference two-round loader (reference:
+src/io/dataset_loader.cpp ``DatasetLoader::LoadFromFile`` with
+``two_round=true``: sample -> ``BinMapper::FindBin`` -> second binning
+pass).  ``Dataset.from_data`` materializes the full raw float64 matrix
+AND the full binned matrix in host RAM; this module replaces that with a
+chunked pipeline whose peak host memory is bounded by
+``ingest_chunk_rows``, not by the row count:
+
+  * **Pass 1 — sketch.**  Each chunk feeds per-feature mergeable
+    summaries (:class:`FeatureSummary`): an EXACT distinct-value/count
+    tally while the feature's cardinality fits
+    :data:`EXACT_TALLY_LIMIT`, overflowing into a deterministic
+    log-bucket quantile sketch (:class:`QuantileSketch`, DDSketch-style)
+    beyond it.  The merged global summaries feed
+    ``BinMapper.find_bin_from_dist`` — the SAME code path
+    ``BinMapper.find_bin`` reduces to through ``np.unique`` — so bin
+    boundaries are **bit-identical** to in-memory construction whenever
+    every feature fits the exact tally, and carry a documented relative
+    error bound of ``ingest_sketch_accuracy`` (alpha) otherwise: every
+    sketch representative ``r`` of a value ``v`` satisfies
+    ``|r - v| <= alpha * |v|``, so every bin boundary sits within alpha
+    relative error of an in-memory boundary.
+  * **Pass 2 — bin + pack.**  The source is re-streamed; each chunk is
+    binned via ``BinMapper.values_to_bins``, EFB-bundled
+    (``apply_bundles`` is row-wise, so per-chunk application is
+    byte-identical to whole-matrix application) and written shard by
+    shard into preallocated (or memory-mapped, when a ``workdir`` is
+    given) buffers for the bin matrix AND its ``packed_mirror()`` word
+    view — the packed/radix2 kernels see byte-identical layouts.
+  * **Restartable.**  With a ``workdir``, every completed shard commits
+    an atomic manifest record (write-to-temp + ``os.replace`` on the
+    robustness/checkpoint.py substrate) and emits an
+    ``ingest_shard_done`` journal event; a killed ingest resumes from
+    the last completed shard (``ingest_resumed``) and produces the same
+    dataset bytes as an uninterrupted run.
+
+Sampling parity: the in-memory path samples ``bin_construct_sample_cnt``
+rows for bin finding (``Dataset._construct_mappers``) and 100k rows for
+EFB planning (``plan_bundles``), both from seeded generators.  Sources
+that declare their row count up front (ndarray / Sequence / Arrow)
+reproduce the exact same sampled row sets, so streamed construction of
+an in-memory-sized dataset is bit-identical end to end.  Unknown-length
+sources (text stripes) sketch the full stream instead — strictly more
+data than the in-memory sample — so their bit-identity window is
+``n <= bin_construct_sample_cnt``.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+from typing import (Any, Callable, Dict, Iterator, List, NamedTuple,
+                    Optional, Sequence, Tuple, Union)
+
+import numpy as np
+
+from ..config import Config, as_config
+from ..obs.events import emit_event
+from ..obs.metrics import count_event
+from ..utils import log
+from .binning import BIN_CATEGORICAL, K_ZERO_THRESHOLD, BinMapper
+from .bundling import apply_bundles, plan_bundles
+from .dataset import (MAX_UINT8_BINS, Dataset, Metadata, _as_2d_float,
+                      _load_forced_bins, _resolve_categorical,
+                      device_bins_pow2)
+
+#: Per-feature distinct-value ceiling of the exact tally.  Below it the
+#: streamed summary reproduces ``np.unique`` of the full sample exactly
+#: (bit-identical bin boundaries); above it the feature overflows into
+#: the alpha-approximate quantile sketch.
+EXACT_TALLY_LIMIT = 65536
+
+#: Raw-byte ceiling for collecting the EFB sample during pass 1; wider
+#: datasets re-stream a dedicated EFB sampling pass instead.
+EFB_SAMPLE_COLLECT_BYTES = 128 << 20
+
+MANIFEST_NAME = "ingest_manifest.json"
+MANIFEST_VERSION = 1
+
+#: Test hook (fault-drill style, robustness/faults.py): called as
+#: ``hook(stage, shard_idx)`` after each shard commits; raising from it
+#: simulates a mid-ingest kill.
+_shard_hook: Optional[Callable[[str, int], None]] = None
+
+
+# --------------------------------------------------------------------------
+# quantile sketch
+# --------------------------------------------------------------------------
+class QuantileSketch:
+    """Deterministic mergeable quantile summary over log-spaced buckets.
+
+    DDSketch-style: a value ``v`` with ``|v| > kZeroThreshold`` lands in
+    integer bucket ``ceil(log_gamma |v|)`` (sign kept separately) where
+    ``gamma = (1 + alpha) / (1 - alpha)``; near-zeros are tallied apart.
+    Bucket assignment is pointwise, so the sketch of a multiset is a
+    homomorphism under multiset union — merging is bucket-wise count
+    addition, exactly commutative and associative regardless of chunk
+    order (the property the merge tests pin down).  Each bucket's
+    representative ``r = 2 * gamma^k / (gamma + 1)`` satisfies
+    ``|r - v| <= alpha * |v|`` for every member value, which bounds
+    every derived quantile and bin boundary by the same relative alpha.
+    """
+
+    __slots__ = ("alpha", "gamma", "_log_gamma", "pos", "neg", "zero_cnt")
+
+    def __init__(self, alpha: float) -> None:
+        self.alpha = float(alpha)
+        self.gamma = (1.0 + self.alpha) / (1.0 - self.alpha)
+        self._log_gamma = math.log(self.gamma)
+        self.pos: Dict[int, int] = {}
+        self.neg: Dict[int, int] = {}
+        self.zero_cnt = 0
+
+    def _keys_of(self, mags: np.ndarray) -> np.ndarray:
+        return np.ceil(np.log(mags) / self._log_gamma).astype(np.int64)
+
+    def _bump(self, table: Dict[int, int], keys: np.ndarray,
+              weights: np.ndarray) -> None:
+        uk, inv = np.unique(keys, return_inverse=True)
+        sums = np.bincount(inv, weights=weights.astype(np.float64),
+                           minlength=len(uk))
+        for k, w in zip(uk.tolist(), sums.tolist()):
+            table[k] = table.get(k, 0) + int(round(w))
+
+    def update(self, values: np.ndarray,
+               weights: Optional[np.ndarray] = None) -> None:
+        """Absorb (possibly weighted) non-NaN values."""
+        values = np.asarray(values, dtype=np.float64)
+        if weights is None:
+            weights = np.ones(len(values), dtype=np.int64)
+        weights = np.asarray(weights)
+        zmask = np.abs(values) <= K_ZERO_THRESHOLD
+        self.zero_cnt += int(weights[zmask].sum())
+        pos = values > K_ZERO_THRESHOLD
+        neg = values < -K_ZERO_THRESHOLD
+        if pos.any():
+            self._bump(self.pos, self._keys_of(values[pos]), weights[pos])
+        if neg.any():
+            self._bump(self.neg, self._keys_of(-values[neg]), weights[neg])
+
+    def merge(self, other: "QuantileSketch") -> None:
+        self.zero_cnt += other.zero_cnt
+        for table, src in ((self.pos, other.pos), (self.neg, other.neg)):
+            for k, c in src.items():
+                table[k] = table.get(k, 0) + c
+
+    def _reps(self, table: Dict[int, int]) -> Tuple[np.ndarray, np.ndarray]:
+        if not table:
+            return (np.zeros(0, np.float64), np.zeros(0, np.int64))
+        keys = np.array(sorted(table), dtype=np.int64)
+        cnts = np.array([table[int(k)] for k in keys], dtype=np.int64)
+        reps = 2.0 * np.power(self.gamma, keys.astype(np.float64)) \
+            / (self.gamma + 1.0)
+        return reps, cnts
+
+    def to_dist(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(sorted representative values, counts) — the summary fed to
+        ``BinMapper.find_bin_from_dist`` (near-zeros surface as 0.0)."""
+        pr, pc = self._reps(self.pos)
+        nr, nc = self._reps(self.neg)
+        vals = [(-nr)[::-1], pr]
+        cnts = [nc[::-1], pc]
+        if self.zero_cnt:
+            vals.insert(1, np.zeros(1, np.float64))
+            cnts.insert(1, np.array([self.zero_cnt], np.int64))
+        return np.concatenate(vals), np.concatenate(cnts)
+
+    def state(self) -> Dict[str, np.ndarray]:
+        pk = np.array(sorted(self.pos), np.int64)
+        nk = np.array(sorted(self.neg), np.int64)
+        return {
+            "pos_keys": pk,
+            "pos_cnts": np.array([self.pos[int(k)] for k in pk], np.int64),
+            "neg_keys": nk,
+            "neg_cnts": np.array([self.neg[int(k)] for k in nk], np.int64),
+            "zero": np.int64(self.zero_cnt),
+        }
+
+    @classmethod
+    def from_state(cls, alpha: float, st: Dict[str, np.ndarray]
+                   ) -> "QuantileSketch":
+        sk = cls(alpha)
+        sk.pos = {int(k): int(c) for k, c in
+                  zip(st["pos_keys"], st["pos_cnts"])}
+        sk.neg = {int(k): int(c) for k, c in
+                  zip(st["neg_keys"], st["neg_cnts"])}
+        sk.zero_cnt = int(st["zero"])
+        return sk
+
+
+class FeatureSummary:
+    """Mergeable per-feature value summary for pass 1.
+
+    Starts as an EXACT sorted distinct-value/count tally; the moment
+    cardinality exceeds ``exact_limit`` it converts to the alpha-bounded
+    :class:`QuantileSketch`.  Conversion is pointwise bucketization, so
+    it commutes with merging — the final summary depends only on the
+    multiset of values, never on chunk order or merge associativity
+    (exactly while the tally holds; bucket-exactly once sketched)."""
+
+    __slots__ = ("alpha", "exact_limit", "vals", "cnts", "sketch",
+                 "na_cnt", "n_total")
+
+    def __init__(self, alpha: float,
+                 exact_limit: Optional[int] = None) -> None:
+        self.alpha = float(alpha)
+        # late-bound so tests can shrink the module-level limit
+        self.exact_limit = int(EXACT_TALLY_LIMIT if exact_limit is None
+                               else exact_limit)
+        self.vals = np.zeros(0, np.float64)
+        self.cnts = np.zeros(0, np.int64)
+        self.sketch: Optional[QuantileSketch] = None
+        self.na_cnt = 0
+        self.n_total = 0
+
+    @property
+    def is_exact(self) -> bool:
+        return self.sketch is None
+
+    def _absorb_tally(self, nv: np.ndarray, nc: np.ndarray) -> None:
+        if self.sketch is not None:
+            self.sketch.update(nv, nc)
+            return
+        allv = np.concatenate([self.vals, nv])
+        allc = np.concatenate([self.cnts, nc])
+        sv, inv = np.unique(allv, return_inverse=True)
+        sc = np.bincount(inv, weights=allc.astype(np.float64),
+                         minlength=len(sv)).astype(np.int64)
+        if len(sv) > self.exact_limit:
+            count_event("ingest_sketch_overflows")
+            self.sketch = QuantileSketch(self.alpha)
+            self.sketch.update(sv, sc)
+            self.vals = np.zeros(0, np.float64)
+            self.cnts = np.zeros(0, np.int64)
+        else:
+            self.vals, self.cnts = sv, sc
+
+    def update(self, column: np.ndarray) -> None:
+        column = np.asarray(column, dtype=np.float64)
+        self.n_total += len(column)
+        nan = np.isnan(column)
+        if nan.any():
+            self.na_cnt += int(nan.sum())
+            column = column[~nan]
+        nv, nc = np.unique(column, return_counts=True)
+        self._absorb_tally(nv, nc.astype(np.int64))
+
+    def merge(self, other: "FeatureSummary") -> None:
+        self.na_cnt += other.na_cnt
+        self.n_total += other.n_total
+        if other.sketch is not None and self.sketch is None:
+            self.sketch = QuantileSketch(self.alpha)
+            self.sketch.update(self.vals, self.cnts)
+            self.vals = np.zeros(0, np.float64)
+            self.cnts = np.zeros(0, np.int64)
+        if self.sketch is not None:
+            if other.sketch is not None:
+                self.sketch.merge(other.sketch)
+            else:
+                self.sketch.update(other.vals, other.cnts)
+            return
+        self._absorb_tally(other.vals, other.cnts)
+
+    def to_dist(self) -> Tuple[np.ndarray, np.ndarray]:
+        if self.sketch is not None:
+            return self.sketch.to_dist()
+        return self.vals, self.cnts
+
+    # ------------------------------------------------------- persistence
+    def state(self) -> Dict[str, np.ndarray]:
+        st: Dict[str, np.ndarray] = {
+            "na": np.int64(self.na_cnt), "n": np.int64(self.n_total),
+            "exact": np.bool_(self.sketch is None),
+        }
+        if self.sketch is None:
+            st["vals"], st["cnts"] = self.vals, self.cnts
+        else:
+            st.update(self.sketch.state())
+        return st
+
+    @classmethod
+    def from_state(cls, alpha: float, st: Dict[str, np.ndarray],
+                   exact_limit: Optional[int] = None) -> "FeatureSummary":
+        fs = cls(alpha, exact_limit)
+        fs.na_cnt = int(st["na"])
+        fs.n_total = int(st["n"])
+        if bool(st["exact"]):
+            fs.vals = np.asarray(st["vals"], np.float64)
+            fs.cnts = np.asarray(st["cnts"], np.int64)
+        else:
+            fs.sketch = QuantileSketch.from_state(alpha, st)
+        return fs
+
+
+# --------------------------------------------------------------------------
+# chunk sources
+# --------------------------------------------------------------------------
+class RawChunk(NamedTuple):
+    """One streamed chunk: float64 features plus any per-row columns the
+    source carries (text stripes yield label/weight/query-id columns)."""
+    data: np.ndarray
+    label: Optional[np.ndarray] = None
+    weight: Optional[np.ndarray] = None
+    qid: Optional[np.ndarray] = None
+
+
+class ChunkSource:
+    """Protocol for re-streamable chunk iterators.
+
+    ``chunks(start_chunk)`` must yield the SAME chunk sequence on every
+    call (pass 1, pass 2 and resume all re-stream), and ``start_chunk``
+    skips already-committed shards cheaply.  ``num_rows`` /
+    ``num_features`` are ``None`` when the source cannot know them
+    before a full pass (text stripes)."""
+
+    kind = "abstract"
+    num_rows: Optional[int] = None
+    num_features: Optional[int] = None
+
+    def chunks(self, start_chunk: int = 0) -> Iterator[RawChunk]:
+        raise NotImplementedError
+
+    def fingerprint(self) -> Dict[str, Any]:
+        """Identity record for the resume manifest: a resumed ingest
+        refuses to continue onto a different-looking source."""
+        return {"kind": self.kind, "num_rows": self.num_rows,
+                "num_features": self.num_features}
+
+
+class ArrayChunkSource(ChunkSource):
+    """Chunk iterator over an in-memory array-like (the parity baseline
+    and the adapter for anything ``_as_2d_float`` accepts)."""
+
+    kind = "ndarray"
+
+    def __init__(self, data: Any, chunk_rows: int) -> None:
+        self.arr = _as_2d_float(data)
+        self.chunk_rows = max(1, int(chunk_rows))
+        self.num_rows, self.num_features = self.arr.shape
+
+    def chunks(self, start_chunk: int = 0) -> Iterator[RawChunk]:
+        for lo in range(start_chunk * self.chunk_rows, self.num_rows,
+                        self.chunk_rows):
+            hi = min(self.num_rows, lo + self.chunk_rows)
+            yield RawChunk(np.asarray(self.arr[lo:hi], dtype=np.float64))
+
+
+class SequenceChunkSource(ChunkSource):
+    """Chunk iterator over ``lightgbm_tpu.Sequence`` objects: reads
+    ``batch_size`` slices like ``basic._sequence_to_array`` but never
+    materializes more than one chunk."""
+
+    kind = "sequence"
+
+    def __init__(self, seqs: Sequence[Any], chunk_rows: int) -> None:
+        self.seqs = list(seqs)
+        self.chunk_rows = max(1, int(chunk_rows))
+        self.num_rows = sum(len(s) for s in self.seqs)
+        self.num_features = None  # discovered from the first batch
+
+    def _batches(self) -> Iterator[np.ndarray]:
+        for s in self.seqs:
+            n = len(s)
+            bs = int(getattr(s, "batch_size", 4096) or 4096)
+            for lo in range(0, n, bs):
+                hi = min(n, lo + bs)
+                batch = np.asarray(s[slice(lo, hi)], dtype=np.float64)
+                yield batch.reshape(hi - lo, -1)
+
+    def chunks(self, start_chunk: int = 0) -> Iterator[RawChunk]:
+        skip = start_chunk * self.chunk_rows
+        parts: List[np.ndarray] = []
+        have = 0
+        for batch in self._batches():
+            if skip >= len(batch):
+                skip -= len(batch)
+                continue
+            if skip:
+                batch = batch[skip:]
+                skip = 0
+            parts.append(batch)
+            have += len(batch)
+            while have >= self.chunk_rows:
+                merged = parts[0] if len(parts) == 1 \
+                    else np.concatenate(parts, axis=0)
+                yield RawChunk(merged[:self.chunk_rows])
+                parts = [merged[self.chunk_rows:]]
+                have = len(parts[0])
+                if have == 0:
+                    parts = []
+        if have:
+            merged = parts[0] if len(parts) == 1 \
+                else np.concatenate(parts, axis=0)
+            yield RawChunk(merged)
+
+
+class ArrowChunkSource(ChunkSource):
+    """Chunk iterator over a pyarrow Table (record batches); present
+    only when pyarrow imports."""
+
+    kind = "arrow"
+
+    def __init__(self, table: Any, chunk_rows: int) -> None:
+        self.table = table
+        self.chunk_rows = max(1, int(chunk_rows))
+        self.num_rows = int(table.num_rows)
+        self.num_features = int(table.num_columns)
+
+    def chunks(self, start_chunk: int = 0) -> Iterator[RawChunk]:
+        lo = start_chunk * self.chunk_rows
+        while lo < self.num_rows:
+            hi = min(self.num_rows, lo + self.chunk_rows)
+            sl = self.table.slice(lo, hi - lo)
+            cols = [np.asarray(sl.column(i).to_numpy(zero_copy_only=False),
+                               dtype=np.float64)
+                    for i in range(self.num_features)]
+            yield RawChunk(np.column_stack(cols))
+            lo = hi
+
+
+class TextStripeSource(ChunkSource):
+    """Byte-range stripe reader over a CSV/TSV/LibSVM file (io/parser.py
+    stripe machinery).  One stripe = one shard; stripes are newline
+    aligned and their byte offsets are recorded on the first pass so
+    pass 2 / resume can ``seek`` instead of re-reading the prefix.
+    LibSVM width grows monotonically during pass 1 (absent trailing
+    indices are implicit zeros, like the whole-file loader)."""
+
+    kind = "text"
+
+    def __init__(self, path: str, config: Config,
+                 stripe_bytes: Optional[int] = None) -> None:
+        from . import parser
+        self.path = str(path)
+        self.config = config
+        self.stripe_bytes = int(stripe_bytes or parser.STRIPE_BYTES)
+        first = parser.read_first_line(self.path)
+        self.fmt = parser._detect_format(first)
+        self.has_header = bool(config.header)
+        self.header_names = None
+        self.sep = "\t" if self.fmt == "tsv" else ","
+        if self.has_header:
+            self.header_names = [t.strip()
+                                 for t in first.strip().split(self.sep)]
+        self.num_rows = None
+        self.num_features = None
+        self._offsets: List[int] = []   # recorded stripe byte offsets
+        if self.fmt == "libsvm":
+            self._label_col = self._weight_col = self._group_col = None
+        else:
+            self._label_col = parser._parse_column_spec(
+                config.label_column or "0", self.header_names)
+            self._weight_col = parser._parse_column_spec(
+                config.weight_column, self.header_names)
+            self._group_col = parser._parse_column_spec(
+                config.group_column, self.header_names)
+
+    def fingerprint(self) -> Dict[str, Any]:
+        try:
+            st = os.stat(self.path)
+            sig = [int(st.st_size), int(st.st_mtime)]
+        except OSError:
+            sig = None
+        return {"kind": self.kind, "path": self.path,
+                "stripe_bytes": self.stripe_bytes, "sig": sig}
+
+    def _parse(self, text: str) -> Optional[RawChunk]:
+        from . import parser
+        if self.fmt == "libsvm":
+            labels, rows, max_idx = parser.parse_libsvm_stripe(text)
+            if not rows:
+                return None
+            width = max(max_idx + 1, self.num_features or 0)
+            self.num_features = max(self.num_features or 0, width)
+            return RawChunk(parser.densify_libsvm_rows(rows, width),
+                            label=labels)
+        raw = parser.parse_delimited_stripe(text, self.sep)
+        if raw is None:
+            return None
+        label = raw[:, self._label_col] \
+            if self._label_col is not None else None
+        weight = raw[:, self._weight_col] \
+            if self._weight_col is not None else None
+        qid = raw[:, self._group_col].astype(np.int64) \
+            if self._group_col is not None else None
+        drop = {c for c in (self._label_col, self._weight_col,
+                            self._group_col) if c is not None}
+        keep = [c for c in range(raw.shape[1]) if c not in drop]
+        data = raw[:, keep]
+        if self.num_features is None:
+            self.num_features = data.shape[1]
+        return RawChunk(data, label=label, weight=weight, qid=qid)
+
+    def chunks(self, start_chunk: int = 0) -> Iterator[RawChunk]:
+        from . import parser
+        start_offset = None
+        if start_chunk and start_chunk <= len(self._offsets):
+            if start_chunk == len(self._offsets):
+                # every recorded stripe is consumed; nothing follows the
+                # last one unless the file grew (it must not)
+                start_offset = None if not self._offsets else -1
+            else:
+                start_offset = self._offsets[start_chunk]
+        idx = start_chunk
+        if start_offset == -1:
+            return
+        stripes = parser.iter_stripe_texts(
+            self.path, stripe_bytes=self.stripe_bytes,
+            skip_header=self.has_header, start_offset=start_offset)
+        if start_offset is None and start_chunk:
+            # offsets unknown (fresh resume without manifest): re-read
+            # and discard the committed prefix
+            for _ in range(start_chunk):
+                next(stripes, None)
+        for off, text in stripes:
+            if idx == len(self._offsets):
+                self._offsets.append(off)
+            chunk = self._parse(text)
+            idx += 1
+            if chunk is not None:
+                yield chunk
+
+
+def make_source(data: Any, cfg: Config,
+                chunk_rows: Optional[int] = None) -> ChunkSource:
+    """Dispatch ``data`` to its :class:`ChunkSource` adapter."""
+    rows = int(chunk_rows or cfg.ingest_chunk_rows)
+    # duck-typed: anything with a re-streamable ``chunks()`` is a source
+    # (custom sources need not subclass ChunkSource)
+    if isinstance(data, ChunkSource) or \
+            (callable(getattr(data, "chunks", None))
+             and not hasattr(data, "toarray")):
+        return data
+    if isinstance(data, (str, os.PathLike)):
+        return TextStripeSource(str(data), cfg)
+    from ..basic import Sequence as LgbSequence
+    if isinstance(data, LgbSequence):
+        return SequenceChunkSource([data], rows)
+    if isinstance(data, list) and data and \
+            all(isinstance(s, LgbSequence) for s in data):
+        return SequenceChunkSource(data, rows)
+    try:
+        import pyarrow as pa
+        if isinstance(data, pa.Table):
+            return ArrowChunkSource(data, rows)
+    except ImportError:
+        pass
+    return ArrayChunkSource(data, rows)
+
+
+def clamp_chunk_rows(chunk_rows: int, num_features: Optional[int],
+                     budget_mb: float) -> int:
+    """Apply ``ingest_memory_budget_mb``: shrink the chunk so one raw
+    float64 chunk + its binned/packed output fits the budget."""
+    if not budget_mb or not num_features:
+        return int(chunk_rows)
+    bytes_per_row = num_features * (8 + 8 + 1 + 4) + 64
+    max_rows = int(budget_mb * 1e6 / bytes_per_row)
+    if 0 < max_rows < chunk_rows:
+        log.warning(f"ingest_memory_budget_mb={budget_mb:g} clamps "
+                    f"ingest_chunk_rows {chunk_rows} -> {max_rows}")
+        return max(256, max_rows)
+    return int(chunk_rows)
+
+
+# --------------------------------------------------------------------------
+# manifest (checkpoint-substrate atomic writes)
+# --------------------------------------------------------------------------
+def _write_atomic(path: str, data: Union[str, bytes]) -> None:
+    from ..robustness.checkpoint import _fsync_dir, _write_file
+    tmp = path + ".tmp"
+    _write_file(tmp, data)
+    os.replace(tmp, path)
+    _fsync_dir(os.path.dirname(path) or ".")
+
+
+def _save_npz_atomic(path: str, arrays: Dict[str, np.ndarray]) -> None:
+    from ..robustness.checkpoint import _fsync_dir
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as fh:
+        np.savez(fh, **arrays)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+    _fsync_dir(os.path.dirname(path) or ".")
+
+
+def read_manifest(workdir: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(os.path.join(workdir, MANIFEST_NAME)) as fh:
+            m = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(m, dict) or \
+            m.get("format_version") != MANIFEST_VERSION:
+        return None
+    return m
+
+
+# --------------------------------------------------------------------------
+# the ingest pipeline
+# --------------------------------------------------------------------------
+class StreamingIngest:
+    """Two-pass chunked construction (see module docstring).
+
+    ``workdir=None`` keeps the output buffers in RAM and skips the
+    manifest (journal events still fire); a workdir makes the big
+    buffers memory-mapped files and every shard restartable."""
+
+    def __init__(self, source: ChunkSource, cfg: Config,
+                 workdir: Optional[str] = None) -> None:
+        self.source = source
+        self.cfg = cfg
+        self.workdir = None if workdir is None else str(workdir)
+        self.alpha = float(cfg.ingest_sketch_accuracy)
+        self.chunk_rows = clamp_chunk_rows(
+            int(getattr(source, "chunk_rows", cfg.ingest_chunk_rows)),
+            source.num_features, float(cfg.ingest_memory_budget_mb))
+        if hasattr(source, "chunk_rows"):
+            source.chunk_rows = self.chunk_rows
+        self.manifest: Dict[str, Any] = {}
+        self.summaries: List[FeatureSummary] = []
+        self.shard_rows: List[int] = []        # rows per committed shard
+        self.mappers: List[BinMapper] = []
+        self.used_feature_idx: List[int] = []
+        self.plan = None
+        self.num_rows = 0
+        self.num_features = 0
+        # per-row side columns harvested from source chunks (text)
+        self._labels: List[np.ndarray] = []
+        self._weights: List[np.ndarray] = []
+        self._qids: List[np.ndarray] = []
+        # raw EFB sample collected opportunistically during pass 1
+        self._efb_rows: Optional[np.ndarray] = None
+        self._efb_raw: List[np.ndarray] = []
+
+    # ------------------------------------------------------------ manifest
+    def _path(self, name: str) -> str:
+        assert self.workdir is not None
+        return os.path.join(self.workdir, name)
+
+    def _commit_manifest(self) -> None:
+        if self.workdir is None:
+            return
+        self.manifest["format_version"] = MANIFEST_VERSION
+        self.manifest["fingerprint"] = self.source.fingerprint()
+        self.manifest["chunk_rows"] = self.chunk_rows
+        self.manifest["sketch_accuracy"] = self.alpha
+        _write_atomic(self._path(MANIFEST_NAME),
+                      json.dumps(self.manifest, default=str))
+
+    def _sketch_state_arrays(self) -> Dict[str, np.ndarray]:
+        arrays: Dict[str, np.ndarray] = {
+            "n_features": np.int64(len(self.summaries)),
+            "shard_rows": np.asarray(self.shard_rows, np.int64),
+        }
+        for j, fs in enumerate(self.summaries):
+            for k, v in fs.state().items():
+                arrays[f"f{j}_{k}"] = v
+        for name, parts in (("labels", self._labels),
+                            ("weights", self._weights),
+                            ("qids", self._qids)):
+            if parts:
+                arrays[name] = np.concatenate(parts)
+        return arrays
+
+    def _load_sketch_state(self) -> bool:
+        try:
+            z = np.load(self._path("sketch_state.npz"))
+        except (OSError, ValueError):
+            return False
+        nf = int(z["n_features"])
+        self.summaries = []
+        for j in range(nf):
+            st = {k[len(f"f{j}_"):]: z[k] for k in z.files
+                  if k.startswith(f"f{j}_")}
+            self.summaries.append(FeatureSummary.from_state(self.alpha, st))
+        self.shard_rows = [int(r) for r in z["shard_rows"]]
+        self._labels = [z["labels"]] if "labels" in z.files else []
+        self._weights = [z["weights"]] if "weights" in z.files else []
+        self._qids = [z["qids"]] if "qids" in z.files else []
+        return True
+
+    # -------------------------------------------------------------- pass 1
+    def _sample_rows(self) -> Optional[np.ndarray]:
+        """The exact bin-construction sample row set of the in-memory
+        path (``Dataset._construct_mappers``), when the source length is
+        known up front; ``None`` = sketch every row."""
+        n = self.source.num_rows
+        if n is None:
+            return None
+        sample_cnt = min(n, int(self.cfg.bin_construct_sample_cnt))
+        if sample_cnt >= n:
+            return None
+        rng = np.random.default_rng(self.cfg.data_random_seed)
+        return np.sort(rng.choice(n, size=sample_cnt, replace=False))
+
+    def _efb_sample_rows(self, n: int) -> np.ndarray:
+        """The exact EFB-planning sample row set of ``plan_bundles``."""
+        if n <= 100_000:
+            return np.arange(n, dtype=np.int64)
+        return np.sort(np.random.default_rng(3)
+                       .choice(n, 100_000, replace=False))
+
+    def _want_efb(self) -> bool:
+        return bool(self.cfg.enable_bundle) and self.cfg.tree_learner \
+            not in ("feature", "feature_parallel")
+
+    def _pass1(self, start_shard: int) -> None:
+        sample_rows = self._sample_rows()
+        collect_efb = (self._want_efb()
+                       and self.source.num_rows is not None
+                       and self.source.num_features is not None)
+        if collect_efb:
+            self._efb_rows = self._efb_sample_rows(self.source.num_rows)
+            est = len(self._efb_rows) * self.source.num_features * 8
+            if est > EFB_SAMPLE_COLLECT_BYTES:
+                collect_efb = False
+                self._efb_rows = None
+        lo = sum(self.shard_rows)
+        shard = start_shard
+        for chunk in self.source.chunks(start_shard):
+            data = chunk.data
+            rows = data.shape[0]
+            hi = lo + rows
+            while len(self.summaries) < data.shape[1]:
+                self.summaries.append(FeatureSummary(self.alpha))
+            if sample_rows is None:
+                sel = data
+            else:
+                i0 = np.searchsorted(sample_rows, lo)
+                i1 = np.searchsorted(sample_rows, hi)
+                sel = data[sample_rows[i0:i1] - lo]
+            for j in range(data.shape[1]):
+                self.summaries[j].update(sel[:, j])
+            if chunk.label is not None:
+                self._labels.append(np.asarray(chunk.label, np.float64))
+            if chunk.weight is not None:
+                self._weights.append(np.asarray(chunk.weight, np.float64))
+            if chunk.qid is not None:
+                self._qids.append(np.asarray(chunk.qid, np.int64))
+            if collect_efb:
+                i0 = np.searchsorted(self._efb_rows, lo)
+                i1 = np.searchsorted(self._efb_rows, hi)
+                self._efb_raw.append(data[self._efb_rows[i0:i1] - lo])
+            self.shard_rows.append(rows)
+            count_event("ingest_rows_streamed", rows)
+            count_event("ingest_shards_done")
+            if self.workdir is not None:
+                _save_npz_atomic(self._path("sketch_state.npz"),
+                                 self._sketch_state_arrays())
+                self.manifest["sketch"] = {"shards_done": shard + 1}
+                if isinstance(self.source, TextStripeSource):
+                    self.manifest["stripe_offsets"] = \
+                        list(self.source._offsets)
+                self._commit_manifest()
+            emit_event("ingest_shard_done", stage="sketch", shard=shard,
+                       rows=rows)
+            if _shard_hook is not None:
+                _shard_hook("sketch", shard)
+            lo = hi
+            shard += 1
+        self.num_rows = lo
+        self.num_features = len(self.summaries)
+        if self.num_rows == 0 or self.num_features == 0:
+            log.fatal("streaming ingest saw no data "
+                      f"(rows={self.num_rows}, features={self.num_features})")
+        if self.workdir is not None:
+            self.manifest["sketch"]["complete"] = True
+            self.manifest["pass1"] = {"num_rows": self.num_rows,
+                                      "num_features": self.num_features}
+            self._commit_manifest()
+
+    # ------------------------------------------------------------- mappers
+    def _build_mappers(self, cat_idx: Sequence[int],
+                       feature_names: List[str]) -> None:
+        cfg = self.cfg
+        max_bin = int(cfg.max_bin)
+        if max_bin > MAX_UINT8_BINS:
+            log.warning(f"max_bin={max_bin} > {MAX_UINT8_BINS} not yet "
+                        "supported on the uint8 path; clamping")
+            max_bin = MAX_UINT8_BINS
+        mbf = list(cfg.max_bin_by_feature or [])
+        forced = _load_forced_bins(cfg, self.num_features)
+        cat_set = set(cat_idx)
+        # totals mirror _construct_mappers: total_sample_cnt is the SAMPLE
+        # size (identical per feature), not the stream length
+        total = max(fs.n_total for fs in self.summaries)
+        self.mappers = []
+        self.sketched_features: List[int] = []
+        for j, fs in enumerate(self.summaries):
+            if j in cat_set and not fs.is_exact:
+                log.fatal(
+                    f"categorical feature {j} exceeds the exact tally limit "
+                    f"({EXACT_TALLY_LIMIT} distinct values); streamed "
+                    "construction requires exact category counts")
+            if not fs.is_exact:
+                self.sketched_features.append(j)
+            dv, cnts = fs.to_dist()
+            fmax = mbf[j] if j < len(mbf) and mbf[j] > 1 else max_bin
+            self.mappers.append(BinMapper.find_bin_from_dist(
+                dv, cnts, na_cnt=fs.na_cnt, total_sample_cnt=total,
+                max_bin=int(fmax),
+                min_data_in_bin=int(cfg.min_data_in_bin),
+                use_missing=bool(cfg.use_missing),
+                zero_as_missing=bool(cfg.zero_as_missing),
+                is_categorical=(j in cat_set),
+                forced_bounds=forced.get(j)))
+        if self.sketched_features:
+            log.info(f"{len(self.sketched_features)} feature(s) overflowed "
+                     f"the exact tally; bin boundaries carry the "
+                     f"alpha={self.alpha:g} sketch bound")
+        self.used_feature_idx = [j for j in range(self.num_features)
+                                 if not self.mappers[j].is_trivial()]
+        dropped = self.num_features - len(self.used_feature_idx)
+        if dropped:
+            log.info(f"Dropped {dropped} trivial (single-bin) feature(s)")
+        if not self.used_feature_idx:
+            log.fatal("Cannot construct Dataset: all features are trivial "
+                      "(single bin). Check your data or binning parameters.")
+        if self.workdir is not None:
+            _write_atomic(self._path("mappers.json"), json.dumps({
+                "mappers": [m.to_dict() for m in self.mappers],
+                "used_feature_idx": self.used_feature_idx,
+                "sketched_features": self.sketched_features,
+                "num_features": self.num_features,
+                "feature_names": feature_names,
+            }))
+            self.manifest["mappers_file"] = "mappers.json"
+            self._commit_manifest()
+
+    def _load_mappers(self) -> bool:
+        try:
+            with open(self._path("mappers.json")) as fh:
+                d = json.load(fh)
+        except (OSError, ValueError):
+            return False
+        self.mappers = [BinMapper.from_dict(m) for m in d["mappers"]]
+        self.used_feature_idx = [int(i) for i in d["used_feature_idx"]]
+        self.sketched_features = [int(i) for i in
+                                  d.get("sketched_features", [])]
+        self.num_features = int(d["num_features"])
+        return True
+
+    # ----------------------------------------------------------------- EFB
+    def _bin_chunk(self, data: np.ndarray) -> np.ndarray:
+        rows = data.shape[0]
+        out = np.zeros((rows, len(self.used_feature_idx)), np.uint8)
+        width = data.shape[1]
+        for col, j in enumerate(self.used_feature_idx):
+            if j < width:
+                vals = data[:, j]
+            else:  # libsvm stripe narrower than the global width
+                vals = np.zeros(rows, np.float64)
+            out[:, col] = self.mappers[j].values_to_bins(vals) \
+                .astype(np.uint8)
+        return out
+
+    def _build_plan(self) -> None:
+        self.plan = None
+        if not self._want_efb() or len(self.used_feature_idx) < 2:
+            return
+        if self._efb_raw:
+            sample_raw = np.concatenate(self._efb_raw, axis=0)
+            sample_bins = self._bin_chunk(sample_raw)
+        else:
+            # dedicated sampling pass: re-stream, binning only the rows
+            # plan_bundles would have sampled from the full matrix
+            rows_wanted = self._efb_sample_rows(self.num_rows)
+            parts = []
+            lo = 0
+            for chunk in self.source.chunks(0):
+                hi = lo + chunk.data.shape[0]
+                i0 = np.searchsorted(rows_wanted, lo)
+                i1 = np.searchsorted(rows_wanted, hi)
+                if i1 > i0:
+                    parts.append(self._bin_chunk(
+                        chunk.data[rows_wanted[i0:i1] - lo]))
+                lo = hi
+            sample_bins = np.concatenate(parts, axis=0) if parts else \
+                np.zeros((0, len(self.used_feature_idx)), np.uint8)
+        num_bins = np.array([self.mappers[j].num_bin
+                             for j in self.used_feature_idx], np.int32)
+        widest = int(num_bins.max()) if len(num_bins) else 1
+        self.plan = plan_bundles(sample_bins, num_bins,
+                                 sample_cnt=max(len(sample_bins), 1),
+                                 max_total_bins=device_bins_pow2(widest))
+        if self.plan is not None:
+            saved = len(self.used_feature_idx) - self.plan.num_bundles
+            log.info(f"EFB bundled {len(self.used_feature_idx)} features "
+                     f"into {self.plan.num_bundles} columns (saved {saved})")
+
+    def _save_plan(self) -> None:
+        if self.workdir is None:
+            return
+        if self.plan is None:
+            _write_atomic(self._path("plan.json"), json.dumps(None))
+        else:
+            p = self.plan
+            _write_atomic(self._path("plan.json"),
+                          json.dumps({"bundles": p.bundles}))
+            _save_npz_atomic(self._path("plan.npz"), {
+                "feat_col": p.feat_col, "src_idx": p.src_idx,
+                "valid": p.valid, "default_bin": p.default_bin,
+                "inv_table": p.inv_table})
+        self.manifest["plan_file"] = "plan.json"
+        self._commit_manifest()
+
+    def _load_plan(self) -> bool:
+        from .bundling import BundlePlan
+        try:
+            with open(self._path("plan.json")) as fh:
+                d = json.load(fh)
+        except (OSError, ValueError):
+            return False
+        if d is None:
+            self.plan = None
+            return True
+        try:
+            z = np.load(self._path("plan.npz"))
+        except (OSError, ValueError):
+            return False
+        self.plan = BundlePlan(
+            bundles=[[int(f) for f in b] for b in d["bundles"]],
+            feat_col=z["feat_col"], src_idx=z["src_idx"],
+            valid=z["valid"], default_bin=z["default_bin"],
+            inv_table=z["inv_table"], num_bundles=len(d["bundles"]))
+        return True
+
+    # -------------------------------------------------------------- pass 2
+    def _alloc(self, name: str, shape: Tuple[int, ...], dtype,
+               resume: bool) -> np.ndarray:
+        if self.workdir is None:
+            return np.zeros(shape, dtype)
+        path = self._path(name)
+        nbytes = int(np.prod(shape)) * np.dtype(dtype).itemsize
+        mode = "r+" if resume and os.path.exists(path) and \
+            os.path.getsize(path) == nbytes else "w+"
+        return np.memmap(path, dtype=dtype, mode=mode, shape=shape)
+
+    def _pass2(self, start_shard: int) -> Tuple[np.ndarray, np.ndarray,
+                                                Optional[np.ndarray]]:
+        n = self.num_rows
+        n_cols = self.plan.num_bundles if self.plan is not None \
+            else len(self.used_feature_idx)
+        pad = (-n_cols) % 4
+        n_words = (n_cols + pad) // 4
+        resume = start_shard > 0
+        bins = self._alloc("bins.u8", (n, n_cols), np.uint8, resume)
+        packed = self._alloc("packed.i32", (n, n_words), np.int32, resume)
+        raw = None
+        if bool(self.cfg.linear_tree):
+            raw = self._alloc("raw.f32",
+                              (n, len(self.used_feature_idx)),
+                              np.float32, resume)
+        offsets = np.concatenate([[0], np.cumsum(self.shard_rows)])
+        for shard, chunk in enumerate(self.source.chunks(start_shard),
+                                      start=start_shard):
+            lo, hi = int(offsets[shard]), int(offsets[shard + 1])
+            vbins = self._bin_chunk(chunk.data)
+            out = apply_bundles(vbins, self.plan) \
+                if self.plan is not None else vbins
+            bins[lo:hi] = out
+            if pad:
+                out = np.concatenate(
+                    [out, np.zeros((out.shape[0], pad), np.uint8)], axis=1)
+            packed[lo:hi] = np.ascontiguousarray(out).view(np.int32) \
+                .reshape(out.shape[0], n_words)
+            if raw is not None:
+                width = chunk.data.shape[1]
+                for col, j in enumerate(self.used_feature_idx):
+                    raw[lo:hi, col] = chunk.data[:, j].astype(np.float32) \
+                        if j < width else 0.0
+            count_event("ingest_shards_done")
+            if self.workdir is not None:
+                bins.flush()
+                packed.flush()
+                if raw is not None:
+                    raw.flush()
+                self.manifest["bin"] = {"shards_done": shard + 1}
+                self._commit_manifest()
+            emit_event("ingest_shard_done", stage="bin", shard=shard,
+                       rows=hi - lo)
+            if _shard_hook is not None:
+                _shard_hook("bin", shard)
+        return bins, packed, raw
+
+    # ----------------------------------------------------------------- run
+    def run(self, label=None, weight=None, group=None, init_score=None,
+            feature_names: Optional[List[str]] = None,
+            categorical_feature=None) -> Dataset:
+        cfg = self.cfg
+        resumed_from = None
+        if self.workdir is not None:
+            os.makedirs(self.workdir, exist_ok=True)
+            m = read_manifest(self.workdir)
+            if m is not None and \
+                    m.get("fingerprint") == self.source.fingerprint() and \
+                    int(m.get("chunk_rows", -1)) == self.chunk_rows:
+                self.manifest = m
+                resumed_from = m
+            elif m is not None:
+                log.warning(f"ingest workdir {self.workdir!r} holds a "
+                            "manifest for a different source/chunking; "
+                            "restarting the ingest from scratch")
+
+        sketch_done = 0
+        bin_done = 0
+        if resumed_from is not None:
+            sk = resumed_from.get("sketch", {})
+            if self._load_sketch_state():
+                sketch_done = int(sk.get("shards_done", 0))
+            if sk.get("complete"):
+                p1 = resumed_from.get("pass1", {})
+                self.num_rows = int(p1.get("num_rows", 0))
+                self.num_features = int(p1.get("num_features", 0))
+            if isinstance(self.source, TextStripeSource):
+                self.source._offsets = [
+                    int(o) for o in resumed_from.get("stripe_offsets", [])]
+            bin_done = int(resumed_from.get("bin", {})
+                           .get("shards_done", 0))
+            emit_event("ingest_resumed",
+                       stage=("bin" if sk.get("complete") else "sketch"),
+                       sketch_shards=sketch_done, bin_shards=bin_done,
+                       workdir=self.workdir)
+            count_event("ingest_resumes")
+        else:
+            emit_event("ingest_started", source=self.source.kind,
+                       chunk_rows=self.chunk_rows, workdir=self.workdir)
+
+        sketch_complete = bool(resumed_from and resumed_from
+                               .get("sketch", {}).get("complete"))
+        if not sketch_complete:
+            self._pass1(sketch_done)
+
+        fnames = feature_names or [f"Column_{i}"
+                                   for i in range(self.num_features)]
+        have_mappers = bool(resumed_from and
+                            resumed_from.get("mappers_file")) and \
+            self._load_mappers()
+        if not have_mappers:
+            cat_idx = _resolve_categorical(categorical_feature, fnames)
+            self._build_mappers(cat_idx, fnames)
+
+        have_plan = bool(resumed_from and resumed_from.get("plan_file")) \
+            and self._load_plan()
+        if not have_plan:
+            self._build_plan()
+            self._save_plan()
+
+        bins, packed, raw = self._pass2(bin_done)
+
+        ds = Dataset()
+        ds.config = cfg
+        ds.num_total_features = self.num_features
+        ds.feature_names = fnames
+        ds.mappers = self.mappers
+        ds.used_feature_idx = list(self.used_feature_idx)
+        ds.bundle_plan = self.plan
+        ds.bins = bins
+        ds._packed_mirror = packed
+        ds.raw = raw
+        ds.metadata = Metadata(self.num_rows)
+        if label is None and self._labels:
+            label = np.concatenate(self._labels)
+        if label is not None:
+            ds.metadata.set_label(label)
+        if weight is None and self._weights:
+            weight = np.concatenate(self._weights)
+        ds.metadata.set_weight(weight)
+        if group is None and self._qids:
+            qid = np.concatenate(self._qids)
+            change = np.r_[True, qid[1:] != qid[:-1]]
+            group = np.diff(np.r_[np.flatnonzero(change), len(qid)])
+        ds.metadata.set_group(group)
+        ds.metadata.set_init_score(init_score)
+        if isinstance(self.source, TextStripeSource):
+            from .parser import load_companion_files
+            side: Dict[str, Any] = {}
+            load_companion_files(self.source.path, side)
+            if ds.metadata.weight is None and "weight" in side:
+                ds.metadata.set_weight(side["weight"])
+            if ds.metadata.query_boundaries is None and "group" in side:
+                ds.metadata.set_group(side["group"])
+            if ds.metadata.init_score is None and "init_score" in side:
+                ds.metadata.set_init_score(side["init_score"])
+            if "position" in side:
+                ds.metadata.set_position(side["position"])
+        ds.ingest_provenance = {
+            "streamed": True,
+            "source": self.source.kind,
+            "chunk_rows": self.chunk_rows,
+            "sketch_accuracy": self.alpha,
+            "sketched_features": list(
+                getattr(self, "sketched_features", [])),
+            "resumed": resumed_from is not None,
+        }
+        if self.workdir is not None:
+            self.manifest["complete"] = True
+            self._commit_manifest()
+        emit_event("ingest_completed", rows=self.num_rows,
+                   features=self.num_features,
+                   columns=int(bins.shape[1]),
+                   sketched=len(getattr(self, "sketched_features", [])))
+        return ds
+
+
+def stream_inner_dataset(data: Any, label=None,
+                         config: Union[Config, Dict[str, Any], None] = None,
+                         *, workdir: Optional[str] = None, weight=None,
+                         group=None, init_score=None,
+                         feature_names: Optional[List[str]] = None,
+                         categorical_feature=None,
+                         chunk_rows: Optional[int] = None) -> Dataset:
+    """Construct a binned inner :class:`~lightgbm_tpu.io.dataset.Dataset`
+    by streaming ``data`` in bounded-memory chunks (module docstring).
+
+    ``data`` may be anything ``Dataset.from_data`` accepts, a text file
+    path, ``Sequence`` object(s), a pyarrow Table, or a custom
+    :class:`ChunkSource`.  With ``workdir`` the ingest is restartable:
+    re-running after a crash resumes from the last committed shard.
+    """
+    cfg = as_config(config)
+    source = make_source(data, cfg, chunk_rows)
+    return StreamingIngest(source, cfg, workdir).run(
+        label=label, weight=weight, group=group, init_score=init_score,
+        feature_names=feature_names,
+        categorical_feature=categorical_feature)
+
+
+def stream_dataset(data: Any, label=None,
+                   params: Union[Config, Dict[str, Any], None] = None, *,
+                   workdir: Optional[str] = None, weight=None, group=None,
+                   init_score=None,
+                   feature_names: Optional[List[str]] = None,
+                   categorical_feature=None,
+                   chunk_rows: Optional[int] = None):
+    """User-facing out-of-core constructor: like ``lgb.Dataset(...)`` but
+    built chunk by chunk under the ``ingest_chunk_rows`` memory bound.
+
+    Returns an already-constructed :class:`lightgbm_tpu.Dataset` that
+    feeds ``train()`` / the elastic cluster unchanged."""
+    from ..basic import Dataset as UserDataset
+    inner = stream_inner_dataset(
+        data, label=label, config=params, workdir=workdir, weight=weight,
+        group=group, init_score=init_score, feature_names=feature_names,
+        categorical_feature=categorical_feature, chunk_rows=chunk_rows)
+    p = params if isinstance(params, dict) else \
+        (dict(params.to_dict()) if hasattr(params, "to_dict") else None)
+    return UserDataset.from_inner(inner, p)

@@ -123,6 +123,23 @@ EVENTS: Dict[str, Tuple[str, str]] = {
                    "— by the dying process on SIGTERM/fatal exception, "
                    "or by the fleet parent from the last mirrored "
                    "heartbeat sidecar when a replica was SIGKILLed"),
+    "ingest_started": (
+        "info", "an out-of-core streaming dataset construction began "
+                "(io/streaming.py): source kind, chunk size and workdir "
+                "are recorded so a later resume can be matched to it"),
+    "ingest_shard_done": (
+        "info", "a streaming-ingest shard committed: its rows were "
+                "absorbed into the pass-1 sketches or written into the "
+                "pass-2 bin/packed buffers, and (with a workdir) the "
+                "manifest records it so a kill resumes after this shard"),
+    "ingest_resumed": (
+        "warning", "a streaming ingest found a matching manifest in its "
+                   "workdir and resumed from the last committed shard "
+                   "instead of restarting from row zero"),
+    "ingest_completed": (
+        "info", "a streaming ingest finished: the binned dataset (and "
+                "its packed mirror) is complete and feeds train()/the "
+                "elastic cluster unchanged"),
 }
 
 #: the process-wide active journal; ``None`` = journaling disabled (the

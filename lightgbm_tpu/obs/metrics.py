@@ -126,6 +126,17 @@ COUNTERS: Dict[str, str] = {
         "(obs/reqtrace.py)",
     "flight_recorder_dumps":
         "crash flight-recorder rings dumped to disk (obs/reqtrace.py)",
+    "ingest_shards_done":
+        "streaming-ingest shards committed across both passes "
+        "(io/streaming.py)",
+    "ingest_rows_streamed":
+        "rows absorbed by streaming-ingest pass 1 (io/streaming.py)",
+    "ingest_resumes":
+        "streaming ingests resumed from a workdir manifest instead of "
+        "restarting (io/streaming.py)",
+    "ingest_sketch_overflows":
+        "per-feature exact distinct tallies that overflowed into the "
+        "approximate quantile sketch (io/streaming.py)",
 }
 
 

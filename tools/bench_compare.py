@@ -64,6 +64,13 @@ def load_payload(path: str) -> Dict[str, Any]:
             raise ValueError("%s: serve payload carries no positive "
                              "p99_ms series" % path)
         return payload
+    if payload.get("kind") == "ingest":
+        # ingest captures (tools/bench_ingest.py) gate on construction
+        # throughput per variant, not vs_baseline
+        if not _ingest_series(payload):
+            raise ValueError("%s: ingest payload carries no positive "
+                             "rows_per_s series" % path)
+        return payload
     if payload.get("quality") == "noisy":
         raise ValueError("%s: capture was refused as noisy "
                          "(rejected_value=%s) — not comparable evidence"
@@ -106,6 +113,61 @@ def _serve_series(payload: Dict[str, Any]) -> List[Tuple[str, float]]:
     return rows
 
 
+def _ingest_series(payload: Dict[str, Any]) -> List[Tuple[str, float]]:
+    """(variant, rows_per_s) rows of a kind="ingest" payload
+    (tools/bench_ingest.py), in_memory first then streamed variants by
+    chunk size.  HIGHER is better."""
+    rows: List[Tuple[str, float]] = []
+    variants = payload.get("variants")
+    if not isinstance(variants, dict):
+        return rows
+
+    def _key(name: str):
+        return (0, 0) if name == "in_memory" else \
+            (1, int(name.rsplit("_", 1)[-1])
+             if name.rsplit("_", 1)[-1].isdigit() else 0)
+
+    for name in sorted(variants, key=_key):
+        r = variants[name]
+        if isinstance(r, dict) and \
+                isinstance(r.get("rows_per_s"), (int, float)) \
+                and r["rows_per_s"] > 0:
+            rows.append((name, float(r["rows_per_s"])))
+    return rows
+
+
+def _compare_ingest(old: Dict[str, Any], new: Dict[str, Any],
+                    threshold: float) -> Dict[str, Any]:
+    old_rows = dict(_ingest_series(old))
+    rows = []
+    for name, new_rps in _ingest_series(new):
+        if name not in old_rows:
+            continue
+        old_rps = old_rows[name]
+        # throughput: LOWER is the regression direction
+        change = new_rps / old_rps - 1.0
+        rows.append({
+            "series": name,
+            "old_rows_per_s": old_rps,
+            "new_rows_per_s": new_rps,
+            "change_pct": round(100.0 * change, 2),
+            "regression": bool(change < -threshold),
+        })
+    if not rows:
+        raise ValueError("ingest captures share no variant series "
+                         "(different chunk-size ladders?)")
+    return {
+        "tool": "bench_compare",
+        "kind": "ingest",
+        "metric": new.get("metric"),
+        "threshold_pct": round(100.0 * threshold, 2),
+        "old_platform": old.get("platform"),
+        "new_platform": new.get("platform"),
+        "rows": rows,
+        "regressions": [r["series"] for r in rows if r["regression"]],
+    }
+
+
 def _compare_serve(old: Dict[str, Any], new: Dict[str, Any],
                    threshold: float) -> Dict[str, Any]:
     old_rows = dict(_serve_series(old))
@@ -144,10 +206,14 @@ def compare(old: Dict[str, Any], new: Dict[str, Any],
         raise ValueError(
             "metric mismatch: %r vs %r — different bench configurations "
             "are not comparable" % (old.get("metric"), new.get("metric")))
-    if old.get("kind") == "serve" or new.get("kind") == "serve":
+    if old.get("kind") == "serve" or new.get("kind") == "serve" \
+            or old.get("kind") == "ingest" or new.get("kind") == "ingest":
         if old.get("kind") != new.get("kind"):
-            raise ValueError("cannot compare a serve capture against a "
-                             "training bench capture")
+            raise ValueError("cannot compare a %s capture against a %s "
+                             "capture" % (old.get("kind") or "training",
+                                          new.get("kind") or "training"))
+        if new.get("kind") == "ingest":
+            return _compare_ingest(old, new, threshold)
         return _compare_serve(old, new, threshold)
     old_rows = dict(_series(old))
     rows = []
@@ -228,10 +294,10 @@ def trend(paths: List[str], threshold: float) -> Dict[str, Any]:
             row.update(usable=False, reason=str(e).split(": ", 1)[-1])
             rows.append(row)
             continue
-        if payload.get("kind") == "serve":
+        if payload.get("kind") in ("serve", "ingest"):
             row.update(usable=False,
-                       reason="serve capture (trend tracks training "
-                              "vs_baseline)")
+                       reason="%s capture (trend tracks training "
+                              "vs_baseline)" % payload["kind"])
             rows.append(row)
             continue
         usable += 1
@@ -316,6 +382,11 @@ def _render_text(payload: Dict[str, Any]) -> str:
             lines.append("  %-18s %8.3f ms -> %8.3f ms  (%+.2f%%)  %s"
                          % (r["series"], r["old_p99_ms"],
                             r["new_p99_ms"], r["change_pct"], flag))
+        elif "old_rows_per_s" in r:
+            lines.append("  %-18s %10.0f rows/s -> %10.0f rows/s  "
+                         "(%+.2f%%)  %s"
+                         % (r["series"], r["old_rows_per_s"],
+                            r["new_rows_per_s"], r["change_pct"], flag))
         else:
             lines.append("  %-18s %8.4f -> %8.4f  (%+.2f%%)  %s"
                          % (r["series"], r["old_vs_baseline"],

@@ -1,0 +1,259 @@
+#!/usr/bin/env python
+"""Benchmark dataset construction: in-memory vs out-of-core streaming.
+
+    JAX_PLATFORMS=cpu python tools/bench_ingest.py \
+        [--rows N] [--features F] [--chunk-sizes 50000,100000,200000]
+
+Builds the same synthetic dataset through ``Dataset.from_data`` (whole
+matrix in RAM) and through ``io/streaming.py`` at several chunk sizes,
+capturing rows/s and PEAK memory footprint per variant.  Each variant
+runs in its own subprocess so the high-water mark is that variant's, not
+the max over earlier variants — the same isolation the CI memory-ceiling
+gate leans on (tests/test_streaming.py).  The footprint is VmRSS+VmSwap
+polled by a sampler thread, NOT ``ru_maxrss``: a forked child inherits
+the parent's high-water (a worker spawned from a fat pytest process
+reports the parent's peak), and zram swap on a loaded host deflates the
+RSS high-water while the array still exists in swap.
+
+Emits a ``kind="ingest"`` payload (``"metric"`` headline per the bench
+capture protocol) that tools/bench_compare.py gates: rows/s per variant,
+HIGHER is better, exit 0/1/2 per tools/_report.py.
+
+Worker mode (internal, one variant per process):
+
+    python tools/bench_ingest.py --worker streamed --rows N \
+        --features F --chunk-rows C
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import resource
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from _report import EXIT_ERROR, EXIT_OK, add_format_arg, emit  # noqa: E402
+
+#: columns 0..F/2 are low-cardinality (exact-tally path), the rest are
+#: continuous (overflowing the tally into the sketch at 2M-row scale)
+_LOW_CARD = 100
+
+
+def _ru_maxrss_mb() -> float:
+    ru = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    # linux reports KB, macOS bytes
+    return ru / 1024.0 if sys.platform.startswith("linux") \
+        else ru / (1024.0 * 1024.0)
+
+
+def _footprint_mb() -> float:
+    """Current VmRSS+VmSwap of THIS process.  Two reasons not to trust
+    ``ru_maxrss`` here: (1) a forked child inherits the parent's
+    high-water, so a worker spawned from a fat pytest process reports
+    the *parent's* peak and every delta against the baseline collapses;
+    (2) zram swap on a loaded host steals pages mid-build, deflating the
+    RSS high-water while the array still exists (in swap)."""
+    try:
+        vals = {"VmRSS": 0.0, "VmSwap": 0.0}
+        with open("/proc/self/status") as fh:
+            for line in fh:
+                key = line.split(":", 1)[0]
+                if key in vals:
+                    vals[key] = float(line.split()[1])  # kB
+        return (vals["VmRSS"] + vals["VmSwap"]) / 1024.0
+    except (OSError, IndexError, ValueError):
+        return _ru_maxrss_mb()
+
+
+class _FootprintSampler:
+    """Daemon thread polling the footprint every few ms: numpy releases
+    the GIL inside large ops, so the poll catches the peak while the
+    build is in flight."""
+
+    def __init__(self, interval_s: float = 0.005):
+        import threading
+        self.peak = 0.0
+        self._stop = threading.Event()
+        self._interval = interval_s
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval):
+            self.sample()
+
+    def sample(self) -> None:
+        self.peak = max(self.peak, _footprint_mb())
+
+    def stop(self) -> float:
+        self._stop.set()
+        self._thread.join(timeout=1.0)
+        self.sample()
+        return self.peak
+
+
+def synth_chunk(chunk_idx: int, rows: int, features: int) -> "Any":
+    """One deterministic synthetic chunk: identical bytes every time a
+    pass re-streams chunk ``chunk_idx`` (the re-streamability contract
+    of ChunkSource), without ever materializing the full matrix."""
+    import numpy as np
+    rng = np.random.default_rng(10_000 + chunk_idx)
+    data = rng.normal(size=(rows, features))
+    for j in range(features // 2):
+        data[:, j] = rng.integers(0, _LOW_CARD, rows)
+    return data
+
+
+class SyntheticSource:
+    """Generator-backed ChunkSource over ``synth_chunk`` — the streamed
+    variants' input, O(chunk) resident."""
+
+    kind = "synthetic"
+
+    def __init__(self, num_rows: int, num_features: int, chunk_rows: int):
+        self.num_rows = int(num_rows)
+        self.num_features = int(num_features)
+        self.chunk_rows = int(chunk_rows)
+
+    def fingerprint(self) -> Dict[str, Any]:
+        return {"kind": self.kind, "num_rows": self.num_rows,
+                "num_features": self.num_features,
+                "chunk_rows": self.chunk_rows}
+
+    def chunks(self, start_chunk: int = 0):
+        from lightgbm_tpu.io.streaming import RawChunk
+        idx = start_chunk
+        lo = start_chunk * self.chunk_rows
+        while lo < self.num_rows:
+            rows = min(self.chunk_rows, self.num_rows - lo)
+            yield RawChunk(synth_chunk(idx, rows, self.num_features))
+            lo += rows
+            idx += 1
+
+
+def run_worker(variant: str, rows: int, features: int,
+               chunk_rows: Optional[int]) -> Dict[str, Any]:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np  # noqa: F401  (baseline includes numpy+package)
+    import lightgbm_tpu  # noqa: F401
+    rss_base = _footprint_mb()
+    if variant == "baseline":
+        return {"peak_rss_mb": rss_base, "rss_base_mb": rss_base}
+    t0 = time.perf_counter()
+    sampler = _FootprintSampler()
+    if variant == "in_memory":
+        from lightgbm_tpu.io.dataset import Dataset
+        parts = [synth_chunk(i, min(chunk_rows or rows, rows - lo),
+                             features)
+                 for i, lo in enumerate(range(0, rows,
+                                              chunk_rows or rows))]
+        data = np.concatenate(parts, axis=0) if len(parts) > 1 else parts[0]
+        sampler.sample()
+        del parts
+        label = (data[:, -1] > 0).astype(np.float64)
+        ds = Dataset.from_data(data, label, {})
+        sampler.sample()
+        ds.packed_mirror()
+    elif variant == "streamed":
+        from lightgbm_tpu.io.streaming import stream_inner_dataset
+        assert chunk_rows, "streamed worker needs --chunk-rows"
+        src = SyntheticSource(rows, features, chunk_rows)
+        ds = stream_inner_dataset(src, label=np.zeros(rows), config={},
+                                  chunk_rows=chunk_rows)
+    else:
+        raise SystemExit(f"unknown worker variant {variant!r}")
+    wall = time.perf_counter() - t0
+    peak = max(rss_base, sampler.stop())
+    return {
+        "wall_s": round(wall, 3),
+        "rows_per_s": round(rows / wall, 1),
+        "peak_rss_mb": round(peak, 1),
+        "rss_base_mb": round(rss_base, 1),
+        "binned_shape": list(ds.bins.shape),
+    }
+
+
+def spawn_worker(variant: str, rows: int, features: int,
+                 chunk_rows: Optional[int] = None) -> Dict[str, Any]:
+    cmd = [sys.executable, os.path.abspath(__file__), "--worker", variant,
+           "--rows", str(rows), "--features", str(features)]
+    if chunk_rows:
+        cmd += ["--chunk-rows", str(chunk_rows)]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(cmd, capture_output=True, text=True, env=env)
+    if out.returncode != 0:
+        raise RuntimeError(f"worker {variant} failed "
+                           f"(rc={out.returncode}):\n{out.stderr[-2000:]}")
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def _render(payload: Dict[str, Any]) -> str:
+    lines = [f"bench_ingest: {payload['rows']} rows x "
+             f"{payload['features']} features"]
+    lines.append("  %-18s %12s %12s %10s"
+                 % ("variant", "rows/s", "peak RSS MB", "wall s"))
+    for name, r in payload["variants"].items():
+        lines.append("  %-18s %12.0f %12.1f %10.2f"
+                     % (name, r.get("rows_per_s", 0),
+                        r.get("peak_rss_mb", 0), r.get("wall_s", 0)))
+    base = payload.get("rss_base_mb")
+    if base is not None:
+        lines.append(f"  (import-only baseline RSS: {base:.1f} MB)")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--rows", type=int, default=500_000)
+    ap.add_argument("--features", type=int, default=16)
+    ap.add_argument("--chunk-sizes", default="50000,100000",
+                    help="comma-separated streamed chunk sizes")
+    ap.add_argument("--worker", default=None,
+                    help=argparse.SUPPRESS)  # internal: run ONE variant
+    ap.add_argument("--chunk-rows", type=int, default=None,
+                    help=argparse.SUPPRESS)
+    add_format_arg(ap)
+    args = ap.parse_args(argv)
+
+    if args.worker:
+        res = run_worker(args.worker, args.rows, args.features,
+                         args.chunk_rows)
+        print(json.dumps(res))
+        return EXIT_OK
+
+    chunk_sizes = [int(s) for s in args.chunk_sizes.split(",") if s]
+    try:
+        base = spawn_worker("baseline", args.rows, args.features)
+        variants: Dict[str, Any] = {
+            "in_memory": spawn_worker("in_memory", args.rows,
+                                      args.features, chunk_sizes[0]),
+        }
+        for cs in chunk_sizes:
+            variants[f"streamed_{cs}"] = spawn_worker(
+                "streamed", args.rows, args.features, cs)
+    except (RuntimeError, ValueError, json.JSONDecodeError) as e:
+        print(f"bench_ingest: error: {e}", file=sys.stderr)
+        return EXIT_ERROR
+    payload = {
+        "tool": "bench_ingest",
+        "kind": "ingest",
+        "metric": f"ingest_construct_{args.rows}x{args.features}",
+        "platform": sys.platform,
+        "rows": args.rows,
+        "features": args.features,
+        "rss_base_mb": base.get("peak_rss_mb"),
+        "variants": variants,
+    }
+    emit(payload, args.format, _render)
+    return EXIT_OK
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -97,6 +97,40 @@ def slo_stats(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     }
 
 
+def ingest_stats(events: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    """Replay streaming-ingest records (io/streaming.py) into a ledger.
+
+    ``None`` when the journal holds no ingest events.  An ingest that
+    started (or resumed) but never logged ``ingest_completed`` is the
+    CI-gate signal — the dataset on disk is partial."""
+    started = completed = resumed = 0
+    shards: Dict[str, int] = {}
+    rows = features = None
+    for rec in events:
+        name = rec.get("event")
+        payload = rec.get("payload") or {}
+        if not isinstance(payload, dict):
+            payload = {}
+        if name == "ingest_started":
+            started += 1
+        elif name == "ingest_resumed":
+            resumed += 1
+        elif name == "ingest_shard_done":
+            stage = str(payload.get("stage", "?"))
+            shards[stage] = shards.get(stage, 0) + 1
+        elif name == "ingest_completed":
+            completed += 1
+            rows = payload.get("rows", rows)
+            features = payload.get("features", features)
+    if not (started or resumed or completed or shards):
+        return None
+    return {
+        "started": started, "resumed": resumed, "completed": completed,
+        "shards": shards, "rows": rows, "features": features,
+        "unfinished": (started + resumed) > 0 and completed == 0,
+    }
+
+
 def load_telemetry(path: str) -> List[Dict[str, Any]]:
     """Telemetry JSONL rows (one per round); torn lines are skipped."""
     rows: List[Dict[str, Any]] = []
@@ -179,6 +213,13 @@ def build_report(trace_doc: Optional[Dict[str, Any]],
         if slo["unrecovered"]:
             findings.append("run ends with unrecovered slo_breach: "
                             + ", ".join(slo["unrecovered"]))
+        ingest = ingest_stats(events)
+        if ingest is not None:
+            payload["ingest"] = ingest
+            if ingest["unfinished"]:
+                findings.append(
+                    "streaming ingest started but never completed — the "
+                    "dataset in its workdir is partial (resumable)")
     if telemetry is not None:
         if not telemetry:
             findings.append("telemetry stream holds no rows")
@@ -218,6 +259,19 @@ def _render_report(payload: Dict[str, Any]) -> str:
         for kind in sorted(slo.get("anomaly_kinds", {})):
             lines.append(f"  anomaly {kind}: "
                          f"{slo['anomaly_kinds'][kind]}")
+    ingest = payload.get("ingest")
+    if ingest is not None:
+        lines.append("")
+        state = "complete" if ingest["completed"] else (
+            "UNFINISHED" if ingest["unfinished"] else "idle")
+        lines.append(f"streaming ingest: {state} "
+                     f"({ingest['started']} started, "
+                     f"{ingest['resumed']} resumed)")
+        for stage in sorted(ingest.get("shards", {})):
+            lines.append(f"  {stage} shards: {ingest['shards'][stage]}")
+        if ingest.get("rows") is not None:
+            lines.append(f"  rows: {ingest['rows']}  features: "
+                         f"{ingest.get('features')}")
     tel = payload.get("telemetry")
     if tel is not None:
         lines.append("")
