@@ -88,6 +88,8 @@ def iter_stripe_texts(path: str, stripe_bytes: int = STRIPE_BYTES, *,
 
 def parse_delimited_stripe(text: str, sep: str) -> Optional[np.ndarray]:
     """Parse one CSV/TSV stripe into a 2-D float64 matrix (None if blank)."""
+    if not text or text.isspace():
+        return None  # all-blank stripe (genfromtxt would warn)
     raw = np.genfromtxt(io.StringIO(text), delimiter=sep, dtype=np.float64)
     if raw.size == 0:
         return None

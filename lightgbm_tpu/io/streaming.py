@@ -29,12 +29,19 @@ chunked pipeline whose peak host memory is bounded by
     shard into preallocated (or memory-mapped, when a ``workdir`` is
     given) buffers for the bin matrix AND its ``packed_mirror()`` word
     view — the packed/radix2 kernels see byte-identical layouts.
-  * **Restartable.**  With a ``workdir``, every completed shard commits
-    an atomic manifest record (write-to-temp + ``os.replace`` on the
+  * **Restartable.**  With a ``workdir``, every completed pass-1 shard
+    commits the whole sketch state in ONE atomic ``sketch_state.npz``
+    write (write-to-temp + ``os.replace`` on the
     robustness/checkpoint.py substrate) and emits an
-    ``ingest_shard_done`` journal event; a killed ingest resumes from
-    the last completed shard (``ingest_resumed``) and produces the same
-    dataset bytes as an uninterrupted run.
+    ``ingest_shard_done`` journal event.  That npz is the single source
+    of truth for pass-1 progress — the resume shard is derived from the
+    shard rows it records, never from a separately-committed manifest
+    field — so no crash window can double-count or skip a shard.  The
+    manifest records only the source fingerprint, phase-completion
+    flags and pass-2 progress (pass-2 shard replays are idempotent
+    memmap rewrites).  A killed ingest resumes from the last completed
+    shard (``ingest_resumed``) and produces the same dataset bytes as
+    an uninterrupted run.
 
 Sampling parity: the in-memory path samples ``bin_construct_sample_cnt``
 rows for bin finding (``Dataset._construct_mappers``) and 100k rows for
@@ -423,11 +430,13 @@ class ArrowChunkSource(ChunkSource):
 
 class TextStripeSource(ChunkSource):
     """Byte-range stripe reader over a CSV/TSV/LibSVM file (io/parser.py
-    stripe machinery).  One stripe = one shard; stripes are newline
-    aligned and their byte offsets are recorded on the first pass so
-    pass 2 / resume can ``seek`` instead of re-reading the prefix.
-    LibSVM width grows monotonically during pass 1 (absent trailing
-    indices are implicit zeros, like the whole-file loader)."""
+    stripe machinery).  One stripe = one shard — EVERY stripe, including
+    one whose lines are all blank (it yields a zero-row chunk), so shard
+    numbering always equals stripe numbering across passes and resume.
+    Stripes are newline aligned and their byte offsets are recorded on
+    the first pass so pass 2 / resume can ``seek`` instead of re-reading
+    the prefix.  LibSVM width grows monotonically during pass 1 (absent
+    trailing indices are implicit zeros, like the whole-file loader)."""
 
     kind = "text"
 
@@ -496,32 +505,30 @@ class TextStripeSource(ChunkSource):
 
     def chunks(self, start_chunk: int = 0) -> Iterator[RawChunk]:
         from . import parser
+        idx = 0
         start_offset = None
-        if start_chunk and start_chunk <= len(self._offsets):
-            if start_chunk == len(self._offsets):
-                # every recorded stripe is consumed; nothing follows the
-                # last one unless the file grew (it must not)
-                start_offset = None if not self._offsets else -1
-            else:
-                start_offset = self._offsets[start_chunk]
-        idx = start_chunk
-        if start_offset == -1:
-            return
+        if start_chunk and self._offsets:
+            # seek to the latest recorded stripe at or before
+            # start_chunk; offsets are recorded as stripes are READ, so
+            # the stripe AT start_chunk may not have one yet — re-read
+            # (without yielding) from the last known stripe instead
+            idx = min(start_chunk, len(self._offsets) - 1)
+            start_offset = self._offsets[idx]
         stripes = parser.iter_stripe_texts(
             self.path, stripe_bytes=self.stripe_bytes,
             skip_header=self.has_header, start_offset=start_offset)
-        if start_offset is None and start_chunk:
-            # offsets unknown (fresh resume without manifest): re-read
-            # and discard the committed prefix
-            for _ in range(start_chunk):
-                next(stripes, None)
         for off, text in stripes:
             if idx == len(self._offsets):
                 self._offsets.append(off)
-            chunk = self._parse(text)
-            idx += 1
-            if chunk is not None:
+            if idx >= start_chunk:
+                chunk = self._parse(text)
+                if chunk is None:
+                    # all-blank stripe: still one (zero-row) shard so
+                    # stripe and shard numbering stay aligned
+                    chunk = RawChunk(np.zeros(
+                        (0, self.num_features or 0), np.float64))
                 yield chunk
+            idx += 1
 
 
 def make_source(data: Any, cfg: Config,
@@ -558,11 +565,13 @@ def clamp_chunk_rows(chunk_rows: int, num_features: Optional[int],
     if not budget_mb or not num_features:
         return int(chunk_rows)
     bytes_per_row = num_features * (8 + 8 + 1 + 4) + 64
-    max_rows = int(budget_mb * 1e6 / bytes_per_row)
-    if 0 < max_rows < chunk_rows:
+    # 256-row floor: a budget too small even for that clamps TO the
+    # floor rather than silently disabling the clamp
+    max_rows = max(256, int(budget_mb * 1e6 / bytes_per_row))
+    if max_rows < chunk_rows:
         log.warning(f"ingest_memory_budget_mb={budget_mb:g} clamps "
                     f"ingest_chunk_rows {chunk_rows} -> {max_rows}")
-        return max(256, max_rows)
+        return max_rows
     return int(chunk_rows)
 
 
@@ -653,10 +662,17 @@ class StreamingIngest:
                       json.dumps(self.manifest, default=str))
 
     def _sketch_state_arrays(self) -> Dict[str, np.ndarray]:
+        # ONE atomic npz commit per shard = the single source of truth
+        # for pass-1 progress (the resume shard is len(shard_rows));
+        # everything resume needs rides in the same write, so there is
+        # no cross-artifact crash window that could double-count a shard
         arrays: Dict[str, np.ndarray] = {
             "n_features": np.int64(len(self.summaries)),
             "shard_rows": np.asarray(self.shard_rows, np.int64),
         }
+        if isinstance(self.source, TextStripeSource):
+            arrays["stripe_offsets"] = np.asarray(
+                self.source._offsets, np.int64)
         for j, fs in enumerate(self.summaries):
             for k, v in fs.state().items():
                 arrays[f"f{j}_{k}"] = v
@@ -668,20 +684,30 @@ class StreamingIngest:
         return arrays
 
     def _load_sketch_state(self) -> bool:
+        # builds into locals first: a corrupt/truncated npz (any
+        # exception — np.load raises BadZipFile/KeyError/... on torn
+        # files) must leave self untouched and report failure
         try:
             z = np.load(self._path("sketch_state.npz"))
-        except (OSError, ValueError):
+            summaries = []
+            for j in range(int(z["n_features"])):
+                st = {k[len(f"f{j}_"):]: z[k] for k in z.files
+                      if k.startswith(f"f{j}_")}
+                summaries.append(FeatureSummary.from_state(self.alpha, st))
+            shard_rows = [int(r) for r in z["shard_rows"]]
+            labels = [z["labels"]] if "labels" in z.files else []
+            weights = [z["weights"]] if "weights" in z.files else []
+            qids = [z["qids"]] if "qids" in z.files else []
+            offsets = [int(o) for o in z["stripe_offsets"]] \
+                if "stripe_offsets" in z.files else None
+        except Exception:
             return False
-        nf = int(z["n_features"])
-        self.summaries = []
-        for j in range(nf):
-            st = {k[len(f"f{j}_"):]: z[k] for k in z.files
-                  if k.startswith(f"f{j}_")}
-            self.summaries.append(FeatureSummary.from_state(self.alpha, st))
-        self.shard_rows = [int(r) for r in z["shard_rows"]]
-        self._labels = [z["labels"]] if "labels" in z.files else []
-        self._weights = [z["weights"]] if "weights" in z.files else []
-        self._qids = [z["qids"]] if "qids" in z.files else []
+        self.summaries = summaries
+        self.shard_rows = shard_rows
+        self._labels, self._weights, self._qids = labels, weights, qids
+        if offsets is not None and isinstance(self.source,
+                                              TextStripeSource):
+            self.source._offsets = offsets
         return True
 
     # -------------------------------------------------------------- pass 1
@@ -711,7 +737,14 @@ class StreamingIngest:
 
     def _pass1(self, start_shard: int) -> None:
         sample_rows = self._sample_rows()
-        collect_efb = (self._want_efb()
+        # opportunistic EFB sample only on an uninterrupted pass: it is
+        # not persisted with the sketch state, so a resumed pass would
+        # otherwise sample only chunks >= start_shard and plan different
+        # bundles than an uninterrupted run.  Resume falls back to the
+        # dedicated re-stream sampling pass in _build_plan, which bins
+        # the identical row set.
+        collect_efb = (start_shard == 0
+                       and self._want_efb()
                        and self.source.num_rows is not None
                        and self.source.num_features is not None)
         if collect_efb:
@@ -752,11 +785,6 @@ class StreamingIngest:
             if self.workdir is not None:
                 _save_npz_atomic(self._path("sketch_state.npz"),
                                  self._sketch_state_arrays())
-                self.manifest["sketch"] = {"shards_done": shard + 1}
-                if isinstance(self.source, TextStripeSource):
-                    self.manifest["stripe_offsets"] = \
-                        list(self.source._offsets)
-                self._commit_manifest()
             emit_event("ingest_shard_done", stage="sketch", shard=shard,
                        rows=rows)
             if _shard_hook is not None:
@@ -769,7 +797,7 @@ class StreamingIngest:
             log.fatal("streaming ingest saw no data "
                       f"(rows={self.num_rows}, features={self.num_features})")
         if self.workdir is not None:
-            self.manifest["sketch"]["complete"] = True
+            self.manifest["sketch"] = {"complete": True}
             self.manifest["pass1"] = {"num_rows": self.num_rows,
                                       "num_features": self.num_features}
             self._commit_manifest()
@@ -1012,22 +1040,52 @@ class StreamingIngest:
         if resumed_from is not None:
             sk = resumed_from.get("sketch", {})
             if self._load_sketch_state():
-                sketch_done = int(sk.get("shards_done", 0))
-            if sk.get("complete"):
-                p1 = resumed_from.get("pass1", {})
-                self.num_rows = int(p1.get("num_rows", 0))
-                self.num_features = int(p1.get("num_features", 0))
-            if isinstance(self.source, TextStripeSource):
-                self.source._offsets = [
-                    int(o) for o in resumed_from.get("stripe_offsets", [])]
+                # the npz (committed atomically per shard, offsets and
+                # all) is the single source of truth for pass-1
+                # progress; the manifest never carries a shard count
+                # that could trail or lead it
+                sketch_done = len(self.shard_rows)
+                if isinstance(self.source, TextStripeSource) and \
+                        self.summaries:
+                    # libsvm width grows monotonically during pass 1;
+                    # restore it so a resumed stripe densifies exactly
+                    # like it would have mid-stream
+                    self.source.num_features = max(
+                        self.source.num_features or 0,
+                        len(self.summaries))
+                if sk.get("complete"):
+                    self.num_rows = sum(self.shard_rows)
+                    self.num_features = len(self.summaries)
+            elif sk.get("complete"):
+                # manifest says pass 1 finished but its state is
+                # missing/corrupt: like a fingerprint mismatch, the
+                # only safe move is a from-scratch restart
+                log.warning(
+                    f"ingest workdir {self.workdir!r} marks the sketch "
+                    "complete but sketch_state.npz is unreadable; "
+                    "restarting the ingest from scratch")
+                resumed_from = None
+                self.manifest = {}
+        if resumed_from is not None:
+            complete = resumed_from.get("sketch", {}).get("complete")
             bin_done = int(resumed_from.get("bin", {})
                            .get("shards_done", 0))
             emit_event("ingest_resumed",
-                       stage=("bin" if sk.get("complete") else "sketch"),
+                       stage=("bin" if complete else "sketch"),
                        sketch_shards=sketch_done, bin_shards=bin_done,
                        workdir=self.workdir)
             count_event("ingest_resumes")
         else:
+            if self.workdir is not None:
+                # from-scratch (re)start: drop any stale shard state
+                # BEFORE stamping the fresh identity manifest, so a
+                # crash in between can never pair a matching manifest
+                # with another run's sketch state
+                try:
+                    os.remove(self._path("sketch_state.npz"))
+                except OSError:
+                    pass
+                self._commit_manifest()
             emit_event("ingest_started", source=self.source.kind,
                        chunk_rows=self.chunk_rows, workdir=self.workdir)
 

@@ -11,8 +11,12 @@ Four claims pinned down here, matching the module's contract:
   * streamed construction is bit-identical to ``Dataset.from_data``
     (bins, packed mirror, mappers, trained model text) for ndarray,
     text-stripe and Sequence sources;
-  * a killed ingest resumes from its manifest to the same dataset
-    bytes (``fault`` marker), and the 2M-row memory-ceiling gate shows
+  * a killed ingest resumes from its atomically-committed sketch state
+    (the npz is the single source of truth for pass-1 progress — no
+    committed shard is ever re-counted or skipped, blank text stripes
+    included) to the same dataset bytes (``fault`` marker), an
+    unreadable sketch state restarts from scratch, and the 2M-row
+    memory-ceiling gate shows
     peak RSS bounded by chunk size while in-memory construction blows
     through the same ceiling.
 """
@@ -304,6 +308,139 @@ class TestKillResume:
         _assert_bit_identical(ds, ds_mem)
         assert streaming.read_manifest(wd).get("complete") is True
 
+    def test_resume_starts_after_last_committed_shard(self, tmp_path):
+        # the sketch npz is the single source of truth for pass-1
+        # progress: after a kill at shard k (npz committed, no separate
+        # manifest shard counter to trail it), the resumed run must
+        # process exactly shards k+1.. — never re-count a committed
+        # shard, never skip one
+        from lightgbm_tpu.obs import events as ev
+        X, y = _mixed_matrix(n=4000)
+        wd = str(tmp_path / "wd")
+
+        def killer(stage, shard):
+            if stage == "sketch" and shard == 2:
+                raise RuntimeError("killed")
+
+        streaming._shard_hook = killer
+        try:
+            with pytest.raises(RuntimeError):
+                stream_inner_dataset(X, y, dict(FAST), workdir=wd,
+                                     chunk_rows=900)
+        finally:
+            streaming._shard_hook = None
+
+        out = str(tmp_path / "resume_events.jsonl")
+        with ev.session(out):
+            stream_inner_dataset(X, y, dict(FAST), workdir=wd,
+                                 chunk_rows=900)
+        recs = [json.loads(line) for line in open(out)]
+        resumed = [r for r in recs if r["event"] == "ingest_resumed"]
+        assert len(resumed) == 1
+        assert resumed[0]["payload"]["sketch_shards"] == 3
+        sketch_shards = [r["payload"]["shard"] for r in recs
+                        if r["event"] == "ingest_shard_done"
+                        and r["payload"]["stage"] == "sketch"]
+        assert sketch_shards == [3, 4]  # 4000 rows / 900 = shards 0..4
+
+    def test_kill_resume_bundled_sparse_bit_identical(self, tmp_path):
+        # sparse, EFB-bundleable features: the opportunistic pass-1 EFB
+        # sample is NOT persisted with the sketch state, so a resumed
+        # run must fall back to the dedicated re-stream sampling pass
+        # and still plan the exact same bundles
+        rng = np.random.default_rng(12)
+        n = 4000
+        dense = rng.normal(size=(n, 2))
+        onehot = np.zeros((n, 6))
+        onehot[np.arange(n), rng.integers(0, 6, n)] = \
+            rng.uniform(1.0, 2.0, n)
+        X = np.column_stack([dense, onehot])
+        y = (dense[:, 0] > 0).astype(np.float64)
+        ds_mem = Dataset.from_data(X, y, dict(FAST))
+        assert ds_mem.bundle_plan is not None  # EFB actually engages
+        wd = str(tmp_path / "wd")
+
+        def killer(stage, shard):
+            if stage == "sketch" and shard == 1:
+                raise RuntimeError("killed")
+
+        streaming._shard_hook = killer
+        try:
+            with pytest.raises(RuntimeError):
+                stream_inner_dataset(X, y, dict(FAST), workdir=wd,
+                                     chunk_rows=900)
+        finally:
+            streaming._shard_hook = None
+        ds = stream_inner_dataset(X, y, dict(FAST), workdir=wd,
+                                  chunk_rows=900)
+        assert ds.ingest_provenance["resumed"] is True
+        assert ds.bundle_plan is not None
+        assert ds.bundle_plan.bundles == ds_mem.bundle_plan.bundles
+        _assert_bit_identical(ds, ds_mem)
+
+    @pytest.mark.parametrize("kill_shard", [2, 6])
+    def test_text_blank_stripe_alignment_and_kill_resume(self, tmp_path,
+                                                         kill_shard):
+        # an all-blank stripe parses to zero rows but is still one
+        # shard, so stripe and shard numbering stay aligned across
+        # passes AND across a kill/resume that crosses the blank region
+        X, y = _mixed_matrix(n=1500, nan_frac=0.0)
+        rows = [",".join(f"{v:.10g}" for v in np.r_[y[i], X[i]])
+                for i in range(len(X))]
+        # 60KB of blank lines >> stripe_bytes guarantees at least one
+        # stripe that is entirely blank
+        text = "\n".join(rows[:700]) + "\n" + "\n" * 60_000 + \
+            "\n".join(rows[700:]) + "\n"
+        path = str(tmp_path / "gappy.csv")
+        with open(path, "w") as fh:
+            fh.write(text)
+        from lightgbm_tpu.io.parser import load_text_file
+        arr, lab, _ = load_text_file(path, Config())
+        assert arr.shape[0] == len(X)
+        ds_mem = Dataset.from_data(arr, lab, dict(FAST))
+
+        # uninterrupted streamed build agrees despite the blank stripes
+        src = TextStripeSource(path, Config(**FAST), stripe_bytes=20_000)
+        ds = stream_inner_dataset(src, config=dict(FAST))
+        assert len(src._offsets) > 8
+        _assert_bit_identical(ds, ds_mem)
+
+        wd = str(tmp_path / "wd")
+
+        def killer(stage, shard):
+            if stage == "sketch" and shard == kill_shard:
+                raise RuntimeError("killed")
+
+        streaming._shard_hook = killer
+        try:
+            with pytest.raises(RuntimeError):
+                stream_inner_dataset(
+                    TextStripeSource(path, Config(**FAST),
+                                     stripe_bytes=20_000),
+                    config=dict(FAST), workdir=wd)
+        finally:
+            streaming._shard_hook = None
+        ds2 = stream_inner_dataset(
+            TextStripeSource(path, Config(**FAST), stripe_bytes=20_000),
+            config=dict(FAST), workdir=wd)
+        assert ds2.ingest_provenance["resumed"] is True
+        _assert_bit_identical(ds2, ds_mem)
+        np.testing.assert_allclose(ds2.metadata.label, ds_mem.metadata.label)
+
+    def test_unreadable_sketch_state_restarts(self, tmp_path):
+        # complete-sketch manifest + corrupt sketch_state.npz must
+        # restart the ingest from scratch, not resume wrong or crash
+        X, y = _mixed_matrix(n=2000)
+        wd = str(tmp_path / "wd")
+        stream_inner_dataset(X, y, dict(FAST), workdir=wd, chunk_rows=500)
+        with open(os.path.join(wd, "sketch_state.npz"), "wb") as fh:
+            fh.write(b"not an npz")
+        ds = stream_inner_dataset(X, y, dict(FAST), workdir=wd,
+                                  chunk_rows=500)
+        assert ds.ingest_provenance["resumed"] is False
+        _assert_bit_identical(ds, Dataset.from_data(X, y, dict(FAST)))
+        assert streaming.read_manifest(wd).get("complete") is True
+
     def test_mismatched_manifest_restarts(self, tmp_path):
         X, y = _mixed_matrix(n=2000)
         wd = str(tmp_path / "wd")
@@ -466,6 +603,19 @@ class TestBenchRoundTrip:
             capture_output=True, text=True, env=env, timeout=120)
         assert cmp_out.returncode == 0, \
             cmp_out.stdout + cmp_out.stderr
+
+
+# ------------------------------------------------------------ chunk clamp
+class TestChunkClamp:
+    def test_tiny_memory_budget_clamps_to_floor(self):
+        from lightgbm_tpu.io.streaming import clamp_chunk_rows
+        # a budget too small even for 256 rows clamps TO the 256-row
+        # floor instead of silently disabling the clamp
+        assert clamp_chunk_rows(100_000, 1000, 0.001) == 256
+        assert 256 <= clamp_chunk_rows(100_000, 16, 1.0) < 100_000
+        assert clamp_chunk_rows(1000, 16, 1000.0) == 1000  # roomy budget
+        assert clamp_chunk_rows(1000, None, 1.0) == 1000   # width unknown
+        assert clamp_chunk_rows(1000, 16, 0.0) == 1000     # budget off
 
 
 # ------------------------------------------------------------ parser unit
