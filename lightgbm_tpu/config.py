@@ -182,6 +182,9 @@ _PARAMS: List[Tuple[str, Any, Tuple[str, ...], Tuple[Tuple[str, float], ...]]] =
     ("heartbeat_interval_s", 5.0, (), ((">", 0.0),)),             # elastic liveness: seconds between per-round worker heartbeat markers (robustness/elastic.py; same file substrate as the startup-barrier ready markers)
     ("heartbeat_timeout_s", 30.0, (), ((">", 0.0),)),             # elastic liveness: a worker silent past this is DEAD (evicted); staleness between heartbeat_interval_s and this marks it SLOW (bounded wait + warn + elastic_slow_worker_rounds counter)
     ("elastic", "off", (), ()),                                   # worker-loss policy: on|off. off (default) = a post-barrier worker death fail-fasts the whole job (pre-PR-9 behavior); on = evict the silent worker, rebuild the mesh over the survivor set, re-shard rows, resume from the newest checkpoint (robustness/elastic.py, docs/ROBUSTNESS.md "Elastic recovery")
+    ("publish_interval", 10, (), ((">", 0),)),                    # continuous-learning pipeline (pipeline/; docs/ROBUSTNESS.md "Continuous learning"): boosting rounds per train->publish cycle — every cycle boosts this many more rounds on the data seen so far, then exports and publishes the snapshot
+    ("pipeline_workdir", "", (), ()),                             # continuous-learning pipeline: durable directory for the atomic cycle manifest, per-cycle checkpoints, model-text exports and the publish-provenance ledger; a SIGKILLed trainer resumes from it with ContinuousTrainer(..., resume="auto"); empty = pipeline unavailable (ContinuousTrainer requires it)
+    ("publish_retry_budget", 2, (), ((">=", 0),)),                # continuous-learning pipeline: publishes retried per cycle after a mid-rollout abort (fleet RollingSwapAborted) before the failure propagates; each retry reuses the cycle's export-assigned version, never skipping forward
     ("use_quantized_grad", False, (), ()),
     ("num_grad_quant_bins", 4, (), ()),
     ("quant_train_renew_leaf", False, (), ()),

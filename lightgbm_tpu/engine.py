@@ -35,7 +35,8 @@ def train(params: Dict[str, Any], train_set: Dataset,
           keep_training_booster: bool = False,
           callbacks: Optional[List[Callable]] = None,
           fobj: Optional[Callable] = None,
-          resume: Optional[str] = None) -> Booster:
+          resume: Optional[str] = None,
+          final_checkpoint: bool = False) -> Booster:
     """Train a booster (reference engine.py:109).
 
     ``resume="auto"`` (requires ``checkpoint_dir`` in ``params``) loads
@@ -46,6 +47,12 @@ def train(params: Dict[str, Any], train_set: Dataset,
     interrupted-and-resumed run finishes with the same round count (and,
     for deterministic configs, the same trees) as an uninterrupted one.
     With no valid checkpoint, training starts from scratch.
+
+    ``final_checkpoint=True`` (requires ``checkpoint_dir``) guarantees a
+    checkpoint at the LAST trained round even when
+    ``checkpoint_interval`` does not land on it — the contract the
+    continuous-learning pipeline (pipeline/) needs so every
+    train→publish cycle ends on a durable, resumable boundary.
     """
     params = normalize_params(params)
     if "num_iterations" in params:
@@ -185,10 +192,13 @@ def train(params: Dict[str, Any], train_set: Dataset,
                 "checkpoint_resume", round_idx=start_round,
                 total_rounds=int(num_boost_round))
         try:
-            return _run_training(booster, params, train_set, rounds_to_run,
-                                 valid_pairs, train_in_valid, feval, fobj,
-                                 callbacks, cbs_before, cbs_after,
-                                 start_round=start_round)
+            out = _run_training(booster, params, train_set, rounds_to_run,
+                                valid_pairs, train_in_valid, feval, fobj,
+                                callbacks, cbs_before, cbs_after,
+                                start_round=start_round)
+            if final_checkpoint and mgr is not None:
+                mgr.save_final(out)
+            return out
         finally:
             if tower is not None:
                 # flush the final partial rollup window and run the SLO

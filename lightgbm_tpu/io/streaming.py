@@ -339,20 +339,34 @@ class ChunkSource:
 
 class ArrayChunkSource(ChunkSource):
     """Chunk iterator over an in-memory array-like (the parity baseline
-    and the adapter for anything ``_as_2d_float`` accepts)."""
+    and the adapter for anything ``_as_2d_float`` accepts).
+
+    An optional row-aligned ``label`` vector rides along chunk by chunk
+    (the continuous-learning pipeline streams labeled training chunks
+    through this; text stripes carry their label column natively)."""
 
     kind = "ndarray"
 
-    def __init__(self, data: Any, chunk_rows: int) -> None:
+    def __init__(self, data: Any, chunk_rows: int,
+                 label: Optional[Any] = None) -> None:
         self.arr = _as_2d_float(data)
         self.chunk_rows = max(1, int(chunk_rows))
         self.num_rows, self.num_features = self.arr.shape
+        self.label = None
+        if label is not None:
+            self.label = np.asarray(label, dtype=np.float64).reshape(-1)
+            if len(self.label) != self.num_rows:
+                raise ValueError(
+                    f"label length {len(self.label)} != data rows "
+                    f"{self.num_rows}")
 
     def chunks(self, start_chunk: int = 0) -> Iterator[RawChunk]:
         for lo in range(start_chunk * self.chunk_rows, self.num_rows,
                         self.chunk_rows):
             hi = min(self.num_rows, lo + self.chunk_rows)
-            yield RawChunk(np.asarray(self.arr[lo:hi], dtype=np.float64))
+            yield RawChunk(np.asarray(self.arr[lo:hi], dtype=np.float64),
+                           label=None if self.label is None
+                           else self.label[lo:hi])
 
 
 class SequenceChunkSource(ChunkSource):

@@ -140,6 +140,25 @@ EVENTS: Dict[str, Tuple[str, str]] = {
         "info", "a streaming ingest finished: the binned dataset (and "
                 "its packed mirror) is complete and feeds train()/the "
                 "elastic cluster unchanged"),
+    "cycle_started": (
+        "info", "a continuous-learning cycle opened (pipeline/): the "
+                "trainer is about to ingest the cycle's fresh chunks"),
+    "cycle_ingested": (
+        "info", "a cycle's chunk prefix committed to the cycle manifest "
+                "— a kill from here re-streams the same chunks and "
+                "boosts as if never interrupted"),
+    "cycle_published": (
+        "info", "a cycle's exported snapshot was published to the live "
+                "serving target at its export-assigned version and "
+                "recorded in the durable publish ledger"),
+    "cycle_resumed": (
+        "warning", "a restarted trainer found an unfinished cycle in "
+                   "the workdir manifest and re-entered it at the "
+                   "correct phase (exactly-once publish preserved)"),
+    "publish_skipped_stale": (
+        "warning", "a resumed cycle's export-assigned version is no "
+                   "longer ahead of the live serving tier; the publish "
+                   "was refused — the tier never regresses"),
 }
 
 #: the process-wide active journal; ``None`` = journaling disabled (the

@@ -137,6 +137,15 @@ COUNTERS: Dict[str, str] = {
     "ingest_sketch_overflows":
         "per-feature exact distinct tallies that overflowed into the "
         "approximate quantile sketch (io/streaming.py)",
+    "pipeline_cycles_completed":
+        "continuous-learning cycles acked end-to-end "
+        "(pipeline/trainer.py)",
+    "pipeline_publish_retries":
+        "pipeline publishes retried after a mid-rollout abort rolled "
+        "the fleet back (same cycle, same version)",
+    "pipeline_stale_publishes_refused":
+        "pipeline publishes refused because the live serving tier was "
+        "already at or past the cycle's assigned version",
 }
 
 

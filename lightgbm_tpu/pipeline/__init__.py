@@ -1,0 +1,15 @@
+"""Continuous learning pipeline: crash-safe train-while-serve cycles.
+
+See pipeline/trainer.py for the cycle state machine and
+docs/ROBUSTNESS.md "Continuous learning" for the crash matrix.
+"""
+
+from .cycle import (BOUNDARIES, PHASE_CHECKPOINTED, PHASE_EXPORTED,
+                    PHASE_INGESTED, PHASE_PUBLISHED, PHASE_STARTED,
+                    CycleManifest, portable_model_text, sha256_text)
+from .trainer import ContinuousTrainer, FleetTarget, ServerTarget
+
+__all__ = ["BOUNDARIES", "ContinuousTrainer", "CycleManifest",
+           "FleetTarget", "PHASE_CHECKPOINTED", "PHASE_EXPORTED",
+           "PHASE_INGESTED", "PHASE_PUBLISHED", "PHASE_STARTED",
+           "ServerTarget", "portable_model_text", "sha256_text"]

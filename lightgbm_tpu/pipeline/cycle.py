@@ -1,0 +1,181 @@
+"""Crash-safe cycle records for the continuous-learning pipeline.
+
+One training-and-publishing *cycle* walks a fixed phase order::
+
+    ingest -> boost -> checkpoint -> export -> publish -> ack
+
+and this module is the durable half of that walk: a single
+``pipeline_manifest.json`` in the pipeline workdir, rewritten atomically
+(temp + ``os.replace`` + directory fsync — the checkpoint-substrate
+idiom from ``robustness/checkpoint.py``) at every phase boundary.  The
+manifest is the ONLY authority on pipeline progress: a trainer that was
+SIGKILLed anywhere reads it back and knows exactly which phase to
+re-enter, and every phase is written to be idempotent under re-entry
+(re-ingesting replays the same chunk prefix, re-boosting resumes from
+the per-cycle checkpoint directory, re-exporting rewrites the same
+bytes, re-publishing reuses the version number assigned at export
+commit).
+
+Phase values stored in the manifest name the last COMMITTED milestone
+of the current cycle (``started`` / ``ingested`` / ``checkpointed`` /
+``exported`` / ``published``); the ack boundary folds the finished
+cycle into ``history`` and resets ``phase`` to ``started`` for the next
+one, all in one atomic rewrite — so "mid-ack" is not an observable
+state.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Any, Dict, Optional
+
+FORMAT_VERSION = 1
+MANIFEST_NAME = "pipeline_manifest.json"
+
+PHASE_STARTED = "started"
+PHASE_INGESTED = "ingested"
+PHASE_CHECKPOINTED = "checkpointed"
+PHASE_EXPORTED = "exported"
+PHASE_PUBLISHED = "published"
+
+#: committed-milestone order; resume compares positions to decide which
+#: phases of the current cycle still need to run
+PHASE_ORDER = (PHASE_STARTED, PHASE_INGESTED, PHASE_CHECKPOINTED,
+               PHASE_EXPORTED, PHASE_PUBLISHED)
+
+#: the five kill-point boundaries the fault drill exercises, in cycle
+#: order (each fires right AFTER its milestone committed durably)
+BOUNDARIES = ("ingest", "boost", "checkpoint", "export", "publish")
+
+
+def sha256_text(text: str) -> str:
+    return hashlib.sha256(text.encode("utf-8")).hexdigest()
+
+
+#: run-local path parameters the export canonicalization removes from the
+#: serialized parameters trailer — they name THIS run's scratch locations,
+#: not anything about the model, and leaving them in would make the same
+#: logical model export different bytes from different workdirs (breaking
+#: the kill/resume drill's bit-identity contract)
+_EXPORT_STRIP_KEYS = frozenset(
+    {"pipeline_workdir", "checkpoint_dir", "event_output"})
+
+
+def portable_model_text(text: str,
+                        num_iterations: Optional[int] = None) -> str:
+    """Canonicalize a booster's ``model_to_string`` output for export:
+    drop the run-local path parameters (``[pipeline_workdir: ...]``,
+    ``[checkpoint_dir: ...]``, ``[event_output: ...]``) from the
+    parameters trailer, and — when ``num_iterations`` is given — rewrite
+    the ``[num_iterations: ...]`` line to the model's TRUE absolute
+    iteration count.  The trailer otherwise records whatever round count
+    the producing ``train()`` call was asked for, which differs between
+    a fresh continuation (relative rounds on top of an init model) and a
+    checkpoint resume (absolute target) even though the trees are
+    identical.  The export is the pipeline's portable publish artifact —
+    its bytes (and therefore its sha256 provenance chain) must depend
+    only on the model, never on where or how the producing run happened
+    to execute."""
+    out = []
+    for line in text.split("\n"):
+        if line.startswith("[") and ":" in line:
+            key = line[1:].split(":", 1)[0]
+            if key in _EXPORT_STRIP_KEYS:
+                continue
+            if key == "num_iterations" and num_iterations is not None:
+                line = f"[num_iterations: {int(num_iterations)}]"
+        out.append(line)
+    return "\n".join(out)
+
+
+def _atomic_json(path: str, payload: Dict[str, Any]) -> None:
+    from ..robustness.checkpoint import _fsync_dir, _write_file
+    tmp = path + ".tmp"
+    _write_file(tmp, json.dumps(payload, indent=1, sort_keys=True))
+    os.replace(tmp, path)
+    _fsync_dir(os.path.dirname(path) or ".")
+
+
+class CycleManifest:
+    """The pipeline's durable cursor: current cycle, last committed
+    phase, chunk/round targets, the pending export record, and the
+    history of acked cycles."""
+
+    def __init__(self, workdir: str, state: Optional[Dict[str, Any]] = None):
+        self.workdir = str(workdir)
+        self.path = os.path.join(self.workdir, MANIFEST_NAME)
+        self.state: Dict[str, Any] = state if state is not None else {
+            "format_version": FORMAT_VERSION,
+            "name": "",
+            "rounds_per_cycle": 0,
+            "chunks_per_cycle": 0,
+            "source_fingerprint": {},
+            "cycle": 0,
+            "phase": PHASE_STARTED,
+            "chunks_consumed": 0,
+            "target_iteration": 0,
+            "model_sha256": None,
+            "export": None,
+            "history": [],
+        }
+
+    # ------------------------------------------------------------- load
+    @classmethod
+    def load(cls, workdir: str) -> Optional["CycleManifest"]:
+        """Parse the workdir's manifest; ``None`` when absent or
+        unreadable (a torn write can't happen — the rewrite is atomic —
+        so unreadable means a foreign file, which the trainer treats as
+        no-manifest and refuses via the fingerprint check)."""
+        path = os.path.join(str(workdir), MANIFEST_NAME)
+        try:
+            with open(path) as fh:
+                state = json.load(fh)
+        except (OSError, ValueError):
+            return None
+        if not isinstance(state, dict) or \
+                state.get("format_version") != FORMAT_VERSION:
+            return None
+        return cls(workdir, state)
+
+    # ----------------------------------------------------------- commit
+    def commit(self) -> None:
+        """Atomically persist the current state (one phase boundary)."""
+        os.makedirs(self.workdir, exist_ok=True)
+        _atomic_json(self.path, self.state)
+
+    # -------------------------------------------------------- accessors
+    @property
+    def cycle(self) -> int:
+        return int(self.state["cycle"])
+
+    @property
+    def phase(self) -> str:
+        return str(self.state["phase"])
+
+    def phase_at_least(self, phase: str) -> bool:
+        return PHASE_ORDER.index(self.phase) >= PHASE_ORDER.index(phase)
+
+    def set_phase(self, phase: str, **fields: Any) -> None:
+        self.state["phase"] = phase
+        self.state.update(fields)
+        self.commit()
+
+    def ack_cycle(self, entry: Dict[str, Any]) -> None:
+        """Fold the finished cycle into history and open the next one —
+        one atomic rewrite, so the ack boundary is all-or-nothing."""
+        self.state["history"].append(entry)
+        self.state["cycle"] = self.cycle + 1
+        self.state["phase"] = PHASE_STARTED
+        self.state["target_iteration"] = 0
+        self.state["model_sha256"] = None
+        self.state["export"] = None
+        self.commit()
+
+    def completed_cycles(self) -> int:
+        return len(self.state["history"])
+
+    def last_entry(self) -> Optional[Dict[str, Any]]:
+        hist = self.state["history"]
+        return hist[-1] if hist else None

@@ -1,0 +1,478 @@
+"""Continuous learning: crash-safe train-while-serve cycles.
+
+``ContinuousTrainer`` composes the substrate the last five PRs built —
+re-streamable chunk sources (io/streaming.py), continuation training
+with bit-for-bit checkpoints (engine.py ``resume="auto"`` +
+robustness/checkpoint.py), and warm zero-downtime publish
+(serving/server.py / serving/fleet.py) — into one loop::
+
+    ingest -> boost -> checkpoint -> export -> publish -> ack
+
+Each *cycle* absorbs the next data chunk(s), boosts ``publish_interval``
+more rounds on everything seen so far (continuing from the previous
+cycle's exported model), guarantees a final checkpoint, exports the
+model text atomically, publishes it to a live serving target, and acks.
+Progress commits to the atomic cycle manifest (pipeline/cycle.py) at
+every boundary, so SIGKILL anywhere resumes into the correct phase:
+
+* killed after ingest — the chunk prefix is re-streamed (sources
+  replay deterministically) and boosting starts as before;
+* killed mid-boost — the per-cycle checkpoint directory resumes the
+  exact round (same trees bit-for-bit, PR 3's contract);
+* killed after the final checkpoint — boosting early-returns from it;
+* killed after export — the recorded version number is reused and the
+  same bytes are re-published idempotently (exactly-once publish: the
+  version is ASSIGNED at export commit, so a retried publish can never
+  consume a second version number);
+* killed after publish — the durable ledger (serving/registry.py
+  ``PublishProvenance``) already names the version, so resume acks
+  without touching the serving tier.
+
+The serving tier never regresses: versions are fenced at publish time
+(``StalePublishError``), and a restarted trainer first *recovers* the
+tier's true latest version from the provenance ledger (re-seeding a
+fresh in-process server from the exported text) instead of trusting its
+own manifest.  A publish aborted mid-rollout (fleet
+``RollingSwapAborted``) rolls back via PR 12's version fence and is
+retried up to ``publish_retry_budget`` times — same cycle, same
+version, never skipping forward.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from ..config import Config, normalize_params
+from ..io.streaming import ArrayChunkSource, _write_atomic, make_source
+from ..obs import events as obs_events
+from ..obs.metrics import count_event
+from ..robustness.checkpoint import load_latest_checkpoint
+from ..serving.fleet import RollingSwapAborted
+from ..serving.registry import PublishProvenance
+from ..utils import log
+from .cycle import (PHASE_CHECKPOINTED, PHASE_EXPORTED, PHASE_INGESTED,
+                    PHASE_PUBLISHED, PHASE_STARTED, CycleManifest,
+                    portable_model_text, sha256_text)
+
+PROVENANCE_NAME = "provenance.json"
+
+
+class ServerTarget:
+    """Publish target over an in-process ``PredictionServer``."""
+
+    kind = "server"
+
+    def __init__(self, server) -> None:
+        self.server = server
+
+    def attach_provenance(self, provenance: PublishProvenance) -> None:
+        if self.server.registry.provenance is None:
+            self.server.registry.provenance = provenance
+
+    def latest(self, name: str) -> Optional[Dict[str, Any]]:
+        try:
+            entry = self.server.registry.get(name)
+        except log.LightGBMError:
+            return None
+        return {"version": int(entry.version), "sha256": entry.sha256}
+
+    def publish(self, name: str, model_text: str, *, version: int,
+                sha256: str, cycle: int) -> None:
+        self.server.publish(name, model_text=model_text, version=version,
+                            sha256=sha256, cycle=cycle)
+
+    def wait_ready(self, timeout_s: float = 30.0) -> None:
+        """In-process registry swaps are atomic; always ready."""
+
+
+class FleetTarget:
+    """Publish target over a ``FleetServer`` (rolling drain-warm-swap
+    across replica processes; aborts surface as ``RollingSwapAborted``
+    and the fleet manifest keeps the old version)."""
+
+    kind = "fleet"
+
+    def __init__(self, fleet) -> None:
+        self.fleet = fleet
+
+    def attach_provenance(self, provenance: PublishProvenance) -> None:
+        if self.fleet.registry.provenance is None:
+            self.fleet.registry.provenance = provenance
+
+    def latest(self, name: str) -> Optional[Dict[str, Any]]:
+        cur = self.fleet.registry.current(name)
+        if not cur:
+            return None
+        return {"version": int(cur["version"]), "sha256": cur.get("sha256")}
+
+    def publish(self, name: str, model_text: str, *, version: int,
+                sha256: str, cycle: int) -> None:
+        self.fleet.publish(name, model_text=model_text, version=version,
+                           sha256=sha256, cycle=cycle)
+
+    def wait_ready(self, timeout_s: float = 30.0) -> None:
+        """Block until every replica slot is healthy again — a publish
+        retry straight after a mid-rollout abort would just re-abort on
+        the still-dead replica; the fleet monitor needs a beat to
+        respawn it."""
+        import time
+        deadline = time.monotonic() + float(timeout_s)
+        while time.monotonic() < deadline:
+            states = self.fleet.states()
+            if states and all(s == "healthy" for s in states.values()):
+                return
+            time.sleep(0.1)
+
+
+class ContinuousTrainer:
+    """Boost-on-arriving-chunks with crash-safe publish cycles.
+
+    ``data``/``label`` feed a re-streamable chunk source (arrays are
+    wrapped in :class:`ArrayChunkSource`; text paths / Sequence / Arrow
+    / custom sources go through ``make_source`` and must carry their
+    label column per chunk).  ``target`` is a :class:`ServerTarget` or
+    :class:`FleetTarget`.  ``resume="auto"`` (default) picks up an
+    existing workdir manifest; ``resume=None`` requires a fresh workdir.
+
+    ``phase_hook(boundary, cycle)`` is the fault-drill seam: called
+    right after each boundary commits durably (``ingest`` /
+    ``checkpoint`` / ``export`` / ``publish``), plus ``boost`` after the
+    first newly trained round of each cycle — the five kill points of
+    ``tools/fault_drill.py pipeline_kill``.
+    """
+
+    def __init__(self, params: Dict[str, Any], data: Any, target, *,
+                 label: Any = None, name: str = "model",
+                 resume: Optional[str] = "auto",
+                 chunks_per_cycle: int = 1,
+                 chunk_rows: Optional[int] = None,
+                 phase_hook: Optional[Callable[[str, int], None]] = None):
+        self.params = normalize_params(dict(params or {}))
+        cfg = Config(self.params)
+        self.workdir = str(cfg.pipeline_workdir or "")
+        if not self.workdir:
+            log.fatal("ContinuousTrainer requires pipeline_workdir= "
+                      "in params (the durable cycle-state directory)")
+        if resume is not None and str(resume) != "auto":
+            log.fatal(f"resume={resume!r} is not supported (only 'auto')")
+        self.resume = resume
+        self.name = str(name)
+        self.rounds_per_cycle = int(cfg.publish_interval)
+        self.retry_budget = int(cfg.publish_retry_budget)
+        self.chunks_per_cycle = max(1, int(chunks_per_cycle))
+        self.target = target
+        self.phase_hook = phase_hook
+        self._journal_path = str(cfg.event_output or "") or None
+        if label is not None:
+            self.source = ArrayChunkSource(
+                data, int(chunk_rows or cfg.ingest_chunk_rows), label=label)
+        else:
+            self.source = make_source(data, cfg, chunk_rows)
+        self.provenance = PublishProvenance(
+            os.path.join(self.workdir, PROVENANCE_NAME))
+        self.manifest: Optional[CycleManifest] = None
+
+    # ---------------------------------------------------------------- run
+    def run(self, num_cycles: Optional[int] = None) -> Dict[str, Any]:
+        """Run cycles until ``num_cycles`` have been ACKED in total
+        (across all runs against this workdir — a resumed run counts
+        the crashed run's completed cycles) or the source runs dry.
+        Returns a summary of the manifest state."""
+        with obs_events.session(self._journal_path):
+            self._startup()
+            man = self.manifest
+            while num_cycles is None or man.completed_cycles() < num_cycles:
+                if not self._run_cycle():
+                    break
+        return {"name": self.name, "workdir": self.workdir,
+                "cycles_completed": man.completed_cycles(),
+                "history": list(man.state["history"])}
+
+    # ------------------------------------------------------------ startup
+    def _startup(self) -> None:
+        man = CycleManifest.load(self.workdir)
+        if man is not None and self.resume is None:
+            log.fatal(f"pipeline workdir {self.workdir!r} already holds a "
+                      "cycle manifest; pass resume='auto' to continue it "
+                      "or use a fresh directory")
+        if man is not None:
+            fp = self.source.fingerprint()
+            if man.state["name"] != self.name or \
+                    int(man.state["rounds_per_cycle"]) != \
+                    self.rounds_per_cycle or \
+                    man.state["source_fingerprint"] != fp:
+                log.fatal(
+                    f"pipeline workdir {self.workdir!r} belongs to a "
+                    f"different pipeline (name/rounds/source mismatch: "
+                    f"manifest says {man.state['name']!r}/"
+                    f"{man.state['rounds_per_cycle']}/"
+                    f"{man.state['source_fingerprint']}, this trainer is "
+                    f"{self.name!r}/{self.rounds_per_cycle}/{fp})")
+            self.manifest = man
+            if man.phase != PHASE_STARTED:
+                obs_events.emit_event(
+                    "cycle_resumed", cycle=man.cycle, phase=man.phase,
+                    chunks_consumed=int(man.state["chunks_consumed"]))
+                log.info(f"pipeline resume: cycle {man.cycle} was killed "
+                         f"after its {man.phase!r} boundary; re-entering")
+        else:
+            os.makedirs(self.workdir, exist_ok=True)
+            self.manifest = CycleManifest(self.workdir)
+            self.manifest.state.update(
+                name=self.name,
+                rounds_per_cycle=self.rounds_per_cycle,
+                chunks_per_cycle=self.chunks_per_cycle,
+                source_fingerprint=self.source.fingerprint())
+            self.manifest.commit()
+        self.target.attach_provenance(self.provenance)
+        self._recover_target()
+
+    def _recover_target(self) -> None:
+        """Bring the serving tier up to the ledger's latest version.
+
+        An in-process ``PredictionServer`` dies with the trainer, so a
+        restarted pipeline re-seeds it from the durable provenance +
+        export text — the tier's TRUE latest version, independent of
+        where the cycle manifest says the trainer was."""
+        latest = self.provenance.latest(self.name)
+        if latest is None:
+            return
+        live = self.target.latest(self.name)
+        if live is not None and int(live["version"]) >= latest["version"]:
+            return
+        path = latest.get("path") or self._export_path(latest.get("cycle"))
+        text = self._read_export(path, latest["sha256"])
+        self.target.publish(self.name, text, version=latest["version"],
+                            sha256=latest["sha256"],
+                            cycle=latest.get("cycle"))
+        log.info(f"pipeline recovery: re-seeded serving target with "
+                 f"{self.name!r} version {latest['version']} "
+                 f"(cycle {latest.get('cycle')})")
+
+    # -------------------------------------------------------------- cycle
+    def _run_cycle(self) -> bool:
+        man = self.manifest
+        c = man.cycle
+        if man.phase == PHASE_STARTED:
+            have = int(man.state["chunks_consumed"])
+            X, y, got = self._collect(have + self.chunks_per_cycle)
+            if got <= have:
+                return False     # source exhausted: no new chunk to learn
+            obs_events.emit_event("cycle_started", cycle=c)
+            prev = man.last_entry()
+            prev_iter = int(prev["iteration"]) if prev else 0
+            man.set_phase(PHASE_INGESTED, chunks_consumed=got,
+                          target_iteration=prev_iter + self.rounds_per_cycle)
+            obs_events.emit_event("cycle_ingested", cycle=c, chunks=got,
+                                  rows=int(X.shape[0]))
+            self._hook("ingest", c)
+        else:
+            # resumed mid-cycle: replay the committed chunk prefix (the
+            # source contract guarantees the same chunk sequence)
+            X, y, got = self._collect(int(man.state["chunks_consumed"]))
+            if got < int(man.state["chunks_consumed"]):
+                log.fatal(f"pipeline resume: source yielded only {got} "
+                          f"chunks but the manifest committed "
+                          f"{man.state['chunks_consumed']} — the source "
+                          "changed under the workdir")
+
+        if not man.phase_at_least(PHASE_EXPORTED):
+            text = self._boost(c, X, y, int(man.state["target_iteration"]))
+            sha = sha256_text(text)
+            if not man.phase_at_least(PHASE_CHECKPOINTED):
+                man.set_phase(PHASE_CHECKPOINTED, model_sha256=sha)
+                self._hook("checkpoint", c)
+            elif man.state.get("model_sha256") not in (None, sha):
+                log.fatal(f"cycle {c}: re-boosted model sha {sha[:12]} != "
+                          f"checkpointed {man.state['model_sha256'][:12]} "
+                          "— determinism broke (non-deterministic params?)")
+            path = self._export_path(c)
+            _write_atomic(path, text)
+            version = self._assign_version()
+            man.set_phase(PHASE_EXPORTED, export={
+                "path": path, "sha256": sha, "version": version,
+                "iteration": int(man.state["target_iteration"])})
+            self._hook("export", c)
+        exp = dict(man.state["export"])
+        text = self._read_export(exp["path"], exp["sha256"])
+
+        if not man.phase_at_least(PHASE_PUBLISHED):
+            self._publish_cycle(c, text, exp)
+            man.set_phase(PHASE_PUBLISHED)
+            self._hook("publish", c)
+
+        man.ack_cycle({
+            "cycle": c, "version": int(exp["version"]),
+            "sha256": exp["sha256"], "path": exp["path"],
+            "iteration": int(exp["iteration"]),
+            "chunks_consumed": int(man.state["chunks_consumed"])})
+        count_event("pipeline_cycles_completed")
+        return True
+
+    # -------------------------------------------------------------- steps
+    def _collect(self, limit: int):
+        """First ``limit`` chunks of the (re-streamed) source, stacked.
+        Returns ``(X, y, chunks_taken)``; fewer chunks than ``limit``
+        means the source ran dry."""
+        xs, ys, n = [], [], 0
+        if limit > 0:
+            for chunk in self.source.chunks(0):
+                if chunk.data.shape[0]:
+                    xs.append(np.asarray(chunk.data, dtype=np.float64))
+                    if chunk.label is not None:
+                        ys.append(np.asarray(chunk.label,
+                                             dtype=np.float64).reshape(-1))
+                n += 1
+                if n >= limit:
+                    break
+        if not xs:
+            return None, None, n
+        X = xs[0] if len(xs) == 1 else np.concatenate(xs, axis=0)
+        if len(ys) != len(xs):
+            log.fatal("ContinuousTrainer needs per-chunk labels (pass "
+                      "label= with array data, or a source whose chunks "
+                      "carry a label column)")
+        y = ys[0] if len(ys) == 1 else np.concatenate(ys, axis=0)
+        return X, y, n
+
+    def _boost(self, c: int, X, y, target_iteration: int) -> str:
+        """Train the cycle's rounds on everything ingested so far,
+        continuing from the previous cycle's export — or, after a
+        mid-boost kill, from the per-cycle checkpoint directory (the
+        one ``train(resume="auto")`` call restores exact state from).
+        Returns the finished model text."""
+        from ..basic import Booster, Dataset
+        from ..engine import train
+        ckpt_dir = os.path.join(self.workdir, "cycles", f"cycle_{c:04d}")
+        p = dict(self.params)
+        p.pop("num_iterations", None)
+        p["checkpoint_dir"] = ckpt_dir
+        ds = Dataset(X, label=y, params=dict(p), free_raw_data=False)
+        callbacks = []
+        if self.phase_hook is not None:
+            callbacks.append(_boost_hook_callback(self.phase_hook, c))
+        state = load_latest_checkpoint(ckpt_dir)
+        if state is not None:
+            # mid-cycle resume: checkpoint iterations are ABSOLUTE
+            # (they count the continuation base), so the total target
+            # is the round count to pass
+            booster = train(p, ds, num_boost_round=target_iteration,
+                            callbacks=callbacks, resume="auto",
+                            final_checkpoint=True)
+        else:
+            init = None
+            prev = self.manifest.last_entry()
+            if prev is not None:
+                init = Booster(model_str=self._read_export(
+                    prev["path"], prev["sha256"]))
+            booster = train(p, ds,
+                            num_boost_round=self.rounds_per_cycle,
+                            callbacks=callbacks, init_model=init,
+                            final_checkpoint=True)
+        return portable_model_text(
+            booster.model_to_string(num_iteration=-1),
+            num_iterations=int(target_iteration))
+
+    def _assign_version(self) -> int:
+        """Version for the cycle being exported: one past the TRUE
+        latest — the max of the durable ledger, the live target and our
+        own acked history — fixed at export commit so a crashed publish
+        retries the SAME number (exactly-once semantics)."""
+        latest = self.provenance.latest(self.name)
+        live = self.target.latest(self.name)
+        prev = self.manifest.last_entry()
+        base = max(latest["version"] if latest else 0,
+                   int(live["version"]) if live else 0,
+                   int(prev["version"]) if prev else 0)
+        return base + 1
+
+    def _publish_cycle(self, c: int, text: str, exp: Dict[str, Any]) -> None:
+        v, sha = int(exp["version"]), str(exp["sha256"])
+        ledger = self.provenance.lookup(self.name, v)
+        if ledger is not None and ledger.get("sha256") == sha:
+            # the crashed run's publish landed and was recorded; the
+            # only missing step was the ack — nothing to re-send
+            log.info(f"cycle {c}: version {v} already in the publish "
+                     "ledger; completing the ack only")
+            return
+        live = self.target.latest(self.name)
+        live_v = int(live["version"]) if live else 0
+        live_sha = live.get("sha256") if live else None
+        if live_v > v or (live_v == v and live_sha not in (None, sha)):
+            # the serving tier moved past this cycle's assigned version
+            # (an external publisher raced us): regressing is forbidden
+            obs_events.emit_event("publish_skipped_stale", cycle=c,
+                                  version=v, live_version=live_v)
+            count_event("pipeline_stale_publishes_refused")
+            log.warning(f"cycle {c}: refusing stale publish of version "
+                        f"{v} over live version {live_v}")
+            return
+        if live_v == v and live_sha == sha:
+            # swap landed but the ledger write was lost to the kill:
+            # repair the record instead of re-swapping
+            self.provenance.record(self.name, v, sha, cycle=c,
+                                   path=exp["path"])
+            obs_events.emit_event("cycle_published", cycle=c, version=v,
+                                  sha256=sha)
+            return
+        attempt = 0
+        while True:
+            try:
+                self.target.publish(self.name, text, version=v,
+                                    sha256=sha, cycle=c)
+                break
+            except RollingSwapAborted as e:
+                attempt += 1
+                count_event("pipeline_publish_retries")
+                if attempt > self.retry_budget:
+                    raise
+                log.warning(f"cycle {c}: publish of version {v} aborted "
+                            f"mid-rollout ({e}); retrying same cycle "
+                            f"({attempt}/{self.retry_budget})")
+                self.target.wait_ready()
+        obs_events.emit_event("cycle_published", cycle=c, version=v,
+                              sha256=sha)
+
+    # ------------------------------------------------------------ helpers
+    def _export_path(self, cycle: Optional[int]) -> str:
+        if cycle is None:
+            log.fatal("publish ledger entry has no cycle/path to recover "
+                      "the export from")
+        d = os.path.join(self.workdir, "exports")
+        os.makedirs(d, exist_ok=True)
+        return os.path.join(d, f"cycle_{int(cycle):04d}.txt")
+
+    def _read_export(self, path: str, sha256: str) -> str:
+        try:
+            with open(path) as fh:
+                text = fh.read()
+        except OSError as e:
+            log.fatal(f"pipeline export {path!r} is unreadable ({e}); "
+                      "the workdir is torn")
+        if sha256_text(text) != sha256:
+            log.fatal(f"pipeline export {path!r} does not match its "
+                      f"recorded sha256 ({sha256[:12]}…); the workdir "
+                      "is torn")
+        return text
+
+    def _hook(self, boundary: str, cycle: int) -> None:
+        if self.phase_hook is not None:
+            self.phase_hook(boundary, cycle)
+
+
+def _boost_hook_callback(hook: Callable[[str, int], None], cycle: int):
+    """Fire the drill seam once, after the first newly trained round of
+    the cycle — BY THEN the checkpoint callback (order 40) has already
+    committed that round when the interval lands on it, so a kill here
+    exercises the mid-boost resume path."""
+    fired = {"done": False}
+
+    def _callback(env) -> None:
+        if not fired["done"]:
+            fired["done"] = True
+            hook("boost", cycle)
+    _callback.order = 90
+    return _callback

@@ -435,6 +435,19 @@ class CheckpointManager:
         _fsync_dir(self.directory)
         return final
 
+    def save_final(self, booster) -> Optional[str]:
+        """Guarantee a checkpoint at the booster's CURRENT iteration:
+        saves one unless the newest on-disk checkpoint already is it.
+        The continuous-learning pipeline (pipeline/trainer.py) calls
+        this through ``train(..., final_checkpoint=True)`` so every
+        cycle ends on a durable, resumable boundary even when
+        ``checkpoint_interval`` does not divide the cycle length."""
+        g = booster._gbdt
+        dirs = checkpoint_dirs(self.directory)
+        if dirs and int(dirs[0][0]) == int(g.iter_):
+            return dirs[0][1]
+        return self.save(booster)
+
     def _prune(self) -> None:
         """Keep the newest ``keep`` checkpoints; drop the rest and any
         orphaned temp dirs from interrupted saves."""

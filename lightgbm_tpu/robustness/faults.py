@@ -131,6 +131,22 @@ def drop_heartbeats(rank: int, at_round: int = 0) -> FaultSpec:
     return FaultSpec("drop_heartbeats", int(rank), int(at_round))
 
 
+def pipeline_kill_hook(boundary: str, cycle: int) -> Callable[[str, int], None]:
+    """A ``ContinuousTrainer`` phase hook that SIGKILLs THIS process the
+    moment the pipeline commits ``boundary`` of ``cycle`` (one of
+    ``pipeline/cycle.py BOUNDARIES``: ingest / boost / checkpoint /
+    export / publish).  A real, uncatchable SIGKILL with no cleanup —
+    the strongest crash the cycle manifest's atomic-commit discipline
+    must survive.  Used by ``tools/fault_drill.py pipeline_kill`` via
+    the ``python -m lightgbm_tpu.pipeline.drill`` child driver."""
+    import signal
+
+    def _hook(b: str, c: int) -> None:
+        if b == boundary and int(c) == int(cycle):
+            os.kill(os.getpid(), signal.SIGKILL)
+    return _hook
+
+
 def newest_checkpoint_path(directory: str) -> Optional[str]:
     dirs = checkpoint_dirs(directory)
     return dirs[0][1] if dirs else None

@@ -205,11 +205,16 @@ class FleetRegistry:
     it untouched — the rollback target by construction."""
 
     def __init__(self, models_dir: str,
-                 metrics: Optional[MetricsRegistry] = None) -> None:
+                 metrics: Optional[MetricsRegistry] = None,
+                 provenance=None) -> None:
         self.models_dir = str(models_dir)
         os.makedirs(self.models_dir, exist_ok=True)
         self.manifest_path = os.path.join(self.models_dir, "manifest.json")
         self.metrics = metrics
+        #: optional durable publish ledger (serving/registry.py
+        #: PublishProvenance); committed publishes carrying a sha256 are
+        #: recorded into it after the manifest commit
+        self.provenance = provenance
         self._lock = threading.Lock()
 
     # ------------------------------------------------------------ manifest
@@ -238,10 +243,17 @@ class FleetRegistry:
         os.replace(tmp, path)
         return path
 
-    def _commit(self, name: str, version: int, path: str) -> None:
+    def _commit(self, name: str, version: int, path: str,
+                sha256: Optional[str] = None,
+                cycle: Optional[int] = None) -> None:
         with self._lock:
             models = self.models()
-            models[str(name)] = {"version": int(version), "path": path}
+            entry = {"version": int(version), "path": path}
+            if sha256 is not None:
+                entry["sha256"] = str(sha256)
+            if cycle is not None:
+                entry["cycle"] = int(cycle)
+            models[str(name)] = entry
             _atomic_json(self.manifest_path, {"models": models})
 
     # ------------------------------------------------------------- publish
@@ -249,7 +261,8 @@ class FleetRegistry:
                 model_text: Optional[str] = None,
                 model_file: Optional[str] = None,
                 version: Optional[int] = None,
-                rollout=None) -> int:
+                rollout=None, sha256: Optional[str] = None,
+                cycle: Optional[int] = None) -> int:
         """Stage a new version, roll it across the fleet, commit.
 
         Exactly one of ``booster`` / ``model_text`` / ``model_file``
@@ -260,7 +273,16 @@ class FleetRegistry:
         the manifest commit, and must raise :class:`RollingSwapAborted`
         on a mid-rollout failure — in which case the manifest keeps the
         old version and the exception propagates.  Returns the
-        committed version."""
+        committed version.
+
+        The manifest never moves backward: an explicit ``version`` older
+        than the committed one raises
+        :class:`~lightgbm_tpu.serving.registry.StalePublishError` before
+        anything is staged (equal is allowed — the idempotent re-publish
+        a crashed pipeline retries through).  ``sha256``/``cycle`` are
+        provenance fields recorded into the manifest entry (and the
+        attached :class:`PublishProvenance` ledger, when any)."""
+        from .registry import StalePublishError
         sources = [s is not None for s in (booster, model_text, model_file)]
         if sum(sources) != 1:
             raise log.LightGBMError(
@@ -274,6 +296,11 @@ class FleetRegistry:
         cur = self.current(name)
         if version is None:
             version = (int(cur["version"]) + 1) if cur else 1
+        elif cur and int(version) < int(cur["version"]):
+            raise StalePublishError(
+                f"refusing to publish {name!r} version {int(version)} "
+                f"over committed fleet version {int(cur['version'])}: "
+                "the fleet manifest never regresses")
         path = self._stage(name, int(version), model_text)
         emit_event("rolling_swap_started", model=name,
                    to_version=int(version),
@@ -289,7 +316,10 @@ class FleetRegistry:
                            else None,
                            reason=f"{type(e).__name__}: {e}")
                 raise
-        self._commit(name, int(version), path)
+        self._commit(name, int(version), path, sha256=sha256, cycle=cycle)
+        if self.provenance is not None and sha256 is not None:
+            self.provenance.record(name, int(version), sha256,
+                                   cycle=cycle, path=path)
         count_event("fleet_rolling_swaps", 1, self.metrics)
         emit_event("rolling_swap_completed", model=name,
                    version=int(version))
@@ -343,9 +373,14 @@ def _replica_serve_conn(server, conn: socket.socket,
                                   "spans": tr.spans}
         elif op == "publish":
             try:
+                # force=True arrives only from the router's rollback
+                # path: converging a replica BACK to the manifest
+                # version after an aborted rollout must bypass the
+                # registry's no-regress fence
                 entry = server.publish(
                     msg["name"], model_file=msg["path"],
-                    version=int(msg["version"]), warmup=True)
+                    version=int(msg["version"]), warmup=True,
+                    force=bool(msg.get("force", False)))
                 reply = {"ok": True, "version": int(entry.version),
                          "compile_s": float(sum(
                              server.entry_compile_s().values()))}
@@ -1144,7 +1179,9 @@ class FleetServer:
     def publish(self, name: str, *, booster=None,
                 model_text: Optional[str] = None,
                 model_file: Optional[str] = None,
-                version: Optional[int] = None) -> int:
+                version: Optional[int] = None,
+                sha256: Optional[str] = None,
+                cycle: Optional[int] = None) -> int:
         """Persist the model and roll it across the fleet one replica
         at a time (drain -> warm -> swap behind the router).  Raises
         :class:`RollingSwapAborted` if a replica dies mid-rollout —
@@ -1156,7 +1193,7 @@ class FleetServer:
             return self.registry.publish(
                 name, booster=booster, model_text=model_text,
                 model_file=model_file, version=version,
-                rollout=self._rollout)
+                rollout=self._rollout, sha256=sha256, cycle=cycle)
 
     def _drain(self, s: _ReplicaSlot) -> None:
         """Bounded wait for the replica's in-flight count to reach
@@ -1249,7 +1286,8 @@ class FleetServer:
                     reply = self._rpc(
                         s, {"op": "publish", "name": name,
                             "path": old["path"],
-                            "version": int(old["version"])},
+                            "version": int(old["version"]),
+                            "force": True},
                         timeout_s=_SWAP_TIMEOUT_S)
                 confirmed = bool(reply.get("ok"))
             except (OSError, EOFError, ValueError, pickle.PickleError):

@@ -14,13 +14,103 @@ the predictor object).
 
 from __future__ import annotations
 
+import json
+import os
 import threading
 import time
-from typing import Dict, List, NamedTuple, Optional
+from typing import Any, Dict, List, NamedTuple, Optional
 
 from ..obs.metrics import MetricsRegistry, count_event
 from ..utils import log
 from .predictor import CompiledPredictor
+
+
+class StalePublishError(log.LightGBMError):
+    """A publish tried to install a version OLDER than the live one.
+
+    The serving tier is contractually forbidden from regressing: a
+    restarted trainer that lost track of the fleet must recover the true
+    latest version (see :class:`PublishProvenance`) instead of swapping
+    the clock backward under live clients.  Re-publishing the SAME
+    version is allowed — that is the idempotent retry path a crashed
+    publish resumes through."""
+
+
+class PublishProvenance:
+    """Durable publish ledger: ``name -> version -> {sha256, cycle}``.
+
+    One atomically rewritten JSON file records every version the
+    registry ever installed, keyed by the sha256 of the model TEXT (the
+    interop format, so provenance survives process/registry death even
+    though the in-process registry itself does not).  A restarted
+    continuous trainer reads this ledger — not its own cycle manifest —
+    to learn the serving tier's true latest version, and an
+    exported-but-unacked cycle compares its export sha against the
+    ledger to decide between idempotent re-publish and plain ack."""
+
+    FORMAT_VERSION = 1
+
+    def __init__(self, path: str) -> None:
+        self.path = str(path)
+        self._lock = threading.Lock()
+
+    def _read(self) -> Dict[str, Any]:
+        try:
+            with open(self.path) as fh:
+                data = json.load(fh)
+        except (OSError, ValueError):
+            return {}
+        if not isinstance(data, dict) or \
+                data.get("format_version") != self.FORMAT_VERSION:
+            return {}
+        return data.get("models", {})
+
+    def record(self, name: str, version: int, sha256: str,
+               cycle: Optional[int] = None,
+               path: Optional[str] = None) -> None:
+        """Durably record one published version (idempotent: recording
+        the same (name, version, sha) again rewrites the same bytes)."""
+        from ..robustness.checkpoint import _fsync_dir, _write_file
+        with self._lock:
+            models = self._read()
+            entry = models.setdefault(str(name), {})
+            entry[str(int(version))] = {
+                "sha256": str(sha256),
+                "cycle": None if cycle is None else int(cycle),
+                "path": path,
+                "unix_time": round(time.time(), 3),
+            }
+            payload = {"format_version": self.FORMAT_VERSION,
+                       "models": models}
+            d = os.path.dirname(self.path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            tmp = self.path + ".tmp"
+            _write_file(tmp, json.dumps(payload, indent=1, sort_keys=True))
+            os.replace(tmp, self.path)
+            _fsync_dir(d or ".")
+
+    def versions(self, name: str) -> List[int]:
+        with self._lock:
+            entry = self._read().get(str(name), {})
+        return sorted(int(v) for v in entry)
+
+    def lookup(self, name: str, version: int) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            entry = self._read().get(str(name), {})
+        return entry.get(str(int(version)))
+
+    def latest(self, name: str) -> Optional[Dict[str, Any]]:
+        """Newest recorded version of ``name`` (with its record), or
+        ``None`` when the ledger has never seen it."""
+        with self._lock:
+            entry = self._read().get(str(name), {})
+        if not entry:
+            return None
+        v = max(int(k) for k in entry)
+        rec = dict(entry[str(v)])
+        rec["version"] = v
+        return rec
 
 
 class ModelEntry(NamedTuple):
@@ -28,31 +118,61 @@ class ModelEntry(NamedTuple):
     version: int
     predictor: CompiledPredictor
     published_unix: float
+    sha256: Optional[str] = None
+    cycle: Optional[int] = None
 
 
 class ModelRegistry:
-    def __init__(self, metrics: Optional[MetricsRegistry] = None) -> None:
+    def __init__(self, metrics: Optional[MetricsRegistry] = None,
+                 provenance: Optional[PublishProvenance] = None) -> None:
         self._entries: Dict[str, ModelEntry] = {}
         self._next_version: Dict[str, int] = {}
         self._lock = threading.Lock()
         self.metrics = metrics
+        #: optional durable publish ledger; publishes carrying a sha256
+        #: are recorded into it (the continuous-learning pipeline
+        #: attaches one so a restarted trainer can recover the serving
+        #: tier's true latest version)
+        self.provenance = provenance
 
     def publish(self, name: str, predictor: CompiledPredictor,
-                version: Optional[int] = None) -> ModelEntry:
+                version: Optional[int] = None,
+                sha256: Optional[str] = None,
+                cycle: Optional[int] = None,
+                force: bool = False) -> ModelEntry:
         """Atomically install ``predictor`` as the live version of
         ``name``.  The predictor should be fully built (and ideally
         warmed) BEFORE publishing — the swap takes effect for the very
-        next request."""
+        next request.
+
+        Versions may never move backward: an explicit ``version`` older
+        than the live one raises :class:`StalePublishError` (equal is
+        allowed — the idempotent re-publish a crashed pipeline retries
+        through).  ``force=True`` bypasses the fence; it exists ONLY for
+        the fleet's rolling-swap rollback, which must converge replicas
+        back onto the manifest version after an aborted rollout."""
         with self._lock:
             if version is None:
                 version = self._next_version.get(name, 0) + 1
+            cur = self._entries.get(name)
+            if not force and cur is not None and int(version) < cur.version:
+                raise StalePublishError(
+                    f"refusing to publish {name!r} version {int(version)} "
+                    f"over live version {cur.version}: the serving tier "
+                    "never regresses (recover the true latest version "
+                    "from publish provenance instead)")
             self._next_version[name] = max(
                 version, self._next_version.get(name, 0))
             replacing = name in self._entries
             entry = ModelEntry(name=name, version=int(version),
                                predictor=predictor,
-                               published_unix=time.time())
+                               published_unix=time.time(),
+                               sha256=sha256,
+                               cycle=None if cycle is None else int(cycle))
             self._entries[name] = entry
+            if self.provenance is not None and sha256 is not None:
+                self.provenance.record(name, int(version), sha256,
+                                       cycle=cycle)
         if replacing:
             count_event("serve_hot_swaps", 1, self.metrics)
             from ..obs.events import emit_event
@@ -85,6 +205,7 @@ class ModelRegistry:
                  "int8": e.predictor.int8,
                  "exact": e.predictor.exact,
                  "fallback": e.predictor._fallback is not None,
+                 "sha256": e.sha256, "cycle": e.cycle,
                  "published_unix": e.published_unix} for e in entries]
 
     def __len__(self) -> int:

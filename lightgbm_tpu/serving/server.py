@@ -107,7 +107,9 @@ class PredictionServer:
     def publish(self, name: str, *, booster=None, model_text: str = None,
                 model_file: str = None, version: Optional[int] = None,
                 int8: bool = False, exact: bool = True,
-                warmup: bool = True) -> ModelEntry:
+                warmup: bool = True, sha256: Optional[str] = None,
+                cycle: Optional[int] = None,
+                force: bool = False) -> ModelEntry:
         """Build, (optionally) warm, then atomically publish a model.
 
         Exactly one of ``booster`` / ``model_text`` / ``model_file``
@@ -131,7 +133,9 @@ class PredictionServer:
         else:
             predictor = CompiledPredictor.from_model_file(model_file, **kw)
         compile_s = predictor.warmup() if warmup else {}
-        entry = self.registry.publish(name, predictor, version=version)
+        entry = self.registry.publish(name, predictor, version=version,
+                                      sha256=sha256, cycle=cycle,
+                                      force=force)
         self._last_compile_s = dict(compile_s)
         return entry
 

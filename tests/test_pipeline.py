@@ -1,0 +1,426 @@
+"""Continuous-learning pipeline tests (lightgbm_tpu/pipeline/).
+
+The contract under test, per docs/ROBUSTNESS.md "Continuous learning":
+
+  * cycle manifest — atomic commits, phase ordering, ack folding;
+  * exactly-once publish — the version is assigned at export commit and
+    a resumed cycle re-publishes the SAME version idempotently;
+  * no-regress serving — ``StalePublishError`` fences both the
+    in-process registry and the fleet manifest, and a trainer whose
+    assigned version fell behind a racing publisher refuses the stale
+    publish instead of regressing the tier;
+  * crash resume — an aborted cycle re-enters the correct phase and the
+    resumed run's published artifacts match an unkilled run's (the
+    byte-level half of this lives in ``fault_drill.py pipeline_kill``);
+  * learning — on a drifting stream, each published version is no worse
+    than its predecessor on current-distribution held-out data.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.obs.events import read_journal
+from lightgbm_tpu.obs.metrics import global_metrics
+from lightgbm_tpu.pipeline import (BOUNDARIES, ContinuousTrainer,
+                                   CycleManifest, ServerTarget,
+                                   portable_model_text, sha256_text)
+from lightgbm_tpu.pipeline.drill import _drift_weights, make_drift_stream
+from lightgbm_tpu.serving import PredictionServer
+from lightgbm_tpu.serving.fleet import FleetRegistry
+from lightgbm_tpu.serving.registry import (PublishProvenance,
+                                           StalePublishError)
+from lightgbm_tpu.utils.log import LightGBMError
+
+TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools")
+sys.path.insert(0, TOOLS)
+
+
+def _params(workdir, tmp, **over):
+    p = {"objective": "binary", "num_leaves": 4, "min_data_in_leaf": 5,
+         "deterministic": True, "seed": 3, "verbosity": -1,
+         "publish_interval": 2, "pipeline_workdir": str(workdir),
+         "checkpoint_interval": 1,
+         "event_output": os.path.join(str(tmp), "journal.jsonl")}
+    p.update(over)
+    return p
+
+
+def _trainer(workdir, tmp, server, X, y, hook=None, **over):
+    return ContinuousTrainer(_params(workdir, tmp, **over), X,
+                             ServerTarget(server), label=y, name="m",
+                             chunk_rows=96, phase_hook=hook)
+
+
+class _Abort(Exception):
+    pass
+
+
+def _abort_at(boundary, cycle):
+    def _hook(b, c):
+        if b == boundary and c == cycle:
+            raise _Abort(f"{b}@{c}")
+    return _hook
+
+
+# ----------------------------------------------------------- cycle manifest
+def test_cycle_manifest_roundtrip(tmp_path):
+    wd = str(tmp_path / "wd")
+    os.makedirs(wd)
+    man = CycleManifest(wd)
+    man.state.update(name="m", rounds_per_cycle=2, chunks_per_cycle=1)
+    man.commit()
+    man.set_phase("ingested", chunks_consumed=1, target_iteration=2)
+    assert man.phase_at_least("ingested")
+    assert not man.phase_at_least("exported")
+    back = CycleManifest.load(wd)
+    assert back is not None
+    assert back.phase == "ingested"
+    assert back.state["target_iteration"] == 2
+    back.ack_cycle({"cycle": 0, "version": 1, "sha256": "x",
+                    "path": "p", "iteration": 2, "chunks_consumed": 1})
+    again = CycleManifest.load(wd)
+    assert again.cycle == 1
+    assert again.phase == "started"
+    assert again.completed_cycles() == 1
+    assert again.last_entry()["version"] == 1
+
+
+def test_cycle_manifest_unreadable_is_none(tmp_path):
+    wd = str(tmp_path / "wd")
+    os.makedirs(wd)
+    with open(os.path.join(wd, "pipeline_manifest.json"), "w") as fh:
+        fh.write("{not json")
+    assert CycleManifest.load(wd) is None
+
+
+def test_boundaries_cover_every_phase():
+    assert BOUNDARIES == ("ingest", "boost", "checkpoint", "export",
+                          "publish")
+
+
+# ------------------------------------------------------- portable exports
+def test_portable_model_text_strips_run_local_params():
+    text = "\n".join([
+        "tree", "Tree=0", "leaf_value=1 2",
+        "parameters:",
+        "[objective: binary]",
+        "[num_iterations: 2]",
+        "[pipeline_workdir: /tmp/xyz]",
+        "[checkpoint_dir: /tmp/xyz/cycles/cycle_0000]",
+        "[event_output: /tmp/xyz/j.jsonl]",
+        "end of parameters", ""])
+    out = portable_model_text(text, num_iterations=4)
+    assert "pipeline_workdir" not in out
+    assert "checkpoint_dir" not in out
+    assert "event_output" not in out
+    assert "[num_iterations: 4]" in out
+    assert "[objective: binary]" in out
+    assert "leaf_value=1 2" in out
+
+
+# -------------------------------------------------------------- provenance
+def test_provenance_ledger_roundtrip(tmp_path):
+    ledger = PublishProvenance(str(tmp_path / "prov.json"))
+    assert ledger.latest("m") is None
+    ledger.record("m", 1, "aaa", cycle=0, path="p0")
+    ledger.record("m", 2, "bbb", cycle=1, path="p1")
+    assert ledger.versions("m") == [1, 2]
+    assert ledger.lookup("m", 1)["sha256"] == "aaa"
+    assert ledger.lookup("m", 9) is None
+    latest = ledger.latest("m")
+    assert latest["version"] == 2 and latest["sha256"] == "bbb"
+    # durable: a fresh handle over the same file sees the same ledger
+    again = PublishProvenance(str(tmp_path / "prov.json"))
+    assert again.versions("m") == [1, 2]
+
+
+# ---------------------------------------------------------- publish fences
+@pytest.fixture(scope="module")
+def model_text():
+    X, y = make_drift_stream(5, 1, 120, 5)
+    p = dict(objective="binary", num_leaves=4, min_data_in_leaf=5,
+             deterministic=True, seed=3, verbosity=-1)
+    booster = lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=2)
+    return booster.model_to_string()
+
+
+def test_registry_refuses_version_regression(model_text):
+    server = PredictionServer(params={})
+    server.publish("m", model_text=model_text, version=2, warmup=False)
+    with pytest.raises(StalePublishError):
+        server.publish("m", model_text=model_text, version=1,
+                       warmup=False)
+    assert server.registry.get("m").version == 2
+    # equal version is the idempotent re-publish a crashed cycle retries
+    server.publish("m", model_text=model_text, version=2, warmup=False)
+    # force= is the rollback-only escape hatch
+    server.publish("m", model_text=model_text, version=1, warmup=False,
+                   force=True)
+    assert server.registry.get("m").version == 1
+
+
+def test_stale_publish_error_is_typed(model_text):
+    assert issubclass(StalePublishError, LightGBMError)
+    server = PredictionServer(params={})
+    server.publish("m", model_text=model_text, version=3, warmup=False)
+    with pytest.raises(LightGBMError):
+        server.publish("m", model_text=model_text, version=2,
+                       warmup=False)
+
+
+def test_fleet_manifest_refuses_version_regression(tmp_path, model_text):
+    reg = FleetRegistry(str(tmp_path / "models"))
+    reg.publish("m", model_text=model_text, version=2)
+    with pytest.raises(StalePublishError):
+        reg.publish("m", model_text=model_text, version=1)
+    assert reg.current("m")["version"] == 2
+    # equal version re-commits idempotently
+    reg.publish("m", model_text=model_text, version=2, sha256="s",
+                cycle=1)
+    assert reg.current("m")["sha256"] == "s"
+
+
+# ------------------------------------------------------- continuous trainer
+def test_trainer_cycles_publish_and_ack(tmp_path):
+    X, y = make_drift_stream(7, 3, 96, 5)
+    wd = str(tmp_path / "wd")
+    server = PredictionServer(params={})
+    done0 = global_metrics.counter("pipeline_cycles_completed")
+    summary = _trainer(wd, tmp_path, server, X, y).run(num_cycles=3)
+    assert summary["cycles_completed"] == 3
+    assert [h["version"] for h in summary["history"]] == [1, 2, 3]
+    assert [h["iteration"] for h in summary["history"]] == [2, 4, 6]
+    assert server.registry.get("m").version == 3
+    assert global_metrics.counter("pipeline_cycles_completed") - done0 == 3
+    # exports hash-verify against both the manifest and the ledger
+    ledger = PublishProvenance(os.path.join(wd, "provenance.json"))
+    for h in summary["history"]:
+        with open(h["path"]) as fh:
+            assert sha256_text(fh.read()) == h["sha256"]
+        assert ledger.lookup("m", h["version"])["sha256"] == h["sha256"]
+    # the journal narrates each cycle in order
+    names = [e["event"] for e in
+             read_journal(os.path.join(str(tmp_path), "journal.jsonl"))]
+    assert names.index("cycle_started") < names.index("cycle_ingested") \
+        < names.index("cycle_published")
+
+
+def test_trainer_stops_when_source_runs_dry(tmp_path):
+    X, y = make_drift_stream(7, 2, 96, 5)
+    server = PredictionServer(params={})
+    summary = _trainer(str(tmp_path / "wd"), tmp_path, server, X, y).run(
+        num_cycles=5)
+    assert summary["cycles_completed"] == 2
+
+
+def test_trainer_requires_workdir():
+    X, y = make_drift_stream(7, 1, 96, 5)
+    with pytest.raises(LightGBMError, match="pipeline_workdir"):
+        ContinuousTrainer({"objective": "binary"}, X,
+                          ServerTarget(PredictionServer(params={})),
+                          label=y)
+
+
+def test_trainer_rejects_foreign_workdir(tmp_path):
+    X, y = make_drift_stream(7, 2, 96, 5)
+    wd = str(tmp_path / "wd")
+    _trainer(wd, tmp_path, PredictionServer(params={}), X, y).run(
+        num_cycles=1)
+    with pytest.raises(LightGBMError, match="different pipeline"):
+        ContinuousTrainer(_params(wd, tmp_path, publish_interval=7), X,
+                          ServerTarget(PredictionServer(params={})),
+                          label=y, name="m",
+                          chunk_rows=96).run(num_cycles=1)
+
+
+@pytest.mark.parametrize("boundary", ["boost", "publish"])
+def test_trainer_abort_resume_completes(tmp_path, boundary):
+    X, y = make_drift_stream(7, 3, 96, 5)
+    wd = str(tmp_path / "wd")
+    with pytest.raises(_Abort):
+        _trainer(wd, tmp_path, PredictionServer(params={}), X, y,
+                 hook=_abort_at(boundary, 1)).run(num_cycles=3)
+    server = PredictionServer(params={})
+    summary = _trainer(wd, tmp_path, server, X, y).run(num_cycles=3)
+    assert summary["cycles_completed"] == 3
+    assert [h["version"] for h in summary["history"]] == [1, 2, 3]
+    assert server.registry.get("m").version == 3
+    names = [e["event"] for e in
+             read_journal(os.path.join(str(tmp_path), "journal.jsonl"))]
+    assert "cycle_resumed" in names
+    # exactly-once: each version published exactly once across both runs
+    published = [e["payload"]["version"] for e in
+                 read_journal(os.path.join(str(tmp_path), "journal.jsonl"))
+                 if e["event"] == "cycle_published"]
+    assert published == [1, 2, 3]
+
+
+def test_recovery_reseeds_fresh_server(tmp_path):
+    X, y = make_drift_stream(7, 2, 96, 5)
+    wd = str(tmp_path / "wd")
+    _trainer(wd, tmp_path, PredictionServer(params={}), X, y).run(
+        num_cycles=2)
+    # the first server died with its process; a restarted pipeline must
+    # bring a FRESH server to the ledger's latest version before cycling
+    server = PredictionServer(params={})
+    _trainer(wd, tmp_path, server, X, y).run(num_cycles=2)
+    entry = server.registry.get("m")
+    assert entry.version == 2
+    ledger = PublishProvenance(os.path.join(wd, "provenance.json"))
+    assert entry.sha256 == ledger.latest("m")["sha256"]
+
+
+def test_stale_publish_refused_not_regressed(tmp_path):
+    X, y = make_drift_stream(7, 2, 96, 5)
+    wd = str(tmp_path / "wd")
+    server = PredictionServer(params={})
+    # die right after cycle 0's export committed version 1 ...
+    with pytest.raises(_Abort):
+        _trainer(wd, tmp_path, server, X, y,
+                 hook=_abort_at("export", 0)).run(num_cycles=2)
+    # ... then an external publisher races the tier to version 9
+    exp = os.path.join(wd, "exports", "cycle_0000.txt")
+    server.publish("m", model_file=exp, version=9, warmup=False)
+    refused0 = global_metrics.counter("pipeline_stale_publishes_refused")
+    summary = _trainer(wd, tmp_path, server, X, y).run(num_cycles=2)
+    # cycle 0 acks WITHOUT publishing (regression forbidden); cycle 1
+    # re-assigns past the live version instead of reusing 2
+    assert summary["cycles_completed"] == 2
+    assert global_metrics.counter(
+        "pipeline_stale_publishes_refused") - refused0 >= 1
+    assert server.registry.get("m").version == 10
+    names = [e["event"] for e in
+             read_journal(os.path.join(str(tmp_path), "journal.jsonl"))]
+    assert "publish_skipped_stale" in names
+
+
+# ------------------------------------------------------- drifting learning
+def _auc(scores, labels):
+    order = np.argsort(scores, kind="mergesort")
+    ranks = np.empty(len(scores))
+    ranks[order] = np.arange(1, len(scores) + 1)
+    pos = labels > 0.5
+    npos, nneg = int(pos.sum()), int((~pos).sum())
+    assert npos and nneg
+    return (ranks[pos].sum() - npos * (npos + 1) / 2) / (npos * nneg)
+
+
+def _current_distribution_holdout(chunk_i, n_chunks, rows, nfeat, seed):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(rows, nfeat))
+    w = _drift_weights(chunk_i, n_chunks, nfeat)
+    logit = X @ w + 0.25 * np.sin(3.0 * X[:, 0])
+    y = (rng.random(rows) < 1.0 / (1.0 + np.exp(-logit))).astype(
+        np.float64)
+    return X, y
+
+
+def test_published_versions_improve_on_drifting_stream(tmp_path):
+    """Each published version's AUC on held-out CURRENT-distribution
+    data is no worse than its predecessor's (within tolerance): the
+    pipeline keeps learning as the stream drifts."""
+    n_chunks, rows, nfeat = 3, 300, 5
+    X, y = make_drift_stream(21, n_chunks, rows, nfeat)
+    server = PredictionServer(params={})
+    summary = _trainer(
+        str(tmp_path / "wd"), tmp_path, server, X, y,
+        publish_interval=8, num_leaves=7, learning_rate=0.2,
+        min_data_in_leaf=10).run(num_cycles=n_chunks)
+    assert summary["cycles_completed"] == n_chunks
+    boosters = {}
+    for h in summary["history"]:
+        with open(h["path"]) as fh:
+            boosters[h["version"]] = lgb.Booster(model_str=fh.read())
+    aucs = []
+    for c in range(1, n_chunks):
+        hx, hy = _current_distribution_holdout(c, n_chunks, 800, nfeat,
+                                               seed=777 + c)
+        prev = _auc(boosters[c].predict(hx), hy)
+        cur = _auc(boosters[c + 1].predict(hx), hy)
+        aucs.append((prev, cur))
+        assert cur >= prev - 0.03, (
+            f"version {c + 1} regressed on chunk {c}'s distribution: "
+            f"{cur:.4f} < {prev:.4f} - tol")
+    # the stream is learnable at all (the last distribution is the
+    # hardest: the pooled training set is dominated by pre-drift chunks)
+    assert all(cur > 0.55 for _, cur in aucs)
+
+
+# ----------------------------------------------------------------- tooling
+def test_checkpoint_inspect_verifies_cycle_chain(tmp_path):
+    import checkpoint_inspect
+    X, y = make_drift_stream(7, 2, 96, 5)
+    wd = str(tmp_path / "wd")
+    _trainer(wd, tmp_path, PredictionServer(params={}), X, y).run(
+        num_cycles=2)
+    rep = checkpoint_inspect.build_pipeline_report(wd)
+    assert rep["all_valid"] and len(rep["cycles"]) == 2
+    assert checkpoint_inspect.main([wd, "--verify-all",
+                                    "--format", "json"]) == 0
+    # tear cycle 1's export: the chain must flag it and exit 1
+    with open(os.path.join(wd, "exports", "cycle_0001.txt"), "a") as fh:
+        fh.write("tamper\n")
+    rep = checkpoint_inspect.build_pipeline_report(wd)
+    assert not rep["all_valid"]
+    assert any("cycle 1" in f for f in rep["findings"])
+    assert checkpoint_inspect.main([wd, "--verify-all",
+                                    "--format", "json"]) == 1
+
+
+def test_run_report_pipeline_section(tmp_path, capsys):
+    import run_report
+    X, y = make_drift_stream(7, 2, 96, 5)
+    wd = str(tmp_path / "wd")
+    _trainer(wd, tmp_path, PredictionServer(params={}), X, y).run(
+        num_cycles=2)
+    ev = os.path.join(str(tmp_path), "journal.jsonl")
+    events = read_journal(ev)
+    stats = run_report.pipeline_stats(events)
+    assert stats["cycles_completed"] == 2
+    assert not stats["unfinished"]
+    assert stats["hot_swaps"] >= 1
+    assert all(c["publish_latency_s"] is not None
+               for c in stats["cycles"])
+    # an unfinished cycle drives the --quick gate to exit 1
+    events.append({"event": "cycle_started", "payload": {"cycle": 7},
+                   "unix_time": 1.0})
+    stats = run_report.pipeline_stats(events)
+    assert stats["unfinished"] and stats["unfinished_cycles"] == [7]
+    rc = run_report.main(["--events", ev, "--quick", "--format", "json"])
+    capsys.readouterr()
+    assert rc == 0          # the on-disk journal itself is complete
+
+
+def test_pipeline_drill_child_driver(tmp_path):
+    """One `python -m lightgbm_tpu.pipeline.drill` lifetime: spec in,
+    summary JSON out, client hammer log written, zero failures."""
+    td = str(tmp_path)
+    wd = os.path.join(td, "wd")
+    spec = {"seed": 11, "num_chunks": 1, "rows_per_chunk": 96,
+            "num_features": 5, "name": "pipe", "num_cycles": 1,
+            "chunks_per_cycle": 1,
+            "client_log": os.path.join(td, "client.jsonl"),
+            "params": {"objective": "binary", "num_leaves": 4,
+                       "min_data_in_leaf": 5, "deterministic": True,
+                       "seed": 3, "verbosity": -1, "publish_interval": 2,
+                       "checkpoint_interval": 1, "pipeline_workdir": wd,
+                       "event_output": os.path.join(td, "ev.jsonl")}}
+    spath = os.path.join(td, "spec.json")
+    with open(spath, "w") as fh:
+        json.dump(spec, fh)
+    proc = subprocess.run(
+        [sys.executable, "-m", "lightgbm_tpu.pipeline.drill", spath],
+        capture_output=True, text=True, timeout=240)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    summary = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert summary["cycles_completed"] == 1
+    obs = [json.loads(line) for line in
+           open(os.path.join(td, "client.jsonl"))]
+    assert obs and all(o["ok"] for o in obs)

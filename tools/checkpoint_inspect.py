@@ -22,6 +22,16 @@ newest.  An eviction-triggered resume falls back through the chain when
 the newest checkpoint is corrupt, so a rotting older checkpoint is a
 latent recovery failure even while normal resumes still succeed — with
 ``--verify-all`` any invalid checkpoint exits 2.
+
+Pointed at a continuous-learning PIPELINE workdir (a directory holding
+``pipeline_manifest.json``, pipeline/cycle.py) the tool switches to
+cycle-chain verification: every acked cycle's checkpoint -> export ->
+publish sha256 chain must hold — the export file on disk hashes to the
+manifest's recorded sha, the publish-provenance ledger names the same
+sha for the same version, versions run 1..N with no gaps — and the
+in-flight cycle's committed artifacts (its export record, its per-cycle
+checkpoint directory) must validate too.  Any broken link is a TORN
+cycle: exit 1.
 """
 
 from __future__ import annotations
@@ -70,6 +80,106 @@ def build_report(directory: str) -> Dict[str, Any]:
     }
 
 
+def build_pipeline_report(workdir: str) -> Dict[str, Any]:
+    """Cycle-chain verification payload for a pipeline workdir.
+
+    Each acked cycle contributes one entry with the per-link verdicts;
+    ``findings`` collects every broken link (a torn cycle).  The
+    in-flight cycle is checked for whatever it has durably committed.
+    """
+    import json
+
+    from lightgbm_tpu.pipeline.cycle import (MANIFEST_NAME, CycleManifest,
+                                             sha256_text)
+    from lightgbm_tpu.serving.registry import PublishProvenance
+    man = CycleManifest.load(workdir)
+    findings: list = []
+    if man is None:
+        return {"tool": "checkpoint_inspect", "mode": "pipeline",
+                "directory": workdir, "cycles": [], "all_valid": False,
+                "findings": [f"unreadable {MANIFEST_NAME} under {workdir}"]}
+    prov = PublishProvenance(os.path.join(workdir, "provenance.json"))
+    name = man.state.get("name", "")
+
+    def _export_sha(path: str):
+        try:
+            with open(path) as fh:
+                return sha256_text(fh.read()), None
+        except OSError as e:
+            return None, f"{type(e).__name__}: {e}"
+
+    entries = []
+    expect_version = 1
+    for h in man.state.get("history", []):
+        c, v = int(h["cycle"]), int(h["version"])
+        got_sha, err = _export_sha(h["path"])
+        ledger = prov.lookup(name, v)
+        entry = {
+            "cycle": c, "version": v, "iteration": h.get("iteration"),
+            "export_readable": err is None,
+            "export_sha_matches": got_sha == h["sha256"],
+            "ledger_recorded": ledger is not None,
+            "ledger_sha_matches": bool(ledger)
+            and ledger.get("sha256") == h["sha256"],
+            "version_in_sequence": v == expect_version,
+        }
+        entry["valid"] = all(entry[k] for k in
+                             ("export_readable", "export_sha_matches",
+                              "ledger_recorded", "ledger_sha_matches",
+                              "version_in_sequence"))
+        if not entry["valid"]:
+            bad = [k for k in ("export_readable", "export_sha_matches",
+                               "ledger_recorded", "ledger_sha_matches",
+                               "version_in_sequence") if not entry[k]]
+            findings.append(f"cycle {c} (version {v}) torn: "
+                            + ", ".join(bad) + (f" [{err}]" if err else ""))
+        entries.append(entry)
+        expect_version = v + 1
+
+    current: Dict[str, Any] = {"cycle": man.cycle, "phase": man.phase}
+    exp = man.state.get("export")
+    if exp:
+        got_sha, err = _export_sha(exp["path"])
+        current["export_sha_matches"] = got_sha == exp["sha256"]
+        if not current["export_sha_matches"]:
+            findings.append(
+                f"in-flight cycle {man.cycle}: committed export torn"
+                + (f" [{err}]" if err else ""))
+    if man.state.get("model_sha256") and exp and \
+            exp["sha256"] != man.state["model_sha256"]:
+        findings.append(f"in-flight cycle {man.cycle}: export sha differs "
+                        "from the checkpointed model sha")
+    ckpt_dir = os.path.join(workdir, "cycles", f"cycle_{man.cycle:04d}")
+    if os.path.isdir(ckpt_dir):
+        dirs = checkpoint_dirs(ckpt_dir)
+        if dirs:
+            ok, reason = validate_checkpoint(dirs[0][1])
+            current["newest_checkpoint_valid"] = ok
+            if not ok:
+                findings.append(f"in-flight cycle {man.cycle}: newest "
+                                f"checkpoint invalid ({reason})")
+    return {"tool": "checkpoint_inspect", "mode": "pipeline",
+            "directory": workdir, "name": name, "cycles": entries,
+            "current": current, "findings": findings,
+            "all_valid": not findings}
+
+
+def _render_pipeline(payload: Dict[str, Any]) -> str:
+    lines = [f"pipeline workdir {payload['directory']} "
+             f"(model {payload.get('name', '?')!r})"]
+    for e in payload["cycles"]:
+        verdict = "OK" if e["valid"] else "TORN"
+        lines.append(f"cycle={e['cycle']:<4d} version={e['version']:<4d} "
+                     f"iter={e['iteration']!s:>5}  {verdict}")
+    cur = payload.get("current") or {}
+    lines.append(f"in-flight: cycle={cur.get('cycle')} "
+                 f"phase={cur.get('phase')}")
+    for f in payload["findings"]:
+        lines.append(f"  FINDING: {f}")
+    lines.append("chain: " + ("OK" if payload["all_valid"] else "TORN"))
+    return "\n".join(lines)
+
+
 def _render_report(payload: Dict[str, Any]) -> str:
     entries = payload["checkpoints"]
     if not entries:
@@ -112,8 +222,13 @@ def main(argv=None) -> int:
                          "output is one report object now, no longer "
                          "one JSON line per checkpoint)")
     args = ap.parse_args(argv)
-    payload = build_report(args.checkpoint_dir)
     fmt = "json" if args.json else args.format
+    if os.path.exists(os.path.join(args.checkpoint_dir,
+                                   "pipeline_manifest.json")):
+        payload = build_pipeline_report(args.checkpoint_dir)
+        emit(payload, fmt, _render_pipeline)
+        return EXIT_OK if payload["all_valid"] else EXIT_FINDINGS
+    payload = build_report(args.checkpoint_dir)
     emit(payload, fmt, _render_report)
     return exit_code(payload, verify_all=args.verify_all)
 

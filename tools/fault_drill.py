@@ -13,8 +13,8 @@ size AND to the serial learner, and every checkpoint manifest in the
 chain the resume walked must sha256-validate
 (tools/checkpoint_inspect.py ``--verify-all`` semantics).
 
-Scenarios (``--quick`` runs the first training one AND the first
-serving one — together the tier-1 CI gate):
+Scenarios (``--quick`` runs the first training one, the first serving
+one AND the pipeline kill chain — together the tier-1 CI gate):
 
   kill        worker killed mid-run -> heartbeat silence -> eviction ->
               mesh reshape -> checkpoint resume -> bit-identity verify
@@ -45,6 +45,28 @@ Serving-fleet scenarios (serving/fleet.py, PR 12):
                     aborts (``rolling_swap_aborted``), already-swapped
                     replicas roll back, every response carries exactly
                     one model version, fleet converges on the OLD one
+
+Continuous-learning pipeline scenarios (pipeline/, PR 15):
+
+  pipeline_kill       one workdir, a CHAIN of trainer processes: run i
+                      is SIGKILLed (by itself, robustness/faults.py
+                      ``pipeline_kill_hook``) the instant boundary i of
+                      cycle 0 commits — ingest, boost, checkpoint,
+                      export, publish — each successor resumes from the
+                      cycle manifest, and the final run completes every
+                      cycle.  Verified from durable artifacts: exports
+                      bit-identical to an unkilled reference run, the
+                      provenance version sequence 1..C with no gaps or
+                      regressions, ZERO failed client requests across
+                      every lifetime, the journal narrating each resume,
+                      and the full checkpoint->export->publish sha chain
+                      (checkpoint_inspect cycle mode).  Part of --quick.
+  pipeline_swap_abort mid-rollout replica death while the PIPELINE is
+                      publishing a cycle to a fleet -> rollout aborts,
+                      the fence rolls the fleet back, and the SAME cycle
+                      retries the SAME version after the fleet heals
+                      (``pipeline_publish_retries``) — never skipping
+                      forward
 
 Exit codes (tools/_report.py convention):
   0 — every scenario passed
@@ -583,6 +605,215 @@ def scenario_serve_swap_abort(X, y):
             "passed": all(checks.values())}
 
 
+# ------------------------------------------------- continuous pipeline
+#: tiny deterministic continuation config for the pipeline drills: 2
+#: rounds per cycle, checkpoint every round, 3 chunks of 96 rows
+_PIPE_PARAMS = dict(objective="binary", num_leaves=4, min_data_in_leaf=5,
+                    deterministic=True, seed=3, verbosity=-1,
+                    publish_interval=2, checkpoint_interval=1)
+_PIPE_CYCLES = 3
+
+
+def _pipeline_spec(td: str, workdir: str, kill=None) -> Dict[str, Any]:
+    spec: Dict[str, Any] = {
+        "seed": 11, "num_chunks": _PIPE_CYCLES, "rows_per_chunk": 96,
+        "num_features": 5, "name": "pipe", "num_cycles": _PIPE_CYCLES,
+        "chunks_per_cycle": 1,
+        "client_log": os.path.join(td, "client.jsonl"),
+        "params": dict(_PIPE_PARAMS, pipeline_workdir=workdir,
+                       event_output=os.path.join(td, "pipe_events.jsonl")),
+    }
+    if kill is not None:
+        spec["kill"] = kill
+    return spec
+
+
+def _pipeline_child(td: str, i: int, spec: Dict[str, Any]):
+    """One trainer lifetime as a real OS process (so the armed SIGKILL
+    is a true no-cleanup crash).  Returns (returncode, stdout)."""
+    import json
+    import subprocess
+    spath = os.path.join(td, f"spec_{i}.json")
+    with open(spath, "w") as fh:
+        json.dump(spec, fh)
+    proc = subprocess.run(
+        [sys.executable, "-m", "lightgbm_tpu.pipeline.drill", spath],
+        capture_output=True, text=True, timeout=300)
+    return proc.returncode, proc.stdout
+
+
+def _client_observations(path: str):
+    """Parse the hammer log, skipping a final line torn by the SIGKILL
+    (a half-written record is evidence of the crash, not of a failed
+    request)."""
+    import json
+    obs = []
+    if os.path.exists(path):
+        with open(path) as fh:
+            for line in fh:
+                try:
+                    obs.append(json.loads(line))
+                except ValueError:
+                    continue
+    return obs
+
+
+def _published_versions(events) -> List[int]:
+    return [int((e.get("payload") or {}).get("version", -1))
+            for e in events if e.get("event") == "cycle_published"]
+
+
+def scenario_pipeline_kill():
+    import json
+    import signal
+
+    import checkpoint_inspect
+    from lightgbm_tpu.obs.events import journal_tail, read_journal
+    from lightgbm_tpu.pipeline import BOUNDARIES
+    from lightgbm_tpu.pipeline.drill import run_spec
+    boundaries_hit: List[Dict[str, Any]] = []
+    with tempfile.TemporaryDirectory() as td:
+        wd = os.path.join(td, "wd")
+        # the kill chain: run i nukes itself at boundary i of cycle 0,
+        # its successor resumes from the manifest and dies at the next
+        # boundary; the last run finishes every cycle
+        for i, boundary in enumerate(BOUNDARIES):
+            rc, _ = _pipeline_child(
+                td, i, _pipeline_spec(td, wd,
+                                      kill={"boundary": boundary,
+                                            "cycle": 0}))
+            boundaries_hit.append({"boundary": boundary, "rc": rc,
+                                   "sigkilled": rc == -signal.SIGKILL})
+        rc, out = _pipeline_child(td, len(BOUNDARIES),
+                                  _pipeline_spec(td, wd))
+        summary = {}
+        if rc == 0 and out.strip():
+            summary = json.loads(out.strip().splitlines()[-1])
+        # the unkilled reference: same spec, fresh workdir, in-process
+        ref_td = os.path.join(td, "ref")
+        os.makedirs(ref_td)
+        ref_wd = os.path.join(ref_td, "wd")
+        ref_spec = _pipeline_spec(ref_td, ref_wd)
+        ref_spec.pop("client_log")
+        run_spec(ref_spec)
+
+        def _export(base, c):
+            p = os.path.join(base, "exports", f"cycle_{c:04d}.txt")
+            with open(p) as fh:
+                return fh.read()
+        bit_identical = all(
+            _export(wd, c) == _export(ref_wd, c)
+            for c in range(_PIPE_CYCLES))
+        prov = json.load(open(os.path.join(wd, "provenance.json")))
+        versions = sorted(int(v) for v in
+                          (prov.get("models", {}).get("pipe") or {}))
+        obs = _client_observations(os.path.join(td, "client.jsonl"))
+        client_errs = [o for o in obs if not o.get("ok")]
+        served = [int(o["version"]) for o in obs if o.get("ok")]
+        ev_path = os.path.join(td, "pipe_events.jsonl")
+        events = read_journal(ev_path)
+        names = [e.get("event") for e in events]
+        tail = journal_tail(ev_path)
+        chain = checkpoint_inspect.build_pipeline_report(wd)
+    want = list(range(1, _PIPE_CYCLES + 1))
+    checks = {
+        # (every armed run must die by ITS OWN SIGKILL, not exit)
+        "killed_at_every_boundary":
+            all(b["sigkilled"] for b in boundaries_hit),
+        "resume_completed_all_cycles": rc == 0
+        and summary.get("cycles_completed") == _PIPE_CYCLES,
+        # (a): resumed lineage's exports == unkilled run's, bit-for-bit
+        "bit_identical_exports": bit_identical,
+        # (b): version sequence strictly monotone, no gaps/regressions
+        "versions_monotone_no_gaps": versions == want
+        and _published_versions(events) == want
+        and served == sorted(served),
+        # (c): zero client requests failed across every lifetime
+        "zero_failed_requests": not client_errs and bool(served),
+        "journal_narrates_resumes":
+            names.count("cycle_resumed") >= len(BOUNDARIES)
+        and names.index("cycle_started") < names.index("cycle_ingested")
+        < names.index("cycle_published"),
+        "cycle_chain_valid": bool(chain["all_valid"]),
+    }
+    return {"name": "pipeline_kill", "boundaries": boundaries_hit,
+            "cycles": summary.get("cycles_completed"),
+            "versions": versions, "client_requests": len(obs),
+            "client_errors": [o.get("error") for o in client_errs[:5]],
+            "checks": checks, "journal_tail": tail,
+            "watchtower": _watchtower_summary(tail),
+            "passed": all(checks.values())}
+
+
+def scenario_pipeline_swap_abort():
+    import json
+
+    from lightgbm_tpu.obs.events import journal_tail, read_journal
+    from lightgbm_tpu.obs.metrics import global_metrics
+    from lightgbm_tpu.pipeline import ContinuousTrainer, FleetTarget
+    from lightgbm_tpu.pipeline.drill import make_drift_stream
+    from lightgbm_tpu.robustness.faults import kill_replica
+    from lightgbm_tpu.serving import FleetServer
+    Xs, ys = make_drift_stream(13, 2, 96, 5)
+    retries0 = global_metrics.counter("pipeline_publish_retries")
+    killed = {"done": False}
+    with tempfile.TemporaryDirectory() as td:
+        ev = os.path.join(td, "pipe_events.jsonl")
+        wd = os.path.join(td, "wd")
+        fleet = FleetServer(dict(_SERVE_PARAMS, event_output=ev),
+                            workdir=td)
+        try:
+            def _mid_swap_kill(slot: int) -> None:
+                if slot == 0 and not killed["done"]:
+                    killed["done"] = True
+                    fleet.inject(kill_replica(2))
+
+            # cycle 0's publish is the initial (non-rolling) rollout;
+            # arm the mid-swap kill only once cycle 1's export commits,
+            # so it lands inside cycle 1's ROLLING publish of version 2
+            def _arm(boundary: str, cycle: int) -> None:
+                if boundary == "export" and cycle == 1:
+                    fleet.swap_fault_hook = _mid_swap_kill
+
+            trainer = ContinuousTrainer(
+                dict(_PIPE_PARAMS, pipeline_workdir=wd, event_output=ev,
+                     publish_retry_budget=2),
+                Xs, FleetTarget(fleet), label=ys, name="pipe",
+                chunk_rows=96, phase_hook=_arm)
+            summary = trainer.run(num_cycles=2)
+            fleet.swap_fault_hook = None
+            live = fleet.replica_versions()
+            manifest = fleet.registry.current("pipe")
+        finally:
+            fleet.close()
+        retries = global_metrics.counter(
+            "pipeline_publish_retries") - retries0
+        prov = json.load(open(os.path.join(wd, "provenance.json")))
+        versions = sorted(int(v) for v in
+                          (prov.get("models", {}).get("pipe") or {}))
+        events = read_journal(ev)
+        names = [e.get("event") for e in events]
+        tail = journal_tail(ev)
+    checks = {
+        "mid_swap_kill_fired": killed["done"],
+        "rollout_aborted": "rolling_swap_aborted" in names
+        and retries >= 1,
+        # the SAME cycle retried the SAME version: exactly versions 1,2
+        # were ever assigned, and cycle 1 still published as version 2
+        "same_cycle_same_version_retried": versions == [1, 2]
+        and _published_versions(events) == [1, 2]
+        and summary["cycles_completed"] == 2,
+        "fleet_converged_on_new_version":
+            manifest is not None and int(manifest["version"]) == 2
+        and bool(live) and all(m.get("pipe") == 2 for m in live.values()),
+    }
+    return {"name": "pipeline_swap_abort", "checks": checks,
+            "publish_retries": int(retries), "versions": versions,
+            "journal_tail": tail,
+            "watchtower": _watchtower_summary(tail),
+            "passed": all(checks.values())}
+
+
 def run_drill(quick: bool, rounds: int, workers: int) -> Dict[str, Any]:
     X, y = _data()
     scenarios: List[Dict[str, Any]] = [scenario_kill(X, y, rounds, workers)]
@@ -598,6 +829,12 @@ def run_drill(quick: bool, rounds: int, workers: int) -> Dict[str, Any]:
     if not quick:
         scenarios.append(scenario_serve_stall(X, y))
         scenarios.append(scenario_serve_swap_abort(X, y))
+    # the pipeline crash-safety gate: the SIGKILL-at-every-boundary
+    # chain is part of --quick (tier-1); the fleet swap-abort pipeline
+    # drill rides the full run
+    scenarios.append(scenario_pipeline_kill())
+    if not quick:
+        scenarios.append(scenario_pipeline_swap_abort())
     return {"tool": "fault_drill", "mode": "quick" if quick else "full",
             "rounds": rounds, "workers": workers,
             "scenarios": scenarios,
@@ -636,7 +873,8 @@ def _render(payload: Dict[str, Any]) -> str:
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--quick", action="store_true",
-                    help="kill scenario only (tier-1 CI gate)")
+                    help="kill + serve_kill + pipeline_kill scenarios "
+                         "only (tier-1 CI gate)")
     ap.add_argument("--rounds", type=int, default=8)
     ap.add_argument("--workers", type=int, default=4)
     add_format_arg(ap)

@@ -12,6 +12,13 @@ single report: top phases, the event timeline, the final counter
 snapshot with the compile-cache and collective-overlap columns pulled
 out.  Any subset of the artifacts may be given; at least one must be.
 
+A journal carrying continuous-learning records (pipeline/trainer.py)
+additionally gets a pipeline section joining the trainer's cycle events
+with the serving tier's hot-swap events: cycles completed, per-cycle
+publish latency, resumes — and a cycle that started but never published
+is a finding (``--quick`` exits 1: the workdir holds an unfinished,
+resumable cycle).
+
 ``--quick`` is the CI gate mode: it only validates that every provided
 artifact parses and carries its expected schema (trace has span
 events, journal has records, telemetry has rows) and reports findings
@@ -131,6 +138,59 @@ def ingest_stats(events: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
     }
 
 
+def pipeline_stats(events: List[Dict[str, Any]]) \
+        -> Optional[Dict[str, Any]]:
+    """Replay continuous-learning records (pipeline/trainer.py) into a
+    cycle ledger, joining the trainer's side of the journal
+    (``cycle_started`` .. ``cycle_published``) with the serving side
+    (``serve_hot_swap``) the same publishes produced.
+
+    ``None`` when the journal holds no pipeline events.  A cycle that
+    started but never published (nor was refused as stale) is the
+    CI-gate signal — the pipeline workdir holds an unfinished cycle.
+    Latencies are wall-clock (``unix_time``), not ``t_mono``, because a
+    resumed cycle's records span trainer processes."""
+    started: Dict[int, Any] = {}
+    published: Dict[int, Dict[str, Any]] = {}
+    resumes = stale = swaps = 0
+    for rec in events:
+        name = rec.get("event")
+        payload = rec.get("payload") or {}
+        if not isinstance(payload, dict):
+            payload = {}
+        c = payload.get("cycle")
+        if name == "cycle_started" and c is not None:
+            started.setdefault(int(c), rec.get("unix_time"))
+        elif name == "cycle_resumed":
+            resumes += 1
+        elif name == "serve_hot_swap":
+            swaps += 1
+        elif name == "cycle_published" and c is not None:
+            published[int(c)] = {"version": payload.get("version"),
+                                 "t": rec.get("unix_time")}
+        elif name == "publish_skipped_stale" and c is not None:
+            stale += 1
+            published.setdefault(int(c), {
+                "version": payload.get("version"),
+                "t": rec.get("unix_time"), "stale": True})
+    if not (started or published or resumes):
+        return None
+    cycles = []
+    for c in sorted(published):
+        t0, t1 = started.get(c), published[c].get("t")
+        lat = round(t1 - t0, 6) if t0 and t1 and t1 >= t0 else None
+        cycles.append({"cycle": c, "version": published[c].get("version"),
+                       "publish_latency_s": lat,
+                       "stale_skipped": bool(published[c].get("stale"))})
+    unfinished = sorted(set(started) - set(published))
+    return {
+        "cycles_completed": len(published), "resumes": resumes,
+        "stale_publishes_refused": stale, "hot_swaps": swaps,
+        "cycles": cycles, "unfinished_cycles": unfinished,
+        "unfinished": bool(unfinished),
+    }
+
+
 def load_telemetry(path: str) -> List[Dict[str, Any]]:
     """Telemetry JSONL rows (one per round); torn lines are skipped."""
     rows: List[Dict[str, Any]] = []
@@ -220,6 +280,15 @@ def build_report(trace_doc: Optional[Dict[str, Any]],
                 findings.append(
                     "streaming ingest started but never completed — the "
                     "dataset in its workdir is partial (resumable)")
+        pipe = pipeline_stats(events)
+        if pipe is not None:
+            payload["pipeline"] = pipe
+            if pipe["unfinished"]:
+                findings.append(
+                    "continuous-learning cycle(s) "
+                    + ", ".join(str(c) for c in pipe["unfinished_cycles"])
+                    + " started but never published — the pipeline "
+                    "workdir holds an unfinished cycle (resumable)")
     if telemetry is not None:
         if not telemetry:
             findings.append("telemetry stream holds no rows")
@@ -272,6 +341,23 @@ def _render_report(payload: Dict[str, Any]) -> str:
         if ingest.get("rows") is not None:
             lines.append(f"  rows: {ingest['rows']}  features: "
                          f"{ingest.get('features')}")
+    pipe = payload.get("pipeline")
+    if pipe is not None:
+        lines.append("")
+        state = "UNFINISHED" if pipe["unfinished"] else "complete"
+        lines.append(f"continuous pipeline: {state} "
+                     f"({pipe['cycles_completed']} cycle(s) published, "
+                     f"{pipe['resumes']} resume(s), "
+                     f"{pipe['hot_swaps']} hot swap(s))")
+        for c in pipe.get("cycles", []):
+            lat = c.get("publish_latency_s")
+            lat_s = f"{lat:.3f}s" if lat is not None else "?"
+            note = "  STALE-SKIPPED" if c.get("stale_skipped") else ""
+            lines.append(f"  cycle {c['cycle']}: version {c['version']} "
+                         f"published after {lat_s}{note}")
+        if pipe.get("stale_publishes_refused"):
+            lines.append(f"  stale publishes refused: "
+                         f"{pipe['stale_publishes_refused']}")
     tel = payload.get("telemetry")
     if tel is not None:
         lines.append("")
