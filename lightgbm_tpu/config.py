@@ -282,6 +282,10 @@ _PARAMS: List[Tuple[str, Any, Tuple[str, ...], Tuple[Tuple[str, float], ...]]] =
     ("serving_retry_budget", 2, (), ((">=", 0),)),  # fleet router failover bound: a request whose replica dies or misses its sub-deadline is transparently re-dispatched to a surviving replica at most this many times (request_failover journal events + fleet_request_failovers counter); 0 = no failover, first error surfaces
     ("fleet_heartbeat_interval_s", 0.5, (), ((">", 0.0),)),  # serving-replica liveness: seconds between a replica's heartbeat markers (same file substrate as training heartbeats, robustness/elastic.py; faster default than heartbeat_interval_s because serving replicas beat on wall time, not boosting rounds)
     ("fleet_heartbeat_timeout_s", 3.0, (), ((">", 0.0),)),   # serving-replica liveness: a replica silent past this is DEAD — evicted from the routing table, killed, respawned and re-warmed from the fleet manifest before it rejoins; staleness between ~2x fleet_heartbeat_interval_s and this marks it SUSPECT (deprioritized, not evicted)
+    ("aot_store", "", (), ()),                      # disk-backed ahead-of-time executable store directory (ops/aot_store.py): serving predictors DESERIALIZE previously compiled bucket programs from it (zero XLA lowerings on warm) and persist fresh ones for later processes; "" = off for a standalone PredictionServer, while a FleetServer defaults its store to <workdir>/models/aot_store next to the fleet manifest and a ContinuousTrainer to <pipeline_workdir>/aot_store ("off" disables even those defaults); artifacts carry a backend/jax-version/device-topology fingerprint — stale or corrupt entries are evicted and rebuilt live, never loaded, and an unwritable path degrades to a warning (utils/paths.py probe)
+    ("serving_autoscale", "off", (), ()),           # SLO-driven fleet elasticity: off|on (serving/fleet.py monitor): "on" lets watchtower breach/recover transitions on the serving SLOs (obs/slo.py serving_p99_ms / serving_error_rate over rollup windows) spawn replica slots up to serving_replicas_max under load and retire them back to serving_replicas_min after recovery — retirement drains the replica out of rotation first, so clients never see a failed request from a scale-down; enabling this without slo_config activates the serving SLOs at their default budgets
+    ("serving_replicas_min", 0, (), ((">=", 0),)),  # autoscale floor on live replica slots (serving/fleet.py); 0 (default) = follow serving_replicas
+    ("serving_replicas_max", 0, (), ((">=", 0),)),  # autoscale ceiling on live replica slots (serving/fleet.py); 0 (default) = follow serving_replicas; must be >= serving_replicas_min when both are explicit
 ]
 
 # Reference-LightGBM parameters this port ACCEPTS but never reads: they
@@ -528,6 +532,20 @@ class Config:
                 f"must be >= fleet_heartbeat_interval_s="
                 f"{self.fleet_heartbeat_interval_s} (a replica cannot be "
                 "declared dead faster than it is expected to beat)")
+        self.serving_autoscale = \
+            str(self.serving_autoscale or "off").strip().lower()
+        if self.serving_autoscale not in ("on", "off"):
+            log.fatal(f"unknown serving_autoscale="
+                      f"{self.serving_autoscale!r} (expected on/off)")
+        if int(self.serving_replicas_min) > 0 and \
+                int(self.serving_replicas_max) > 0 and \
+                int(self.serving_replicas_min) > \
+                int(self.serving_replicas_max):
+            log.fatal(
+                f"serving_replicas_min={self.serving_replicas_min} must "
+                f"be <= serving_replicas_max="
+                f"{self.serving_replicas_max} (the autoscale floor "
+                "cannot exceed the ceiling)")
         if not self.serving_buckets or \
                 any(int(b) <= 0 for b in self.serving_buckets):
             log.fatal(f"serving_buckets must be a non-empty list of positive "

@@ -159,6 +159,19 @@ EVENTS: Dict[str, Tuple[str, str]] = {
         "warning", "a resumed cycle's export-assigned version is no "
                    "longer ahead of the live serving tier; the publish "
                    "was refused — the tier never regresses"),
+    "aot_store_miss": (
+        "info", "an AOT executable store lookup found no loadable "
+                "artifact (absent/torn/stale/corrupt per its reason "
+                "field); the program was lowered live and re-persisted "
+                "(ops/aot_store.py)"),
+    "replica_autoscaled_up": (
+        "info", "the fleet autoscaler spawned a new replica slot in "
+                "response to a serving SLO breach "
+                "(serving_autoscale=on)"),
+    "replica_autoscaled_down": (
+        "info", "the fleet autoscaler drained and retired a replica "
+                "slot after SLO recovery — removed from rotation "
+                "before shutdown, so no client request fails"),
 }
 
 #: the process-wide active journal; ``None`` = journaling disabled (the

@@ -146,6 +146,24 @@ COUNTERS: Dict[str, str] = {
     "pipeline_stale_publishes_refused":
         "pipeline publishes refused because the live serving tier was "
         "already at or past the cycle's assigned version",
+    "aot_store_hits":
+        "serve programs deserialized from the disk AOT executable "
+        "store instead of lowered live (ops/aot_store.py)",
+    "aot_store_misses":
+        "AOT store lookups that found no loadable artifact (absent, "
+        "torn, stale or corrupt) and fell back to a live lowering",
+    "aot_store_stale_evictions":
+        "AOT artifacts evicted because their fingerprint, format or "
+        "sha256 failed verification — never loaded, rebuilt live",
+    "aot_store_writes":
+        "compiled executables serialized into the AOT store "
+        "(temp+rename-atomic artifact + sidecar meta)",
+    "fleet_autoscale_ups":
+        "replica slots spawned by the SLO-driven fleet autoscaler "
+        "(serving/fleet.py serving_autoscale=on)",
+    "fleet_autoscale_downs":
+        "replica slots drained and retired by the fleet autoscaler "
+        "after SLO recovery",
 }
 
 

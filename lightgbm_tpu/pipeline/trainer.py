@@ -72,6 +72,17 @@ class ServerTarget:
         if self.server.registry.provenance is None:
             self.server.registry.provenance = provenance
 
+    def attach_aot_store(self, path: str) -> None:
+        """Point the in-process server at the trainer's AOT executable
+        store so every incarnation against this workdir reuses the
+        serialized serve programs instead of re-lowering per cycle."""
+        if not path or self.server.aot_store is not None:
+            return
+        from ..ops.aot_store import AOTStore
+        store = AOTStore(path, metrics=self.server.metrics)
+        if store.writable:
+            self.server.aot_store = store
+
     def latest(self, name: str) -> Optional[Dict[str, Any]]:
         try:
             entry = self.server.registry.get(name)
@@ -101,6 +112,10 @@ class FleetTarget:
     def attach_provenance(self, provenance: PublishProvenance) -> None:
         if self.fleet.registry.provenance is None:
             self.fleet.registry.provenance = provenance
+
+    def attach_aot_store(self, path: str) -> None:
+        """A fleet owns its own store next to its manifest (replicas
+        inherit it via their spawn spec) — nothing to attach here."""
 
     def latest(self, name: str) -> Optional[Dict[str, Any]]:
         cur = self.fleet.registry.current(name)
@@ -165,6 +180,16 @@ class ContinuousTrainer:
         self.chunks_per_cycle = max(1, int(chunks_per_cycle))
         self.target = target
         self.phase_hook = phase_hook
+        # serve-program reuse across trainer incarnations: unless the
+        # caller configured (or disabled) a store, keep one in the
+        # durable workdir so a restarted trainer's publishes warm from
+        # disk instead of re-lowering the whole bucket ladder
+        aot_cfg = str(cfg.aot_store or "").strip()
+        if aot_cfg.lower() == "off":
+            self.aot_store_dir = ""
+        else:
+            self.aot_store_dir = aot_cfg or os.path.join(
+                self.workdir, "aot_store")
         self._journal_path = str(cfg.event_output or "") or None
         if label is not None:
             self.source = ArrayChunkSource(
@@ -228,6 +253,7 @@ class ContinuousTrainer:
                 source_fingerprint=self.source.fingerprint())
             self.manifest.commit()
         self.target.attach_provenance(self.provenance)
+        self.target.attach_aot_store(self.aot_store_dir)
         self._recover_target()
 
     def _recover_target(self) -> None:

@@ -114,6 +114,7 @@ _AUTH_LEN = 32
 #: replica-slot lifecycle states beyond the heartbeat trio
 _WARMING = "warming"
 _FAILED = "failed"
+_RETIRING = "retiring"
 
 #: rolling latency window cap, mirroring server.py
 _WINDOW_MAX = 4096
@@ -475,6 +476,13 @@ def _replica_body(spec: dict, params: Dict[str, Any], slot: int,
     part of :func:`_replica_main` bracketed by the journal session and
     the flight-recorder fatal-exception guard)."""
     from .server import PredictionServer
+    from ..obs import compile_events
+    from ..obs.metrics import global_metrics
+    # arm the compile listener BEFORE any serving work so the ready
+    # marker can report how many XLA lowerings the manifest warm cost —
+    # a replica rejoining through the AOT store reports ZERO, which is
+    # what the serve_kill drill and the fleet tests assert on
+    compile_events.install()
     server = PredictionServer(params)
     manifest = spec.get("manifest_path")
     models: Dict[str, dict] = {}
@@ -484,9 +492,12 @@ def _replica_body(spec: dict, params: Dict[str, Any], slot: int,
                 models = json.load(fh).get("models", {})
         except (OSError, ValueError):
             models = {}   # empty fleet: nothing to warm yet
+    lowerings0 = global_metrics.counter("xla_program_lowerings")
     for name, info in sorted(models.items()):
         server.publish(name, model_file=info["path"],
                        version=int(info["version"]), warmup=True)
+    warm_lowerings = global_metrics.counter("xla_program_lowerings") \
+        - lowerings0
 
     lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -515,7 +526,8 @@ def _replica_body(spec: dict, params: Dict[str, Any], slot: int,
     hb_thread.start()
     _atomic_json(spec["ready_path"],
                  {"port": int(port), "pid": os.getpid(),
-                  "slot": slot, "incarnation": incarnation})
+                  "slot": slot, "incarnation": incarnation,
+                  "warm_lowerings": int(warm_lowerings)})
 
     lsock.settimeout(0.25)     # periodic stop-flag check
     while not stop.is_set():
@@ -616,6 +628,18 @@ class FleetServer:
         self.metrics = MetricsRegistry()
         self.registry = FleetRegistry(
             os.path.join(self.workdir, "models"), metrics=self.metrics)
+        #: AOT executable store (ops/aot_store.py), persisted NEXT TO
+        #: the fleet manifest so a respawned replica warms its full
+        #: bucket ladder by deserializing executables instead of
+        #: re-lowering them — fleet default is ON ("aot_store": "off"
+        #: disables; an explicit path relocates it, e.g. to share one
+        #: store across fleets on a machine)
+        aot_cfg = str(cfg.aot_store or "").strip()
+        if aot_cfg.lower() == "off":
+            self.aot_dir = ""
+        else:
+            self.aot_dir = aot_cfg or os.path.join(
+                self.registry.models_dir, "aot_store")
         self._event_base = str(cfg.event_output or "")
         self._journal = obs_events.start(self._event_base) \
             if self._event_base else None
@@ -640,10 +664,38 @@ class FleetServer:
                 self._tele_base = os.path.join(obs_dir, "serving.jsonl")
         self._tower: Optional[Watchtower] = None
         self._tower_lock = threading.Lock()
+        #: SLO-driven elasticity (serving_autoscale=on): the monitor
+        #: spawns slots up to ``replicas_max`` while a serving SLO is
+        #: breached and retires them back to ``replicas_min`` after
+        #: recovery.  Autoscale without slo_config activates the
+        #: serving SLOs at their default budgets — it has no other
+        #: breach signal to act on.
+        self.autoscale = str(cfg.serving_autoscale or "off") \
+            .strip().lower() == "on"
+        rmin = int(cfg.serving_replicas_min)
+        rmax = int(cfg.serving_replicas_max)
+        self.replicas_min = rmin if rmin > 0 else self.replicas_n
+        self.replicas_max = rmax if rmax > 0 \
+            else max(self.replicas_n, self.replicas_min)
+        if self.replicas_min > self.replicas_max:
+            raise log.LightGBMError(
+                f"serving_replicas_min={self.replicas_min} exceeds "
+                f"serving_replicas_max={self.replicas_max}")
+        if self.autoscale:
+            self.replicas_n = min(max(self.replicas_n,
+                                      self.replicas_min),
+                                  self.replicas_max)
+        #: one scale action per cooldown — a breach must not fork-bomb
+        #: the host, and a recovery must not mass-retire the fleet
+        self.autoscale_cooldown_s = max(1.0, float(cfg.rollup_window_s))
+        self._last_scale_unix = 0.0
+        self._retire_threads: List[threading.Thread] = []
         try:
             enabled = parse_slo_config(cfg.slo_config)
         except ValueError:
             enabled = {}
+        if self.autoscale and not enabled:
+            enabled = parse_slo_config("on")
         if enabled:
             hook = lambda n, v=1: count_event(n, v, self.metrics)
             rollup = Rollup(window_s=float(cfg.rollup_window_s),
@@ -668,6 +720,10 @@ class FleetServer:
             maxlen=_WINDOW_MAX)
         self._rr = 0
         self._slots: Dict[int, _ReplicaSlot] = {}
+        #: next never-used slot id for autoscaled spawns — slot ids are
+        #: monotonic (a retired slot's id is never recycled, so journal
+        #: lineage per slot stays unambiguous)
+        self._next_slot = self.replicas_n
         self._stop = threading.Event()
         self._monitor: Optional[threading.Thread] = None
         try:
@@ -688,6 +744,11 @@ class FleetServer:
         from ..obs.merge import rank_file_path
         p = dict(self._params)
         p["serving_replicas"] = 0       # a replica never nests a fleet
+        p["serving_autoscale"] = "off"  # scaling is the router's job
+        if self.aot_dir:
+            p["aot_store"] = self.aot_dir
+        else:
+            p.pop("aot_store", None)
         for key, base in (("event_output", self._event_base),
                           ("serving_telemetry_output", self._tele_base)):
             if base:
@@ -749,7 +810,11 @@ class FleetServer:
         if rejoin:
             emit_event("replica_rejoined", slot=s.slot,
                        incarnation=s.incarnation, pid=s.pid,
-                       warm_s=round(s.ready_unix - s.spawn_unix, 3))
+                       warm_s=round(s.ready_unix - s.spawn_unix, 3),
+                       # -1 = pre-store marker; 0 = warmed entirely
+                       # from the AOT executable store (the drill gate)
+                       warm_lowerings=int(
+                           marker.get("warm_lowerings", -1)))
         return True
 
     def _startup_barrier(self) -> None:
@@ -882,11 +947,24 @@ class FleetServer:
                             f"fleet: replica slot {s.slot} monitor "
                             f"failure ({type(e).__name__}: {e}); "
                             "will retry next poll")
+            if self.autoscale:
+                try:
+                    self._autoscale_step(now)
+                except Exception as e:
+                    # same containment contract as the per-slot poll:
+                    # a scaling failure degrades elasticity, not the
+                    # monitor keeping the fixed fleet alive
+                    log.warning(
+                        "fleet: autoscale step failed "
+                        f"({type(e).__name__}: {e}); will retry")
 
     def _check_slot(self, s: _ReplicaSlot, now: float) -> None:
         """One monitor poll for one slot (exceptions are the caller's
         problem — it keeps the monitor thread alive)."""
-        if s.state == _FAILED:
+        if s.state in (_FAILED, _RETIRING):
+            # a retiring slot is the autoscaler's to tear down; running
+            # the dead-man logic here would respawn a replica the fleet
+            # just decided it no longer needs
             return
         if s.state == _WARMING:
             if os.path.exists(s.ready_path):
@@ -941,6 +1019,95 @@ class FleetServer:
         elif state == HEALTHY and s.state == SUSPECT:
             s.state = HEALTHY
             s.suspect_since = None
+
+    # ------------------------------------------------------------ autoscale
+    def _autoscale_step(self, now: float) -> None:
+        """One SLO-driven scaling decision per monitor pass.  A breach
+        on any watched serving SLO grows the fleet ONE slot toward
+        ``serving_replicas_max``; a fully recovered tower retires the
+        highest routable slot back toward ``serving_replicas_min``.
+        One step per cooldown (a rollup window): the new capacity must
+        show up in the burn-rate before the next move, or a single
+        breach would fork-bomb straight to max."""
+        tower = self._tower
+        if tower is None:
+            return
+        if now - self._last_scale_unix < self.autoscale_cooldown_s:
+            return
+        with self._tower_lock:
+            tower.evaluate()
+            breached = tower.breached()
+        with self._lock:
+            active = [s for s in self._slots.values()
+                      if s.state not in (_FAILED, _RETIRING)]
+            n = len(active)
+            if breached and n < self.replicas_max:
+                slot_id = self._next_slot
+                self._next_slot += 1
+                s = _ReplicaSlot(slot_id)
+                self._slots[slot_id] = s
+                action = "up"
+            elif not breached and n > max(1, self.replicas_min):
+                # retire the youngest routable HEALTHY slot, and only
+                # with another routable survivor to carry the traffic
+                cands = [c for c in active
+                         if c.routable and c.state == HEALTHY]
+                routable = [c for c in active if c.routable]
+                if len(cands) < 1 or len(routable) < 2:
+                    return
+                s = max(cands, key=lambda c: c.slot)
+                s.draining = True       # out of _pick immediately
+                s.state = _RETIRING
+                action = "down"
+            else:
+                return
+        self._last_scale_unix = now
+        if action == "up":
+            count_event("fleet_autoscale_ups", 1, self.metrics)
+            emit_event("replica_autoscaled_up", slot=s.slot,
+                       replicas=n + 1, reason=",".join(breached))
+            self._spawn(s)
+        else:
+            count_event("fleet_autoscale_downs", 1, self.metrics)
+            emit_event("replica_autoscaled_down", slot=s.slot,
+                       replicas=n - 1, reason="slo_recovered")
+            t = threading.Thread(target=self._retire, args=(s,),
+                                 name=f"fleet-retire-{s.slot}",
+                                 daemon=True)
+            self._retire_threads.append(t)
+            t.start()
+
+    def _retire(self, s: _ReplicaSlot) -> None:
+        """Drain and tear down a scaled-out replica off the monitor
+        thread (a drain is a bounded wait, but bounded != free).  The
+        slot id leaves ``_slots`` for good — ids are never recycled."""
+        try:
+            self._drain(s)
+            if s.port is not None and s.proc is not None \
+                    and s.proc.poll() is None:
+                try:
+                    self._rpc(s, {"op": "close"}, timeout_s=2.0)
+                except (OSError, EOFError, ValueError,
+                        pickle.PickleError):
+                    pass
+            if s.proc is not None:
+                try:
+                    s.proc.wait(timeout=5.0)
+                except Exception:
+                    try:
+                        s.proc.kill()
+                        s.proc.wait(timeout=5.0)
+                    except Exception:
+                        pass
+        finally:
+            if s.log_file is not None:
+                try:
+                    s.log_file.close()
+                except OSError:
+                    pass
+                s.log_file = None
+            with self._lock:
+                self._slots.pop(s.slot, None)
 
     # -------------------------------------------------------------- routing
     def _pick(self, exclude: set) -> Optional[_ReplicaSlot]:
@@ -1429,7 +1596,7 @@ class FleetServer:
             lines.extend(prom.counter_lines(
                 name, val, "fleet counter (obs/metrics.py)"))
         state_code = {HEALTHY: 0, SUSPECT: 1, DEAD: 2, _WARMING: 3,
-                      _FAILED: 4}
+                      _FAILED: 4, _RETIRING: 5}
         with self._lock:
             slots = list(self._slots.values())
         for s in slots:
@@ -1437,7 +1604,7 @@ class FleetServer:
             lines.extend(prom.gauge_lines(
                 "fleet_replica_state", state_code.get(s.state, 4),
                 "replica lifecycle state (0 healthy, 1 suspect, 2 dead, "
-                "3 warming, 4 failed)", labels=lab))
+                "3 warming, 4 failed, 5 retiring)", labels=lab))
             lines.extend(prom.gauge_lines(
                 "fleet_replica_incarnation", s.incarnation,
                 "respawn count of the slot", labels=lab))
@@ -1489,6 +1656,8 @@ class FleetServer:
         self._stop.set()
         if self._monitor is not None:
             self._monitor.join(timeout=5.0)
+        for t in self._retire_threads:
+            t.join(timeout=5.0)
         with self._lock:
             slots = list(self._slots.values())
         for s in slots:

@@ -73,7 +73,8 @@ class CompiledPredictor:
 
     def __init__(self, booster, *, ladder: Optional[BucketLadder] = None,
                  exact: bool = True, int8: bool = False,
-                 metrics: Optional[MetricsRegistry] = None) -> None:
+                 metrics: Optional[MetricsRegistry] = None,
+                 aot_store=None) -> None:
         from ..basic import Booster  # lazy: basic imports a lot
         if not isinstance(booster, Booster):
             raise log.LightGBMError(
@@ -81,6 +82,11 @@ class CompiledPredictor:
                 "/ from_model_file for text artifacts)")
         self.metrics = metrics if metrics is not None else MetricsRegistry()
         self.ladder = ladder if ladder is not None else BucketLadder()
+        #: optional ops/aot_store.py disk tier — bucket programs then
+        #: deserialize from a previously persisted executable instead of
+        #: re-lowering (zero-lowering warm for respawned replicas and
+        #: fresh processes); None keeps the process-cache-only behavior
+        self.aot_store = aot_store
         self.int8 = bool(int8)
         self.k = max(1, booster.num_model_per_iteration())
         self.num_features = booster.num_feature()
@@ -153,12 +159,14 @@ class CompiledPredictor:
             trace.record_span("bucket_pad", trace.us(t_pad),
                               (t_run - t_pad) * 1e6, parent=parent,
                               bucket=bucket)
+        cat_feats, int8 = self.cat_feats, self.int8
         fn = cc.get_or_build(
-            ("serve_leaves", cc.sig((self.fb, bins_t)), self.cat_feats,
-             self.int8),
-            lambda: predict_forest_leaves, anchors=(self,),
-            metrics=self.metrics, counter_ns="serve")
-        lv = fn(self.fb, bins_t, cat_feats=self.cat_feats, int8=self.int8)
+            ("serve_leaves", cc.sig((self.fb, bins_t)), cat_feats, int8),
+            lambda: (lambda fb, bt: predict_forest_leaves(
+                fb, bt, cat_feats=cat_feats, int8=int8)),
+            anchors=(self,), metrics=self.metrics, counter_ns="serve",
+            store=self.aot_store, aot_args=(self.fb, bins_t))
+        lv = fn(self.fb, bins_t)
         out = np.asarray(lv)[:, :rows]
         if trace is not None:
             trace.record_span("device_run", trace.us(t_run),
@@ -183,13 +191,15 @@ class CompiledPredictor:
             trace.record_span("bucket_pad", trace.us(t_pad),
                               (t_run - t_pad) * 1e6, parent=parent,
                               bucket=bucket)
+        k, cat_feats, int8 = self.k, self.cat_feats, self.int8
         fn = cc.get_or_build(
-            ("serve_sums", cc.sig((self.fb, bins_t)), self.k,
-             self.cat_feats, self.int8),
-            lambda: predict_bitset_forest, anchors=(self,),
-            metrics=self.metrics, counter_ns="serve")
-        res = fn(self.fb, bins_t, self.k, cat_feats=self.cat_feats,
-                 int8=self.int8)
+            ("serve_sums", cc.sig((self.fb, bins_t)), k, cat_feats,
+             int8),
+            lambda: (lambda fb, bt: predict_bitset_forest(
+                fb, bt, k, cat_feats=cat_feats, int8=int8)),
+            anchors=(self,), metrics=self.metrics, counter_ns="serve",
+            store=self.aot_store, aot_args=(self.fb, bins_t))
+        res = fn(self.fb, bins_t)
         out = np.asarray(res, np.float64)[:rows]
         if trace is not None:
             trace.record_span("device_run", trace.us(t_run),
@@ -267,19 +277,38 @@ class CompiledPredictor:
         {bucket: seconds} (the cold-compile cost a live request never
         pays).  Idempotent — warm buckets take the trace-cache hit
         path and cost microseconds."""
+        return {b: d["total_s"] for b, d in self.warmup_ex().items()}
+
+    def warmup_ex(self) -> Dict[int, Dict[str, float]]:
+        """``warmup`` with the cost split per bucket:
+        ``{bucket: {"total_s", "lower_s", "aot_load_s"}}``.  A bucket
+        whose program deserialized from the AOT store books its whole
+        wall time as ``aot_load_s`` (zero lowerings happened); one
+        built live books it as ``lower_s`` — the split
+        tools/bench_serve.py reports and bench_compare.py gates cold
+        warm time on."""
         import time
         if self._fallback is not None:
             return {}
-        timings: Dict[int, float] = {}
+        timings: Dict[int, Dict[str, float]] = {}
         width = self.num_features
         for b in self.ladder.sizes:
+            hits0 = self.metrics.counter("aot_store_hits") \
+                if self.aot_store is not None else 0
             t0 = time.perf_counter()
             bins = self._binner(np.zeros((b, width)))
             if self.exact:
                 self._leaves_for_chunk(bins, b, b)
             else:
                 self._sums_for_chunk(bins, b, b)
-            timings[b] = time.perf_counter() - t0
+            dt = time.perf_counter() - t0
+            from_store = self.aot_store is not None and \
+                self.metrics.counter("aot_store_hits") > hits0
+            timings[b] = {
+                "total_s": dt,
+                "aot_load_s": dt if from_store else 0.0,
+                "lower_s": 0.0 if from_store else dt,
+            }
             with self._warm_lock:
                 self._warm.add(b)
         return timings

@@ -58,6 +58,19 @@ class PredictionServer:
         self.registry = registry if registry is not None \
             else ModelRegistry(metrics=self.metrics)
         self.max_inflight = int(cfg.serving_max_inflight)
+        #: disk-backed AOT executable store (ops/aot_store.py) —
+        #: ``aot_store=<dir>`` makes publish warms deserialize
+        #: previously compiled bucket programs (zero lowerings) and
+        #: persist fresh ones for later processes; ""/"off" (default)
+        #: keeps warms process-local.  An unwritable path degrades to a
+        #: warning through the shared utils/paths.py probe.
+        self.aot_store = None
+        aot_path = str(cfg.aot_store or "").strip()
+        if aot_path and aot_path.lower() != "off":
+            from ..ops.aot_store import AOTStore
+            store = AOTStore(aot_path, metrics=self.metrics)
+            if store.writable:
+                self.aot_store = store
         self._inflight = 0
         #: requests that have entered predict() but not yet resolved
         #: admission (accepted or rejected) — the library-level analogue
@@ -125,23 +138,33 @@ class PredictionServer:
                 "publish() needs exactly one of booster=, model_text=, "
                 "model_file=")
         kw = dict(ladder=self.ladder, int8=int8, exact=exact,
-                  metrics=self.metrics)
+                  metrics=self.metrics, aot_store=self.aot_store)
         if booster is not None:
             predictor = CompiledPredictor.from_booster(booster, **kw)
         elif model_text is not None:
             predictor = CompiledPredictor.from_model_text(model_text, **kw)
         else:
             predictor = CompiledPredictor.from_model_file(model_file, **kw)
-        compile_s = predictor.warmup() if warmup else {}
+        detail = predictor.warmup_ex() if warmup else {}
         entry = self.registry.publish(name, predictor, version=version,
                                       sha256=sha256, cycle=cycle,
                                       force=force)
-        self._last_compile_s = dict(compile_s)
+        self._last_compile_s = {b: d["total_s"]
+                                for b, d in detail.items()}
+        self._last_warm_detail = {b: dict(d) for b, d in detail.items()}
         return entry
 
     def entry_compile_s(self) -> Dict[int, float]:
         """Per-bucket warmup compile seconds of the LAST publish()."""
         return dict(getattr(self, "_last_compile_s", {}))
+
+    def entry_warm_detail(self) -> Dict[int, Dict[str, float]]:
+        """Per-bucket ``{"total_s", "lower_s", "aot_load_s"}`` of the
+        LAST publish() warm — lower_s is live XLA lowering+compile
+        time, aot_load_s is deserialize-from-store time
+        (ops/aot_store.py)."""
+        return {b: dict(d) for b, d in
+                getattr(self, "_last_warm_detail", {}).items()}
 
     # ------------------------------------------------------------- predict
     def predict(self, name: str, X, raw_score: bool = True,

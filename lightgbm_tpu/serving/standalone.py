@@ -219,13 +219,20 @@ def build_standalone(trees: Sequence[Tree], num_features: int, k: int):
             # reference tree.cpp CategoricalDecision)
             if csi < len(t.cat_nan_left) and t.cat_nan_left[csi]:
                 catb[ti, ci, binner.cat_nan_bin(f)] = 1.0
+    # bfloat16 casts happen on the HOST (ml_dtypes rounds identically
+    # to XLA's convert_element_type): an eager jnp dtype conversion
+    # would lower a one-off XLA program, breaking the serving tier's
+    # zero-lowering warm-from-AOT-store contract for (re)spawned
+    # replicas
+    import ml_dtypes
+    bf16 = ml_dtypes.bfloat16
     fb = BitsetForest(
         feat=jnp.asarray(feat), thr=jnp.asarray(thr),
         dl=jnp.asarray(dl), nanb=jnp.asarray(nanb),
         catn=jnp.asarray(catn), catf=jnp.asarray(catf),
-        catb=jnp.asarray(catb, jnp.bfloat16),
-        mpos=jnp.asarray(mpos, jnp.bfloat16),
-        mneg=jnp.asarray(mneg, jnp.bfloat16),
+        catb=jnp.asarray(catb.astype(bf16)),
+        mpos=jnp.asarray(mpos.astype(bf16)),
+        mneg=jnp.asarray(mneg.astype(bf16)),
         depth=jnp.asarray(depth), value=jnp.asarray(value),
         cls=jnp.asarray(np.arange(T, dtype=np.int32) % k))
     return binner, fb, cat_feats

@@ -53,11 +53,14 @@ def fleet_model():
 @pytest.fixture(scope="module")
 def fleet(fleet_model, tmp_path_factory):
     _, _, _ = fleet_model
+    wd = str(tmp_path_factory.mktemp("fleet"))
     srv = FleetServer(
         {"serving_replicas": 2, "serving_buckets": [1, 8],
          "fleet_heartbeat_interval_s": 0.2,
-         "fleet_heartbeat_timeout_s": 1.5},
-        workdir=str(tmp_path_factory.mktemp("fleet")))
+         "fleet_heartbeat_timeout_s": 1.5,
+         "event_output": os.path.join(wd, "events.jsonl")},
+        workdir=wd)
+    srv.journal_path = os.path.join(wd, "events.jsonl")
     yield srv
     srv.close()
 
@@ -121,6 +124,19 @@ def test_fleet_kill_failover_zero_errors(fleet, fleet_model):
     # the rejoined replica warmed the committed manifest version
     live = fleet.replica_versions()
     assert live and all(m["m"] == 2 for m in live.values())
+    # ...and it warmed THROUGH the AOT executable store: the journal's
+    # replica_rejoined for the respawned incarnation records a zero
+    # xla_program_lowerings delta over its whole manifest warm pass
+    from lightgbm_tpu.obs.events import read_journal
+    rejoins = [e["payload"] for e in read_journal(fleet.journal_path)
+               if e.get("event") == "replica_rejoined"
+               and int((e.get("payload") or {}).get("incarnation", 0)) >= 1]
+    assert rejoins, "no respawn rejoin in the journal"
+    assert all(p.get("warm_lowerings") == 0 for p in rejoins), rejoins
+    # the store lives next to the fleet manifest and holds the ladder
+    store_dir = os.path.join(fleet.registry.models_dir, "aot_store")
+    assert os.path.isfile(os.path.join(store_dir, "aot_store.json"))
+    assert any(f.endswith(".aotx") for f in os.listdir(store_dir))
 
 
 def test_fleet_snapshot_and_prometheus(fleet):
@@ -203,3 +219,82 @@ def test_fleet_request_trace_end_to_end(fleet_model, tmp_path):
         assert art["flight"] == []          # nobody died
     finally:
         srv.close()
+
+
+def test_fleet_autoscale_config_validation():
+    with pytest.raises(LightGBMError, match="serving_autoscale"):
+        Config({"serving_autoscale": "sometimes"})
+    with pytest.raises(LightGBMError, match="serving_replicas_min"):
+        Config({"serving_replicas_min": 3, "serving_replicas_max": 2})
+    cfg = Config({"serving_autoscale": "ON "})
+    assert cfg.serving_autoscale == "on"
+
+
+def test_fleet_autoscale_breach_and_recover(fleet_model, tmp_path):
+    """The PR16 autoscale drill: a synthetic ``serving_p99_ms`` breach
+    spawns a replica slot up to ``serving_replicas_max``; recovery
+    retires it back to ``serving_replicas_min``.  Zero failed client
+    requests throughout, and the journal narrates
+    ``replica_autoscaled_up`` before ``replica_autoscaled_down``."""
+    from lightgbm_tpu.obs import events as obs_events
+    from lightgbm_tpu.obs.events import read_journal
+    b1, _, X = fleet_model
+    ev = str(tmp_path / "events.jsonl")
+    fleet = FleetServer(
+        {"serving_replicas": 1, "serving_buckets": [1, 8],
+         "serving_autoscale": "on", "serving_replicas_min": 1,
+         "serving_replicas_max": 2,
+         "fleet_heartbeat_interval_s": 0.2,
+         "fleet_heartbeat_timeout_s": 1.5,
+         "rollup_window_s": 0.5, "event_output": ev},
+        workdir=str(tmp_path))
+    errs = []
+
+    def _client():
+        try:
+            r = fleet.predict_ex("m", X[:3], deadline_ms=10_000)
+            assert r["version"] == 1
+        except Exception as e:  # noqa: BLE001 — tallied below
+            errs.append(f"{type(e).__name__}: {e}")
+
+    try:
+        fleet.publish("m", booster=b1)
+        # journals are process-global: when another test's fleet already
+        # holds one open, this run's events join it — read from there
+        jp = obs_events.active().path if obs_events.active() else ev
+        assert fleet.autoscale and fleet.watchtower is not None
+        assert sorted(fleet._slots) == [0]
+        # synthetic breach: feed latency far over the 50ms p99 budget
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline and len(fleet._slots) < 2:
+            fleet._feed_tower(latency_s=0.5)
+            _client()
+            time.sleep(0.05)
+        assert sorted(fleet._slots) == [0, 1], "no scale-up on breach"
+        assert fleet.metrics.counter("fleet_autoscale_ups") == 1
+        # the new slot joins the routing table (warm from the store)
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline and not all(
+                s == "healthy" for s in fleet.states().values()):
+            _client()
+            time.sleep(0.1)
+        assert all(s == "healthy" for s in fleet.states().values())
+        # recovery: fast samples until the burn rate clears and the
+        # autoscaler retires the extra slot back to min
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline and len(fleet._slots) > 1:
+            fleet._feed_tower(latency_s=0.001)
+            _client()
+            time.sleep(0.05)
+        assert sorted(fleet._slots) == [0], "no scale-down on recovery"
+        assert fleet.metrics.counter("fleet_autoscale_downs") == 1
+        _client()                    # the surviving fleet still serves
+        assert not errs, errs[:5]
+    finally:
+        fleet.close()
+    evs = [e["event"] for e in read_journal(jp)]
+    up, down = evs.index("replica_autoscaled_up"), \
+        evs.index("replica_autoscaled_down")
+    assert up < down
+    # the SLO engine narrated the cause on both sides of the cycle
+    assert "slo_breach" in evs and "slo_recovered" in evs

@@ -19,7 +19,10 @@ Round-8 serving tier: also accepts ``kind="serve"`` payloads from
 tools/bench_serve.py.  Serve captures gate on request LATENCY, not
 throughput-vs-anchor: the compared series are per-bucket (and overall)
 ``p99_ms``, LOWER is better, and a rise beyond --threshold is the
-regression.  Both sides must be serve captures of the same metric.
+regression.  When both sides carry ``cold_warm_s`` (publish -> full
+ladder warm, the respawn cold-start tax) it gates under the same
+threshold — an AOT-store regression shows there first.  Both sides
+must be serve captures of the same metric.
 
 Exit codes (tools/_report.py convention):
   0 — comparable, no regression beyond --threshold,
@@ -188,6 +191,21 @@ def _compare_serve(old: Dict[str, Any], new: Dict[str, Any],
     if not rows:
         raise ValueError("serve captures share no p99 series "
                          "(different bucket ladders?)")
+    # cold-start warm cost (publish -> full ladder ready) gates
+    # alongside p99: an AOT-store regression shows up here long before
+    # it shows up in any steady-state latency percentile
+    old_cw = old.get("cold_warm_s")
+    new_cw = new.get("cold_warm_s")
+    if isinstance(old_cw, (int, float)) and old_cw > 0 \
+            and isinstance(new_cw, (int, float)) and new_cw > 0:
+        change = float(new_cw) / float(old_cw) - 1.0
+        rows.append({
+            "series": "cold_warm",
+            "old_cold_warm_s": float(old_cw),
+            "new_cold_warm_s": float(new_cw),
+            "change_pct": round(100.0 * change, 2),
+            "regression": bool(change > threshold),
+        })
     return {
         "tool": "bench_compare",
         "kind": "serve",
@@ -382,6 +400,10 @@ def _render_text(payload: Dict[str, Any]) -> str:
             lines.append("  %-18s %8.3f ms -> %8.3f ms  (%+.2f%%)  %s"
                          % (r["series"], r["old_p99_ms"],
                             r["new_p99_ms"], r["change_pct"], flag))
+        elif "old_cold_warm_s" in r:
+            lines.append("  %-18s %8.3f s  -> %8.3f s   (%+.2f%%)  %s"
+                         % (r["series"], r["old_cold_warm_s"],
+                            r["new_cold_warm_s"], r["change_pct"], flag))
         elif "old_rows_per_s" in r:
             lines.append("  %-18s %10.0f rows/s -> %10.0f rows/s  "
                          "(%+.2f%%)  %s"

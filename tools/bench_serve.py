@@ -8,8 +8,15 @@ reports, per bucket:
   * ``p50_ms`` / ``p95_ms`` / ``p99_ms`` request latency,
   * ``rows_per_s`` steady-state throughput,
   * ``compile_s`` — the cold warmup compile cost the bucket paid ONCE
-    at publish (the cost a live request never sees),
+    at publish (the cost a live request never sees), split into
+    ``lower_s`` (live XLA lowering) vs ``aot_load_s`` (deserialized
+    from a disk AOT store — pass ``--aot-store DIR`` and run twice
+    against the same directory to measure the warm-from-disk path),
   * ``run_s`` / ``requests`` — total warm time and request count.
+
+The payload-level ``cold_warm_s`` sums the per-bucket warmup cost —
+the cold-start tax a (re)spawned replica pays before it can serve,
+which tools/bench_compare.py gates alongside p99.
 
 It also captures ``steady_lowerings``: the ``xla_program_lowerings``
 delta over the whole timed stream, which the serving contract says must
@@ -121,7 +128,8 @@ def _request_sizes(buckets: List[int], requests: int,
 
 
 def run(requests: int, features: int, trees: int, leaves: int,
-        buckets: List[int], seed: int, raw_score: bool) -> Dict[str, Any]:
+        buckets: List[int], seed: int, raw_score: bool,
+        aot_store: str = "") -> Dict[str, Any]:
     import jax
 
     import lightgbm_tpu as lgb
@@ -148,12 +156,16 @@ def run(requests: int, features: int, trees: int, leaves: int,
     # its queue/pad/device/gather breakdown (span sums are measured
     # INSIDE the request, so the percentile columns still time the same
     # code path operators serve with when they enable request_trace)
-    server = PredictionServer({"serving_buckets": buckets,
-                               "request_trace": "all"})
+    params: Dict[str, Any] = {"serving_buckets": buckets,
+                              "request_trace": "all"}
+    if aot_store:
+        params["aot_store"] = aot_store
+    server = PredictionServer(params)
     t0 = time.perf_counter()
     server.publish("bench", booster=booster, warmup=True)
     publish_s = time.perf_counter() - t0
     compile_s = server.entry_compile_s()
+    warm_detail = server.entry_warm_detail()
 
     sizes = _request_sizes(buckets, requests, rng)
     max_n = max(sizes)
@@ -194,6 +206,9 @@ def run(requests: int, features: int, trees: int, leaves: int,
             "rows_per_s": per_bucket_rows[b] / run_s if run_s > 0 else 0.0,
             "run_s": run_s,
             "compile_s": float(compile_s.get(b, 0.0)),
+            "lower_s": float(warm_detail.get(b, {}).get("lower_s", 0.0)),
+            "aot_load_s": float(
+                warm_detail.get(b, {}).get("aot_load_s", 0.0)),
         })
         if b in stages:
             row["stage_ms"] = {col: round(v, 4)
@@ -216,6 +231,8 @@ def run(requests: int, features: int, trees: int, leaves: int,
         "overall": overall,
         "publish_s": publish_s,
         "compile_s_total": float(sum(compile_s.values())),
+        "cold_warm_s": float(sum(d["total_s"]
+                                 for d in warm_detail.values())),
         "steady_lowerings": int(steady),
         "counters": server.stats()["counters"],
     }
@@ -336,8 +353,9 @@ def run_open_loop(requests: int, features: int, trees: int, leaves: int,
             "buckets": bucket_rows,
             "overall": overall,
             "publish_s": publish_s,
-            # the recompile contract is measured by the closed loop
-            # (in-process counter); replica processes own their own
+            # warm cost and the recompile contract are measured by the
+            # closed loop (in-process counters); replicas own their own
+            "cold_warm_s": 0.0,
             "steady_lowerings": 0,
             "counters": {},
         }
@@ -382,6 +400,13 @@ def _render_text(payload: Dict[str, Any]) -> str:
                      % (payload["rate_rps"], payload["achieved_rps"],
                         payload["replicas"], payload["errors"]))
     else:
+        lower = sum(r.get("lower_s", 0.0)
+                    for r in payload["buckets"].values())
+        aot = sum(r.get("aot_load_s", 0.0)
+                  for r in payload["buckets"].values())
+        lines.append("  cold warm: %.3fs (lowered %.3fs / aot-loaded "
+                     "%.3fs)" % (payload.get("cold_warm_s", 0.0),
+                                 lower, aot))
         lines.append("  steady-state lowerings: %d (contract: 0)"
                      % payload["steady_lowerings"])
     return "\n".join(lines)
@@ -408,6 +433,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--replicas", type=int, default=0,
                     help="open-loop only: drive a FleetServer with this "
                          "many replica processes (0 = in-process server)")
+    ap.add_argument("--aot-store", default="",
+                    help="closed loop only: warm serve programs through "
+                         "this disk AOT store (run twice against the "
+                         "same dir to measure the warm-from-disk path)")
     ap.add_argument("--out", default="",
                     help="also write the JSON payload to this path")
     _report.add_format_arg(ap)
@@ -426,7 +455,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         else:
             payload = run(args.requests, args.features, args.trees,
                           args.leaves, buckets, args.seed,
-                          raw_score=not args.converted)
+                          raw_score=not args.converted,
+                          aot_store=args.aot_store)
     except ValueError as e:
         print("bench_serve: error: %s" % e, file=sys.stderr)
         return _report.EXIT_ERROR

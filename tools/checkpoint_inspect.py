@@ -32,6 +32,16 @@ sha for the same version, versions run 1..N with no gaps — and the
 in-flight cycle's committed artifacts (its export record, its per-cycle
 checkpoint directory) must validate too.  Any broken link is a TORN
 cycle: exit 1.
+
+AOT executable stores (ops/aot_store.py) join the verification
+surface: pointed directly at a store directory (one holding
+``aot_store.json``) the tool verifies every artifact's sha256 against
+its sidecar meta and the fingerprint chain (one backend/jax-version/
+topology fingerprint per store); ``--verify-all`` — and pipeline mode
+always — additionally discovers stores nested under the target
+directory and folds their findings in.  A torn or stale store exits 1:
+the serving tier would evict-and-relower (never crash), but a respawn
+loses its zero-lowering warm path.
 """
 
 from __future__ import annotations
@@ -48,6 +58,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 from _report import (EXIT_ERROR, EXIT_FINDINGS, EXIT_OK,  # noqa: E402
                      add_format_arg, emit)
+from lightgbm_tpu.ops.aot_store import (  # noqa: E402
+    find_aot_stores, is_aot_store, verify_store)
 from lightgbm_tpu.robustness.checkpoint import (  # noqa: E402
     MODEL_NAME, checkpoint_dirs, read_manifest, validate_checkpoint)
 
@@ -78,6 +90,26 @@ def build_report(directory: str) -> Dict[str, Any]:
         "all_valid": all(e["valid"] for e in entries) if entries else None,
         "invalid_count": sum(1 for e in entries if not e["valid"]),
     }
+
+
+def build_aot_report(directory: str) -> Dict[str, Any]:
+    """Integrity payload for one AOT executable store directory."""
+    rep = verify_store(directory)
+    return {"tool": "checkpoint_inspect", "mode": "aot_store",
+            "directory": directory, "store": rep,
+            "findings": list(rep["findings"]),
+            "all_valid": bool(rep["valid"])}
+
+
+def _store_findings(root: str) -> list:
+    """Findings from every AOT store discovered under ``root`` (used by
+    --verify-all and pipeline mode), prefixed with the store path."""
+    findings = []
+    for store in find_aot_stores(root):
+        rep = verify_store(store)
+        for f in rep["findings"]:
+            findings.append(f"aot store {store}: {f}")
+    return findings
 
 
 def build_pipeline_report(workdir: str) -> Dict[str, Any]:
@@ -158,6 +190,10 @@ def build_pipeline_report(workdir: str) -> Dict[str, Any]:
             if not ok:
                 findings.append(f"in-flight cycle {man.cycle}: newest "
                                 f"checkpoint invalid ({reason})")
+    # a pipeline workdir owns an AOT store by default (pipeline/
+    # trainer.py keeps one under <workdir>/aot_store): a torn store is
+    # part of the recovery surface this mode exists to verify
+    findings.extend(_store_findings(workdir))
     return {"tool": "checkpoint_inspect", "mode": "pipeline",
             "directory": workdir, "name": name, "cycles": entries,
             "current": current, "findings": findings,
@@ -180,11 +216,23 @@ def _render_pipeline(payload: Dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+def _render_aot(payload: Dict[str, Any]) -> str:
+    rep = payload["store"]
+    lines = [f"aot store {payload['directory']}: "
+             f"{len(rep.get('artifacts', []))} artifact(s)"]
+    for f in payload["findings"]:
+        lines.append(f"  FINDING: {f}")
+    lines.append("store: " + ("OK" if payload["all_valid"]
+                              else "TORN/STALE"))
+    return "\n".join(lines)
+
+
 def _render_report(payload: Dict[str, Any]) -> str:
     entries = payload["checkpoints"]
-    if not entries:
-        return f"no checkpoints under {payload['directory']}"
     lines = []
+    if not entries:
+        lines.append(f"no checkpoints under {payload['directory']}")
+        entries = []
     for e in entries:
         ts = e["unix_time"]
         when = time.strftime("%Y-%m-%d %H:%M:%S",
@@ -194,6 +242,8 @@ def _render_report(payload: Dict[str, Any]) -> str:
         lines.append(f"iter={e['iteration']:<8d} time={when}  "
                      f"model={e['model_bytes']:>9d}B  trees={trees!s:>5}  "
                      f"{verdict}  {os.path.basename(e['path'])}")
+    for f in payload.get("store_findings", []):
+        lines.append(f"  FINDING: {f}")
     return "\n".join(lines)
 
 
@@ -223,14 +273,25 @@ def main(argv=None) -> int:
                          "one JSON line per checkpoint)")
     args = ap.parse_args(argv)
     fmt = "json" if args.json else args.format
+    if is_aot_store(args.checkpoint_dir):
+        payload = build_aot_report(args.checkpoint_dir)
+        emit(payload, fmt, _render_aot)
+        return EXIT_OK if payload["all_valid"] else EXIT_FINDINGS
     if os.path.exists(os.path.join(args.checkpoint_dir,
                                    "pipeline_manifest.json")):
         payload = build_pipeline_report(args.checkpoint_dir)
         emit(payload, fmt, _render_pipeline)
         return EXIT_OK if payload["all_valid"] else EXIT_FINDINGS
     payload = build_report(args.checkpoint_dir)
+    if args.verify_all:
+        payload["store_findings"] = _store_findings(args.checkpoint_dir)
     emit(payload, fmt, _render_report)
-    return exit_code(payload, verify_all=args.verify_all)
+    code = exit_code(payload, verify_all=args.verify_all)
+    if code == EXIT_OK and payload.get("store_findings"):
+        # torn/stale AOT store: serving degrades to live lowering, the
+        # respawn warm path is gone — a finding, not a hard error
+        code = EXIT_FINDINGS
+    return code
 
 
 if __name__ == "__main__":

@@ -37,7 +37,10 @@ Serving-fleet scenarios (serving/fleet.py, PR 12):
                     eviction within ``fleet_heartbeat_timeout_s``,
                     respawn + warm-from-manifest + rejoin; the journal
                     narrates ``replica_dead -> replica_evicted ->
-                    replica_spawned -> replica_rejoined``
+                    replica_spawned -> replica_rejoined``, and the
+                    rejoining incarnation warms its whole bucket
+                    ladder from the AOT executable store — its
+                    journal-recorded ``warm_lowerings`` is 0
   serve_stall       SIGSTOP a replica for LESS than the heartbeat
                     timeout -> requests route around it, NO eviction,
                     replica serves again after SIGCONT
@@ -343,6 +346,22 @@ def _journal_events(path: str) -> List[str]:
     return [e.get("event", "?") for e in read_journal(path)]
 
 
+def _rejoin_lowerings(path: str) -> List[int]:
+    """``warm_lowerings`` of every journal ``replica_rejoined`` whose
+    incarnation is a respawn (>= 1).  The AOT-store rejoin contract
+    says each is 0: the replica warmed its whole bucket ladder from
+    the disk store, paying zero XLA lowerings."""
+    from lightgbm_tpu.obs.events import read_journal
+    out: List[int] = []
+    for e in read_journal(path):
+        if e.get("event") != "replica_rejoined":
+            continue
+        p = e.get("payload") or {}
+        if int(p.get("incarnation", 0)) >= 1:
+            out.append(int(p.get("warm_lowerings", -1)))
+    return out
+
+
 def _eviction_ordered(evs: List[str]) -> bool:
     """``replica_dead -> replica_evicted -> replica_spawned ->
     replica_rejoined`` in order, starting the search at the death (the
@@ -418,6 +437,7 @@ def scenario_serve_kill(X, y):
         finally:
             fleet.close()
         evs = _journal_events(ev)
+        rejoin_low = _rejoin_lowerings(ev)
         from lightgbm_tpu.obs.events import journal_tail
         tail = journal_tail(ev)
         # the victim's crash flight recorder: slot 0 died in its first
@@ -446,9 +466,15 @@ def scenario_serve_kill(X, y):
         "flight_dump_recovered": flight is not None
         and (flight.get("meta") or {}).get("slot") == 0
         and (flight.get("meta") or {}).get("incarnation") == 0,
+        # PR16: the respawn must rejoin through the AOT executable
+        # store — its warm pass re-lowers NOTHING (journal-recorded
+        # xla_program_lowerings delta of the rejoining incarnation)
+        "rejoined_via_aot_store": bool(rejoin_low)
+        and all(n == 0 for n in rejoin_low),
     }
     out = {"name": "serve_kill", "checks": checks,
            "eviction_latency_s": evict_s, "failovers": failovers,
+           "rejoin_warm_lowerings": rejoin_low,
            "request_errors": errs[:5], "journal_tail": tail,
            "watchtower": _watchtower_summary(tail),
            "passed": all(checks.values())}
