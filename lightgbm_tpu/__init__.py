@@ -13,6 +13,7 @@ __version__ = "0.1.0"
 
 from .basic import Booster, Dataset, Sequence  # noqa: E402
 from .engine import cv, train  # noqa: E402
+from .io.sharded import shard_stream_dataset  # noqa: E402
 from .io.streaming import stream_dataset  # noqa: E402
 from .callback import (early_stopping, log_evaluation,  # noqa: E402
                        log_telemetry, record_evaluation, reset_parameter)
@@ -36,6 +37,7 @@ __all__ = [
     "record_evaluation", "reset_parameter", "global_metrics",
     "LGBMModel", "LGBMClassifier", "LGBMRegressor", "LGBMRanker",
     "LightGBMError", "register_logger", "Sequence", "stream_dataset",
+    "shard_stream_dataset",
     "plot_importance", "plot_split_value_histogram", "plot_metric",
     "plot_tree", "create_tree_digraph",
 ]

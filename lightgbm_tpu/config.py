@@ -213,6 +213,8 @@ _PARAMS: List[Tuple[str, Any, Tuple[str, ...], Tuple[Tuple[str, float], ...]]] =
     ("ingest_chunk_rows", 100000, (), ((">", 0),)),               # out-of-core streaming construction (io/streaming.py): rows per chunk in both the sketch pass and the bin+pack pass; peak host memory scales with this, not with the row count
     ("ingest_memory_budget_mb", 0.0, (), ((">=", 0.0),)),         # out-of-core streaming construction: soft ceiling on the chunk working set in MB (0 = off); ingest_chunk_rows is clamped down so one raw+binned chunk fits the budget
     ("ingest_sketch_accuracy", 0.001, (), ((">", 0.0), ("<", 0.5))),  # out-of-core streaming construction: relative accuracy alpha of the mergeable log-bucket quantile sketch used when a feature overflows the exact distinct tally; bin boundaries then sit within alpha relative error of the in-memory ones
+    ("ingest_workers", 0, (), ((">=", 0),)),                      # elastic sharded ingest (io/sharded.py; docs/SCALING.md "Sharded ingestion"): worker hosts sharding pass 1/pass 2 over a stripe-ownership ledger; 0 (default) = single-host io/streaming.py path, no ledger, no extra files; 1 = delegate to the single-host path (byte-identical artifacts); >=2 = multi-process workers with heartbeat death detection and work-stealing — output stays bit-identical to the single-host build regardless of worker deaths (reuses heartbeat_interval_s / heartbeat_timeout_s for liveness)
+    ("ingest_stripe_batch", 1, (), ((">", 0),)),                  # elastic sharded ingest: contiguous stripes a worker claims per ledger sweep; larger batches amortize claim-file round-trips, smaller ones spread reassignable work more evenly after a host death
     ("save_binary", False, ("is_save_binary", "is_save_binary_file"), ()),
     ("precise_float_parser", False, (), ()),
     ("parser_config_file", "", (), ()),

@@ -1,0 +1,1185 @@
+"""Elastic multi-host sharded ingest: stripe-ownership work-stealing
+with bit-identical recovery.
+
+``io/streaming.py`` builds both ingest passes on one process, which
+makes the data plane the last single point of failure in the continuous
+loop: a dead ingest host stalls every downstream cycle.  This module
+shards BOTH passes across the existing cluster substrate — the
+spec-file + subprocess + ready-marker protocol of ``parallel/cluster.py``
+and the wall-clock heartbeat liveness of ``robustness/elastic.py`` —
+around one idea: a **stripe-ownership ledger**.
+
+The source is cut into stripes exactly like the single-host build cuts
+it into shards (text stripes are byte-addressable via their recorded
+newline-aligned offsets; array / arrow / parquet / synthetic sources
+shard by chunk index, parquet by row group).  Ownership is decided per
+stripe by three kinds of files, all on the atomic temp+rename substrate
+of the PR 14 ``sketch_state.npz`` commits:
+
+  ledger    ``stripe_ledger.json`` — the stripe universe and the source
+            identity (fingerprint); written once by the coordinator,
+            immutable for the ingest's lifetime.
+  claim     ``claims/p<P>_s<N>.claim`` — created with ``O_CREAT|O_EXCL``
+            so exactly one worker wins a stripe (the fence against
+            double-claims); carries rank, pid, steal generation.
+  commit    ``commits/p1_s<N>.npz`` / ``commits/p2_s<N>.json`` — the
+            stripe's finished work, committed atomically.  A commit is
+            the ONLY thing that makes work durable; committed stripes
+            are never redone.
+
+Workers sweep the ledger: claim an uncommitted stripe (batches of
+``ingest_stripe_batch``), process it, commit, heartbeat.  A worker whose
+heartbeats go silent past ``heartbeat_timeout_s`` is declared dead by
+the survivors, who *steal* its claimed-but-uncommitted stripes — an
+atomic replace of the claim file with a higher-generation one — and
+re-do only those.  The coordinator merges the per-stripe
+``FeatureSummary`` commits in stripe order; because the summary merge is
+a multiset homomorphism (bucket-wise add, order- and
+grouping-invariant — io/streaming.py), the merged distributions, and
+therefore the bin boundaries, packed mirror and model text, are
+**bit-identical to the single-host build** no matter which workers died,
+who stole what, or how many workers ran.  Pass 2 shards the same way:
+workers bin their stripes straight into the shared ``bins.u8`` /
+``packed.i32`` memmaps at disjoint row ranges computed from the merged
+per-stripe row counts.
+
+``shard_stream_dataset`` with ``ingest_workers <= 1`` delegates to the
+single-host path untouched (no ledger, no extra files, byte-identical
+artifacts and journal); ``>= 2`` runs the protocol above.
+``sharded_collect`` is the in-process flavor the continuous-learning
+trainer uses for its cycle ingest phase: same ledger, claims and
+commits, one claimant — a SIGKILLed cycle resumes by loading committed
+stripes instead of re-streaming them (exactly-once, fenced by the
+ledger fingerprint recorded in the cycle manifest).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import time
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..config import Config, as_config
+from ..obs.events import emit_event
+from ..obs.metrics import count_event
+from ..utils import log
+from .streaming import (ArrayChunkSource, ChunkSource, FeatureSummary,
+                        ParquetChunkSource, RawChunk, StreamingIngest,
+                        TextStripeSource, _save_npz_atomic, _write_atomic,
+                        clamp_chunk_rows, make_source)
+
+LEDGER_NAME = "stripe_ledger.json"
+LEDGER_VERSION = 1
+
+#: pass tags: claim/commit namespaces and heartbeat epochs.  Heartbeats
+#: live in a per-pass epoch namespace (robustness/elastic.py idiom) so a
+#: worker that lagged through pass 1 starts pass 2 with a fresh slate.
+PASS_SKETCH = "p1"
+PASS_BIN = "p2"
+PASS_COLLECT = "c"
+_EPOCH = {PASS_SKETCH: 1, PASS_BIN: 2, PASS_COLLECT: 1}
+
+#: fault-injection seam (tools/fault_drill.py, pipeline drills): called
+#: as ``hook(pass_tag, stripe)`` right after a stripe commit.  Module
+#: global like streaming._shard_hook.
+_stripe_hook = None
+
+
+# ---------------------------------------------------------------------------
+# ledger
+# ---------------------------------------------------------------------------
+def ledger_path(workdir: str) -> str:
+    return os.path.join(str(workdir), LEDGER_NAME)
+
+
+def ledger_fingerprint(ledger: Dict[str, Any]) -> str:
+    """Stable identity of a ledger: sha256 over its immutable fields.
+    Recorded by the cycle manifest so a resumed cycle can prove it is
+    re-entering the SAME ingest, not a workdir someone repointed."""
+    import hashlib
+    ident = {k: ledger.get(k) for k in
+             ("fingerprint", "chunk_rows", "num_stripes", "passes")}
+    blob = json.dumps(ident, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+def read_ledger(workdir: str) -> Optional[Dict[str, Any]]:
+    """Parse the stripe ledger; ``None`` for missing/torn/alien files."""
+    try:
+        with open(ledger_path(workdir)) as fh:
+            led = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(led, dict) or \
+            led.get("format_version") != LEDGER_VERSION:
+        return None
+    return led
+
+
+def write_ledger(workdir: str, ledger: Dict[str, Any]) -> None:
+    ledger["format_version"] = LEDGER_VERSION
+    _write_atomic(ledger_path(workdir), json.dumps(ledger, default=str))
+
+
+# ---------------------------------------------------------------------------
+# claims (the double-claim fence) and commits
+# ---------------------------------------------------------------------------
+def claim_path(workdir: str, tag: str, stripe: int) -> str:
+    return os.path.join(str(workdir), "claims", f"{tag}_s{int(stripe)}.claim")
+
+
+def commit_path(workdir: str, tag: str, stripe: int) -> str:
+    ext = ".json" if tag == PASS_BIN else ".npz"
+    return os.path.join(str(workdir), "commits",
+                        f"{tag}_s{int(stripe)}{ext}")
+
+
+def try_claim(workdir: str, tag: str, stripe: int, rank: int,
+              generation: int = 0) -> bool:
+    """Fence ownership of ``stripe`` with ``O_CREAT|O_EXCL``: exactly
+    one creator wins, losers see ``FileExistsError``.  Returns True when
+    this rank now owns the stripe."""
+    path = claim_path(workdir, tag, stripe)
+    try:
+        fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+    except FileExistsError:
+        return False
+    with os.fdopen(fd, "w") as fh:
+        json.dump({"stripe": int(stripe), "pass": tag, "rank": int(rank),
+                   "pid": os.getpid(), "generation": int(generation),
+                   "unix_time": time.time()}, fh)
+        fh.flush()
+        os.fsync(fh.fileno())
+    return True
+
+
+def read_claim(workdir: str, tag: str, stripe: int) -> Optional[dict]:
+    try:
+        with open(claim_path(workdir, tag, stripe)) as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return None
+
+
+def steal_claim(workdir: str, tag: str, stripe: int, rank: int,
+                old: dict) -> bool:
+    """Atomically replace a dead owner's claim with a higher-generation
+    one (temp + ``os.replace``).  Two survivors racing the same steal
+    both replace and the last write wins; the post-replace re-read lets
+    the loser back off, and even the residual window is harmless — a
+    stripe's commit content is deterministic, so a double re-do commits
+    identical arrays."""
+    path = claim_path(workdir, tag, stripe)
+    payload = {"stripe": int(stripe), "pass": tag, "rank": int(rank),
+               "pid": os.getpid(),
+               "generation": int(old.get("generation", 0)) + 1,
+               "unix_time": time.time()}
+    tmp = path + f".steal.r{int(rank)}.tmp"
+    with open(tmp, "w") as fh:
+        json.dump(payload, fh)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+    now = read_claim(workdir, tag, stripe)
+    return bool(now and now.get("rank") == int(rank)
+                and now.get("pid") == os.getpid())
+
+
+def committed_stripes(workdir: str, tag: str, num_stripes: int) -> set:
+    return {s for s in range(int(num_stripes))
+            if os.path.exists(commit_path(workdir, tag, s))}
+
+
+def _pid_alive(pid: Optional[int]) -> bool:
+    if not pid:
+        return False
+    try:
+        os.kill(int(pid), 0)
+    except (ProcessLookupError, ValueError):
+        return False
+    except PermissionError:
+        return True
+    return True
+
+
+def _owner_age(workdir: str, tag: str, claim: dict) -> float:
+    """Seconds since the claim's owner last showed life: its freshest
+    heartbeat in the pass's epoch, or the claim stamp itself (a worker
+    publishes a heartbeat before its first claim, so a missing marker
+    means the claim stamp IS the latest news)."""
+    from ..robustness.elastic import heartbeat_path, read_heartbeat
+    hb = read_heartbeat(heartbeat_path(
+        os.path.join(str(workdir), "coord"), _EPOCH[tag],
+        int(claim.get("rank", 0))))
+    last = float(claim.get("unix_time", 0.0))
+    if hb and int(hb.get("pid", -1)) == int(claim.get("pid", -2)):
+        last = max(last, float(hb.get("unix_time", 0.0)))
+    return time.time() - last
+
+
+# ---------------------------------------------------------------------------
+# stripe enumeration and addressing
+# ---------------------------------------------------------------------------
+class SyntheticChunkSource(ChunkSource):
+    """Deterministic generator-backed source (the bench/drill input):
+    chunk ``i`` is a pure function of ``i``, so it is re-streamable and
+    stripe-addressable from any process with the same three numbers.
+    Mirrors ``tools/bench_ingest.py synth_chunk`` exactly."""
+
+    kind = "synthetic"
+    _LOW_CARD = 100
+
+    def __init__(self, num_rows: int, num_features: int,
+                 chunk_rows: int) -> None:
+        self.num_rows = int(num_rows)
+        self.num_features = int(num_features)
+        self.chunk_rows = max(1, int(chunk_rows))
+
+    def fingerprint(self) -> Dict[str, Any]:
+        return {"kind": self.kind, "num_rows": self.num_rows,
+                "num_features": self.num_features,
+                "chunk_rows": self.chunk_rows}
+
+    def chunks(self, start_chunk: int = 0) -> Iterator[RawChunk]:
+        idx = start_chunk
+        lo = idx * self.chunk_rows
+        while lo < self.num_rows:
+            rows = min(self.chunk_rows, self.num_rows - lo)
+            rng = np.random.default_rng(10_000 + idx)
+            data = rng.normal(size=(rows, self.num_features))
+            for j in range(self.num_features // 2):
+                data[:, j] = rng.integers(0, self._LOW_CARD, rows)
+            yield RawChunk(data)
+            lo += rows
+            idx += 1
+
+
+class _NpyChunkSource(ArrayChunkSource):
+    """Array source rehydrated in a worker process from the spill the
+    coordinator wrote (``np.load(mmap_mode="r")`` — O(chunk) resident).
+    Same ``kind``/shape as the original, so fingerprints agree."""
+
+    def __init__(self, path: str, chunk_rows: int,
+                 label_path: Optional[str] = None) -> None:
+        data = np.load(path, mmap_mode="r")
+        label = np.load(label_path) if label_path else None
+        super().__init__(data, chunk_rows, label=label)
+
+
+def enumerate_stripes(source: ChunkSource) -> Tuple[int, Optional[list]]:
+    """The stripe universe of ``source``: ``(num_stripes, offsets)``
+    where ``offsets`` is the recorded byte offset per stripe for text
+    sources (workers seek instead of re-reading the prefix) and ``None``
+    otherwise."""
+    if isinstance(source, TextStripeSource):
+        if source.fmt == "libsvm":
+            log.fatal(
+                "sharded ingest does not support LibSVM input: its "
+                "feature width is discovered monotonically during a "
+                "sequential pass, which is order-dependent and breaks "
+                "the bit-identity contract; convert to CSV/TSV or "
+                "Parquet (row groups shard naturally)")
+        from . import parser
+        offsets = [off for off, _ in parser.iter_stripe_texts(
+            source.path, stripe_bytes=source.stripe_bytes,
+            skip_header=source.has_header)]
+        if not offsets:
+            log.fatal(f"sharded ingest saw no stripes in {source.path!r}")
+        return len(offsets), offsets
+    if isinstance(source, ParquetChunkSource):
+        return max(1, source.num_row_groups), None
+    if source.num_rows is not None:
+        rows = int(getattr(source, "chunk_rows", 0)) or 1
+        return max(1, math.ceil(source.num_rows / rows)), None
+    log.fatal(f"sharded ingest needs a stripe-enumerable source; "
+              f"{source.kind!r} has unknown length and is not striped")
+
+
+def stripe_row_offsets(source: ChunkSource,
+                       num_stripes: int) -> Optional[np.ndarray]:
+    """Global row offset of each stripe, when knowable up front (needed
+    to slice the deterministic bin-construction sample row set exactly
+    like the sequential pass).  ``None`` for unknown-length sources —
+    those sketch every row, so no offsets are needed."""
+    if source.num_rows is None:
+        return None
+    if isinstance(source, ParquetChunkSource):
+        rows = [source._pf.metadata.row_group(g).num_rows
+                for g in range(source.num_row_groups)]
+        return np.concatenate([[0], np.cumsum(rows)[:-1]]).astype(np.int64)
+    cr = int(getattr(source, "chunk_rows", 0)) or 1
+    return (np.arange(num_stripes, dtype=np.int64) * cr)
+
+
+def _read_stripe(source: ChunkSource, stripe: int) -> Optional[RawChunk]:
+    for chunk in source.chunks(int(stripe)):
+        return chunk
+    return None
+
+
+def _source_spec(source: ChunkSource, workdir: str) -> Dict[str, Any]:
+    """Serializable descriptor a worker process rebuilds the source
+    from.  In-memory arrays are spilled to the workdir once (float64,
+    the exact post-``_as_2d_float`` bytes) so workers mmap them."""
+    if isinstance(source, SyntheticChunkSource):
+        return {"kind": "synthetic", "num_rows": source.num_rows,
+                "num_features": source.num_features,
+                "chunk_rows": source.chunk_rows}
+    if isinstance(source, TextStripeSource):
+        return {"kind": "text", "path": source.path,
+                "stripe_bytes": source.stripe_bytes}
+    if isinstance(source, ParquetChunkSource):
+        return {"kind": "parquet", "path": source.path}
+    if isinstance(source, ArrayChunkSource):
+        # rewrite the spill every time: same bytes on a resume, and a
+        # workdir reused for new data never serves workers stale rows
+        spill = os.path.join(workdir, "source_data.npy")
+        np.save(spill + ".tmp.npy", np.asarray(source.arr))
+        os.replace(spill + ".tmp.npy", spill)
+        spec: Dict[str, Any] = {"kind": "npy", "path": spill,
+                                "chunk_rows": source.chunk_rows}
+        if source.label is not None:
+            lpath = os.path.join(workdir, "source_label.npy")
+            np.save(lpath + ".tmp.npy", source.label)
+            os.replace(lpath + ".tmp.npy", lpath)
+            spec["label_path"] = lpath
+        return spec
+    log.fatal(f"sharded ingest cannot ship a {source.kind!r} source to "
+              "worker processes; pass a text/parquet path, an array, or "
+              "a SyntheticChunkSource")
+
+
+def _source_from_spec(spec: Dict[str, Any], cfg: Config) -> ChunkSource:
+    kind = spec.get("kind")
+    if kind == "synthetic":
+        return SyntheticChunkSource(spec["num_rows"], spec["num_features"],
+                                    spec["chunk_rows"])
+    if kind == "text":
+        return TextStripeSource(spec["path"], cfg,
+                                stripe_bytes=spec.get("stripe_bytes"))
+    if kind == "parquet":
+        return ParquetChunkSource(spec["path"])
+    if kind == "npy":
+        return _NpyChunkSource(spec["path"], spec["chunk_rows"],
+                               label_path=spec.get("label_path"))
+    log.fatal(f"unknown sharded-ingest source spec kind {kind!r}")
+
+
+# ---------------------------------------------------------------------------
+# per-stripe work (both sides run the same code)
+# ---------------------------------------------------------------------------
+def _sketch_stripe_arrays(chunk: RawChunk, alpha: float,
+                          sample_rows: Optional[np.ndarray],
+                          row_lo: Optional[int]) -> Dict[str, np.ndarray]:
+    """Pass-1 work for one stripe: per-feature summaries over exactly
+    the rows the sequential pass would have fed them (the global
+    bin-construction sample sliced at this stripe's row range), plus the
+    stripe's side columns.  The commit is self-contained — the merge
+    needs nothing else."""
+    data = chunk.data
+    rows = data.shape[0]
+    if sample_rows is None or row_lo is None:
+        sel = data
+    else:
+        i0 = np.searchsorted(sample_rows, row_lo)
+        i1 = np.searchsorted(sample_rows, row_lo + rows)
+        sel = data[sample_rows[i0:i1] - row_lo]
+    arrays: Dict[str, np.ndarray] = {
+        "rows": np.int64(rows),
+        "n_features": np.int64(data.shape[1]),
+    }
+    for j in range(data.shape[1]):
+        fs = FeatureSummary(alpha)
+        fs.update(sel[:, j])
+        for k, v in fs.state().items():
+            arrays[f"f{j}_{k}"] = v
+    if chunk.label is not None:
+        arrays["labels"] = np.asarray(chunk.label, np.float64)
+    if chunk.weight is not None:
+        arrays["weights"] = np.asarray(chunk.weight, np.float64)
+    if chunk.qid is not None:
+        arrays["qids"] = np.asarray(chunk.qid, np.int64)
+    return arrays
+
+
+def _summary_from_commit(z, j: int, alpha: float) -> FeatureSummary:
+    prefix = f"f{j}_"
+    st = {k[len(prefix):]: z[k] for k in z.files if k.startswith(prefix)}
+    return FeatureSummary.from_state(alpha, st)
+
+
+# ---------------------------------------------------------------------------
+# the ledger sweep (claim -> process -> commit, stealing from the dead)
+# ---------------------------------------------------------------------------
+class _Sweeper:
+    """One worker's view of one pass: sweep the stripe universe until
+    every stripe is committed — by anyone.  The sweep is the steal loop:
+    a stripe claimed by a rank whose heartbeats aged past
+    ``heartbeat_timeout_s`` (or whose pid is provably gone on this host)
+    is reassigned here."""
+
+    def __init__(self, workdir: str, tag: str, rank: int,
+                 num_stripes: int, cfg: Config, *,
+                 batch: int = 1, fault: Optional[dict] = None,
+                 pid_fence: bool = False, label: str = "") -> None:
+        self.workdir = str(workdir)
+        self.tag = tag
+        self.label = str(label)
+        self.rank = int(rank)
+        self.num_stripes = int(num_stripes)
+        self.interval_s = float(cfg.heartbeat_interval_s)
+        self.timeout_s = float(cfg.heartbeat_timeout_s)
+        self.stall_timeout_s = float(cfg.cluster_timeout_s)
+        self.batch = max(1, int(batch))
+        self.fault = fault
+        self.pid_fence = bool(pid_fence)
+        self.coord = os.path.join(self.workdir, "coord")
+        self._claims = 0
+        self._beat = 0
+        self._dead_seen: set = set()
+        os.makedirs(os.path.join(self.workdir, "claims"), exist_ok=True)
+        os.makedirs(os.path.join(self.workdir, "commits"), exist_ok=True)
+
+    # ------------------------------------------------------------ liveness
+    def heartbeat(self) -> None:
+        from ..robustness.elastic import publish_heartbeat
+        publish_heartbeat(self.coord, _EPOCH[self.tag], self.rank,
+                          self._beat)
+        self._beat += 1
+
+    def _owner_dead(self, claim: dict) -> Tuple[bool, float]:
+        age = _owner_age(self.workdir, self.tag, claim)
+        if claim.get("rank") == self.rank and \
+                claim.get("pid") != os.getpid():
+            # a previous incarnation of THIS rank (coordinator restart
+            # respawned us): its claim can never be committed by anyone
+            # else, and its heartbeats are ours now — steal immediately
+            return True, age
+        if self.pid_fence and not _pid_alive(claim.get("pid")):
+            # single-host mode (trainer collect): the owner is this
+            # host's own dead predecessor; no need to wait out the
+            # timeout to know it will never commit
+            return True, age
+        from ..robustness.elastic import DEAD, age_state
+        return age_state(age, interval_s=self.interval_s,
+                         timeout_s=self.timeout_s) == DEAD, age
+
+    def _steal_leader(self, claim: dict) -> bool:
+        """Deterministic steal leadership: only the LOWEST-ranked live
+        worker (dead owner excluded) performs a given steal.  Every
+        survivor converges on the same leader from the heartbeats alone,
+        so two survivors practically never race the same claim — and the
+        atomic-replace + re-read in ``steal_claim`` still resolves the
+        residual window if they do."""
+        from ..robustness.elastic import (DEAD, age_state, heartbeat_path,
+                                          read_heartbeat)
+        for r in range(self.rank):
+            if r == claim.get("rank"):
+                continue
+            hb = read_heartbeat(heartbeat_path(
+                self.coord, _EPOCH[self.tag], r))
+            if hb is None:
+                continue
+            age = time.time() - float(hb.get("unix_time", 0.0))
+            if age_state(age, interval_s=self.interval_s,
+                         timeout_s=self.timeout_s) != DEAD:
+                return False
+        return True
+
+    def _note_death(self, claim: dict, age: float) -> None:
+        key = (claim.get("rank"), claim.get("pid"))
+        if key in self._dead_seen:
+            return
+        self._dead_seen.add(key)
+        emit_event("ingest_worker_dead", rank=self.rank,
+                   dead_rank=claim.get("rank"), stage=self.tag,
+                   age_s=round(age, 3))
+        count_event("ingest_worker_deaths")
+
+    def _maybe_die(self) -> None:
+        # drill seam: an armed worker SIGKILLs itself right after its
+        # (after_stripes+1)-th CLAIM of the named pass — leaving a
+        # claimed-but-uncommitted stripe for the survivors to steal,
+        # the exact window work-stealing exists for
+        f = self.fault
+        if f and f.get("pass") == self.tag and \
+                self._claims > int(f.get("after_stripes", 0)):
+            import signal
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    # --------------------------------------------------------------- sweep
+    def _acquire(self, stripe: int) -> bool:
+        """Own ``stripe`` if possible: fresh claim, our own residual
+        claim, or a steal from a dead owner."""
+        extra = {"ledger": self.label} if self.label else {}
+        if try_claim(self.workdir, self.tag, stripe, self.rank):
+            self._claims += 1
+            emit_event("ingest_stripe_claimed", rank=self.rank,
+                       stripe=stripe, stage=self.tag, generation=0,
+                       **extra)
+            self._maybe_die()
+            return True
+        claim = read_claim(self.workdir, self.tag, stripe)
+        if claim is None:
+            return False  # torn or racing; revisit next sweep
+        if claim.get("rank") == self.rank and \
+                claim.get("pid") == os.getpid():
+            return True   # ours already (crash window between claim+work)
+        dead, age = self._owner_dead(claim)
+        if not dead:
+            return False
+        self._note_death(claim, age)
+        if not self._steal_leader(claim):
+            return False  # a lower-ranked live survivor will steal
+        if not steal_claim(self.workdir, self.tag, stripe, self.rank,
+                           claim):
+            return False  # another survivor won the steal race
+        self._claims += 1
+        emit_event("ingest_stripe_reassigned", rank=self.rank,
+                   stripe=stripe, stage=self.tag,
+                   from_rank=claim.get("rank"), to_rank=self.rank,
+                   generation=int(claim.get("generation", 0)) + 1,
+                   age_s=round(age, 3), **extra)
+        count_event("ingest_stripes_reassigned")
+        self._maybe_die()
+        return True
+
+    def sweep(self, process) -> None:
+        """Run until every stripe of this pass is committed.
+        ``process(stripe)`` does the stripe's work and commits it."""
+        poll = max(0.01, min(self.interval_s / 2.0, 0.1))
+        last_done = -1
+        stalled_at = time.monotonic()
+        while True:
+            self.heartbeat()
+            progress = False
+            pending: List[int] = []
+            for s in range(self.num_stripes):
+                if os.path.exists(commit_path(self.workdir, self.tag, s)):
+                    continue
+                if self._acquire(s):
+                    pending.append(s)
+                if len(pending) >= self.batch:
+                    for p in pending:
+                        process(p)
+                        self.heartbeat()
+                    progress = True
+                    pending = []
+            for p in pending:
+                process(p)
+                self.heartbeat()
+                progress = True
+            done = committed_stripes(self.workdir, self.tag,
+                                     self.num_stripes)
+            if len(done) == self.num_stripes:
+                return
+            if progress or len(done) > last_done:
+                # progress anywhere in the fleet resets the deadline —
+                # an idle worker watching others commit is not wedged
+                last_done = len(done)
+                stalled_at = time.monotonic()
+            elif time.monotonic() - stalled_at > self.stall_timeout_s:
+                log.fatal(
+                    f"pass {self.tag}: no stripe committed anywhere for "
+                    f"{self.stall_timeout_s:.0f}s "
+                    f"({len(done)}/{self.num_stripes} done) — the fleet "
+                    "is wedged; raise cluster_timeout_s or inspect the "
+                    "worker logs")
+            if not progress:
+                time.sleep(poll)
+
+
+# ---------------------------------------------------------------------------
+# worker process (python -m lightgbm_tpu.io.sharded <spec.json>)
+# ---------------------------------------------------------------------------
+def _commit_sketch_stripe(workdir: str, tag: str, stripe: int,
+                          source: ChunkSource, alpha: float,
+                          sample_rows: Optional[np.ndarray],
+                          row_offs: Optional[np.ndarray],
+                          rank: int) -> None:
+    chunk = _read_stripe(source, stripe)
+    if chunk is None:
+        log.fatal(f"stripe {stripe} vanished from the source mid-ingest "
+                  "(the ledger was enumerated over different data)")
+    row_lo = None if row_offs is None else int(row_offs[stripe])
+    arrays = _sketch_stripe_arrays(chunk, alpha, sample_rows, row_lo)
+    _save_npz_atomic(commit_path(workdir, tag, stripe), arrays)
+    count_event("ingest_rows_streamed", int(arrays["rows"]))
+    count_event("ingest_shards_done")
+    emit_event("ingest_shard_done", rank=rank, stage="sketch",
+               shard=stripe, rows=int(arrays["rows"]))
+    if _stripe_hook is not None:
+        _stripe_hook(tag, stripe)
+
+
+def _commit_bin_stripe(workdir: str, stripe: int, source: ChunkSource,
+                       ing: StreamingIngest, plan2: Dict[str, Any],
+                       bufs: Dict[str, np.ndarray], rank: int) -> None:
+    chunk = _read_stripe(source, stripe)
+    if chunk is None:
+        log.fatal(f"stripe {stripe} vanished from the source mid-ingest")
+    from .bundling import apply_bundles
+    offsets = plan2["row_offsets"]
+    lo, hi = int(offsets[stripe]), int(offsets[stripe + 1])
+    vbins = ing._bin_chunk(chunk.data)
+    out = apply_bundles(vbins, ing.plan) if ing.plan is not None else vbins
+    bufs["bins"][lo:hi] = out
+    pad = int(plan2["pad"])
+    if pad:
+        out = np.concatenate(
+            [out, np.zeros((out.shape[0], pad), np.uint8)], axis=1)
+    bufs["packed"][lo:hi] = np.ascontiguousarray(out).view(np.int32) \
+        .reshape(out.shape[0], int(plan2["n_words"]))
+    if bufs.get("raw") is not None:
+        width = chunk.data.shape[1]
+        for col, j in enumerate(ing.used_feature_idx):
+            bufs["raw"][lo:hi, col] = \
+                chunk.data[:, j].astype(np.float32) if j < width else 0.0
+    for name in ("bins", "packed", "raw"):
+        if bufs.get(name) is not None:
+            bufs[name].flush()
+    _write_atomic(commit_path(workdir, PASS_BIN, stripe),
+                  json.dumps({"stripe": stripe, "rows": hi - lo}))
+    count_event("ingest_shards_done")
+    emit_event("ingest_shard_done", rank=rank, stage="bin", shard=stripe,
+               rows=hi - lo)
+    if _stripe_hook is not None:
+        _stripe_hook(PASS_BIN, stripe)
+
+
+def _open_pass2_buffers(workdir: str, plan2: Dict[str, Any],
+                        used: int) -> Dict[str, np.ndarray]:
+    n = int(plan2["num_rows"])
+    bufs: Dict[str, Optional[np.ndarray]] = {
+        "bins": np.memmap(os.path.join(workdir, "bins.u8"), np.uint8,
+                          mode="r+", shape=(n, int(plan2["n_cols"]))),
+        "packed": np.memmap(os.path.join(workdir, "packed.i32"), np.int32,
+                            mode="r+", shape=(n, int(plan2["n_words"]))),
+        "raw": None,
+    }
+    if plan2.get("linear_raw"):
+        bufs["raw"] = np.memmap(os.path.join(workdir, "raw.f32"),
+                                np.float32, mode="r+", shape=(n, used))
+    return bufs
+
+
+def _read_pass2_plan(workdir: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(os.path.join(workdir, "pass2_plan.json")) as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return None
+
+
+def _worker_main(spec_path: str) -> int:
+    with open(spec_path) as fh:
+        spec = json.load(fh)
+    cfg = as_config(spec["params"])
+    workdir = spec["workdir"]
+    rank = int(spec["rank"])
+    led = read_ledger(workdir)
+    if led is None:
+        log.fatal(f"worker {rank}: no readable stripe ledger in "
+                  f"{workdir!r}")
+    source = _source_from_spec(spec["source"], cfg)
+    if hasattr(source, "chunk_rows"):
+        source.chunk_rows = int(led["chunk_rows"])
+    if isinstance(source, TextStripeSource) and led.get("stripe_offsets"):
+        source._offsets = [int(o) for o in led["stripe_offsets"]]
+        source.num_features = led.get("num_features") or None
+    S = int(led["num_stripes"])
+    alpha = float(cfg.ingest_sketch_accuracy)
+    fault = spec.get("fault")
+    from ..obs import events as obs_events
+    with obs_events.session(spec.get("event_output"), rank=rank):
+        # ready marker: the coordinator's startup barrier
+        _write_atomic(os.path.join(workdir, "coord", f"ready_r{rank}.json"),
+                      json.dumps({"rank": rank, "pid": os.getpid()}))
+        # go marker: the coordinator releases the whole fleet at once,
+        # so every worker enters pass 1 together — a late-spawning
+        # worker is not silently cut out of the claim race
+        go = os.path.join(workdir, "coord", "go.json")
+        deadline = time.monotonic() + float(cfg.cluster_timeout_s)
+        while not os.path.exists(go):
+            if time.monotonic() > deadline:
+                log.fatal(f"worker {rank}: coordinator never released "
+                          "the start barrier")
+            time.sleep(0.02)
+
+        # ---- pass 1: sketch stripes off the ledger
+        ing = StreamingIngest(source, cfg, None)  # sample/bin helpers only
+        sample_rows = ing._sample_rows()
+        row_offs = stripe_row_offsets(source, S)
+        sweep1 = _Sweeper(workdir, PASS_SKETCH, rank, S, cfg,
+                          batch=int(cfg.ingest_stripe_batch), fault=fault)
+        sweep1.sweep(lambda s: _commit_sketch_stripe(
+            workdir, PASS_SKETCH, s, source, alpha, sample_rows,
+            row_offs, rank))
+
+        # ---- barrier: wait for the coordinator's merge artifacts
+        sweep2 = _Sweeper(workdir, PASS_BIN, rank, S, cfg,
+                          batch=int(cfg.ingest_stripe_batch), fault=fault)
+        deadline = time.monotonic() + float(cfg.cluster_timeout_s)
+        while True:
+            sweep2.heartbeat()
+            plan2 = _read_pass2_plan(workdir)
+            if plan2 is not None:
+                break
+            if time.monotonic() > deadline:
+                log.fatal(f"worker {rank}: coordinator never published "
+                          "the pass-2 plan")
+            time.sleep(0.05)
+
+        # ---- pass 2: bin stripes into the shared memmaps
+        wing = StreamingIngest(source, cfg, workdir)
+        if not wing._load_mappers() or not wing._load_plan():
+            log.fatal(f"worker {rank}: merge artifacts unreadable in "
+                      f"{workdir!r}")
+        bufs = _open_pass2_buffers(workdir, plan2,
+                                   len(wing.used_feature_idx))
+        sweep2.sweep(lambda s: _commit_bin_stripe(
+            workdir, s, source, wing, plan2, bufs, rank))
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# coordinator
+# ---------------------------------------------------------------------------
+def _worker_journal_base() -> Optional[str]:
+    from ..obs import events as obs_events
+    j = obs_events.active()
+    return j.path if j is not None else None
+
+
+def _wait_stripe_commits(workdir: str, tag: str, num_stripes: int,
+                         procs: Sequence, timeout_s: float,
+                         logs: Sequence[str]) -> None:
+    """Block until every stripe of ``tag`` is committed.  Worker deaths
+    are survivable (that is the point) — only ALL workers exiting with
+    stripes still open, or the cluster deadline, is fatal."""
+    deadline = time.monotonic() + float(timeout_s)
+    while True:
+        done = committed_stripes(workdir, tag, num_stripes)
+        if len(done) == num_stripes:
+            return
+        if all(p.poll() is not None for p in procs):
+            done = committed_stripes(workdir, tag, num_stripes)
+            if len(done) == num_stripes:
+                return
+            tails = []
+            for lp in logs:
+                try:
+                    with open(lp, "rb") as fh:
+                        tails.append(f"--- {os.path.basename(lp)} ---\n"
+                                     + fh.read()[-2000:].decode(
+                                         errors="replace"))
+                except OSError:
+                    pass
+            raise log.LightGBMError(
+                f"every sharded-ingest worker exited with "
+                f"{num_stripes - len(done)} stripe(s) of pass {tag!r} "
+                "uncommitted; worker logs:\n" + "\n".join(tails))
+        if time.monotonic() > deadline:
+            raise log.LightGBMError(
+                f"sharded ingest pass {tag!r} timed out with "
+                f"{num_stripes - len(done)} stripe(s) uncommitted")
+        time.sleep(0.05)
+
+
+def _merge_pass1(ing: StreamingIngest, workdir: str,
+                 num_stripes: int) -> None:
+    """Fold every per-stripe summary commit into ``ing`` IN STRIPE
+    ORDER.  Summary merge is order-invariant, so the order only matters
+    for the concatenated side columns (labels line up with rows); the
+    distributions — and everything derived from them — equal the
+    sequential pass's bit for bit."""
+    alpha = ing.alpha
+    for s in range(num_stripes):
+        z = np.load(commit_path(workdir, PASS_SKETCH, s))
+        nf = int(z["n_features"])
+        while len(ing.summaries) < nf:
+            ing.summaries.append(FeatureSummary(alpha))
+        for j in range(nf):
+            ing.summaries[j].merge(_summary_from_commit(z, j, alpha))
+        ing.shard_rows.append(int(z["rows"]))
+        if "labels" in z.files:
+            ing._labels.append(z["labels"])
+        if "weights" in z.files:
+            ing._weights.append(z["weights"])
+        if "qids" in z.files:
+            ing._qids.append(z["qids"])
+    ing.num_rows = sum(ing.shard_rows)
+    ing.num_features = len(ing.summaries)
+    if ing.num_rows == 0 or ing.num_features == 0:
+        log.fatal("sharded ingest saw no data "
+                  f"(rows={ing.num_rows}, features={ing.num_features})")
+
+
+def _fresh_workdir(workdir: str) -> None:
+    """Drop every protocol artifact of a previous, different ingest
+    (fingerprint mismatch) so no stale claim/commit can leak in."""
+    import shutil
+    for sub in ("claims", "commits", "coord"):
+        shutil.rmtree(os.path.join(workdir, sub), ignore_errors=True)
+    for name in (LEDGER_NAME, "pass2_plan.json", "mappers.json",
+                 "plan.json", "plan.npz", "ingest_manifest.json",
+                 "bins.u8", "packed.i32", "raw.f32",
+                 "source_data.npy", "source_label.npy"):
+        try:
+            os.remove(os.path.join(workdir, name))
+        except OSError:
+            pass
+
+
+def shard_stream_inner_dataset(
+        data: Any, label=None,
+        config: Optional[Any] = None, *,
+        workdir: str, weight=None, group=None, init_score=None,
+        feature_names: Optional[List[str]] = None,
+        categorical_feature=None, chunk_rows: Optional[int] = None,
+        faults: Optional[Dict[int, dict]] = None):
+    """Multi-host out-of-core construction (module docstring).
+
+    ``ingest_workers <= 1`` delegates to the single-host
+    ``stream_inner_dataset`` path unchanged — no ledger, no worker
+    processes, byte-identical artifacts.  ``faults`` is the drill seam:
+    ``{rank: {"pass": "p1"|"p2", "after_stripes": k}}`` arms rank's
+    self-SIGKILL after its ``k+1``-th claim of that pass."""
+    from .streaming import stream_inner_dataset
+    cfg = as_config(config)
+    W = int(cfg.ingest_workers)
+    if W <= 1:
+        return stream_inner_dataset(
+            data, label=label, config=cfg, workdir=workdir, weight=weight,
+            group=group, init_score=init_score,
+            feature_names=feature_names,
+            categorical_feature=categorical_feature, chunk_rows=chunk_rows)
+    if not workdir:
+        log.fatal("sharded ingest (ingest_workers >= 2) requires a "
+                  "workdir: the stripe ledger, claims and commits are "
+                  "its coordination substrate")
+    workdir = str(workdir)
+    os.makedirs(workdir, exist_ok=True)
+
+    source = make_source(data, cfg, chunk_rows)
+    cr = clamp_chunk_rows(
+        int(getattr(source, "chunk_rows", cfg.ingest_chunk_rows)),
+        source.num_features, float(cfg.ingest_memory_budget_mb))
+    if hasattr(source, "chunk_rows"):
+        source.chunk_rows = cr
+
+    # ---- ledger: create fresh, or re-enter a matching one
+    fp = source.fingerprint()
+    led = read_ledger(workdir)
+    resumed = led is not None and led.get("fingerprint") == fp and \
+        int(led.get("chunk_rows", -1)) == cr
+    if led is not None and not resumed:
+        log.warning(f"sharded-ingest workdir {workdir!r} holds a ledger "
+                    "for a different source/chunking; restarting from "
+                    "scratch")
+        _fresh_workdir(workdir)
+        led = None
+    if led is None:
+        S, offsets = enumerate_stripes(source)
+        led = {"kind": "sharded_ingest", "fingerprint": fp,
+               "chunk_rows": cr, "num_stripes": S,
+               "passes": [PASS_SKETCH, PASS_BIN],
+               "ingest_workers": W,
+               "stripe_batch": int(cfg.ingest_stripe_batch),
+               "complete": False}
+        if offsets is not None:
+            led["stripe_offsets"] = offsets
+            led["num_features"] = source.num_features
+        write_ledger(workdir, led)
+    S = int(led["num_stripes"])
+    os.makedirs(os.path.join(workdir, "coord"), exist_ok=True)
+    os.makedirs(os.path.join(workdir, "claims"), exist_ok=True)
+    os.makedirs(os.path.join(workdir, "commits"), exist_ok=True)
+
+    if resumed:
+        emit_event("ingest_resumed", stage="sharded",
+                   sketch_shards=len(committed_stripes(
+                       workdir, PASS_SKETCH, S)),
+                   bin_shards=len(committed_stripes(workdir, PASS_BIN, S)),
+                   workdir=workdir)
+        count_event("ingest_resumes")
+    else:
+        emit_event("ingest_started", source=source.kind, chunk_rows=cr,
+                   workdir=workdir, stripes=S, workers=W)
+
+    # ---- spawn the worker fleet (cluster spawn substrate)
+    from ..parallel.cluster import spawn_worker, wait_for_markers
+    src_spec = _source_spec(source, workdir)
+    base = _worker_journal_base()
+    procs, logfiles, logpaths = [], [], []
+    for r in range(W):
+        spec = {"workdir": workdir, "rank": r,
+                "params": dict(cfg.to_dict()), "source": src_spec}
+        if base is not None:
+            from ..obs.merge import rank_file_path
+            spec["event_output"] = rank_file_path(base, 0, r)
+        if faults and r in faults:
+            spec["fault"] = dict(faults[r])
+        spec_path = os.path.join(workdir, f"spec_r{r}.json")
+        _write_atomic(spec_path, json.dumps(spec))
+        log_path = os.path.join(workdir, f"log_r{r}.log")
+        proc, lf = spawn_worker("lightgbm_tpu.io.sharded", spec_path,
+                                log_path)
+        procs.append(proc)
+        logfiles.append(lf)
+        logpaths.append(log_path)
+
+    try:
+        wait_for_markers(
+            [os.path.join(workdir, "coord", f"ready_r{r}.json")
+             for r in range(W)],
+            float(cfg.cluster_timeout_s),
+            alive=lambda: any(p.poll() is None for p in procs))
+        _write_atomic(os.path.join(workdir, "coord", "go.json"),
+                      json.dumps({"workers": W}))
+
+        # ---- pass 1 completes stripe by stripe; then merge
+        _wait_stripe_commits(workdir, PASS_SKETCH, S, procs,
+                             float(cfg.cluster_timeout_s), logpaths)
+        ing = StreamingIngest(source, cfg, workdir)
+        _merge_pass1(ing, workdir, S)
+        ing.manifest["sketch"] = {"complete": True}
+        ing.manifest["pass1"] = {"num_rows": ing.num_rows,
+                                 "num_features": ing.num_features}
+        fnames = feature_names or [f"Column_{i}"
+                                   for i in range(ing.num_features)]
+        from .dataset import _resolve_categorical
+        cat_idx = _resolve_categorical(categorical_feature, fnames)
+        ing._build_mappers(cat_idx, fnames)
+        ing._build_plan()   # dedicated sampling pass: bins the exact
+        ing._save_plan()    # plan_bundles row set (streaming.py _pass1)
+
+        # ---- publish the pass-2 plan + pre-size the shared buffers
+        n_cols = ing.plan.num_bundles if ing.plan is not None \
+            else len(ing.used_feature_idx)
+        pad = (-n_cols) % 4
+        plan2 = {"num_rows": int(ing.num_rows), "n_cols": int(n_cols),
+                 "pad": int(pad), "n_words": int((n_cols + pad) // 4),
+                 "linear_raw": bool(cfg.linear_tree),
+                 "row_offsets": [0] + [int(v) for v in
+                                       np.cumsum(ing.shard_rows)]}
+        bins = ing._alloc("bins.u8", (ing.num_rows, n_cols), np.uint8,
+                          resume=True)
+        packed = ing._alloc("packed.i32",
+                            (ing.num_rows, plan2["n_words"]), np.int32,
+                            resume=True)
+        raw = None
+        if bool(cfg.linear_tree):
+            raw = ing._alloc("raw.f32",
+                             (ing.num_rows, len(ing.used_feature_idx)),
+                             np.float32, resume=True)
+        for buf in (bins, packed, raw):
+            if buf is not None:
+                buf.flush()
+        _write_atomic(os.path.join(workdir, "pass2_plan.json"),
+                      json.dumps(plan2))
+        emit_event("ingest_merge_completed", stripes=S,
+                   rows=ing.num_rows, features=ing.num_features,
+                   workers=W, columns=n_cols)
+
+        # ---- pass 2 completes stripe by stripe; assemble the Dataset
+        _wait_stripe_commits(workdir, PASS_BIN, S, procs,
+                             float(cfg.cluster_timeout_s), logpaths)
+        for p in procs:
+            p.wait(timeout=float(cfg.cluster_timeout_s))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for lf in logfiles:
+            lf.close()
+
+    from .dataset import Dataset, Metadata
+    ds = Dataset()
+    ds.config = cfg
+    ds.num_total_features = ing.num_features
+    ds.feature_names = fnames
+    ds.mappers = ing.mappers
+    ds.used_feature_idx = list(ing.used_feature_idx)
+    ds.bundle_plan = ing.plan
+    ds.bins = bins
+    ds._packed_mirror = packed
+    ds.raw = raw
+    ds.metadata = Metadata(ing.num_rows)
+    if label is None and ing._labels:
+        label = np.concatenate(ing._labels)
+    if label is not None:
+        ds.metadata.set_label(label)
+    if weight is None and ing._weights:
+        weight = np.concatenate(ing._weights)
+    ds.metadata.set_weight(weight)
+    if group is None and ing._qids:
+        qid = np.concatenate(ing._qids)
+        change = np.r_[True, qid[1:] != qid[:-1]]
+        group = np.diff(np.r_[np.flatnonzero(change), len(qid)])
+    ds.metadata.set_group(group)
+    ds.metadata.set_init_score(init_score)
+    if isinstance(source, TextStripeSource):
+        from .parser import load_companion_files
+        side: Dict[str, Any] = {}
+        load_companion_files(source.path, side)
+        if ds.metadata.weight is None and "weight" in side:
+            ds.metadata.set_weight(side["weight"])
+        if ds.metadata.query_boundaries is None and "group" in side:
+            ds.metadata.set_group(side["group"])
+        if ds.metadata.init_score is None and "init_score" in side:
+            ds.metadata.set_init_score(side["init_score"])
+        if "position" in side:
+            ds.metadata.set_position(side["position"])
+    ds.ingest_provenance = {
+        "streamed": True,
+        "sharded": True,
+        "source": source.kind,
+        "chunk_rows": cr,
+        "stripes": S,
+        "workers": W,
+        "sketch_accuracy": ing.alpha,
+        "sketched_features": list(getattr(ing, "sketched_features", [])),
+        "resumed": bool(resumed),
+        "ledger_fingerprint": ledger_fingerprint(led),
+    }
+    ing.manifest["complete"] = True
+    ing._commit_manifest()
+    led["complete"] = True
+    write_ledger(workdir, led)
+    emit_event("ingest_completed", rows=ing.num_rows,
+               features=ing.num_features, columns=int(bins.shape[1]),
+               sketched=len(getattr(ing, "sketched_features", [])))
+    return ds
+
+
+def shard_stream_dataset(data: Any, label=None, params=None, *,
+                         workdir: str, weight=None, group=None,
+                         init_score=None,
+                         feature_names: Optional[List[str]] = None,
+                         categorical_feature=None,
+                         chunk_rows: Optional[int] = None,
+                         faults: Optional[Dict[int, dict]] = None):
+    """User-facing elastic multi-host constructor: ``stream_dataset``
+    semantics with ``params["ingest_workers"]`` worker processes
+    sharding both passes over the stripe ledger.  Output is
+    bit-identical to ``stream_dataset`` over the same input regardless
+    of worker count or worker deaths."""
+    from ..basic import Dataset as UserDataset
+    inner = shard_stream_inner_dataset(
+        data, label=label, config=params, workdir=workdir, weight=weight,
+        group=group, init_score=init_score, feature_names=feature_names,
+        categorical_feature=categorical_feature, chunk_rows=chunk_rows,
+        faults=faults)
+    p = params if isinstance(params, dict) else \
+        (dict(params.to_dict()) if hasattr(params, "to_dict") else None)
+    return UserDataset.from_inner(inner, p)
+
+
+# ---------------------------------------------------------------------------
+# in-process collect (the ContinuousTrainer ingest phase)
+# ---------------------------------------------------------------------------
+def sharded_collect(source: ChunkSource, limit: int, workdir: str,
+                    cfg: Config, *, label: str = ""):
+    """Stripe-ledger flavor of ``ContinuousTrainer._collect``: the first
+    ``limit`` chunks of ``source``, each committed as one stripe before
+    use.  One claimant (this process), but the full claim/commit
+    protocol — so a SIGKILLed cycle resumes by LOADING its committed
+    stripes (exactly-once: no row is ever streamed into a cycle twice),
+    and a predecessor's orphaned claim is stolen via the pid fence
+    instead of a heartbeat wait.  Returns ``(X, y, chunks_taken)`` with
+    the exact semantics (dtype, concat order, dry-source behavior) of
+    the in-memory collect."""
+    workdir = str(workdir)
+    os.makedirs(workdir, exist_ok=True)
+    fp = source.fingerprint()
+    led = read_ledger(workdir)
+    if led is not None:
+        stripes = int(led.get("num_stripes", -1))
+        # a COMPLETE ledger may record fewer stripes than asked for: the
+        # source ran dry below the limit, and re-asking cannot grow it
+        ok = led.get("fingerprint") == fp and (
+            stripes == int(limit)
+            or (led.get("complete") and stripes <= int(limit)))
+        if not ok:
+            log.warning(f"collect ledger {workdir!r} belongs to a "
+                        "different source/limit; restarting the "
+                        "cycle's ingest")
+            _fresh_workdir(workdir)
+            led = None
+    if led is None:
+        led = {"kind": "sharded_ingest", "fingerprint": fp,
+               "chunk_rows": int(getattr(source, "chunk_rows", 0)),
+               "num_stripes": int(limit), "passes": [PASS_COLLECT],
+               "ingest_workers": 1, "stripe_batch": 1, "complete": False}
+        write_ledger(workdir, led)
+    done = committed_stripes(workdir, PASS_COLLECT, limit)
+    if done:
+        emit_event("ingest_resumed", stage="collect", ledger=label,
+                   sketch_shards=len(done), workdir=workdir)
+        count_event("ingest_resumes")
+    sweeper = _Sweeper(workdir, PASS_COLLECT, 0, int(limit), cfg,
+                       pid_fence=True, label=label)
+    xs, ys, taken = [], [], 0
+    for stripe in range(int(limit)):
+        cpath = commit_path(workdir, PASS_COLLECT, stripe)
+        if stripe in done:
+            z = np.load(cpath)
+            xs.append(z["data"])
+            if "label" in z.files:
+                ys.append(z["label"])
+            taken += 1
+            continue
+        chunk = _read_stripe(source, stripe)
+        if chunk is None:
+            break  # source ran dry before limit (in-memory semantics)
+        if not sweeper._acquire(stripe):
+            log.fatal(f"collect stripe {stripe} is claimed by a live "
+                      "process; two trainers share one cycle workdir")
+        arrays: Dict[str, np.ndarray] = {
+            "data": np.asarray(chunk.data, np.float64),
+            "rows": np.int64(chunk.data.shape[0])}
+        if chunk.label is not None:
+            arrays["label"] = np.asarray(chunk.label,
+                                         np.float64).reshape(-1)
+        _save_npz_atomic(cpath, arrays)
+        count_event("ingest_shards_done")
+        count_event("ingest_rows_streamed", int(arrays["rows"]))
+        emit_event("ingest_shard_done", stage="collect", shard=stripe,
+                   rows=int(arrays["rows"]), ledger=label)
+        if _stripe_hook is not None:
+            _stripe_hook(PASS_COLLECT, stripe)
+        xs.append(arrays["data"])
+        if "label" in arrays:
+            ys.append(arrays["label"])
+        taken += 1
+    led["complete"] = True
+    led["num_stripes"] = int(taken)
+    write_ledger(workdir, led)
+    xs = [x for x in xs if x.shape[0]]
+    ys = [y for y in ys if y.shape[0]]
+    if not xs:
+        return None, None, taken
+    X = xs[0] if len(xs) == 1 else np.concatenate(xs, axis=0)
+    if len(ys) != len(xs):
+        log.fatal("ContinuousTrainer needs per-chunk labels (pass "
+                  "label= with array data, or a source whose chunks "
+                  "carry a label column)")
+    y = ys[0] if len(ys) == 1 else np.concatenate(ys, axis=0)
+    return X, y, taken
+
+
+def collect_ledger_fingerprint(workdir: str) -> Optional[str]:
+    """Fingerprint of the ledger in ``workdir`` (cycle-manifest field),
+    ``None`` when no readable ledger exists."""
+    led = read_ledger(workdir)
+    return None if led is None else ledger_fingerprint(led)
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(_worker_main(sys.argv[1]))

@@ -140,6 +140,27 @@ EVENTS: Dict[str, Tuple[str, str]] = {
         "info", "a streaming ingest finished: the binned dataset (and "
                 "its packed mirror) is complete and feeds train()/the "
                 "elastic cluster unchanged"),
+    "ingest_stripe_claimed": (
+        "info", "a sharded-ingest worker fenced ownership of a stripe "
+                "via an O_EXCL claim file on the stripe ledger "
+                "(io/sharded.py); the claim names the pass, worker rank "
+                "and steal generation"),
+    "ingest_stripe_reassigned": (
+        "warning", "a sharded-ingest stripe claimed by a dead worker "
+                   "was stolen by a survivor: the old claim was "
+                   "atomically replaced with a higher-generation one "
+                   "and the stripe will be re-done (it had no commit; "
+                   "committed stripes are never redone)"),
+    "ingest_worker_dead": (
+        "error", "a sharded-ingest worker's heartbeats went silent "
+                 "past heartbeat_timeout_s; survivors will steal its "
+                 "unclaimed and uncommitted stripes off the ledger"),
+    "ingest_merge_completed": (
+        "info", "the sharded-ingest coordinator merged every per-stripe "
+                "summary commit in stripe order — the order-invariant "
+                "FeatureSummary merge makes bin boundaries bit-identical "
+                "to the single-host build — and published the pass-2 "
+                "plan for the workers"),
     "cycle_started": (
         "info", "a continuous-learning cycle opened (pipeline/): the "
                 "trainer is about to ingest the cycle's fresh chunks"),

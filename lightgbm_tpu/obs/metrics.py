@@ -137,6 +137,12 @@ COUNTERS: Dict[str, str] = {
     "ingest_sketch_overflows":
         "per-feature exact distinct tallies that overflowed into the "
         "approximate quantile sketch (io/streaming.py)",
+    "ingest_stripes_reassigned":
+        "sharded-ingest stripes stolen from a dead worker's claim by "
+        "a survivor (io/sharded.py)",
+    "ingest_worker_deaths":
+        "sharded-ingest workers declared dead after heartbeat_timeout_s "
+        "of silence (io/sharded.py)",
     "pipeline_cycles_completed":
         "continuous-learning cycles acked end-to-end "
         "(pipeline/trainer.py)",

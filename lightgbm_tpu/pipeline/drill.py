@@ -103,7 +103,16 @@ def run_spec(spec: dict) -> dict:
 
     hook = None
     kill = spec.get("kill")
-    if kill:
+    if kill and kill["boundary"] == "ingest_stripe":
+        # SIGKILL inside the sharded-ingest collect, right after stripe
+        # ``stripe``'s commit file lands — a crash point the phase hook
+        # cannot reach (it only fires at cycle-boundary commits).  The
+        # committed stripe must survive the resume without re-reading.
+        from ..io import sharded
+        from ..robustness.faults import sharded_stripe_kill_hook
+        sharded._stripe_hook = sharded_stripe_kill_hook(
+            kill["stripe"], sharded.PASS_COLLECT)
+    elif kill:
         from ..robustness.faults import pipeline_kill_hook
         hook = pipeline_kill_hook(kill["boundary"], kill["cycle"])
 

@@ -191,6 +191,13 @@ class ContinuousTrainer:
             self.aot_store_dir = aot_cfg or os.path.join(
                 self.workdir, "aot_store")
         self._journal_path = str(cfg.event_output or "") or None
+        self.cfg = cfg
+        # ingest_workers >= 1 routes the cycle ingest phase through the
+        # stripe ledger (io/sharded.py): per-chunk claims + atomic
+        # commits under workdir/ingest/cycle_NNNN, so a SIGKILL
+        # mid-ingest resumes by loading committed stripes exactly-once
+        self.ingest_workers = int(cfg.ingest_workers)
+        self._ledger_fp: Optional[str] = None
         if label is not None:
             self.source = ArrayChunkSource(
                 data, int(chunk_rows or cfg.ingest_chunk_rows), label=label)
@@ -290,8 +297,11 @@ class ContinuousTrainer:
             obs_events.emit_event("cycle_started", cycle=c)
             prev = man.last_entry()
             prev_iter = int(prev["iteration"]) if prev else 0
+            extra = {"ingest_ledger": self._ledger_fp} \
+                if self._ledger_fp else {}
             man.set_phase(PHASE_INGESTED, chunks_consumed=got,
-                          target_iteration=prev_iter + self.rounds_per_cycle)
+                          target_iteration=prev_iter + self.rounds_per_cycle,
+                          **extra)
             obs_events.emit_event("cycle_ingested", cycle=c, chunks=got,
                                   rows=int(X.shape[0]))
             self._hook("ingest", c)
@@ -304,6 +314,13 @@ class ContinuousTrainer:
                           f"chunks but the manifest committed "
                           f"{man.state['chunks_consumed']} — the source "
                           "changed under the workdir")
+            want_fp = man.state.get("ingest_ledger")
+            if want_fp and self._ledger_fp != want_fp:
+                log.fatal(f"pipeline resume: cycle {c}'s stripe ledger "
+                          f"fingerprint {self._ledger_fp} != the one the "
+                          f"manifest committed ({want_fp}) — the ingest "
+                          "workdir was repointed or rebuilt under the "
+                          "cycle")
 
         if not man.phase_at_least(PHASE_EXPORTED):
             text = self._boost(c, X, y, int(man.state["target_iteration"]))
@@ -343,6 +360,15 @@ class ContinuousTrainer:
         """First ``limit`` chunks of the (re-streamed) source, stacked.
         Returns ``(X, y, chunks_taken)``; fewer chunks than ``limit``
         means the source ran dry."""
+        if self.ingest_workers and limit > 0:
+            from ..io.sharded import (collect_ledger_fingerprint,
+                                      sharded_collect)
+            tag = f"cycle_{self.manifest.cycle:04d}"
+            ldir = os.path.join(self.workdir, "ingest", tag)
+            out = sharded_collect(self.source, limit, ldir, self.cfg,
+                                  label=tag)
+            self._ledger_fp = collect_ledger_fingerprint(ldir)
+            return out
         xs, ys, n = [], [], 0
         if limit > 0:
             for chunk in self.source.chunks(0):

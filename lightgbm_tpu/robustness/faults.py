@@ -147,6 +147,24 @@ def pipeline_kill_hook(boundary: str, cycle: int) -> Callable[[str, int], None]:
     return _hook
 
 
+def sharded_stripe_kill_hook(stripe: int,
+                             pass_tag: Optional[str] = None
+                             ) -> Callable[[str, int], None]:
+    """An ``io/sharded.py`` stripe hook that SIGKILLs THIS process right
+    after stripe ``stripe`` of ``pass_tag`` (``p1``/``p2``/``c``; any
+    pass when ``None``) is durably committed — the commit file exists
+    but nothing downstream of it ran.  Installed as
+    ``lightgbm_tpu.io.sharded._stripe_hook`` by the pipeline drill
+    child to prove a sharded-ingest cycle resumes exactly-once: the
+    committed stripe must NOT be re-read or double-counted on resume."""
+    import signal
+
+    def _hook(tag: str, s: int) -> None:
+        if int(s) == int(stripe) and (pass_tag is None or tag == pass_tag):
+            os.kill(os.getpid(), signal.SIGKILL)
+    return _hook
+
+
 def newest_checkpoint_path(directory: str) -> Optional[str]:
     dirs = checkpoint_dirs(directory)
     return dirs[0][1] if dirs else None

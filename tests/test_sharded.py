@@ -1,0 +1,361 @@
+"""Elastic multi-host sharded ingestion tests (io/sharded.py).
+
+The contract pinned down here, matching the module and
+docs/SCALING.md "Sharded ingestion":
+  * the stripe-ownership primitives hold — ``O_CREAT|O_EXCL`` claims
+    admit exactly one winner, steals bump the generation atomically,
+    and a torn or alien ledger reads as absent;
+  * a two-worker build is bit-identical to the single-host streaming
+    build (bins, packed mirror, mappers, trained model core), with or
+    without a worker SIGKILLed mid-pass (its stripes are stolen, never
+    redone once committed);
+  * ``ingest_workers <= 1`` delegates to the single-host path
+    untouched: no ledger, no extra files, byte-identical artifacts and
+    the same journal shape — and the default config keeps the feature
+    off entirely;
+  * ``sharded_collect`` (the ContinuousTrainer ingest phase) matches
+    the in-memory collect semantics, resumes from its committed
+    stripes exactly-once (commit files untouched on re-entry), and
+    restarts cleanly from an alien ledger;
+  * Parquet row groups are the stripe unit and a missing pyarrow
+    surfaces as a clean ``LightGBMError``;
+  * ``tools/checkpoint_inspect.py`` greenlights a healthy collect
+    workdir and exits 1 on a torn ledger; ``tools/run_report.py``
+    renders the sharded section and fails ``--quick`` on an
+    orphaned stripe.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.sharded import (PASS_BIN, PASS_COLLECT, PASS_SKETCH,
+                                     claim_path, commit_path,
+                                     committed_stripes,
+                                     collect_ledger_fingerprint,
+                                     enumerate_stripes, ledger_fingerprint,
+                                     ledger_path, read_claim, read_ledger,
+                                     shard_stream_inner_dataset,
+                                     sharded_collect, steal_claim,
+                                     try_claim, write_ledger, _read_stripe)
+from lightgbm_tpu.io.streaming import (ArrayChunkSource,
+                                       stream_inner_dataset)
+from lightgbm_tpu.obs import events as obs_events
+from lightgbm_tpu.robustness.elastic import model_core
+from lightgbm_tpu.utils.log import LightGBMError
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FAST = {"num_leaves": 7, "min_data_in_leaf": 5, "verbose": -1}
+ELASTIC = {"heartbeat_interval_s": 0.2, "heartbeat_timeout_s": 1.0,
+           "verbosity": -1}
+
+
+def _matrix(n=400, f=5, seed=3):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(n, f))
+    X[:, 1] = rng.randint(0, 4, n)
+    y = (X[:, 0] + X[:, 1] * 0.25 > 0).astype(np.float64)
+    return X, y
+
+
+def _assert_bit_identical(ds_a, ds_b):
+    np.testing.assert_array_equal(np.asarray(ds_a.bins),
+                                  np.asarray(ds_b.bins))
+    np.testing.assert_array_equal(np.asarray(ds_a.packed_mirror()),
+                                  np.asarray(ds_b.packed_mirror()))
+    assert ds_a.used_feature_idx == ds_b.used_feature_idx
+    for a, b in zip(ds_a.mappers, ds_b.mappers):
+        assert a.to_dict() == b.to_dict()
+
+
+def _train_core(ds):
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.basic import Dataset as UserDataset
+    user = UserDataset.from_inner(ds, dict(FAST))
+    bst = lgb.train(dict(FAST, objective="binary", deterministic=True,
+                         seed=7), user, num_boost_round=5)
+    return model_core(bst.model_to_string())
+
+
+# --------------------------------------------------------- ledger protocol
+class TestLedgerProtocol:
+    def test_claim_is_exclusive(self, tmp_path):
+        wd = str(tmp_path)
+        os.makedirs(os.path.join(wd, "claims"))
+        assert try_claim(wd, PASS_SKETCH, 0, rank=0)
+        assert not try_claim(wd, PASS_SKETCH, 0, rank=1)
+        c = read_claim(wd, PASS_SKETCH, 0)
+        assert c["rank"] == 0 and c["pid"] == os.getpid()
+        assert c["generation"] == 0
+        # a different pass is a different fence
+        assert try_claim(wd, PASS_BIN, 0, rank=1)
+
+    def test_steal_bumps_generation(self, tmp_path):
+        wd = str(tmp_path)
+        os.makedirs(os.path.join(wd, "claims"))
+        assert try_claim(wd, PASS_SKETCH, 3, rank=0)
+        old = read_claim(wd, PASS_SKETCH, 3)
+        assert steal_claim(wd, PASS_SKETCH, 3, rank=1, old=old)
+        now = read_claim(wd, PASS_SKETCH, 3)
+        assert now["rank"] == 1 and now["generation"] == 1
+        assert not os.path.exists(claim_path(wd, PASS_SKETCH, 3)
+                                  + ".steal.r1.tmp")
+
+    def test_ledger_roundtrip_torn_and_alien(self, tmp_path):
+        wd = str(tmp_path)
+        led = {"kind": "sharded_ingest", "fingerprint": {"k": 1},
+               "chunk_rows": 10, "num_stripes": 4,
+               "passes": [PASS_SKETCH, PASS_BIN], "complete": False}
+        write_ledger(wd, led)
+        back = read_ledger(wd)
+        assert back is not None and back["num_stripes"] == 4
+        assert ledger_fingerprint(back) == ledger_fingerprint(led)
+        # torn file reads as absent
+        with open(ledger_path(wd), "w") as fh:
+            fh.write('{"kind": "sharded_in')
+        assert read_ledger(wd) is None
+        # alien format_version reads as absent
+        with open(ledger_path(wd), "w") as fh:
+            json.dump({"kind": "sharded_ingest", "format_version": 999}, fh)
+        assert read_ledger(wd) is None
+
+    def test_commit_extensions(self, tmp_path):
+        wd = str(tmp_path)
+        assert commit_path(wd, PASS_BIN, 0).endswith(".json")
+        assert commit_path(wd, PASS_SKETCH, 0).endswith(".npz")
+        assert commit_path(wd, PASS_COLLECT, 0).endswith(".npz")
+
+
+# --------------------------------------------------- multi-worker identity
+class TestMultiWorker:
+    def test_two_workers_bit_identical_to_single_host(self, tmp_path):
+        X, y = _matrix(400, 5)
+        single = stream_inner_dataset(
+            X, label=y, config=Config({"verbosity": -1}),
+            workdir=str(tmp_path / "single"), chunk_rows=80)
+        ds = shard_stream_inner_dataset(
+            X, label=y,
+            config=Config(dict(ELASTIC, ingest_workers=2)),
+            workdir=str(tmp_path / "sharded"), chunk_rows=80)
+        _assert_bit_identical(ds, single)
+        assert _train_core(ds) == _train_core(single)
+        led = read_ledger(str(tmp_path / "sharded"))
+        assert led["complete"] and led["num_stripes"] == 5
+        assert ds.ingest_provenance["sharded"]
+        assert ds.ingest_provenance["workers"] == 2
+
+    def test_killed_worker_stripes_stolen_bit_identical(self, tmp_path):
+        X, y = _matrix(400, 5)
+        single = stream_inner_dataset(
+            X, label=y, config=Config({"verbosity": -1}),
+            workdir=str(tmp_path / "single"), chunk_rows=80)
+        wd = str(tmp_path / "sharded")
+        ev = str(tmp_path / "events.jsonl")
+        with obs_events.session(ev):
+            ds = shard_stream_inner_dataset(
+                X, label=y,
+                config=Config(dict(ELASTIC, ingest_workers=2)),
+                workdir=wd, chunk_rows=80,
+                faults={0: {"pass": PASS_SKETCH, "after_stripes": 0}})
+        _assert_bit_identical(ds, single)
+        assert committed_stripes(wd, PASS_SKETCH, 5) == set(range(5))
+        assert committed_stripes(wd, PASS_BIN, 5) == set(range(5))
+        from lightgbm_tpu.obs.merge import find_rank_files
+        recs = []
+        for path in [ev] + find_rank_files(ev):
+            with open(path) as fh:
+                recs += [json.loads(ln) for ln in fh if ln.strip()]
+        deaths = [r for r in recs if r["event"] == "ingest_worker_dead"]
+        steals = [r for r in recs
+                  if r["event"] == "ingest_stripe_reassigned"]
+        assert deaths and all(r["payload"]["dead_rank"] == 0
+                              for r in deaths)
+        assert steals and all(r["payload"]["to_rank"] == 1
+                              and r["payload"]["generation"] >= 1
+                              for r in steals)
+
+
+# --------------------------------------------------- single-host delegation
+class TestDelegation:
+    def _journal_shape(self, path):
+        with open(path) as fh:
+            recs = [json.loads(ln) for ln in fh if ln.strip()]
+        return [(r["event"], sorted(r["payload"])) for r in recs]
+
+    def test_w1_delegates_byte_identical(self, tmp_path):
+        X, y = _matrix(300, 4)
+        wd1, wd2 = str(tmp_path / "plain"), str(tmp_path / "w1")
+        ev1, ev2 = str(tmp_path / "e1.jsonl"), str(tmp_path / "e2.jsonl")
+        with obs_events.session(ev1):
+            plain = stream_inner_dataset(
+                X, label=y, config=Config({"verbosity": -1}),
+                workdir=wd1, chunk_rows=75)
+        with obs_events.session(ev2):
+            ds = shard_stream_inner_dataset(
+                X, label=y,
+                config=Config({"verbosity": -1, "ingest_workers": 1}),
+                workdir=wd2, chunk_rows=75)
+        _assert_bit_identical(ds, plain)
+        # no ledger, no claims/commits — the workdirs hold the SAME files
+        assert not os.path.exists(ledger_path(wd2))
+        assert sorted(os.listdir(wd1)) == sorted(os.listdir(wd2))
+        for name in sorted(os.listdir(wd1)):
+            a = open(os.path.join(wd1, name), "rb").read()
+            b = open(os.path.join(wd2, name), "rb").read()
+            assert a == b, f"{name} differs between plain and W=1"
+        assert self._journal_shape(ev1) == self._journal_shape(ev2)
+
+    def test_default_config_keeps_feature_off(self, tmp_path):
+        assert int(Config({}).ingest_workers) == 0
+        X, y = _matrix(200, 4)
+        wd = str(tmp_path / "wd")
+        ds = shard_stream_inner_dataset(
+            X, label=y, config=Config({"verbosity": -1}),
+            workdir=wd, chunk_rows=100)
+        assert not os.path.exists(ledger_path(wd))
+        assert not os.path.exists(os.path.join(wd, "claims"))
+        assert np.asarray(ds.bins).shape[0] == 200
+
+
+# -------------------------------------------------------- sharded_collect
+class TestShardedCollect:
+    def test_matches_in_memory_collect_and_resumes(self, tmp_path):
+        X, y = _matrix(600, 4)
+        cfg = Config({"verbosity": -1})
+        wd = str(tmp_path / "c0")
+        src = ArrayChunkSource(X, 50, label=y)
+        X1, y1, taken = sharded_collect(src, 6, wd, cfg, label="c0")
+        assert taken == 6
+        np.testing.assert_array_equal(X1, X[:300])
+        np.testing.assert_array_equal(y1, y[:300])
+        led = read_ledger(wd)
+        assert led["complete"] and led["passes"] == [PASS_COLLECT]
+        fp = collect_ledger_fingerprint(wd)
+        assert fp == ledger_fingerprint(led)
+        # resume: committed stripes are LOADED, never re-streamed
+        mtimes = {s: os.path.getmtime(commit_path(wd, PASS_COLLECT, s))
+                  for s in range(6)}
+        X2, y2, taken2 = sharded_collect(
+            ArrayChunkSource(X, 50, label=y), 6, wd, cfg, label="c0")
+        assert taken2 == 6
+        np.testing.assert_array_equal(X2, X1)
+        np.testing.assert_array_equal(y2, y1)
+        for s in range(6):
+            assert os.path.getmtime(
+                commit_path(wd, PASS_COLLECT, s)) == mtimes[s]
+        assert collect_ledger_fingerprint(wd) == fp
+
+    def test_dry_source_completes_short(self, tmp_path):
+        X, y = _matrix(120, 4)
+        cfg = Config({"verbosity": -1})
+        wd = str(tmp_path / "dry")
+        X1, y1, taken = sharded_collect(
+            ArrayChunkSource(X, 50, label=y), 9, wd, cfg)
+        assert taken == 3 and X1.shape[0] == 120
+        led = read_ledger(wd)
+        assert led["complete"] and led["num_stripes"] == 3
+        # re-asking with the same limit re-enters the complete ledger
+        X2, _, taken2 = sharded_collect(
+            ArrayChunkSource(X, 50, label=y), 9, wd, cfg)
+        assert taken2 == 3
+        np.testing.assert_array_equal(X2, X1)
+
+    def test_alien_ledger_restarts_cleanly(self, tmp_path):
+        X, y = _matrix(200, 4)
+        cfg = Config({"verbosity": -1})
+        wd = str(tmp_path / "alien")
+        os.makedirs(wd)
+        write_ledger(wd, {"kind": "sharded_ingest",
+                          "fingerprint": {"other": True},
+                          "chunk_rows": 1, "num_stripes": 4,
+                          "passes": [PASS_COLLECT], "complete": False})
+        X1, y1, taken = sharded_collect(
+            ArrayChunkSource(X, 50, label=y), 4, wd, cfg)
+        assert taken == 4
+        np.testing.assert_array_equal(X1, X)
+
+
+# ---------------------------------------------------------------- parquet
+class TestParquet:
+    def test_missing_pyarrow_is_a_clean_error(self, monkeypatch,
+                                              tmp_path):
+        from lightgbm_tpu.io.streaming import ParquetChunkSource
+        monkeypatch.setitem(sys.modules, "pyarrow", None)
+        monkeypatch.setitem(sys.modules, "pyarrow.parquet", None)
+        with pytest.raises(LightGBMError, match="pyarrow"):
+            ParquetChunkSource(str(tmp_path / "x.parquet"))
+
+    def test_row_groups_are_stripes(self, tmp_path):
+        pa = pytest.importorskip("pyarrow")
+        pq = pytest.importorskip("pyarrow.parquet")
+        from lightgbm_tpu.io.streaming import ParquetChunkSource
+        X, _ = _matrix(100, 3)
+        tbl = pa.table({f"f{i}": X[:, i] for i in range(3)})
+        path = str(tmp_path / "d.parquet")
+        pq.write_table(tbl, path, row_group_size=25)
+        src = ParquetChunkSource(path)
+        S, offsets = enumerate_stripes(src)
+        assert S == 4 and offsets is None
+        chunk = _read_stripe(src, 2)
+        np.testing.assert_array_equal(chunk.data, X[50:75])
+
+
+# ------------------------------------------------------------------ tools
+class TestTools:
+    def _collect_workdir(self, tmp_path):
+        X, y = _matrix(300, 4)
+        wd = str(tmp_path / "cy")
+        ev = str(tmp_path / "events.jsonl")
+        with obs_events.session(ev):
+            sharded_collect(ArrayChunkSource(X, 50, label=y), 6, wd,
+                            Config({"verbosity": -1}), label="cycle_0000")
+        return wd, ev
+
+    def test_checkpoint_inspect_sharded(self, tmp_path):
+        wd, _ = self._collect_workdir(tmp_path)
+        tool = os.path.join(REPO, "tools", "checkpoint_inspect.py")
+        r = subprocess.run([sys.executable, tool, wd, "--json"],
+                           capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, r.stdout + r.stderr
+        doc = json.loads(r.stdout)
+        assert doc["ledger"]["complete"]
+        assert doc["commits"][PASS_COLLECT]["committed"] == 6
+        # a torn ledger is a hard failure
+        with open(ledger_path(wd), "w") as fh:
+            fh.write('{"torn')
+        r = subprocess.run([sys.executable, tool, wd, "--json"],
+                           capture_output=True, text=True, timeout=120)
+        assert r.returncode == 1
+
+    def test_run_report_sharded_and_orphan_gate(self, tmp_path):
+        _, ev = self._collect_workdir(tmp_path)
+        tool = os.path.join(REPO, "tools", "run_report.py")
+        r = subprocess.run(
+            [sys.executable, tool, "--events", ev, "--quick",
+             "--format", "json"],
+            capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, r.stdout + r.stderr
+        doc = json.loads(r.stdout)
+        assert doc["sharded"]["stripes_committed"] == 6
+        assert doc["sharded"]["orphaned_stripes"] == []
+        # synthesize a claimed-but-never-committed stripe -> gate fails
+        with open(ev) as fh:
+            rec = json.loads(fh.readline())
+        rec["event"] = "ingest_stripe_claimed"
+        rec["payload"] = {"rank": 0, "stripe": 999, "stage": PASS_COLLECT,
+                          "generation": 0}
+        with open(ev, "a") as fh:
+            fh.write(json.dumps(rec) + "\n")
+        r = subprocess.run(
+            [sys.executable, tool, "--events", ev, "--quick",
+             "--format", "json"],
+            capture_output=True, text=True, timeout=120)
+        assert r.returncode == 1
+        doc = json.loads(r.stdout)
+        assert any("stripe" in f for f in doc["findings"])
+        assert "c:999" in doc["sharded"]["orphaned_stripes"]

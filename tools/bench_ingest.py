@@ -15,6 +15,11 @@ the parent's high-water (a worker spawned from a fat pytest process
 reports the parent's peak), and zram swap on a loaded host deflates the
 RSS high-water while the array still exists in swap.
 
+``--hosts N`` (N >= 2) adds a sharded multi-process variant per chunk
+size: the stripe-ledger build (io/sharded.py) with N real ingest worker
+processes, reported as the AGGREGATE rows/s the coordinator observed —
+the scaling headline docs/SCALING.md "Sharded ingestion" quotes.
+
 Emits a ``kind="ingest"`` payload (``"metric"`` headline per the bench
 capture protocol) that tools/bench_compare.py gates: rows/s per variant,
 HIGHER is better, exit 0/1/2 per tools/_report.py.
@@ -139,7 +144,8 @@ class SyntheticSource:
 
 
 def run_worker(variant: str, rows: int, features: int,
-               chunk_rows: Optional[int]) -> Dict[str, Any]:
+               chunk_rows: Optional[int],
+               hosts: int = 0) -> Dict[str, Any]:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import numpy as np  # noqa: F401  (baseline includes numpy+package)
     import lightgbm_tpu  # noqa: F401
@@ -167,8 +173,37 @@ def run_worker(variant: str, rows: int, features: int,
         src = SyntheticSource(rows, features, chunk_rows)
         ds = stream_inner_dataset(src, label=np.zeros(rows), config={},
                                   chunk_rows=chunk_rows)
+        binned = list(ds.bins.shape)
+    elif variant == "sharded":
+        # multi-host mode: the stripe-ledger build (io/sharded.py) with
+        # ``hosts`` real worker processes; this process coordinates and
+        # merges, so rows/s here is the AGGREGATE ingest rate
+        import tempfile
+
+        from lightgbm_tpu.io.sharded import (SyntheticChunkSource,
+                                             shard_stream_inner_dataset)
+        assert chunk_rows, "sharded worker needs --chunk-rows"
+        assert hosts >= 2, "sharded worker needs --hosts >= 2"
+        src = SyntheticChunkSource(rows, features, chunk_rows)
+        with tempfile.TemporaryDirectory() as td:
+            ds = shard_stream_inner_dataset(
+                src, config={"ingest_workers": hosts, "verbosity": -1},
+                workdir=td, chunk_rows=chunk_rows)
+            binned = list(ds.bins.shape)
+            wall = time.perf_counter() - t0
+            peak = max(rss_base, sampler.stop())
+            return {
+                "wall_s": round(wall, 3),
+                "rows_per_s": round(rows / wall, 1),
+                "peak_rss_mb": round(peak, 1),
+                "rss_base_mb": round(rss_base, 1),
+                "hosts": hosts,
+                "binned_shape": binned,
+            }
     else:
         raise SystemExit(f"unknown worker variant {variant!r}")
+    if variant == "in_memory":
+        binned = list(ds.bins.shape)
     wall = time.perf_counter() - t0
     peak = max(rss_base, sampler.stop())
     return {
@@ -176,16 +211,19 @@ def run_worker(variant: str, rows: int, features: int,
         "rows_per_s": round(rows / wall, 1),
         "peak_rss_mb": round(peak, 1),
         "rss_base_mb": round(rss_base, 1),
-        "binned_shape": list(ds.bins.shape),
+        "binned_shape": binned,
     }
 
 
 def spawn_worker(variant: str, rows: int, features: int,
-                 chunk_rows: Optional[int] = None) -> Dict[str, Any]:
+                 chunk_rows: Optional[int] = None,
+                 hosts: int = 0) -> Dict[str, Any]:
     cmd = [sys.executable, os.path.abspath(__file__), "--worker", variant,
            "--rows", str(rows), "--features", str(features)]
     if chunk_rows:
         cmd += ["--chunk-rows", str(chunk_rows)]
+    if hosts:
+        cmd += ["--hosts", str(hosts)]
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     out = subprocess.run(cmd, capture_output=True, text=True, env=env)
     if out.returncode != 0:
@@ -215,6 +253,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--features", type=int, default=16)
     ap.add_argument("--chunk-sizes", default="50000,100000",
                     help="comma-separated streamed chunk sizes")
+    ap.add_argument("--hosts", type=int, default=0,
+                    help="also run the sharded multi-process build "
+                         "(io/sharded.py stripe ledger) with N ingest "
+                         "worker processes; rows/s is the aggregate "
+                         "rate the coordinator observed")
     ap.add_argument("--worker", default=None,
                     help=argparse.SUPPRESS)  # internal: run ONE variant
     ap.add_argument("--chunk-rows", type=int, default=None,
@@ -224,7 +267,7 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if args.worker:
         res = run_worker(args.worker, args.rows, args.features,
-                         args.chunk_rows)
+                         args.chunk_rows, hosts=args.hosts)
         print(json.dumps(res))
         return EXIT_OK
 
@@ -238,6 +281,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         for cs in chunk_sizes:
             variants[f"streamed_{cs}"] = spawn_worker(
                 "streamed", args.rows, args.features, cs)
+        if args.hosts >= 2:
+            for cs in chunk_sizes:
+                variants[f"sharded_{args.hosts}h_{cs}"] = spawn_worker(
+                    "sharded", args.rows, args.features, cs,
+                    hosts=args.hosts)
     except (RuntimeError, ValueError, json.JSONDecodeError) as e:
         print(f"bench_ingest: error: {e}", file=sys.stderr)
         return EXIT_ERROR

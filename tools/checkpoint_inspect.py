@@ -33,6 +33,15 @@ in-flight cycle's committed artifacts (its export record, its per-cycle
 checkpoint directory) must validate too.  Any broken link is a TORN
 cycle: exit 1.
 
+Sharded-ingest workdirs (io/sharded.py; a directory holding
+``stripe_ledger.json``) get stripe-ledger verification: the ledger must
+parse (a torn ledger exits 1 — no resume can trust the stripe
+universe) and the commit chain must hold (every commit file loads; a
+COMPLETE ledger holds one commit per stripe per pass).  ``--verify-all``
+— and pipeline mode always — additionally discovers ledgers nested
+under the target (a pipeline workdir keeps one per cycle under
+``ingest/cycle_NNNN``) and folds their findings in.
+
 AOT executable stores (ops/aot_store.py) join the verification
 surface: pointed directly at a store directory (one holding
 ``aot_store.json``) the tool verifies every artifact's sha256 against
@@ -110,6 +119,116 @@ def _store_findings(root: str) -> list:
         for f in rep["findings"]:
             findings.append(f"aot store {store}: {f}")
     return findings
+
+
+def is_sharded_workdir(directory: str) -> bool:
+    return os.path.exists(os.path.join(directory, "stripe_ledger.json"))
+
+
+def _find_stripe_ledgers(root: str) -> list:
+    """Sharded-ingest workdirs nested under ``root`` (pipeline cycle
+    ledgers live at ``<workdir>/ingest/cycle_NNNN``), excluding ``root``
+    itself."""
+    found = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        if dirpath != root and "stripe_ledger.json" in filenames:
+            found.append(dirpath)
+            dirnames[:] = []    # a ledger dir never nests another
+    return sorted(found)
+
+
+def build_sharded_report(workdir: str) -> Dict[str, Any]:
+    """Integrity payload for one sharded-ingest workdir (a directory
+    holding ``stripe_ledger.json``, io/sharded.py).
+
+    The ledger itself must parse (a torn ledger is a hard finding: no
+    resume can trust the stripe universe), and the commit chain must
+    hold: every commit file present must load, and a COMPLETE ledger
+    must hold a commit for every stripe of every pass.  Missing commits
+    under an incomplete ledger are progress, not damage — the next run
+    resumes them."""
+    import json
+
+    from lightgbm_tpu.io import sharded
+    findings: list = []
+    led = sharded.read_ledger(workdir)
+    if led is None:
+        return {"tool": "checkpoint_inspect", "mode": "sharded_ingest",
+                "directory": workdir, "ledger": None,
+                "findings": [f"torn or unreadable stripe ledger under "
+                             f"{workdir} — the stripe universe cannot "
+                             "be trusted; re-run the ingest"],
+                "all_valid": False}
+    stripes = int(led.get("num_stripes", 0))
+    passes = [str(p) for p in led.get("passes", [])]
+    complete = bool(led.get("complete"))
+    chain: Dict[str, Dict[str, int]] = {}
+    for tag in passes:
+        committed = torn = 0
+        for s in range(stripes):
+            cpath = sharded.commit_path(workdir, tag, s)
+            if not os.path.exists(cpath):
+                if complete:
+                    findings.append(
+                        f"pass {tag} stripe {s}: ledger says complete "
+                        "but the commit file is missing")
+                continue
+            try:
+                if cpath.endswith(".json"):
+                    with open(cpath) as fh:
+                        json.load(fh)
+                else:
+                    import numpy as _np
+                    with _np.load(cpath) as z:
+                        z.files
+                committed += 1
+            except Exception as e:
+                torn += 1
+                findings.append(f"pass {tag} stripe {s}: commit file "
+                                f"unreadable ({type(e).__name__}: {e})")
+        chain[tag] = {"committed": committed, "torn": torn,
+                      "missing": stripes - committed - torn}
+    return {"tool": "checkpoint_inspect", "mode": "sharded_ingest",
+            "directory": workdir,
+            "ledger": {"fingerprint": sharded.ledger_fingerprint(led),
+                       "num_stripes": stripes, "passes": passes,
+                       "complete": complete,
+                       "workers": led.get("ingest_workers")},
+            "commits": chain, "findings": findings,
+            "all_valid": not findings}
+
+
+def _ledger_findings(root: str) -> list:
+    """Findings from every sharded-ingest ledger discovered under
+    ``root`` (used by --verify-all and pipeline mode), prefixed with
+    the ledger path."""
+    findings = []
+    for wd in _find_stripe_ledgers(root):
+        rep = build_sharded_report(wd)
+        for f in rep["findings"]:
+            findings.append(f"stripe ledger {wd}: {f}")
+    return findings
+
+
+def _render_sharded(payload: Dict[str, Any]) -> str:
+    led = payload.get("ledger")
+    if led is None:
+        lines = [f"sharded ingest {payload['directory']}: TORN LEDGER"]
+    else:
+        state = "complete" if led["complete"] else "in progress"
+        lines = [f"sharded ingest {payload['directory']}: "
+                 f"{led['num_stripes']} stripe(s), "
+                 f"passes {'+'.join(led['passes'])}, {state}"]
+        for tag in led["passes"]:
+            c = payload["commits"].get(tag, {})
+            lines.append(f"  pass {tag}: {c.get('committed', 0)} "
+                         f"committed, {c.get('missing', 0)} missing, "
+                         f"{c.get('torn', 0)} torn")
+        lines.append(f"  ledger fingerprint: {led['fingerprint'][:16]}…")
+    for f in payload["findings"]:
+        lines.append(f"  FINDING: {f}")
+    lines.append("ledger: " + ("OK" if payload["all_valid"] else "TORN"))
+    return "\n".join(lines)
 
 
 def build_pipeline_report(workdir: str) -> Dict[str, Any]:
@@ -194,6 +313,10 @@ def build_pipeline_report(workdir: str) -> Dict[str, Any]:
     # trainer.py keeps one under <workdir>/aot_store): a torn store is
     # part of the recovery surface this mode exists to verify
     findings.extend(_store_findings(workdir))
+    # ... and, with sharded ingest on (ingest_workers >= 1), per-cycle
+    # stripe ledgers under <workdir>/ingest/cycle_NNNN: a torn ledger or
+    # commit breaks the exactly-once resume of its cycle
+    findings.extend(_ledger_findings(workdir))
     return {"tool": "checkpoint_inspect", "mode": "pipeline",
             "directory": workdir, "name": name, "cycles": entries,
             "current": current, "findings": findings,
@@ -277,6 +400,10 @@ def main(argv=None) -> int:
         payload = build_aot_report(args.checkpoint_dir)
         emit(payload, fmt, _render_aot)
         return EXIT_OK if payload["all_valid"] else EXIT_FINDINGS
+    if is_sharded_workdir(args.checkpoint_dir):
+        payload = build_sharded_report(args.checkpoint_dir)
+        emit(payload, fmt, _render_sharded)
+        return EXIT_OK if payload["all_valid"] else EXIT_FINDINGS
     if os.path.exists(os.path.join(args.checkpoint_dir,
                                    "pipeline_manifest.json")):
         payload = build_pipeline_report(args.checkpoint_dir)
@@ -284,7 +411,8 @@ def main(argv=None) -> int:
         return EXIT_OK if payload["all_valid"] else EXIT_FINDINGS
     payload = build_report(args.checkpoint_dir)
     if args.verify_all:
-        payload["store_findings"] = _store_findings(args.checkpoint_dir)
+        payload["store_findings"] = (_store_findings(args.checkpoint_dir)
+                                     + _ledger_findings(args.checkpoint_dir))
     emit(payload, fmt, _render_report)
     code = exit_code(payload, verify_all=args.verify_all)
     if code == EXIT_OK and payload.get("store_findings"):

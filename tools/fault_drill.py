@@ -71,6 +71,24 @@ Continuous-learning pipeline scenarios (pipeline/, PR 15):
                       (``pipeline_publish_retries``) — never skipping
                       forward
 
+Sharded-ingest scenarios (io/sharded.py, PR 18; both part of --quick):
+
+  ingest_host_kill      SIGKILL 1 of 3 ingest workers mid-pass-1 AND a
+                        second mid-pass-2 -> survivors declare each dead
+                        within ``heartbeat_timeout_s`` and steal the
+                        orphaned stripes; zero stripes lost, every
+                        stripe committed exactly once, and bins +
+                        packed mirror + trained model bit-identical to
+                        an unkilled single-host build of the same CSV
+  pipeline_kill_sharded the pipeline kill chain with ``ingest_workers=1``:
+                        run 0 SIGKILLs itself right after a stripe
+                        commit inside cycle 0's collect, run 1 at the
+                        ingest boundary — each successor resumes by
+                        LOADING committed stripes (exactly-once across
+                        lifetimes, journal-proven), exports bit-identical
+                        to an unkilled sharded reference, version
+                        sequence unchanged
+
 Exit codes (tools/_report.py convention):
   0 — every scenario passed
   1 — a scenario's verification failed (recovery broken)
@@ -631,6 +649,150 @@ def scenario_serve_swap_abort(X, y):
             "passed": all(checks.values())}
 
 
+# ------------------------------------------------- sharded ingest
+def _read_all_journals(path: str) -> List[Dict[str, Any]]:
+    """The coordinator journal plus every per-rank worker journal the
+    sharded ingest derived from it, concatenated."""
+    from lightgbm_tpu.obs.events import read_journal
+    from lightgbm_tpu.obs.merge import find_rank_files
+    events = list(read_journal(path)) if os.path.exists(path) else []
+    for rank_path in find_rank_files(path):
+        events.extend(read_journal(rank_path))
+    return events
+
+
+def scenario_ingest_host_kill():
+    """SIGKILL one of three sharded-ingest workers mid-pass-1 and a
+    second mid-pass-2 (io/sharded.py).  The survivors must declare each
+    dead within ``heartbeat_timeout_s``, steal its orphaned stripes,
+    and the merged dataset — bins, packed mirror, trained model — must
+    be bit-identical to an unkilled single-host build of the same CSV:
+    the stripe ledger's order-invariance contract."""
+    import time
+
+    import numpy as np
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.sharded import (PASS_BIN, PASS_SKETCH,
+                                         committed_stripes,
+                                         shard_stream_dataset)
+    from lightgbm_tpu.io.streaming import TextStripeSource, stream_dataset
+    from lightgbm_tpu.obs import events as obs_events
+    from lightgbm_tpu.obs.events import journal_tail, read_journal
+    from lightgbm_tpu.obs.merge import rank_file_path
+    from lightgbm_tpu.robustness.elastic import model_core
+    timeout_s = float(BASE_PARAMS["heartbeat_timeout_s"])
+    ingest_params = dict(verbosity=-1,
+                         heartbeat_interval_s=BASE_PARAMS[
+                             "heartbeat_interval_s"],
+                         heartbeat_timeout_s=timeout_s)
+    train_params = dict(objective="binary", num_leaves=7,
+                        min_data_in_leaf=5, deterministic=True, seed=7,
+                        verbosity=-1)
+    rng = np.random.RandomState(5)
+    X = rng.normal(size=(1200, 5))
+    yv = (X[:, 0] + X[:, 1] > 0).astype(np.float64)
+    with tempfile.TemporaryDirectory() as td:
+        csv = os.path.join(td, "drill.csv")
+        with open(csv, "w") as fh:
+            for i in range(X.shape[0]):
+                fh.write(",".join([f"{yv[i]:.0f}"]
+                                  + [f"{v:.6f}" for v in X[i]]) + "\n")
+        stripe_bytes = 6000        # ~10 stripes over the ~55KB file
+        ev = os.path.join(td, "ingest_events.jsonl")
+        sh_wd = os.path.join(td, "sharded")
+        # arm on the FIRST claim of the pass: the go barrier guarantees
+        # every worker enters the claim race, so a first-claim kill
+        # always fires (a later-claim kill can be starved out when the
+        # survivors drain the stripe universe first)
+        faults = {0: {"pass": PASS_SKETCH, "after_stripes": 0},
+                  1: {"pass": PASS_BIN, "after_stripes": 0}}
+        with obs_events.session(ev):
+            src = TextStripeSource(csv, Config(dict(ingest_params)),
+                                   stripe_bytes=stripe_bytes)
+            ds = shard_stream_dataset(
+                src, params=dict(ingest_params, ingest_workers=3),
+                workdir=sh_wd, faults=faults)
+        booster = lgb.train(train_params, ds, num_boost_round=5)
+        core = model_core(booster.model_to_string())
+
+        src_ref = TextStripeSource(csv, Config(dict(ingest_params)),
+                                   stripe_bytes=stripe_bytes)
+        ref_wd = os.path.join(td, "single")
+        ds_ref = stream_dataset(src_ref, params=dict(ingest_params),
+                                workdir=ref_wd)
+        booster_ref = lgb.train(train_params, ds_ref, num_boost_round=5)
+        core_ref = model_core(booster_ref.model_to_string())
+
+        def _bytes(wd, name):
+            with open(os.path.join(wd, name), "rb") as fh:
+                return fh.read()
+        bins_identical = _bytes(sh_wd, "bins.u8") == _bytes(ref_wd,
+                                                            "bins.u8")
+        packed_identical = _bytes(sh_wd, "packed.i32") == \
+            _bytes(ref_wd, "packed.i32")
+
+        import json as _json
+        with open(os.path.join(sh_wd, "stripe_ledger.json")) as fh:
+            S = int(_json.load(fh)["num_stripes"])
+        p1_done = committed_stripes(sh_wd, PASS_SKETCH, S)
+        p2_done = committed_stripes(sh_wd, PASS_BIN, S)
+
+        events = _read_all_journals(ev)
+        tail = journal_tail(ev)
+        # reassignment latency per killed rank: from its journal's last
+        # record (the moment it went silent) to the survivor's steal
+        latency = {}
+        for dead_rank in faults:
+            rank_ev = list(read_journal(rank_file_path(ev, 0, dead_rank)))
+            last = max((e.get("unix_time") or 0.0) for e in rank_ev) \
+                if rank_ev else None
+            steal = min((e.get("unix_time") or 0.0) for e in events
+                        if e.get("event") == "ingest_stripe_reassigned"
+                        and (e.get("payload") or {}).get("from_rank")
+                        == dead_rank) if any(
+                e.get("event") == "ingest_stripe_reassigned"
+                and (e.get("payload") or {}).get("from_rank") == dead_rank
+                for e in events) else None
+            latency[dead_rank] = (round(steal - last, 3)
+                                  if last and steal else None)
+    done = [(str((e.get("payload") or {}).get("stage")),
+             (e.get("payload") or {}).get("shard"))
+            for e in events if e.get("event") == "ingest_shard_done"]
+    reassigned = [e for e in events
+                  if e.get("event") == "ingest_stripe_reassigned"]
+    dead_ranks = {(e.get("payload") or {}).get("dead_rank")
+                  for e in events
+                  if e.get("event") == "ingest_worker_dead"}
+    checks = {
+        # every stripe of both passes committed exactly once — none
+        # lost with its dead owner, none redone after its commit
+        "zero_stripes_lost": p1_done == set(range(S))
+        and p2_done == set(range(S)),
+        "exactly_once_commits": len(done) == 2 * S
+        and len(set(done)) == 2 * S,
+        "both_workers_declared_dead": {0, 1} <= dead_ranks,
+        "orphans_reassigned": len(reassigned) >= 2
+        and {0, 1} <= {(e.get("payload") or {}).get("from_rank")
+                       for e in reassigned},
+        # steal landed within the liveness budget (heartbeat_timeout_s
+        # + scheduling slack: the survivor steals on its next sweep)
+        "reassigned_within_timeout": all(
+            v is not None and v <= timeout_s + 2.0
+            for v in latency.values()),
+        "bins_bit_identical": bins_identical,
+        "packed_bit_identical": packed_identical,
+        "model_bit_identical": core == core_ref,
+    }
+    return {"name": "ingest_host_kill", "stripes": S,
+            "reassignment_latency_s": latency,
+            "reassigned": len(reassigned), "checks": checks,
+            "journal_tail": tail,
+            "watchtower": _watchtower_summary(tail),
+            "passed": all(checks.values())}
+
+
 # ------------------------------------------------- continuous pipeline
 #: tiny deterministic continuation config for the pipeline drills: 2
 #: rounds per cycle, checkpoint every round, 3 chunks of 96 rows
@@ -640,7 +802,8 @@ _PIPE_PARAMS = dict(objective="binary", num_leaves=4, min_data_in_leaf=5,
 _PIPE_CYCLES = 3
 
 
-def _pipeline_spec(td: str, workdir: str, kill=None) -> Dict[str, Any]:
+def _pipeline_spec(td: str, workdir: str, kill=None,
+                   extra_params=None) -> Dict[str, Any]:
     spec: Dict[str, Any] = {
         "seed": 11, "num_chunks": _PIPE_CYCLES, "rows_per_chunk": 96,
         "num_features": 5, "name": "pipe", "num_cycles": _PIPE_CYCLES,
@@ -649,6 +812,8 @@ def _pipeline_spec(td: str, workdir: str, kill=None) -> Dict[str, Any]:
         "params": dict(_PIPE_PARAMS, pipeline_workdir=workdir,
                        event_output=os.path.join(td, "pipe_events.jsonl")),
     }
+    if extra_params:
+        spec["params"].update(extra_params)
     if kill is not None:
         spec["kill"] = kill
     return spec
@@ -771,6 +936,100 @@ def scenario_pipeline_kill():
             "passed": all(checks.values())}
 
 
+def scenario_pipeline_kill_sharded():
+    """The pipeline kill chain with sharded ingest on
+    (``ingest_workers=1``): run 0 SIGKILLs itself right after a stripe
+    COMMIT inside cycle 0's collect (the ``ingest_stripe`` boundary the
+    phase hook cannot reach), run 1 resumes — it must LOAD the committed
+    stripe, never re-stream it — and dies at the ingest boundary, and
+    the final run completes every cycle.  Exactly-once is asserted from
+    the journal (one ``ingest_shard_done`` per ledger+stripe across
+    every lifetime) and the exports must be bit-identical to an
+    unkilled sharded reference."""
+    import json
+    import signal
+
+    import checkpoint_inspect
+    from lightgbm_tpu.obs.events import journal_tail, read_journal
+    from lightgbm_tpu.pipeline.drill import run_spec
+    extra = {"ingest_workers": 1}
+    kills = [{"boundary": "ingest_stripe", "cycle": 0, "stripe": 0},
+             {"boundary": "ingest", "cycle": 0}]
+    boundaries_hit: List[Dict[str, Any]] = []
+    with tempfile.TemporaryDirectory() as td:
+        wd = os.path.join(td, "wd")
+        for i, kill in enumerate(kills):
+            rc, _ = _pipeline_child(
+                td, i, _pipeline_spec(td, wd, kill=kill,
+                                      extra_params=extra))
+            boundaries_hit.append({"boundary": kill["boundary"],
+                                   "rc": rc,
+                                   "sigkilled": rc == -signal.SIGKILL})
+        rc, out = _pipeline_child(td, len(kills),
+                                  _pipeline_spec(td, wd,
+                                                 extra_params=extra))
+        summary = {}
+        if rc == 0 and out.strip():
+            summary = json.loads(out.strip().splitlines()[-1])
+        ref_td = os.path.join(td, "ref")
+        os.makedirs(ref_td)
+        ref_wd = os.path.join(ref_td, "wd")
+        ref_spec = _pipeline_spec(ref_td, ref_wd, extra_params=extra)
+        ref_spec.pop("client_log")
+        run_spec(ref_spec)
+
+        def _export(base, c):
+            p = os.path.join(base, "exports", f"cycle_{c:04d}.txt")
+            with open(p) as fh:
+                return fh.read()
+        bit_identical = all(
+            _export(wd, c) == _export(ref_wd, c)
+            for c in range(_PIPE_CYCLES))
+        obs = _client_observations(os.path.join(td, "client.jsonl"))
+        client_errs = [o for o in obs if not o.get("ok")]
+        events = read_journal(os.path.join(td, "pipe_events.jsonl"))
+        tail = journal_tail(os.path.join(td, "pipe_events.jsonl"))
+        ledgers = sorted(os.listdir(os.path.join(wd, "ingest"))) \
+            if os.path.isdir(os.path.join(wd, "ingest")) else []
+        chain = checkpoint_inspect.build_pipeline_report(wd)
+    commits = [((e.get("payload") or {}).get("ledger"),
+                (e.get("payload") or {}).get("shard"))
+               for e in events if e.get("event") == "ingest_shard_done"
+               and (e.get("payload") or {}).get("stage") == "collect"]
+    collect_resumes = sum(
+        1 for e in events if e.get("event") == "ingest_resumed"
+        and (e.get("payload") or {}).get("stage") == "collect")
+    versions = _published_versions(events)
+    checks = {
+        "killed_at_every_boundary":
+            all(b["sigkilled"] for b in boundaries_hit),
+        "resume_completed_all_cycles": rc == 0
+        and summary.get("cycles_completed") == _PIPE_CYCLES,
+        "bit_identical_exports": bit_identical,
+        # the heart of the drill: across three trainer lifetimes no
+        # (cycle ledger, stripe) pair was ever committed twice — the
+        # resumed runs LOADED the crashed runs' commits
+        "exactly_once_stripe_commits": bool(commits)
+        and len(commits) == len(set(commits)),
+        "resumed_from_ledger": collect_resumes >= 1,
+        "one_ledger_per_cycle": ledgers == [
+            f"cycle_{c:04d}" for c in range(_PIPE_CYCLES)],
+        "versions_monotone_no_gaps":
+            versions == list(range(1, _PIPE_CYCLES + 1)),
+        "zero_failed_requests": not client_errs,
+        # pipeline-mode checkpoint_inspect now folds per-cycle stripe
+        # ledgers into the chain verdict
+        "cycle_chain_valid": bool(chain["all_valid"]),
+    }
+    return {"name": "pipeline_kill_sharded", "boundaries": boundaries_hit,
+            "cycles": summary.get("cycles_completed"),
+            "versions": versions, "stripe_commits": len(commits),
+            "collect_resumes": collect_resumes, "checks": checks,
+            "journal_tail": tail,
+            "watchtower": _watchtower_summary(tail),
+            "passed": all(checks.values())}
+
+
 def scenario_pipeline_swap_abort():
     import json
 
@@ -859,6 +1118,11 @@ def run_drill(quick: bool, rounds: int, workers: int) -> Dict[str, Any]:
     # chain is part of --quick (tier-1); the fleet swap-abort pipeline
     # drill rides the full run
     scenarios.append(scenario_pipeline_kill())
+    # the sharded-ingest gates (PR 18): both part of --quick — the
+    # worker-kill stripe-steal drill with its bit-identity contract,
+    # and the exactly-once SIGKILL-mid-collect pipeline chain
+    scenarios.append(scenario_ingest_host_kill())
+    scenarios.append(scenario_pipeline_kill_sharded())
     if not quick:
         scenarios.append(scenario_pipeline_swap_abort())
     return {"tool": "fault_drill", "mode": "quick" if quick else "full",
@@ -899,8 +1163,9 @@ def _render(payload: Dict[str, Any]) -> str:
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--quick", action="store_true",
-                    help="kill + serve_kill + pipeline_kill scenarios "
-                         "only (tier-1 CI gate)")
+                    help="kill + serve_kill + pipeline_kill + "
+                         "ingest_host_kill + pipeline_kill_sharded "
+                         "scenarios only (tier-1 CI gate)")
     ap.add_argument("--rounds", type=int, default=8)
     ap.add_argument("--workers", type=int, default=4)
     add_format_arg(ap)

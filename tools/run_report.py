@@ -17,7 +17,11 @@ additionally gets a pipeline section joining the trainer's cycle events
 with the serving tier's hot-swap events: cycles completed, per-cycle
 publish latency, resumes — and a cycle that started but never published
 is a finding (``--quick`` exits 1: the workdir holds an unfinished,
-resumable cycle).
+resumable cycle).  Journals with sharded-ingest stripe records
+(io/sharded.py) get a stripe-ledger section — claims joined against
+commits — where a claimed-but-never-committed stripe is likewise a
+``--quick`` finding: the merged dataset under that ledger is
+incomplete.
 
 ``--quick`` is the CI gate mode: it only validates that every provided
 artifact parses and carries its expected schema (trace has span
@@ -135,6 +139,64 @@ def ingest_stats(events: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
         "started": started, "resumed": resumed, "completed": completed,
         "shards": shards, "rows": rows, "features": features,
         "unfinished": (started + resumed) > 0 and completed == 0,
+    }
+
+
+#: claim/steal records carry the ledger pass tag; commit records carry
+#: the human stage name — the join key between the two families
+_STAGE_TO_TAG = {"sketch": "p1", "bin": "p2", "collect": "c"}
+
+
+def sharded_stats(events: List[Dict[str, Any]]) \
+        -> Optional[Dict[str, Any]]:
+    """Replay sharded-ingest records (io/sharded.py) into a stripe
+    ledger: claims (first-claim + steals) joined against commits.
+
+    ``None`` when the journal holds no stripe events.  A stripe that
+    was claimed (or reassigned) but never committed is ORPHANED — the
+    CI-gate signal that a worker died holding work nobody finished,
+    so the merged dataset under that ledger is incomplete."""
+    claims: Dict[Any, int] = {}
+    done = set()
+    reassigned = deaths = merges = 0
+    workers = None
+    dead_ranks = set()
+    for rec in events:
+        name = rec.get("event")
+        payload = rec.get("payload") or {}
+        if not isinstance(payload, dict):
+            payload = {}
+        if name == "ingest_stripe_claimed":
+            k = (str(payload.get("stage")), payload.get("stripe"))
+            claims[k] = claims.get(k, 0) + 1
+        elif name == "ingest_stripe_reassigned":
+            reassigned += 1
+            k = (str(payload.get("stage")), payload.get("stripe"))
+            claims[k] = claims.get(k, 0) + 1
+        elif name == "ingest_worker_dead":
+            deaths += 1
+            if payload.get("dead_rank") is not None:
+                dead_ranks.add(int(payload["dead_rank"]))
+        elif name == "ingest_merge_completed":
+            merges += 1
+            workers = payload.get("workers", workers)
+        elif name == "ingest_shard_done":
+            tag = _STAGE_TO_TAG.get(str(payload.get("stage")))
+            if tag is not None:
+                done.add((tag, payload.get("shard")))
+    if not (claims or reassigned or deaths or merges):
+        return None
+    orphaned = sorted(f"{tag}:{stripe}" for tag, stripe in claims
+                      if (tag, stripe) not in done)
+    return {
+        "stripes_claimed": len(claims),
+        "stripes_committed": len(done),
+        "stripes_reassigned": reassigned,
+        "worker_deaths": deaths,
+        "dead_ranks": sorted(dead_ranks),
+        "merges_completed": merges,
+        "workers": workers,
+        "orphaned_stripes": orphaned,
     }
 
 
@@ -280,6 +342,16 @@ def build_report(trace_doc: Optional[Dict[str, Any]],
                 findings.append(
                     "streaming ingest started but never completed — the "
                     "dataset in its workdir is partial (resumable)")
+        shd = sharded_stats(events)
+        if shd is not None:
+            payload["sharded"] = shd
+            if shd["orphaned_stripes"]:
+                findings.append(
+                    "sharded-ingest stripe(s) "
+                    + ", ".join(shd["orphaned_stripes"])
+                    + " claimed but never committed — a worker died "
+                    "holding work no survivor finished; the merged "
+                    "dataset under that ledger is incomplete")
         pipe = pipeline_stats(events)
         if pipe is not None:
             payload["pipeline"] = pipe
@@ -341,6 +413,23 @@ def _render_report(payload: Dict[str, Any]) -> str:
         if ingest.get("rows") is not None:
             lines.append(f"  rows: {ingest['rows']}  features: "
                          f"{ingest.get('features')}")
+    shd = payload.get("sharded")
+    if shd is not None:
+        lines.append("")
+        state = "ORPHANED STRIPES" if shd["orphaned_stripes"] else "clean"
+        lines.append(f"sharded ingest: {state} "
+                     f"({shd['stripes_claimed']} stripe(s) claimed, "
+                     f"{shd['stripes_committed']} committed, "
+                     f"{shd['stripes_reassigned']} reassigned)")
+        if shd.get("workers") is not None:
+            lines.append(f"  workers: {shd['workers']}  merges: "
+                         f"{shd['merges_completed']}")
+        if shd["worker_deaths"]:
+            ranks = ", ".join(str(r) for r in shd["dead_ranks"]) or "?"
+            lines.append(f"  worker deaths: {shd['worker_deaths']} "
+                         f"(rank(s) {ranks})")
+        for s in shd["orphaned_stripes"]:
+            lines.append(f"  orphaned stripe {s}")
     pipe = payload.get("pipeline")
     if pipe is not None:
         lines.append("")
