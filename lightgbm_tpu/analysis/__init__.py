@@ -10,6 +10,17 @@ Rule families (stable IDs; full catalog in docs/STATIC_ANALYSIS.md):
   * ``OBS3xx`` — telemetry contracts: counter names declared once.
   * ``GRW4xx`` — grower capability contracts: fallback-to-strict
     branches in ``learner/`` need a justified suppression entry.
+  * ``RBS5xx`` — robustness: bounded retry loops, deadline-carrying
+    blocking IO in the serving/cluster tier.
+  * ``CRS6xx`` — crash safety: persistent-state writes must go through
+    temp+``os.replace`` (``utils/paths.py write_atomic``), crash-
+    critical renames need a directory fsync, read-modify-write needs a
+    fence, commit failures must not be swallowed.  Judged on the
+    package-wide effect-summary engine (effects.py).
+  * ``CNC7xx`` — concurrency: deadlines on ``time.monotonic()`` not
+    ``time.time()``, wire bytes authenticated before ``pickle.loads``,
+    ``guarded-by(<lock>)`` attribute discipline, explicit thread
+    lifecycles.  Same engine.
   * ``LNT0xx`` — lint infrastructure (syntax errors, malformed/stale
     suppressions).
 
@@ -24,17 +35,18 @@ in ``tools/tpulint_suppressions.txt``.
 """
 
 from . import contracts  # noqa: F401 — rule registration side effect
+from . import effects    # noqa: F401 — shared effect-summary engine
 from . import grwrules   # noqa: F401 — rule registration side effect
 from . import jaxrules   # noqa: F401 — rule registration side effect
 from .cli import build_rules, main
 from .core import (FileContext, LintRun, LintRunner, Rule, Violation,
                    register_rule, registered_rules)
 from .reporters import (EXIT_ERROR, EXIT_FINDINGS, EXIT_OK, render_json,
-                        render_text)
+                        render_sarif, render_text)
 
 __all__ = [
     "FileContext", "LintRun", "LintRunner", "Rule", "Violation",
     "register_rule", "registered_rules", "build_rules", "main",
-    "render_json", "render_text", "EXIT_OK", "EXIT_FINDINGS",
-    "EXIT_ERROR",
+    "effects", "render_json", "render_sarif", "render_text",
+    "EXIT_OK", "EXIT_FINDINGS", "EXIT_ERROR",
 ]

@@ -15,13 +15,16 @@ import os
 import sys
 from typing import List, Optional
 
+from . import cncrules   # noqa: F401 — registers CNC7xx rules
 from . import contracts  # noqa: F401 — registers CFG2xx/OBS3xx rules
+from . import crsrules   # noqa: F401 — registers CRS6xx rules
 from . import grwrules   # noqa: F401 — registers GRW4xx rules
 from . import jaxrules   # noqa: F401 — registers TPU1xx rules
 from . import rbsrules   # noqa: F401 — registers RBS5xx rules
 from .core import (LintRunner, SEVERITY_ERROR, SEVERITY_WARNING,
                    registered_rules)
-from .reporters import (EXIT_ERROR, exit_code, render_json, render_text)
+from .reporters import (EXIT_ERROR, exit_code, render_json, render_sarif,
+                        render_text)
 
 #: diagnostics emitted by the runner/suppression machinery rather than a
 #: registered rule — still valid --select/--ignore targets
@@ -38,6 +41,35 @@ def default_root() -> str:
     # analysis/ lives at <root>/lightgbm_tpu/analysis
     return os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
+
+
+def changed_paths(root: str, ref: str) -> List[str]:
+    """Python files changed vs ``ref`` plus untracked ones (absolute
+    paths, deduplicated, existing on disk).  Raises RuntimeError when
+    the repo/ref cannot be consulted — the caller must NOT silently
+    lint nothing on a bad ref."""
+    import subprocess
+    cmds = (["git", "diff", "--name-only", ref, "--"],
+            ["git", "ls-files", "--others", "--exclude-standard"])
+    names: List[str] = []
+    for cmd in cmds:
+        try:
+            proc = subprocess.run(cmd, cwd=root, capture_output=True,
+                                  text=True, check=True)
+        except (OSError, subprocess.CalledProcessError) as e:
+            detail = getattr(e, "stderr", "") or str(e)
+            raise RuntimeError(
+                f"--changed: {' '.join(cmd)} failed: {detail.strip()}")
+        names.extend(proc.stdout.splitlines())
+    out = []
+    for rel in names:
+        rel = rel.strip()
+        if not rel.endswith(".py"):
+            continue
+        p = os.path.join(root, rel)
+        if os.path.isfile(p):
+            out.append(os.path.abspath(p))
+    return sorted(set(out))
 
 
 def build_rules(select: Optional[List[str]] = None,
@@ -59,8 +91,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--root", default=default_root(),
                     help="repo root for relative paths, the config "
                          "registry and docs (default: autodetected)")
-    ap.add_argument("--format", choices=("text", "json"), default="text",
+    ap.add_argument("--format", choices=("text", "json", "sarif"),
+                    default="text",
                     help="report format (default: text)")
+    ap.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                    metavar="REF",
+                    help="lint only Python files changed vs REF "
+                         "(default HEAD) plus untracked ones, scoped to "
+                         "the given paths; package-wide rules degrade "
+                         "to subset semantics automatically")
     ap.add_argument("--select", default="",
                     help="comma-separated rule IDs to run exclusively")
     ap.add_argument("--ignore", default="",
@@ -98,6 +137,23 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"tpulint: unknown rule id(s): {', '.join(unknown)} "
               f"(see --list-rules)", file=sys.stderr)
         return EXIT_ERROR
+    if args.changed is not None:
+        try:
+            changed = changed_paths(root, args.changed)
+        except RuntimeError as e:
+            print(f"tpulint: {e}", file=sys.stderr)
+            return EXIT_ERROR
+        # scope the diff to the requested paths — the same containment
+        # rule LintRun.covers() applies, so package-wide "never used"
+        # directions degrade to subset semantics automatically
+        scope = [os.path.abspath(p) for p in paths]
+        paths = [c for c in changed
+                 if any(c == s or c.startswith(s + os.sep)
+                        for s in scope)]
+        if not paths:
+            print("tpulint: --changed: no changed Python files in "
+                  "scope — nothing to lint")
+            return 0
     runner = LintRunner(build_rules(select or None, ignore or None),
                         root=root, suppression_path=supp)
     violations, stats = runner.run(paths)
@@ -118,6 +174,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         stats["by_rule"] = dict(sorted(by_rule.items()))
     if args.format == "json":
         print(render_json(violations, stats))
+    elif args.format == "sarif":
+        print(render_sarif(violations, stats, runner.rules))
     else:
         print(render_text(violations, stats))
     return exit_code(violations)

@@ -1,0 +1,271 @@
+"""Concurrency rules (CNC7xx) — clocks, locks, threads, wire bytes.
+
+PR 16's elastic multi-host work and the serving fleet gave this repo a
+real concurrency surface: monitor threads aging heartbeats, routers
+deserializing wire bytes, autoscalers with cooldown clocks.  Each rule
+here freezes one review question, judged on the effect summaries of
+effects.py (one call level deep):
+
+  * **CNC701** ``wall-clock-deadline`` — ``time.time()`` feeding
+    deadline/elapsed/timeout arithmetic (directly, through a local, or
+    through one call level into a parameter the callee uses that way).
+    Wall clocks step (NTP); durations and deadlines must come from
+    ``time.monotonic()``.  Wall stamps *stored* into journals/markers
+    are fine — the rule fires only on arithmetic.  The one legitimate
+    exception — cross-HOST marker aging, where wall time is the only
+    shared clock — takes a justified suppression-file entry.
+  * **CNC702** ``wire-pickle-unverified`` — a function whose effective
+    effects read wire bytes AND reach ``pickle.loads`` with no
+    constant-time token compare (``hmac.compare_digest``) in flow.
+    Pickle is arbitrary code execution; authentication must dominate
+    deserialization.
+  * **CNC703** ``guarded-attr-unlocked`` — a class declares its locking
+    discipline with a body comment ``# tpulint: guarded-by(<lock>):
+    <attr>[, <attr>...]`` and a method mutates a declared attribute
+    outside ``with self.<lock>``.  ``__init__``/``__new__`` are exempt
+    (no concurrent alias can exist yet).
+  * **CNC704** ``thread-lifecycle-undeclared`` — ``threading.Thread``
+    constructed without an explicit ``daemon=`` and no ``.join(`` in
+    the file: the author never decided whether the thread may outlive
+    the process teardown.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set
+
+from . import effects
+from .core import (FileContext, LintRun, Rule, SEVERITY_ERROR, Violation,
+                   register_rule)
+from .effects import (CONST_TIME, PICKLE_LOADS, WIRE_READ, EffectIndex,
+                      FunctionSummary, deadline_hits, is_wall_clock_call)
+
+
+@register_rule
+class WallClockDeadline(Rule):
+    id = "CNC701"
+    name = "wall-clock-deadline"
+    severity = SEVERITY_ERROR
+    description = ("time.time() feeds deadline/elapsed arithmetic — "
+                   "wall clocks step; use time.monotonic() (wall stamps "
+                   "stored in journals/manifests are exempt)")
+
+    def finalize(self, run: LintRun) -> Iterable[Violation]:
+        idx = effects.get_index(run)
+        for s in idx.summaries:
+            if not s.wall_calls:
+                continue
+            call_ids = {id(c): c.lineno for c in s.wall_calls}
+            seeds: Dict[str, int] = {}
+            for n in effects._walk_own(s.node):
+                if not (isinstance(n, ast.Assign) and len(n.targets) == 1
+                        and isinstance(n.targets[0], ast.Name)):
+                    continue
+                if any(id(sub) in call_ids for sub in ast.walk(n.value)):
+                    seeds[n.targets[0].id] = n.value.lineno
+            hits = deadline_hits(s.node, seeds, call_ids)
+            hits |= self._call_through_hits(idx, s, seeds, call_ids)
+            for lineno in sorted(hits):
+                yield self.violation(
+                    s.ctx, lineno, 0,
+                    f"{s.name}(): this time.time() reading flows into "
+                    "deadline/elapsed arithmetic — use time.monotonic() "
+                    "(wall time is for journal stamps, not durations)")
+
+    @staticmethod
+    def _call_through_hits(idx: EffectIndex, s: FunctionSummary,
+                           seeds: Dict[str, int],
+                           call_ids: Dict[int, int]) -> Set[int]:
+        """One level through a call: a wall-derived value passed into a
+        parameter the callee itself feeds into deadline arithmetic."""
+        def origin(expr: ast.AST) -> Optional[int]:
+            if isinstance(expr, ast.Name) and expr.id in seeds:
+                return seeds[expr.id]
+            for sub in ast.walk(expr):
+                if id(sub) in call_ids:
+                    return call_ids[id(sub)]
+            return None
+
+        hits: Set[int] = set()
+        for c in s.calls:
+            g = idx.resolve_callee(s, c)
+            if g is None or not g.wall_deadline_params:
+                continue
+            offset = 1 if (g.params and g.params[0] in ("self", "cls")
+                           and isinstance(c.node.func,
+                                          ast.Attribute)) else 0
+            for i, a in enumerate(c.node.args):
+                pidx = i + offset
+                if pidx < len(g.params) \
+                        and g.params[pidx] in g.wall_deadline_params:
+                    lin = origin(a)
+                    if lin is not None:
+                        hits.add(lin)
+            for kw in c.node.keywords:
+                if kw.arg in g.wall_deadline_params:
+                    lin = origin(kw.value)
+                    if lin is not None:
+                        hits.add(lin)
+        return hits
+
+
+@register_rule
+class WirePickleUnverified(Rule):
+    id = "CNC702"
+    name = "wire-pickle-unverified"
+    severity = SEVERITY_ERROR
+    description = ("pickle.loads reachable from wire bytes with no "
+                   "constant-time token verification in flow — pickle "
+                   "is arbitrary code execution")
+
+    def finalize(self, run: LintRun) -> Iterable[Violation]:
+        idx = effects.get_index(run)
+        for s in idx.summaries:
+            eff = idx.effective_effects(s)
+            if WIRE_READ not in eff or PICKLE_LOADS not in eff:
+                continue
+            if CONST_TIME in eff:
+                continue
+            if s.pickle_lines:
+                lineno = s.pickle_lines[0]
+            else:
+                lineno = next(
+                    (c.lineno for c in s.calls
+                     if (g := idx.resolve_callee(s, c)) is not None
+                     and PICKLE_LOADS in g.effects), s.node.lineno)
+            yield self.violation(
+                s.ctx, lineno, 0,
+                f"{s.name}() reads wire bytes and reaches pickle.loads "
+                "with no hmac.compare_digest token check in flow — "
+                "authenticate before deserializing")
+
+
+_GUARD_RE = re.compile(
+    r"#\s*tpulint:\s*guarded-by\((\w+)\):\s*([\w,\s]+)")
+
+_MUTATORS = effects._MUTATING_METHODS
+
+
+@register_rule
+class GuardedAttrUnlocked(Rule):
+    id = "CNC703"
+    name = "guarded-attr-unlocked"
+    severity = SEVERITY_ERROR
+    description = ("attribute declared '# tpulint: guarded-by(<lock>): "
+                   "<attrs>' mutated outside 'with self.<lock>'")
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            decls = self._declarations(ctx, node)
+            if not decls:
+                continue
+            for item in node.body:
+                if not isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                if item.name in ("__init__", "__new__"):
+                    continue   # no concurrent alias can exist yet
+                yield from self._scan(ctx, item.body, decls,
+                                      frozenset(), item.name)
+
+    @staticmethod
+    def _declarations(ctx: FileContext,
+                      cls: ast.ClassDef) -> Dict[str, str]:
+        decls: Dict[str, str] = {}
+        end = getattr(cls, "end_lineno", cls.lineno) or cls.lineno
+        for i in range(cls.lineno, end + 1):
+            m = _GUARD_RE.search(ctx.line_text(i))
+            if m:
+                lock = m.group(1)
+                for attr in m.group(2).split(","):
+                    attr = attr.strip()
+                    if attr:
+                        decls[attr] = lock
+        return decls
+
+    def _scan(self, ctx: FileContext, stmts, decls: Dict[str, str],
+              held: frozenset, method: str) -> Iterable[Violation]:
+        for st in stmts:
+            yield from self._scan_node(ctx, st, decls, held, method)
+
+    def _scan_node(self, ctx: FileContext, n: ast.AST,
+                   decls: Dict[str, str], held: frozenset,
+                   method: str) -> Iterable[Violation]:
+        if isinstance(n, (ast.With, ast.AsyncWith)):
+            newheld = set(held)
+            for item in n.items:
+                ce = item.context_expr
+                if isinstance(ce, ast.Attribute) \
+                        and isinstance(ce.value, ast.Name) \
+                        and ce.value.id == "self":
+                    newheld.add(ce.attr)
+            yield from self._scan(ctx, n.body, decls,
+                                  frozenset(newheld), method)
+            return
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda, ast.ClassDef)):
+            return              # nested scope: judged on its own
+        attr = self._mutated_attr(n)
+        if attr is not None and attr in decls \
+                and decls[attr] not in held:
+            yield self.violation(
+                ctx, n.lineno, 0,
+                f"{method}() mutates self.{attr} outside 'with "
+                f"self.{decls[attr]}' (declared guarded-by"
+                f"({decls[attr]}))")
+        for child in ast.iter_child_nodes(n):
+            yield from self._scan_node(ctx, child, decls, held, method)
+
+    @staticmethod
+    def _mutated_attr(n: ast.AST) -> Optional[str]:
+        def self_attr(t: ast.AST) -> Optional[str]:
+            if isinstance(t, ast.Subscript):
+                t = t.value
+            if isinstance(t, ast.Attribute) \
+                    and isinstance(t.value, ast.Name) \
+                    and t.value.id == "self":
+                return t.attr
+            return None
+
+        if isinstance(n, ast.Assign):
+            for t in n.targets:
+                a = self_attr(t)
+                if a:
+                    return a
+        elif isinstance(n, ast.AugAssign):
+            return self_attr(n.target)
+        elif isinstance(n, ast.Call) \
+                and isinstance(n.func, ast.Attribute) \
+                and n.func.attr in _MUTATORS:
+            return self_attr(n.func.value)
+        return None
+
+
+@register_rule
+class ThreadLifecycleUndeclared(Rule):
+    id = "CNC704"
+    name = "thread-lifecycle-undeclared"
+    severity = SEVERITY_ERROR
+    description = ("threading.Thread without explicit daemon= and no "
+                   ".join( in the file — decide whether the thread may "
+                   "outlive teardown")
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        for n in ast.walk(ctx.tree):
+            if not isinstance(n, ast.Call):
+                continue
+            base, bare = effects._call_name(n.func)
+            if bare != "Thread" or base not in ("threading", ""):
+                continue
+            if any(kw.arg == "daemon" for kw in n.keywords):
+                continue
+            if ".join(" in ctx.source:
+                continue        # join-on-close evidence in this file
+            yield self.violation(
+                ctx, n.lineno, 0,
+                "threading.Thread without an explicit daemon= and no "
+                ".join() in this file — declare the thread's lifecycle")

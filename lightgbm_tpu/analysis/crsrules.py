@@ -1,0 +1,242 @@
+"""Crash-safety rules (CRS6xx) — durable-state writes must survive kill.
+
+The continuous-learning pipeline (pipeline/cycle.py), the sharded-ingest
+ledger (io/sharded.py), checkpoints (robustness/checkpoint.py) and the
+AOT executable store (ops/aot_store.py) all promise "a SIGKILL at any
+instant leaves a consistent, resumable artifact".  That promise rests on
+one idiom — write to a temp file, fsync it, ``os.replace`` into place,
+fsync the directory — now blessed as ``utils/paths.py write_atomic``.
+These rules audit the promise package-wide, judging *functions* (via the
+effect summaries of effects.py, one call level deep) rather than single
+lines:
+
+  * **CRS601** ``persistent-write-not-atomic`` — a raw ``open(path,
+    "w")`` whose path is flavored as persistent state (manifest /
+    ledger / checkpoint / registry / marker / claim / heartbeat, or a
+    token the module declares in ``PERSISTED_ARTIFACTS``) in a function
+    whose effective effects show no ``os.replace``/``write_atomic``
+    commit.  ``O_EXCL`` creations (claim fences) and append-mode opens
+    (journals) are exempt; an unresolvable callee that receives
+    something sharing the path's flavor token suppresses the finding
+    (it might be the commit helper).
+  * **CRS602** ``replace-without-dir-fsync`` — ``os.replace`` whose
+    destination is crash-CRITICAL (manifest/ledger/checkpoint/registry)
+    in a function whose effective effects carry no directory fsync:
+    the rename itself can still be lost with the directory's metadata.
+  * **CRS603** ``read-modify-write-unfenced`` — one function both reads
+    and rewrites the same flavored shared artifact with no fence in
+    sight (no lock held, no ``O_EXCL`` claim, no fingerprint/verify
+    call): two racing processes will silently drop one side's update.
+  * **CRS604** ``commit-failure-swallowed`` — a ``try`` whose body
+    commits (``os.replace``/``write_atomic``, own or one-level callee)
+    with a bare/broad ``except`` that neither re-raises nor logs:
+    a failed commit must never look like a successful one.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set, Tuple
+
+from . import effects
+from .core import (FileContext, LintRun, Rule, SEVERITY_ERROR, Violation,
+                   register_rule)
+from .effects import (CRASH_CRITICAL_TOKENS, FENCE_CALL_TOKENS, FSYNC_DIR,
+                      LOCK_PREFIX, OPEN_EXCL, REPLACE, WRITE_ATOMIC,
+                      EffectIndex, FunctionSummary, expr_token, match_token)
+
+
+def _token_reaches_unknown_call(idx: EffectIndex, s: FunctionSummary,
+                                token: str) -> bool:
+    """Unresolvable-call conservatism: does some callee we cannot
+    summarize receive an argument sharing ``token``?  If so it might be
+    the commit/fsync helper — no finding."""
+    for c in s.calls:
+        if idx.is_known_call(s, c):
+            continue
+        args = list(c.node.args) + [kw.value for kw in c.node.keywords]
+        if any(expr_token(a, (token,)) for a in args):
+            return True
+    return False
+
+
+@register_rule
+class PersistentWriteNotAtomic(Rule):
+    id = "CRS601"
+    name = "persistent-write-not-atomic"
+    severity = SEVERITY_ERROR
+    description = ("persistent-state file written raw (no temp+os.replace "
+                   "or write_atomic in flow) — a kill mid-write corrupts "
+                   "the artifact")
+
+    def finalize(self, run: LintRun) -> Iterable[Violation]:
+        idx = effects.get_index(run)
+        for s in idx.summaries:
+            raw = [w for w in s.writes
+                   if w.mode == "raw" and w.token is not None]
+            if not raw:
+                continue
+            eff = idx.effective_effects(s)
+            if REPLACE in eff or WRITE_ATOMIC in eff \
+                    or OPEN_EXCL in s.effects:
+                continue
+            for w in raw:
+                if _token_reaches_unknown_call(idx, s, w.token):
+                    continue
+                yield self.violation(
+                    s.ctx, w.lineno, 0,
+                    f"{s.name}() writes {w.token}-flavored persistent "
+                    "state raw — write to a temp file and commit with "
+                    "os.replace (use utils/paths.py write_atomic)")
+
+
+@register_rule
+class ReplaceWithoutDirFsync(Rule):
+    id = "CRS602"
+    name = "replace-without-dir-fsync"
+    severity = SEVERITY_ERROR
+    description = ("os.replace into a crash-critical artifact without a "
+                   "directory fsync in flow — the rename can be lost "
+                   "with the directory metadata")
+
+    def finalize(self, run: LintRun) -> Iterable[Violation]:
+        idx = effects.get_index(run)
+        for s in idx.summaries:
+            if not s.replace_calls:
+                continue
+            eff = idx.effective_effects(s)
+            if FSYNC_DIR in eff:
+                continue
+            for rc in s.replace_calls:
+                if len(rc.args) < 2:
+                    continue
+                tok = expr_token(rc.args[1], CRASH_CRITICAL_TOKENS)
+                if tok is None:
+                    continue
+                if _token_reaches_unknown_call(idx, s, tok):
+                    continue
+                yield self.violation(
+                    s.ctx, rc.lineno, 0,
+                    f"{s.name}() renames a {tok}-flavored crash-critical "
+                    "artifact into place without fsyncing the directory "
+                    "(utils/paths.py fsync_dir, or write_atomic which "
+                    "does both)")
+
+
+@register_rule
+class ReadModifyWriteUnfenced(Rule):
+    id = "CRS603"
+    name = "read-modify-write-unfenced"
+    severity = SEVERITY_ERROR
+    description = ("read-modify-write of a shared on-disk artifact with "
+                   "no fence (lock, O_EXCL claim, or fingerprint check) "
+                   "— concurrent writers silently drop updates")
+
+    def finalize(self, run: LintRun) -> Iterable[Violation]:
+        idx = effects.get_index(run)
+        for s in idx.summaries:
+            eff = idx.effective_effects(s)
+            if any(e.startswith(LOCK_PREFIX) for e in eff) \
+                    or OPEN_EXCL in eff:
+                continue
+            if any(match_token(c.name, FENCE_CALL_TOKENS)
+                   for c in s.calls):
+                continue
+            reads: Set[str] = {r.token for r in s.reads if r.token}
+            writes: List[Tuple[str, int]] = [
+                (w.token, w.lineno) for w in s.writes
+                if w.token and w.mode in ("raw", "atomic")]
+            # one-level call-through: a resolved callee's sites count as
+            # the caller's, attributed to the call line
+            for c in s.calls:
+                g = idx.resolve_callee(s, c)
+                if g is None or g is s:
+                    continue
+                reads |= {r.token for r in g.reads if r.token}
+                writes += [(w.token, c.lineno) for w in g.writes
+                           if w.token and w.mode in ("raw", "atomic")]
+            for tok, lineno in writes:
+                if tok in reads:
+                    yield self.violation(
+                        s.ctx, lineno, 0,
+                        f"{s.name}() reads and rewrites the same "
+                        f"{tok}-flavored shared artifact without a "
+                        "fence — hold a lock, claim via O_EXCL, or "
+                        "verify a fingerprint before committing")
+                    break       # one finding per function suffices
+
+
+def _try_body_commits(idx: EffectIndex, s: FunctionSummary,
+                      try_node: ast.Try) -> bool:
+    for stmt in try_node.body:
+        for n in ast.walk(stmt):
+            if not isinstance(n, ast.Call):
+                continue
+            base, bare = effects._call_name(n.func)
+            if base == "os" and bare in ("replace", "rename"):
+                return True
+            if bare == "write_atomic":
+                return True
+            site = effects.CallSite(base, bare, n, n.lineno)
+            g = idx.resolve_callee(s, site)
+            if g is not None and (REPLACE in g.effects
+                                  or WRITE_ATOMIC in g.effects):
+                return True
+    return False
+
+
+_BROAD = ("Exception", "BaseException")
+_LOG_BASES = ("log", "logger", "logging", "warnings")
+_LOG_NAMES = ("warning", "warn", "error", "exception", "critical",
+              "info", "debug", "emit_event")
+
+
+@register_rule
+class CommitFailureSwallowed(Rule):
+    id = "CRS604"
+    name = "commit-failure-swallowed"
+    severity = SEVERITY_ERROR
+    description = ("bare/broad except swallows an os.replace/commit "
+                   "failure without re-raising or logging — a failed "
+                   "publish must never look successful")
+
+    def finalize(self, run: LintRun) -> Iterable[Violation]:
+        idx = effects.get_index(run)
+        for s in idx.summaries:
+            for n in effects._walk_own(s.node):
+                if not isinstance(n, ast.Try):
+                    continue
+                if not _try_body_commits(idx, s, n):
+                    continue
+                for h in n.handlers:
+                    if not self._is_broad(h):
+                        continue
+                    if self._handler_reacts(h):
+                        continue
+                    yield self.violation(
+                        s.ctx, h.lineno, 0,
+                        f"{s.name}() commits inside this try but the "
+                        "broad except neither re-raises nor logs — the "
+                        "caller cannot tell a failed commit from a "
+                        "successful one")
+
+    @staticmethod
+    def _is_broad(h: ast.ExceptHandler) -> bool:
+        t = h.type
+        if t is None:
+            return True
+        names = [t] if not isinstance(t, ast.Tuple) else list(t.elts)
+        return any(isinstance(e, ast.Name) and e.id in _BROAD
+                   for e in names)
+
+    @staticmethod
+    def _handler_reacts(h: ast.ExceptHandler) -> bool:
+        for stmt in h.body:
+            for n in ast.walk(stmt):
+                if isinstance(n, ast.Raise):
+                    return True
+                if isinstance(n, ast.Call):
+                    base, bare = effects._call_name(n.func)
+                    if base in _LOG_BASES or bare in _LOG_NAMES:
+                        return True
+        return False

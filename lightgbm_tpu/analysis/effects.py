@@ -1,0 +1,554 @@
+"""Package-wide function **effect summaries** (pass 1 of CRS/CNC lint).
+
+The crash-safety (CRS6xx, crsrules.py) and concurrency (CNC7xx,
+cncrules.py) rule families reason about what a function *does* — writes
+a file raw, calls ``os.replace``, fsyncs a directory, reads wire bytes,
+feeds a wall-clock reading into deadline arithmetic — rather than what
+a single line looks like.  This module is the shared pass 1: it walks
+every linted file once and computes a :class:`FunctionSummary` per
+function/method, keyed by qualified name, with
+
+  * **direct effects** — a small vocabulary of string labels
+    (``calls-os.replace``, ``calls-fsync-dir``, ``reads-wire-bytes``,
+    ``calls-pickle.loads``, ``compares-token-constant-time``,
+    ``acquires-lock-<name>``, ``uses-wall-clock``, ``sleeps-in-loop``,
+    ...) observed in the function's own body (nested ``def``s excluded —
+    they get their own summaries);
+  * **parameterized effects** — per-parameter observations (this param
+    is written raw / written atomically / fed into deadline
+    arithmetic) so callers can be judged through a call;
+  * **call sites** — every callee's bare name, for ONE level of
+    call-through resolution.
+
+Resolution is deliberately conservative: a bare callee name resolves to
+a summary only when it is unique in the same module, or failing that
+unique across the whole run; ambiguous or unknown names resolve to
+``None`` and rules must treat an unresolvable call as "could do
+anything" — i.e. **no finding** rather than a guessed one.  Effective
+effects go exactly ONE call level deep (a callee's *direct* effects,
+never its callees'), which keeps the engine linear and its verdicts
+explainable: every finding is "this function does X and neither it nor
+anything it directly calls does Y".
+
+Like everything under ``lightgbm_tpu/analysis/`` this module is stdlib
+only and must never import jax (see tools/tpulint.py's file-path
+loading contract).
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+import dataclasses
+import re
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from .core import FileContext, LintRun
+
+# --------------------------------------------------------------------------
+# effect vocabulary
+# --------------------------------------------------------------------------
+
+REPLACE = "calls-os.replace"
+FSYNC = "calls-fsync"
+FSYNC_DIR = "calls-fsync-dir"
+WRITE_ATOMIC = "calls-write-atomic"
+TEMP_RENAME = "writes-file-via-temp-rename"
+WIRE_READ = "reads-wire-bytes"
+PICKLE_LOADS = "calls-pickle.loads"
+CONST_TIME = "compares-token-constant-time"
+WALL_CLOCK = "uses-wall-clock"
+SLEEP_IN_LOOP = "sleeps-in-loop"
+OPEN_EXCL = "opens-o-excl"
+OPEN_APPEND = "opens-append"
+LOCK_PREFIX = "acquires-lock-"
+
+#: identifier/path tokens that mark a write target as *persistent state*
+#: the crash-safety contract applies to (CRS601/603).  Deliberately
+#: excludes ephemeral coordination files (specs, ready markers are
+#: covered by "marker"; spill/scratch files are not listed).  Modules
+#: may extend this per-module with a ``PERSISTED_ARTIFACTS`` tuple of
+#: extra name tokens.
+PERSISTED_TOKENS = frozenset({
+    "manifest", "ledger", "checkpoint", "registry", "marker", "claim",
+    "heartbeat",
+})
+
+#: the subset whose loss corrupts recovery (CRS602 demands a directory
+#: fsync in flow): heartbeat/claim files are liveness signals that a
+#: crash may legitimately lose.
+CRASH_CRITICAL_TOKENS = frozenset({
+    "manifest", "ledger", "checkpoint", "registry",
+})
+
+#: tokens that mark a name as deadline/elapsed arithmetic (CNC701):
+#: ``time.time()`` flowing into one of these must be ``time.monotonic``.
+DEADLINE_TOKENS = frozenset({
+    "age", "deadline", "elapsed", "timeout", "expire", "expiry",
+    "remaining", "stale", "dt",
+})
+
+#: call names that constitute a read-modify-write *fence* (CRS603)
+FENCE_CALL_TOKENS = frozenset({
+    "fingerprint", "claim", "verify", "lock", "fence",
+})
+
+#: the module-level registry name modules use to declare extra
+#: persisted-artifact tokens
+PERSISTED_REGISTRY_NAME = "PERSISTED_ARTIFACTS"
+
+_MUTATING_METHODS = frozenset({
+    "append", "extend", "insert", "add", "discard", "remove", "pop",
+    "popitem", "clear", "update", "setdefault",
+})
+
+_BUILTIN_NAMES = frozenset(dir(builtins))
+#: stdlib module aliases whose attribute calls we treat as fully known
+#: (they never hide an ``os.replace`` on the caller's behalf), so they
+#: do not trigger unresolvable-call conservatism
+_KNOWN_MODULES = frozenset({
+    "os", "json", "time", "_time", "pickle", "hmac", "math", "re",
+    "struct", "socket", "tempfile", "shutil", "threading", "np",
+    "numpy", "log", "logging",
+})
+
+_TOKEN_EXACT_LEN = 3   # tokens this short must equal a whole segment
+
+
+def _segments(name: object) -> List[str]:
+    """Split an identifier or path-ish string into lowercase alnum
+    segments: ``"manifest_path"`` -> ``["manifest", "path"]``."""
+    out: List[str] = []
+    cur: List[str] = []
+    for ch in str(name):
+        if ch.isalnum():
+            cur.append(ch.lower())
+        elif cur:
+            out.append("".join(cur))
+            cur = []
+    if cur:
+        out.append("".join(cur))
+    return out
+
+
+def match_token(name: object, tokens: Sequence[str]) -> Optional[str]:
+    """The first token of ``tokens`` that flavors ``name``.
+
+    A token matches a name *segment* exactly, or as a prefix when the
+    token is long enough (>3 chars) for prefixing to be meaningful —
+    so ``"staleness"`` matches ``stale`` but ``"usage"`` does not
+    match ``age``."""
+    for seg in _segments(name):
+        for t in tokens:
+            if seg == t or (len(t) > _TOKEN_EXACT_LEN
+                            and seg.startswith(t)):
+                return t
+    return None
+
+
+def expr_token(node: ast.AST, tokens: Sequence[str]) -> Optional[str]:
+    """The first token flavoring any identifier or string literal
+    inside expression ``node`` (used to classify path expressions)."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            t = match_token(sub.id, tokens)
+        elif isinstance(sub, ast.Attribute):
+            t = match_token(sub.attr, tokens)
+        elif isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            t = match_token(sub.value, tokens)
+        else:
+            continue
+        if t is not None:
+            return t
+    return None
+
+
+def _walk_own(fnode: ast.AST) -> Iterator[ast.AST]:
+    """Yield every node in ``fnode``'s own body, NOT descending into
+    nested function/class definitions (those get their own summaries)."""
+    stack = list(ast.iter_child_nodes(fnode))
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _call_name(func: ast.AST) -> Tuple[str, str]:
+    """``(base, bare)`` for a call target: ``os.replace`` ->
+    ``("os", "replace")``; ``open`` -> ``("", "open")``; anything more
+    exotic keeps only the trailing attribute as ``bare``."""
+    if isinstance(func, ast.Name):
+        return "", func.id
+    if isinstance(func, ast.Attribute):
+        base = func.value.id if isinstance(func.value, ast.Name) else ""
+        return base, func.attr
+    return "", ""
+
+
+@dataclasses.dataclass
+class CallSite:
+    base: str               # receiver name when syntactically evident
+    name: str               # bare callee name
+    node: ast.Call
+    lineno: int
+
+
+@dataclasses.dataclass
+class WriteSite:
+    token: Optional[str]    # persisted-artifact flavor of the path expr
+    lineno: int
+    mode: str               # "raw" | "atomic" | "append" | "excl"
+    node: ast.AST
+
+
+@dataclasses.dataclass
+class ReadSite:
+    token: Optional[str]
+    lineno: int
+
+
+@dataclasses.dataclass
+class FunctionSummary:
+    """Pass-1 product: everything CRS/CNC rules know about a function."""
+
+    qualname: str                  # "<relpath>::Class.method"
+    name: str                      # bare name, the resolution key
+    class_name: Optional[str]
+    ctx: FileContext
+    node: ast.AST                  # the FunctionDef / AsyncFunctionDef
+    effects: Set[str] = dataclasses.field(default_factory=set)
+    calls: List[CallSite] = dataclasses.field(default_factory=list)
+    writes: List[WriteSite] = dataclasses.field(default_factory=list)
+    reads: List[ReadSite] = dataclasses.field(default_factory=list)
+    replace_calls: List[ast.Call] = dataclasses.field(default_factory=list)
+    pickle_lines: List[int] = dataclasses.field(default_factory=list)
+    wall_calls: List[ast.Call] = dataclasses.field(default_factory=list)
+    #: params written raw (``open(p, "w")``) / atomically in this body
+    writes_raw_params: Set[str] = dataclasses.field(default_factory=set)
+    writes_atomic_params: Set[str] = dataclasses.field(default_factory=set)
+    #: params that feed deadline/elapsed arithmetic in this body
+    wall_deadline_params: Set[str] = dataclasses.field(default_factory=set)
+    params: List[str] = dataclasses.field(default_factory=list)
+
+    @property
+    def relpath(self) -> str:
+        return self.ctx.relpath.replace("\\", "/")
+
+
+def _param_names(fnode: ast.AST) -> List[str]:
+    a = fnode.args
+    names = [p.arg for p in getattr(a, "posonlyargs", [])]
+    names += [p.arg for p in a.args]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    names += [p.arg for p in a.kwonlyargs]
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+def _open_mode(call: ast.Call) -> str:
+    for kw in call.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant) \
+                and isinstance(kw.value.value, str):
+            return kw.value.value
+    if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant) \
+            and isinstance(call.args[1].value, str):
+        return call.args[1].value
+    return "r"
+
+
+def deadline_hits(fnode: ast.AST, seeds: Dict[str, int],
+                  call_ids: Optional[Dict[int, int]] = None) -> Set[int]:
+    """Report-linenos of seeds that flow into deadline arithmetic.
+
+    ``seeds`` maps a local name to the lineno to report (the clock call
+    that produced it); ``call_ids`` maps ``id(call_node)`` to a lineno
+    for *inline* clock calls.  A flow is a ``-``/``+`` binop or a
+    comparison where one side mentions a seed and either (a) some
+    operand/attribute carries a deadline token, (b) the enclosing
+    assignment target does, or (c) the function's own name does
+    (``_owner_age``-style helpers)."""
+    call_ids = call_ids or {}
+    fname_flavored = match_token(
+        getattr(fnode, "name", ""), DEADLINE_TOKENS) is not None
+    hits: Set[int] = set()
+
+    def _eval(n: ast.AST, target_flavored: bool) -> None:
+        if isinstance(n, ast.BinOp) and isinstance(n.op, (ast.Sub, ast.Add)):
+            sides = [n.left, n.right]
+        elif isinstance(n, ast.Compare):
+            sides = [n.left] + list(n.comparators)
+        else:
+            return
+        involved: Set[int] = set()
+        flavored = False
+        for side in sides:
+            for sub in ast.walk(side):
+                if isinstance(sub, ast.Name):
+                    if sub.id in seeds:
+                        involved.add(seeds[sub.id])
+                    elif match_token(sub.id, DEADLINE_TOKENS):
+                        flavored = True
+                elif isinstance(sub, ast.Attribute):
+                    if match_token(sub.attr, DEADLINE_TOKENS):
+                        flavored = True
+                elif isinstance(sub, ast.Call) and id(sub) in call_ids:
+                    involved.add(call_ids[id(sub)])
+        if involved and (flavored or target_flavored or fname_flavored):
+            hits.update(involved)
+
+    for n in _walk_own(fnode):
+        if isinstance(n, (ast.BinOp, ast.Compare)):
+            _eval(n, False)
+        elif isinstance(n, ast.Assign):
+            tgt_flavored = any(
+                match_token(t.id if isinstance(t, ast.Name) else
+                            getattr(t, "attr", ""), DEADLINE_TOKENS)
+                for t in n.targets
+                if isinstance(t, (ast.Name, ast.Attribute)))
+            if tgt_flavored:
+                for sub in ast.walk(n.value):
+                    _eval(sub, True)
+    return hits
+
+
+def is_wall_clock_call(node: ast.AST) -> bool:
+    """``time.time()`` / ``_time.time()`` / bare ``time()`` calls."""
+    if not isinstance(node, ast.Call):
+        return False
+    base, bare = _call_name(node.func)
+    if bare != "time":
+        return False
+    return base in ("time", "_time") or (
+        base == "" and isinstance(node.func, ast.Name))
+
+
+def _summarize_function(ctx: FileContext, qualname: str,
+                        class_name: Optional[str], fnode: ast.AST,
+                        persisted: frozenset) -> FunctionSummary:
+    s = FunctionSummary(qualname=qualname, name=fnode.name,
+                        class_name=class_name, ctx=ctx, node=fnode)
+    s.params = _param_names(fnode)
+    param_set = set(s.params)
+    raw_write = False
+
+    for n in _walk_own(fnode):
+        if isinstance(n, (ast.With, ast.AsyncWith)):
+            for item in n.items:
+                ce = item.context_expr
+                if isinstance(ce, ast.Call):
+                    ce = ce.func
+                nm = ce.attr if isinstance(ce, ast.Attribute) else (
+                    ce.id if isinstance(ce, ast.Name) else "")
+                if nm and match_token(nm, ("lock", "mutex")):
+                    s.effects.add(LOCK_PREFIX + nm)
+            continue
+        if isinstance(n, (ast.While, ast.For, ast.AsyncFor)):
+            for sub in ast.walk(n):
+                if isinstance(sub, ast.Call):
+                    _, bare = _call_name(sub.func)
+                    if bare == "sleep":
+                        s.effects.add(SLEEP_IN_LOOP)
+                        break
+            continue
+        if not isinstance(n, ast.Call):
+            continue
+        base, bare = _call_name(n.func)
+        if bare:
+            s.calls.append(CallSite(base, bare, n, n.lineno))
+        if base == "os" and bare in ("replace", "rename"):
+            s.effects.add(REPLACE)
+            s.replace_calls.append(n)
+        elif base == "os" and bare == "fsync":
+            s.effects.add(FSYNC)
+        elif "fsync" in bare and "dir" in bare:
+            s.effects.add(FSYNC_DIR)
+        elif bare == "write_atomic":
+            s.effects.add(WRITE_ATOMIC)
+            fsync_off = any(
+                kw.arg == "fsync_dir" and isinstance(kw.value, ast.Constant)
+                and kw.value.value is False for kw in n.keywords)
+            if not fsync_off:
+                s.effects.add(FSYNC_DIR)
+            if n.args:
+                s.writes.append(WriteSite(
+                    expr_token(n.args[0], persisted), n.lineno,
+                    "atomic", n))
+                if isinstance(n.args[0], ast.Name) \
+                        and n.args[0].id in param_set:
+                    s.writes_atomic_params.add(n.args[0].id)
+        elif base == "" and bare == "open" and n.args:
+            mode = _open_mode(n)
+            tok = expr_token(n.args[0], persisted)
+            if mode.startswith(("w", "x")):
+                kind = "excl" if mode.startswith("x") else "raw"
+                if kind == "excl":
+                    s.effects.add(OPEN_EXCL)
+                else:
+                    raw_write = True
+                    if isinstance(n.args[0], ast.Name) \
+                            and n.args[0].id in param_set:
+                        s.writes_raw_params.add(n.args[0].id)
+                s.writes.append(WriteSite(tok, n.lineno, kind, n))
+            elif mode.startswith("a"):
+                s.effects.add(OPEN_APPEND)
+                s.writes.append(WriteSite(tok, n.lineno, "append", n))
+            else:
+                s.reads.append(ReadSite(tok, n.lineno))
+        elif base == "os" and bare == "open":
+            excl = any(isinstance(sub, ast.Attribute)
+                       and sub.attr == "O_EXCL" for sub in ast.walk(n))
+            if excl:
+                s.effects.add(OPEN_EXCL)
+            if n.args:
+                s.writes.append(WriteSite(
+                    expr_token(n.args[0], persisted), n.lineno,
+                    "excl" if excl else "raw", n))
+        elif bare in ("recv", "recv_into", "recvfrom", "recv_bytes"):
+            s.effects.add(WIRE_READ)
+        elif base == "pickle" and bare == "loads":
+            s.effects.add(PICKLE_LOADS)
+            s.pickle_lines.append(n.lineno)
+        elif bare == "compare_digest":
+            s.effects.add(CONST_TIME)
+        elif is_wall_clock_call(n):
+            s.effects.add(WALL_CLOCK)
+            s.wall_calls.append(n)
+
+    if raw_write and REPLACE in s.effects:
+        s.effects.add(TEMP_RENAME)
+
+    # parameterized deadline effect: which params feed -,+,< arithmetic
+    if param_set:
+        seeds = {p: 0 for p in param_set if p not in ("self", "cls")}
+        if seeds:
+            hit_marks = {p: i + 1 for i, p in enumerate(sorted(seeds))}
+            hits = deadline_hits(fnode, {p: hit_marks[p] for p in seeds})
+            back = {v: k for k, v in hit_marks.items()}
+            s.wall_deadline_params = {back[h] for h in hits if h in back}
+    return s
+
+
+def module_persisted_tokens(ctx: FileContext) -> frozenset:
+    """PERSISTED_TOKENS plus any tokens the module declares via a
+    module-level ``PERSISTED_ARTIFACTS = ("name", ...)`` registry."""
+    extra: Set[str] = set()
+    for stmt in ctx.tree.body:
+        if not isinstance(stmt, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name)
+                   and t.id == PERSISTED_REGISTRY_NAME
+                   for t in stmt.targets):
+            continue
+        for sub in ast.walk(stmt.value):
+            if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+                extra.update(_segments(sub.value))
+    return PERSISTED_TOKENS | frozenset(extra)
+
+
+def summarize_file(ctx: FileContext) -> List[FunctionSummary]:
+    persisted = module_persisted_tokens(ctx)
+    out: List[FunctionSummary] = []
+
+    def visit(node: ast.AST, prefix: str, class_name: Optional[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{ctx.relpath}::{prefix}{child.name}"
+                out.append(_summarize_function(
+                    ctx, qual, class_name, child, persisted))
+                visit(child, f"{prefix}{child.name}.", class_name)
+            elif isinstance(child, ast.ClassDef):
+                visit(child, f"{prefix}{child.name}.", child.name)
+            elif isinstance(child, (ast.If, ast.Try, ast.With)):
+                visit(child, prefix, class_name)
+
+    visit(ctx.tree, "", None)
+    return out
+
+
+class EffectIndex:
+    """All summaries of one lint run + conservative name resolution."""
+
+    def __init__(self) -> None:
+        self.summaries: List[FunctionSummary] = []
+        self.by_module: Dict[str, Dict[str, List[FunctionSummary]]] = {}
+        self.by_bare: Dict[str, List[FunctionSummary]] = {}
+        self._persisted: Dict[str, frozenset] = {}
+        self._effective: Dict[str, Set[str]] = {}
+
+    def add_file(self, ctx: FileContext) -> None:
+        self._persisted[ctx.relpath] = module_persisted_tokens(ctx)
+        for s in summarize_file(ctx):
+            self.summaries.append(s)
+            self.by_module.setdefault(ctx.relpath, {}) \
+                .setdefault(s.name, []).append(s)
+            self.by_bare.setdefault(s.name, []).append(s)
+
+    def persisted_tokens(self, relpath: str) -> frozenset:
+        return self._persisted.get(relpath, PERSISTED_TOKENS)
+
+    def resolve(self, relpath: str,
+                bare: str) -> Optional[FunctionSummary]:
+        """Same-module unique match first, then package-unique; an
+        ambiguous or unknown name resolves to ``None`` (rules must then
+        be conservative: no finding)."""
+        local = self.by_module.get(relpath, {}).get(bare, [])
+        if len(local) == 1:
+            return local[0]
+        if local:
+            return None
+        pkg = self.by_bare.get(bare, [])
+        if len(pkg) == 1:
+            return pkg[0]
+        return None
+
+    def is_known_call(self, s: FunctionSummary, site: CallSite) -> bool:
+        """True when the callee cannot secretly commit on the caller's
+        behalf: it resolves to a summary, is a builtin, or is an
+        attribute of a known stdlib module."""
+        if self.resolve_callee(s, site) is not None:
+            return True
+        if site.base:
+            return site.base in _KNOWN_MODULES
+        return site.name in _BUILTIN_NAMES
+
+    def effective_effects(self, s: FunctionSummary) -> Set[str]:
+        """Own effects ∪ direct effects of each resolved callee —
+        exactly one level deep, never recursive."""
+        cached = self._effective.get(s.qualname)
+        if cached is not None:
+            return cached
+        eff = set(s.effects)
+        for c in s.calls:
+            g = self.resolve_callee(s, c)
+            if g is not None and g is not s:
+                eff |= g.effects
+        self._effective[s.qualname] = eff
+        return eff
+
+    def resolve_callee(self, s: FunctionSummary,
+                       site: CallSite) -> Optional[FunctionSummary]:
+        # a syntactic receiver that is a known module namespace never
+        # resolves to one of our defs under a colliding bare name
+        if site.base in _KNOWN_MODULES:
+            return None
+        return self.resolve(s.ctx.relpath, site.name)
+
+
+_SCRATCH_KEY = "__effect_summaries__"
+
+
+def get_index(run: LintRun) -> EffectIndex:
+    """The run's (cached) effect index — built once, shared by every
+    CRS/CNC rule via ``run.scratch``."""
+    idx = run.scratch.get(_SCRATCH_KEY)
+    if not isinstance(idx, EffectIndex):
+        idx = EffectIndex()
+        for ctx in run.contexts:
+            idx.add_file(ctx)
+        run.scratch[_SCRATCH_KEY] = idx
+    return idx

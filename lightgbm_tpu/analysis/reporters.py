@@ -1,4 +1,4 @@
-"""tpulint reporters: text and JSON, with the shared CLI exit codes.
+"""tpulint reporters: text, JSON and SARIF, with the shared exit codes.
 
 Exit-code convention shared by every repo CLI (tools/_report.py mirrors
 these for trace_report / checkpoint_inspect):
@@ -13,7 +13,7 @@ from __future__ import annotations
 import json
 from typing import Dict, List, Sequence
 
-from .core import Violation
+from .core import Rule, Violation
 
 EXIT_OK = 0
 EXIT_FINDINGS = 1
@@ -43,6 +43,48 @@ def render_json(violations: Sequence[Violation],
         "violations": [v.as_dict() for v in violations],
         "summary": stats,
     }, indent=2, sort_keys=True)
+
+
+_SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/"
+                 "sarif-spec/master/Schemata/sarif-schema-2.1.0.json")
+
+
+def render_sarif(violations: Sequence[Violation],
+                 stats: Dict[str, object],
+                 rules: Sequence[Rule] = ()) -> str:
+    """Minimal SARIF 2.1.0 document (one run, one driver) — the format
+    code-review UIs ingest natively.  Paths are repo-relative URIs;
+    ``startColumn`` is converted to SARIF's 1-based convention."""
+    levels = {"error": "error", "warning": "warning"}
+    rule_meta = [{
+        "id": r.id,
+        "name": r.name,
+        "shortDescription": {"text": r.description or r.name},
+        "defaultConfiguration": {
+            "level": levels.get(r.severity, "warning")},
+    } for r in sorted(rules, key=lambda r: r.id)]
+    results = [{
+        "ruleId": v.rule_id,
+        "level": levels.get(v.severity, "warning"),
+        "message": {"text": v.message},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": v.path.replace("\\", "/")},
+                "region": {"startLine": v.line,
+                           "startColumn": v.col + 1},
+            },
+        }],
+    } for v in violations]
+    doc = {
+        "$schema": _SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {"name": "tpulint",
+                                "rules": rule_meta}},
+            "results": results,
+        }],
+    }
+    return json.dumps(doc, indent=2, sort_keys=True)
 
 
 def exit_code(violations: Sequence[Violation]) -> int:

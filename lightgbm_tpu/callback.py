@@ -128,10 +128,10 @@ def _prune_stale_telemetry(path: str, cut: int) -> int:
         else:
             kept.append(ln)
     if dropped:
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            f.writelines(kept)
-        os.replace(tmp, path)
+        from .utils.paths import write_atomic
+        # telemetry is an append-only log, not crash-critical state; the
+        # rewrite only needs atomicity, not a directory fsync
+        write_atomic(path, "".join(kept), fsync_dir=False)
     return dropped
 
 
@@ -183,14 +183,18 @@ def log_telemetry(path: str, period: int = 1,
                          f"at iteration >= {resume_from} left by the "
                          "interrupted predecessor run")
         from .obs import memory as obs_memory, trace as obs_trace
-        now = _time.time()
-        dt = None if state["t_last"] is None else now - state["t_last"]
-        state["t_last"] = now
+        # iter_time_s is an ELAPSED measurement — monotonic, so an NTP
+        # step mid-run cannot produce a negative or inflated duration;
+        # unix_time stays wall (it is a journal stamp, not arithmetic)
+        now_mono = _time.monotonic()
+        dt = (None if state["t_last"] is None
+              else now_mono - state["t_last"])
+        state["t_last"] = now_mono
         mem = obs_memory.memory_snapshot()
         rec: Dict[str, Any] = {
             "run": state["run"],
             "iteration": env.iteration,
-            "unix_time": round(now, 3),
+            "unix_time": round(_time.time(), 3),
             "iter_time_s": None if dt is None else round(dt, 6),
             "evals": {f"{item[0]}.{item[1]}": float(item[2])
                       for item in (env.evaluation_result_list or [])},

@@ -173,17 +173,16 @@ def steal_claim(workdir: str, tag: str, stripe: int, rank: int,
     the loser back off, and even the residual window is harmless — a
     stripe's commit content is deterministic, so a double re-do commits
     identical arrays."""
+    from ..utils.paths import write_atomic
     path = claim_path(workdir, tag, stripe)
     payload = {"stripe": int(stripe), "pass": tag, "rank": int(rank),
                "pid": os.getpid(),
                "generation": int(old.get("generation", 0)) + 1,
                "unix_time": time.time()}
-    tmp = path + f".steal.r{int(rank)}.tmp"
-    with open(tmp, "w") as fh:
-        json.dump(payload, fh)
-        fh.flush()
-        os.fsync(fh.fileno())
-    os.replace(tmp, path)
+    # claims are a liveness fence, not durable state: the pid-suffixed
+    # temp keeps racing survivors off each other's staging file, and
+    # skipping the directory flush keeps steals cheap
+    write_atomic(path, json.dumps(payload), fsync_dir=False)
     now = read_claim(workdir, tag, stripe)
     return bool(now and now.get("rank") == int(rank)
                 and now.get("pid") == os.getpid())

@@ -639,22 +639,21 @@ def clamp_chunk_rows(chunk_rows: int, num_features: Optional[int],
 # manifest (checkpoint-substrate atomic writes)
 # --------------------------------------------------------------------------
 def _write_atomic(path: str, data: Union[str, bytes]) -> None:
-    from ..robustness.checkpoint import _fsync_dir, _write_file
-    tmp = path + ".tmp"
-    _write_file(tmp, data)
-    os.replace(tmp, path)
-    _fsync_dir(os.path.dirname(path) or ".")
+    from ..utils.paths import write_atomic
+    write_atomic(path, data)
 
 
 def _save_npz_atomic(path: str, arrays: Dict[str, np.ndarray]) -> None:
-    from ..robustness.checkpoint import _fsync_dir
+    # arrays stream straight into the temp file (no bytes staging), so
+    # this is the one writer that hand-rolls write_atomic's dance
+    from ..utils.paths import fsync_dir
     tmp = path + ".tmp"
     with open(tmp, "wb") as fh:
         np.savez(fh, **arrays)
         fh.flush()
         os.fsync(fh.fileno())
     os.replace(tmp, path)
-    _fsync_dir(os.path.dirname(path) or ".")
+    fsync_dir(os.path.dirname(path) or ".")
 
 
 def read_manifest(workdir: str) -> Optional[Dict[str, Any]]:

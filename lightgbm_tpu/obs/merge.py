@@ -228,10 +228,8 @@ def merge_rank_traces(
                                   for f in files]}}
     count_event("trace_merges")
     if out_path:
-        tmp = out_path + ".tmp"
-        with open(tmp, "w") as fh:
-            json.dump(doc, fh)
-        os.replace(tmp, out_path)
+        from ..utils.paths import write_atomic
+        write_atomic(out_path, json.dumps(doc))
     return doc
 
 

@@ -408,15 +408,11 @@ def read_snapshot(path: str) -> Optional[Dict[str, Any]]:
 
 
 def _atomic_write_json(path: str, doc: Dict[str, Any]) -> None:
+    from ..utils.paths import write_atomic
     d = os.path.dirname(os.path.abspath(path))
     if d:
         os.makedirs(d, exist_ok=True)
-    tmp = path + ".tmp.%d" % os.getpid()
-    with open(tmp, "w", encoding="utf-8") as f:
-        json.dump(doc, f)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
+    write_atomic(path, json.dumps(doc))
 
 
 # module-level active recorder: one `is None` check on hot paths keeps

@@ -42,6 +42,7 @@ _ACTIVE_LOCK = threading.Lock()
 class TraceRecorder:
     """Accumulates trace events; thread-safe appends; one per trace run."""
 
+    # tpulint: guarded-by(_lock): _events, meta
     def __init__(self, export_path: Optional[str] = None) -> None:
         self._events: List[Dict[str, Any]] = []
         self._lock = threading.Lock()
@@ -121,10 +122,8 @@ class TraceRecorder:
 
     def export(self, path: str) -> None:
         """Write the Chrome trace JSON (Perfetto-loadable) to ``path``."""
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(self.to_dict(), f)
-        os.replace(tmp, path)
+        from ..utils.paths import write_atomic
+        write_atomic(path, json.dumps(self.to_dict()))
 
 
 def active() -> Optional[TraceRecorder]:

@@ -63,7 +63,7 @@ from typing import Any, Callable, Dict, Hashable, List, Optional, Tuple
 
 from ..obs.metrics import MetricsRegistry, count_event
 from ..utils import log
-from ..utils.paths import check_output_path
+from ..utils.paths import check_output_path, write_atomic
 
 #: store header file name — presence marks a directory as an AOT store
 HEADER_NAME = "aot_store.json"
@@ -104,12 +104,7 @@ def is_aot_store(path: str) -> bool:
 
 
 def _atomic_bytes(path: str, payload: bytes) -> None:
-    tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "wb") as fh:
-        fh.write(payload)
-        fh.flush()
-        os.fsync(fh.fileno())
-    os.replace(tmp, path)
+    write_atomic(path, payload)
 
 
 class AOTStore:

@@ -6,8 +6,8 @@ One training-and-publishing *cycle* walks a fixed phase order::
 
 and this module is the durable half of that walk: a single
 ``pipeline_manifest.json`` in the pipeline workdir, rewritten atomically
-(temp + ``os.replace`` + directory fsync — the checkpoint-substrate
-idiom from ``robustness/checkpoint.py``) at every phase boundary.  The
+(``utils/paths.py`` ``write_atomic``: temp + ``os.replace`` +
+directory fsync) at every phase boundary.  The
 manifest is the ONLY authority on pipeline progress: a trainer that was
 SIGKILLed anywhere reads it back and knows exactly which phase to
 re-enter, and every phase is written to be idempotent under re-entry
@@ -91,11 +91,8 @@ def portable_model_text(text: str,
 
 
 def _atomic_json(path: str, payload: Dict[str, Any]) -> None:
-    from ..robustness.checkpoint import _fsync_dir, _write_file
-    tmp = path + ".tmp"
-    _write_file(tmp, json.dumps(payload, indent=1, sort_keys=True))
-    os.replace(tmp, path)
-    _fsync_dir(os.path.dirname(path) or ".")
+    from ..utils.paths import write_atomic
+    write_atomic(path, json.dumps(payload, indent=1, sort_keys=True))
 
 
 class CycleManifest:

@@ -43,6 +43,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..utils import log
+from ..utils.paths import fsync_dir
 
 CKPT_PREFIX = "ckpt_"
 MANIFEST_NAME = "manifest.json"
@@ -60,15 +61,9 @@ def _sha256(path: str) -> str:
     return h.hexdigest()
 
 
-def _fsync_dir(path: str) -> None:
-    try:
-        fd = os.open(path, os.O_RDONLY)
-        try:
-            os.fsync(fd)
-        finally:
-            os.close(fd)
-    except OSError:  # fsync on a dir is best-effort (not all filesystems)
-        pass
+# one blessed implementation (utils/paths.py) for the whole repo; the
+# old private name survives as an alias for its historical importers
+_fsync_dir = fsync_dir
 
 
 def _write_file(path: str, data) -> None:

@@ -259,7 +259,10 @@ class HeartbeatMonitor:
         ``timeout_s`` past entry plus an explicit attempt cap, so a
         frozen clock cannot spin it forever.
         """
-        t_enter = time.time()
+        # the wait's own deadline/elapsed arithmetic runs on the
+        # monotonic clock (immune to wall steps); only marker AGING
+        # (classify) uses wall time, the one clock all hosts share
+        t_enter = time.monotonic()
         poll = min(max(self.interval_s / 10.0, _POLL_MIN_S), _POLL_MAX_S)
         max_attempts = int(self.timeout_s / poll) + 2
         deadline = t_enter + self.timeout_s + poll
@@ -268,7 +271,8 @@ class HeartbeatMonitor:
         report = self.classify(expect_round)
         attempts = 0
         while (not report.all_healthy and not report.dead
-               and attempts < max_attempts and time.time() < deadline):
+               and attempts < max_attempts
+               and time.monotonic() < deadline):
             self._note_slow(report)
             sleep(poll)
             attempts += 1
@@ -288,7 +292,7 @@ class HeartbeatMonitor:
                            age_s=round(report.ages.get(r, -1.0), 3),
                            timeout_s=self.timeout_s)
             raise WorkerEvicted(report.dead, expect_round,
-                                time.time() - t_enter)
+                                time.monotonic() - t_enter)
         return report
 
 

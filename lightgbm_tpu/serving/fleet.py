@@ -77,6 +77,7 @@ from ..robustness.elastic import (DEAD, HEALTHY, SUSPECT, age_state,
                                   heartbeat_path, publish_heartbeat,
                                   read_heartbeat)
 from ..utils import log
+from ..utils.paths import write_atomic
 
 #: deadline budget (ms) for requests that arrive without one — bounds
 #: every socket operation the dispatch performs (RBS502: no unbounded
@@ -182,11 +183,8 @@ def _recv_msg(sock: socket.socket, deadline_mono: float,
 
 
 def _atomic_json(path: str, payload: dict) -> None:
-    """temp + rename, the heartbeat/checkpoint-manifest idiom."""
-    tmp = path + ".tmp"
-    with open(tmp, "w") as fh:
-        json.dump(payload, fh)
-    os.replace(tmp, path)
+    """Atomic+durable manifest/marker rewrite (utils/paths.py idiom)."""
+    write_atomic(path, json.dumps(payload))
 
 
 # ---------------------------------------------------------------------------
@@ -238,10 +236,7 @@ class FleetRegistry:
 
     def _stage(self, name: str, version: int, model_text: str) -> str:
         path = os.path.join(self.models_dir, f"{name}_v{int(version)}.txt")
-        tmp = path + ".tmp"
-        with open(tmp, "w") as fh:
-            fh.write(model_text)
-        os.replace(tmp, path)
+        write_atomic(path, model_text)
         return path
 
     def _commit(self, name: str, version: int, path: str,
@@ -555,6 +550,7 @@ class _ReplicaSlot:
 
     __slots__ = ("slot", "incarnation", "proc", "log_file", "port",
                  "pid", "state", "draining", "spawn_unix", "ready_unix",
+                 "spawn_mono", "hb_seen_mono", "hb_stamp",
                  "ready_path", "respawn_failures", "suspect_since",
                  "flight_mirror")
 
@@ -570,8 +566,19 @@ class _ReplicaSlot:
         self.flight_mirror: Optional[dict] = None
         self.state = _WARMING
         self.draining = False
+        #: journal-facing wall stamps (human-readable provenance only —
+        #: the monitor NEVER does arithmetic on them)
         self.spawn_unix = 0.0
         self.ready_unix = 0.0
+        #: monitor-clock (time.monotonic) receipts.  The replica's
+        #: heartbeat markers carry ITS wall clock; comparing that
+        #: against the router's wall clock would mark healthy replicas
+        #: dead on a backwards clock step, so the monitor instead
+        #: records — on its own monotonic clock — when the marker
+        #: payload last CHANGED (``hb_stamp`` is the last payload seen).
+        self.spawn_mono = 0.0
+        self.hb_seen_mono = 0.0
+        self.hb_stamp: Optional[float] = None
         self.ready_path = ""
         self.respawn_failures = 0
         self.suspect_since: Optional[float] = None
@@ -688,7 +695,9 @@ class FleetServer:
         #: one scale action per cooldown — a breach must not fork-bomb
         #: the host, and a recovery must not mass-retire the fleet
         self.autoscale_cooldown_s = max(1.0, float(cfg.rollup_window_s))
-        self._last_scale_unix = 0.0
+        # -inf: the first scaling decision is never cooldown-gated
+        # (monotonic origin is arbitrary, so 0.0 would be wrong)
+        self._last_scale_mono = float("-inf")
         self._retire_threads: List[threading.Thread] = []
         try:
             enabled = parse_slo_config(cfg.slo_config)
@@ -786,7 +795,10 @@ class FleetServer:
         s.draining = False
         s.port = None
         s.flight_mirror = None       # stale ring from the old incarnation
-        s.spawn_unix = time.time()
+        s.spawn_unix = time.time()   # journal stamp; aging uses mono
+        s.spawn_mono = time.monotonic()
+        s.hb_stamp = None
+        s.hb_seen_mono = 0.0
         s.proc, s.log_file = spawn_worker(
             "lightgbm_tpu.serving.fleet", spec_path,
             os.path.join(self.logs_dir, f"replica_{tag}.log"))
@@ -805,12 +817,16 @@ class FleetServer:
         s.pid = int(marker.get("pid", s.pid or 0))
         s.state = HEALTHY
         s.suspect_since = None
-        s.ready_unix = time.time()
+        s.ready_unix = time.time()   # journal stamp; aging uses mono
+        # freshness receipt: a replica that never publishes a heartbeat
+        # after promotion ages from its promotion instant
+        s.hb_stamp = None
+        s.hb_seen_mono = time.monotonic()
         s.respawn_failures = 0
         if rejoin:
             emit_event("replica_rejoined", slot=s.slot,
                        incarnation=s.incarnation, pid=s.pid,
-                       warm_s=round(s.ready_unix - s.spawn_unix, 3),
+                       warm_s=round(s.hb_seen_mono - s.spawn_mono, 3),
                        # -1 = pre-store marker; 0 = warmed entirely
                        # from the AOT executable store (the drill gate)
                        warm_lowerings=int(
@@ -913,7 +929,10 @@ class FleetServer:
     def _monitor_loop(self) -> None:
         poll = min(max(self.hb_interval_s / 2.0, 0.05), 0.5)
         while not self._stop.wait(poll):
-            now = time.time()
+            # monotonic: liveness deadlines must survive wall-clock
+            # steps (NTP slew/step would otherwise kill healthy
+            # replicas or leave dead ones routable)
+            now = time.monotonic()
             with self._lock:
                 slots = list(self._slots.values())
             for s in slots:
@@ -934,7 +953,7 @@ class FleetServer:
                         # launched; an immediately-expired warming
                         # window re-enters the respawn path next poll
                         s.state = _WARMING
-                        s.spawn_unix = 0.0
+                        s.spawn_mono = float("-inf")
                     if s.respawn_failures > _RESPAWN_LIMIT:
                         s.state = _FAILED
                         log.warning(
@@ -971,7 +990,7 @@ class FleetServer:
                 self._promote(s, rejoin=s.incarnation > 0)
                 return
             died = s.proc is not None and s.proc.poll() is not None
-            timed_out = now - s.spawn_unix > _SPAWN_WINDOW_S
+            timed_out = now - s.spawn_mono > _SPAWN_WINDOW_S
             if died or timed_out:
                 s.respawn_failures += 1
                 if s.respawn_failures > _RESPAWN_LIMIT:
@@ -1004,8 +1023,16 @@ class FleetServer:
                 s.flight_mirror = snap
         hb = read_heartbeat(heartbeat_path(
             self.coord_dir, s.incarnation, s.slot))
-        last = float(hb["unix_time"]) if hb else s.ready_unix
-        age = max(0.0, now - last)
+        # Receipt-based aging: the marker's unix_time is the REPLICA's
+        # wall clock — never compare it against ours (a backwards step
+        # on either side would fabricate a timeout).  Liveness is "the
+        # marker payload changed recently", measured entirely on the
+        # monitor's monotonic clock.
+        stamp = hb.get("unix_time") if hb else None
+        if stamp is not None and stamp != s.hb_stamp:
+            s.hb_stamp = stamp
+            s.hb_seen_mono = now
+        age = max(0.0, now - s.hb_seen_mono)
         state = age_state(age, interval_s=self.hb_interval_s,
                           timeout_s=self.hb_timeout_s)
         if state == DEAD:
@@ -1032,7 +1059,7 @@ class FleetServer:
         tower = self._tower
         if tower is None:
             return
-        if now - self._last_scale_unix < self.autoscale_cooldown_s:
+        if now - self._last_scale_mono < self.autoscale_cooldown_s:
             return
         with self._tower_lock:
             tower.evaluate()
@@ -1061,7 +1088,7 @@ class FleetServer:
                 action = "down"
             else:
                 return
-        self._last_scale_unix = now
+        self._last_scale_mono = now
         if action == "up":
             count_event("fleet_autoscale_ups", 1, self.metrics)
             emit_event("replica_autoscaled_up", slot=s.slot,

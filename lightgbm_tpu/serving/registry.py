@@ -70,7 +70,7 @@ class PublishProvenance:
                path: Optional[str] = None) -> None:
         """Durably record one published version (idempotent: recording
         the same (name, version, sha) again rewrites the same bytes)."""
-        from ..robustness.checkpoint import _fsync_dir, _write_file
+        from ..utils.paths import write_atomic
         with self._lock:
             models = self._read()
             entry = models.setdefault(str(name), {})
@@ -85,10 +85,8 @@ class PublishProvenance:
             d = os.path.dirname(self.path)
             if d:
                 os.makedirs(d, exist_ok=True)
-            tmp = self.path + ".tmp"
-            _write_file(tmp, json.dumps(payload, indent=1, sort_keys=True))
-            os.replace(tmp, self.path)
-            _fsync_dir(d or ".")
+            write_atomic(self.path,
+                         json.dumps(payload, indent=1, sort_keys=True))
 
     def versions(self, name: str) -> List[int]:
         with self._lock:
@@ -123,6 +121,7 @@ class ModelEntry(NamedTuple):
 
 
 class ModelRegistry:
+    # tpulint: guarded-by(_lock): _entries, _next_version
     def __init__(self, metrics: Optional[MetricsRegistry] = None,
                  provenance: Optional[PublishProvenance] = None) -> None:
         self._entries: Dict[str, ModelEntry] = {}

@@ -1,20 +1,75 @@
-"""Output-path validation shared by every path-producing config key.
+"""Output-path validation and the blessed atomic-write idiom.
 
-The failure-path contract (docs/OBSERVABILITY.md, docs/ROBUSTNESS.md):
-a mistyped or unwritable output path (``trace_output``,
-``telemetry_output``, ``checkpoint_dir``, ...) degrades the FEATURE to a
-warning emitted before boosting round 1 — it must never surface as a
-mid-training crash after hours of work, and it must never take the
-trained booster down with it.  This module is the single implementation
-of that probe; the per-feature call sites only differ in the key name
-they put in the warning.
+Two contracts live here:
+
+1. **Output-path probing** (docs/OBSERVABILITY.md, docs/ROBUSTNESS.md):
+   a mistyped or unwritable output path (``trace_output``,
+   ``telemetry_output``, ``checkpoint_dir``, ...) degrades the FEATURE
+   to a warning emitted before boosting round 1 — it must never surface
+   as a mid-training crash after hours of work, and it must never take
+   the trained booster down with it.  This module is the single
+   implementation of that probe; the per-feature call sites only differ
+   in the key name they put in the warning.
+
+2. **Crash-safe persistent writes** (docs/STATIC_ANALYSIS.md CRS6xx):
+   every manifest/ledger/marker/registry rewrite in the repo flows
+   through :func:`write_atomic` — write to a pid-suffixed temp sibling,
+   fsync the file, ``os.replace`` into place, then (by default) fsync
+   the parent directory so the rename itself is durable.  A reader
+   never observes a torn file; a crashed writer leaves only a temp
+   husk.  tpulint's CRS601/CRS602 rules recognize exactly this helper
+   (by name) as the safe idiom — hand-rolling the temp+rename dance
+   elsewhere is a lint finding.
 """
 
 from __future__ import annotations
 
 import os
+from typing import Union
 
 from . import log
+
+
+def fsync_dir(path: str) -> None:
+    """Flush a directory entry so a just-renamed file survives power
+    loss.  Best-effort: not every filesystem supports fsync on a
+    directory fd, and the rename's ATOMICITY never depends on it."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+    except OSError:
+        pass
+
+
+# the keyword-only flag below shadows the function name inside
+# write_atomic's scope; alias it so the call still resolves
+_dir_fsync = fsync_dir
+
+
+def write_atomic(path: str, data: Union[str, bytes], *,
+                 fsync_dir: bool = True) -> None:
+    """Atomically (and durably) replace ``path`` with ``data``.
+
+    The temp sibling embeds the pid so concurrent writers (pytest-xdist
+    workers, racing fleet survivors) cannot corrupt each other's
+    staging file; the loser of an ``os.replace`` race is simply
+    overwritten by the winner, which is the last-write-wins semantics
+    every call site already assumes.  ``fsync_dir=False`` skips the
+    directory flush for artifacts whose durability across power loss
+    does not matter (claims, advisory markers) — the rename is atomic
+    either way."""
+    mode = "wb" if isinstance(data, bytes) else "w"
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, mode) as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    if fsync_dir:
+        _dir_fsync(os.path.dirname(path) or ".")
 
 
 def writable_file(path: str) -> bool:

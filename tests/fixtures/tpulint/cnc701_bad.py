@@ -1,0 +1,26 @@
+"""CNC701 bad: wall-clock readings feed deadline arithmetic.
+
+wait_ready builds its deadline from time.time() directly; poll_lease
+launders the reading through a local and passes it to a callee whose
+parameters feed deadline arithmetic (one-level call-through).  An NTP
+step makes both waits return instantly or spin for hours.
+"""
+
+import time
+
+
+def wait_ready(poll_s):
+    deadline = time.time() + poll_s
+    while time.time() < deadline:
+        check()
+
+
+def _lease_ok(now, expires_at):
+    remaining = expires_at - now
+    return remaining > 0.0
+
+
+def poll_lease(lease_s):
+    t0 = time.time()
+    while _lease_ok(t0, t0 + lease_s):
+        step()

@@ -1,0 +1,27 @@
+"""CNC701 ok: deadlines run on time.monotonic(); time.time() appears
+only as a stored journal stamp, never in arithmetic."""
+
+import time
+
+
+def wait_ready(poll_s):
+    deadline = time.monotonic() + poll_s
+    while time.monotonic() < deadline:
+        check()
+
+
+def _lease_ok(now, expires_at):
+    remaining = expires_at - now
+    return remaining > 0.0
+
+
+def poll_lease(lease_s):
+    t0 = time.monotonic()
+    while _lease_ok(t0, t0 + lease_s):
+        step()
+
+
+def stamp_journal(journal):
+    # storing a wall stamp for humans/other hosts to read is fine
+    journal["unix_time"] = round(time.time(), 3)
+    return journal

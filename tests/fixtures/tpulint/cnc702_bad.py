@@ -1,0 +1,21 @@
+"""CNC702 bad: wire bytes reach pickle.loads with no authentication.
+
+pickle deserialization is arbitrary code execution; anything that can
+reach the socket owns the process.  handle_frame shows the one-level
+case: the recv lives in a helper, the loads in the caller.
+"""
+
+import pickle
+
+
+def recv_model(conn):
+    payload = conn.recv(65536)
+    return pickle.loads(payload)
+
+
+def _read_frame(conn):
+    return conn.recv_bytes()
+
+
+def handle_frame(conn):
+    return pickle.loads(_read_frame(conn))

@@ -1,0 +1,28 @@
+"""CNC702 ok: a constant-time token check dominates every pickle.loads
+on wire bytes (directly or one call away); json payloads need none."""
+
+import hmac
+import json
+import pickle
+
+
+def recv_model(conn, secret):
+    token = conn.recv(32)
+    if not hmac.compare_digest(token, secret):
+        raise ValueError("bad auth token")
+    return pickle.loads(conn.recv(1 << 20))
+
+
+def _authenticated(conn, secret):
+    return hmac.compare_digest(conn.recv(32), secret)
+
+
+def recv_checked(conn, secret):
+    if not _authenticated(conn, secret):
+        raise ValueError("bad auth token")
+    return pickle.loads(conn.recv(1 << 20))
+
+
+def recv_stats(conn):
+    # json cannot execute code — no token demanded
+    return json.loads(conn.recv(4096).decode("utf-8"))

@@ -1,0 +1,27 @@
+"""CNC703 bad: attributes declared guarded-by(_lock) mutated bare.
+
+The class declares its locking discipline in the body comment; add()
+and the tail of drain() mutate declared attributes with no lock held —
+exactly the races the declaration promises cannot happen.
+"""
+
+import threading
+
+
+class EventBuffer:
+    # tpulint: guarded-by(_lock): _events, _count
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._events = []
+        self._count = 0
+
+    def add(self, ev):
+        self._events.append(ev)
+        self._count += 1
+
+    def drain(self):
+        with self._lock:
+            out = list(self._events)
+            self._events.clear()
+        self._count = 0
+        return out

@@ -1,0 +1,33 @@
+"""CNC703 ok: declared attributes only mutate under the declared lock;
+__init__ is exempt (no concurrent alias exists yet) and undeclared
+attributes stay free."""
+
+import threading
+
+
+class EventBuffer:
+    # tpulint: guarded-by(_lock): _events, _count
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._events = []
+        self._count = 0
+        self._hint = None
+
+    def add(self, ev):
+        with self._lock:
+            self._events.append(ev)
+            self._count += 1
+
+    def drain(self):
+        with self._lock:
+            out = list(self._events)
+            self._events.clear()
+            self._count = 0
+        return out
+
+    def set_hint(self, h):
+        self._hint = h      # undeclared attribute: no discipline claimed
+
+    def snapshot(self):
+        with self._lock:
+            return list(self._events), self._count

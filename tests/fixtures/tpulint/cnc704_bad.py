@@ -1,0 +1,14 @@
+"""CNC704 bad: a thread whose lifecycle was never decided.
+
+No daemon= and nothing in this file ever waits for the thread — at
+interpreter teardown it either blocks exit forever (non-daemon default)
+or dies mid-write, and the author chose neither.
+"""
+
+import threading
+
+
+def start_monitor(target):
+    t = threading.Thread(target=target, name="monitor")
+    t.start()
+    return t

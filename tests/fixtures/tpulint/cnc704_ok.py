@@ -1,0 +1,17 @@
+"""CNC704 ok: every thread's lifecycle is declared — daemon= chosen
+explicitly, or the file visibly joins it."""
+
+import threading
+
+
+def start_daemon(target):
+    t = threading.Thread(target=target, daemon=True)
+    t.start()
+    return t
+
+
+def run_and_wait(target):
+    t = threading.Thread(target=target)
+    t.start()
+    t.join(timeout=30.0)
+    return t

@@ -1,0 +1,21 @@
+"""CRS601 bad: persistent-state files written raw.
+
+Both writers put the bytes straight into the final path — a SIGKILL
+mid-write leaves a truncated manifest/roster that recovery then loads.
+The second writer's flavor token comes from the module's own
+PERSISTED_ARTIFACTS registry rather than the built-in vocabulary.
+"""
+
+import json
+
+PERSISTED_ARTIFACTS = ("roster",)
+
+
+def save_manifest(path, entries):
+    with open(path + ".manifest", "w") as fh:
+        json.dump(entries, fh)
+
+
+def save_roster(path, names):
+    with open(path + ".roster", "w") as fh:
+        fh.write("\n".join(names))

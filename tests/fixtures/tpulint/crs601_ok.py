@@ -1,0 +1,51 @@
+"""CRS601 ok: every persistent write commits atomically (or is exempt).
+
+Covers the exemption surface: write_atomic directly, temp+os.replace
+one call level away (call-through), append-mode journals, and an
+unresolvable callee that receives the flavored path (it might be the
+commit helper — conservatism means no finding).
+"""
+
+import json
+import os
+
+from utils.paths import write_atomic
+
+
+def publish_manifest(path, entries):
+    write_atomic(path + ".manifest", json.dumps(entries))
+
+
+def save_checkpoint(checkpoint_path, blob):
+    # raw temp write, but the commit lives one call away in _commit()
+    checkpoint_tmp = checkpoint_path + ".tmp"
+    with open(checkpoint_tmp, "w") as fh:
+        fh.write(blob)
+    _commit(checkpoint_tmp, checkpoint_path)
+
+
+def _commit(tmp, final):
+    os.replace(tmp, final)
+    _fsync_dir(os.path.dirname(final) or ".")
+
+
+def _fsync_dir(path):
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def append_ledger_journal(ledger_path, line):
+    # append-only journals are crash-safe by construction
+    with open(ledger_path, "a") as fh:
+        fh.write(line)
+
+
+def export_ledger(storage, ledger_path, rows):
+    # storage.seal is unresolvable and receives the ledger path — it
+    # might be the commit step, so the engine must stay silent
+    with open(ledger_path, "w") as fh:
+        fh.write("\n".join(rows))
+    storage.seal(ledger_path)

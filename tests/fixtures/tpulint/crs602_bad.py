@@ -1,0 +1,21 @@
+"""CRS602 bad: crash-critical renames with no directory fsync in flow.
+
+The second writer even fsyncs the temp FILE — but without fsyncing the
+directory the rename itself can be lost with the directory metadata,
+resurrecting the previous manifest after a power cut.
+"""
+
+import os
+
+
+def install_manifest(tmp, manifest_path):
+    os.replace(tmp, manifest_path)
+
+
+def publish_checkpoint(checkpoint_path, payload):
+    checkpoint_tmp = checkpoint_path + ".tmp"
+    with open(checkpoint_tmp, "w") as fh:
+        fh.write(payload)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(checkpoint_tmp, checkpoint_path)

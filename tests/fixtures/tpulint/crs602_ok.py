@@ -1,0 +1,23 @@
+"""CRS602 ok: crash-critical renames fsync the directory; liveness
+markers (heartbeats) may legitimately lose a rename."""
+
+import os
+
+
+def install_manifest(tmp, manifest_path):
+    os.replace(tmp, manifest_path)
+    _fsync_dir(os.path.dirname(manifest_path) or ".")
+
+
+def bump_heartbeat(tmp, heartbeat_path):
+    # liveness marker: a rename lost in a crash is re-published on the
+    # next beat, so no directory fsync is demanded
+    os.replace(tmp, heartbeat_path)
+
+
+def _fsync_dir(path):
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)

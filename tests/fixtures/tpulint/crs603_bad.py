@@ -1,0 +1,18 @@
+"""CRS603 bad: read-modify-write of a shared ledger with no fence.
+
+Two processes running bump_ledger concurrently both read count=N and
+both write count=N+1 — one increment is silently lost.  The write is
+atomic (no CRS601), but atomicity is not mutual exclusion.
+"""
+
+import json
+
+from utils.paths import write_atomic
+
+
+def bump_ledger(root):
+    ledger = root + "/ledger.json"
+    with open(ledger) as fh:
+        data = json.load(fh)
+    data["count"] = data.get("count", 0) + 1
+    write_atomic(ledger, json.dumps(data))

@@ -1,0 +1,44 @@
+"""CRS603 ok: every read-modify-write carries a visible fence —
+a held lock, an O_EXCL claim file, or a fingerprint/verify check."""
+
+import json
+import os
+import threading
+
+from utils.paths import write_atomic
+
+_LOCK = threading.Lock()
+
+
+def bump_locked(root):
+    ledger = root + "/ledger.json"
+    with _LOCK:
+        with open(ledger) as fh:
+            data = json.load(fh)
+        data["count"] = data.get("count", 0) + 1
+        write_atomic(ledger, json.dumps(data))
+
+
+def bump_claimed(root):
+    ledger = root + "/ledger.json"
+    fd = os.open(ledger + ".claim", os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+    os.close(fd)
+    with open(ledger) as fh:
+        data = json.load(fh)
+    data["count"] = data.get("count", 0) + 1
+    write_atomic(ledger, json.dumps(data))
+
+
+def bump_fenced(root, owner):
+    ledger = root + "/ledger.json"
+    with open(ledger) as fh:
+        data = json.load(fh)
+    if not _verify_owner(data, owner):
+        return False
+    data["count"] = data.get("count", 0) + 1
+    write_atomic(ledger, json.dumps(data))
+    return True
+
+
+def _verify_owner(data, owner):
+    return data.get("owner") == owner

@@ -1,0 +1,27 @@
+"""CRS604 bad: broad excepts swallow a commit failure.
+
+Both handlers turn a failed os.replace into ordinary control flow with
+no log and no re-raise — the caller cannot tell a failed publish from a
+successful one.  The second case commits one call level away.
+"""
+
+import os
+
+
+def refresh_marker(tmp, path):
+    try:
+        os.replace(tmp, path + ".marker")
+    except Exception:
+        return False
+    return True
+
+
+def publish_via_helper(tmp, path):
+    try:
+        _install(tmp, path)
+    except Exception:
+        pass
+
+
+def _install(tmp, path):
+    os.replace(tmp, path + ".marker")

@@ -298,3 +298,42 @@ def test_fleet_autoscale_breach_and_recover(fleet_model, tmp_path):
     assert up < down
     # the SLO engine narrated the cause on both sides of the cycle
     assert "slo_breach" in evs and "slo_recovered" in evs
+
+
+def test_fleet_monitor_survives_backwards_wall_clock(tmp_path):
+    """A backwards wall-clock step must never mark a healthy replica
+    dead.  Replica heartbeat markers carry the REPLICA's wall clock;
+    the monitor ages them by marker-change receipts on its own
+    monotonic clock, so a marker whose ``unix_time`` steps backwards
+    (NTP step on the replica host) keeps refreshing liveness — while a
+    genuinely silent replica still times out."""
+    from lightgbm_tpu.robustness.elastic import HEALTHY, publish_heartbeat
+    from lightgbm_tpu.serving.fleet import FleetServer, _ReplicaSlot
+
+    srv = FleetServer.__new__(FleetServer)
+    srv.coord_dir = str(tmp_path)
+    srv.hb_interval_s = 1.0
+    srv.hb_timeout_s = 3.0
+    srv._rt = None
+    deaths = []
+    srv._declare_dead = lambda s, reason, age_s: deaths.append(
+        (s.slot, reason, age_s))
+
+    s = _ReplicaSlot(0)
+    s.state = HEALTHY
+    s.hb_seen_mono = 0.0        # promotion receipt at monitor-clock 0
+    wall = 1_000_000.0
+    mono = 0.0
+    for _ in range(10):
+        mono += 1.0
+        wall -= 50.0            # replica's wall clock stepping BACK
+        publish_heartbeat(srv.coord_dir, s.incarnation, s.slot, 0,
+                          now=wall)
+        srv._check_slot(s, mono)
+    assert s.state == HEALTHY
+    assert not deaths, deaths
+
+    # same monitor, same slot: silence (no new marker) still kills
+    mono += srv.hb_timeout_s + 1.0
+    srv._check_slot(s, mono)
+    assert deaths and deaths[0][1] == "heartbeat_timeout"

@@ -65,6 +65,14 @@ RULE_FIXTURES = [
     ("OBS302", "obs302_bad.py", "obs302_ok.py"),
     ("OBS303", "obs303_bad.py", "obs303_ok.py"),
     ("OBS304", "obs304_bad.py", "obs304_ok.py"),
+    ("CRS601", "crs601_bad.py", "crs601_ok.py"),
+    ("CRS602", "crs602_bad.py", "crs602_ok.py"),
+    ("CRS603", "crs603_bad.py", "crs603_ok.py"),
+    ("CRS604", "crs604_bad.py", "crs604_ok.py"),
+    ("CNC701", "cnc701_bad.py", "cnc701_ok.py"),
+    ("CNC702", "cnc702_bad.py", "cnc702_ok.py"),
+    ("CNC703", "cnc703_bad.py", "cnc703_ok.py"),
+    ("CNC704", "cnc704_bad.py", "cnc704_ok.py"),
 ]
 
 
@@ -134,6 +142,110 @@ def test_tpu102_partial_jit_in_loop_fires(tmp_path):
         "        f(x, 2)\n")
     violations, _ = lint(str(f), root=str(tmp_path))
     assert any(v.rule_id == "TPU102" for v in violations), violations
+
+
+# ------------------------------------------------- effect-summary engine
+def _effect_index(source, relpath="mod.py"):
+    import ast
+    ctx = ANALYSIS.FileContext(relpath, relpath, source,
+                               ast.parse(source))
+    idx = ANALYSIS.effects.EffectIndex()
+    idx.add_file(ctx)
+    return idx
+
+
+def _summary(idx, name):
+    return next(s for s in idx.summaries if s.name == name)
+
+
+def test_effects_one_level_call_through():
+    idx = _effect_index(
+        "import os\n\n"
+        "def commit(tmp, final):\n"
+        "    os.replace(tmp, final)\n\n"
+        "def save(tmp, final):\n"
+        "    commit(tmp, final)\n")
+    eff = idx.effective_effects(_summary(idx, "save"))
+    assert ANALYSIS.effects.REPLACE in eff
+
+
+def test_effects_depth_capped_at_one_level():
+    """A's effective effects see B's DIRECT effects, never C's."""
+    idx = _effect_index(
+        "import os\n\n"
+        "def c(tmp, final):\n"
+        "    os.replace(tmp, final)\n\n"
+        "def b(tmp, final):\n"
+        "    c(tmp, final)\n\n"
+        "def a(tmp, final):\n"
+        "    b(tmp, final)\n")
+    replace = ANALYSIS.effects.REPLACE
+    assert replace in idx.effective_effects(_summary(idx, "b"))
+    assert replace not in idx.effective_effects(_summary(idx, "a"))
+
+
+def test_effects_ambiguous_name_resolves_to_none():
+    idx = _effect_index(
+        "class A:\n"
+        "    def go(self):\n"
+        "        pass\n\n"
+        "class B:\n"
+        "    def go(self):\n"
+        "        pass\n")
+    assert idx.resolve("mod.py", "go") is None
+    assert idx.resolve("mod.py", "never_defined") is None
+
+
+def test_effects_wall_deadline_params():
+    idx = _effect_index(
+        "def lease_ok(now, expires_at):\n"
+        "    remaining = expires_at - now\n"
+        "    return remaining > 0.0\n")
+    s = _summary(idx, "lease_ok")
+    assert s.wall_deadline_params == {"now", "expires_at"}
+
+
+def test_effects_token_matching():
+    m = ANALYSIS.effects.match_token
+    deadline = ANALYSIS.effects.DEADLINE_TOKENS
+    persisted = ANALYSIS.effects.PERSISTED_TOKENS
+    assert m("staleness_s", deadline) == "stale"
+    assert m("usage", deadline) is None        # no short-prefix matches
+    assert m("manifest_path", persisted) == "manifest"
+    assert m("semantic", persisted) is None
+
+
+def test_effects_unresolvable_call_conservatism(tmp_path):
+    """A raw flavored write next to an UNKNOWN callee that receives the
+    flavored path must stay silent (it might be the commit helper) —
+    and removing that call makes CRS601 fire again."""
+    hedged = tmp_path / "hedged.py"
+    hedged.write_text(
+        "def export(storage, manifest_path, text):\n"
+        "    with open(manifest_path, 'w') as fh:\n"
+        "        fh.write(text)\n"
+        "    storage.seal(manifest_path)\n")
+    violations, _ = lint(str(hedged), root=str(tmp_path))
+    assert violations == [], violations
+    bare = tmp_path / "bare.py"
+    bare.write_text(
+        "def export(manifest_path, text):\n"
+        "    with open(manifest_path, 'w') as fh:\n"
+        "        fh.write(text)\n")
+    violations, _ = lint(str(bare), root=str(tmp_path))
+    assert rule_ids(violations) == {"CRS601"}, violations
+
+
+def test_effects_index_cached_per_run():
+    runner = ANALYSIS.LintRunner(
+        ANALYSIS.build_rules(select=["CRS601", "CNC702"]), root=FIXTURES)
+    runner.run([os.path.join(FIXTURES, "crs601_bad.py")])
+    # both rules ran finalize; the scratch index must have been built
+    # once and shared (same object across a second get_index call)
+    # — exercised indirectly: a fresh run() must not leak the first
+    # run's summaries into the second
+    v1, _ = runner.run([os.path.join(FIXTURES, "cnc702_bad.py")])
+    assert rule_ids(v1) == {"CNC702"}
 
 
 # -------------------------------------------------------- contract projects
@@ -349,8 +461,117 @@ def test_cli_exit_codes_and_json(capsys):
     out = capsys.readouterr().out
     assert rc == 0
     assert "TPU101" in out and "CFG203" in out
+    # the crash-safety/concurrency families are registered
+    for rid in ("CRS601", "CRS602", "CRS603", "CRS604",
+                "CNC701", "CNC702", "CNC703", "CNC704"):
+        assert rid in out, rid
 
     rc = TOOL.main([os.path.join(FIXTURES, "no_such_file.py")])
+    capsys.readouterr()
+    assert rc == 2
+
+
+def test_cli_sarif_matches_golden(capsys):
+    """--format sarif output is frozen by a golden file (stable keys,
+    sorted rules, 1-based columns) so CI upload integrations don't
+    silently drift."""
+    rc = TOOL.main([os.path.join(FIXTURES, "tpu101_bad.py"),
+                    "--root", FIXTURES, "--select", "TPU101",
+                    "--format", "sarif"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    got = json.loads(out)
+    with open(os.path.join(FIXTURES, "sarif_golden.json")) as fh:
+        golden = json.load(fh)
+    assert got == golden
+    # spot-check the invariants the golden encodes
+    assert got["version"] == "2.1.0"
+    run = got["runs"][0]
+    assert run["tool"]["driver"]["name"] == "tpulint"
+    assert all(r["ruleId"] == "TPU101" for r in run["results"])
+    region = run["results"][0]["locations"][0]["physicalLocation"]
+    assert region["region"]["startColumn"] >= 1     # SARIF is 1-based
+
+
+def test_cli_sarif_clean_run_has_empty_results(capsys):
+    rc = TOOL.main([os.path.join(FIXTURES, "tpu101_ok.py"),
+                    "--root", FIXTURES, "--format", "sarif"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    doc = json.loads(out)
+    assert doc["runs"][0]["results"] == []
+    # the full rule catalog still ships with a clean run
+    ids = {r["id"] for r in doc["runs"][0]["tool"]["driver"]["rules"]}
+    assert {"TPU101", "CRS601", "CNC701"} <= ids
+
+
+def _git(repo, *args):
+    subprocess.run(["git", "-C", str(repo), *args], check=True,
+                   capture_output=True, text=True)
+
+
+def test_cli_changed_scopes_to_git_diff(tmp_path, capsys):
+    """--changed lints only files changed vs REF (plus untracked), so a
+    pre-existing violation in an untouched file does not fail the
+    incremental gate — and a bad REF is a loud exit 2, never a silent
+    empty lint."""
+    repo = tmp_path
+    _git(repo, "init", "-q")
+    clean = repo / "clean.py"
+    clean.write_text("def ok():\n    return 1\n")
+    bad = repo / "bad.py"
+    bad.write_text(
+        "import threading\n\n"
+        "def go(fn):\n"
+        "    t = threading.Thread(target=fn)\n"
+        "    t.start()\n")
+    _git(repo, "add", "-A")
+    _git(repo, "-c", "user.email=t@t", "-c", "user.name=t",
+         "commit", "-qm", "seed")
+
+    # nothing changed: nothing to lint, exit 0
+    rc = TOOL.main(["--root", str(repo), "--changed", "HEAD", str(repo)])
+    out = capsys.readouterr().out
+    assert rc == 0 and "nothing to lint" in out
+
+    # touch only the clean file: bad.py's violation stays out of scope
+    clean.write_text("def ok():\n    return 2\n")
+    rc = TOOL.main(["--root", str(repo), "--changed", "HEAD", str(repo)])
+    capsys.readouterr()
+    assert rc == 0
+
+    # a full lint still sees it
+    rc = TOOL.main(["--root", str(repo), str(repo)])
+    out = capsys.readouterr().out
+    assert rc == 1 and "CNC704" in out
+
+    # touching the bad file pulls it into the incremental scope
+    bad.write_text(bad.read_text() + "\n# touched\n")
+    rc = TOOL.main(["--root", str(repo), "--changed", "HEAD", str(repo)])
+    out = capsys.readouterr().out
+    assert rc == 1 and "CNC704" in out
+
+    # an untracked new file is always in scope
+    clean.write_text("def ok():\n    return 1\n")
+    bad.write_text(
+        "import threading\n\n"
+        "def go(fn):\n"
+        "    t = threading.Thread(target=fn, daemon=True)\n"
+        "    t.start()\n")
+    _git(repo, "add", "-A")
+    _git(repo, "-c", "user.email=t@t", "-c", "user.name=t",
+         "commit", "-qm", "fix")
+    fresh = repo / "fresh.py"
+    fresh.write_text(
+        "import threading\n"
+        "t = threading.Thread(target=print)\n")
+    rc = TOOL.main(["--root", str(repo), "--changed", "HEAD", str(repo)])
+    out = capsys.readouterr().out
+    assert rc == 1 and "fresh.py" in out
+
+    # bad ref: exit 2, with the git error surfaced
+    rc = TOOL.main(["--root", str(repo), "--changed", "no-such-ref",
+                    str(repo)])
     capsys.readouterr()
     assert rc == 2
 
@@ -400,13 +621,16 @@ def test_runner_reuse_does_not_leak_state():
 
 
 def test_gate_runs_without_jax(tmp_path):
-    """CI contract: the lint gate must work with jax unimportable."""
+    """CI contract: the lint gate must work with jax unimportable —
+    including the effect-summary engine and the CRS/CNC families."""
     script = (
         "import sys\n"
         "sys.modules['jax'] = None  # poison: import jax would fail\n"
         "sys.modules['numpy'] = None\n"
         f"sys.path.insert(0, {os.path.join(REPO, 'tools')!r})\n"
         "import tpulint\n"
+        "rc = tpulint.main(['--list-rules'])\n"
+        "assert rc == 0\n"
         f"rc = tpulint.main(['--root', {REPO!r}])\n"
         "sys.exit(rc)\n"
     )
@@ -414,6 +638,8 @@ def test_gate_runs_without_jax(tmp_path):
                        capture_output=True, text=True,
                        env={**os.environ, "PYTHONPATH": ""})
     assert p.returncode == 0, p.stdout + p.stderr
+    for rid in ("TPU101", "CRS601", "CRS604", "CNC701", "CNC704"):
+        assert rid in p.stdout, rid
 
 
 # -------------------------------------------- shared report/exit contract
