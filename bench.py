@@ -96,7 +96,8 @@ def record_cache(payload, mode="kernel", path=CACHE_PATH):
     sweep once overwrote the cache with a 25%-slower number)."""
     overrides = [k for k in os.environ
                  if k.startswith("BENCH_") and k not in
-                 ("BENCH_CHILD", "BENCH_E2E", "BENCH_ATTEMPTS")]
+                 ("BENCH_CHILD", "BENCH_E2E", "BENCH_RANK",
+                  "BENCH_ATTEMPTS")]
     if overrides and mode != "sweep":
         return
     try:
@@ -124,7 +125,8 @@ def supervise():
     """Driver entry: probe + measure in killable child processes, retry,
     fall back to the cached last-good number."""
     env = dict(os.environ, BENCH_CHILD="1")
-    mode = "e2e" if os.environ.get("BENCH_E2E") else "kernel"
+    mode = "rank" if os.environ.get("BENCH_RANK") else \
+        ("e2e" if os.environ.get("BENCH_E2E") else "kernel")
     last_fail = "unknown"
     for i, (probe_t, measure_t) in enumerate(ATTEMPTS):
         if i:
@@ -183,7 +185,7 @@ def supervise():
             if "metric" in cache:       # legacy single-payload layout
                 cache = {"kernel": cache}
             cached = None
-            for m in (mode, "kernel", "sweep", "e2e"):
+            for m in (mode, "kernel", "sweep", "e2e", "rank"):
                 if m in cache:
                     cached = cache[m]
                     break
@@ -392,6 +394,114 @@ def main_e2e():
     print(json.dumps(_quality_gate(payload)))
 
 
+def _synth_msltr(n, f, rng):
+    """MS-LTR-shaped ranking task: skewed query lengths (lognormal —
+    median ~120 docs with a tail past 1000, the WEB30K histogram shape
+    that makes pad-to-max waste explode) and graded 0..4 relevance
+    correlated with a linear score.  Returns (feat, label, sizes)."""
+    sizes, tot = [], 0
+    while tot < n:
+        s = int(np.clip(rng.lognormal(mean=4.8, sigma=0.9), 4, 1333))
+        s = min(s, n - tot)
+        sizes.append(s)
+        tot += s
+    feat = rng.normal(size=(n, f)).astype(np.float32)
+    w = rng.normal(size=f)
+    score = feat @ w * 0.3 + rng.normal(scale=1.0, size=n)
+    label = np.empty(n, np.float32)
+    off = 0
+    for s in sizes:
+        r = np.argsort(np.argsort(score[off:off + s]))
+        label[off:off + s] = np.minimum(4, (r * 5) // max(s, 1))
+        off += s
+    return feat, label, np.asarray(sizes, np.int64)
+
+
+def _time_rank_arm(feat, label, sizes, params, no_buckets):
+    """One lambdarank A/B arm: train 2 warm rounds (lowers the bucketed
+    pairwise programs), then time BENCH_ITERS continued iterations on
+    the warm booster.  ``no_buckets`` flips the production env hatch —
+    the SAME code path degenerates to one pad-to-max bucket."""
+    import lightgbm_tpu as lgb
+
+    prior = os.environ.get("LGBMTPU_NO_RANK_BUCKETS")
+    if no_buckets:
+        os.environ["LGBMTPU_NO_RANK_BUCKETS"] = "1"
+    else:
+        os.environ.pop("LGBMTPU_NO_RANK_BUCKETS", None)
+    try:
+        ds = lgb.Dataset(feat, label=label, group=sizes, params=params)
+        t0 = time.time()
+        bst = lgb.train(params, ds, num_boost_round=2)
+        warm_s = time.time() - t0
+        gb = bst._gbdt
+        t0 = time.time()
+        for _ in range(BENCH_ITERS):
+            gb.train_one_iter()
+        elapsed = time.time() - t0
+        obj = gb.objective
+        pad = int(getattr(obj, "_rank_pad_rows", 0))
+        n = len(label)
+        return {
+            "seconds": round(elapsed, 3),
+            "iters_per_s": round(BENCH_ITERS / elapsed, 4),
+            "pad_rows": pad,
+            "pad_waste_ratio": round(pad / float(pad + n), 6),
+            "bucket_count": int(getattr(obj, "_rank_bucket_count", 0)),
+            "warm_s": round(warm_s, 3),
+        }
+    finally:
+        if prior is None:
+            os.environ.pop("LGBMTPU_NO_RANK_BUCKETS", None)
+        else:
+            os.environ["LGBMTPU_NO_RANK_BUCKETS"] = prior
+
+
+def main_rank():
+    """BENCH_RANK=1: lambdarank training throughput, bucketed vs
+    pad-to-max (``kind="rank"`` payload, gated by bench_compare.py).
+
+    Both arms run in ONE process on the same synthetic MS-LTR task so
+    the A/B shares its capture window; the headline ``value`` is the
+    bucketed arm's steady-state iters/s and ``padded`` carries the
+    LGBMTPU_NO_RANK_BUCKETS=1 control next to it."""
+    import jax
+
+    rng = np.random.default_rng(0)
+    n, f = BENCH_ROWS, 28
+    feat, label, sizes = _synth_msltr(n, f, rng)
+    params = {
+        "objective": "lambdarank", "verbose": -1,
+        "num_leaves": NUM_LEAVES, "learning_rate": 0.1,
+        "max_bin": MAX_BIN, "min_data_in_leaf": 0,
+        "min_sum_hessian_in_leaf": 100.0,
+        "lambdarank_truncation_level": 30,
+    }
+    capture = _capture_quality()
+    bucketed = _time_rank_arm(feat, label, sizes, params,
+                              no_buckets=False)
+    padded = _time_rank_arm(feat, label, sizes, params, no_buckets=True)
+    payload = {
+        "metric": f"rank_synth_{n}rows_{len(sizes)}queries_"
+                  f"{BENCH_ITERS}iters_leaves{NUM_LEAVES}",
+        "kind": "rank",
+        "value": bucketed["iters_per_s"],
+        "unit": "iters_per_s",
+        "vs_baseline": 0.0,
+        "rows": n,
+        "queries": len(sizes),
+        "qmax": int(sizes.max()),
+        "bucketed": bucketed,
+        "padded": padded,
+        "bucket_speedup": round(bucketed["iters_per_s"] /
+                                max(padded["iters_per_s"], 1e-9), 4),
+        "platform": jax.devices()[0].platform,
+        "capture_quality": capture,
+        "memory": _memory_result(),
+    }
+    print(json.dumps(payload))
+
+
 def _time_kernel_run(feat, label, max_bin, hist_dtype):
     """Scan-chained BENCH_ITERS training iterations at one bin width;
     returns ``(compile_s, run_s)`` — first-call wall minus steady run
@@ -480,6 +590,9 @@ def _time_kernel_run(feat, label, max_bin, hist_dtype):
 
 
 def main():
+    if os.environ.get("BENCH_RANK"):
+        main_rank()
+        return
     if os.environ.get("BENCH_E2E"):
         main_e2e()
         return

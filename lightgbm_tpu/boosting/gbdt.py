@@ -198,6 +198,11 @@ class GBDT:
         # recent training run).
         self.timer = PhaseTimer()
         self.metrics = MetricsRegistry()
+        if self.objective is not None:
+            # objective.init ran before this registry existed; attach it
+            # now so rank compile-cache bumps land dual-scope and the
+            # bucket-plan gauges mirror into Booster.telemetry()
+            self.objective.attach_booster_metrics(self.metrics)
         #: the training-side watchtower (rollups + SLOs + anomaly
         #: detection) — attached by engine.train() only when slo_config/
         #: anomaly_detection is configured; None is the all-off default
@@ -817,6 +822,7 @@ class GBDT:
         self.train_set = train_set
         if self.objective is not None:
             self.objective.init(train_set.metadata, train_set.num_data)
+            self.objective.attach_booster_metrics(self.metrics)
         for m in self.train_metrics:
             m.init(train_set.metadata, train_set.num_data)
         self.bins = self._place_rows(jnp.asarray(train_set.bins))

@@ -249,6 +249,7 @@ _PARAMS: List[Tuple[str, Any, Tuple[str, ...], Tuple[Tuple[str, float], ...]]] =
     ("lambdarank_norm", True, (), ()),
     ("label_gain", [], (), ()),
     ("lambdarank_position_bias_regularization", 0.0, (), ((">=", 0.0),)),
+    ("rank_query_buckets", "auto", (), ()),  # query-length bucket ladder for the device lambdarank/xendcg kernels (objectives.py): "auto" derives power-of-two buckets from the training query-length distribution; an explicit list (e.g. "16,64,256") pins the ladder (extended to cover the longest query); each bucket geometry lowers ONE pairwise program through ops/compile_cache.py (rank_compile_hits/misses), so padded-pair compute is sum_b nq_b*T*Q_b instead of nq*T*Qmax; LGBMTPU_NO_RANK_BUCKETS=1 is the pad-to-max A/B hatch
     # --- metric ---
     ("metric", [], ("metrics", "metric_types"), ()),
     ("metric_freq", 1, ("output_freq",), ((">", 0),)),
@@ -426,6 +427,13 @@ class Config:
         try:
             if name == "seed":
                 return None if value is None else int(value)
+            if name == "rank_query_buckets":
+                # str default ("auto") but list values are legal — keep
+                # them as int lists instead of stringifying
+                if isinstance(value, str) and \
+                        value.strip().lower() in ("", "auto"):
+                    return "auto"
+                return _parse_int_list(value)
             if isinstance(default, bool):
                 v: Any = _parse_bool(value)
             elif isinstance(default, int):
@@ -553,6 +561,23 @@ class Config:
             log.fatal(f"serving_buckets must be a non-empty list of positive "
                       f"row counts, got {self.serving_buckets!r}")
         self.serving_buckets = sorted({int(b) for b in self.serving_buckets})
+        rqb = self.rank_query_buckets
+        if isinstance(rqb, str):
+            rqb = rqb.strip().lower() or "auto"
+            if rqb != "auto":
+                try:
+                    rqb = _parse_int_list(rqb)
+                except (TypeError, ValueError):
+                    log.fatal(f"unknown rank_query_buckets="
+                              f"{self.rank_query_buckets!r} (expected "
+                              "\"auto\" or a list of positive doc counts)")
+        if isinstance(rqb, (list, tuple)):
+            if not rqb or any(int(b) <= 0 for b in rqb):
+                log.fatal(f"rank_query_buckets must be \"auto\" or a "
+                          f"non-empty list of positive doc counts, got "
+                          f"{self.rank_query_buckets!r}")
+            rqb = sorted({int(b) for b in rqb})
+        self.rank_query_buckets = rqb
         # max_depth implies a num_leaves cap when num_leaves not explicit
         if self.max_depth > 0 and not self.is_explicit("num_leaves"):
             full = 1 << min(self.max_depth, 30)

@@ -263,10 +263,12 @@ class _NpyChunkSource(ArrayChunkSource):
     Same ``kind``/shape as the original, so fingerprints agree."""
 
     def __init__(self, path: str, chunk_rows: int,
-                 label_path: Optional[str] = None) -> None:
+                 label_path: Optional[str] = None,
+                 qid_path: Optional[str] = None) -> None:
         data = np.load(path, mmap_mode="r")
         label = np.load(label_path) if label_path else None
-        super().__init__(data, chunk_rows, label=label)
+        qid = np.load(qid_path) if qid_path else None
+        super().__init__(data, chunk_rows, label=label, qid=qid)
 
 
 def enumerate_stripes(source: ChunkSource) -> Tuple[int, Optional[list]]:
@@ -346,6 +348,11 @@ def _source_spec(source: ChunkSource, workdir: str) -> Dict[str, Any]:
             np.save(lpath + ".tmp.npy", source.label)
             os.replace(lpath + ".tmp.npy", lpath)
             spec["label_path"] = lpath
+        if source.qid is not None:
+            qpath = os.path.join(workdir, "source_qid.npy")
+            np.save(qpath + ".tmp.npy", source.qid)
+            os.replace(qpath + ".tmp.npy", qpath)
+            spec["qid_path"] = qpath
         return spec
     log.fatal(f"sharded ingest cannot ship a {source.kind!r} source to "
               "worker processes; pass a text/parquet path, an array, or "
@@ -364,7 +371,8 @@ def _source_from_spec(spec: Dict[str, Any], cfg: Config) -> ChunkSource:
         return ParquetChunkSource(spec["path"])
     if kind == "npy":
         return _NpyChunkSource(spec["path"], spec["chunk_rows"],
-                               label_path=spec.get("label_path"))
+                               label_path=spec.get("label_path"),
+                               qid_path=spec.get("qid_path"))
     log.fatal(f"unknown sharded-ingest source spec kind {kind!r}")
 
 
@@ -811,6 +819,20 @@ def _merge_pass1(ing: StreamingIngest, workdir: str,
             ing._weights.append(z["weights"])
         if "qids" in z.files:
             ing._qids.append(z["qids"])
+    # a query id spanning a stripe boundary would be split by stripe
+    # ownership: workers claim and (on resume or steal) reprocess whole
+    # stripes, so rows of one query could be committed by different
+    # incarnations — refuse loudly instead of silently fracturing the
+    # group structure (align stripe_rows with the query layout, or use
+    # the single-process streaming ingest)
+    for s in range(1, len(ing._qids)):
+        prev, cur = ing._qids[s - 1], ing._qids[s]
+        if len(prev) and len(cur) and prev[-1] == cur[0]:
+            raise log.LightGBMError(
+                f"sharded ingest: query id {int(cur[0])} straddles the "
+                f"stripe {s - 1}/{s} boundary; qid groups must not cross "
+                "stripes (choose stripe_rows aligned to query boundaries "
+                "or ingest with stream_dataset)")
     ing.num_rows = sum(ing.shard_rows)
     ing.num_features = len(ing.summaries)
     if ing.num_rows == 0 or ing.num_features == 0:
@@ -1017,9 +1039,8 @@ def shard_stream_inner_dataset(
         weight = np.concatenate(ing._weights)
     ds.metadata.set_weight(weight)
     if group is None and ing._qids:
-        qid = np.concatenate(ing._qids)
-        change = np.r_[True, qid[1:] != qid[:-1]]
-        group = np.diff(np.r_[np.flatnonzero(change), len(qid)])
+        from .parser import qid_to_group_sizes
+        group = qid_to_group_sizes(np.concatenate(ing._qids))
     ds.metadata.set_group(group)
     ds.metadata.set_init_score(init_score)
     if isinstance(source, TextStripeSource):

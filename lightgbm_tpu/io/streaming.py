@@ -341,14 +341,18 @@ class ArrayChunkSource(ChunkSource):
     """Chunk iterator over an in-memory array-like (the parity baseline
     and the adapter for anything ``_as_2d_float`` accepts).
 
-    An optional row-aligned ``label`` vector rides along chunk by chunk
-    (the continuous-learning pipeline streams labeled training chunks
-    through this; text stripes carry their label column natively)."""
+    Optional row-aligned ``label`` / ``qid`` vectors ride along chunk by
+    chunk (the continuous-learning pipeline streams labeled training
+    chunks through this; text stripes carry their label/query columns
+    natively).  A ``qid`` column survives both ingest passes and lands in
+    ``Metadata.query_boundaries`` via ``parser.qid_to_group_sizes`` —
+    bit-identically to the in-memory ``Dataset(..., group=...)`` build."""
 
     kind = "ndarray"
 
     def __init__(self, data: Any, chunk_rows: int,
-                 label: Optional[Any] = None) -> None:
+                 label: Optional[Any] = None,
+                 qid: Optional[Any] = None) -> None:
         self.arr = _as_2d_float(data)
         self.chunk_rows = max(1, int(chunk_rows))
         self.num_rows, self.num_features = self.arr.shape
@@ -359,6 +363,13 @@ class ArrayChunkSource(ChunkSource):
                 raise ValueError(
                     f"label length {len(self.label)} != data rows "
                     f"{self.num_rows}")
+        self.qid = None
+        if qid is not None:
+            self.qid = np.asarray(qid, dtype=np.int64).reshape(-1)
+            if len(self.qid) != self.num_rows:
+                raise ValueError(
+                    f"qid length {len(self.qid)} != data rows "
+                    f"{self.num_rows}")
 
     def chunks(self, start_chunk: int = 0) -> Iterator[RawChunk]:
         for lo in range(start_chunk * self.chunk_rows, self.num_rows,
@@ -366,7 +377,9 @@ class ArrayChunkSource(ChunkSource):
             hi = min(self.num_rows, lo + self.chunk_rows)
             yield RawChunk(np.asarray(self.arr[lo:hi], dtype=np.float64),
                            label=None if self.label is None
-                           else self.label[lo:hi])
+                           else self.label[lo:hi],
+                           qid=None if self.qid is None
+                           else self.qid[lo:hi])
 
 
 class SequenceChunkSource(ChunkSource):
@@ -1189,9 +1202,8 @@ class StreamingIngest:
             weight = np.concatenate(self._weights)
         ds.metadata.set_weight(weight)
         if group is None and self._qids:
-            qid = np.concatenate(self._qids)
-            change = np.r_[True, qid[1:] != qid[:-1]]
-            group = np.diff(np.r_[np.flatnonzero(change), len(qid)])
+            from .parser import qid_to_group_sizes
+            group = qid_to_group_sizes(np.concatenate(self._qids))
         ds.metadata.set_group(group)
         ds.metadata.set_init_score(init_score)
         if isinstance(self.source, TextStripeSource):

@@ -92,7 +92,14 @@ def _dev_auc():
 
 
 @functools.lru_cache(maxsize=None)
-def _dev_ndcg(ks: tuple):
+def _dev_ndcg_sums(ks: tuple):
+    """Per-k NDCG SUMS over one query-length bucket's queries ([len(ks)]).
+    The caller (NDCGMetric.eval_device_traced) runs this per bucket of the
+    rank_query_buckets plan and divides the combined sum by the total
+    query count — the bucketed twin of the old pad-to-max mean kernel, so
+    the fused-eval path pays sum_b nq_b*Q_b sort work instead of
+    nq*qmax.  jit's shape-keyed trace cache gives one lowering per bucket
+    geometry, warm across iterations."""
     import jax
     import jax.numpy as jnp
 
@@ -108,8 +115,8 @@ def _dev_ndcg(ks: tuple):
             kk = min(k, sc.shape[1])
             dcg = jnp.sum(g_srt[:, :kk] * disc[None, :kk], axis=1)
             idcg = idcgs[i]
-            out.append(jnp.mean(jnp.where(idcg > 0, dcg
-                                          / jnp.maximum(idcg, 1e-30), 1.0)))
+            out.append(jnp.sum(jnp.where(idcg > 0, dcg
+                                         / jnp.maximum(idcg, 1e-30), 1.0)))
         return jnp.stack(out)
     return jax.jit(run)
 
@@ -528,17 +535,24 @@ class NDCGMetric(Metric):
     def display_names(self):
         return [f"ndcg@{k}" for k in self.ks]
 
+    def _ndcg_from_buckets(self, score_dev, dev_buckets, gain_dev):
+        nq = len(self.bounds) - 1
+        total = None
+        for qidx_dev, idcg_dev, disc_dev in dev_buckets:
+            part = _dev_ndcg_sums(tuple(self.ks))(
+                score_dev, qidx_dev, gain_dev, idcg_dev, disc_dev)
+            total = part if total is None else total + part
+        return total / nq
+
     def eval_device_traced(self, score_dev, objective=None):
         import jax
         import jax.numpy as jnp
-        if not hasattr(self, "_qidx_dev"):
-            from .objectives import _pad_queries
-            qidx, _, qmax = _pad_queries(self.bounds)
-            qidx_dev = jnp.asarray(qidx)
+        if not hasattr(self, "_rank_dev_buckets"):
+            from .objectives import _rank_buckets
+            spec = getattr(self.config, "rank_query_buckets", "auto")
+            buckets, _ = _rank_buckets(np.asarray(self.bounds), spec)
             gain_dev = jnp.asarray(
                 self.label_gain[self.label.astype(int)], jnp.float32)
-            disc_dev = jnp.asarray(
-                1.0 / np.log2(np.arange(max(qmax, 1)) + 2.0), jnp.float32)
             idcgs = np.zeros((len(self.ks), len(self.bounds) - 1), np.float32)
             for qi in range(len(self.bounds) - 1):
                 s, e = self.bounds[qi], self.bounds[qi + 1]
@@ -546,18 +560,23 @@ class NDCGMetric(Metric):
                 ideal = np.argsort(-lbl, kind="mergesort")
                 for i, k in enumerate(self.ks):
                     idcgs[i, qi] = _dcg_at_k(lbl, ideal, k, self.label_gain)
-            idcg_dev = jnp.asarray(idcgs)
-            if isinstance(qidx_dev, jax.core.Tracer):
+            dev_buckets = []
+            for cap, qids, idx in buckets:
+                dev_buckets.append((
+                    jnp.asarray(idx),
+                    jnp.asarray(idcgs[:, qids]),
+                    jnp.asarray(1.0 / np.log2(np.arange(max(cap, 1)) + 2.0),
+                                jnp.float32)))
+            if isinstance(gain_dev, jax.core.Tracer) or (
+                    dev_buckets and isinstance(dev_buckets[0][0],
+                                               jax.core.Tracer)):
                 # abstract trace (see Metric._dev_arrays): use uncached
-                return _dev_ndcg(tuple(self.ks))(
-                    score_dev, qidx_dev, gain_dev, idcg_dev, disc_dev)
-            self._qidx_dev = qidx_dev
+                return self._ndcg_from_buckets(score_dev, dev_buckets,
+                                               gain_dev)
+            self._rank_dev_buckets = dev_buckets
             self._gain_dev = gain_dev
-            self._disc_dev = disc_dev
-            self._idcg_dev = idcg_dev
-        return _dev_ndcg(tuple(self.ks))(
-            score_dev, self._qidx_dev, self._gain_dev, self._idcg_dev,
-            self._disc_dev)
+        return self._ndcg_from_buckets(score_dev, self._rank_dev_buckets,
+                                       self._gain_dev)
 
 
 class MapMetric(Metric):

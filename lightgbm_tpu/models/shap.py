@@ -389,7 +389,8 @@ def _jit_phi(S: int, L: int, F1: int):
 def predict_contrib(trees: List[Tree], X: np.ndarray, num_features: int,
                     num_tree_per_iteration: int = 1,
                     start_iteration: int = 0,
-                    end_iteration: int = -1) -> np.ndarray:
+                    end_iteration: int = -1,
+                    force_device: bool = False) -> np.ndarray:
     """SHAP contributions summed over trees (vectorized TreeSHAP).
 
     Returns ``[n, F + 1]`` for single-output models, ``[n, k * (F + 1)]``
@@ -398,7 +399,10 @@ def predict_contrib(trees: List[Tree], X: np.ndarray, num_features: int,
 
     Small inputs run the recurrences in numpy float64 (bit-comparable to the
     reference's double TreeSHAP); large inputs run the same recurrences as a
-    jitted float32 program on the default jax backend.
+    jitted float32 program on the default jax backend.  ``force_device``
+    takes the jitted path regardless of size — the serving tier feeds
+    bucket-padded row counts, so the traced shape set stays finite and a
+    steady-state ``predict_contrib`` request lowers zero new programs.
     """
     X = np.asarray(X, np.float64)
     if X.ndim == 1:
@@ -409,7 +413,8 @@ def predict_contrib(trees: List[Tree], X: np.ndarray, num_features: int,
     end = total_iters if end_iteration is None or end_iteration <= 0 else \
         min(total_iters, end_iteration)
     phi = np.zeros((n, k, num_features + 1))
-    use_jax = n * max((t.num_leaves for t in trees), default=1) > 2_000_000
+    use_jax = force_device or \
+        n * max((t.num_leaves for t in trees), default=1) > 2_000_000
     for it in range(start_iteration, end):
         for c in range(k):
             t = trees[it * k + c]

@@ -26,7 +26,8 @@ pass whose result is L scalars.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+import os
+from typing import List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -34,6 +35,8 @@ import numpy as np
 
 from .config import Config
 from .io.dataset import Metadata
+from .obs.metrics import global_metrics
+from .ops import compile_cache as cc
 from .utils import log
 
 
@@ -76,6 +79,20 @@ class ObjectiveFunction:
         self.config = config
         self.metadata: Optional[Metadata] = None
         self.num_data = 0
+        #: booster-scoped MetricsRegistry, attached by the trainer AFTER
+        #: init (GBDT builds its registry after objective.init runs);
+        #: compile-cache bumps dual-scope through it when present
+        self._metrics = None
+
+    def attach_booster_metrics(self, registry) -> None:
+        """Point telemetry at a booster's own registry and mirror any
+        gauges the objective computed at init time (the ranking
+        objectives publish ``rank_pad_rows`` / ``rank_bucket_count``)."""
+        self._metrics = registry
+        for gname in ("rank_pad_rows", "rank_bucket_count"):
+            val = getattr(self, "_" + gname, None)
+            if val is not None:
+                registry.set_gauge(gname, val)
 
     def init(self, metadata: Metadata, num_data: int) -> None:
         self.metadata = metadata
@@ -519,27 +536,210 @@ def _pad_queries(boundaries: np.ndarray) -> Tuple[np.ndarray, np.ndarray, int]:
     return idx, sizes.astype(np.int32), q
 
 
+def _rank_bucket_ladder(sizes: np.ndarray, spec) -> List[int]:
+    """Query-length bucket caps, smallest to largest, covering every
+    query.  ``spec`` is ``config.rank_query_buckets``: ``"auto"`` derives
+    the next-power-of-two set of the observed lengths; an explicit list
+    is used as-is (extended with the max length when it falls short).
+    The ``LGBMTPU_NO_RANK_BUCKETS=1`` hatch collapses the ladder to one
+    pad-to-max bucket — the pre-bucketing geometry, kept as the A/B
+    baseline for bench.py and the parity tests."""
+    qmax = int(sizes.max()) if len(sizes) else 1
+    if os.environ.get("LGBMTPU_NO_RANK_BUCKETS"):
+        return [qmax]
+    if isinstance(spec, str):           # "auto"
+        return sorted({1 << max(int(s) - 1, 0).bit_length() for s in sizes}) \
+            or [qmax]
+    caps = sorted({int(b) for b in spec})
+    if caps[-1] < qmax:
+        caps.append(qmax)
+    return caps
+
+
+def _rank_buckets(boundaries: np.ndarray, spec
+                  ) -> Tuple[List[Tuple[int, np.ndarray, np.ndarray]], int]:
+    """Group queries into length buckets.  Returns
+    ``([(cap, query_ids[nq_b], qidx[nq_b, cap])...], pad_rows)`` where
+    ``qidx`` is the padded doc-index matrix (-1 pads) of the queries
+    assigned to that cap (the smallest cap >= the query's length) and
+    ``pad_rows`` counts the padding slots across all buckets — the
+    quantity the pad-to-max layout inflates to ``nq*qmax - ndocs``."""
+    sizes = np.diff(np.asarray(boundaries)).astype(np.int64)
+    caps = _rank_bucket_ladder(sizes, spec)
+    assign = np.searchsorted(np.asarray(caps), sizes, side="left")
+    out: List[Tuple[int, np.ndarray, np.ndarray]] = []
+    pad_rows = 0
+    for bi, cap in enumerate(caps):
+        qids = np.flatnonzero(assign == bi)
+        if not len(qids):
+            continue
+        idx = np.full((len(qids), cap), -1, np.int32)
+        for r, qi in enumerate(qids):
+            s, e = int(boundaries[qi]), int(boundaries[qi + 1])
+            idx[r, :e - s] = np.arange(s, e, dtype=np.int32)
+        pad_rows += int(len(qids) * cap - sizes[qids].sum())
+        out.append((int(cap), qids.astype(np.int32), idx))
+    return out, pad_rows
+
+
+def _lambdarank_pair_accum(score, label, gain_doc, qidx, inv_dcg,
+                           g_acc, h_acc, *, sigmoid: float, trunc: int,
+                           norm: bool):
+    """Pairwise |dNDCG| lambda gradients for ONE query-length bucket,
+    scattered onto the per-doc accumulators.  Pure and shape-static in
+    ``qidx`` ([nq_b, Q] padded with -1): the whole pair tensor is
+    [nq_b, T, Q] with T = min(trunc, Q), so a bucket of short queries
+    never pays the longest query's Q.  Each doc belongs to exactly one
+    bucket, so chaining buckets through (g_acc, h_acc) accumulates
+    exactly (the other buckets contribute +0.0 to its slot)."""
+    s = sigmoid
+    valid = qidx >= 0
+    safe = jnp.maximum(qidx, 0)
+    sc = jnp.where(valid, score[safe], -jnp.inf)      # [nq_b, Q]
+    gains = jnp.where(valid, gain_doc[safe], 0.0)
+    lbl = jnp.where(valid, label[safe], -1.0)
+
+    # rank of each doc by descending score (ties by index, like ref sort)
+    order = jnp.argsort(-sc, axis=1, stable=True)      # positions -> doc slot
+    rank = jnp.argsort(order, axis=1)                  # doc slot -> position
+
+    # -- truncation-aware pair enumeration in SORTED space.  The
+    # reference (rank_objective.hpp:138-292) iterates i over sorted
+    # positions [0, trunc) and j over (i, cnt): every pair has its
+    # higher-scored member inside the truncation level, so the pair set
+    # is O(Q * trunc), not O(Q^2).  Materializing [nq, T, Q] instead of
+    # [nq, Q, Q] is what makes MS-LTR-scale query lengths (thousands of
+    # docs) fit in memory (VERDICT r1 #7).
+    Q = sc.shape[1]
+    T = int(min(trunc, Q))
+    s_srt = jnp.take_along_axis(sc, order, axis=1)      # [nq_b, Q] desc
+    g_srt = jnp.take_along_axis(gains, order, axis=1)
+    l_srt = jnp.take_along_axis(lbl, order, axis=1)
+    v_srt = jnp.take_along_axis(valid, order, axis=1)
+    disc = 1.0 / jnp.log2(jnp.arange(Q, dtype=jnp.float32) + 2.0)  # [Q]
+    inv = inv_dcg[:, None, None]                         # [nq_b, 1, 1]
+
+    sa = s_srt[:, :T, None]                              # [nq_b, T, 1]
+    sb = s_srt[:, None, :]                               # [nq_b, 1, Q]
+    ga_ = g_srt[:, :T, None]
+    gb_ = g_srt[:, None, :]
+    la_ = l_srt[:, :T, None]
+    lb_ = l_srt[:, None, :]
+    delta = jnp.abs((ga_ - gb_)
+                    * (disc[None, :T, None] - disc[None, None, :])) \
+        * inv                                            # [nq_b, T, Q]
+    # each unordered pair once: position b strictly below position a
+    tri = (jnp.arange(Q)[None, None, :]
+           > jnp.arange(T)[None, :, None])
+    pair_ok = (la_ != lb_) & tri & v_srt[:, :T, None] & v_srt[:, None, :]
+
+    a_better = la_ > lb_
+    diff_hl = jnp.where(a_better, sa - sb, sb - sa)      # s_high - s_low
+    diff_hl = jnp.clip(diff_hl, -50.0 / s, 50.0 / s)
+    rho = 1.0 / (1.0 + jnp.exp(s * diff_hl))
+    lam = -s * rho * delta                    # dL/ds for the better doc
+    hes = s * s * rho * (1.0 - rho) * delta
+    lam = jnp.where(pair_ok, lam, 0.0)
+    hes = jnp.where(pair_ok, hes, 0.0)
+
+    # accumulate onto sorted positions: a gets +/-lam per label order,
+    # b the negation; hessians add on both ends
+    g_a = jnp.where(a_better, lam, -lam)
+    g_pos = jnp.zeros_like(s_srt).at[:, :T].add(jnp.sum(g_a, axis=2))
+    g_pos = g_pos - jnp.sum(g_a, axis=1)
+    h_pos = jnp.zeros_like(s_srt).at[:, :T].add(jnp.sum(hes, axis=2))
+    h_pos = h_pos + jnp.sum(hes, axis=1)
+
+    if norm:
+        # reference norm_: scale by log2(1 + |sum lambda|) / |sum lambda|
+        sum_lam = jnp.sum(jnp.abs(lam), axis=(1, 2))
+        nf = jnp.where(sum_lam > 0,
+                       jnp.log2(1.0 + sum_lam) / jnp.maximum(sum_lam, 1e-20),
+                       1.0)
+        g_pos = g_pos * nf[:, None]
+        h_pos = h_pos * nf[:, None]
+
+    # sorted positions back to padded doc slots
+    g_doc = jnp.take_along_axis(g_pos, rank, axis=1)
+    h_doc = jnp.take_along_axis(h_pos, rank, axis=1)
+
+    g_acc = g_acc.at[safe.reshape(-1)].add(
+        jnp.where(valid, g_doc, 0.0).reshape(-1))
+    h_acc = h_acc.at[safe.reshape(-1)].add(
+        jnp.where(valid, h_doc, 0.0).reshape(-1))
+    return g_acc, h_acc
+
+
+def _xendcg_accum(score, label, gumbel, qidx, g_acc, h_acc):
+    """XE-NDCG listwise gradients for ONE query-length bucket, scattered
+    onto the per-doc accumulators.  ``gumbel`` is the PER-DOC noise
+    vector ([n], drawn once per iteration) gathered through ``qidx`` —
+    drawing per doc instead of per padded slot makes the perturbed
+    targets identical across bucket geometries (bucketed == pad-to-max
+    up to reduction order)."""
+    valid = qidx >= 0
+    safe = jnp.maximum(qidx, 0)
+    sc = jnp.where(valid, score[safe], -1e30)
+    lbl = jnp.where(valid, label[safe], 0.0)
+    # Gumbel-perturbed relevance targets (XE-NDCG-MART, Bruch et al.):
+    # phi = max(2^y - 1 + Gumbel(0,1), 0), renormalized per query
+    gum = jnp.where(valid, gumbel[safe], 0.0)
+    phi = jnp.maximum(jnp.power(2.0, lbl) - 1.0 + gum, 0.0)
+    phi = jnp.where(valid, phi, 0.0)
+    phi_sum = jnp.sum(phi, axis=1, keepdims=True)
+    target = phi / jnp.maximum(phi_sum, 1e-20)
+    p = jax.nn.softmax(sc, axis=1)
+    p = jnp.where(valid, p, 0.0)
+    g_doc = p - target
+    h_doc = p * (1.0 - p)
+    g_acc = g_acc.at[safe.reshape(-1)].add(
+        jnp.where(valid, g_doc, 0.0).reshape(-1))
+    h_acc = h_acc.at[safe.reshape(-1)].add(
+        jnp.where(valid, jnp.maximum(h_doc, 1e-15), 0.0).reshape(-1))
+    return g_acc, h_acc
+
+
+def _pos_bias_newton(g, h, biases, positions, counts, *, lr: float,
+                     reg: float):
+    """Functional Newton step on per-position bias factors
+    (rank_objective.hpp:295 UpdatePositionBiasFactors): utility
+    derivative w.r.t. a position's bias is -sum(lambda) there,
+    L2-regularized per instance.  Pure — returns the NEW bias vector so
+    the update can live inside the same compiled program as the
+    gradients (the carried-array formulation that makes position-debiased
+    lambdarank fully device-resident)."""
+    first = jnp.zeros_like(biases).at[positions].add(-g)
+    second = jnp.zeros_like(biases).at[positions].add(-h)
+    first = first - biases * reg * counts
+    second = second - reg * counts
+    return biases + lr * first / (jnp.abs(second) + 0.001)
+
+
 class LambdarankNDCG(ObjectiveFunction):
     """reference rank_objective.hpp:138 LambdarankNDCG — pairwise lambda
     gradients weighted by |dNDCG|, truncation at
     ``lambdarank_truncation_level``, optional per-query normalization.
 
-    Queries are padded to the max query length and vmapped; the reference's
-    per-query OpenMP loop (rank_objective.hpp:73) becomes a batched kernel.
-    """
+    Queries are grouped into power-of-two LENGTH BUCKETS
+    (``rank_query_buckets``, the serving BucketLadder idiom applied to
+    training): each bucket runs one batched pairwise kernel at its own
+    [nq_b, T, Q_b] geometry, so padded-pair compute is
+    sum_b nq_b*T*Q_b instead of the pad-to-max nq*T*qmax — a ~Q_max/Q̄
+    win on skewed (MS-LTR-like) query-length distributions.  Bucket
+    programs are keyed through ops/compile_cache.py with NO anchors and
+    every data array a traced argument (``rank_compile_hits/misses``):
+    identical geometry across boosters and iterations re-enters the same
+    XLA executable, zero new lowerings.  Position debiasing threads its
+    bias factors as explicit carried DEVICE arrays (functional Newton
+    update inside the same program); the host ``_pos_biases`` copy is
+    kept in sync only for checkpointing and inspection."""
     NAME = "lambdarank"
 
     def init(self, metadata, num_data):
         super().init(metadata, num_data)
         if metadata.query_boundaries is None:
             log.fatal("Lambdarank tasks require query information")
-        self._qidx_np, _, self._qmax = _pad_queries(metadata.query_boundaries)
-        if self._qmax > 2048:
-            log.warning(
-                f"Longest query has {self._qmax} docs; the padded pairwise "
-                f"lambda computation is O(max_query_len^2) per query — "
-                f"consider lambdarank_truncation_level or splitting queries")
-        self._qidx = jnp.asarray(self._qidx_np)
+        self._init_rank_buckets(metadata.query_boundaries)
         lbl = np.asarray(metadata.label)
         gains = self.config.label_gain or [float((1 << i) - 1) for i in
                                            range(max(int(lbl.max()) + 1, 31))]
@@ -547,175 +747,211 @@ class LambdarankNDCG(ObjectiveFunction):
         if int(lbl.max()) >= len(self._label_gain):
             log.fatal("label_gain shorter than max label")
         # inverse max DCG per query (rank_objective.hpp:165-177)
-        inv = np.zeros(len(self._qidx_np), np.float64)
+        bounds = np.asarray(metadata.query_boundaries)
+        nq = len(bounds) - 1
+        inv = np.zeros(nq, np.float64)
         trunc = self.config.lambdarank_truncation_level
-        for i, row in enumerate(self._qidx_np):
-            docs = row[row >= 0]
+        for i in range(nq):
+            docs = np.arange(int(bounds[i]), int(bounds[i + 1]))
             g = np.sort(self._label_gain[lbl[docs].astype(int)])[::-1][:trunc]
             dcg = np.sum(g / np.log2(np.arange(2, len(g) + 2)))
             inv[i] = 1.0 / dcg if dcg > 0 else 0.0
-        self._inv_max_dcg = jnp.asarray(inv, jnp.float32)
+        # per-bucket device arrays: (cap, qidx [nq_b, cap], inv_dcg [nq_b])
+        self._buckets = [(cap, jnp.asarray(idx),
+                          jnp.asarray(inv[qids], jnp.float32))
+                         for cap, qids, idx in self._buckets_np]
         self._gain_of_doc = jnp.asarray(
             self._label_gain[lbl.astype(int)], jnp.float32)
         # position-debiased LTR (rank_objective.hpp:43-56,295: per-position
         # additive bias factors on the score, Newton-updated each iteration
         # with L2 regularization lambdarank_position_bias_regularization)
+        self.jit_safe = True       # re-init may change the position state
         self._positions = None
         if metadata.position is not None:
             pos = np.asarray(metadata.position)
             ids, inv_idx = np.unique(pos, return_inverse=True)
             self._positions = inv_idx.astype(np.int32)
+            self._positions_dev = jnp.asarray(self._positions)
+            # the device f32 carry is the source of truth; the host f64
+            # mirror below exists for checkpointing/inspection only
+            self._pos_biases_dev = jnp.zeros(len(ids), jnp.float32)
             self._pos_biases = np.zeros(len(ids), np.float64)
+            self._pos_counts_dev = jnp.asarray(
+                np.bincount(inv_idx, minlength=len(ids)).astype(np.float32))
             self._pos_reg = float(
                 self.config.lambdarank_position_bias_regularization)
-            # bias factors mutate every call (the Newton update below and
-            # the score adjustment both read them) — not jittable
+            # the per-iteration bias carry keeps this objective off the
+            # FUSED round scan (a scan-traced get_gradients would freeze
+            # the carry as a constant); jitted_gradients below still runs
+            # the whole update as one cached device program
             self.jit_safe = False
 
-    def _update_position_bias(self, g: np.ndarray, h: np.ndarray) -> None:
-        """Newton step on per-position bias factors (rank_objective.hpp:295
-        UpdatePositionBiasFactors): utility derivative w.r.t. a position's
-        bias is -sum(lambda) there, L2-regularized per instance."""
-        p = self._positions
-        first = np.zeros_like(self._pos_biases)
-        second = np.zeros_like(self._pos_biases)
-        counts = np.zeros_like(self._pos_biases)
-        np.add.at(first, p, -g)
-        np.add.at(second, p, -h)
-        np.add.at(counts, p, 1.0)
-        first -= self._pos_biases * self._pos_reg * counts
-        second -= self._pos_reg * counts
-        self._pos_biases += (float(self.config.learning_rate) * first
-                             / (np.abs(second) + 0.001))
+    def _init_rank_buckets(self, boundaries) -> None:
+        """Build the query-length bucket plan + telemetry gauges (shared
+        with RankXENDCG)."""
+        bounds = np.asarray(boundaries)
+        sizes = np.diff(bounds)
+        self._qmax = int(sizes.max()) if len(sizes) else 1
+        spec = getattr(self.config, "rank_query_buckets", "auto")
+        self._buckets_np, self._rank_pad_rows = _rank_buckets(bounds, spec)
+        self._rank_bucket_count = len(self._buckets_np)
+        if self._qmax > 2048 and os.environ.get("LGBMTPU_NO_RANK_BUCKETS"):
+            log.warning(
+                f"Longest query has {self._qmax} docs and query-length "
+                f"bucketing is disabled (LGBMTPU_NO_RANK_BUCKETS): the "
+                f"pad-to-max pairwise lambda computation is "
+                f"O(max_query_len^2) per query — unset the hatch to "
+                f"restore the bucketed kernels (rank_query_buckets), or "
+                f"lower lambdarank_truncation_level / split queries")
+        global_metrics.set_gauge("rank_pad_rows", self._rank_pad_rows)
+        global_metrics.set_gauge("rank_bucket_count",
+                                 self._rank_bucket_count)
+        if self._metrics is not None:
+            self._metrics.set_gauge("rank_pad_rows", self._rank_pad_rows)
+            self._metrics.set_gauge("rank_bucket_count",
+                                    self._rank_bucket_count)
+
+    def _bucket_geoms(self) -> tuple:
+        return tuple((int(qidx.shape[0]), cap)
+                     for cap, qidx, _ in self._buckets)
 
     def get_gradients(self, score):
+        """Pure traceable composition over the bucket plan — the function
+        the fused round scan traces inline (plain lambdarank) and tests
+        call eagerly.  Training dispatch goes through jitted_gradients,
+        which runs this same arithmetic as one cached program."""
         if self._positions is not None:
-            score = score + jnp.asarray(
-                self._pos_biases[self._positions], jnp.float32)
-        s = self.config.sigmoid
-        trunc = self.config.lambdarank_truncation_level
-        norm = self.config.lambdarank_norm
-        qidx = self._qidx                      # [nq, Q]
-        valid = qidx >= 0
-        safe = jnp.maximum(qidx, 0)
-        sc = jnp.where(valid, score[safe], -jnp.inf)      # [nq, Q]
-        gains = jnp.where(valid, self._gain_of_doc[safe], 0.0)
-        lbl = jnp.where(valid, self._label[safe], -1.0)
-
-        # rank of each doc by descending score (ties by index, like ref sort)
-        order = jnp.argsort(-sc, axis=1, stable=True)      # positions -> doc slot
-        rank = jnp.argsort(order, axis=1)                  # doc slot -> position
-
-        # -- truncation-aware pair enumeration in SORTED space.  The
-        # reference (rank_objective.hpp:138-292) iterates i over sorted
-        # positions [0, trunc) and j over (i, cnt): every pair has its
-        # higher-scored member inside the truncation level, so the pair set
-        # is O(Q * trunc), not O(Q^2).  Materializing [nq, T, Q] instead of
-        # [nq, Q, Q] is what makes MS-LTR-scale query lengths (thousands of
-        # docs) fit in memory (VERDICT r1 #7).
-        Q = sc.shape[1]
-        T = int(min(trunc, Q))
-        s_srt = jnp.take_along_axis(sc, order, axis=1)      # [nq, Q] desc
-        g_srt = jnp.take_along_axis(gains, order, axis=1)
-        l_srt = jnp.take_along_axis(lbl, order, axis=1)
-        v_srt = jnp.take_along_axis(valid, order, axis=1)
-        disc = 1.0 / jnp.log2(jnp.arange(Q, dtype=jnp.float32) + 2.0)  # [Q]
-        inv_dcg = self._inv_max_dcg[:, None, None]           # [nq, 1, 1]
-
-        sa = s_srt[:, :T, None]                              # [nq, T, 1]
-        sb = s_srt[:, None, :]                               # [nq, 1, Q]
-        ga_ = g_srt[:, :T, None]
-        gb_ = g_srt[:, None, :]
-        la_ = l_srt[:, :T, None]
-        lb_ = l_srt[:, None, :]
-        delta = jnp.abs((ga_ - gb_)
-                        * (disc[None, :T, None] - disc[None, None, :])) \
-            * inv_dcg                                        # [nq, T, Q]
-        # each unordered pair once: position b strictly below position a
-        tri = (jnp.arange(Q)[None, None, :]
-               > jnp.arange(T)[None, :, None])
-        pair_ok = (la_ != lb_) & tri & v_srt[:, :T, None] & v_srt[:, None, :]
-
-        a_better = la_ > lb_
-        diff_hl = jnp.where(a_better, sa - sb, sb - sa)      # s_high - s_low
-        diff_hl = jnp.clip(diff_hl, -50.0 / s, 50.0 / s)
-        rho = 1.0 / (1.0 + jnp.exp(s * diff_hl))
-        lam = -s * rho * delta                    # dL/ds for the better doc
-        hes = s * s * rho * (1.0 - rho) * delta
-        lam = jnp.where(pair_ok, lam, 0.0)
-        hes = jnp.where(pair_ok, hes, 0.0)
-
-        # accumulate onto sorted positions: a gets +/-lam per label order,
-        # b the negation; hessians add on both ends
-        g_a = jnp.where(a_better, lam, -lam)
-        g_pos = jnp.zeros_like(s_srt).at[:, :T].add(jnp.sum(g_a, axis=2))
-        g_pos = g_pos - jnp.sum(g_a, axis=1)
-        h_pos = jnp.zeros_like(s_srt).at[:, :T].add(jnp.sum(hes, axis=2))
-        h_pos = h_pos + jnp.sum(hes, axis=1)
-
-        if norm:
-            # reference norm_: scale by log2(1 + |sum lambda|) / |sum lambda|
-            sum_lam = jnp.sum(jnp.abs(lam), axis=(1, 2))
-            nf = jnp.where(sum_lam > 0,
-                           jnp.log2(1.0 + sum_lam) / jnp.maximum(sum_lam, 1e-20),
-                           1.0)
-            g_pos = g_pos * nf[:, None]
-            h_pos = h_pos * nf[:, None]
-
-        # sorted positions back to padded doc slots
-        g_doc = jnp.take_along_axis(g_pos, rank, axis=1)
-        h_doc = jnp.take_along_axis(h_pos, rank, axis=1)
-
-        g = jnp.zeros_like(score).at[safe.reshape(-1)].add(
-            jnp.where(valid, g_doc, 0.0).reshape(-1))
-        h = jnp.zeros_like(score).at[safe.reshape(-1)].add(
-            jnp.where(valid, h_doc, 0.0).reshape(-1))
+            score = score + self._pos_biases_dev[self._positions_dev]
+        g = jnp.zeros_like(score)
+        h = jnp.zeros_like(score)
+        for cap, qidx, inv in self._buckets:
+            g, h = _lambdarank_pair_accum(
+                score, self._label, self._gain_of_doc, qidx, inv, g, h,
+                sigmoid=float(self.config.sigmoid),
+                trunc=int(self.config.lambdarank_truncation_level),
+                norm=bool(self.config.lambdarank_norm))
         g, h = self._apply_weight(g, h)
-        if self._positions is not None:
-            self._update_position_bias(np.asarray(g, np.float64),
-                                       np.asarray(h, np.float64))
+        if self._positions is not None and \
+                not isinstance(score, jax.core.Tracer):
+            self._pos_biases_dev = _pos_bias_newton(
+                g, h, self._pos_biases_dev, self._positions_dev,
+                self._pos_counts_dev,
+                lr=float(self.config.learning_rate), reg=self._pos_reg)
+            self._pos_biases = np.asarray(self._pos_biases_dev, np.float64)
         return g, h
 
+    def jitted_gradients(self, score):
+        """One compile-cached program per bucket-geometry signature:
+        score adjust (position bias), every bucket's pairwise kernel,
+        weighting and the functional Newton bias update all lower as a
+        SINGLE XLA executable, keyed only by geometry + hyperparameters
+        (no anchors; labels/gains/biases are traced arguments), so a
+        second booster over identical geometry is a pure
+        ``rank_compile_hits`` path — zero new lowerings."""
+        pos = self._positions is not None
+        has_w = self._weight is not None
+        statics = (int(self.num_data), self._bucket_geoms(),
+                   float(self.config.sigmoid),
+                   int(self.config.lambdarank_truncation_level),
+                   bool(self.config.lambdarank_norm), has_w,
+                   int(self._pos_biases_dev.shape[0]) if pos else 0,
+                   float(self.config.learning_rate) if pos else 0.0,
+                   float(self._pos_reg) if pos else 0.0)
+        sigmoid, trunc, norm = statics[2], statics[3], statics[4]
+        lr, reg = statics[7], statics[8]
 
-class RankXENDCG(ObjectiveFunction):
+        def builder():
+            def run(score, label, gain_doc, weight, bias, positions,
+                    counts, buckets):
+                sc = score + bias[positions] if pos else score
+                g = jnp.zeros_like(score)
+                h = jnp.zeros_like(score)
+                for qidx, inv in buckets:
+                    g, h = _lambdarank_pair_accum(
+                        sc, label, gain_doc, qidx, inv, g, h,
+                        sigmoid=sigmoid, trunc=trunc, norm=norm)
+                if has_w:
+                    g, h = g * weight, h * weight
+                if pos:
+                    nb = _pos_bias_newton(g, h, bias, positions, counts,
+                                          lr=lr, reg=reg)
+                    return g, h, nb
+                return g, h
+            return jax.jit(run)
+
+        fn = cc.get_or_build(("rank_grad", statics), builder, anchors=(),
+                             metrics=self._metrics, counter_ns="rank")
+        empty_f = jnp.zeros((0,), jnp.float32)
+        empty_i = jnp.zeros((0,), jnp.int32)
+        out = fn(score, self._label, self._gain_of_doc,
+                 self._weight if has_w else empty_f,
+                 self._pos_biases_dev if pos else empty_f,
+                 self._positions_dev if pos else empty_i,
+                 self._pos_counts_dev if pos else empty_f,
+                 tuple((qidx, inv) for _, qidx, inv in self._buckets))
+        if pos:
+            g, h, nb = out
+            self._pos_biases_dev = nb
+            self._pos_biases = np.asarray(nb, np.float64)
+            return g, h
+        return out
+
+
+class RankXENDCG(LambdarankNDCG):
     """reference rank_objective.hpp:378 RankXENDCG (XE-NDCG-MART, Bruch et
-    al.) — listwise cross-entropy with Gumbel-perturbed relevance targets."""
+    al.) — listwise cross-entropy with Gumbel-perturbed relevance targets,
+    over the same query-length bucket plan as lambdarank (one listwise
+    program per bucket geometry; the Gumbel noise is drawn PER DOC so the
+    targets do not depend on the bucket ladder)."""
     NAME = "rank_xendcg"
-    # each call splits self._rng — per-call mutable state, not jittable
+    # each call splits self._rng — per-call mutable HOST state; the split
+    # stays on host (and off the fused scan) while the drawn key rides
+    # into the cached device program as a traced argument
     jit_safe = False
 
     def init(self, metadata, num_data):
-        super().init(metadata, num_data)
+        ObjectiveFunction.init(self, metadata, num_data)
         if metadata.query_boundaries is None:
             log.fatal("Ranking tasks require query information")
-        self._qidx_np, _, self._qmax = _pad_queries(metadata.query_boundaries)
-        self._qidx = jnp.asarray(self._qidx_np)
+        self._init_rank_buckets(metadata.query_boundaries)
+        self._buckets = [(cap, jnp.asarray(idx), None)
+                         for cap, qids, idx in self._buckets_np]
+        self._positions = None
         self._rng = jax.random.PRNGKey(self.config.objective_seed)
         self._iter = 0
 
     def get_gradients(self, score):
         self._rng, key = jax.random.split(self._rng)
-        qidx = self._qidx
-        valid = qidx >= 0
-        safe = jnp.maximum(qidx, 0)
-        sc = jnp.where(valid, score[safe], -1e30)
-        lbl = jnp.where(valid, self._label[safe], 0.0)
-        # Gumbel-perturbed relevance targets (XE-NDCG-MART, Bruch et al.):
-        # phi = max(2^y - 1 + Gumbel(0,1), 0), renormalized per query
-        gumbel = jax.random.gumbel(key, lbl.shape)
-        phi = jnp.maximum(jnp.power(2.0, lbl) - 1.0 + gumbel, 0.0)
-        phi = jnp.where(valid, phi, 0.0)
-        phi_sum = jnp.sum(phi, axis=1, keepdims=True)
-        target = phi / jnp.maximum(phi_sum, 1e-20)
-        p = jax.nn.softmax(sc, axis=1)
-        p = jnp.where(valid, p, 0.0)
-        g_doc = p - target
-        h_doc = p * (1.0 - p)
-        g = jnp.zeros_like(score).at[safe.reshape(-1)].add(
-            jnp.where(valid, g_doc, 0.0).reshape(-1))
-        h = jnp.zeros_like(score).at[safe.reshape(-1)].add(
-            jnp.where(valid, jnp.maximum(h_doc, 1e-15), 0.0).reshape(-1))
+        gumbel = jax.random.gumbel(key, score.shape)
+        g = jnp.zeros_like(score)
+        h = jnp.zeros_like(score)
+        for cap, qidx, _ in self._buckets:
+            g, h = _xendcg_accum(score, self._label, gumbel, qidx, g, h)
         return self._apply_weight(g, h)
+
+    def jitted_gradients(self, score):
+        has_w = self._weight is not None
+        statics = (int(self.num_data), self._bucket_geoms(), has_w)
+        self._rng, key = jax.random.split(self._rng)
+
+        def builder():
+            def run(score, label, weight, rkey, buckets):
+                gumbel = jax.random.gumbel(rkey, score.shape)
+                g = jnp.zeros_like(score)
+                h = jnp.zeros_like(score)
+                for qidx in buckets:
+                    g, h = _xendcg_accum(score, label, gumbel, qidx, g, h)
+                if has_w:
+                    g, h = g * weight, h * weight
+                return g, h
+            return jax.jit(run)
+
+        fn = cc.get_or_build(("rank_xendcg", statics), builder, anchors=(),
+                             metrics=self._metrics, counter_ns="rank")
+        empty_f = jnp.zeros((0,), jnp.float32)
+        return fn(score, self._label,
+                  self._weight if has_w else empty_f, key,
+                  tuple(qidx for _, qidx, _ in self._buckets))
 
 
 # ------------------------------------------------------------------ factory

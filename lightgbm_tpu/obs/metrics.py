@@ -170,6 +170,15 @@ COUNTERS: Dict[str, str] = {
     "fleet_autoscale_downs":
         "replica slots drained and retired by the fleet autoscaler "
         "after SLO recovery",
+    "rank_compile_hits":
+        "ranking-scope compile-cache hits — a query-length bucket "
+        "re-entered an already-lowered pairwise program "
+        "(ops/compile_cache.py)",
+    "rank_compile_misses":
+        "ranking-scope compile-cache misses — a fresh bucket geometry "
+        "lowered a new pairwise program (ops/compile_cache.py)",
+    "serve_contrib_requests":
+        "serving-tier predict_contrib (tree-SHAP) requests served",
 }
 
 

@@ -150,8 +150,9 @@ class CompileCache:
         the key (so a *different* dataset with identical shapes can
         never reuse a closure over the old one's arrays) and bound the
         entry's lifetime to theirs.  ``counter_ns`` picks the telemetry
-        namespace: ``"round"`` (training round bodies, the default) or
-        ``"serve"`` (serving-tier predict programs) — spelled as literal
+        namespace: ``"round"`` (training round bodies, the default),
+        ``"serve"`` (serving-tier predict programs) or ``"rank"``
+        (query-length-bucketed ranking programs) — spelled as literal
         branches below because the OBS301 lint contract requires counter
         names to appear as string literals at the bump site.
 
@@ -177,6 +178,8 @@ class CompileCache:
         if fn is not None:
             if counter_ns == "serve":
                 count_event("serve_compile_hits", 1, metrics)
+            elif counter_ns == "rank":
+                count_event("rank_compile_hits", 1, metrics)
             else:
                 count_event("round_compile_hits", 1, metrics)
             return fn
@@ -189,6 +192,8 @@ class CompileCache:
             fn = builder()
         if counter_ns == "serve":
             count_event("serve_compile_misses", 1, metrics)
+        elif counter_ns == "rank":
+            count_event("rank_compile_misses", 1, metrics)
         else:
             count_event("round_compile_misses", 1, metrics)
         with self._lock:

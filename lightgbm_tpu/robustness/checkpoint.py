@@ -144,7 +144,8 @@ class CheckpointState:
                  valid_scores: Dict[str, np.ndarray],
                  rng_state: Optional[dict], strategy_rng_state: Optional[dict],
                  history: Dict[str, Dict[str, List[float]]],
-                 stopping_states: Optional[List[dict]] = None):
+                 stopping_states: Optional[List[dict]] = None,
+                 pos_biases: Optional[np.ndarray] = None):
         self.path = path
         self.iteration = iteration
         self.model_text = model_text
@@ -154,6 +155,10 @@ class CheckpointState:
         self.strategy_rng_state = strategy_rng_state
         self.history = history
         self.stopping_states = stopping_states or []
+        #: position-debiased lambdarank bias-factor carry (f32) — saved
+        #: from the objective's device array so a resumed run continues
+        #: the Newton iteration bit-identically
+        self.pos_biases = pos_biases
 
     def restore_into(self, booster, callbacks) -> None:
         """Overwrite the continuation booster's training state with the
@@ -225,6 +230,20 @@ class CheckpointState:
             except (KeyError, ValueError, TypeError) as e:
                 log.warning(f"resume: could not restore sampling RNG state "
                             f"({e}); reseeding")
+        if self.pos_biases is not None and g.objective is not None and \
+                getattr(g.objective, "_positions", None) is not None:
+            if len(self.pos_biases) == \
+                    len(np.asarray(g.objective._pos_biases_dev)):
+                g.objective._pos_biases_dev = jnp.asarray(
+                    self.pos_biases, jnp.float32)
+                g.objective._pos_biases = np.asarray(
+                    self.pos_biases, np.float64)
+            else:
+                log.warning(
+                    f"resume: checkpointed position-bias vector has "
+                    f"{len(self.pos_biases)} entries, dataset has "
+                    f"{len(np.asarray(g.objective._pos_biases_dev))}; "
+                    "bias factors restart from zero")
         for cb in callbacks or []:
             er = getattr(cb, "eval_result", None)
             if isinstance(er, dict):
@@ -268,12 +287,15 @@ def load_latest_checkpoint(directory: str) -> Optional[CheckpointState]:
             with open(os.path.join(path, META_NAME)) as f:
                 meta = json.load(f)
             scores = None
+            pos_biases = None
             valid_scores: Dict[str, np.ndarray] = {}
             state_path = os.path.join(path, STATE_NAME)
             if os.path.exists(state_path):
                 with np.load(state_path) as z:
                     if "scores" in z:
                         scores = np.asarray(z["scores"])
+                    if "pos_biases" in z:
+                        pos_biases = np.asarray(z["pos_biases"])
                     for vi, name in enumerate(meta.get("valid_names", [])):
                         key = f"valid_{vi}"
                         if key in z:
@@ -291,7 +313,8 @@ def load_latest_checkpoint(directory: str) -> Optional[CheckpointState]:
             rng_state=meta.get("rng_state"),
             strategy_rng_state=meta.get("strategy_rng_state"),
             history=meta.get("history") or {},
-            stopping_states=meta.get("stopping_states") or [])
+            stopping_states=meta.get("stopping_states") or [],
+            pos_biases=pos_biases)
     return None
 
 
@@ -387,6 +410,14 @@ class CheckpointManager:
         for vi in range(len(g.valid_scores)):
             arrays[f"valid_{vi}"] = np.asarray(g.valid_scores[vi],
                                                np.float32)
+        # position-debiased lambdarank: the bias-factor carry is training
+        # state exactly like the score caches — an f32 device->npz->device
+        # round-trip is bit-exact, so a killed run resumes the Newton
+        # iteration on the same factors
+        if g.objective is not None and \
+                getattr(g.objective, "_positions", None) is not None:
+            arrays["pos_biases"] = np.asarray(
+                g.objective._pos_biases_dev, np.float32)
         state_path = os.path.join(tmp, STATE_NAME)
         with open(state_path, "wb") as f:
             np.savez(f, **arrays)

@@ -357,7 +357,8 @@ def _replica_serve_conn(server, conn: socket.socket,
                 out, ver = server.serve(
                     msg["name"], msg["X"],
                     raw_score=bool(msg.get("raw_score", True)),
-                    deadline_ms=sub, trace=tr)
+                    deadline_ms=sub, trace=tr,
+                    contrib=bool(msg.get("contrib", False)))
                 reply = {"ok": True, "out": out, "version": int(ver)}
             except Exception as e:
                 reply = {"ok": False, "error": type(e).__name__,
@@ -1195,8 +1196,19 @@ class FleetServer:
         return self.predict_ex(name, X, raw_score=raw_score,
                                deadline_ms=deadline_ms)["out"]
 
+    def predict_contrib(self, name: str, X,
+                        deadline_ms: Optional[float] = None) -> np.ndarray:
+        """``PredictionServer.predict_contrib`` over the fleet: tree-SHAP
+        contributions with the same failover/deadline semantics as
+        ``predict`` (the ``contrib`` flag rides the predict wire op, so
+        old replicas without it simply serve plain predictions — callers
+        should fleet-upgrade before relying on it)."""
+        return self.predict_ex(name, X, deadline_ms=deadline_ms,
+                               contrib=True)["out"]
+
     def predict_ex(self, name: str, X, raw_score: bool = True,
-                   deadline_ms: Optional[float] = None) -> Dict[str, Any]:
+                   deadline_ms: Optional[float] = None,
+                   contrib: bool = False) -> Dict[str, Any]:
         """``predict`` plus provenance: ``{"out", "version", "replica",
         "failovers", "latency_ms"}``.  ``version`` is the single model
         version behind every row of ``out`` (the rolling-swap fence —
@@ -1243,6 +1255,8 @@ class FleetServer:
             dispatched += 1
             msg = {"op": "predict", "name": name, "X": X,
                    "raw_score": bool(raw_score), "deadline_ms": sub_ms}
+            if contrib:
+                msg["contrib"] = True
             aid = None
             a0 = 0.0
             if tr is not None:

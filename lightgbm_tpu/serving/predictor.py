@@ -271,6 +271,58 @@ class CompiledPredictor:
     def predict(self, X, raw_score: bool = True):
         return self.predict_ex(X, raw_score=raw_score)[0]
 
+    # -------------------------------------------------------------- contrib
+    def predict_contrib_ex(self, X, trace=None, parent: Optional[int] = None):
+        """(contribs, RequestStats): tree-SHAP through the bucket ladder.
+
+        Rows are quantized to ladder buckets and zero-padded before the
+        jitted TreeSHAP recurrences run (``models/shap.py`` with
+        ``force_device=True``): SHAP is row-independent, so pad rows
+        compute garbage that is sliced off, and the traced row-shape set
+        stays the ladder — a steady-state contrib request lowers zero
+        new programs, same contract as ``predict_ex``.  Output layout is
+        ``Booster.predict(pred_contrib=True)``'s ([n, F+1], or
+        [n, k*(F+1)] class-major), at device f32 rather than the host
+        walk's f64."""
+        from ..models import shap
+        X = np.asarray(X, np.float64)
+        if X.ndim == 1:
+            X = X.reshape(1, -1)
+        n = X.shape[0]
+        stats = RequestStats()
+        stats.rows = n
+        if self._fallback is not None:
+            stats.fallback = True
+            count_event("serve_host_fallback_requests", 1, self.metrics)
+            return self._fallback.predict(X, pred_contrib=True), stats
+        cols = self.num_features + 1 if self.k == 1 \
+            else self.k * (self.num_features + 1)
+        out = np.empty((n, cols))
+        for off, rows, bucket in self.ladder.chunks(n):
+            stats.chunks.append((bucket, rows))
+            stats.pad_rows += bucket - rows
+            self._mark_chunk(bucket, stats)
+            t_pad = time.perf_counter() if trace is not None else 0.0
+            padded = np.zeros((bucket, X.shape[1]))
+            padded[:rows] = X[off:off + rows]
+            if trace is not None:
+                t_run = time.perf_counter()
+                trace.record_span("bucket_pad", trace.us(t_pad),
+                                  (t_run - t_pad) * 1e6, parent=parent,
+                                  bucket=bucket)
+            res = shap.predict_contrib(
+                self.trees, padded, self.num_features,
+                num_tree_per_iteration=self.k, force_device=True)
+            out[off:off + rows] = res[:rows]
+            if trace is not None:
+                trace.record_span("device_run", trace.us(t_run),
+                                  (time.perf_counter() - t_run) * 1e6,
+                                  parent=parent, bucket=bucket)
+        return out, stats
+
+    def predict_contrib(self, X):
+        return self.predict_contrib_ex(X)[0]
+
     # --------------------------------------------------------------- warmup
     def warmup(self) -> Dict[int, float]:
         """Trace + compile every bucket program up front; returns

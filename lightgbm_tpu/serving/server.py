@@ -187,9 +187,20 @@ class PredictionServer:
                             deadline_ms=deadline_ms)
         return out
 
+    def predict_contrib(self, name: str, X,
+                        deadline_ms: Optional[float] = None) -> np.ndarray:
+        """Tree-SHAP contributions for one request, through the same
+        admission control / bucket ladder / telemetry as ``predict``
+        (``CompiledPredictor.predict_contrib_ex``).  Counted on
+        ``serve_contrib_requests`` alongside ``serve_requests``."""
+        out, _ = self.serve(name, X, deadline_ms=deadline_ms,
+                            contrib=True)
+        return out
+
     def serve(self, name: str, X, raw_score: bool = True,
               deadline_ms: Optional[float] = None,
-              trace: Optional["reqtrace.RequestTrace"] = None):
+              trace: Optional["reqtrace.RequestTrace"] = None,
+              contrib: bool = False):
         """``predict`` plus provenance: returns ``(out, version)`` where
         ``version`` is the registry version that actually served the
         request.  The entry is resolved exactly once, so the returned
@@ -208,14 +219,14 @@ class PredictionServer:
             tr = reqtrace.RequestTrace()
         if tr is None:
             return self._serve(name, X, raw_score, deadline_ms,
-                               None, None, None)
+                               None, None, None, contrib=contrib)
         # pre-allocate the replica root + queue-wait span ids so children
         # recorded mid-flight can parent onto spans that close at the end
         rid, qid = tr.new_id(), tr.new_id()
         status, t0 = "ok", time.perf_counter()
         try:
             return self._serve(name, X, raw_score, deadline_ms,
-                               tr, rid, qid)
+                               tr, rid, qid, contrib=contrib)
         except BaseException:
             status = "error"
             raise
@@ -230,7 +241,8 @@ class PredictionServer:
     def _serve(self, name: str, X, raw_score: bool,
                deadline_ms: Optional[float],
                tr: Optional["reqtrace.RequestTrace"],
-               rid: Optional[int], qid: Optional[int]):
+               rid: Optional[int], qid: Optional[int],
+               contrib: bool = False):
         t_admit = time.perf_counter()
         with self._inflight_lock:
             self._pending += 1
@@ -295,14 +307,20 @@ class PredictionServer:
                 raise ServerOverloaded(
                     f"request deadline_ms={deadline_ms} expired before "
                     "predict start")
-            out, stats = entry.predictor.predict_ex(
-                X, raw_score=raw_score, trace=tr, parent=rid)
+            if contrib:
+                out, stats = entry.predictor.predict_contrib_ex(
+                    X, trace=tr, parent=rid)
+            else:
+                out, stats = entry.predictor.predict_ex(
+                    X, raw_score=raw_score, trace=tr, parent=rid)
             latency_s = time.perf_counter() - t0
         finally:
             with self._inflight_lock:
                 self._inflight -= 1
                 self.metrics.set_gauge("serve_inflight", self._inflight)
         count_event("serve_requests", 1, self.metrics)
+        if contrib:
+            count_event("serve_contrib_requests", 1, self.metrics)
         count_event("serve_rows", stats.rows, self.metrics)
         if stats.pad_rows:
             count_event("serve_pad_waste_rows", stats.pad_rows, self.metrics)

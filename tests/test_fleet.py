@@ -86,6 +86,13 @@ def test_fleet_parity_and_provenance(fleet, fleet_model):
     assert r["replica"] in (0, 1)
     ref = b1.predict(X[:5], raw_score=True)
     assert np.array_equal(np.asarray(r["out"]).ravel(), ref.ravel())
+    # contrib rides the same wire op (replica bumps its own
+    # serve_contrib_requests; here we pin routing + output parity)
+    contrib = np.asarray(fleet.predict_contrib("m", X[:5],
+                                               deadline_ms=30_000))
+    ref_c = np.asarray(b1.predict(X[:5], pred_contrib=True))
+    assert contrib.shape == ref_c.shape
+    np.testing.assert_allclose(contrib, ref_c, rtol=2e-4, atol=2e-5)
     # unknown model surfaces the registry's typed error, not a retry loop
     with pytest.raises(LightGBMError, match="no model named"):
         fleet.predict("nope", X[:3])

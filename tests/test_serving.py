@@ -318,26 +318,56 @@ def test_hot_swap_concurrent_never_mixes(reg_model):
 def test_steady_state_zero_lowerings(reg_model, multi_model):
     """The tentpole CI gate: after one warmup pass per bucket, 100+
     mixed-shape requests across MULTIPLE live models must add zero XLA
-    lowerings (every request re-enters a compiled bucket program)."""
+    lowerings (every request re-enters a compiled bucket program).
+    ``predict_contrib`` requests ride the same gate: tree-SHAP runs
+    bucket-padded through the jitted recurrences, so its traced shape
+    set is the ladder too."""
     bst, X = reg_model
     mbst, mX = multi_model
     srv = PredictionServer({"serving_buckets": [1, 8, 64]})
     srv.publish("reg", booster=bst)          # warmup=True compiles all
     srv.publish("multi", booster=mbst)       # buckets up front
+    for b in (1, 8, 64):                     # warm the contrib programs
+        srv.predict_contrib("reg", X[:b])
+        srv.predict_contrib("multi", mX[:b])
+    warm_contrib = 6
     base = _lowerings()
     rng = np.random.default_rng(4)
     for i in range(110):
         n = int(rng.integers(1, 130))
-        if i % 3 == 2:
+        if i % 10 == 5:
+            srv.predict_contrib("reg", X[:n])
+        elif i % 10 == 9:
+            srv.predict_contrib("multi", mX[:n])
+        elif i % 3 == 2:
             srv.predict("multi", mX[:n], raw_score=(i % 2 == 0))
         else:
             srv.predict("reg", X[:n], raw_score=(i % 2 == 0))
     assert _lowerings() - base == 0, \
         "serving steady state lowered new XLA programs"
     counters = srv.stats()["counters"]
-    assert counters["serve_requests"] == 110
+    assert counters["serve_requests"] == 110 + warm_contrib
+    assert counters["serve_contrib_requests"] == 22 + warm_contrib
     assert counters["serve_bucket_hits"] > 0
     assert counters["serve_pad_waste_rows"] > 0
+
+
+def test_serve_contrib_matches_booster(reg_model, multi_model):
+    """Served contributions match ``Booster.predict(pred_contrib=True)``
+    to device-f32 tolerance, layout included, and sum to the raw
+    margin (the SHAP additivity identity)."""
+    for bst, X in (reg_model, multi_model):
+        srv = PredictionServer({"serving_buckets": [8, 64]})
+        srv.publish("m", booster=bst)
+        got = srv.predict_contrib("m", X[:50])
+        ref = np.asarray(bst.predict(X[:50], pred_contrib=True))
+        assert got.shape == ref.shape
+        np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+        k = bst.num_model_per_iteration()
+        raw = np.asarray(bst.predict(X[:50], raw_score=True))
+        total = got.reshape(50, k, -1).sum(axis=2)
+        np.testing.assert_allclose(
+            total[:, 0] if k == 1 else total, raw, rtol=1e-4, atol=1e-5)
 
 
 @pytest.mark.parametrize("mode", ["off", "all"])

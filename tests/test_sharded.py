@@ -359,3 +359,47 @@ class TestTools:
         doc = json.loads(r.stdout)
         assert any("stripe" in f for f in doc["findings"])
         assert "c:999" in doc["sharded"]["orphaned_stripes"]
+
+
+# --------------------------------------------------------- query groups
+class TestShardedQueryGroups:
+    """The qid column survives the two-pass sharded ingest: each stripe
+    commit carries its slice, the merge concatenates in stripe order, and
+    the resulting ``query_boundaries`` are bit-identical to the
+    single-host build.  A query id straddling a stripe boundary is
+    refused loudly — stripe ownership (steal/resume reprocesses whole
+    stripes) cannot guarantee one incarnation commits a split query."""
+
+    @staticmethod
+    def _ranked(n=400, f=5, group=40, seed=3):
+        X, y = _matrix(n, f, seed)
+        qid = np.repeat(np.arange(n // group), group)
+        return X, y, qid
+
+    def test_sharded_qid_bit_identical_to_single_host(self, tmp_path):
+        from lightgbm_tpu.io.streaming import stream_inner_dataset
+        X, y, qid = self._ranked()
+        src = ArrayChunkSource(X, 80, label=y, qid=qid)
+        single = stream_inner_dataset(
+            ArrayChunkSource(X, 80, label=y, qid=qid),
+            config=Config({"verbosity": -1}),
+            workdir=str(tmp_path / "single"), chunk_rows=80)
+        ds = shard_stream_inner_dataset(
+            src, config=Config(dict(ELASTIC, ingest_workers=2)),
+            workdir=str(tmp_path / "sharded"), chunk_rows=80)
+        np.testing.assert_array_equal(
+            np.asarray(ds.metadata.query_boundaries),
+            np.asarray(single.metadata.query_boundaries))
+        np.testing.assert_array_equal(
+            np.asarray(ds.metadata.query_boundaries),
+            np.arange(0, 401, 40))
+        _assert_bit_identical(ds, single)
+
+    def test_qid_straddling_stripe_boundary_refused(self, tmp_path):
+        X, y, _ = self._ranked()
+        qid = np.repeat(np.arange(4), 100)   # 100-row queries, 80-row stripes
+        src = ArrayChunkSource(X, 80, label=y, qid=qid)
+        with pytest.raises(LightGBMError, match="straddles the stripe"):
+            shard_stream_inner_dataset(
+                src, config=Config(dict(ELASTIC, ingest_workers=2)),
+                workdir=str(tmp_path), chunk_rows=80)

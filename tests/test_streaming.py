@@ -655,3 +655,41 @@ class TestStripeParser:
         ds = stream_inner_dataset(src, config=dict(FAST))
         ds_mem = Dataset.from_data(arr, label, dict(FAST))
         _assert_bit_identical(ds, ds_mem)
+
+
+# --------------------------------------------------------- query groups
+class TestQueryGroups:
+    """A qid column streamed chunk by chunk must land in
+    ``Metadata.query_boundaries`` bit-identically to the in-memory
+    ``group=`` build — chunk boundaries routinely split queries, so the
+    run-length happens once over the harvested column, not per chunk."""
+
+    @staticmethod
+    def _ranked(n=500, f=5, seed=7):
+        rng = np.random.RandomState(seed)
+        X = rng.normal(size=(n, f))
+        y = rng.randint(0, 4, n).astype(np.float64)
+        sizes, tot = [], 0
+        while tot < n:
+            s = min(int(rng.randint(1, 40)), n - tot)
+            sizes.append(s)
+            tot += s
+        qid = np.repeat(np.arange(len(sizes)), sizes)
+        return X, y, np.asarray(sizes), qid
+
+    def test_streamed_qid_groups_bit_identical(self):
+        from lightgbm_tpu.io.streaming import ArrayChunkSource
+        X, y, sizes, qid = self._ranked()
+        ds_mem = Dataset.from_data(X, y, dict(FAST), group=sizes)
+        src = ArrayChunkSource(X, 64, label=y, qid=qid)
+        ds = stream_inner_dataset(src, config=dict(FAST))
+        np.testing.assert_array_equal(
+            np.asarray(ds.metadata.query_boundaries),
+            np.asarray(ds_mem.metadata.query_boundaries))
+        _assert_bit_identical(ds, ds_mem)
+
+    def test_qid_length_mismatch_raises(self):
+        from lightgbm_tpu.io.streaming import ArrayChunkSource
+        X, y, _, qid = self._ranked()
+        with pytest.raises(ValueError, match="qid length"):
+            ArrayChunkSource(X, 64, label=y, qid=qid[:-1])

@@ -74,6 +74,13 @@ def load_payload(path: str) -> Dict[str, Any]:
             raise ValueError("%s: ingest payload carries no positive "
                              "rows_per_s series" % path)
         return payload
+    if payload.get("kind") == "rank":
+        # rank captures (BENCH_RANK=1) gate on lambdarank training
+        # iters/s per A/B arm plus the bucketed pad-waste ratio
+        if not _rank_series(payload):
+            raise ValueError("%s: rank payload carries no positive "
+                             "iters_per_s series" % path)
+        return payload
     if payload.get("quality") == "noisy":
         raise ValueError("%s: capture was refused as noisy "
                          "(rejected_value=%s) — not comparable evidence"
@@ -137,6 +144,65 @@ def _ingest_series(payload: Dict[str, Any]) -> List[Tuple[str, float]]:
                 and r["rows_per_s"] > 0:
             rows.append((name, float(r["rows_per_s"])))
     return rows
+
+
+def _rank_series(payload: Dict[str, Any]) -> List[Tuple[str, float]]:
+    """(arm, iters_per_s) rows of a kind="rank" payload (BENCH_RANK=1):
+    the bucketed arm first, then the pad-to-max control.  HIGHER is
+    better."""
+    rows: List[Tuple[str, float]] = []
+    for arm in ("bucketed", "padded"):
+        r = payload.get(arm)
+        if isinstance(r, dict) and \
+                isinstance(r.get("iters_per_s"), (int, float)) \
+                and r["iters_per_s"] > 0:
+            rows.append((arm, float(r["iters_per_s"])))
+    return rows
+
+
+def _compare_rank(old: Dict[str, Any], new: Dict[str, Any],
+                  threshold: float) -> Dict[str, Any]:
+    old_rows = dict(_rank_series(old))
+    rows = []
+    for name, new_ips in _rank_series(new):
+        if name not in old_rows:
+            continue
+        old_ips = old_rows[name]
+        # training throughput: LOWER is the regression direction
+        change = new_ips / old_ips - 1.0
+        rows.append({
+            "series": name,
+            "old_iters_per_s": old_ips,
+            "new_iters_per_s": new_ips,
+            "change_pct": round(100.0 * change, 2),
+            "regression": bool(change < -threshold),
+        })
+    if not rows:
+        raise ValueError("rank captures share no iters_per_s series")
+    # bucketed pad waste gates alongside throughput: a ladder-choice
+    # regression shows up as growing padding long before wall-clock does
+    old_pw = (old.get("bucketed") or {}).get("pad_waste_ratio")
+    new_pw = (new.get("bucketed") or {}).get("pad_waste_ratio")
+    if isinstance(old_pw, (int, float)) and old_pw > 0 \
+            and isinstance(new_pw, (int, float)):
+        change = float(new_pw) / float(old_pw) - 1.0
+        rows.append({
+            "series": "pad_waste",
+            "old_pad_waste_ratio": float(old_pw),
+            "new_pad_waste_ratio": float(new_pw),
+            "change_pct": round(100.0 * change, 2),
+            "regression": bool(change > threshold),
+        })
+    return {
+        "tool": "bench_compare",
+        "kind": "rank",
+        "metric": new.get("metric"),
+        "threshold_pct": round(100.0 * threshold, 2),
+        "old_platform": old.get("platform"),
+        "new_platform": new.get("platform"),
+        "rows": rows,
+        "regressions": [r["series"] for r in rows if r["regression"]],
+    }
 
 
 def _compare_ingest(old: Dict[str, Any], new: Dict[str, Any],
@@ -224,14 +290,16 @@ def compare(old: Dict[str, Any], new: Dict[str, Any],
         raise ValueError(
             "metric mismatch: %r vs %r — different bench configurations "
             "are not comparable" % (old.get("metric"), new.get("metric")))
-    if old.get("kind") == "serve" or new.get("kind") == "serve" \
-            or old.get("kind") == "ingest" or new.get("kind") == "ingest":
+    if old.get("kind") in ("serve", "ingest", "rank") \
+            or new.get("kind") in ("serve", "ingest", "rank"):
         if old.get("kind") != new.get("kind"):
             raise ValueError("cannot compare a %s capture against a %s "
                              "capture" % (old.get("kind") or "training",
                                           new.get("kind") or "training"))
         if new.get("kind") == "ingest":
             return _compare_ingest(old, new, threshold)
+        if new.get("kind") == "rank":
+            return _compare_rank(old, new, threshold)
         return _compare_serve(old, new, threshold)
     old_rows = dict(_series(old))
     rows = []
@@ -312,7 +380,7 @@ def trend(paths: List[str], threshold: float) -> Dict[str, Any]:
             row.update(usable=False, reason=str(e).split(": ", 1)[-1])
             rows.append(row)
             continue
-        if payload.get("kind") in ("serve", "ingest"):
+        if payload.get("kind") in ("serve", "ingest", "rank"):
             row.update(usable=False,
                        reason="%s capture (trend tracks training "
                               "vs_baseline)" % payload["kind"])
@@ -409,6 +477,16 @@ def _render_text(payload: Dict[str, Any]) -> str:
                          "(%+.2f%%)  %s"
                          % (r["series"], r["old_rows_per_s"],
                             r["new_rows_per_s"], r["change_pct"], flag))
+        elif "old_iters_per_s" in r:
+            lines.append("  %-18s %8.4f iters/s -> %8.4f iters/s  "
+                         "(%+.2f%%)  %s"
+                         % (r["series"], r["old_iters_per_s"],
+                            r["new_iters_per_s"], r["change_pct"], flag))
+        elif "old_pad_waste_ratio" in r:
+            lines.append("  %-18s %8.4f -> %8.4f  (%+.2f%%)  %s"
+                         % (r["series"], r["old_pad_waste_ratio"],
+                            r["new_pad_waste_ratio"], r["change_pct"],
+                            flag))
         else:
             lines.append("  %-18s %8.4f -> %8.4f  (%+.2f%%)  %s"
                          % (r["series"], r["old_vs_baseline"],

@@ -54,6 +54,7 @@ _COMPILE_COUNTERS = (
     "fused_runner_cache_hits", "fused_runner_cache_misses",
     "xla_compile_events", "xla_program_lowerings",
     "serve_compile_hits", "serve_compile_misses",
+    "rank_compile_hits", "rank_compile_misses",
 )
 
 #: final-snapshot gauges surfaced as the "collective" join column
@@ -61,6 +62,10 @@ _COLLECTIVE_GAUGES = (
     "collective_s_per_pass", "collective_s_blocked",
     "collective_s_per_round", "overlap_efficiency", "overlap_on",
 )
+
+#: final-snapshot gauges surfaced as the "rank" join column (query
+#: bucketing geometry: padded-row overhead and ladder width)
+_RANK_GAUGES = ("rank_pad_rows", "rank_bucket_count")
 
 #: final-snapshot counters surfaced as the "watchtower" join column
 _WATCHTOWER_COUNTERS = (
@@ -293,6 +298,7 @@ def telemetry_stats(rows: List[Dict[str, Any]]) -> Dict[str, Any]:
                     if k in counters},
         "collective": {k: gauges[k] for k in _COLLECTIVE_GAUGES
                        if k in gauges},
+        "rank": {k: gauges[k] for k in _RANK_GAUGES if k in gauges},
         "watchtower": {k: counters[k] for k in _WATCHTOWER_COUNTERS
                        if k in counters},
     }
@@ -454,7 +460,7 @@ def _render_report(payload: Dict[str, Any]) -> str:
         if tel.get("last_round") is not None:
             lines.append(f"  rounds {tel['first_round']}"
                          f"..{tel['last_round']}")
-        for section in ("compile", "collective", "watchtower"):
+        for section in ("compile", "collective", "rank", "watchtower"):
             vals = tel.get(section) or {}
             if vals:
                 lines.append(f"  {section}:")
